@@ -1,0 +1,22 @@
+"""seaweedfs_tpu — a TPU-native distributed object/file store.
+
+A from-scratch re-design of the capabilities of SeaweedFS (reference:
+kyklaed/seaweedfs, mounted at /root/reference) built idiomatically on
+JAX/XLA/Pallas for TPU. The defining feature is the erasure-coding pipeline:
+RS(10,4) GF(256) Reed-Solomon encode / decode / missing-shard rebuild runs as
+batched uint8 bitsliced matmul kernels on the TPU MXU, selected via
+``ec_backend="tpu"`` with a C++/numpy CPU reference path for parity.
+
+Layer map (mirrors reference SURVEY.md §1, re-architected):
+  ops/       — GF(256) math, RS backends (cpu/xla/pallas), crc32c, compression
+  models/    — the EC pipeline "model": jittable encode/reconstruct programs
+  parallel/  — device-mesh sharding: pod-scale rebuild over ICI collectives
+  storage/   — needle codec, volume engine, needle maps, EC volumes (ref: weed/storage)
+  topology/  — master control plane: DC/rack/node tree, layout, growth (ref: weed/topology)
+  server/    — master + volume servers, HTTP data plane (ref: weed/server)
+  filer/     — namespace tier: Entry/FileChunk, chunk algebra (ref: weed/filer)
+  shell/     — admin shell commands: ec.encode/rebuild/decode/balance (ref: weed/shell)
+  utils/     — config, logging, metrics
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+"""Compute kernels: GF(256) Reed-Solomon (CPU/XLA/Pallas), CRC32C, codecs."""

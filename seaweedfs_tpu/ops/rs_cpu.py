@@ -1,0 +1,97 @@
+"""CPU Reed-Solomon backends: numpy reference + optional C++ native kernel.
+
+These are the parity/test oracle and the CPU baseline denominator for the
+TPU benchmark (the role klauspost/reedsolomon's SIMD assembly plays for the
+reference — see /root/reference/weed/storage/erasure_coding/ec_encoder.go:198).
+
+Both backends implement one primitive:
+
+    apply_matrix(M [m,k] GF(256), shards [k,B] u8) -> [m,B] u8
+
+from which encode (M = parity rows of the generator) and reconstruct
+(M = reconstruction matrix for the erasure pattern) are built in ops/rs.py.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from . import gf256
+
+
+def apply_matrix_numpy(m: np.ndarray, shards: np.ndarray) -> np.ndarray:
+    """XOR-accumulate of full-multiply-table gathers. Pure numpy."""
+    m = np.asarray(m, dtype=np.uint8)
+    shards = np.asarray(shards, dtype=np.uint8)
+    t = gf256.mul_table()
+    out = np.empty((m.shape[0], shards.shape[1]), dtype=np.uint8)
+    for i in range(m.shape[0]):
+        acc = t[m[i, 0]][shards[0]]
+        for j in range(1, m.shape[1]):
+            c = m[i, j]
+            if c == 0:
+                continue
+            acc = acc ^ t[c][shards[j]]
+        out[i] = acc
+    return out
+
+
+# --- optional C++ native backend (ops/../native/libswfs_native.so) ----------
+
+_native = None
+
+
+def _load_native():
+    global _native
+    if _native is not None:
+        return _native
+    so = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "native",
+        "libswfs_native.so",
+    )
+    if not os.path.exists(so):
+        _native = False
+        return False
+    try:
+        lib = ctypes.CDLL(so)
+        lib.gf256_apply_matrix.argtypes = [
+            ctypes.c_void_p,  # matrix [m,k]
+            ctypes.c_int,  # m
+            ctypes.c_int,  # k
+            ctypes.c_void_p,  # shards [k,B] row-major
+            ctypes.c_void_p,  # out [m,B]
+            ctypes.c_long,  # B
+        ]
+        lib.gf256_apply_matrix.restype = None
+        _native = lib
+        return lib
+    except OSError:
+        _native = False
+        return False
+
+
+def native_available() -> bool:
+    return bool(_load_native())
+
+
+def apply_matrix_native(m: np.ndarray, shards: np.ndarray) -> np.ndarray:
+    lib = _load_native()
+    if not lib:
+        raise RuntimeError("native library not built; run make -C seaweedfs_tpu/native")
+    m = np.ascontiguousarray(m, dtype=np.uint8)
+    shards = np.ascontiguousarray(shards, dtype=np.uint8)
+    rows, k = m.shape
+    b = shards.shape[1]
+    out = np.empty((rows, b), dtype=np.uint8)
+    lib.gf256_apply_matrix(
+        m.ctypes.data_as(ctypes.c_void_p),
+        rows,
+        k,
+        shards.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p),
+        b,
+    )
+    return out

@@ -1,0 +1,16 @@
+"""Test harness config: force CPU JAX with a virtual 8-device mesh.
+
+Mirrors the reference's approach of testing multi-node logic in-process
+(topology_test.go constructs Topology + fake heartbeats instead of spinning
+clusters): we test multi-chip sharding on a virtual CPU mesh instead of
+requiring a pod.  Real-TPU execution is covered by bench.py and
+__graft_entry__.py, which the driver runs on hardware.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,128 @@
+"""Field + matrix algebra tests for ops/gf256.
+
+Covers the invariants the reference's dep guarantees (and that byte-level
+shard compatibility rests on): field axioms under poly 0x11D, systematic
+Vandermonde generator, invertibility of every k-row submatrix, and the
+GF(2) bit-domain expansion matching byte-domain multiplication.
+"""
+import itertools
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops import gf256
+
+
+def test_exp_log_roundtrip():
+    for a in range(1, 256):
+        assert gf256.EXP_TABLE[gf256.LOG_TABLE[a]] == a
+
+
+def test_field_axioms_sampled():
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        a, b, c = (int(x) for x in rng.integers(0, 256, 3))
+        assert gf256.gf_mul(a, b) == gf256.gf_mul(b, a)
+        assert gf256.gf_mul(a, gf256.gf_mul(b, c)) == gf256.gf_mul(
+            gf256.gf_mul(a, b), c
+        )
+        # distributive over XOR (field addition)
+        assert gf256.gf_mul(a, b ^ c) == gf256.gf_mul(a, b) ^ gf256.gf_mul(a, c)
+    for a in range(1, 256):
+        assert gf256.gf_mul(a, gf256.gf_inv(a)) == 1
+        assert gf256.gf_div(a, a) == 1
+        assert gf256.gf_mul(a, 1) == a
+
+
+def test_known_values():
+    # 2*2=4, and the wraparound step: 0x80 * 2 = 0x11D & 0xFF = 0x1D
+    assert gf256.gf_mul(2, 2) == 4
+    assert gf256.gf_mul(0x80, 2) == 0x1D
+    assert gf256.gf_exp(2, 8) == 0x1D  # 2^8 = 2 * 0x80 with wraparound
+    assert gf256.gf_exp(2, 8) == gf256.gf_mul(gf256.gf_exp(2, 7), 2)
+
+
+def test_mul_table_matches_scalar():
+    t = gf256.mul_table()
+    rng = np.random.default_rng(1)
+    for _ in range(300):
+        a, b = (int(x) for x in rng.integers(0, 256, 2))
+        assert t[a, b] == gf256.gf_mul(a, b)
+
+
+def test_matrix_inverse():
+    rng = np.random.default_rng(2)
+    for n in (1, 3, 10):
+        for _ in range(5):
+            m = rng.integers(0, 256, (n, n)).astype(np.uint8)
+            try:
+                inv = gf256.gf_mat_inv(m)
+            except ValueError:
+                continue  # singular draw
+            ident = gf256.gf_mat_mul(m, inv)
+            assert np.array_equal(ident, np.eye(n, dtype=np.uint8))
+
+
+def test_singular_raises():
+    m = np.zeros((3, 3), dtype=np.uint8)
+    with pytest.raises(ValueError):
+        gf256.gf_mat_inv(m)
+
+
+def test_generator_systematic():
+    g = gf256.build_matrix(10, 14)
+    assert g.shape == (14, 10)
+    assert np.array_equal(g[:10], np.eye(10, dtype=np.uint8))
+    # parity rows must be all-nonzero for MDS property sanity
+    assert (g[10:] != 0).all()
+
+
+def test_any_10_rows_invertible():
+    """The MDS guarantee: every 10-row submatrix of the 14x10 generator is
+    invertible — any 10 surviving shards can rebuild the volume."""
+    g = gf256.build_matrix(10, 14)
+    for rows in itertools.combinations(range(14), 10):
+        inv = gf256.gf_mat_inv(g[list(rows)])  # raises if singular
+        assert inv.shape == (10, 10)
+
+
+def test_reconstruction_matrix_identity_when_data_present():
+    r, use = gf256.reconstruction_matrix(10, 14, present=list(range(10)), wanted=[3])
+    assert use == list(range(10))
+    expect = np.zeros((1, 10), dtype=np.uint8)
+    expect[0, 3] = 1
+    assert np.array_equal(r, expect)
+
+
+# Parity rows of the RS(10,4) Vandermonde-systematic generator, pinned as
+# constants so any change to the field polynomial or matrix construction —
+# which would silently break byte-compatibility with reference shard files —
+# fails this test rather than passing tautologically.
+PINNED_PARITY_ROWS = [
+    [129, 150, 175, 184, 210, 196, 254, 232, 3, 2],
+    [150, 129, 184, 175, 196, 210, 232, 254, 2, 3],
+    [191, 214, 98, 10, 6, 111, 223, 183, 5, 4],
+    [214, 191, 10, 98, 111, 6, 183, 223, 4, 5],
+]
+
+
+def test_generator_parity_rows_pinned():
+    g = gf256.build_matrix(10, 14)
+    assert g[10:].tolist() == PINNED_PARITY_ROWS
+
+
+def test_bit_expansion_matches_byte_domain():
+    rng = np.random.default_rng(3)
+    m = rng.integers(0, 256, (4, 10)).astype(np.uint8)
+    x = rng.integers(0, 256, (10, 64)).astype(np.uint8)
+    byte_out = gf256.gf_mat_mul(m, x)
+    a = gf256.expand_to_gf2(m)  # [32, 80]
+    bits = gf256.bytes_to_bits(x)  # [80, 64]
+    bit_out = (a.astype(np.int32) @ bits.astype(np.int32)) & 1
+    assert np.array_equal(gf256.bits_to_bytes(bit_out.astype(np.uint8)), byte_out)
+
+
+def test_bits_roundtrip():
+    rng = np.random.default_rng(4)
+    x = rng.integers(0, 256, (14, 100)).astype(np.uint8)
+    assert np.array_equal(gf256.bits_to_bytes(gf256.bytes_to_bits(x)), x)
