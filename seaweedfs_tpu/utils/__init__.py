@@ -1,0 +1,1 @@
+"""Shared infrastructure: config, logging, metrics, device timing."""

@@ -5,12 +5,22 @@ Mirrors the reference's approach of testing multi-node logic in-process
 clusters): we test multi-chip sharding on a virtual CPU mesh instead of
 requiring a pod.  Real-TPU execution is covered by bench.py and
 __graft_entry__.py, which the driver runs on hardware.
+
+IMPORTANT rig detail: this box's sitecustomize imports jax at interpreter
+start and registers the tunneled single-client "axon" TPU platform, baking
+JAX_PLATFORMS=axon into jax.config before this file runs.  Setting the env
+var here is therefore too late — we must update jax.config directly, or
+every pytest run would claim (and contend for) the TPU session.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests spawn
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
