@@ -1,0 +1,35 @@
+"""Shared loader for the C++ native library (libswfs_native.so).
+
+One dlopen per process; each consumer module registers its own function
+signatures on the shared handle.  Returns False when the library isn't built
+(make -C seaweedfs_tpu/native) so callers can fall back to numpy paths.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+_handle: ctypes.CDLL | bool | None = None
+
+
+def load() -> ctypes.CDLL | bool:
+    global _handle
+    if _handle is None:
+        so = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "native",
+            "libswfs_native.so",
+        )
+        if not os.path.exists(so):
+            _handle = False
+        else:
+            try:
+                _handle = ctypes.CDLL(so)
+            except OSError:
+                _handle = False
+    return _handle
+
+
+def reset_for_tests() -> None:
+    global _handle
+    _handle = None

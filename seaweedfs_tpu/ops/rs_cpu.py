@@ -14,11 +14,10 @@ from which encode (M = parity rows of the generator) and reconstruct
 from __future__ import annotations
 
 import ctypes
-import os
 
 import numpy as np
 
-from . import gf256
+from . import _native, gf256
 
 
 def apply_matrix_numpy(m: np.ndarray, shards: np.ndarray) -> np.ndarray:
@@ -40,23 +39,10 @@ def apply_matrix_numpy(m: np.ndarray, shards: np.ndarray) -> np.ndarray:
 
 # --- optional C++ native backend (ops/../native/libswfs_native.so) ----------
 
-_native = None
-
 
 def _load_native():
-    global _native
-    if _native is not None:
-        return _native
-    so = os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "native",
-        "libswfs_native.so",
-    )
-    if not os.path.exists(so):
-        _native = False
-        return False
-    try:
-        lib = ctypes.CDLL(so)
+    lib = _native.load()
+    if lib and not getattr(lib, "_gf256_bound", False):
         lib.gf256_apply_matrix.argtypes = [
             ctypes.c_void_p,  # matrix [m,k]
             ctypes.c_int,  # m
@@ -66,11 +52,8 @@ def _load_native():
             ctypes.c_long,  # B
         ]
         lib.gf256_apply_matrix.restype = None
-        _native = lib
-        return lib
-    except OSError:
-        _native = False
-        return False
+        lib._gf256_bound = True
+    return lib
 
 
 def native_available() -> bool:

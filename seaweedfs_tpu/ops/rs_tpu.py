@@ -122,12 +122,13 @@ def _tile_for(b: int) -> int:
     return min(BATCH_TILE, max(128, -(-b // 128) * 128))
 
 
-def _apply_pallas(a_bm: jax.Array, x: jax.Array, interpret: bool) -> jax.Array:
+def _apply_pallas(
+    a_bm: jax.Array, x: jax.Array, interpret: bool, tile: int
+) -> jax.Array:
     m8, k8 = a_bm.shape
     k, b = x.shape
     assert k8 == 8 * k, (a_bm.shape, x.shape)
     m = m8 // 8
-    tile = _tile_for(b)
     grid = (pl.cdiv(b, tile),)
     return pl.pallas_call(
         _gf2_matmul_kernel,
@@ -148,19 +149,26 @@ def _apply_pallas(a_bm: jax.Array, x: jax.Array, interpret: bool) -> jax.Array:
 # --- jitted entry points ----------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("kernel", "interpret"))
+@functools.partial(jax.jit, static_argnames=("kernel", "interpret", "tile"))
 def apply_matrix_device(
-    a_bm: jax.Array, x: jax.Array, kernel: str = "pallas", interpret: bool = False
+    a_bm: jax.Array,
+    x: jax.Array,
+    kernel: str = "pallas",
+    interpret: bool = False,
+    tile: int | None = None,
 ) -> jax.Array:
     """Device-resident apply: bit-major matrix [8m,8k] bf16, shards [k,B] u8
     -> [m,B] u8.  For the pallas kernel B is padded to the block tile (the
-    pad region computes garbage that is sliced off); XLA needs no pad."""
+    pad region computes garbage that is sliced off); XLA needs no pad.
+    `tile` is an explicit static override (tests, tuning) — by default it is
+    derived from B so the jit cache stays consistent."""
     if kernel == "pallas":
         b = x.shape[1]
-        pad = (-b) % _tile_for(b)
+        tile = tile or _tile_for(b)
+        pad = (-b) % tile
         if pad:
             x = jnp.pad(x, ((0, 0), (0, pad)))
-        out = _apply_pallas(a_bm, x, interpret)
+        out = _apply_pallas(a_bm, x, interpret, tile)
         return out[:, :b] if pad else out
     if kernel == "xla":
         return _apply_xla(a_bm, x)
@@ -184,7 +192,10 @@ def _prepared(matrix_bytes: bytes, m: int, k: int) -> jax.Array:
 
 
 def apply_matrix(
-    m_gf: np.ndarray, shards: np.ndarray, kernel: str = "pallas"
+    m_gf: np.ndarray,
+    shards: np.ndarray,
+    kernel: str = "pallas",
+    tile: int | None = None,
 ) -> np.ndarray:
     """Host-convenience apply (numpy in/out). Pipelines that care about
     staging (storage/ec/encoder.py) use apply_matrix_device directly."""
@@ -192,5 +203,7 @@ def apply_matrix(
     rows = m_gf.shape[0]
     a_bm = _prepared(m_gf.tobytes(), *m_gf.shape)
     x = jnp.asarray(np.ascontiguousarray(shards, dtype=np.uint8))
-    out = apply_matrix_device(a_bm, x, kernel=kernel, interpret=_interpret_default())
+    out = apply_matrix_device(
+        a_bm, x, kernel=kernel, interpret=_interpret_default(), tile=tile
+    )
     return np.asarray(out)[:rows]

@@ -58,14 +58,13 @@ def test_cross_backend_identical():
     assert np.array_equal(outs[0], outs[2])
 
 
-def test_large_batch_tiling(monkeypatch):
-    """B spanning multiple grid tiles incl. a ragged tail (tile shrunk so
-    interpret mode stays fast; the real-TPU multi-tile path is exercised by
-    bench.py on hardware)."""
-    monkeypatch.setattr(rs_tpu, "BATCH_TILE", 512)
+def test_large_batch_tiling():
+    """B spanning multiple grid tiles incl. a ragged tail (explicit small
+    tile so interpret mode stays fast; the real-TPU multi-tile path is
+    exercised by bench.py on hardware)."""
     m = gf256.parity_matrix(10, 14)
     x = _rand(10, 3 * 512 + 77, 5)
     assert np.array_equal(
-        rs_tpu.apply_matrix(m, x, kernel="pallas"),
+        rs_tpu.apply_matrix(m, x, kernel="pallas", tile=512),
         rs_cpu.apply_matrix_numpy(m, x),
     )
