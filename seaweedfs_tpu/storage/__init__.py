@@ -1,0 +1,6 @@
+"""Storage engine: needle codec, volume files, needle maps, erasure coding.
+
+The data plane of the framework (reference: weed/storage/).  A Volume is an
+append-only `.dat` file of CRC-checked needles plus a `.idx` offset index;
+EC volumes stripe a `.dat` into 14 shard files with TPU-batched RS(10,4).
+"""

@@ -1,0 +1,95 @@
+"""Vacuum: reclaim deleted-needle space by copying live records.
+
+Reference behavior (weed/storage/volume_vacuum.go): Compact2 copies live
+needles into shadow files (.cpd/.cpx), then commitCompact applies
+`makeupDiff` — index entries appended since the snapshot (writes that raced
+the copy) are replayed onto the shadow — and atomically renames.  Same
+protocol here; the compaction revision increments in the new superblock.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+
+from . import idx as idx_mod
+from . import needle as needle_mod
+from . import needle_map
+from . import types as t
+from .super_block import SUPER_BLOCK_SIZE, SuperBlock
+from .volume import Volume
+
+
+def compact(v: Volume) -> tuple[str, str, int]:
+    """Phase 1: copy live needles to .cpd/.cpx. Returns (cpd, cpx,
+    idx_snapshot_bytes) — the snapshot marks where makeupDiff starts."""
+    base = Volume.base_name(v.dir, v.id, v.collection)
+    cpd, cpx = base + ".cpd", base + ".cpx"
+    v.sync()
+    idx_snapshot = os.path.getsize(v.idx_path)
+
+    # The live superblock is untouched until commit(); only the shadow file
+    # carries the bumped revision.
+    new_sb = dataclasses.replace(
+        v.super_block, compaction_revision=v.super_block.compaction_revision + 1
+    )
+    with open(cpd, "wb") as dat, open(cpx, "wb") as xf:
+        dat.write(new_sb.to_bytes())
+        for rec_offset, n in v.scan():
+            loc = v.nm.get(n.id)
+            if loc is None or loc[0] != rec_offset:
+                # deleted, or superseded by a later rewrite of the same id
+                # (the reference compares nv.Offset to the scan offset,
+                # volume_vacuum.go Compact copy loop)
+                continue
+            offset = dat.tell()
+            record = n.to_bytes(v.version)
+            dat.write(record)
+            xf.write(idx_mod.pack_entry(n.id, offset, n.size))
+    return cpd, cpx, idx_snapshot
+
+
+def commit(v: Volume, cpd: str, cpx: str, idx_snapshot: int) -> None:
+    """Phase 2: replay post-snapshot index entries onto the shadow files
+    (makeupDiff, volume_vacuum.go:200), then rename over the originals."""
+    with v._lock:
+        v.sync()
+        with open(v.idx_path, "rb") as f:
+            f.seek(idx_snapshot)
+            diff = f.read()
+        if diff:
+            ids, offs, sizes = idx_mod.parse_buffer(diff)
+            with open(cpd, "r+b") as dat, open(cpx, "ab") as xf, open(
+                v.dat_path, "rb"
+            ) as old:
+                for i in range(len(ids)):
+                    nid, off, size = int(ids[i]), int(offs[i]), int(sizes[i])
+                    if t.size_is_valid(size):
+                        # racing write: copy the record across
+                        total = needle_mod.actual_size(size, v.version)
+                        old.seek(off)
+                        record = old.read(total)
+                        dat.seek(0, os.SEEK_END)
+                        new_off = dat.tell()
+                        dat.write(record)
+                        xf.write(idx_mod.pack_entry(nid, new_off, size))
+                    else:
+                        xf.write(
+                            idx_mod.pack_entry(nid, 0, t.TOMBSTONE_FILE_SIZE)
+                        )
+        v._dat.close()
+        v._idx.close()
+        os.replace(cpd, v.dat_path)
+        os.replace(cpx, v.idx_path)
+        with open(v.dat_path, "rb") as f:
+            v.super_block = SuperBlock.from_bytes(f.read(SUPER_BLOCK_SIZE))
+        v.nm = needle_map.CompactMap.load_from_idx(v.idx_path)
+        v._dat = open(v.dat_path, "r+b")
+        v._idx = open(v.idx_path, "ab")
+
+
+def vacuum(v: Volume) -> float:
+    """Full compact+commit. Returns the garbage ratio that was reclaimed."""
+    ratio = v.garbage_ratio
+    cpd, cpx, snap = compact(v)
+    commit(v, cpd, cpx, snap)
+    return ratio

@@ -1,0 +1,369 @@
+"""Storage engine tests on real tmp files — the reference tests the volume
+engine against the OS, not a fake filesystem (volume_read_test.go,
+volume_write_test.go, volume_vacuum_test.go)."""
+import os
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.storage import idx, needle_map, vacuum
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.storage.needle import (
+    CURRENT_VERSION,
+    CrcError,
+    Needle,
+    actual_size,
+    padding_length,
+)
+from seaweedfs_tpu.storage.super_block import SuperBlock
+from seaweedfs_tpu.storage.volume import (
+    CookieMismatch,
+    NotFoundError,
+    Volume,
+    VolumeReadOnly,
+)
+
+
+# --- scalar types -----------------------------------------------------------
+
+
+def test_ttl_roundtrip():
+    for s in ("", "5m", "3h", "2d", "1w", "6M", "1y"):
+        ttl = t.TTL.parse(s)
+        assert str(ttl) == s
+        assert t.TTL.from_bytes(ttl.to_bytes()) == ttl
+    assert t.TTL.parse("3h").minutes == 180
+    with pytest.raises(ValueError):
+        t.TTL.parse("7q")
+
+
+def test_replica_placement():
+    rp = t.ReplicaPlacement.parse("012")
+    assert (rp.diff_dc, rp.diff_rack, rp.same_rack) == (0, 1, 2)
+    assert rp.copy_count == 4
+    assert t.ReplicaPlacement.from_byte(rp.to_byte()) == rp
+    with pytest.raises(ValueError):
+        t.ReplicaPlacement.parse("039")
+
+
+def test_fid_roundtrip():
+    fid = t.format_fid(3, 0x0163, 0x7037D6AA)
+    vid, nid, cookie = t.parse_fid(fid)
+    assert (vid, nid, cookie) == (3, 0x0163, 0x7037D6AA)
+    with pytest.raises(ValueError):
+        t.parse_fid("nonsense")
+    with pytest.raises(ValueError):
+        t.parse_fid("3,ab")  # too short for cookie
+
+
+def test_offset_encoding():
+    for off in (0, 8, 4096, 2**32):
+        assert t.offset_from_bytes(t.offset_to_bytes(off)) == off
+
+
+# --- needle codec -----------------------------------------------------------
+
+
+def test_needle_roundtrip_v2_v3():
+    for version in (2, 3):
+        n = Needle(
+            id=0xABCDEF,
+            cookie=0x12345678,
+            data=b"hello needle world",
+            name=b"file.txt",
+            mime=b"text/plain",
+            last_modified=1_700_000_000,
+            ttl=t.TTL.parse("3d"),
+            pairs=b'{"k":"v"}',
+        )
+        buf = n.to_bytes(version)
+        assert len(buf) % 8 == 0
+        m = Needle.from_bytes(buf, version)
+        assert m.id == n.id and m.cookie == n.cookie
+        assert m.data == n.data and m.name == n.name and m.mime == n.mime
+        assert m.last_modified == n.last_modified
+        assert str(m.ttl) == "3d"
+        assert m.pairs == n.pairs
+        if version == 3:
+            assert m.append_at_ns == n.append_at_ns
+
+
+def test_needle_v1_roundtrip():
+    n = Needle(id=7, cookie=9, data=b"v1 payload")
+    buf = n.to_bytes(1)
+    m = Needle.from_bytes(buf, 1)
+    assert m.data == n.data
+
+
+def test_needle_crc_detects_corruption():
+    n = Needle(id=1, cookie=2, data=b"payload bytes here")
+    buf = bytearray(n.to_bytes())
+    buf[t.NEEDLE_HEADER_SIZE + 4 + 3] ^= 0xFF  # flip a data byte
+    with pytest.raises(CrcError):
+        Needle.from_bytes(bytes(buf))
+
+
+def test_padding_quirk_matches_reference():
+    """PaddingLength returns 8 - (x % 8), i.e. 8 (not 0) when aligned —
+    reproduced for byte compatibility (needle_read.go:198-204)."""
+    for size in range(0, 64):
+        pad = padding_length(size, 3)
+        assert 1 <= pad <= 8
+        assert (16 + size + 4 + 8 + pad) % 8 == 0
+        assert actual_size(size, 3) == 16 + size + 4 + 8 + pad
+
+
+def test_empty_data_needle():
+    n = Needle(id=5, cookie=6, data=b"", name=b"ignored-when-empty")
+    buf = n.to_bytes()
+    m = Needle.from_bytes(buf)
+    assert m.size == 0 and m.data == b""
+
+
+# --- superblock -------------------------------------------------------------
+
+
+def test_superblock_roundtrip():
+    sb = SuperBlock(
+        version=3,
+        replica_placement=t.ReplicaPlacement.parse("001"),
+        ttl=t.TTL.parse("1w"),
+        compaction_revision=7,
+    )
+    b = sb.to_bytes()
+    assert len(b) == 8
+    sb2 = SuperBlock.from_bytes(b)
+    assert sb2 == sb
+
+
+# --- idx + needle maps ------------------------------------------------------
+
+
+def test_idx_pack_parse(tmp_path):
+    p = tmp_path / "x.idx"
+    entries = [(1, 8, 100), (2, 136, 50), (1, 0, t.TOMBSTONE_FILE_SIZE)]
+    with open(p, "wb") as f:
+        for e in entries:
+            f.write(idx.pack_entry(*e))
+    assert list(idx.walk(str(p))) == entries
+    assert idx.entry_count(str(p)) == 3
+
+
+def test_compact_map_replay(tmp_path):
+    p = tmp_path / "v.idx"
+    with open(p, "wb") as f:
+        f.write(idx.pack_entry(10, 8, 100))
+        f.write(idx.pack_entry(11, 112, 200))
+        f.write(idx.pack_entry(10, 0, t.TOMBSTONE_FILE_SIZE))
+        f.write(idx.pack_entry(12, 320, 300))
+    m = needle_map.CompactMap.load_from_idx(str(p))
+    assert m.get(10) is None
+    assert m.get(11) == (112, 200)
+    assert len(m) == 2
+    assert m.stats.deleted_count == 1
+    assert m.stats.deleted_bytes == 100
+    assert m.stats.maximum_key == 12
+
+
+def test_memdb_sorted(tmp_path):
+    p = tmp_path / "v.idx"
+    with open(p, "wb") as f:
+        for nid in (5, 3, 9, 1):
+            f.write(idx.pack_entry(nid, nid * 8, 10))
+    db = needle_map.MemDb.load_from_idx(str(p))
+    assert list(db.ids) == [1, 3, 5, 9]
+    assert db.get(5) == (40, 10)
+    assert db.get(4) is None
+
+
+# --- volume engine ----------------------------------------------------------
+
+
+def _fill(v, count=20, seed=0):
+    rng = np.random.default_rng(seed)
+    blobs = {}
+    for i in range(1, count + 1):
+        data = rng.integers(0, 256, int(rng.integers(1, 2000)), dtype=np.uint8).tobytes()
+        v.write(i, 0xC0FFEE + i, data, name=f"f{i}".encode())
+        blobs[i] = data
+    return blobs
+
+
+def test_volume_write_read_delete(tmp_path):
+    v = Volume(str(tmp_path), 1, collection="col")
+    blobs = _fill(v)
+    for nid, data in blobs.items():
+        n = v.read(nid, cookie=0xC0FFEE + nid)
+        assert n.data == data
+    with pytest.raises(CookieMismatch):
+        v.read(3, cookie=0xDEAD)
+    with pytest.raises(NotFoundError):
+        v.read(999)
+    assert v.delete(5) > 0
+    with pytest.raises(NotFoundError):
+        v.read(5)
+    assert v.delete(5) == 0  # second delete is a no-op
+    v.close()
+
+
+def test_volume_reload_from_disk(tmp_path):
+    v = Volume(str(tmp_path), 2)
+    blobs = _fill(v, count=10, seed=1)
+    v.delete(4)
+    v.close()
+    v2 = Volume(str(tmp_path), 2)
+    assert not v2.has(4)
+    for nid, data in blobs.items():
+        if nid == 4:
+            continue
+        assert v2.read(nid).data == data
+    v2.close()
+
+
+def test_volume_readonly(tmp_path):
+    v = Volume(str(tmp_path), 3)
+    v.read_only = True
+    with pytest.raises(VolumeReadOnly):
+        v.write(1, 1, b"x")
+    with pytest.raises(VolumeReadOnly):
+        v.delete(1)
+    v.close()
+
+
+def test_volume_scan_record_semantics(tmp_path):
+    """scan() yields stored records in file order (superseded ones
+    included — liveness is the needle map's call, as in the reference's
+    ScanVolumeFile); tombstone records only appear with include_deleted."""
+    v = Volume(str(tmp_path), 4)
+    _fill(v, count=8, seed=2)
+    v.delete(2)
+    v.delete(7)
+    records = [n.id for _, n in v.scan()]
+    assert records == list(range(1, 9))  # originals still on disk
+    live = [n.id for _, n in v.scan() if v.nm.has(n.id)]
+    assert set(live) == {1, 3, 4, 5, 6, 8}
+    with_tombs = [n.id for _, n in v.scan(include_deleted=True)]
+    assert with_tombs == records + [2, 7]  # tombstone appends at the tail
+    v.close()
+
+
+def test_vacuum_reclaims_space(tmp_path):
+    v = Volume(str(tmp_path), 5)
+    blobs = _fill(v, count=30, seed=3)
+    for nid in range(1, 16):
+        v.delete(nid)
+    size_before = v.content_size
+    ratio = vacuum.vacuum(v)
+    assert ratio > 0.3
+    assert v.content_size < size_before
+    assert v.super_block.compaction_revision == 1
+    for nid in range(16, 31):
+        assert v.read(nid).data == blobs[nid]
+    for nid in range(1, 16):
+        assert not v.has(nid)
+    # volume still writable after vacuum
+    v.write(100, 1, b"post-vacuum write")
+    assert v.read(100).data == b"post-vacuum write"
+    v.close()
+    # and reloads cleanly
+    v2 = Volume(str(tmp_path), 5)
+    assert v2.read(100).data == b"post-vacuum write"
+    assert needle_map.verify_index_integrity(v2.dat_path, v2.idx_path, 3) == 16
+    v2.close()
+
+
+def test_vacuum_with_racing_write(tmp_path):
+    """makeupDiff: a write that lands between compact and commit survives."""
+    v = Volume(str(tmp_path), 6)
+    _fill(v, count=5, seed=4)
+    v.delete(1)
+    cpd, cpx, snap = vacuum.compact(v)
+    v.write(50, 0xAA, b"racing write")  # lands after snapshot
+    v.delete(2)  # racing delete
+    vacuum.commit(v, cpd, cpx, snap)
+    assert v.read(50).data == b"racing write"
+    assert not v.has(2)
+    assert not v.has(1)
+    assert v.read(3).data  # pre-existing survives
+    v.close()
+
+
+def test_vacuum_after_overwrite_keeps_latest(tmp_path):
+    """A needle rewritten under the same id must survive vacuum exactly
+    once, with the latest contents."""
+    v = Volume(str(tmp_path), 8)
+    v.write(1, 0xA, b"version one")
+    v.write(2, 0xB, b"other")
+    v.write(1, 0xA, b"version two, the keeper")
+    vacuum.vacuum(v)
+    assert v.read(1).data == b"version two, the keeper"
+    assert v.read(2).data == b"other"
+    # exactly 2 live records on disk after vacuum
+    assert len([1 for _ in v.scan()]) == 2
+    v.close()
+
+
+def test_tail_recovery_after_crash(tmp_path):
+    """Crash between .dat append and .idx append: the record is re-indexed
+    at next load; a torn partial record is ignored and healed."""
+    v = Volume(str(tmp_path), 9)
+    v.write(1, 0xA, b"indexed record")
+    # simulate: record durably in .dat, idx entry lost
+    n = Needle(id=2, cookie=0xB, data=b"unindexed but complete")
+    record = n.to_bytes(v.version)
+    with open(v.dat_path, "ab") as f:
+        f.write(record)
+    # plus a torn partial record at EOF
+    torn = Needle(id=3, cookie=0xC, data=b"never fully written").to_bytes(v.version)
+    with open(v.dat_path, "ab") as f:
+        f.write(torn[: len(torn) // 2])
+    v.close()
+
+    v2 = Volume(str(tmp_path), 9)
+    assert v2.read(2).data == b"unindexed but complete"  # recovered
+    assert not v2.has(3)  # torn record dropped
+    v2.write(4, 0xD, b"post-recovery append")
+    assert v2.read(4).data == b"post-recovery append"
+    assert v2.read(1).data == b"indexed record"
+    v2.close()
+    # idempotent: loading again recovers nothing new
+    v3 = Volume(str(tmp_path), 9)
+    assert sorted(nid for nid, _, _ in v3.nm.items()) == [1, 2, 4]
+    v3.close()
+
+
+def test_compact_leaves_live_superblock_untouched(tmp_path):
+    v = Volume(str(tmp_path), 10)
+    v.write(1, 0xA, b"x")
+    cpd, cpx, snap = vacuum.compact(v)
+    assert v.super_block.compaction_revision == 0  # bump only lands at commit
+    vacuum.commit(v, cpd, cpx, snap)
+    assert v.super_block.compaction_revision == 1
+    v.close()
+
+
+def test_scan_stops_at_torn_tail(tmp_path):
+    v = Volume(str(tmp_path), 11)
+    v.write(1, 0xA, b"whole record")
+    v.sync()
+    with open(v.dat_path, "ab") as f:
+        f.write(b"\xff" * 21)  # garbage partial "record"
+    assert [n.id for _, n in v.scan()] == [1]  # no crash
+    vacuum.vacuum(v)  # vacuum also survives
+    assert v.read(1).data == b"whole record"
+    v.close()
+
+
+def test_index_integrity_checker(tmp_path):
+    v = Volume(str(tmp_path), 7)
+    _fill(v, count=5, seed=5)
+    v.close()
+    # corrupt the idx: point needle 3 at the wrong offset
+    entries = list(idx.walk(v.idx_path))
+    with open(v.idx_path, "wb") as f:
+        for nid, off, size in entries:
+            if nid == 3:
+                off = 8
+            f.write(idx.pack_entry(nid, off, size))
+    with pytest.raises(ValueError, match="mismatch"):
+        needle_map.verify_index_integrity(v.dat_path, v.idx_path, 3)
