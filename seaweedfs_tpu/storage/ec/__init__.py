@@ -1,0 +1,49 @@
+"""Erasure coding: RS(10,4) striping of volumes into 14 shard files.
+
+Reference: /root/reference/weed/storage/erasure_coding/ (1,429 LoC Go).
+File formats preserved byte-for-byte (.ec00-.ec13, .ecx, .ecj) so volumes
+encoded here are readable by the reference and vice versa; the GF(256) math
+runs through seaweedfs_tpu.ops.rs (CPU SIMD or TPU MXU backends).
+"""
+from .layout import (
+    DATA_SHARDS,
+    LARGE_BLOCK_SIZE,
+    PARITY_SHARDS,
+    SMALL_BLOCK_SIZE,
+    TOTAL_SHARDS,
+    Interval,
+    ShardBits,
+    locate_data,
+    to_ext,
+)
+from .encoder import (
+    ec_base_name,
+    rebuild_ec_files,
+    write_ec_files,
+    write_sorted_file_from_idx,
+)
+from .decoder import find_dat_file_size, write_dat_file, write_idx_file_from_ec_index
+from .volume import EcVolume, EcVolumeShard, NeedleNotFound, rebuild_ecx_file
+
+__all__ = [
+    "DATA_SHARDS",
+    "PARITY_SHARDS",
+    "TOTAL_SHARDS",
+    "LARGE_BLOCK_SIZE",
+    "SMALL_BLOCK_SIZE",
+    "Interval",
+    "ShardBits",
+    "locate_data",
+    "to_ext",
+    "ec_base_name",
+    "write_ec_files",
+    "rebuild_ec_files",
+    "write_sorted_file_from_idx",
+    "write_dat_file",
+    "write_idx_file_from_ec_index",
+    "find_dat_file_size",
+    "EcVolume",
+    "EcVolumeShard",
+    "NeedleNotFound",
+    "rebuild_ecx_file",
+]

@@ -40,12 +40,19 @@ class CompactMap:
     def __init__(self):
         self._m: dict[int, tuple[int, int]] = {}
         self.stats = MapStats()
+        self._live = 0
+        # Highest end-offset any .idx entry ever claimed in .dat (including
+        # entries later superseded or tombstoned) — the tail-recovery
+        # watermark, computed during load instead of a second .idx pass.
+        self.indexed_end = 0
 
     def set(self, needle_id: int, actual_offset: int, size: int) -> None:
         old = self._m.get(needle_id)
         if old is not None and t.size_is_valid(old[1]):
             self.stats.deleted_count += 1
             self.stats.deleted_bytes += old[1]
+        elif old is None or not t.size_is_valid(old[1]):
+            self._live += 1
         self._m[needle_id] = (actual_offset, size)
         self.stats.file_count += 1
         self.stats.file_bytes += max(size, 0)
@@ -59,6 +66,7 @@ class CompactMap:
         self._m[needle_id] = (old[0], t.TOMBSTONE_FILE_SIZE)
         self.stats.deleted_count += 1
         self.stats.deleted_bytes += old[1]
+        self._live -= 1
         return old[1]
 
     def get(self, needle_id: int) -> tuple[int, int] | None:
@@ -72,7 +80,7 @@ class CompactMap:
         return self.get(needle_id) is not None
 
     def __len__(self) -> int:
-        return sum(1 for v in self._m.values() if t.size_is_valid(v[1]))
+        return self._live
 
     def items(self):
         for k, (off, size) in self._m.items():
@@ -82,9 +90,11 @@ class CompactMap:
     # -- .idx persistence ----------------------------------------------------
 
     @classmethod
-    def load_from_idx(cls, path: str) -> "CompactMap":
+    def load_from_idx(cls, path: str, version: int | None = None) -> "CompactMap":
         """Replay a .idx into a live map (volume_loading.go behavior:
-        tombstones and re-writes applied in order)."""
+        tombstones and re-writes applied in order).  When `version` is given,
+        `indexed_end` tracks the highest record end any entry claims so
+        Volume tail recovery needs no second .idx read."""
         m = cls()
         if not os.path.exists(path):
             return m
@@ -94,6 +104,10 @@ class CompactMap:
             nid, off, size = int(ids[i]), int(offs[i]), int(sizes[i])
             if t.size_is_valid(size):
                 m.set(nid, off, size)
+                if version is not None:
+                    end = off + needle_mod.actual_size(size, version)
+                    if end > m.indexed_end:
+                        m.indexed_end = end
             else:
                 m.delete(nid)
         return m

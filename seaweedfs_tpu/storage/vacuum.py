@@ -76,14 +76,22 @@ def commit(v: Volume, cpd: str, cpx: str, idx_snapshot: int) -> None:
                         xf.write(
                             idx_mod.pack_entry(nid, 0, t.TOMBSTONE_FILE_SIZE)
                         )
-        v._dat.close()
         v._idx.close()
         os.replace(cpd, v.dat_path)
         os.replace(cpx, v.idx_path)
         with open(v.dat_path, "rb") as f:
             v.super_block = SuperBlock.from_bytes(f.read(SUPER_BLOCK_SIZE))
-        v.nm = needle_map.CompactMap.load_from_idx(v.idx_path)
-        v._dat = open(v.dat_path, "r+b")
+        # Publish the new (dat, nm) pair as one atomic reference swap; the
+        # old dat file object is deliberately NOT closed here — lock-free
+        # readers that captured the previous _ReadState keep preading the
+        # old (pre-rename) inode and the fd closes via refcounting when the
+        # last of them finishes.
+        from .volume import _ReadState
+
+        v._state = _ReadState(
+            open(v.dat_path, "r+b"),
+            needle_map.CompactMap.load_from_idx(v.idx_path, v.version),
+        )
         v._idx = open(v.idx_path, "ab")
 
 

@@ -15,10 +15,14 @@ dataFileAccessLock); reads use positional pread and need no lock.
 Crash consistency: a record is durable once both the .dat bytes and the
 .idx entry are flushed.  If the process dies between the two, load-time
 tail recovery (_recover_tail, the CheckVolumeDataIntegrity analogue in
-volume_loading/volume_checking.go) scans .dat past the last indexed byte
-and re-indexes complete, CRC-valid records; a torn partial record at EOF
-is ignored and healed (overwritten from the 8-aligned boundary) by the
-next append.
+volume_loading/volume_checking.go) scans .dat past the last indexed byte,
+re-indexes complete CRC-valid records, and truncates any torn/corrupt
+tail so the file ends on a record boundary.
+
+Vacuum swap: reads are lock-free, so the (dat file, needle map) pair is
+published as one immutable _ReadState; commit() swaps the whole state in a
+single reference assignment and leaves the old dat file open for readers
+still holding the previous state (closed by refcounting when they finish).
 """
 from __future__ import annotations
 
@@ -62,6 +66,16 @@ class VolumeInfo:
     compact_revision: int
 
 
+class _ReadState:
+    """Immutable (dat file, needle map) pair captured by lock-free reads."""
+
+    __slots__ = ("dat", "nm")
+
+    def __init__(self, dat, nm):
+        self.dat = dat
+        self.nm = nm
+
+
 class Volume:
     def __init__(
         self,
@@ -84,8 +98,8 @@ class Volume:
         if os.path.exists(self.dat_path):
             with open(self.dat_path, "rb") as f:
                 self.super_block = SuperBlock.from_bytes(f.read(SUPER_BLOCK_SIZE))
-            self.nm = needle_map.CompactMap.load_from_idx(self.idx_path)
-            self._recover_tail()
+            nm = needle_map.CompactMap.load_from_idx(self.idx_path, self.version)
+            self._recover_tail(nm)
         else:
             self.super_block = SuperBlock(
                 version=version,
@@ -95,26 +109,26 @@ class Volume:
             with open(self.dat_path, "wb") as f:
                 f.write(self.super_block.to_bytes())
             open(self.idx_path, "ab").close()
-            self.nm = needle_map.CompactMap()
-        self._dat = open(self.dat_path, "r+b")
+            nm = needle_map.CompactMap()
+        self._state = _ReadState(open(self.dat_path, "r+b"), nm)
         self._idx = open(self.idx_path, "ab")
 
-    def _recover_tail(self) -> None:
+    @property
+    def nm(self) -> needle_map.CompactMap:
+        return self._state.nm
+
+    @property
+    def _dat(self):
+        return self._state.dat
+
+    def _recover_tail(self, nm: needle_map.CompactMap) -> None:
         """Re-index complete CRC-valid records written after the last .idx
-        entry (crash between .dat append and .idx append).  Only size>0
+        entry (crash between .dat append and .idx append), then truncate any
+        torn or corrupt tail to the last record boundary.  Only size>0
         records are recovered — a trailing size-0 record is ambiguous
         between an empty write and a delete tombstone, and the reference's
         tombstones are always paired with their idx entry anyway."""
-        indexed_end = SUPER_BLOCK_SIZE
-        if os.path.exists(self.idx_path):
-            with open(self.idx_path, "rb") as f:
-                ids, offs, sizes = idx_mod.parse_buffer(f.read())
-            for i in range(len(ids)):
-                if t.size_is_valid(int(sizes[i])):
-                    end = int(offs[i]) + needle_mod.actual_size(
-                        int(sizes[i]), self.version
-                    )
-                    indexed_end = max(indexed_end, end)
+        indexed_end = max(SUPER_BLOCK_SIZE, nm.indexed_end)
         dat_size = os.path.getsize(self.dat_path)
         if dat_size <= indexed_end:
             return
@@ -126,22 +140,29 @@ class Volume:
                 hdr = f.read(t.NEEDLE_HEADER_SIZE)
                 _, nid, nsize = Needle.parse_header(hdr)
                 if not t.size_is_valid(nsize):
-                    offset += needle_mod.actual_size(0, self.version)
+                    total = needle_mod.actual_size(0, self.version)
+                    if offset + total > dat_size:
+                        break  # torn tombstone record at EOF
+                    offset += total
                     continue
                 total = needle_mod.actual_size(nsize, self.version)
                 if offset + total > dat_size:
-                    break  # torn partial record at EOF: next append heals
+                    break  # torn partial record at EOF
                 f.seek(offset)
                 try:
                     Needle.from_bytes(f.read(total), self.version)
                 except Exception:
-                    break  # garbage or corrupt tail: stop recovering
+                    break  # garbage or corrupt tail
                 recovered.append((nid, offset, nsize))
                 offset += total
+        if offset < dat_size:
+            # drop the torn/corrupt tail so scan()/vacuum never walk into it
+            # and the next append starts on a clean record boundary
+            os.truncate(self.dat_path, offset)
         if recovered:
             with open(self.idx_path, "ab") as xf:
                 for nid, off, size in recovered:
-                    self.nm.set(nid, off, size)
+                    nm.set(nid, off, size)
                     xf.write(idx_mod.pack_entry(nid, off, size))
 
     # -- naming --------------------------------------------------------------
@@ -226,16 +247,22 @@ class Volume:
 
     # -- read path -----------------------------------------------------------
 
-    def _read_at(self, offset: int, size: int) -> Needle:
+    def _read_at(
+        self, offset: int, size: int, st: _ReadState | None = None
+    ) -> Needle:
+        st = st or self._state
         total = needle_mod.actual_size(size, self.version)
-        buf = os.pread(self._dat.fileno(), total, offset)
+        buf = os.pread(st.dat.fileno(), total, offset)
         return Needle.from_bytes(buf, self.version)
 
     def read(self, needle_id: int, cookie: int | None = None) -> Needle:
-        loc = self.nm.get(needle_id)
+        # one state capture: the offset from st.nm is only ever applied to
+        # st.dat, so a concurrent vacuum swap can't mix old map / new file
+        st = self._state
+        loc = st.nm.get(needle_id)
         if loc is None:
             raise NotFoundError(f"needle {needle_id:x} not found in volume {self.id}")
-        n = self._read_at(loc[0], loc[1])
+        n = self._read_at(loc[0], loc[1], st)
         if cookie is not None and n.cookie != cookie:
             raise CookieMismatch(f"cookie mismatch for needle {needle_id:x}")
         return n

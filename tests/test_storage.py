@@ -325,6 +325,14 @@ def test_tail_recovery_after_crash(tmp_path):
     v2.write(4, 0xD, b"post-recovery append")
     assert v2.read(4).data == b"post-recovery append"
     assert v2.read(1).data == b"indexed record"
+    # the torn tail was truncated, not left as garbage mid-file: scan()
+    # walks every record cleanly (regression: stale header desyncing vacuum)
+    assert sorted(n.id for _, n in v2.scan()) == [1, 2, 4]
+    from seaweedfs_tpu.storage.vacuum import vacuum
+
+    vacuum(v2)
+    assert sorted(n.id for _, n in v2.scan()) == [1, 2, 4]
+    assert v2.read(4).data == b"post-recovery append"
     v2.close()
     # idempotent: loading again recovers nothing new
     v3 = Volume(str(tmp_path), 9)
