@@ -1,0 +1,103 @@
+"""North-star benchmark: RS(10,4) encode throughput, TPU vs CPU reference.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The metric is device-resident encode throughput (input bytes/s) of the
+bitsliced GF(2) MXU kernel — the hot loop of `ec.encode`
+(reference weed/storage/erasure_coding/ec_encoder.go:162-192, whose CPU
+equivalent is klauspost/reedsolomon's AVX2/GFNI SIMD).  vs_baseline is the
+speedup over this repo's own C++ CPU kernel (GFNI/AVX2 nibble shuffles)
+measured on the same host — BASELINE.md's "measure the denominator" rule.
+"""
+import json
+import time
+
+import numpy as np
+
+
+def _measure(fn, iters=5, warmup=2):
+    for _ in range(warmup):
+        fn()
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def bench_cpu(parity_m, mb=64):
+    from seaweedfs_tpu.ops import rs_cpu
+
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 256, size=(10, mb * 1024 * 1024 // 8), dtype=np.uint8)
+    apply_fn = (
+        rs_cpu.apply_matrix_native
+        if rs_cpu.native_available()
+        else rs_cpu.apply_matrix_numpy
+    )
+    dt = _measure(lambda: apply_fn(parity_m, x), iters=3, warmup=1)
+    return x.nbytes / dt
+
+
+def bench_device(parity_m, mb=256, n_small=4, n_large=36):
+    """On this rig block_until_ready() returns before the tunneled device
+    finishes, and per-dispatch tunnel latency is tens of ms — so the
+    kernel is timed inside an on-device fori_loop and the cost of n_large
+    vs n_small iterations is differenced.  The per-iteration input XOR
+    (defeats loop-invariant hoisting) is counted against us, making the
+    reported number a conservative lower bound on kernel throughput."""
+    import jax
+    import jax.numpy as jnp
+
+    from seaweedfs_tpu.ops import rs_tpu
+
+    kernel = "pallas" if rs_tpu.on_tpu() else "xla"
+    interpret = not rs_tpu.on_tpu()
+    a_bm = rs_tpu.prepare_matrix(parity_m)
+    rng = np.random.default_rng(1)
+    b = mb * 1024 * 1024 // 10
+    b -= b % rs_tpu.BATCH_TILE  # whole tiles: no pad copy in the timed loop
+    x = jax.device_put(rng.integers(0, 256, size=(10, b), dtype=np.uint8))
+
+    @jax.jit
+    def many(a_bm, x, n):
+        def body(i, acc):
+            xi = x ^ i.astype(jnp.uint8)
+            out = rs_tpu.apply_matrix_device(
+                a_bm, xi, kernel=kernel, interpret=interpret
+            )
+            return acc + jnp.sum(out[:, ::65536].astype(jnp.int32))
+
+        return jax.lax.fori_loop(0, n, body, jnp.int32(0))
+
+    int(many(a_bm, x, 1))  # compile + warm
+    times = {}
+    for n in (n_small, n_large):
+        t0 = time.perf_counter()
+        int(many(a_bm, x, n))  # scalar fetch = completion barrier
+        times[n] = time.perf_counter() - t0
+    per_iter = (times[n_large] - times[n_small]) / (n_large - n_small)
+    return x.nbytes / per_iter, kernel
+
+
+def main():
+    from seaweedfs_tpu.ops import rs
+
+    parity_m = rs.RSCodec().matrix[10:]
+    cpu_bps = bench_cpu(parity_m)
+    dev_bps, kernel = bench_device(parity_m)
+    print(
+        json.dumps(
+            {
+                "metric": f"rs_10_4_encode_{kernel}",
+                "value": round(dev_bps / 1e9, 3),
+                "unit": "GB/s",
+                "vs_baseline": round(dev_bps / cpu_bps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,16 @@
+"""Device-mesh parallelism for EC math: the pod-scale rebuild path.
+
+The reference scales `ec.rebuild`/degraded reads by streaming shard
+intervals between hosts over per-shard gRPC (weed/storage/store_ec.go:
+299-337).  The TPU-native design instead lays shards out over a device
+mesh and lets XLA collectives ride ICI (SURVEY.md §2.10): each device
+holds its local shard rows, computes partial GF(2) bit-counts, and one
+psum over the shard axis + mod-2 yields the reconstructed bytes.
+"""
+from .distributed import (
+    distributed_apply_matrix,
+    make_mesh,
+    shard_parallel_apply,
+)
+
+__all__ = ["make_mesh", "distributed_apply_matrix", "shard_parallel_apply"]

@@ -1,0 +1,117 @@
+"""Mesh-sharded GF(256) linear algebra: encode/rebuild over many chips.
+
+Two parallel axes (SURVEY.md §2.10 mapping):
+
+  "shard" — the RS shard dimension (the reference's 10-way striping over
+            volume servers becomes a sharded array axis).  The bitsliced
+            matmul out = (A @ bits(x)) mod 2 decomposes over column groups:
+            each device computes partial f32 bit-counts from its local
+            shard rows, one `psum` over the shard axis sums counts
+            (exact: counts <= 80 per output bit), mod-2 recovers the XOR.
+            This turns the reference's per-shard gRPC interval streams
+            (store_ec.go:299-337) into a single ICI collective.
+
+  "batch" — the stripe/byte dimension, embarrassingly parallel (pure data
+            parallelism; no collective).
+
+Both compose in one mesh: a (S, D) mesh reconstructs S-sharded inputs in
+D-way data parallel with one psum.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import gf256
+from ..ops.rs_tpu import _pack_bits_bitmajor, _unpack_bits_bitmajor
+
+
+def make_mesh(
+    n_shard: int = 1, n_batch: int | None = None, devices=None
+) -> Mesh:
+    """(n_shard, n_batch) device mesh with axes ("shard", "batch")."""
+    devices = devices if devices is not None else jax.devices()
+    if n_batch is None:
+        n_batch = len(devices) // n_shard
+    devs = np.asarray(devices[: n_shard * n_batch]).reshape(n_shard, n_batch)
+    return Mesh(devs, axis_names=("shard", "batch"))
+
+
+def split_matrix_bitmajor(m_gf: np.ndarray, n_groups: int) -> jax.Array:
+    """GF(256) matrix [m, k] -> per-group bit-major GF(2) blocks
+    [n_groups, 8m, 8*(k/n_groups)] bf16, group g covering input shards
+    [g*k/n, (g+1)*k/n).  Each device's block is bit-major over its LOCAL
+    k so the kernel's unpack/pack layout is unchanged."""
+    m_gf = np.asarray(m_gf, dtype=np.uint8)
+    m, k = m_gf.shape
+    if k % n_groups:
+        raise ValueError(f"k={k} not divisible by {n_groups} shard groups")
+    k_loc = k // n_groups
+    a_std = gf256.expand_to_gf2(m_gf)  # [8m, 8k], row p*8+i, col d*8+j
+    # -> [8m(bit-major rows), bit j, d]
+    a = a_std.reshape(m, 8, k, 8)  # [p, i, d, j]
+    a_bm_rows = a.transpose(1, 0, 3, 2).reshape(8 * m, 8, k)  # [row, j, d]
+    groups = []
+    for g in range(n_groups):
+        blk = a_bm_rows[:, :, g * k_loc : (g + 1) * k_loc]  # [8m, 8, k_loc]
+        groups.append(blk.reshape(8 * m, 8 * k_loc))
+    return jnp.asarray(np.stack(groups), dtype=jnp.bfloat16)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "m_rows"))
+def _distributed_apply(mesh: Mesh, a_groups: jax.Array, x: jax.Array, m_rows: int):
+    """a_groups [S, 8m, 8k_loc] sharded on S; x [k, B] sharded (shard,
+    batch); -> [m, B] u8 sharded on batch."""
+
+    def kernel(a_loc, x_loc):
+        bits = _unpack_bits_bitmajor(x_loc)  # [8k_loc, B_loc]
+        partial = jnp.dot(
+            a_loc[0], bits, preferred_element_type=jnp.float32
+        )  # [8m, B_loc]
+        counts = jax.lax.psum(partial, axis_name="shard")
+        return _pack_bits_bitmajor(counts, m_rows)  # [m, B_loc]
+
+    return shard_map(
+        kernel,
+        mesh=mesh,
+        in_specs=(P("shard", None, None), P("shard", "batch")),
+        out_specs=P(None, "batch"),
+    )(a_groups, x)
+
+
+def distributed_apply_matrix(
+    mesh: Mesh, m_gf: np.ndarray, shards, pad_rows_to: int = 4
+) -> jax.Array:
+    """out[i] = XOR_j m_gf[i,j] ⊗ shards[j], computed over the mesh.
+
+    `shards` is [k, B] uint8 (host or device); k must divide over the
+    mesh's shard axis and B over its batch axis.  Output rows are padded
+    to a sublane-friendly multiple and sliced back."""
+    m_gf = np.asarray(m_gf, dtype=np.uint8)
+    rows, k = m_gf.shape
+    pad = (-rows) % pad_rows_to
+    if pad:
+        m_gf = np.concatenate([m_gf, np.zeros((pad, k), dtype=np.uint8)])
+    n_shard = mesh.shape["shard"]
+    a_groups = jax.device_put(
+        split_matrix_bitmajor(m_gf, n_shard),
+        NamedSharding(mesh, P("shard", None, None)),
+    )
+    x = jax.device_put(
+        jnp.asarray(shards, dtype=jnp.uint8),
+        NamedSharding(mesh, P("shard", "batch")),
+    )
+    out = _distributed_apply(mesh, a_groups, x, rows + pad)
+    return out[:rows]
+
+
+def shard_parallel_apply(
+    mesh: Mesh, m_gf: np.ndarray, shards
+) -> np.ndarray:
+    """Host-convenience wrapper returning numpy."""
+    return np.asarray(distributed_apply_matrix(mesh, m_gf, shards))

@@ -8,10 +8,6 @@ one.  Only data shards are read; missing data shards must be rebuilt first
 """
 from __future__ import annotations
 
-import os
-
-import numpy as np
-
 from .. import idx as idx_mod
 from .. import needle as needle_mod
 from .. import types as t

@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import os
 from collections import deque
-from typing import Callable, Iterator
+from typing import Iterator
 
 import numpy as np
 
@@ -121,6 +121,19 @@ def write_ec_files(
     dat_size = os.path.getsize(dat_path)
     codec = _Codec(rs.RSCodec().matrix[DATA_SHARDS:], backend)
 
+    # persist the volume version alongside the shards, as the reference's
+    # VolumeEcShardsGenerate does (volume_grpc_erasure_coding.go:74)
+    from ..super_block import SUPER_BLOCK_SIZE, SuperBlock
+    from ..volume_info import load_volume_info, save_volume_info
+
+    if not load_volume_info(base_name + ".vif"):
+        try:
+            with open(dat_path, "rb") as f:
+                sb = SuperBlock.from_bytes(f.read(SUPER_BLOCK_SIZE))
+            save_volume_info(base_name + ".vif", {"version": sb.version})
+        except ValueError:
+            pass  # raw/synthetic .dat without a superblock: no .vif
+
     outputs = [open(base_name + to_ext(i), "wb") for i in range(TOTAL_SHARDS)]
     inflight: deque[tuple[np.ndarray, object]] = deque()
 
@@ -209,7 +222,3 @@ def write_sorted_file_from_idx(base_name: str, ext: str = ".ecx") -> None:
     """<base>.idx -> <base><ext>, entries sorted ascending by needle id,
     deletions dropped (WriteSortedFileFromIdx ec_encoder.go:27-54)."""
     needle_map.write_sorted_file_from_idx(base_name + ".idx", base_name + ext)
-
-
-# Optional hook point mirroring the reference's per-shard open for tests
-ReadShardFn = Callable[[int, int, int], bytes]

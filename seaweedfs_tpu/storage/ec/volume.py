@@ -138,7 +138,15 @@ class EcVolume:
         if info:
             self.version = int(info.get("version", needle_mod.CURRENT_VERSION))
         else:
-            self.version = needle_mod.CURRENT_VERSION
+            # no .vif: derive the true version from the .ec00 superblock
+            # (block 0 of the stripe is the head of the original .dat) the
+            # way ec_decoder.go:120-138 does, then persist it
+            try:
+                from .decoder import read_ec_volume_version
+
+                self.version = read_ec_volume_version(self.base_name)
+            except OSError:
+                self.version = needle_mod.CURRENT_VERSION
             save_volume_info(self.base_name + ".vif", {"version": self.version})
         # remote shard locations, refreshed by the store from master lookups
         # (store_ec.go:238-279)

@@ -48,11 +48,13 @@ class CompactMap:
 
     def set(self, needle_id: int, actual_offset: int, size: int) -> None:
         old = self._m.get(needle_id)
-        if old is not None and t.size_is_valid(old[1]):
+        old_live = old is not None and t.size_is_valid(old[1])
+        if old_live:
             self.stats.deleted_count += 1
             self.stats.deleted_bytes += old[1]
-        elif old is None or not t.size_is_valid(old[1]):
-            self._live += 1
+        # size-0 entries (empty writes) are dead on arrival: get() won't
+        # return them, so they must not count as live either
+        self._live += int(t.size_is_valid(size)) - int(old_live)
         self._m[needle_id] = (actual_offset, size)
         self.stats.file_count += 1
         self.stats.file_bytes += max(size, 0)

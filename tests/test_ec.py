@@ -274,6 +274,22 @@ class TestEncodeDecode:
         with open(base + ".dat", "rb") as f:
             assert f.read() == payload.tobytes()
 
+    def test_version1_volume_roundtrip(self, tmp_path):
+        """EcVolume derives the true needle version from the .ec00
+        superblock when no .vif exists (regression: defaulting to v3 broke
+        v1/v2 volume reads)."""
+        v = Volume(str(tmp_path), 5, version=1)
+        v.write(1, 0xAB, b"version-one payload")
+        v.sync()
+        base = encode_volume(v)
+        os.remove(base + ".vif")  # simulate shards copied without sidecar
+        ev = ec.EcVolume(str(tmp_path), 5)
+        assert ev.version == 1
+        for i in range(14):
+            ev.add_shard(i)
+        assert ev.read_needle(1, cookie=0xAB).data == b"version-one payload"
+        ev.close()
+
     def test_tpu_backend_parity(self, tmp_path):
         """Encode with the device (xla) backend matches the CPU encode
         byte-for-byte — the fixture-equivalence shape of ec_test.go."""

@@ -375,3 +375,25 @@ def test_index_integrity_checker(tmp_path):
             f.write(idx.pack_entry(nid, off, size))
     with pytest.raises(ValueError, match="mismatch"):
         needle_map.verify_index_integrity(v.dat_path, v.idx_path, 3)
+
+
+def test_compact_map_live_count_edge_cases():
+    """len() stays O(1)-correct across size-0 entries, rewrites and deletes
+    (regression: dead-on-arrival entries counted as live)."""
+    from seaweedfs_tpu.storage.needle_map import CompactMap
+
+    m = CompactMap()
+    m.set(1, 100, 0)  # empty write: dead on arrival
+    assert len(m) == 0 and not m.has(1)
+    m.set(1, 200, 50)  # rewrite with real data
+    assert len(m) == 1
+    m.set(1, 300, 60)  # supersede
+    assert len(m) == 1
+    m.set(2, 400, 10)
+    assert len(m) == 2
+    m.delete(1)
+    assert len(m) == 1
+    m.delete(1)  # double delete: no change
+    assert len(m) == 1
+    m.delete(99)  # absent: no change
+    assert len(m) == 1
