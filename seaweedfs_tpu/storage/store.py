@@ -1,0 +1,462 @@
+"""Store: the volume server's registry of volumes and EC shards.
+
+Reference: weed/storage/store.go (595 LoC), store_ec.go (407),
+store_ec_delete.go, store_vacuum.go.  One Store per volume-server process;
+it owns a set of DiskLocations, routes needle reads/writes to the right
+Volume or EcVolume, assembles heartbeat state for the master, and queues
+mount/unmount deltas so the heartbeat loop can push them immediately
+(NewVolumesChan / NewEcShardsChan, store.go:66-70).
+
+The Store is synchronous (file I/O + device kernels); the asyncio server
+layer calls it via ``asyncio.to_thread``.
+"""
+from __future__ import annotations
+
+import glob
+import os
+import queue
+import threading
+from dataclasses import dataclass, field
+
+from . import needle as needle_mod
+from . import types as t
+from .disk_location import DiskLocation
+from .ec import (
+    EcVolume,
+    NeedleNotFound,
+    ShardBits,
+    ec_base_name,
+    rebuild_ecx_file,
+    to_ext,
+    write_ec_files,
+    write_sorted_file_from_idx,
+)
+from .ec.volume import RemoteReadFn
+from .needle import Needle
+from .vacuum import vacuum as vacuum_volume
+from .volume import NotFoundError, Volume, VolumeInfo
+
+
+@dataclass
+class VolumeMessage:
+    """Heartbeat record for one normal volume
+    (master_pb.VolumeInformationMessage, master.proto:77-95)."""
+
+    id: int
+    size: int
+    collection: str
+    file_count: int
+    delete_count: int
+    deleted_byte_count: int
+    read_only: bool
+    replica_placement: int
+    version: int
+    ttl: int
+    disk_type: str
+
+
+@dataclass
+class EcShardMessage:
+    """Heartbeat record for one EC volume's local shards
+    (master_pb.VolumeEcShardInformationMessage, master.proto:97-102)."""
+
+    id: int
+    collection: str
+    ec_index_bits: int
+    disk_type: str
+
+
+@dataclass
+class HeartbeatState:
+    """Everything the master needs from one pulse (master_pb.Heartbeat,
+    master.proto:45-75)."""
+
+    volumes: list[VolumeMessage] = field(default_factory=list)
+    ec_shards: list[EcShardMessage] = field(default_factory=list)
+    max_volume_counts: dict[str, int] = field(default_factory=dict)
+    has_no_volumes: bool = False
+    has_no_ec_shards: bool = False
+
+
+class Store:
+    def __init__(
+        self,
+        locations: list[DiskLocation],
+        ip: str = "localhost",
+        port: int = 8080,
+        public_url: str = "",
+        ec_backend: str = "auto",
+    ):
+        self.locations = locations
+        self.ip = ip
+        self.port = port
+        self.public_url = public_url or f"{ip}:{port}"
+        self.ec_backend = ec_backend
+        self.volume_size_limit = 30 * 1024 * 1024 * 1024  # set by master pulse
+        self._lock = threading.RLock()
+        # delta queues drained by the heartbeat loop (store.go:66-70)
+        self.new_volumes: queue.SimpleQueue[VolumeMessage] = queue.SimpleQueue()
+        self.deleted_volumes: queue.SimpleQueue[VolumeMessage] = queue.SimpleQueue()
+        self.new_ec_shards: queue.SimpleQueue[EcShardMessage] = queue.SimpleQueue()
+        self.deleted_ec_shards: queue.SimpleQueue[EcShardMessage] = queue.SimpleQueue()
+        for loc in self.locations:
+            loc.load_existing_volumes()
+
+    # -- lookup --------------------------------------------------------------
+
+    def find_volume(self, vid: int) -> Volume | None:
+        for loc in self.locations:
+            v = loc.volumes.get(vid)
+            if v is not None:
+                return v
+        return None
+
+    def find_ec_volume(self, vid: int) -> EcVolume | None:
+        for loc in self.locations:
+            ev = loc.ec_volumes.get(vid)
+            if ev is not None:
+                return ev
+        return None
+
+    def location_of_volume(self, vid: int) -> DiskLocation | None:
+        for loc in self.locations:
+            if vid in loc.volumes:
+                return loc
+        return None
+
+    def has_volume(self, vid: int) -> bool:
+        return self.find_volume(vid) is not None
+
+    def volume_infos(self) -> list[VolumeInfo]:
+        return [
+            v.info() for loc in self.locations for v in loc.volumes.values()
+        ]
+
+    # -- volume lifecycle (store.go:200-320) ---------------------------------
+
+    def add_volume(
+        self,
+        vid: int,
+        collection: str = "",
+        replica_placement: str | t.ReplicaPlacement = "000",
+        ttl: str | t.TTL = "",
+        version: int = needle_mod.CURRENT_VERSION,
+        disk_type: str = "",
+    ) -> Volume:
+        with self._lock:
+            if self.find_volume(vid) is not None:
+                raise ValueError(f"volume {vid} already exists")
+            loc = self._pick_location(disk_type)
+            if loc is None:
+                raise RuntimeError("no disk location has free slots")
+            if isinstance(replica_placement, str):
+                replica_placement = t.ReplicaPlacement.parse(replica_placement)
+            if isinstance(ttl, str):
+                ttl = t.TTL.parse(ttl)
+            v = Volume(loc.directory, vid, collection, replica_placement, ttl, version)
+            loc.volumes[vid] = v
+            self.new_volumes.put(self._volume_message(v, loc.disk_type))
+            return v
+
+    def _pick_location(self, disk_type: str = "") -> DiskLocation | None:
+        best = None
+        for loc in self.locations:
+            if disk_type and loc.disk_type != disk_type:
+                continue
+            if loc.low_on_space() or loc.free_slots() <= 0:
+                continue
+            if best is None or loc.free_slots() > best.free_slots():
+                best = loc
+        return best
+
+    def delete_volume(self, vid: int) -> None:
+        with self._lock:
+            for loc in self.locations:
+                v = loc.volumes.pop(vid, None)
+                if v is not None:
+                    msg = self._volume_message(v, loc.disk_type)
+                    v.destroy()
+                    self.deleted_volumes.put(msg)
+                    return
+        raise NotFoundError(f"volume {vid} not found")
+
+    def unmount_volume(self, vid: int) -> None:
+        with self._lock:
+            for loc in self.locations:
+                v = loc.volumes.pop(vid, None)
+                if v is not None:
+                    msg = self._volume_message(v, loc.disk_type)
+                    v.close()
+                    self.deleted_volumes.put(msg)
+                    return
+        raise NotFoundError(f"volume {vid} not found")
+
+    def mount_volume(self, vid: int) -> None:
+        with self._lock:
+            for loc in self.locations:
+                if vid in loc.volumes:
+                    return
+                for dat in glob.glob(os.path.join(loc.directory, f"*{vid}.dat")):
+                    stem = os.path.basename(dat)[: -len(".dat")]
+                    collection, _, vid_s = stem.rpartition("_")
+                    if vid_s != str(vid):
+                        continue
+                    v = Volume(loc.directory, vid, collection)
+                    loc.volumes[vid] = v
+                    self.new_volumes.put(self._volume_message(v, loc.disk_type))
+                    return
+        raise NotFoundError(f"volume {vid} not found on disk")
+
+    def mark_volume_readonly(self, vid: int, read_only: bool = True) -> None:
+        v = self.find_volume(vid)
+        if v is None:
+            raise NotFoundError(f"volume {vid} not found")
+        v.read_only = read_only
+
+    # -- needle ops ----------------------------------------------------------
+
+    def write_needle(self, vid: int, n: Needle) -> int:
+        v = self.find_volume(vid)
+        if v is None:
+            raise NotFoundError(f"volume {vid} not found")
+        if v.content_size + len(n.data) > self.volume_size_limit:
+            v.read_only = True  # stop accepting; master will grow elsewhere
+        v.append_needle(n)
+        return n.size
+
+    def read_needle(self, vid: int, needle_id: int, cookie: int | None = None) -> Needle:
+        v = self.find_volume(vid)
+        if v is not None:
+            return v.read(needle_id, cookie)
+        ev = self.find_ec_volume(vid)
+        if ev is not None:
+            return self.read_ec_needle(vid, needle_id, cookie)
+        raise NotFoundError(f"volume {vid} not found")
+
+    def delete_needle(self, vid: int, needle_id: int, cookie: int | None = None) -> int:
+        v = self.find_volume(vid)
+        if v is None:
+            raise NotFoundError(f"volume {vid} not found")
+        return v.delete(needle_id, cookie)
+
+    # -- vacuum (store_vacuum.go) --------------------------------------------
+
+    def vacuum_volume(self, vid: int) -> float:
+        v = self.find_volume(vid)
+        if v is None:
+            raise NotFoundError(f"volume {vid} not found")
+        return vacuum_volume(v)
+
+    # -- EC shard lifecycle (store_ec.go) ------------------------------------
+
+    def ec_generate(self, vid: int) -> None:
+        """Stripe a local volume into .ec00-.ec13 + .ecx + .vif
+        (VolumeEcShardsGenerate volume_grpc_erasure_coding.go:38-81).
+        The GF(256) math runs on the configured backend (TPU by default)."""
+        v = self.find_volume(vid)
+        if v is None:
+            raise NotFoundError(f"volume {vid} not found")
+        v.sync()
+        base = Volume.base_name(v.dir, vid, v.collection)
+        write_ec_files(base, backend=self.ec_backend)
+        write_sorted_file_from_idx(base)
+
+    def ec_rebuild(self, vid: int, collection: str = "") -> list[int]:
+        """Rebuild whatever shards are missing from the local >=10
+        (VolumeEcShardsRebuild volume_grpc_erasure_coding.go:84-123).
+        Returns rebuilt shard ids."""
+        from .ec import rebuild_ec_files
+
+        base = self._ec_base(vid, collection)
+        if base is None:
+            raise NotFoundError(f"ec volume {vid} not found")
+        rebuilt = rebuild_ec_files(base, backend=self.ec_backend)
+        rebuild_ecx_file(base)
+        return rebuilt
+
+    def _ec_base(self, vid: int, collection: str = "") -> str | None:
+        """Directory-resolved EC base name: prefer a mounted EcVolume's dir,
+        else any location holding shard/sidecar files."""
+        ev = self.find_ec_volume(vid)
+        if ev is not None:
+            return ev.base_name
+        for loc in self.locations:
+            base = ec_base_name(loc.directory, vid, collection)
+            if os.path.exists(base + ".ecx") or os.path.exists(base + to_ext(0)):
+                return base
+        return None
+
+    def mount_ec_shards(self, vid: int, shard_ids: list[int], collection: str = "") -> None:
+        """(VolumeEcShardsMount volume_grpc_erasure_coding.go:267-287)"""
+        with self._lock:
+            ev = self.find_ec_volume(vid)
+            if ev is None:
+                loc = self._location_with_ec_files(vid, collection)
+                if loc is None:
+                    raise NotFoundError(f"ec volume {vid} has no local files")
+                ev = EcVolume(loc.directory, vid, collection)
+                loc.ec_volumes[vid] = ev
+            for sid in shard_ids:
+                ev.add_shard(sid)
+            self.new_ec_shards.put(self._ec_message(ev))
+
+    def _location_with_ec_files(self, vid: int, collection: str) -> DiskLocation | None:
+        for loc in self.locations:
+            if os.path.exists(ec_base_name(loc.directory, vid, collection) + ".ecx"):
+                return loc
+        return None
+
+    def unmount_ec_shards(self, vid: int, shard_ids: list[int]) -> None:
+        with self._lock:
+            ev = self.find_ec_volume(vid)
+            if ev is None:
+                return
+            bits = ShardBits(0)
+            for sid in shard_ids:
+                s = ev.delete_shard(sid)
+                if s is not None:
+                    s.close()
+                    bits = bits.add(sid)
+            self.deleted_ec_shards.put(
+                EcShardMessage(vid, ev.collection, int(bits), self._disk_type_of(ev))
+            )
+            if not ev.shards:
+                for loc in self.locations:
+                    if loc.ec_volumes.get(vid) is ev:
+                        del loc.ec_volumes[vid]
+                ev.close()
+
+    def delete_ec_shards(self, vid: int, shard_ids: list[int], collection: str = "") -> None:
+        """Unmount + remove the shard files; drop sidecars when the last
+        shard goes (VolumeEcShardsDelete volume_grpc_erasure_coding.go:181-236)."""
+        with self._lock:
+            ev = self.find_ec_volume(vid)
+            if ev is not None:
+                collection = ev.collection
+            self.unmount_ec_shards(vid, shard_ids)
+            base = self._ec_base(vid, collection)
+            if base is None:
+                return
+            for sid in shard_ids:
+                p = base + to_ext(sid)
+                if os.path.exists(p):
+                    os.remove(p)
+            if not any(os.path.exists(base + to_ext(i)) for i in range(14)):
+                for ext in (".ecx", ".ecj", ".vif"):
+                    if os.path.exists(base + ext):
+                        os.remove(base + ext)
+
+    def destroy_ec_volume(self, vid: int) -> None:
+        with self._lock:
+            for loc in self.locations:
+                ev = loc.ec_volumes.pop(vid, None)
+                if ev is not None:
+                    self.deleted_ec_shards.put(self._ec_message(ev))
+                    ev.destroy()
+
+    # -- EC reads ------------------------------------------------------------
+
+    def read_ec_needle(
+        self,
+        vid: int,
+        needle_id: int,
+        cookie: int | None = None,
+        remote_read: RemoteReadFn | None = None,
+    ) -> Needle:
+        """(ReadEcShardNeedle store_ec.go:136-174); falls back to remote
+        shards then degraded reconstruction via the EcVolume."""
+        ev = self.find_ec_volume(vid)
+        if ev is None:
+            raise NotFoundError(f"ec volume {vid} not found")
+        return ev.read_needle(
+            needle_id, cookie, remote_read, backend=self.ec_backend
+        )
+
+    def read_ec_shard_interval(self, vid: int, shard_id: int, offset: int, size: int) -> bytes:
+        """Serve a raw shard range to a peer (VolumeEcShardRead
+        volume_grpc_erasure_coding.go:309-375)."""
+        ev = self.find_ec_volume(vid)
+        if ev is None:
+            raise NotFoundError(f"ec volume {vid} not found")
+        shard = ev.shards.get(shard_id)
+        if shard is None:
+            raise NotFoundError(f"ec volume {vid} shard {shard_id} not local")
+        return shard.read_at(offset, size)
+
+    def delete_ec_needle(self, vid: int, needle_id: int) -> None:
+        """Local tombstone (VolumeEcBlobDelete fans this out to all shard
+        holders at the server layer)."""
+        ev = self.find_ec_volume(vid)
+        if ev is None:
+            raise NotFoundError(f"ec volume {vid} not found")
+        ev.delete_needle(needle_id)
+
+    # -- heartbeat assembly (CollectHeartbeat store.go:254-320,
+    #    CollectErasureCodingHeartbeat store_ec.go:25-52) --------------------
+
+    def _volume_message(self, v: Volume, disk_type: str) -> VolumeMessage:
+        info = v.info()
+        return VolumeMessage(
+            id=v.id,
+            size=info.size,
+            collection=v.collection,
+            file_count=info.file_count,
+            delete_count=info.delete_count,
+            deleted_byte_count=info.deleted_bytes,
+            read_only=v.read_only,
+            replica_placement=v.super_block.replica_placement.to_byte(),
+            version=v.version,
+            ttl=int.from_bytes(v.super_block.ttl.to_bytes(), "big"),
+            disk_type=disk_type,
+        )
+
+    def _disk_type_of(self, ev: EcVolume) -> str:
+        for loc in self.locations:
+            if loc.ec_volumes.get(ev.id) is ev:
+                return loc.disk_type
+        return "hdd"
+
+    def _ec_message(self, ev: EcVolume) -> EcShardMessage:
+        return EcShardMessage(
+            id=ev.id,
+            collection=ev.collection,
+            ec_index_bits=int(ev.shard_bits()),
+            disk_type=self._disk_type_of(ev),
+        )
+
+    def collect_heartbeat(self) -> HeartbeatState:
+        hs = HeartbeatState()
+        for loc in self.locations:
+            hs.max_volume_counts[loc.disk_type] = (
+                hs.max_volume_counts.get(loc.disk_type, 0) + loc.max_volume_count
+            )
+            for v in loc.volumes.values():
+                hs.volumes.append(self._volume_message(v, loc.disk_type))
+            for ev in loc.ec_volumes.values():
+                hs.ec_shards.append(self._ec_message(ev))
+        hs.has_no_volumes = not hs.volumes
+        hs.has_no_ec_shards = not hs.ec_shards
+        return hs
+
+    def drain_deltas(self):
+        """-> (new_vols, deleted_vols, new_ec, deleted_ec) accumulated since
+        the last pulse."""
+
+        def drain(q):
+            out = []
+            while True:
+                try:
+                    out.append(q.get_nowait())
+                except queue.Empty:
+                    return out
+
+        return (
+            drain(self.new_volumes),
+            drain(self.deleted_volumes),
+            drain(self.new_ec_shards),
+            drain(self.deleted_ec_shards),
+        )
+
+    def close(self) -> None:
+        for loc in self.locations:
+            loc.close()
