@@ -1,0 +1,32 @@
+"""Master control plane: the cluster's volume/EC-shard placement brain.
+
+Reference: weed/topology/ (4,250 LoC Go).  DC/rack/node tree, per-collection
+volume layouts, XYZ replica-placement growth, EC shard map, sequencers and
+master-driven vacuum.
+"""
+from .node import DataCenter, DataNode, EcShardInfo, Rack
+from .sequence import MemorySequencer, SnowflakeSequencer
+from .topology import Collection, EcShardLocations, Topology
+from .vacuum import scan_and_vacuum, vacuum_one_volume
+from .volume_growth import NoFreeSpace, VolumeGrowOption, VolumeGrowth, target_count_per_request
+from .volume_layout import VolumeLayout, VolumeLocationList
+
+__all__ = [
+    "DataCenter",
+    "DataNode",
+    "Rack",
+    "EcShardInfo",
+    "Collection",
+    "EcShardLocations",
+    "Topology",
+    "MemorySequencer",
+    "SnowflakeSequencer",
+    "VolumeGrowOption",
+    "VolumeGrowth",
+    "NoFreeSpace",
+    "target_count_per_request",
+    "VolumeLayout",
+    "VolumeLocationList",
+    "scan_and_vacuum",
+    "vacuum_one_volume",
+]

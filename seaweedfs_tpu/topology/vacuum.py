@@ -1,0 +1,83 @@
+"""Master-driven vacuum orchestration.
+
+Reference: weed/topology/topology_vacuum.go (269 LoC).  The master
+periodically scans every VolumeLayout for volumes whose garbage ratio
+exceeds the threshold, then drives the Check → Compact (all replicas) →
+Commit / Cleanup protocol against the volume servers.  RPC transport is
+injected so the loop is testable in-process (the reference's tests do the
+same by faking heartbeats, SURVEY.md §4).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Protocol
+
+from .node import DataNode
+from .topology import Topology
+from .volume_layout import VolumeLayout
+
+
+class VacuumRpc(Protocol):
+    """The four volume-server vacuum verbs (volume_grpc_vacuum.go)."""
+
+    def check(self, node: DataNode, vid: int) -> float:
+        """-> garbage ratio on that replica."""
+
+    def compact(self, node: DataNode, vid: int) -> bool: ...
+
+    def commit(self, node: DataNode, vid: int) -> bool: ...
+
+    def cleanup(self, node: DataNode, vid: int) -> bool: ...
+
+
+@dataclass
+class VacuumResult:
+    vid: int
+    compacted: list[str]
+    committed: bool
+
+
+def vacuum_one_volume(
+    rpc: VacuumRpc, vl: VolumeLayout, vid: int, nodes: list[DataNode]
+) -> VacuumResult:
+    """Compact every replica, commit only if all succeeded, else cleanup
+    (vacuumOneVolumeId topology_vacuum.go:35-90).  The volume is pulled
+    from the writable set for the duration so no writes race the copy
+    (the engine's makeupDiff still absorbs any that slip through)."""
+    vl.set_readonly(vid, True)
+    try:
+        compacted = []
+        for n in nodes:
+            if rpc.compact(n, vid):
+                compacted.append(n.url)
+        if len(compacted) == len(nodes):
+            for n in nodes:
+                rpc.commit(n, vid)
+            return VacuumResult(vid, compacted, True)
+        for n in nodes:
+            rpc.cleanup(n, vid)
+        return VacuumResult(vid, compacted, False)
+    finally:
+        vl.set_readonly(vid, False)
+
+
+def scan_and_vacuum(
+    topo: Topology,
+    rpc: VacuumRpc,
+    garbage_threshold: float = 0.3,
+    max_volumes: int = 0,
+) -> list[VacuumResult]:
+    """One pass over all layouts (Vacuum topology_vacuum.go:220-269)."""
+    results = []
+    for _, vl in topo.layouts():
+        for vid, loc in list(vl.vid2location.items()):
+            nodes = list(loc.nodes)
+            if not nodes:
+                continue
+            ratios = [rpc.check(n, vid) for n in nodes]
+            if min(ratios) <= garbage_threshold:
+                continue
+            results.append(vacuum_one_volume(rpc, vl, vid, nodes))
+            if max_volumes and len(results) >= max_volumes:
+                return results
+    return results

@@ -1,0 +1,162 @@
+"""VolumeLayout: write-target selection per (collection, rp, ttl, disk).
+
+Reference: weed/topology/volume_layout.go (538 LoC).  Tracks which volume
+ids live where, which are writable (enough replicas, not full/readonly),
+and picks write targets.  The reference's `crowded`/`oversized` sets and
+round-robin cursor are kept; the per-vid replica list is the authority.
+"""
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass
+
+from ..storage import types as t
+from ..storage.store import VolumeMessage
+from .node import DataNode
+
+
+@dataclass
+class VolumeLocationList:
+    nodes: list[DataNode]
+
+    def refresh(self) -> None:
+        seen = set()
+        out = []
+        for n in self.nodes:
+            if n.url not in seen:
+                seen.add(n.url)
+                out.append(n)
+        self.nodes = out
+
+    def __len__(self) -> int:
+        return len(self.nodes)
+
+
+class VolumeLayout:
+    def __init__(
+        self,
+        rp: t.ReplicaPlacement,
+        ttl: t.TTL,
+        disk_type: str = "hdd",
+        volume_size_limit: int = 30 * 1024**3,
+    ):
+        self.rp = rp
+        self.ttl = ttl
+        self.disk_type = disk_type
+        self.volume_size_limit = volume_size_limit
+        self.vid2location: dict[int, VolumeLocationList] = {}
+        self.writables: list[int] = []
+        self.readonly: set[int] = set()
+        self.oversized: set[int] = set()
+        self.crowded: set[int] = set()
+        self._cursor = random.randrange(1 << 30)
+        self._lock = threading.RLock()
+
+    # -- registration (volume_layout.go RegisterVolume/UnRegisterVolume) -----
+
+    def register(self, v: VolumeMessage, node: DataNode) -> None:
+        with self._lock:
+            loc = self.vid2location.setdefault(v.id, VolumeLocationList([]))
+            if all(n.url != node.url for n in loc.nodes):
+                loc.nodes.append(node)
+            if v.size >= self.volume_size_limit:
+                self.oversized.add(v.id)
+            if v.read_only:
+                self.readonly.add(v.id)
+            self._recheck_writable(v.id)
+
+    def unregister(self, vid: int, node: DataNode) -> None:
+        with self._lock:
+            loc = self.vid2location.get(vid)
+            if loc is None:
+                return
+            loc.nodes = [n for n in loc.nodes if n.url != node.url]
+            if not loc.nodes:
+                del self.vid2location[vid]
+                self._remove_writable(vid)
+                self.readonly.discard(vid)
+                self.oversized.discard(vid)
+            else:
+                self._recheck_writable(vid)
+
+    def _enough_copies(self, vid: int) -> bool:
+        loc = self.vid2location.get(vid)
+        return loc is not None and len(loc) >= self.rp.copy_count
+
+    def _recheck_writable(self, vid: int) -> None:
+        ok = (
+            self._enough_copies(vid)
+            and vid not in self.readonly
+            and vid not in self.oversized
+        )
+        if ok:
+            if vid not in self.writables:
+                self.writables.append(vid)
+        else:
+            self._remove_writable(vid)
+
+    def _remove_writable(self, vid: int) -> None:
+        if vid in self.writables:
+            self.writables.remove(vid)
+
+    def set_readonly(self, vid: int, read_only: bool) -> None:
+        with self._lock:
+            if read_only:
+                self.readonly.add(vid)
+            else:
+                self.readonly.discard(vid)
+            self._recheck_writable(vid)
+
+    def set_oversized(self, vid: int, size: int) -> None:
+        with self._lock:
+            if size >= self.volume_size_limit:
+                self.oversized.add(vid)
+                if size >= self.volume_size_limit * 0.9:
+                    self.crowded.add(vid)
+                self._recheck_writable(vid)
+
+    # -- write selection (PickForWrite volume_layout.go:281-320) -------------
+
+    def pick_for_write(
+        self, count: int = 1, data_center: str = "", data_node: str = ""
+    ) -> tuple[int, list[DataNode]]:
+        """-> (vid, replica locations); raises LookupError when nothing is
+        writable under the constraints."""
+        with self._lock:
+            candidates = self.writables
+            if data_center or data_node:
+                candidates = [
+                    vid
+                    for vid in self.writables
+                    if any(
+                        (not data_center or self._dc_of(n) == data_center)
+                        and (not data_node or n.url == data_node)
+                        for n in self.vid2location[vid].nodes
+                    )
+                ]
+            if not candidates:
+                raise LookupError("no writable volumes")
+            self._cursor += 1
+            vid = candidates[self._cursor % len(candidates)]
+            return vid, list(self.vid2location[vid].nodes)
+
+    @staticmethod
+    def _dc_of(node: DataNode) -> str:
+        return node.rack.data_center.name if node.rack else ""
+
+    def lookup(self, vid: int) -> list[DataNode]:
+        loc = self.vid2location.get(vid)
+        return list(loc.nodes) if loc else []
+
+    def active_volume_count(self) -> int:
+        return len(self.writables)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "writables": sorted(self.writables),
+                "readonly": sorted(self.readonly),
+                "oversized": sorted(self.oversized),
+                "total": len(self.vid2location),
+            }

@@ -1,0 +1,285 @@
+"""Master control plane tested with fake heartbeats — multi-node without
+processes, the reference's approach (topology_test.go:1-210,
+volume_growth_test.go:1-348)."""
+import random
+
+import pytest
+
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.storage.store import EcShardMessage, HeartbeatState, VolumeMessage
+from seaweedfs_tpu.topology import (
+    MemorySequencer,
+    NoFreeSpace,
+    Topology,
+    VolumeGrowOption,
+    VolumeGrowth,
+    scan_and_vacuum,
+    target_count_per_request,
+)
+
+
+def vol(vid, size=1000, collection="", rp="000", read_only=False, disk="hdd"):
+    return VolumeMessage(
+        id=vid,
+        size=size,
+        collection=collection,
+        file_count=1,
+        delete_count=0,
+        deleted_byte_count=0,
+        read_only=read_only,
+        replica_placement=int(rp),
+        version=3,
+        ttl=0,
+        disk_type=disk,
+    )
+
+
+def heartbeat(volumes=(), ec=(), max_counts=None):
+    return HeartbeatState(
+        volumes=list(volumes),
+        ec_shards=list(ec),
+        max_volume_counts=max_counts or {"hdd": 10},
+    )
+
+
+def build_topo(layout):
+    """layout: {dc: {rack: [(ip, port, max_count), ...]}} -> Topology with
+    registered empty nodes."""
+    topo = Topology()
+    for dc, racks in layout.items():
+        for rack, nodes in racks.items():
+            for ip, port, maxc in nodes:
+                n = topo.get_or_create_node(dc, rack, ip, port)
+                topo.sync_node(n, heartbeat(max_counts={"hdd": maxc}))
+    return topo
+
+
+class TestHeartbeatIntake:
+    def test_full_sync_registers_volumes(self):
+        topo = Topology()
+        n = topo.get_or_create_node("dc1", "r1", "10.0.0.1", 8080)
+        new, deleted = topo.sync_node(n, heartbeat([vol(1), vol(2)]))
+        assert sorted(new) == [1, 2] and not deleted
+        assert [x.url for x in topo.lookup_volume("", 1)] == ["10.0.0.1:8080"]
+        assert topo.max_volume_id == 2
+
+    def test_full_sync_detects_removed_volumes(self):
+        topo = Topology()
+        n = topo.get_or_create_node("dc1", "r1", "10.0.0.1", 8080)
+        topo.sync_node(n, heartbeat([vol(1), vol(2)]))
+        new, deleted = topo.sync_node(n, heartbeat([vol(2)]))
+        assert deleted == [1] and not new
+        assert topo.lookup_volume("", 1) == []
+
+    def test_incremental_sync(self):
+        topo = Topology()
+        n = topo.get_or_create_node("dc1", "r1", "10.0.0.1", 8080)
+        topo.sync_node(n, heartbeat())
+        topo.incremental_sync_node(n, [vol(5)], [])
+        assert topo.lookup_volume("", 5)
+        topo.incremental_sync_node(n, [], [vol(5)])
+        assert topo.lookup_volume("", 5) == []
+
+    def test_node_death_unregisters_everything(self):
+        topo = Topology()
+        n1 = topo.get_or_create_node("dc1", "r1", "10.0.0.1", 8080)
+        n2 = topo.get_or_create_node("dc1", "r1", "10.0.0.2", 8080)
+        topo.sync_node(n1, heartbeat([vol(1)]))
+        topo.sync_node(n2, heartbeat([vol(1)]))
+        topo.unregister_node(n1)
+        locs = topo.lookup_volume("", 1)
+        assert [x.url for x in locs] == ["10.0.0.2:8080"]
+        assert topo.find_node("10.0.0.1:8080") is None
+
+    def test_ec_shard_registration(self):
+        topo = Topology()
+        n1 = topo.get_or_create_node("dc1", "r1", "10.0.0.1", 8080)
+        n2 = topo.get_or_create_node("dc1", "r2", "10.0.0.2", 8080)
+        bits1 = sum(1 << i for i in range(7))       # shards 0-6
+        bits2 = sum(1 << i for i in range(7, 14))   # shards 7-13
+        topo.sync_node(n1, heartbeat(ec=[EcShardMessage(9, "", bits1, "hdd")]))
+        topo.sync_node(n2, heartbeat(ec=[EcShardMessage(9, "", bits2, "hdd")]))
+        locs = topo.lookup_ec_shards(9)
+        assert [n.url for n in locs.locations[0]] == ["10.0.0.1:8080"]
+        assert [n.url for n in locs.locations[13]] == ["10.0.0.2:8080"]
+        # lookup_volume falls through to EC
+        assert len(topo.lookup_volume("", 9)) == 2
+        # delta-remove n1's shards
+        topo.incremental_sync_node(n1, [], [], [], [EcShardMessage(9, "", bits1, "hdd")])
+        assert locs.locations[0] == []
+
+
+class TestPickForWrite:
+    def test_round_robin_over_writables(self):
+        topo = Topology()
+        n = topo.get_or_create_node("dc1", "r1", "10.0.0.1", 8080)
+        topo.sync_node(n, heartbeat([vol(1), vol(2), vol(3)]))
+        opt = VolumeGrowOption()
+        seen = set()
+        for _ in range(30):
+            fid, _, nodes = topo.pick_for_write(1, opt)
+            vid, nid, cookie = t.parse_fid(fid)
+            seen.add(vid)
+            assert nodes[0].url == "10.0.0.1:8080"
+        assert seen == {1, 2, 3}
+
+    def test_readonly_and_oversized_excluded(self):
+        topo = Topology(volume_size_limit=10_000)
+        n = topo.get_or_create_node("dc1", "r1", "10.0.0.1", 8080)
+        topo.sync_node(
+            n, heartbeat([vol(1), vol(2, read_only=True), vol(3, size=20_000)])
+        )
+        for _ in range(10):
+            fid, _, _ = topo.pick_for_write(1, VolumeGrowOption())
+            assert fid.startswith("1,")
+
+    def test_under_replicated_not_writable(self):
+        topo = Topology()
+        n = topo.get_or_create_node("dc1", "r1", "10.0.0.1", 8080)
+        # rp=001 needs 2 copies; only one registered
+        topo.sync_node(n, heartbeat([vol(1, rp="001")]))
+        opt = VolumeGrowOption(replica_placement=t.ReplicaPlacement.parse("001"))
+        with pytest.raises(LookupError):
+            topo.pick_for_write(1, opt)
+
+    def test_fid_ids_are_sequential(self):
+        topo = Topology(sequencer=MemorySequencer(start=100))
+        n = topo.get_or_create_node("dc1", "r1", "10.0.0.1", 8080)
+        topo.sync_node(n, heartbeat([vol(1)]))
+        fid1, _, _ = topo.pick_for_write(1, VolumeGrowOption())
+        fid2, _, _ = topo.pick_for_write(3, VolumeGrowOption())
+        assert t.parse_fid(fid1)[1] == 100
+        assert t.parse_fid(fid2)[1] == 101
+        fid3, _, _ = topo.pick_for_write(1, VolumeGrowOption())
+        assert t.parse_fid(fid3)[1] == 104
+
+
+class TestVolumeGrowth:
+    def fabric(self):
+        return build_topo(
+            {
+                "dc1": {"r1": [("s1", 1, 4), ("s2", 1, 4)], "r2": [("s3", 1, 4)]},
+                "dc2": {"r1": [("s4", 1, 4)]},
+                "dc3": {"r1": [("s5", 1, 4)]},
+            }
+        )
+
+    def grow(self, topo, rp):
+        g = VolumeGrowth(rng=random.Random(42))
+        opt = VolumeGrowOption(replica_placement=t.ReplicaPlacement.parse(rp))
+        return g.find_empty_slots(topo.data_centers, opt)
+
+    def test_000_single_copy(self):
+        servers = self.grow(self.fabric(), "000")
+        assert len(servers) == 1
+
+    def test_001_same_rack_pair(self):
+        servers = self.grow(self.fabric(), "001")
+        assert len(servers) == 2
+        racks = {s.rack.name for s in servers}
+        dcs = {s.rack.data_center.name for s in servers}
+        assert len(racks) == 1 and len(dcs) == 1
+        assert {s.url for s in servers} == {"s1:1", "s2:1"}
+
+    def test_010_cross_rack(self):
+        servers = self.grow(self.fabric(), "010")
+        assert len(servers) == 2
+        assert servers[0].rack.data_center.name == servers[1].rack.data_center.name
+        assert servers[0].rack.name != servers[1].rack.name
+
+    def test_200_three_data_centers(self):
+        servers = self.grow(self.fabric(), "200")
+        assert len(servers) == 3
+        assert len({s.rack.data_center.name for s in servers}) == 3
+
+    def test_011_mixed(self):
+        servers = self.grow(self.fabric(), "011")
+        assert len(servers) == 3
+        by_rack = {}
+        for s in servers:
+            by_rack.setdefault((s.rack.data_center.name, s.rack.name), []).append(s)
+        # one rack has 2 nodes, another rack (same dc) has 1
+        sizes = sorted(len(v) for v in by_rack.values())
+        assert sizes == [1, 2]
+
+    def test_no_capacity_raises(self):
+        topo = build_topo({"dc1": {"r1": [("s1", 1, 0)]}})
+        with pytest.raises(NoFreeSpace):
+            self.grow(topo, "000")
+
+    def test_insufficient_dcs_raises(self):
+        topo = build_topo({"dc1": {"r1": [("s1", 1, 4)]}})
+        with pytest.raises(NoFreeSpace):
+            self.grow(topo, "100")
+
+    def test_grow_volumes_allocates_and_numbers(self):
+        topo = self.fabric()
+        allocated = []
+        opt = VolumeGrowOption(replica_placement=t.ReplicaPlacement.parse("001"))
+        vids = topo.grow_volumes(opt, 2, lambda n, vid, o: allocated.append((n.url, vid)))
+        assert len(vids) == 2 and vids[0] != vids[1]
+        assert len(allocated) == 4  # 2 volumes × 2 replicas
+
+    def test_target_count(self):
+        assert target_count_per_request(t.ReplicaPlacement.parse("000")) == 7
+        assert target_count_per_request(t.ReplicaPlacement.parse("001")) == 6
+        assert target_count_per_request(t.ReplicaPlacement.parse("011")) == 3
+        assert target_count_per_request(t.ReplicaPlacement.parse("111")) == 1
+
+
+class FakeVacuumRpc:
+    def __init__(self, ratios):
+        self.ratios = ratios
+        self.compacted, self.committed, self.cleaned = [], [], []
+        self.fail_compact_on = set()
+
+    def check(self, node, vid):
+        return self.ratios.get(vid, 0.0)
+
+    def compact(self, node, vid):
+        if node.url in self.fail_compact_on:
+            return False
+        self.compacted.append((node.url, vid))
+        return True
+
+    def commit(self, node, vid):
+        self.committed.append((node.url, vid))
+        return True
+
+    def cleanup(self, node, vid):
+        self.cleaned.append((node.url, vid))
+        return True
+
+
+class TestVacuumOrchestration:
+    def make(self):
+        topo = Topology()
+        n1 = topo.get_or_create_node("dc1", "r1", "10.0.0.1", 8080)
+        n2 = topo.get_or_create_node("dc1", "r1", "10.0.0.2", 8080)
+        topo.sync_node(n1, heartbeat([vol(1, rp="001"), vol(2, rp="001")]))
+        topo.sync_node(n2, heartbeat([vol(1, rp="001"), vol(2, rp="001")]))
+        return topo
+
+    def test_only_garbage_above_threshold(self):
+        topo = self.make()
+        rpc = FakeVacuumRpc({1: 0.6, 2: 0.1})
+        results = scan_and_vacuum(topo, rpc, garbage_threshold=0.3)
+        assert [r.vid for r in results] == [1]
+        assert results[0].committed
+        assert len(rpc.committed) == 2  # both replicas
+
+    def test_failed_compact_cleans_up(self):
+        topo = self.make()
+        rpc = FakeVacuumRpc({1: 0.9})
+        rpc.fail_compact_on = {"10.0.0.2:8080"}
+        results = scan_and_vacuum(topo, rpc, garbage_threshold=0.3)
+        assert not results[0].committed
+        assert len(rpc.cleaned) == 2
+        assert not rpc.committed
+
+    def test_volume_stays_writable_after(self):
+        topo = self.make()
+        rpc = FakeVacuumRpc({1: 0.9, 2: 0.9})
+        scan_and_vacuum(topo, rpc)
+        _, vl = topo.layouts()[0]
+        assert sorted(vl.writables) == [1, 2]
