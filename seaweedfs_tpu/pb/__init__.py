@@ -1,0 +1,11 @@
+"""Protobuf schemas + descriptor-driven gRPC plumbing.
+
+Reference: weed/pb/ — 9 protos, 27.8k generated LoC.  Here: 3 condensed
+protos (master, volume_server, filer) compiled with `protoc --python_out`
+(see generate.sh) and a reflection layer (rpc.py) that derives client stubs
+and server handlers from the descriptors, replacing grpc_tools codegen.
+"""
+from . import server_address
+from .rpc import Stub, channel, close_all_channels, generic_handler
+
+__all__ = ["Stub", "channel", "close_all_channels", "generic_handler", "server_address"]

@@ -1,0 +1,68 @@
+"""Descriptor-driven gRPC infra: unary + bidi streaming over a real
+in-process grpc.aio server."""
+import asyncio
+
+import grpc
+import pytest
+
+from seaweedfs_tpu.pb import Stub, generic_handler, server_address
+from seaweedfs_tpu.pb import master_pb2
+
+
+class FakeMaster:
+    async def Assign(self, request, context):
+        return master_pb2.AssignResponse(
+            fid=f"1,00000064{0xDEAD:08x}", count=request.count or 1
+        )
+
+    async def SendHeartbeat(self, request_iterator, context):
+        async for hb in request_iterator:
+            yield master_pb2.HeartbeatResponse(
+                volume_size_limit=1000, leader=hb.ip
+            )
+
+
+@pytest.fixture
+def loop():
+    loop = asyncio.new_event_loop()
+    yield loop
+    loop.close()
+
+
+def test_server_address():
+    assert server_address.parse("localhost:9333") == ("localhost", 9333, 19333)
+    assert server_address.parse("h:8080.18081") == ("h", 8080, 18081)
+    assert server_address.grpc_address("h:9333") == "h:19333"
+
+
+def test_unary_and_streaming(loop):
+    async def run():
+        server = grpc.aio.server()
+        server.add_generic_rpc_handlers(
+            [generic_handler(master_pb2, "Seaweed", FakeMaster())]
+        )
+        port = server.add_insecure_port("127.0.0.1:0")
+        await server.start()
+        try:
+            async with grpc.aio.insecure_channel(f"127.0.0.1:{port}") as ch:
+                stub = Stub(ch, master_pb2, "Seaweed")
+                resp = await stub.Assign(master_pb2.AssignRequest(count=3))
+                assert resp.count == 3 and resp.fid.startswith("1,")
+
+                async def pulses():
+                    for ip in ("a", "b"):
+                        yield master_pb2.Heartbeat(ip=ip)
+
+                got = []
+                async for r in stub.SendHeartbeat(pulses()):
+                    got.append(r.leader)
+                assert got == ["a", "b"]
+
+                # unimplemented method -> UNIMPLEMENTED, not a crash
+                with pytest.raises(grpc.aio.AioRpcError) as ei:
+                    await stub.LookupVolume(master_pb2.LookupVolumeRequest())
+                assert ei.value.code() == grpc.StatusCode.UNIMPLEMENTED
+        finally:
+            await server.stop(None)
+
+    loop.run_until_complete(run())
