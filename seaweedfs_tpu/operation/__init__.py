@@ -1,0 +1,19 @@
+"""Client verbs against the cluster (reference: weed/operation/, 1,732 LoC):
+assign, upload, delete, lookup — async HTTP/gRPC helpers used by the filer,
+gateways, shell, and tests.
+"""
+from .assign import assign
+from .delete import delete_file
+from .lookup import lookup_file_id, lookup_volume_ids
+from .upload import upload_data, upload_multipart_body
+from .submit import submit_data
+
+__all__ = [
+    "assign",
+    "delete_file",
+    "lookup_file_id",
+    "lookup_volume_ids",
+    "upload_data",
+    "upload_multipart_body",
+    "submit_data",
+]

@@ -1,0 +1,25 @@
+"""Delete files by fid (reference: operation/delete_content.go — batched
+per volume)."""
+from __future__ import annotations
+
+import asyncio
+
+import aiohttp
+
+from .lookup import lookup_file_id
+
+
+async def delete_file(master: str, fid: str) -> bool:
+    urls = await lookup_file_id(master, fid)
+    if not urls:
+        return False
+    async with aiohttp.ClientSession() as s:
+        async with s.delete(urls[0]) as r:
+            return r.status < 300
+
+
+async def delete_files(master: str, fids: list[str]) -> int:
+    results = await asyncio.gather(
+        *(delete_file(master, fid) for fid in fids), return_exceptions=True
+    )
+    return sum(1 for r in results if r is True)

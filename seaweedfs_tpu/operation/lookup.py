@@ -1,0 +1,31 @@
+"""Volume location lookup (reference: operation/lookup.go)."""
+from __future__ import annotations
+
+from ..pb import Stub, channel, master_pb2, server_address
+
+
+async def lookup_volume_ids(
+    master: str, vids: list[str], collection: str = ""
+) -> dict[str, list[dict]]:
+    """vid -> [{url, publicUrl, grpcPort}]; missing vids map to []."""
+    stub = Stub(channel(server_address.grpc_address(master)), master_pb2, "Seaweed")
+    resp = await stub.LookupVolume(
+        master_pb2.LookupVolumeRequest(
+            volume_or_file_ids=[str(v) for v in vids], collection=collection
+        )
+    )
+    out: dict[str, list[dict]] = {}
+    for e in resp.volume_id_locations:
+        key = e.volume_or_file_id.split(",")[0]
+        out[key] = [
+            {"url": l.url, "publicUrl": l.public_url, "grpcPort": l.grpc_port}
+            for l in e.locations
+        ]
+    return out
+
+
+async def lookup_file_id(master: str, fid: str) -> list[str]:
+    """fid -> list of full data URLs for it."""
+    vid = fid.split(",")[0]
+    locs = await lookup_volume_ids(master, [vid])
+    return [f"http://{l['url']}/{fid}" for l in locs.get(vid, [])]

@@ -1,0 +1,69 @@
+"""Upload needle bytes to a volume server (reference:
+operation/upload_content.go, 370 LoC — retry, gzip, multipart assembly)."""
+from __future__ import annotations
+
+import gzip
+import uuid
+
+import aiohttp
+
+_COMPRESSIBLE = ("text/", "application/json", "application/xml", "application/javascript")
+
+
+def _should_gzip(mime: str, data: bytes) -> bool:
+    if len(data) < 128:
+        return False
+    return any(mime.startswith(p) for p in _COMPRESSIBLE)
+
+
+async def upload_data(
+    url: str,
+    data: bytes,
+    filename: str = "",
+    mime: str = "",
+    compress: bool = True,
+    retries: int = 2,
+) -> dict:
+    """POST to http://volume/fid as multipart/form-data; returns the
+    volume server's JSON ({name, size, eTag})."""
+    body = data
+    gzipped = False
+    if compress and _should_gzip(mime, data):
+        gz = gzip.compress(data)
+        if len(gz) < len(data) * 0.9:
+            body = gz
+            gzipped = True
+    last_err: Exception | None = None
+    for _ in range(retries + 1):
+        try:
+            with aiohttp.MultipartWriter("form-data") as mpw:
+                part = mpw.append(
+                    body,
+                    {"Content-Type": mime or "application/octet-stream"},
+                )
+                part.set_content_disposition(
+                    "form-data", name="file", filename=filename or uuid.uuid4().hex
+                )
+                if gzipped:
+                    part.headers["Content-Encoding"] = "gzip"
+                async with aiohttp.ClientSession() as s:
+                    async with s.post(url, data=mpw) as r:
+                        if r.status >= 300:
+                            raise RuntimeError(
+                                f"upload {url}: HTTP {r.status} {await r.text()}"
+                            )
+                        return await r.json()
+        except Exception as e:  # noqa: BLE001 — retry any transport error
+            last_err = e
+    raise RuntimeError(f"upload {url} failed after {retries + 1} tries: {last_err}")
+
+
+async def upload_multipart_body(url: str, body: bytes, content_type: str = "") -> dict:
+    """Re-post an already-multipart body (master /submit proxy path)."""
+    async with aiohttp.ClientSession() as s:
+        async with s.post(
+            url, data=body, headers={"Content-Type": content_type} if content_type else {}
+        ) as r:
+            if r.status >= 300:
+                raise RuntimeError(f"upload {url}: HTTP {r.status}")
+            return await r.json()

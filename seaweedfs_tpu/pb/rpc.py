@@ -17,6 +17,7 @@ MAX_MESSAGE_SIZE = 32 * 1024 * 1024  # reference pb/grpc_client_server.go
 GRPC_OPTIONS = [
     ("grpc.max_send_message_length", MAX_MESSAGE_SIZE),
     ("grpc.max_receive_message_length", MAX_MESSAGE_SIZE),
+    ("grpc.so_reuseport", 0),  # never silently share a listener
 ]
 
 

@@ -1,0 +1,10 @@
+"""Servers: master + volume (+ filer, gateways) on asyncio/grpc.aio.
+
+Reference: weed/server/ (10.2k LoC).  Each server is a plain class with
+async start()/stop(); the `weed server` all-in-one launcher lives in
+cluster.py.
+"""
+from .master import MasterServer
+from .volume import VolumeServer
+
+__all__ = ["MasterServer", "VolumeServer"]

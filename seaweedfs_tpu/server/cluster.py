@@ -1,0 +1,79 @@
+"""In-process cluster launcher — the `weed server` equivalent.
+
+Reference: weed/command/server.go boots master+volume(+filer) in one
+process; here `LocalCluster` does the same on one asyncio loop, and is
+what the e2e tests and the benchmark harness drive.
+"""
+from __future__ import annotations
+
+import asyncio
+
+from .master import MasterServer
+from .volume import VolumeServer
+
+
+class LocalCluster:
+    def __init__(
+        self,
+        n_volume_servers: int = 1,
+        dirs_per_server: int = 1,
+        base_dir: str = "/tmp/seaweedfs-tpu",
+        max_volume_count: int = 16,
+        volume_size_limit_mb: int = 1024,
+        pulse_seconds: int = 1,
+        ec_backend: str = "auto",
+        data_centers: list[str] | None = None,
+        racks: list[str] | None = None,
+    ):
+        import os
+
+        self.master = MasterServer(
+            port=0, volume_size_limit_mb=volume_size_limit_mb,
+            pulse_seconds=pulse_seconds,
+        )
+        self.base_dir = base_dir
+        self._specs = []
+        for i in range(n_volume_servers):
+            dirs = [
+                os.path.join(base_dir, f"vs{i}", f"d{j}")
+                for j in range(dirs_per_server)
+            ]
+            self._specs.append(
+                dict(
+                    directories=dirs,
+                    max_volume_counts=max_volume_count,
+                    pulse_seconds=pulse_seconds,
+                    ec_backend=ec_backend,
+                    data_center=(data_centers or ["dc1"])[i % len(data_centers or ["dc1"])],
+                    rack=(racks or ["r1"])[i % len(racks or ["r1"])],
+                )
+            )
+        self.volume_servers: list[VolumeServer] = []
+
+    async def start(self) -> None:
+        await self.master.start()
+        for spec in self._specs:
+            vs = VolumeServer(masters=[self.master.url], port=0, grpc_port=0, **spec)
+            # master http port == grpc port resolution needs master.grpc_port;
+            # VolumeServer resolves host:port -> grpc via +10000, so pass the
+            # explicit grpc address form
+            vs.masters = [f"{self.master.ip}:{self.master.port}.{self.master.grpc_port}"]
+            await vs.start()
+            self.volume_servers.append(vs)
+        await self.wait_for_nodes(len(self.volume_servers))
+
+    async def wait_for_nodes(self, n: int, timeout: float = 10.0) -> None:
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            if len(self.master.topo.data_nodes()) >= n:
+                return
+            await asyncio.sleep(0.05)
+        raise TimeoutError(f"only {len(self.master.topo.data_nodes())}/{n} nodes joined")
+
+    async def stop(self) -> None:
+        for vs in self.volume_servers:
+            await vs.stop()
+        await self.master.stop()
+        from ..pb.rpc import close_all_channels
+
+        await close_all_channels()

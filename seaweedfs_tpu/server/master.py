@@ -1,0 +1,672 @@
+"""MasterServer: topology brain + HTTP admin + gRPC services.
+
+Reference: weed/server/master_server.go (410), master_grpc_server.go (409),
+master_grpc_server_volume.go (324), master_server_handlers*.go (341).
+
+One asyncio process hosting:
+  - gRPC `Seaweed` service: SendHeartbeat (bidi: volume servers),
+    KeepConnected (bidi: filers/shells/mounts get VolumeLocation pushes),
+    Assign / LookupVolume / LookupEcVolume / VolumeList / admin locks
+  - aiohttp admin+data endpoints: /dir/assign, /dir/lookup, /dir/status,
+    /vol/grow, /vol/vacuum, /col/delete, /submit
+  - automatic volume growth when a layout runs out of writable volumes
+    (the reference's vgCh channel → here an asyncio queue consumed by
+    a grower task)
+  - periodic vacuum scan driving the volume servers' compact protocol
+
+Single-master deployment (the reference supports the same); raft HA is
+layered on in server/raft.py.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from dataclasses import dataclass, field
+
+import grpc
+from aiohttp import web
+
+from ..pb import Stub, generic_handler, master_pb2, volume_server_pb2
+from ..pb.rpc import GRPC_OPTIONS, channel
+from ..storage import types as t
+from ..topology import (
+    MemorySequencer,
+    NoFreeSpace,
+    Topology,
+    VolumeGrowOption,
+    target_count_per_request,
+)
+from ..topology.node import DataNode
+from .conversions import (
+    ec_msg_from_pb,
+    heartbeat_state_from_pb,
+    node_to_location,
+    volume_msg_from_pb,
+)
+
+log = logging.getLogger("master")
+
+
+@dataclass
+class AdminLock:
+    """Exclusive admin lock leased to one shell at a time
+    (LeaseAdminToken master_grpc_server_admin.go)."""
+
+    token: int = 0
+    ts_ns: int = 0
+    client: str = ""
+    message: str = ""
+
+    LEASE_NS = 60 * 1_000_000_000
+
+    def is_held(self) -> bool:
+        return self.token != 0 and time.time_ns() - self.ts_ns < self.LEASE_NS
+
+
+class MasterServer:
+    def __init__(
+        self,
+        ip: str = "127.0.0.1",
+        port: int = 9333,
+        grpc_port: int = 0,
+        volume_size_limit_mb: int = 30 * 1024,
+        default_replication: str = "000",
+        pulse_seconds: int = 5,
+        garbage_threshold: float = 0.3,
+        sequencer: MemorySequencer | None = None,
+        auto_vacuum: bool = False,
+    ):
+        self.ip = ip
+        self.port = port
+        self.grpc_port = grpc_port or (port + 10000 if port else 0)
+        self.default_replication = default_replication
+        self.pulse_seconds = pulse_seconds
+        self.garbage_threshold = garbage_threshold
+        self.auto_vacuum = auto_vacuum
+        self.topo = Topology(
+            volume_size_limit=volume_size_limit_mb * 1024 * 1024,
+            sequencer=sequencer,
+            pulse_seconds=pulse_seconds,
+        )
+        self._subscribers: dict[object, asyncio.Queue] = {}
+        self._grow_queue: asyncio.Queue = asyncio.Queue()
+        self._growing: set[tuple] = set()
+        self.locks: dict[str, AdminLock] = {}
+        self._grpc_server: grpc.aio.Server | None = None
+        self._http_runner: web.AppRunner | None = None
+        self._tasks: list[asyncio.Task] = []
+
+    @property
+    def url(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    @property
+    def grpc_url(self) -> str:
+        return f"{self.ip}:{self.grpc_port}"
+
+    @property
+    def advertise_url(self) -> str:
+        """host:port[.grpc] — explicit grpc form when the +10000 convention
+        doesn't hold (dynamically-assigned test ports)."""
+        if self.grpc_port == self.port + 10000:
+            return self.url
+        return f"{self.ip}:{self.port}.{self.grpc_port}"
+
+    # ------------------------------------------------------------------ lifecycle
+
+    async def start(self) -> None:
+        self._grpc_server = grpc.aio.server(options=GRPC_OPTIONS)
+        self._grpc_server.add_generic_rpc_handlers(
+            [generic_handler(master_pb2, "Seaweed", self)]
+        )
+        self.grpc_port = self._grpc_server.add_insecure_port(
+            f"{self.ip}:{self.grpc_port}"
+        )
+        await self._grpc_server.start()
+
+        app = web.Application(client_max_size=256 * 1024 * 1024)
+        app.router.add_route("*", "/dir/assign", self.h_assign)
+        app.router.add_route("*", "/dir/lookup", self.h_lookup)
+        app.router.add_get("/dir/status", self.h_dir_status)
+        app.router.add_route("*", "/vol/grow", self.h_grow)
+        app.router.add_route("*", "/vol/vacuum", self.h_vacuum)
+        app.router.add_route("*", "/col/delete", self.h_col_delete)
+        app.router.add_post("/submit", self.h_submit)
+        app.router.add_get("/cluster/status", self.h_cluster_status)
+        self._http_runner = web.AppRunner(app)
+        await self._http_runner.setup()
+        site = web.TCPSite(self._http_runner, self.ip, self.port)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        self.port = port
+
+        self._tasks.append(asyncio.create_task(self._grower_loop()))
+        if self.auto_vacuum:
+            self._tasks.append(asyncio.create_task(self._vacuum_loop()))
+        log.info("master up http=%s grpc=%s", self.url, self.grpc_url)
+
+    async def stop(self) -> None:
+        for t_ in self._tasks:
+            t_.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        if self._grpc_server:
+            await self._grpc_server.stop(0.1)
+        if self._http_runner:
+            await self._http_runner.cleanup()
+
+    # ------------------------------------------------------------------ gRPC
+
+    async def SendHeartbeat(self, request_iterator, context):
+        """Volume-server registration stream (master_grpc_server.go:61-170)."""
+        node: DataNode | None = None
+        try:
+            async for hb in request_iterator:
+                if node is None:
+                    node = self.topo.get_or_create_node(
+                        hb.data_center,
+                        hb.rack,
+                        hb.ip,
+                        hb.port,
+                        hb.public_url,
+                        hb.grpc_port,
+                    )
+                    log.info("volume server joined: %s", node.url)
+                if hb.volumes or hb.has_no_volumes or hb.ec_shards or hb.has_no_ec_shards:
+                    new_v, del_v, new_ec, del_ec = self.topo.sync_node(
+                        node, heartbeat_state_from_pb(hb)
+                    )
+                    self._broadcast_location(node, new_v, del_v, new_ec, del_ec)
+                if hb.new_volumes or hb.deleted_volumes or hb.new_ec_shards or hb.deleted_ec_shards:
+                    self.topo.incremental_sync_node(
+                        node,
+                        [volume_msg_from_pb(v) for v in hb.new_volumes],
+                        [volume_msg_from_pb(v) for v in hb.deleted_volumes],
+                        [ec_msg_from_pb(e) for e in hb.new_ec_shards],
+                        [ec_msg_from_pb(e) for e in hb.deleted_ec_shards],
+                    )
+                    self._broadcast_location(
+                        node,
+                        [v.id for v in hb.new_volumes],
+                        [v.id for v in hb.deleted_volumes],
+                        [e.id for e in hb.new_ec_shards],
+                        [e.id for e in hb.deleted_ec_shards],
+                    )
+                yield master_pb2.HeartbeatResponse(
+                    volume_size_limit=self.topo.volume_size_limit,
+                    leader=self.advertise_url,
+                )
+        finally:
+            if node is not None:
+                # stream broke: the server is gone; drop its volumes and
+                # tell every subscribed client (phantom cleanup :63-94)
+                dead_vids = list(node.volumes)
+                dead_ec = list(node.ec_shards)
+                self.topo.unregister_node(node)
+                self._broadcast_location(node, [], dead_vids, [], dead_ec)
+                log.info("volume server left: %s", node.url)
+
+    async def KeepConnected(self, request_iterator, context):
+        """Client subscription stream: pushes VolumeLocation deltas
+        (master_grpc_server.go broadcastToClients)."""
+        q: asyncio.Queue = asyncio.Queue()
+        key = object()
+        self._subscribers[key] = q
+        # send current full location map first
+        for n in self.topo.data_nodes():
+            loc = master_pb2.VolumeLocation(
+                url=n.url,
+                public_url=n.public_url,
+                grpc_port=n.grpc_port,
+                data_center=n.rack.data_center.name if n.rack else "",
+                new_vids=sorted(set(list(n.volumes) + list(n.ec_shards))),
+                new_ec_vids=sorted(n.ec_shards),
+            )
+            yield master_pb2.KeepConnectedResponse(
+                volume_location=loc, leader=self.advertise_url
+            )
+
+        async def drain_requests():
+            try:
+                async for _ in request_iterator:
+                    pass
+            except Exception:
+                pass
+            finally:
+                q.put_nowait(None)
+
+        drainer = asyncio.create_task(drain_requests())
+        try:
+            while True:
+                item = await q.get()
+                if item is None:
+                    break
+                yield item
+        finally:
+            drainer.cancel()
+            self._subscribers.pop(key, None)
+
+    def _broadcast_location(
+        self,
+        node: DataNode,
+        new_vids: list[int],
+        deleted_vids: list[int],
+        new_ec_vids: list[int] = (),
+        deleted_ec_vids: list[int] = (),
+    ) -> None:
+        if not (new_vids or deleted_vids or new_ec_vids or deleted_ec_vids):
+            return
+        msg = master_pb2.KeepConnectedResponse(
+            volume_location=master_pb2.VolumeLocation(
+                url=node.url,
+                public_url=node.public_url,
+                grpc_port=node.grpc_port,
+                data_center=node.rack.data_center.name if node.rack else "",
+                new_vids=sorted(set(new_vids) | set(new_ec_vids)),
+                deleted_vids=sorted(set(deleted_vids) | set(deleted_ec_vids)),
+                new_ec_vids=sorted(set(new_ec_vids)),
+                deleted_ec_vids=sorted(set(deleted_ec_vids)),
+            ),
+            leader=self.advertise_url,
+        )
+        for q in self._subscribers.values():
+            q.put_nowait(msg)
+
+    async def Assign(self, request, context):
+        try:
+            option = self._grow_option(
+                request.collection,
+                request.replication,
+                request.ttl,
+                request.data_center,
+                request.rack,
+                request.data_node,
+                request.disk_type,
+            )
+        except ValueError as e:
+            return master_pb2.AssignResponse(error=str(e))
+        count = int(request.count) or 1
+        for attempt in range(3):
+            try:
+                fid, n, nodes = self.topo.pick_for_write(count, option)
+                return master_pb2.AssignResponse(
+                    fid=fid,
+                    count=n,
+                    location=node_to_location(nodes[0]),
+                    replicas=[node_to_location(x) for x in nodes[1:]],
+                )
+            except LookupError:
+                grown = await self._grow_now(option)
+                if not grown:
+                    break
+        return master_pb2.AssignResponse(error="no writable volumes and growth failed")
+
+    async def LookupVolume(self, request, context):
+        resp = master_pb2.LookupVolumeResponse()
+        for vof in request.volume_or_file_ids:
+            entry = resp.volume_id_locations.add(volume_or_file_id=vof)
+            try:
+                vid_s = vof.split(",")[0]
+                nodes = self.topo.lookup_volume(request.collection, int(vid_s))
+                if not nodes:
+                    entry.error = f"volume {vid_s} not found"
+                else:
+                    entry.locations.extend(node_to_location(n) for n in nodes)
+            except ValueError:
+                entry.error = f"bad volume id {vof!r}"
+        return resp
+
+    async def LookupEcVolume(self, request, context):
+        locs = self.topo.lookup_ec_shards(request.volume_id)
+        resp = master_pb2.LookupEcVolumeResponse(volume_id=request.volume_id)
+        if locs is None:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND, f"ec volume {request.volume_id} not found"
+            )
+        for sid, nodes in enumerate(locs.locations):
+            if nodes:
+                e = resp.shard_id_locations.add(shard_id=sid)
+                e.locations.extend(node_to_location(n) for n in nodes)
+        return resp
+
+    async def Statistics(self, request, context):
+        total = used = files = 0
+        for n in self.topo.data_nodes():
+            for v in n.volumes.values():
+                if request.collection and v.collection != request.collection:
+                    continue
+                used += v.size
+                files += v.file_count
+            total += n.max_volume_count() * self.topo.volume_size_limit
+        return master_pb2.StatisticsResponse(
+            total_size=total, used_size=used, file_count=files
+        )
+
+    async def CollectionList(self, request, context):
+        return master_pb2.CollectionListResponse(
+            collections=[
+                master_pb2.Collection(name=c) for c in sorted(self.topo.collections)
+                if c
+            ]
+        )
+
+    async def CollectionDelete(self, request, context):
+        vids = set()
+        for col_name, vl in self.topo.layouts():
+            if col_name == request.name:
+                vids.update(vl.vid2location)
+        for vid in vids:
+            for node in self.topo.lookup_volume(request.name, vid):
+                stub = self._volume_stub(node)
+                try:
+                    await stub.VolumeDelete(
+                        volume_server_pb2.VolumeDeleteRequest(volume_id=vid)
+                    )
+                except grpc.aio.AioRpcError as e:
+                    log.warning("delete %d on %s failed: %s", vid, node.url, e)
+        self.topo.collections.pop(request.name, None)
+        return master_pb2.CollectionDeleteResponse()
+
+    async def VolumeList(self, request, context):
+        return master_pb2.VolumeListResponse(
+            topology_info_json=json.dumps(self.topo.to_info()),
+            volume_size_limit_mb=self.topo.volume_size_limit // (1024 * 1024),
+        )
+
+    async def LeaseAdminToken(self, request, context):
+        lock = self.locks.setdefault(request.lock_name, AdminLock())
+        now = time.time_ns()
+        if lock.is_held() and lock.token != request.previous_token:
+            await context.abort(
+                grpc.StatusCode.ABORTED,
+                f"lock {request.lock_name} held by {lock.client}: {lock.message}",
+            )
+        lock.token = now
+        lock.ts_ns = now
+        lock.client = request.client_name
+        lock.message = request.message
+        return master_pb2.LeaseAdminTokenResponse(token=now, lock_ts_ns=now)
+
+    async def ReleaseAdminToken(self, request, context):
+        lock = self.locks.get(request.lock_name)
+        if lock and lock.token == request.previous_token:
+            lock.token = 0
+        return master_pb2.ReleaseAdminTokenResponse()
+
+    async def VacuumVolume(self, request, context):
+        await self._vacuum_pass(
+            request.garbage_threshold or self.garbage_threshold,
+            request.volume_id or 0,
+        )
+        return master_pb2.VacuumVolumeResponse()
+
+    # ------------------------------------------------------------------ growth
+
+    def _grow_option(
+        self,
+        collection: str = "",
+        replication: str = "",
+        ttl: str = "",
+        data_center: str = "",
+        rack: str = "",
+        data_node: str = "",
+        disk_type: str = "",
+    ) -> VolumeGrowOption:
+        return VolumeGrowOption(
+            collection=collection,
+            replica_placement=t.ReplicaPlacement.parse(
+                replication or self.default_replication
+            ),
+            ttl=t.TTL.parse(ttl or ""),
+            disk_type=disk_type or "hdd",
+            preferred_data_center=data_center,
+            preferred_rack=rack,
+            preferred_node=data_node,
+        )
+
+    async def _grow_now(self, option: VolumeGrowOption, count: int = 0) -> list[int]:
+        """Synchronously grow volumes for an assign that found nothing
+        writable (AutomaticGrowByType volume_growth.go:60-110)."""
+        key = (option.collection, str(option.replica_placement), str(option.ttl))
+        if key in self._growing:
+            await asyncio.sleep(0.05)
+            return []
+        self._growing.add(key)
+        try:
+            count = count or target_count_per_request(option.replica_placement)
+            allocations: list[tuple[DataNode, int]] = []
+
+            def plan(node, vid, opt):
+                allocations.append((node, vid))
+
+            try:
+                vids = self.topo.grow_volumes(option, count, plan)
+            except NoFreeSpace as e:
+                log.warning("growth failed: %s", e)
+                return []
+            ok_vids = set(vids)
+            for node, vid in allocations:
+                stub = self._volume_stub(node)
+                try:
+                    await stub.AllocateVolume(
+                        volume_server_pb2.AllocateVolumeRequest(
+                            volume_id=vid,
+                            collection=option.collection,
+                            replication=str(option.replica_placement),
+                            ttl=str(option.ttl),
+                            disk_type=option.disk_type,
+                        )
+                    )
+                except grpc.aio.AioRpcError as e:
+                    log.warning("allocate %d on %s failed: %s", vid, node.url, e)
+                    ok_vids.discard(vid)
+            # register immediately so the triggering assign can succeed;
+            # heartbeat deltas will confirm
+            for node, vid in allocations:
+                if vid in ok_vids:
+                    from ..storage.store import VolumeMessage
+
+                    self.topo.incremental_sync_node(
+                        node,
+                        [
+                            VolumeMessage(
+                                id=vid,
+                                size=0,
+                                collection=option.collection,
+                                file_count=0,
+                                delete_count=0,
+                                deleted_byte_count=0,
+                                read_only=False,
+                                replica_placement=option.replica_placement.to_byte(),
+                                version=3,
+                                ttl=int.from_bytes(option.ttl.to_bytes(), "big"),
+                                disk_type=option.disk_type,
+                            )
+                        ],
+                        [],
+                    )
+            return sorted(ok_vids)
+        finally:
+            self._growing.discard(key)
+
+    async def _grower_loop(self) -> None:
+        while True:
+            option = await self._grow_queue.get()
+            await self._grow_now(option)
+
+    def _volume_stub(self, node: DataNode) -> Stub:
+        return Stub(channel(node.grpc_url), volume_server_pb2, "VolumeServer")
+
+    # ------------------------------------------------------------------ vacuum
+
+    async def _vacuum_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.pulse_seconds * 3)
+            try:
+                await self._vacuum_pass(self.garbage_threshold)
+            except Exception:
+                log.exception("vacuum pass failed")
+
+    async def _vacuum_pass(self, threshold: float, only_vid: int = 0) -> int:
+        """Drive Check → Compact → Commit over gRPC
+        (topology_vacuum.go:220-269)."""
+        done = 0
+        for _, vl in self.topo.layouts():
+            for vid, loc in list(vl.vid2location.items()):
+                if only_vid and vid != only_vid:
+                    continue
+                nodes = list(loc.nodes)
+                if not nodes:
+                    continue
+                ratios = []
+                for n in nodes:
+                    try:
+                        r = await self._volume_stub(n).VacuumVolumeCheck(
+                            volume_server_pb2.VacuumVolumeCheckRequest(volume_id=vid)
+                        )
+                        ratios.append(r.garbage_ratio)
+                    except grpc.aio.AioRpcError:
+                        ratios.append(0.0)
+                if not only_vid and (not ratios or min(ratios) <= threshold):
+                    continue
+                vl.set_readonly(vid, True)
+                try:
+                    ok = True
+                    for n in nodes:
+                        try:
+                            async for _ in self._volume_stub(n).VacuumVolumeCompact(
+                                volume_server_pb2.VacuumVolumeCompactRequest(volume_id=vid)
+                            ):
+                                pass
+                        except grpc.aio.AioRpcError:
+                            ok = False
+                    for n in nodes:
+                        verb = "VacuumVolumeCommit" if ok else "VacuumVolumeCleanup"
+                        try:
+                            await getattr(self._volume_stub(n), verb)(
+                                getattr(volume_server_pb2, verb + "Request")(volume_id=vid)
+                            )
+                        except grpc.aio.AioRpcError:
+                            pass
+                    done += ok
+                finally:
+                    vl.set_readonly(vid, False)
+        return done
+
+    # ------------------------------------------------------------------ HTTP
+
+    async def h_assign(self, request: web.Request) -> web.Response:
+        params = {**request.query, **(await request.post() if request.method == "POST" else {})}
+        req = master_pb2.AssignRequest(
+            count=int(params.get("count", 1)),
+            replication=params.get("replication", ""),
+            collection=params.get("collection", ""),
+            ttl=params.get("ttl", ""),
+            data_center=params.get("dataCenter", ""),
+            rack=params.get("rack", ""),
+            data_node=params.get("dataNode", ""),
+            disk_type=params.get("disk", ""),
+        )
+        resp = await self.Assign(req, None)
+        if resp.error:
+            return web.json_response({"error": resp.error}, status=404)
+        return web.json_response(
+            {
+                "fid": resp.fid,
+                "url": resp.location.url,
+                "publicUrl": resp.location.public_url,
+                "count": resp.count,
+            }
+        )
+
+    async def h_lookup(self, request: web.Request) -> web.Response:
+        vof = request.query.get("volumeId", "")
+        collection = request.query.get("collection", "")
+        resp = await self.LookupVolume(
+            master_pb2.LookupVolumeRequest(
+                volume_or_file_ids=[vof], collection=collection
+            ),
+            None,
+        )
+        entry = resp.volume_id_locations[0]
+        if entry.error:
+            return web.json_response(
+                {"volumeOrFileId": vof, "error": entry.error}, status=404
+            )
+        return web.json_response(
+            {
+                "volumeOrFileId": vof,
+                "locations": [
+                    {"url": l.url, "publicUrl": l.public_url} for l in entry.locations
+                ],
+            }
+        )
+
+    async def h_dir_status(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"Topology": self.topo.to_info(), "Version": "seaweedfs-tpu"}
+        )
+
+    async def h_cluster_status(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"IsLeader": True, "Leader": self.url, "MaxVolumeId": self.topo.max_volume_id}
+        )
+
+    async def h_grow(self, request: web.Request) -> web.Response:
+        params = request.query
+        try:
+            option = self._grow_option(
+                params.get("collection", ""),
+                params.get("replication", ""),
+                params.get("ttl", ""),
+                params.get("dataCenter", ""),
+            )
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        count = int(params.get("count", 0))
+        vids = await self._grow_now(option, count)
+        if not vids:
+            return web.json_response({"error": "growth failed"}, status=500)
+        return web.json_response({"count": len(vids), "vids": vids})
+
+    async def h_vacuum(self, request: web.Request) -> web.Response:
+        threshold = float(
+            request.query.get("garbageThreshold", self.garbage_threshold)
+        )
+        n = await self._vacuum_pass(threshold)
+        return web.json_response({"vacuumed": n})
+
+    async def h_col_delete(self, request: web.Request) -> web.Response:
+        name = request.query.get("collection", "")
+        await self.CollectionDelete(
+            master_pb2.CollectionDeleteRequest(name=name), None
+        )
+        return web.json_response({"deleted": name})
+
+    async def h_submit(self, request: web.Request) -> web.Response:
+        """One-shot upload: assign + proxy the body to the volume server
+        (master_server_handlers.go submit)."""
+        from ..operation.upload import upload_multipart_body
+
+        params = request.query
+        resp = await self.Assign(
+            master_pb2.AssignRequest(
+                count=1,
+                replication=params.get("replication", ""),
+                collection=params.get("collection", ""),
+                ttl=params.get("ttl", ""),
+            ),
+            None,
+        )
+        if resp.error:
+            return web.json_response({"error": resp.error}, status=500)
+        body = await request.read()
+        result = await upload_multipart_body(
+            f"http://{resp.location.url}/{resp.fid}",
+            body,
+            content_type=request.content_type,
+        )
+        result["fid"] = resp.fid
+        result["fileUrl"] = f"{resp.location.public_url}/{resp.fid}"
+        return web.json_response(result)

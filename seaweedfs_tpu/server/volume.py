@@ -1,0 +1,865 @@
+"""VolumeServer: storage engine host — HTTP data plane + gRPC admin/EC.
+
+Reference: weed/server/volume_server.go (23-53), volume_server_handlers*.go,
+volume_grpc_admin.go (351), volume_grpc_vacuum.go (111), volume_grpc_copy.go
+(401), volume_grpc_erasure_coding.go (446), volume_grpc_client_to_master.go.
+
+One asyncio process per storage node:
+  - aiohttp: GET/HEAD/POST/PUT/DELETE on /vid,fid — reads serve normal
+    volumes, EC volumes (with remote-shard + degraded reconstruction
+    fallbacks), or redirect to a peer; writes fan out to replicas
+    (store_replicate.go:24-120)
+  - grpc.aio `VolumeServer` service: volume lifecycle, the 4-step vacuum
+    protocol, file copy streams, and all nine EC RPCs (SURVEY.md §2.2)
+  - a heartbeat task streaming full + delta state to the master
+    (volume_grpc_client_to_master.go:50-92)
+
+Blocking storage/kernel work runs via asyncio.to_thread; the degraded EC
+read's remote-shard hook uses synchronous gRPC stubs since it already runs
+on a worker thread.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import time
+
+import grpc
+from aiohttp import web
+
+from ..pb import Stub, generic_handler, master_pb2, volume_server_pb2
+from ..pb.rpc import GRPC_OPTIONS, channel
+from ..storage import types as t
+from ..storage import vacuum as vacuum_mod
+from ..storage.disk_location import DiskLocation
+from ..storage.ec import (
+    TOTAL_SHARDS,
+    ec_base_name,
+    find_dat_file_size,
+    to_ext,
+    write_dat_file,
+    write_idx_file_from_ec_index,
+)
+from ..storage.needle import CrcError, Needle
+from ..storage.store import Store
+from ..storage.volume import CookieMismatch, NotFoundError, Volume, VolumeReadOnly
+from .conversions import ec_msg_to_pb, volume_msg_to_pb
+
+log = logging.getLogger("volume")
+
+_EC_LOCATION_TTL = 10.0  # seconds; reference refreshes at 11s (store_ec.go:254)
+
+
+class VolumeServer:
+    def __init__(
+        self,
+        masters: list[str],
+        directories: list[str],
+        ip: str = "127.0.0.1",
+        port: int = 8080,
+        grpc_port: int = 0,
+        public_url: str = "",
+        max_volume_counts: int | list[int] = 8,
+        data_center: str = "",
+        rack: str = "",
+        pulse_seconds: int = 5,
+        ec_backend: str = "auto",
+        read_mode: str = "proxy",  # local | proxy | redirect
+    ):
+        if isinstance(max_volume_counts, int):
+            max_volume_counts = [max_volume_counts] * len(directories)
+        self.store = Store(
+            [
+                DiskLocation(d, max_volume_count=c)
+                for d, c in zip(directories, max_volume_counts)
+            ],
+            ip=ip,
+            port=port,
+            public_url=public_url,
+            ec_backend=ec_backend,
+        )
+        self.masters = masters
+        self.ip = ip
+        self.port = port
+        self.grpc_port = grpc_port or (port + 10000 if port else 0)
+        self.data_center = data_center
+        self.rack = rack
+        self.pulse_seconds = pulse_seconds
+        self.read_mode = read_mode
+        self.current_master = masters[0] if masters else ""
+        self._pending_compacts: dict[int, tuple[str, str, int]] = {}
+        self._ec_locations: dict[int, tuple[float, dict[int, list[str]]]] = {}
+        self._grpc_server: grpc.aio.Server | None = None
+        self._http_runner: web.AppRunner | None = None
+        self._tasks: list[asyncio.Task] = []
+        self._stopping = False
+
+    @property
+    def url(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    @property
+    def grpc_url(self) -> str:
+        return f"{self.ip}:{self.grpc_port}"
+
+    # ------------------------------------------------------------------ lifecycle
+
+    async def start(self, heartbeat: bool = True) -> None:
+        self._grpc_server = grpc.aio.server(options=GRPC_OPTIONS)
+        self._grpc_server.add_generic_rpc_handlers(
+            [generic_handler(volume_server_pb2, "VolumeServer", self)]
+        )
+        self.grpc_port = self._grpc_server.add_insecure_port(
+            f"{self.ip}:{self.grpc_port}"
+        )
+        await self._grpc_server.start()
+
+        app = web.Application(client_max_size=256 * 1024 * 1024)
+        app.router.add_get("/status", self.h_status)
+        app.router.add_route("*", "/{fid:.*}", self.h_needle)
+        self._http_runner = web.AppRunner(app)
+        await self._http_runner.setup()
+        site = web.TCPSite(self._http_runner, self.ip, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        self.store.port = self.port
+        if self.store.public_url == f"{self.ip}:0":
+            self.store.public_url = self.url
+
+        if heartbeat and self.masters:
+            self._tasks.append(asyncio.create_task(self._heartbeat_forever()))
+        log.info("volume server up http=%s grpc=%s", self.url, self.grpc_url)
+
+    async def stop(self) -> None:
+        self._stopping = True
+        for t_ in self._tasks:
+            t_.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        if self._grpc_server:
+            await self._grpc_server.stop(0.1)
+        if self._http_runner:
+            await self._http_runner.cleanup()
+        self.store.close()
+
+    # ------------------------------------------------------------------ heartbeat
+
+    def _full_heartbeat(self) -> master_pb2.Heartbeat:
+        hs = self.store.collect_heartbeat()
+        hb = master_pb2.Heartbeat(
+            ip=self.ip,
+            port=self.port,
+            public_url=self.store.public_url,
+            grpc_port=self.grpc_port,
+            data_center=self.data_center,
+            rack=self.rack,
+            has_no_volumes=hs.has_no_volumes,
+            has_no_ec_shards=hs.has_no_ec_shards,
+        )
+        for k, v in hs.max_volume_counts.items():
+            hb.max_volume_counts[k] = v
+        hb.volumes.extend(volume_msg_to_pb(v) for v in hs.volumes)
+        hb.ec_shards.extend(ec_msg_to_pb(e) for e in hs.ec_shards)
+        return hb
+
+    def _delta_heartbeat(self) -> master_pb2.Heartbeat | None:
+        new_v, del_v, new_ec, del_ec = self.store.drain_deltas()
+        if not (new_v or del_v or new_ec or del_ec):
+            return None
+        hb = master_pb2.Heartbeat(
+            ip=self.ip, port=self.port,
+            public_url=self.store.public_url, grpc_port=self.grpc_port,
+            data_center=self.data_center, rack=self.rack,
+        )
+        hb.new_volumes.extend(volume_msg_to_pb(v) for v in new_v)
+        hb.deleted_volumes.extend(volume_msg_to_pb(v) for v in del_v)
+        hb.new_ec_shards.extend(ec_msg_to_pb(e) for e in new_ec)
+        hb.deleted_ec_shards.extend(ec_msg_to_pb(e) for e in del_ec)
+        return hb
+
+    async def _heartbeat_forever(self) -> None:
+        i = 0
+        while not self._stopping:
+            master = self.masters[i % len(self.masters)]
+            i += 1
+            try:
+                await self._heartbeat_stream(master)
+            except asyncio.CancelledError:
+                return
+            except Exception as e:
+                log.debug("heartbeat to %s failed: %s", master, e)
+            await asyncio.sleep(min(self.pulse_seconds, 1))
+
+    async def _heartbeat_stream(self, master: str) -> None:
+        """One connected session: full heartbeat, then deltas + periodic
+        re-sync (doHeartbeat volume_grpc_client_to_master.go:92+)."""
+        from ..pb import server_address
+
+        stub = Stub(channel(server_address.grpc_address(master)), master_pb2, "Seaweed")
+
+        async def pulses():
+            yield self._full_heartbeat()
+            n = 0
+            while not self._stopping:
+                await asyncio.sleep(
+                    0.05 if not self.store.new_volumes.empty()
+                    or not self.store.new_ec_shards.empty()
+                    else self.pulse_seconds
+                )
+                hb = self._delta_heartbeat()
+                n += 1
+                if hb is None and n % 4 == 0:
+                    hb = self._full_heartbeat()  # periodic full re-sync
+                if hb is not None:
+                    yield hb
+
+        async for resp in stub.SendHeartbeat(pulses()):
+            if resp.volume_size_limit:
+                self.store.volume_size_limit = resp.volume_size_limit
+            if resp.leader:
+                self.current_master = resp.leader
+
+    # ------------------------------------------------------------------ HTTP data plane
+
+    async def h_status(self, request: web.Request) -> web.Response:
+        infos = await asyncio.to_thread(self.store.volume_infos)
+        return web.json_response(
+            {
+                "Version": "seaweedfs-tpu",
+                "Volumes": [vars(i) for i in infos],
+            }
+        )
+
+    async def h_needle(self, request: web.Request) -> web.StreamResponse:
+        if request.method in ("GET", "HEAD"):
+            return await self.h_read(request)
+        if request.method in ("POST", "PUT"):
+            return await self.h_write(request)
+        if request.method == "DELETE":
+            return await self.h_delete(request)
+        raise web.HTTPMethodNotAllowed(request.method, ["GET", "POST", "PUT", "DELETE"])
+
+    def _parse_fid(self, request: web.Request) -> tuple[int, int, int]:
+        fid = request.match_info["fid"].strip("/")
+        return t.parse_fid(fid)  # raises ValueError
+
+    async def h_read(self, request: web.Request) -> web.StreamResponse:
+        """(GetOrHeadHandler volume_server_handlers_read.go:31-235)"""
+        try:
+            vid, nid, cookie = self._parse_fid(request)
+        except ValueError as e:
+            raise web.HTTPBadRequest(text=str(e))
+        v = self.store.find_volume(vid)
+        ev = self.store.find_ec_volume(vid) if v is None else None
+        if v is None and ev is None:
+            return await self._read_remote(request, vid)
+        try:
+            if v is not None:
+                n = await asyncio.to_thread(self.store.read_needle, vid, nid, cookie)
+            else:
+                n = await asyncio.to_thread(
+                    self.store.read_ec_needle, vid, nid, cookie, self._remote_shard_reader(vid)
+                )
+        except (NotFoundError, KeyError):
+            raise web.HTTPNotFound()
+        except CookieMismatch:
+            raise web.HTTPForbidden()
+        except CrcError:
+            raise web.HTTPInternalServerError(text="data corruption: CRC mismatch")
+
+        headers = {"Etag": f'"{n.etag}"', "Accept-Ranges": "bytes"}
+        if n.last_modified:
+            headers["Last-Modified"] = time.strftime(
+                "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(n.last_modified)
+            )
+        body = n.data
+        if n.is_compressed:
+            if "gzip" in request.headers.get("Accept-Encoding", ""):
+                headers["Content-Encoding"] = "gzip"
+            else:
+                import gzip as _gz
+
+                body = _gz.decompress(body)
+        ct = n.mime.decode() if n.mime else "application/octet-stream"
+        if request.method == "HEAD":
+            return web.Response(
+                status=200, headers={**headers, "Content-Length": str(len(body))},
+                content_type=ct,
+            )
+        # range support
+        rng = request.http_range
+        if rng.start is not None or rng.stop is not None:
+            start = rng.start or 0
+            stop = rng.stop if rng.stop is not None else len(body)
+            part = body[start:stop]
+            headers["Content-Range"] = f"bytes {start}-{start + len(part) - 1}/{len(body)}"
+            return web.Response(status=206, body=part, headers=headers, content_type=ct)
+        return web.Response(body=body, headers=headers, content_type=ct)
+
+    async def _read_remote(self, request: web.Request, vid: int) -> web.StreamResponse:
+        """Volume not local: proxy to or redirect at a peer holding it
+        (volume_server_handlers_read.go:65-120)."""
+        locations = await self._lookup_volume_locations(vid)
+        locations = [u for u in locations if u != self.url]
+        if not locations:
+            raise web.HTTPNotFound(text=f"volume {vid} not found anywhere")
+        target = locations[0]
+        if self.read_mode == "redirect":
+            raise web.HTTPMovedPermanently(
+                f"http://{target}{request.path_qs}"
+            )
+        import aiohttp
+
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://{target}{request.path_qs}") as r:
+                body = await r.read()
+                return web.Response(
+                    status=r.status, body=body,
+                    content_type=r.content_type or "application/octet-stream",
+                )
+
+    async def _lookup_volume_locations(self, vid: int) -> list[str]:
+        if not self.masters:
+            return []
+        from ..pb import server_address
+
+        stub = Stub(
+            channel(server_address.grpc_address(self.current_master)),
+            master_pb2,
+            "Seaweed",
+        )
+        try:
+            resp = await stub.LookupVolume(
+                master_pb2.LookupVolumeRequest(volume_or_file_ids=[str(vid)])
+            )
+        except grpc.aio.AioRpcError:
+            return []
+        out = []
+        for e in resp.volume_id_locations:
+            out.extend(l.url for l in e.locations)
+        return out
+
+    async def h_write(self, request: web.Request) -> web.Response:
+        """(PostHandler volume_server_handlers_write.go) — parse upload,
+        append locally, fan out to replicas unless this IS a replica write."""
+        try:
+            vid, nid, cookie = self._parse_fid(request)
+        except ValueError as e:
+            raise web.HTTPBadRequest(text=str(e))
+        if not self.store.has_volume(vid):
+            raise web.HTTPNotFound(text=f"volume {vid} not local")
+
+        body = await request.read()
+        name, mime, data, compressed = self._parse_upload(
+            request.headers.get("Content-Type", ""), body
+        )
+        from ..storage.needle import FLAG_IS_COMPRESSED
+
+        n = Needle(
+            id=nid,
+            cookie=cookie,
+            data=data,
+            name=name,
+            mime=mime,
+            last_modified=int(time.time()),
+            flags=FLAG_IS_COMPRESSED if compressed else 0,
+        )
+        is_replicate = request.query.get("type") == "replicate"
+        try:
+            size = await asyncio.to_thread(self.store.write_needle, vid, n)
+        except VolumeReadOnly:
+            raise web.HTTPConflict(text=f"volume {vid} is read-only")
+        if not is_replicate:
+            err = await self._replicate(request, vid, body_override=body)
+            if err:
+                raise web.HTTPInternalServerError(text=f"replication failed: {err}")
+        return web.json_response({"name": name.decode() or "", "size": size, "eTag": n.etag})
+
+    @staticmethod
+    def _parse_upload(
+        content_type: str, body: bytes
+    ) -> tuple[bytes, bytes, bytes, bool]:
+        """multipart/form-data or raw body -> (filename, mime, data,
+        is_gzipped) (needle_parse_upload.go).  Parses from the cached raw
+        bytes so the identical body can be re-posted to replicas."""
+        if content_type.startswith("multipart/"):
+            import email
+            import email.policy
+
+            msg = email.message_from_bytes(
+                b"Content-Type: " + content_type.encode() + b"\r\n\r\n" + body,
+                policy=email.policy.HTTP,
+            )
+            for part in msg.iter_parts():
+                data = part.get_payload(decode=True) or b""
+                fname = (part.get_filename() or "").encode()
+                pmime = (part.get_content_type() or "").encode()
+                if part.get("Content-Type") is None or pmime == b"application/octet-stream":
+                    pmime = b""
+                gz = part.get("Content-Encoding") == "gzip"
+                return fname, pmime, data, gz
+            return b"", b"", b"", False
+        ct = content_type.split(";")[0].strip()
+        mime = ct.encode() if ct and ct != "application/octet-stream" else b""
+        return b"", mime, body, False
+
+    async def _replicate(
+        self, request: web.Request, vid: int, body_override
+    ) -> str | None:
+        """Fan the original request out to every replica
+        (DistributedOperation store_replicate.go:60)."""
+        v = self.store.find_volume(vid)
+        if v is None or v.super_block.replica_placement.copy_count <= 1:
+            return None
+        locations = await self._lookup_volume_locations(vid)
+        peers = [u for u in locations if u != self.url]
+        if not peers:
+            return "no replica locations known"
+        import aiohttp
+
+        body = body_override if body_override is not None else await request.read()
+        sep = "&" if request.query_string else ""
+        qs = f"?{request.query_string}{sep}type=replicate"
+        errors = []
+
+        async def one(peer):
+            try:
+                async with aiohttp.ClientSession() as s:
+                    async with s.request(
+                        request.method,
+                        f"http://{peer}{request.path}{qs}",
+                        data=body,
+                        headers={"Content-Type": request.headers.get("Content-Type", "")},
+                    ) as r:
+                        if r.status >= 300:
+                            errors.append(f"{peer}: HTTP {r.status}")
+            except Exception as e:
+                errors.append(f"{peer}: {e}")
+
+        await asyncio.gather(*(one(p) for p in peers))
+        return "; ".join(errors) if errors else None
+
+    async def h_delete(self, request: web.Request) -> web.Response:
+        try:
+            vid, nid, cookie = self._parse_fid(request)
+        except ValueError as e:
+            raise web.HTTPBadRequest(text=str(e))
+        is_replicate = request.query.get("type") == "replicate"
+        v = self.store.find_volume(vid)
+        if v is None:
+            ev = self.store.find_ec_volume(vid)
+            if ev is None:
+                raise web.HTTPNotFound()
+            await asyncio.to_thread(self.store.delete_ec_needle, vid, nid)
+            return web.json_response({"size": 0})
+        try:
+            size = await asyncio.to_thread(self.store.delete_needle, vid, nid, cookie)
+        except CookieMismatch:
+            raise web.HTTPForbidden()
+        if not is_replicate:
+            await self._replicate(request, vid, body_override=b"")
+        return web.json_response({"size": size})
+
+    # ------------------------------------------------------------------ EC remote reads
+
+    def _remote_shard_reader(self, vid: int):
+        """Sync hook: shard_id, offset, size -> bytes|None, fetching from a
+        peer found via master LookupEcVolume (store_ec.go:238-337).  Both the
+        location lookup and the shard fetch happen lazily INSIDE the hook,
+        which runs on a to_thread worker — sync gRPC on the event-loop
+        thread would deadlock against our own servers."""
+
+        def read(shard_id: int, offset: int, size: int):
+            locations = self._cached_ec_locations(vid)
+            for addr in locations.get(shard_id, []):
+                try:
+                    ch = grpc.insecure_channel(addr, options=GRPC_OPTIONS)
+                    stub = Stub(ch, volume_server_pb2, "VolumeServer")
+                    chunks = []
+                    for resp in stub.VolumeEcShardRead(
+                        volume_server_pb2.VolumeEcShardReadRequest(
+                            volume_id=vid, shard_id=shard_id, offset=offset, size=size
+                        )
+                    ):
+                        if resp.is_deleted:
+                            return None
+                        chunks.append(resp.data)
+                    ch.close()
+                    return b"".join(chunks)
+                except grpc.RpcError:
+                    continue
+            return None
+
+        return read
+
+    def _cached_ec_locations(self, vid: int) -> dict[int, list[str]]:
+        now = time.time()
+        cached = self._ec_locations.get(vid)
+        if cached and now - cached[0] < _EC_LOCATION_TTL:
+            return cached[1]
+        locs: dict[int, list[str]] = {}
+        if self.masters:
+            from ..pb import server_address
+
+            try:
+                ch = grpc.insecure_channel(
+                    server_address.grpc_address(self.current_master), options=GRPC_OPTIONS
+                )
+                stub = Stub(ch, master_pb2, "Seaweed")
+                resp = stub.LookupEcVolume(
+                    master_pb2.LookupEcVolumeRequest(volume_id=vid)
+                )
+                for e in resp.shard_id_locations:
+                    locs[e.shard_id] = [
+                        f"{l.url.rsplit(':', 1)[0]}:{l.grpc_port}" for l in e.locations
+                        if l.url != self.url
+                    ]
+                ch.close()
+            except grpc.RpcError:
+                pass
+        self._ec_locations[vid] = (now, locs)
+        return locs
+
+    # ------------------------------------------------------------------ gRPC: lifecycle
+
+    async def AllocateVolume(self, request, context):
+        await asyncio.to_thread(
+            self.store.add_volume,
+            request.volume_id,
+            request.collection,
+            request.replication or "000",
+            request.ttl or "",
+            3,
+            request.disk_type or "",
+        )
+        return volume_server_pb2.AllocateVolumeResponse()
+
+    async def VolumeMount(self, request, context):
+        await asyncio.to_thread(self.store.mount_volume, request.volume_id)
+        return volume_server_pb2.VolumeMountResponse()
+
+    async def VolumeUnmount(self, request, context):
+        await asyncio.to_thread(self.store.unmount_volume, request.volume_id)
+        return volume_server_pb2.VolumeUnmountResponse()
+
+    async def VolumeDelete(self, request, context):
+        try:
+            await asyncio.to_thread(self.store.delete_volume, request.volume_id)
+        except NotFoundError:
+            pass
+        return volume_server_pb2.VolumeDeleteResponse()
+
+    async def VolumeMarkReadonly(self, request, context):
+        self.store.mark_volume_readonly(request.volume_id, True)
+        return volume_server_pb2.VolumeMarkReadonlyResponse()
+
+    async def VolumeMarkWritable(self, request, context):
+        self.store.mark_volume_readonly(request.volume_id, False)
+        return volume_server_pb2.VolumeMarkWritableResponse()
+
+    async def VolumeConfigure(self, request, context):
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            return volume_server_pb2.VolumeConfigureResponse(error="not found")
+        v.super_block.replica_placement = t.ReplicaPlacement.parse(request.replication)
+        return volume_server_pb2.VolumeConfigureResponse()
+
+    async def VolumeStatus(self, request, context):
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        info = v.info()
+        return volume_server_pb2.VolumeStatusResponse(
+            is_read_only=v.read_only,
+            volume_size=info.size,
+            file_count=info.file_count,
+            file_deleted_count=info.delete_count,
+        )
+
+    async def DeleteCollection(self, request, context):
+        for loc in self.store.locations:
+            for vid, v in list(loc.volumes.items()):
+                if v.collection == request.collection:
+                    await asyncio.to_thread(self.store.delete_volume, vid)
+        return volume_server_pb2.DeleteCollectionResponse()
+
+    async def VolumeServerStatus(self, request, context):
+        return volume_server_pb2.VolumeServerStatusResponse(
+            data_dirs=[l.directory for l in self.store.locations],
+            volume_count=sum(len(l.volumes) for l in self.store.locations),
+            ec_shard_count=sum(
+                ev.shard_bits().count()
+                for l in self.store.locations
+                for ev in l.ec_volumes.values()
+            ),
+        )
+
+    async def VolumeServerLeave(self, request, context):
+        self._stopping = True
+        for t_ in self._tasks:
+            t_.cancel()
+        return volume_server_pb2.VolumeServerLeaveResponse()
+
+    # ------------------------------------------------------------------ gRPC: vacuum
+
+    async def VacuumVolumeCheck(self, request, context):
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        return volume_server_pb2.VacuumVolumeCheckResponse(
+            garbage_ratio=v.garbage_ratio
+        )
+
+    async def VacuumVolumeCompact(self, request, context):
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        cpd, cpx, snap = await asyncio.to_thread(vacuum_mod.compact, v)
+        self._pending_compacts[request.volume_id] = (cpd, cpx, snap)
+        yield volume_server_pb2.VacuumVolumeCompactResponse(
+            processed_bytes=os.path.getsize(cpd)
+        )
+
+    async def VacuumVolumeCommit(self, request, context):
+        v = self.store.find_volume(request.volume_id)
+        pending = self._pending_compacts.pop(request.volume_id, None)
+        if v is None or pending is None:
+            await context.abort(grpc.StatusCode.FAILED_PRECONDITION, "no pending compact")
+        await asyncio.to_thread(vacuum_mod.commit, v, *pending)
+        return volume_server_pb2.VacuumVolumeCommitResponse(is_read_only=v.read_only)
+
+    async def VacuumVolumeCleanup(self, request, context):
+        pending = self._pending_compacts.pop(request.volume_id, None)
+        if pending:
+            for p in pending[:2]:
+                if os.path.exists(p):
+                    os.remove(p)
+        return volume_server_pb2.VacuumVolumeCleanupResponse()
+
+    # ------------------------------------------------------------------ gRPC: copy
+
+    async def CopyFile(self, request, context):
+        """Stream a volume/EC file to a puller (volume_grpc_copy.go
+        CopyFile)."""
+        v = self.store.find_volume(request.volume_id)
+        if v is not None:
+            base = Volume.base_name(v.dir, v.id, v.collection)
+        else:
+            base = await asyncio.to_thread(
+                self.store._ec_base, request.volume_id, request.collection
+            )
+            if base is None:
+                if request.ignore_source_file_not_found:
+                    return
+                await context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        path = base + request.ext
+        if not os.path.exists(path):
+            if request.ignore_source_file_not_found:
+                return
+            await context.abort(grpc.StatusCode.NOT_FOUND, f"{path} not found")
+        stop = request.stop_offset or os.path.getsize(path)
+        chunk = 1024 * 1024
+        with open(path, "rb") as f:
+            sent = 0
+            while sent < stop:
+                buf = f.read(min(chunk, stop - sent))
+                if not buf:
+                    break
+                sent += len(buf)
+                yield volume_server_pb2.CopyFileResponse(file_content=buf)
+
+    async def _pull_file(self, source_grpc: str, vid: int, collection: str, ext: str,
+                         dest_path: str, ignore_missing: bool = False) -> bool:
+        stub = Stub(channel(source_grpc), volume_server_pb2, "VolumeServer")
+        tmp = dest_path + ".tmp"
+        got_any = False
+        try:
+            with open(tmp, "wb") as f:
+                async for resp in stub.CopyFile(
+                    volume_server_pb2.CopyFileRequest(
+                        volume_id=vid,
+                        collection=collection,
+                        ext=ext,
+                        ignore_source_file_not_found=ignore_missing,
+                    )
+                ):
+                    got_any = True
+                    f.write(resp.file_content)
+        except grpc.aio.AioRpcError:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            if ignore_missing:
+                return False
+            raise
+        if got_any or not ignore_missing:
+            os.replace(tmp, dest_path)
+            return True
+        os.remove(tmp)
+        return False
+
+    async def VolumeCopy(self, request, context):
+        """Pull .dat/.idx of a volume from a peer and mount it
+        (volume_grpc_copy.go VolumeCopy)."""
+        loc = self.store._pick_location()
+        if loc is None:
+            await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, "no free slots")
+        base = Volume.base_name(loc.directory, request.volume_id, request.collection)
+        n = 0
+        for ext in (".dat", ".idx"):
+            await self._pull_file(
+                request.source_data_node, request.volume_id, request.collection,
+                ext, base + ext,
+            )
+            n += os.path.getsize(base + ext)
+        await asyncio.to_thread(self.store.mount_volume, request.volume_id)
+        yield volume_server_pb2.VolumeCopyResponse(processed_bytes=n)
+
+    async def ReadNeedleBlob(self, request, context):
+        try:
+            n = await asyncio.to_thread(
+                self.store.read_needle, request.volume_id, request.needle_id
+            )
+        except (NotFoundError, KeyError):
+            await context.abort(grpc.StatusCode.NOT_FOUND, "needle not found")
+        return volume_server_pb2.ReadNeedleBlobResponse(needle_blob=n.data)
+
+    # ------------------------------------------------------------------ gRPC: erasure coding
+
+    async def VolumeEcShardsGenerate(self, request, context):
+        """volume_grpc_erasure_coding.go:38-81 — the TPU encode entry."""
+        try:
+            await asyncio.to_thread(self.store.ec_generate, request.volume_id)
+        except NotFoundError:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        return volume_server_pb2.VolumeEcShardsGenerateResponse()
+
+    async def VolumeEcShardsRebuild(self, request, context):
+        try:
+            rebuilt = await asyncio.to_thread(
+                self.store.ec_rebuild, request.volume_id, request.collection
+            )
+        except (NotFoundError, ValueError) as e:
+            await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        return volume_server_pb2.VolumeEcShardsRebuildResponse(
+            rebuilt_shard_ids=rebuilt
+        )
+
+    async def VolumeEcShardsCopy(self, request, context):
+        """Pull shard files (+ sidecars) from source_data_node
+        (volume_grpc_erasure_coding.go:126-177)."""
+        loc = self.store._pick_location()
+        if loc is None:
+            await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, "no free slots")
+        base = ec_base_name(loc.directory, request.volume_id, request.collection)
+        for sid in request.shard_ids:
+            await self._pull_file(
+                request.source_data_node, request.volume_id, request.collection,
+                to_ext(sid), base + to_ext(sid),
+            )
+        if request.copy_ecx_file:
+            await self._pull_file(
+                request.source_data_node, request.volume_id, request.collection,
+                ".ecx", base + ".ecx",
+            )
+        if request.copy_ecj_file:
+            await self._pull_file(
+                request.source_data_node, request.volume_id, request.collection,
+                ".ecj", base + ".ecj", ignore_missing=True,
+            )
+        if request.copy_vif_file:
+            await self._pull_file(
+                request.source_data_node, request.volume_id, request.collection,
+                ".vif", base + ".vif", ignore_missing=True,
+            )
+        return volume_server_pb2.VolumeEcShardsCopyResponse()
+
+    async def VolumeEcShardsDelete(self, request, context):
+        await asyncio.to_thread(
+            self.store.delete_ec_shards,
+            request.volume_id,
+            list(request.shard_ids),
+            request.collection,
+        )
+        return volume_server_pb2.VolumeEcShardsDeleteResponse()
+
+    async def VolumeEcShardsMount(self, request, context):
+        try:
+            await asyncio.to_thread(
+                self.store.mount_ec_shards,
+                request.volume_id,
+                list(request.shard_ids),
+                request.collection,
+            )
+        except NotFoundError as e:
+            await context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        return volume_server_pb2.VolumeEcShardsMountResponse()
+
+    async def VolumeEcShardsUnmount(self, request, context):
+        await asyncio.to_thread(
+            self.store.unmount_ec_shards, request.volume_id, list(request.shard_ids)
+        )
+        return volume_server_pb2.VolumeEcShardsUnmountResponse()
+
+    async def VolumeEcShardRead(self, request, context):
+        """Stream raw shard bytes (volume_grpc_erasure_coding.go:309-375)."""
+        ev = self.store.find_ec_volume(request.volume_id)
+        if ev is None or request.shard_id not in ev.shards:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND,
+                f"ec volume {request.volume_id} shard {request.shard_id} not here",
+            )
+        if request.file_key:
+            from ..storage.ec.volume import NeedleNotFound, search_sorted_index
+
+            try:
+                _, _, size = await asyncio.to_thread(
+                    search_sorted_index, ev._ecx.fileno(), ev.ecx_size, request.file_key
+                )
+                if t.size_is_deleted(size):
+                    yield volume_server_pb2.VolumeEcShardReadResponse(is_deleted=True)
+                    return
+            except NeedleNotFound:
+                pass
+        remaining = request.size
+        offset = request.offset
+        chunk = 1024 * 1024
+        while remaining > 0:
+            buf = await asyncio.to_thread(
+                self.store.read_ec_shard_interval,
+                request.volume_id,
+                request.shard_id,
+                offset,
+                min(chunk, remaining),
+            )
+            if not buf:
+                break
+            yield volume_server_pb2.VolumeEcShardReadResponse(data=buf)
+            offset += len(buf)
+            remaining -= len(buf)
+
+    async def VolumeEcBlobDelete(self, request, context):
+        try:
+            await asyncio.to_thread(
+                self.store.delete_ec_needle, request.volume_id, request.file_key
+            )
+        except NotFoundError:
+            pass
+        return volume_server_pb2.VolumeEcBlobDeleteResponse()
+
+    async def VolumeEcShardsToVolume(self, request, context):
+        """Decode EC shards back into a normal .dat/.idx volume
+        (volume_grpc_erasure_coding.go:407-446)."""
+        ev = self.store.find_ec_volume(request.volume_id)
+        if ev is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "ec volume not found")
+        base = ev.base_name
+
+        def decode():
+            dat_size = find_dat_file_size(base)
+            write_dat_file(base, dat_size)
+            write_idx_file_from_ec_index(base)
+
+        await asyncio.to_thread(decode)
+        await asyncio.to_thread(self.store.mount_volume, request.volume_id)
+        return volume_server_pb2.VolumeEcShardsToVolumeResponse()

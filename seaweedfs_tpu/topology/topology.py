@@ -122,9 +122,12 @@ class Topology:
 
     # -- heartbeat intake (master_grpc_server.go:61-170) ---------------------
 
-    def sync_node(self, node: DataNode, hs: HeartbeatState) -> tuple[list, list]:
+    def sync_node(
+        self, node: DataNode, hs: HeartbeatState
+    ) -> tuple[list, list, list, list]:
         """Full registration: reconcile the node's volume + EC view.
-        Returns (new_vids, deleted_vids) for client broadcast."""
+        Returns (new_vids, deleted_vids, new_ec_vids, deleted_ec_vids) for
+        client broadcast."""
         with self._lock:
             node.max_volume_counts = dict(hs.max_volume_counts)
             node.last_seen = time.time()
@@ -143,8 +146,10 @@ class Topology:
             for info in deleted_ec:
                 self._unregister_ec_shards(info, node)
             return (
-                [v.id for v in new_v] + [s.vid for s in new_ec],
-                [v.id for v in deleted_v] + [s.vid for s in deleted_ec],
+                [v.id for v in new_v],
+                [v.id for v in deleted_v],
+                [s.vid for s in new_ec],
+                [s.vid for s in deleted_ec],
             )
 
     def incremental_sync_node(

@@ -58,7 +58,7 @@ class TestHeartbeatIntake:
     def test_full_sync_registers_volumes(self):
         topo = Topology()
         n = topo.get_or_create_node("dc1", "r1", "10.0.0.1", 8080)
-        new, deleted = topo.sync_node(n, heartbeat([vol(1), vol(2)]))
+        new, deleted, _, _ = topo.sync_node(n, heartbeat([vol(1), vol(2)]))
         assert sorted(new) == [1, 2] and not deleted
         assert [x.url for x in topo.lookup_volume("", 1)] == ["10.0.0.1:8080"]
         assert topo.max_volume_id == 2
@@ -67,7 +67,7 @@ class TestHeartbeatIntake:
         topo = Topology()
         n = topo.get_or_create_node("dc1", "r1", "10.0.0.1", 8080)
         topo.sync_node(n, heartbeat([vol(1), vol(2)]))
-        new, deleted = topo.sync_node(n, heartbeat([vol(2)]))
+        new, deleted, _, _ = topo.sync_node(n, heartbeat([vol(2)]))
         assert deleted == [1] and not new
         assert topo.lookup_volume("", 1) == []
 
