@@ -1,0 +1,31 @@
+"""Admin shell (reference: weed/shell/, 12.5k LoC).
+
+`weed shell` REPL equivalent: commands registered in commands.COMMANDS,
+executed against a CommandEnv holding master stubs + the exclusive admin
+lock.  Usable programmatically (the tests and the CLI both call
+run_command) or interactively via repl().
+"""
+from .command_env import CommandEnv, TopoNode
+from .commands import COMMANDS, run_command
+
+__all__ = ["CommandEnv", "TopoNode", "COMMANDS", "run_command", "repl"]
+
+
+async def repl(masters: list[str]) -> None:
+    """Interactive loop (shell_liner.go:28)."""
+    import asyncio
+    import sys
+
+    env = CommandEnv(masters)
+    env.write("seaweedfs-tpu shell; 'help' lists commands, Ctrl-D exits")
+    while True:
+        sys.stdout.write("> ")
+        sys.stdout.flush()
+        line = await asyncio.to_thread(sys.stdin.readline)
+        if not line:
+            break
+        try:
+            await run_command(env, line)
+        except Exception as e:  # noqa: BLE001 — REPL survives command errors
+            env.write(f"error: {e}")
+    await env.release_lock()

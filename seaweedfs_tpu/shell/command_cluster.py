@@ -1,0 +1,34 @@
+"""cluster.* commands (reference: weed/shell/command_cluster_ps.go etc.)."""
+from ..pb import master_pb2
+from .commands import command, parse_flags
+
+
+@command("cluster.ps")
+async def cmd_cluster_ps(env, args):
+    """list volume servers and their usage"""
+    nodes, limit_mb = await env.collect_topology()
+    env.write(f"volume size limit: {limit_mb} MB")
+    for n in nodes:
+        env.write(
+            f"  {n.data_center}/{n.rack}/{n.url}"
+            f"  volumes={len(n.volumes)} ec_vols={len(n.ec_shards)}"
+            f" free_slots={n.free_slots()}"
+        )
+
+
+@command("cluster.check")
+async def cmd_cluster_check(env, args):
+    """sanity-check cluster connectivity (master + every volume server)"""
+    from ..pb import volume_server_pb2
+
+    nodes, _ = await env.collect_topology()
+    ok = 0
+    for n in nodes:
+        try:
+            await env.volume_stub(n.grpc_address).VolumeServerStatus(
+                volume_server_pb2.VolumeServerStatusRequest()
+            )
+            ok += 1
+        except Exception as e:  # noqa: BLE001
+            env.write(f"  {n.url}: UNREACHABLE ({e})")
+    env.write(f"{ok}/{len(nodes)} volume servers reachable")

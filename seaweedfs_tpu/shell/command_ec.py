@@ -1,0 +1,375 @@
+"""ec.* commands: encode / rebuild / balance / decode orchestration.
+
+Reference: weed/shell/command_ec_encode.go:57-269 (mark readonly →
+generate → spread with balancedEcDistribution → delete original),
+command_ec_rebuild.go:99-176, command_ec_balance.go, command_ec_decode.go,
+command_ec_common.go:19-58 (moveMountedShardToEcNode).
+
+The GF(256) math itself runs wherever VolumeEcShardsGenerate lands — on
+the volume server's configured backend (TPU MXU kernels by default).
+"""
+from __future__ import annotations
+
+from ..pb import master_pb2, volume_server_pb2
+from ..storage.ec import TOTAL_SHARDS
+from .command_env import CommandEnv, TopoNode
+from .commands import command, parse_flags
+
+
+def ec_nodes_by_freeness(nodes: list[TopoNode]) -> list[TopoNode]:
+    return sorted(nodes, key=lambda n: n.free_slots(), reverse=True)
+
+
+def node_shards(node: TopoNode, vid: int) -> list[int]:
+    for s in node.ec_shards:
+        if s["id"] == vid:
+            return [i for i in range(TOTAL_SHARDS) if s["ec_index_bits"] >> i & 1]
+    return []
+
+
+def balanced_ec_distribution(nodes: list[TopoNode], n_shards: int = TOTAL_SHARDS):
+    """Round-robin shards over nodes sorted by free slots
+    (balancedEcDistribution command_ec_encode.go:253-269).  Returns
+    [(node, [shard ids])]."""
+    ranked = ec_nodes_by_freeness(nodes)
+    if not ranked:
+        return []
+    alloc = {n.url: [] for n in ranked}
+    free = {n.url: max(0, n.free_slots() * TOTAL_SHARDS) for n in ranked}
+    i = 0
+    for sid in range(n_shards):
+        for _ in range(len(ranked)):
+            n = ranked[i % len(ranked)]
+            i += 1
+            if free[n.url] > 0 or all(f <= 0 for f in free.values()):
+                alloc[n.url].append(sid)
+                free[n.url] -= 1
+                break
+    return [(n, alloc[n.url]) for n in ranked if alloc[n.url]]
+
+
+async def spread_ec_shards(
+    env: CommandEnv,
+    vid: int,
+    collection: str,
+    source: TopoNode,
+    targets: list[tuple[TopoNode, list[int]]],
+) -> None:
+    """Copy+mount each target's shard set from source, then unmount the
+    moved shards at the source (parallelCopyEcShardsFromSource →
+    unmountEcShards, command_ec_encode.go:145-188)."""
+    first = True
+    for node, shard_ids in targets:
+        if node.url == source.url:
+            first = False
+            continue
+        stub = env.volume_stub(node.grpc_address)
+        await stub.VolumeEcShardsCopy(
+            volume_server_pb2.VolumeEcShardsCopyRequest(
+                volume_id=vid,
+                collection=collection,
+                shard_ids=shard_ids,
+                copy_ecx_file=True,
+                copy_ecj_file=True,
+                copy_vif_file=first,
+                source_data_node=source.grpc_address,
+            )
+        )
+        first = False
+        await stub.VolumeEcShardsMount(
+            volume_server_pb2.VolumeEcShardsMountRequest(
+                volume_id=vid, collection=collection, shard_ids=shard_ids
+            )
+        )
+        src_stub = env.volume_stub(source.grpc_address)
+        await src_stub.VolumeEcShardsUnmount(
+            volume_server_pb2.VolumeEcShardsUnmountRequest(
+                volume_id=vid, shard_ids=shard_ids
+            )
+        )
+        await src_stub.VolumeEcShardsDelete(
+            volume_server_pb2.VolumeEcShardsDeleteRequest(
+                volume_id=vid, collection=collection, shard_ids=shard_ids
+            )
+        )
+
+
+@command("ec.encode")
+async def cmd_ec_encode(env, args):
+    """-volumeId N [-collection c] : erasure-code a volume (RS 10+4 on TPU)
+    and spread the shards across the cluster"""
+    env.confirm_is_locked()
+    flags = parse_flags(args)
+    collection = flags.get("collection", "")
+    vids: list[int] = []
+    if "volumeId" in flags:
+        vids = [int(flags["volumeId"])]
+    nodes, _ = await env.collect_topology()
+    if not vids and collection:
+        vids = sorted(
+            {
+                v["id"]
+                for n in nodes
+                for v in n.volumes
+                if v["collection"] == collection
+            }
+        )
+    if not vids:
+        raise ValueError("usage: ec.encode -volumeId N | -collection c")
+    for vid in vids:
+        await _encode_one(env, nodes, vid, collection)
+        env.write(f"ec encoded volume {vid}")
+
+
+async def _encode_one(env, nodes: list[TopoNode], vid: int, collection: str):
+    holders = [n for n in nodes if any(v["id"] == vid for v in n.volumes)]
+    if not holders:
+        raise ValueError(f"volume {vid} not found")
+    # 1. freeze all replicas (markVolumeReplicasWritable false)
+    for n in holders:
+        await env.volume_stub(n.grpc_address).VolumeMarkReadonly(
+            volume_server_pb2.VolumeMarkReadonlyRequest(volume_id=vid)
+        )
+    source = holders[0]
+    src_stub = env.volume_stub(source.grpc_address)
+    collection = next(
+        (v["collection"] for v in source.volumes if v["id"] == vid), collection
+    )
+    # 2. generate shards on the source (TPU kernels server-side)
+    await src_stub.VolumeEcShardsGenerate(
+        volume_server_pb2.VolumeEcShardsGenerateRequest(
+            volume_id=vid, collection=collection
+        )
+    )
+    await src_stub.VolumeEcShardsMount(
+        volume_server_pb2.VolumeEcShardsMountRequest(
+            volume_id=vid, collection=collection,
+            shard_ids=list(range(TOTAL_SHARDS)),
+        )
+    )
+    # 3. spread with balanced distribution
+    targets = balanced_ec_distribution(nodes)
+    await spread_ec_shards(env, vid, collection, source, targets)
+    # 4. drop the original volume from every replica
+    for n in holders:
+        await env.volume_stub(n.grpc_address).VolumeDelete(
+            volume_server_pb2.VolumeDeleteRequest(volume_id=vid)
+        )
+
+
+async def collect_ec_volume_shards(env) -> dict[int, dict[int, TopoNode]]:
+    """vid -> shard_id -> node holding it, from the topology snapshot."""
+    nodes, _ = await env.collect_topology()
+    out: dict[int, dict[int, TopoNode]] = {}
+    for n in nodes:
+        for s in n.ec_shards:
+            for sid in range(TOTAL_SHARDS):
+                if s["ec_index_bits"] >> sid & 1:
+                    out.setdefault(s["id"], {})[sid] = n
+    return out
+
+
+@command("ec.rebuild")
+async def cmd_ec_rebuild(env, args):
+    """[-force] : rebuild missing EC shards onto a rebuilder node
+    (command_ec_rebuild.go:99-176)"""
+    env.confirm_is_locked()
+    flags = parse_flags(args)
+    apply = "force" in flags
+    shard_map = await collect_ec_volume_shards(env)
+    nodes, _ = await env.collect_topology()
+    for vid, shards in sorted(shard_map.items()):
+        missing = [sid for sid in range(TOTAL_SHARDS) if sid not in shards]
+        if not missing:
+            continue
+        if len(shards) < 10:
+            env.write(f"ec volume {vid}: only {len(shards)} shards left, unrecoverable")
+            continue
+        env.write(f"ec volume {vid}: rebuilding shards {missing}")
+        if not apply:
+            continue
+        rebuilder = ec_nodes_by_freeness(nodes)[0]
+        collection = next(
+            (
+                s["collection"]
+                for n in nodes
+                for s in n.ec_shards
+                if s["id"] == vid
+            ),
+            "",
+        )
+        stub = env.volume_stub(rebuilder.grpc_address)
+        # gather every available shard onto the rebuilder (prepareToRecoverMissingEcShard)
+        local = set(node_shards(rebuilder, vid))
+        to_copy: dict[str, list[int]] = {}
+        for sid, holder in shards.items():
+            if sid not in local and holder.url != rebuilder.url:
+                to_copy.setdefault(holder.grpc_address, []).append(sid)
+        for src_addr, sids in to_copy.items():
+            await stub.VolumeEcShardsCopy(
+                volume_server_pb2.VolumeEcShardsCopyRequest(
+                    volume_id=vid,
+                    collection=collection,
+                    shard_ids=sids,
+                    copy_ecx_file=True,
+                    copy_ecj_file=True,
+                    copy_vif_file=True,
+                    source_data_node=src_addr,
+                )
+            )
+        resp = await stub.VolumeEcShardsRebuild(
+            volume_server_pb2.VolumeEcShardsRebuildRequest(
+                volume_id=vid, collection=collection
+            )
+        )
+        await stub.VolumeEcShardsMount(
+            volume_server_pb2.VolumeEcShardsMountRequest(
+                volume_id=vid, collection=collection,
+                shard_ids=list(resp.rebuilt_shard_ids),
+            )
+        )
+        # drop the borrowed shards it only needed as rebuild input
+        borrowed = [sid for sids in to_copy.values() for sid in sids]
+        if borrowed:
+            await stub.VolumeEcShardsUnmount(
+                volume_server_pb2.VolumeEcShardsUnmountRequest(
+                    volume_id=vid, shard_ids=borrowed
+                )
+            )
+            await stub.VolumeEcShardsDelete(
+                volume_server_pb2.VolumeEcShardsDeleteRequest(
+                    volume_id=vid, collection=collection, shard_ids=borrowed
+                )
+            )
+        env.write(f"ec volume {vid}: rebuilt {list(resp.rebuilt_shard_ids)}")
+
+
+@command("ec.balance")
+async def cmd_ec_balance(env, args):
+    """[-force] : even EC shard counts across nodes (command_ec_balance.go)"""
+    env.confirm_is_locked()
+    flags = parse_flags(args)
+    apply = "force" in flags
+    nodes, _ = await env.collect_topology()
+    counts = {
+        n.url: sum(bin(s["ec_index_bits"]).count("1") for s in n.ec_shards)
+        for n in nodes
+    }
+    by_url = {n.url: n for n in nodes}
+    moves = []
+    while True:
+        hi = max(counts, key=counts.get)
+        lo = min(counts, key=counts.get)
+        if counts[hi] - counts[lo] <= 1:
+            break
+        src = by_url[hi]
+        moved = False
+        for s in src.ec_shards:
+            sids = [i for i in range(TOTAL_SHARDS) if s["ec_index_bits"] >> i & 1]
+            dst_held = node_shards(by_url[lo], s["id"])
+            movable = [sid for sid in sids if sid not in dst_held]
+            if movable:
+                moves.append((s["id"], s["collection"], movable[0], src, by_url[lo]))
+                s["ec_index_bits"] &= ~(1 << movable[0])
+                counts[hi] -= 1
+                counts[lo] += 1
+                moved = True
+                break
+        if not moved:
+            break
+    for vid, collection, sid, src, dst in moves:
+        env.write(f"move ec shard {vid}.{sid}: {src.url} -> {dst.url}")
+        if apply:
+            await move_ec_shard(env, vid, collection, sid, src, dst)
+    env.write(f"{len(moves)} shard moves{' applied' if apply else ' planned (use -force)'}")
+
+
+async def move_ec_shard(env, vid, collection, sid, src, dst):
+    """copy → mount → unmount+delete at source (moveMountedShardToEcNode
+    command_ec_common.go:19-58)."""
+    stub = env.volume_stub(dst.grpc_address)
+    await stub.VolumeEcShardsCopy(
+        volume_server_pb2.VolumeEcShardsCopyRequest(
+            volume_id=vid, collection=collection, shard_ids=[sid],
+            copy_ecx_file=True, copy_ecj_file=True, copy_vif_file=True,
+            source_data_node=src.grpc_address,
+        )
+    )
+    await stub.VolumeEcShardsMount(
+        volume_server_pb2.VolumeEcShardsMountRequest(
+            volume_id=vid, collection=collection, shard_ids=[sid]
+        )
+    )
+    src_stub = env.volume_stub(src.grpc_address)
+    await src_stub.VolumeEcShardsUnmount(
+        volume_server_pb2.VolumeEcShardsUnmountRequest(volume_id=vid, shard_ids=[sid])
+    )
+    await src_stub.VolumeEcShardsDelete(
+        volume_server_pb2.VolumeEcShardsDeleteRequest(
+            volume_id=vid, collection=collection, shard_ids=[sid]
+        )
+    )
+
+
+@command("ec.decode")
+async def cmd_ec_decode(env, args):
+    """-volumeId N : convert an EC volume back to a normal volume
+    (command_ec_decode.go)"""
+    env.confirm_is_locked()
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    shard_map = await collect_ec_volume_shards(env)
+    shards = shard_map.get(vid)
+    if not shards:
+        raise ValueError(f"ec volume {vid} not found")
+    # choose the node already holding the most shards as the decoder
+    holders: dict[str, list[int]] = {}
+    for sid, n in shards.items():
+        holders.setdefault(n.url, []).append(sid)
+    nodes, _ = await env.collect_topology()
+    by_url = {n.url: n for n in nodes}
+    decoder = by_url[max(holders, key=lambda u: len(holders[u]))]
+    collection = next(
+        (s["collection"] for n in nodes for s in n.ec_shards if s["id"] == vid), ""
+    )
+    stub = env.volume_stub(decoder.grpc_address)
+    local = set(holders.get(decoder.url, []))
+    to_copy: dict[str, list[int]] = {}
+    for sid, holder in shards.items():
+        if sid not in local and holder.url != decoder.url:
+            to_copy.setdefault(holder.grpc_address, []).append(sid)
+    for src_addr, sids in to_copy.items():
+        await stub.VolumeEcShardsCopy(
+            volume_server_pb2.VolumeEcShardsCopyRequest(
+                volume_id=vid, collection=collection, shard_ids=sids,
+                copy_ecx_file=True, copy_ecj_file=True, copy_vif_file=True,
+                source_data_node=src_addr,
+            )
+        )
+    await stub.VolumeEcShardsToVolume(
+        volume_server_pb2.VolumeEcShardsToVolumeRequest(
+            volume_id=vid, collection=collection
+        )
+    )
+    # remove EC shards everywhere
+    for n in {n.url: n for n in shards.values()}.values():
+        sids = node_shards(n, vid)
+        if sids:
+            s_stub = env.volume_stub(n.grpc_address)
+            await s_stub.VolumeEcShardsUnmount(
+                volume_server_pb2.VolumeEcShardsUnmountRequest(
+                    volume_id=vid, shard_ids=sids
+                )
+            )
+            await s_stub.VolumeEcShardsDelete(
+                volume_server_pb2.VolumeEcShardsDeleteRequest(
+                    volume_id=vid, collection=collection, shard_ids=sids
+                )
+            )
+    await env.volume_stub(decoder.grpc_address).VolumeEcShardsDelete(
+        volume_server_pb2.VolumeEcShardsDeleteRequest(
+            volume_id=vid, collection=collection,
+            shard_ids=list(range(TOTAL_SHARDS)),
+        )
+    )
+    env.write(f"decoded ec volume {vid} back to a normal volume on {decoder.url}")

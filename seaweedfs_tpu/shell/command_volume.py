@@ -1,0 +1,255 @@
+"""volume.* commands.
+
+Reference: weed/shell/command_volume_list.go, command_volume_balance.go
+(422), command_volume_fix_replication.go (570), command_volume_move.go,
+command_volume_vacuum.go, command_volume_mark.go.
+"""
+from __future__ import annotations
+
+from ..pb import master_pb2, volume_server_pb2
+from ..storage import types as t
+from .command_env import TopoNode
+from .commands import command, parse_flags
+
+
+@command("volume.list")
+async def cmd_volume_list(env, args):
+    """list volumes per node (like the reference's topology dump)"""
+    nodes, _ = await env.collect_topology()
+    total_vols = 0
+    for n in nodes:
+        env.write(f"{n.data_center}/{n.rack}/{n.url}")
+        for v in sorted(n.volumes, key=lambda v: v["id"]):
+            env.write(
+                f"  volume id:{v['id']} size:{v['size']}"
+                f" collection:{v['collection']!r} file_count:{v['file_count']}"
+                f" delete_count:{v['delete_count']}"
+                f" replica_placement:{v['replica_placement']:03d}"
+                f"{' readonly' if v['read_only'] else ''}"
+            )
+            total_vols += 1
+        for s in sorted(n.ec_shards, key=lambda s: s["id"]):
+            bits = s["ec_index_bits"]
+            shard_ids = [i for i in range(14) if bits >> i & 1]
+            env.write(f"  ec volume id:{s['id']} shards:{shard_ids}")
+    env.write(f"total {total_vols} volumes on {len(nodes)} nodes")
+
+
+@command("volume.vacuum")
+async def cmd_volume_vacuum(env, args):
+    """-garbageThreshold 0.3 [-volumeId N] : trigger a master vacuum pass"""
+    flags = parse_flags(args)
+    await env.master_stub.VacuumVolume(
+        master_pb2.VacuumVolumeRequest(
+            garbage_threshold=float(flags.get("garbageThreshold", 0.3)),
+            volume_id=int(flags.get("volumeId", 0)),
+        )
+    )
+    env.write("vacuum pass requested")
+
+
+@command("volume.mark")
+async def cmd_volume_mark(env, args):
+    """-node <host:port.grpc> -volumeId N -readonly|-writable"""
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    stub = env.volume_stub(flags["node"])
+    if "writable" in flags:
+        await stub.VolumeMarkWritable(
+            volume_server_pb2.VolumeMarkWritableRequest(volume_id=vid)
+        )
+        env.write(f"volume {vid} writable")
+    else:
+        await stub.VolumeMarkReadonly(
+            volume_server_pb2.VolumeMarkReadonlyRequest(volume_id=vid)
+        )
+        env.write(f"volume {vid} readonly")
+
+
+@command("volume.delete")
+async def cmd_volume_delete(env, args):
+    """-node <grpc addr> -volumeId N : delete one volume replica"""
+    env.confirm_is_locked()
+    flags = parse_flags(args)
+    await env.volume_stub(flags["node"]).VolumeDelete(
+        volume_server_pb2.VolumeDeleteRequest(volume_id=int(flags["volumeId"]))
+    )
+    env.write("deleted")
+
+
+@command("volume.mount")
+async def cmd_volume_mount(env, args):
+    """-node <grpc addr> -volumeId N"""
+    flags = parse_flags(args)
+    await env.volume_stub(flags["node"]).VolumeMount(
+        volume_server_pb2.VolumeMountRequest(volume_id=int(flags["volumeId"]))
+    )
+
+
+@command("volume.unmount")
+async def cmd_volume_unmount(env, args):
+    """-node <grpc addr> -volumeId N"""
+    flags = parse_flags(args)
+    await env.volume_stub(flags["node"]).VolumeUnmount(
+        volume_server_pb2.VolumeUnmountRequest(volume_id=int(flags["volumeId"]))
+    )
+
+
+async def move_volume(env, vid: int, collection: str, src: TopoNode, dst: TopoNode):
+    """Copy a volume to dst then delete from src (command_volume_move.go)."""
+    async for _ in env.volume_stub(dst.grpc_address).VolumeCopy(
+        volume_server_pb2.VolumeCopyRequest(
+            volume_id=vid, collection=collection, source_data_node=src.grpc_address
+        )
+    ):
+        pass
+    await env.volume_stub(src.grpc_address).VolumeDelete(
+        volume_server_pb2.VolumeDeleteRequest(volume_id=vid)
+    )
+
+
+@command("volume.move")
+async def cmd_volume_move(env, args):
+    """-volumeId N -source <grpc> -target <grpc>"""
+    env.confirm_is_locked()
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    nodes, _ = await env.collect_topology()
+    by_grpc = {n.grpc_address: n for n in nodes}
+    src = by_grpc[flags["source"]]
+    dst = by_grpc[flags["target"]]
+    collection = next(
+        (v["collection"] for v in src.volumes if v["id"] == vid), ""
+    )
+    await move_volume(env, vid, collection, src, dst)
+    env.write(f"moved volume {vid}: {src.url} -> {dst.url}")
+
+
+@command("volume.balance")
+async def cmd_volume_balance(env, args):
+    """[-force] : even out volume counts across nodes
+    (command_volume_balance.go — balanceVolumeServers by ratio)"""
+    env.confirm_is_locked()
+    flags = parse_flags(args)
+    apply = "force" in flags
+    nodes, _ = await env.collect_topology()
+    if len(nodes) < 2:
+        env.write("nothing to balance")
+        return
+    moves = plan_balance_moves(nodes)
+    for vid, collection, src, dst in moves:
+        env.write(f"move volume {vid}: {src.url} -> {dst.url}")
+        if apply:
+            await move_volume(env, vid, collection, src, dst)
+    env.write(f"{len(moves)} moves{' applied' if apply else ' planned (use -force)'}")
+
+
+def plan_balance_moves(nodes: list[TopoNode]):
+    """Greedy: move volumes from the fullest node to the emptiest until the
+    spread is <=1 (the reference balances by fullness ratio; with uniform
+    max counts that reduces to this)."""
+    moves = []
+    counts = {n.url: len(n.volumes) for n in nodes}
+    vols = {n.url: sorted(n.volumes, key=lambda v: v["size"]) for n in nodes}
+    by_url = {n.url: n for n in nodes}
+    replica_urls = {}
+    for n in nodes:
+        for v in n.volumes:
+            replica_urls.setdefault(v["id"], set()).add(n.url)
+    while True:
+        hi = max(counts, key=counts.get)
+        lo = min(counts, key=counts.get)
+        if counts[hi] - counts[lo] <= 1 or not vols[hi]:
+            return moves
+        # pick a volume whose replicas don't already sit on `lo`
+        pick = None
+        for i, v in enumerate(vols[hi]):
+            if lo not in replica_urls.get(v["id"], set()):
+                pick = vols[hi].pop(i)
+                break
+        if pick is None:
+            return moves
+        moves.append((pick["id"], pick["collection"], by_url[hi], by_url[lo]))
+        replica_urls[pick["id"]].discard(hi)
+        replica_urls[pick["id"]].add(lo)
+        counts[hi] -= 1
+        counts[lo] += 1
+
+
+@command("volume.fix.replication")
+async def cmd_volume_fix_replication(env, args):
+    """[-force] : re-replicate under-replicated volumes, delete
+    over-replicated ones (command_volume_fix_replication.go)"""
+    env.confirm_is_locked()
+    flags = parse_flags(args)
+    apply = "force" in flags
+    nodes, _ = await env.collect_topology()
+    plan = plan_replication_fixes(nodes)
+    for action, vid, collection, src, dst in plan:
+        if action == "copy":
+            env.write(f"replicate volume {vid}: {src.url} -> {dst.url}")
+            if apply:
+                async for _ in env.volume_stub(dst.grpc_address).VolumeCopy(
+                    volume_server_pb2.VolumeCopyRequest(
+                        volume_id=vid,
+                        collection=collection,
+                        source_data_node=src.grpc_address,
+                    )
+                ):
+                    pass
+        else:
+            env.write(f"delete over-replicated volume {vid} from {src.url}")
+            if apply:
+                await env.volume_stub(src.grpc_address).VolumeDelete(
+                    volume_server_pb2.VolumeDeleteRequest(volume_id=vid)
+                )
+    env.write(f"{len(plan)} fixes{' applied' if apply else ' planned (use -force)'}")
+
+
+def plan_replication_fixes(nodes: list[TopoNode]):
+    """-> [(action, vid, collection, src_node, dst_node|None)].
+    Placement for new replicas prefers different racks then different
+    nodes, mirroring fixUnderReplicatedVolumes' placement scoring."""
+    by_vid: dict[int, list[tuple[TopoNode, dict]]] = {}
+    for n in nodes:
+        for v in n.volumes:
+            by_vid.setdefault(v["id"], []).append((n, v))
+    plan = []
+    for vid, replicas in by_vid.items():
+        v = replicas[0][1]
+        rp = t.ReplicaPlacement.from_byte(v["replica_placement"])
+        want = rp.copy_count
+        have = len(replicas)
+        holder_urls = {n.url for n, _ in replicas}
+        if have < want:
+            candidates = [n for n in nodes if n.url not in holder_urls and n.free_slots() > 0]
+            holder_racks = {(n.data_center, n.rack) for n, _ in replicas}
+            candidates.sort(
+                key=lambda n: ((n.data_center, n.rack) in holder_racks, -n.free_slots())
+            )
+            src = replicas[0][0]
+            for dst in candidates[: want - have]:
+                plan.append(("copy", vid, v["collection"], src, dst))
+        elif have > want:
+            extra = sorted(replicas, key=lambda r: len(r[0].volumes), reverse=True)
+            for n, _ in extra[: have - want]:
+                plan.append(("delete", vid, v["collection"], n, None))
+    return plan
+
+
+@command("volume.grow")
+async def cmd_volume_grow(env, args):
+    """-count N [-collection c] [-replication XYZ] : pre-grow volumes"""
+    flags = parse_flags(args)
+    import aiohttp
+
+    from ..pb import server_address
+
+    master = server_address.http_address(env.masters[0])
+    qs = (
+        f"count={flags.get('count', 1)}&collection={flags.get('collection', '')}"
+        f"&replication={flags.get('replication', '')}"
+    )
+    async with aiohttp.ClientSession() as s:
+        async with s.get(f"http://{master}/vol/grow?{qs}") as r:
+            env.write(await r.text())

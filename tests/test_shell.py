@@ -1,0 +1,219 @@
+"""Admin shell against a live in-process cluster: the reference's
+shell-command tests run algorithms on canned topology (SURVEY.md §4); here
+the same commands run end-to-end over real gRPC."""
+import asyncio
+import io
+import os
+
+import pytest
+
+from seaweedfs_tpu.operation import assign, submit_data, upload_data
+from seaweedfs_tpu.server.cluster import LocalCluster
+from seaweedfs_tpu.shell import CommandEnv, run_command
+from seaweedfs_tpu.storage.ec import TOTAL_SHARDS
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def sh(env, line):
+    await run_command(env, line)
+
+
+async def make(tmp_path, n=3):
+    cluster = LocalCluster(
+        base_dir=str(tmp_path), n_volume_servers=n, pulse_seconds=1
+    )
+    await cluster.start()
+    env = CommandEnv([cluster.master.advertise_url], out=io.StringIO())
+    return cluster, env
+
+
+async def fill_volume(cluster, n_blobs=10):
+    """-> (vid, {fid: data}) all landing in one volume."""
+    master = cluster.master.advertise_url
+    a = await assign(master)
+    vid = int(a.fid.split(",")[0])
+    blobs = {}
+    for i in range(n_blobs):
+        ai = await assign(master)
+        if int(ai.fid.split(",")[0]) != vid:
+            continue
+        data = os.urandom(700 + 97 * i)
+        await upload_data(f"http://{ai.url}/{ai.fid}", data)
+        blobs[ai.fid] = data
+    return vid, blobs
+
+
+async def read_all(cluster, blobs):
+    import aiohttp
+
+    vs = cluster.volume_servers[0]
+    async with aiohttp.ClientSession() as s:
+        for fid, data in blobs.items():
+            async with s.get(f"http://{vs.url}/{fid}") as r:
+                assert r.status == 200, fid
+                assert await r.read() == data, fid
+
+
+def test_help_lock_clusterps(tmp_path):
+    async def go():
+        cluster, env = await make(tmp_path, n=2)
+        try:
+            await sh(env, "help")
+            assert "ec.encode" in env.out.getvalue()
+            with pytest.raises(RuntimeError):
+                await sh(env, "volume.balance")
+            await sh(env, "lock")
+            await sh(env, "cluster.ps")
+            assert "2" in env.out.getvalue()
+            await sh(env, "unlock")
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_lock_is_exclusive(tmp_path):
+    async def go():
+        cluster, env = await make(tmp_path, n=1)
+        try:
+            await sh(env, "lock")
+            env2 = CommandEnv([cluster.master.advertise_url], out=io.StringIO())
+            with pytest.raises(Exception):
+                await sh(env2, "lock")
+            await sh(env, "unlock")
+            await sh(env2, "lock")
+            await sh(env2, "unlock")
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_ec_encode_balance_rebuild_decode_roundtrip(tmp_path):
+    """The full EC lifecycle through shell commands."""
+
+    async def go():
+        cluster, env = await make(tmp_path, n=3)
+        try:
+            vid, blobs = await fill_volume(cluster)
+            await asyncio.sleep(1.2)  # heartbeat the volume into topology
+            await sh(env, "lock")
+
+            # encode + spread
+            await sh(env, f"ec.encode -volumeId {vid}")
+            await asyncio.sleep(1.2)
+            locs = cluster.master.topo.lookup_ec_shards(vid)
+            assert locs is not None
+            holders = {
+                n.url for shard_nodes in locs.locations for n in shard_nodes
+            }
+            assert len(holders) >= 2, "shards not spread"
+            # original volume deleted everywhere
+            assert not any(
+                vs.store.has_volume(vid) for vs in cluster.volume_servers
+            )
+            await read_all(cluster, blobs)
+
+            # destroy one server's shards on disk, then rebuild
+            holders_vs = [
+                vs for vs in cluster.volume_servers
+                if vs.store.find_ec_volume(vid) is not None
+            ]
+            # lose the server with the fewest shards (must be <=4: RS(10,4)
+            # tolerates at most 4 lost shards)
+            victim = min(
+                holders_vs, key=lambda vs: len(vs.store.find_ec_volume(vid).shards)
+            )
+            lost = sorted(victim.store.find_ec_volume(vid).shards)
+            assert lost and len(lost) <= 4
+            victim.store.destroy_ec_volume(vid)
+            await asyncio.sleep(1.2)
+            env.out.truncate(0)
+            await sh(env, "ec.rebuild -force")
+            assert f"rebuilt" in env.out.getvalue()
+            await asyncio.sleep(1.2)
+            locs = cluster.master.topo.lookup_ec_shards(vid)
+            held = [sid for sid, ns in enumerate(locs.locations) if ns]
+            assert len(held) == TOTAL_SHARDS
+            await read_all(cluster, blobs)
+
+            # balance shard counts
+            await sh(env, "ec.balance -force")
+            await asyncio.sleep(1.2)
+            await read_all(cluster, blobs)
+
+            # decode back to a normal volume
+            await sh(env, f"ec.decode -volumeId {vid}")
+            await asyncio.sleep(1.2)
+            assert any(vs.store.has_volume(vid) for vs in cluster.volume_servers)
+            assert all(
+                vs.store.find_ec_volume(vid) is None
+                for vs in cluster.volume_servers
+            )
+            await read_all(cluster, blobs)
+            await sh(env, "unlock")
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_volume_list_and_balance(tmp_path):
+    async def go():
+        cluster, env = await make(tmp_path, n=2)
+        try:
+            master = cluster.master.advertise_url
+            for _ in range(4):
+                await submit_data(master, os.urandom(500))
+            await asyncio.sleep(1.2)
+            await sh(env, "volume.list")
+            out = env.out.getvalue()
+            assert "volume id:" in out
+            await sh(env, "lock")
+            await sh(env, "volume.balance -force")
+            await sh(env, "volume.fix.replication")
+            await sh(env, "unlock")
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_fix_replication_restores_lost_replica(tmp_path):
+    async def go():
+        cluster, env = await make(tmp_path, n=2)
+        try:
+            master = cluster.master.advertise_url
+            a = await assign(master, replication="001")
+            vid = int(a.fid.split(",")[0])
+            data = os.urandom(2048)
+            await upload_data(f"http://{a.url}/{a.fid}", data)
+            await asyncio.sleep(1.2)
+            # drop one replica
+            victim = next(
+                vs for vs in cluster.volume_servers if vs.store.has_volume(vid)
+            )
+            victim.store.delete_volume(vid)
+            await asyncio.sleep(1.2)
+            await sh(env, "lock")
+            await sh(env, "volume.fix.replication -force")
+            await asyncio.sleep(1.2)
+            holders = [
+                vs for vs in cluster.volume_servers if vs.store.has_volume(vid)
+            ]
+            assert len(holders) == 2
+            # restored replica serves the data
+            import aiohttp
+
+            async with aiohttp.ClientSession() as s:
+                for vs in holders:
+                    async with s.get(f"http://{vs.url}/{a.fid}") as r:
+                        assert r.status == 200 and await r.read() == data
+            await sh(env, "unlock")
+        finally:
+            await cluster.stop()
+
+    run(go())
