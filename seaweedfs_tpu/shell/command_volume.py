@@ -206,10 +206,46 @@ async def cmd_volume_fix_replication(env, args):
     env.write(f"{len(plan)} fixes{' applied' if apply else ' planned (use -force)'}")
 
 
+def placement_feasible(
+    locations: list[tuple[str, str, str]], rp: t.ReplicaPlacement
+) -> bool:
+    """Can `locations` [(dc, rack, url), ...] be completed to (or exactly
+    form) a valid XYZ placement?  Mirrors the reference's
+    satisfyReplicaPlacement (command_volume_fix_replication.go): one main
+    rack holds 1+same_rack replicas on distinct servers, diff_rack other
+    racks in the main DC hold one each, diff_dc other DCs hold one each."""
+    if len({loc[2] for loc in locations}) != len(locations):
+        return False  # two replicas on one server is never valid
+    if len(locations) > rp.copy_count:
+        return False
+    mains = {(dc, rack) for dc, rack, _ in locations} or {("", "")}
+    for main_dc, main_rack in mains:
+        other_dcs: dict[str, int] = {}
+        other_racks: dict[str, int] = {}
+        main_count = 0
+        for dc, rack, _ in locations:
+            if dc != main_dc:
+                other_dcs[dc] = other_dcs.get(dc, 0) + 1
+            elif rack != main_rack:
+                other_racks[rack] = other_racks.get(rack, 0) + 1
+            else:
+                main_count += 1
+        if (
+            main_count <= 1 + rp.same_rack
+            and len(other_dcs) <= rp.diff_dc
+            and all(c == 1 for c in other_dcs.values())
+            and len(other_racks) <= rp.diff_rack
+            and all(c == 1 for c in other_racks.values())
+        ):
+            return True
+    return False
+
+
 def plan_replication_fixes(nodes: list[TopoNode]):
     """-> [(action, vid, collection, src_node, dst_node|None)].
-    Placement for new replicas prefers different racks then different
-    nodes, mirroring fixUnderReplicatedVolumes' placement scoring."""
+    New-replica targets must keep the XYZ ReplicaPlacement satisfiable
+    (placement_feasible above); among valid targets the freest wins,
+    mirroring fixUnderReplicatedVolumes' placement scoring."""
     by_vid: dict[int, list[tuple[TopoNode, dict]]] = {}
     for n in nodes:
         for v in n.volumes:
@@ -222,18 +258,54 @@ def plan_replication_fixes(nodes: list[TopoNode]):
         have = len(replicas)
         holder_urls = {n.url for n, _ in replicas}
         if have < want:
-            candidates = [n for n in nodes if n.url not in holder_urls and n.free_slots() > 0]
-            holder_racks = {(n.data_center, n.rack) for n, _ in replicas}
-            candidates.sort(
-                key=lambda n: ((n.data_center, n.rack) in holder_racks, -n.free_slots())
-            )
+            holders = [(n.data_center, n.rack, n.url) for n, _ in replicas]
             src = replicas[0][0]
-            for dst in candidates[: want - have]:
+            for _ in range(want - have):
+                valid = [
+                    n
+                    for n in nodes
+                    if n.url not in holder_urls
+                    and n.free_slots() > 0
+                    and placement_feasible(
+                        holders + [(n.data_center, n.rack, n.url)], rp
+                    )
+                ]
+                if not valid:
+                    break  # no target can satisfy the placement; skip, don't violate
+                dst = max(valid, key=lambda n: n.free_slots())
                 plan.append(("copy", vid, v["collection"], src, dst))
+                holders.append((dst.data_center, dst.rack, dst.url))
+                holder_urls.add(dst.url)
         elif have > want:
-            extra = sorted(replicas, key=lambda r: len(r[0].volumes), reverse=True)
-            for n, _ in extra[: have - want]:
-                plan.append(("delete", vid, v["collection"], n, None))
+            # Pick the SET of deletions whose remainder keeps the placement
+            # satisfiable (reference fixOverReplicatedVolumes checks
+            # satisfyReplicaPlacement on what stays); among valid sets,
+            # prefer deleting from the fullest nodes.  Replica counts are
+            # tiny, so exhaustive combinations are fine.
+            import itertools
+
+            best = None
+            for combo in itertools.combinations(range(have), have - want):
+                rest = [
+                    (n.data_center, n.rack, n.url)
+                    for j, (n, _) in enumerate(replicas)
+                    if j not in combo
+                ]
+                fullness = sum(len(replicas[j][0].volumes) for j in combo)
+                if placement_feasible(rest, rp) and (
+                    best is None or fullness > best[0]
+                ):
+                    best = (fullness, combo)
+            if best is None:
+                # placement unsatisfiable either way; trim fullest-first
+                order = sorted(
+                    range(have),
+                    key=lambda j: len(replicas[j][0].volumes),
+                    reverse=True,
+                )
+                best = (0, tuple(order[: have - want]))
+            for j in best[1]:
+                plan.append(("delete", vid, v["collection"], replicas[j][0], None))
     return plan
 
 

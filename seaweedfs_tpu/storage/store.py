@@ -212,6 +212,8 @@ class Store:
         if v is None:
             raise NotFoundError(f"volume {vid} not found")
         v.read_only = read_only
+        if not read_only:
+            v.full = False  # admin override re-opens a size-locked volume
 
     # -- needle ops ----------------------------------------------------------
 
@@ -219,10 +221,22 @@ class Store:
         v = self.find_volume(vid)
         if v is None:
             raise NotFoundError(f"volume {vid} not found")
-        if v.content_size + len(n.data) > self.volume_size_limit:
-            v.read_only = True  # stop accepting; master will grow elsewhere
+        # Soft limit, as the reference: the limit-crossing write itself still
+        # lands (so replicas with slightly different sizes can't diverge),
+        # THEN the volume stops accepting appends (deletes stay allowed, so
+        # vacuum can later shrink it back) and the state change is pushed as
+        # an immediate heartbeat delta so the master stops picking it.
         v.append_needle(n)
+        if not v.full and v.content_size > self.volume_size_limit:
+            v.full = True
+            self._push_volume_delta(v)
         return n.size
+
+    def _push_volume_delta(self, v: Volume) -> None:
+        loc = self.location_of_volume(v.id)
+        self.new_volumes.put(
+            self._volume_message(v, loc.disk_type if loc else "")
+        )
 
     def read_needle(self, vid: int, needle_id: int, cookie: int | None = None) -> Needle:
         v = self.find_volume(vid)
@@ -245,7 +259,13 @@ class Store:
         v = self.find_volume(vid)
         if v is None:
             raise NotFoundError(f"volume {vid} not found")
-        return vacuum_volume(v)
+        ratio = vacuum_volume(v)
+        # a vacuumed volume that shrank back under the limit re-opens for
+        # writes; tell the master right away
+        if v.full and v.content_size <= self.volume_size_limit:
+            v.full = False
+            self._push_volume_delta(v)
+        return ratio
 
     # -- EC shard lifecycle (store_ec.go) ------------------------------------
 
@@ -403,7 +423,7 @@ class Store:
             file_count=info.file_count,
             delete_count=info.delete_count,
             deleted_byte_count=info.deleted_bytes,
-            read_only=v.read_only,
+            read_only=v.read_only or v.full,
             replica_placement=v.super_block.replica_placement.to_byte(),
             version=v.version,
             ttl=int.from_bytes(v.super_block.ttl.to_bytes(), "big"),

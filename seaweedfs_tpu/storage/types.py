@@ -135,14 +135,21 @@ def format_fid(volume_id: int, needle_id: int, cookie: int) -> str:
 
 
 def parse_fid(fid: str) -> tuple[int, int, int]:
-    """'vid,keycookie[_alt]' -> (volume_id, needle_id, cookie)."""
+    """'vid,keycookie[_N]' -> (volume_id, needle_id, cookie).
+
+    The '_N' suffix of a count>1 assignment is a decimal delta ADDED to the
+    needle id (reference weed/storage/needle/needle.go ParsePath: n.Id +=
+    delta), so each file of the batch lands on its own needle."""
     try:
         vid_s, rest = fid.split(",", 1)
-        rest = rest.split("_")[0]
+        delta = 0
+        if "_" in rest:
+            rest, delta_s = rest.split("_", 1)
+            delta = int(delta_s)
         volume_id = int(vid_s)
         if len(rest) <= 8:
             raise ValueError
-        needle_id = int(rest[:-8], 16)
+        needle_id = int(rest[:-8], 16) + delta
         cookie = int(rest[-8:], 16)
         return volume_id, needle_id, cookie
     except ValueError as e:

@@ -90,6 +90,10 @@ class Volume:
         self.id = vid
         self.collection = collection
         self.read_only = False
+        # size-induced write lock (reference noWriteCanDelete): the volume
+        # stops accepting appends but still takes deletes, so garbage can
+        # accumulate and vacuum can shrink it back under the limit
+        self.full = False
         self._lock = threading.RLock()
         base = self.base_name(dirname, vid, collection)
         self.dat_path = base + ".dat"
@@ -182,7 +186,7 @@ class Volume:
         """Append; returns (actual_offset, size). The volume's syncWrite
         (volume_write.go:93): record first, then index entry."""
         with self._lock:
-            if self.read_only:
+            if self.read_only or self.full:
                 raise VolumeReadOnly(f"volume {self.id} is read-only")
             record = n.to_bytes(self.version)
             self._dat.seek(0, os.SEEK_END)
@@ -292,7 +296,7 @@ class Volume:
             file_count=len(self.nm),
             delete_count=s.deleted_count,
             deleted_bytes=s.deleted_bytes,
-            read_only=self.read_only,
+            read_only=self.read_only or self.full,
             replica_placement=str(self.super_block.replica_placement),
             ttl=str(self.super_block.ttl),
             version=self.version,

@@ -115,6 +115,13 @@ class DataNode:
             prev = self.ec_shards.get(vid)
             if prev is None or int(prev.shard_bits) != int(info.shard_bits):
                 new.append(info)
+                # shard ids that vanished from the node's bits must be
+                # unregistered too, or a reconnect full-sync leaves the
+                # master serving stale EC shard locations
+                if prev is not None:
+                    gone = prev.shard_bits.minus(info.shard_bits)
+                    if gone.count():
+                        deleted.append(replace(prev, shard_bits=gone))
         for vid, info in self.ec_shards.items():
             if vid not in incoming:
                 deleted.append(info)

@@ -195,8 +195,7 @@ class Topology:
 
     def _register_volume(self, v: VolumeMessage, node: DataNode) -> None:
         vl = self._layout_for(v)
-        vl.register(v, node)
-        vl.set_oversized(v.id, v.size)
+        vl.register(v, node)  # also derives oversized/crowded from v.size
 
     def _unregister_volume(self, v: VolumeMessage, node: DataNode) -> None:
         vl = self._layout_for(v)

@@ -47,7 +47,15 @@ class VolumeLayout:
         self.volume_size_limit = volume_size_limit
         self.vid2location: dict[int, VolumeLocationList] = {}
         self.writables: list[int] = []
-        self.readonly: set[int] = set()
+        # read-only is tracked per replica (last-reporter-wins on a flat set
+        # would let one writable replica mask a still-read-only one), plus a
+        # layout-wide admin/vacuum override
+        self.readonly_nodes: dict[int, set[str]] = {}
+        self.readonly_admin: set[int] = set()
+        # per-replica sizes; oversized/crowded derive from the LARGEST
+        # replica, so a freshly-vacuumed small replica can't reopen a vid
+        # whose other replica is still at the limit
+        self.sizes: dict[int, dict[str, int]] = {}
         self.oversized: set[int] = set()
         self.crowded: set[int] = set()
         self._cursor = random.randrange(1 << 30)
@@ -60,11 +68,19 @@ class VolumeLayout:
             loc = self.vid2location.setdefault(v.id, VolumeLocationList([]))
             if all(n.url != node.url for n in loc.nodes):
                 loc.nodes.append(node)
-            if v.size >= self.volume_size_limit:
-                self.oversized.add(v.id)
+            # Heartbeats are the authority in BOTH directions: a replica that
+            # was vacuumed back under the limit or marked writable again must
+            # return to the pool (reference ensureCorrectWritables) — but only
+            # for ITS OWN read-only bit.
+            urls = self.readonly_nodes.setdefault(v.id, set())
             if v.read_only:
-                self.readonly.add(v.id)
-            self._recheck_writable(v.id)
+                urls.add(node.url)
+            else:
+                urls.discard(node.url)
+            if not urls:
+                del self.readonly_nodes[v.id]
+            self.sizes.setdefault(v.id, {})[node.url] = v.size
+            self._derive_size_state(v.id)  # rechecks writability
 
     def unregister(self, vid: int, node: DataNode) -> None:
         with self._lock:
@@ -72,22 +88,36 @@ class VolumeLayout:
             if loc is None:
                 return
             loc.nodes = [n for n in loc.nodes if n.url != node.url]
+            urls = self.readonly_nodes.get(vid)
+            if urls is not None:
+                urls.discard(node.url)
+                if not urls:
+                    del self.readonly_nodes[vid]
+            sizes = self.sizes.get(vid)
+            if sizes is not None:
+                sizes.pop(node.url, None)
+                if not sizes:
+                    del self.sizes[vid]
             if not loc.nodes:
                 del self.vid2location[vid]
                 self._remove_writable(vid)
-                self.readonly.discard(vid)
+                self.readonly_admin.discard(vid)
                 self.oversized.discard(vid)
+                self.crowded.discard(vid)
             else:
-                self._recheck_writable(vid)
+                self._derive_size_state(vid)
 
     def _enough_copies(self, vid: int) -> bool:
         loc = self.vid2location.get(vid)
         return loc is not None and len(loc) >= self.rp.copy_count
 
+    def is_readonly(self, vid: int) -> bool:
+        return vid in self.readonly_admin or bool(self.readonly_nodes.get(vid))
+
     def _recheck_writable(self, vid: int) -> None:
         ok = (
             self._enough_copies(vid)
-            and vid not in self.readonly
+            and not self.is_readonly(vid)
             and vid not in self.oversized
         )
         if ok:
@@ -101,20 +131,27 @@ class VolumeLayout:
             self.writables.remove(vid)
 
     def set_readonly(self, vid: int, read_only: bool) -> None:
+        """Layout-wide admin/vacuum override, independent of what replicas
+        report in heartbeats."""
         with self._lock:
             if read_only:
-                self.readonly.add(vid)
+                self.readonly_admin.add(vid)
             else:
-                self.readonly.discard(vid)
+                self.readonly_admin.discard(vid)
             self._recheck_writable(vid)
 
-    def set_oversized(self, vid: int, size: int) -> None:
-        with self._lock:
-            if size >= self.volume_size_limit:
-                self.oversized.add(vid)
-                if size >= self.volume_size_limit * 0.9:
-                    self.crowded.add(vid)
-                self._recheck_writable(vid)
+    def _derive_size_state(self, vid: int) -> None:
+        sizes = self.sizes.get(vid)
+        mx = max(sizes.values()) if sizes else 0
+        if mx >= self.volume_size_limit:
+            self.oversized.add(vid)
+        else:
+            self.oversized.discard(vid)
+        if mx >= self.volume_size_limit * 0.9:
+            self.crowded.add(vid)
+        else:
+            self.crowded.discard(vid)
+        self._recheck_writable(vid)
 
     # -- write selection (PickForWrite volume_layout.go:281-320) -------------
 
@@ -156,7 +193,9 @@ class VolumeLayout:
         with self._lock:
             return {
                 "writables": sorted(self.writables),
-                "readonly": sorted(self.readonly),
+                "readonly": sorted(
+                    self.readonly_admin | set(self.readonly_nodes)
+                ),
                 "oversized": sorted(self.oversized),
                 "total": len(self.vid2location),
             }

@@ -1,0 +1,265 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md):
+
+1. count>1 fid '_N' suffix is a needle-id delta, not noise
+2. VolumeLayout returns vids to the writable pool when state reverts
+3. Store soft volume-size limit: the crossing write lands, then readonly
+4. plan_replication_fixes honors XYZ ReplicaPlacement
+5. set_ec_shards unregisters shard ids that vanished on full re-sync
+"""
+import pytest
+
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.store import (
+    EcShardMessage,
+    HeartbeatState,
+    Store,
+    VolumeMessage,
+)
+from seaweedfs_tpu.topology import MemorySequencer, Topology
+from seaweedfs_tpu.topology.volume_layout import VolumeLayout
+
+
+def vol(vid, size=1000, rp="000", read_only=False):
+    return VolumeMessage(
+        id=vid,
+        size=size,
+        collection="",
+        file_count=1,
+        delete_count=0,
+        deleted_byte_count=0,
+        read_only=read_only,
+        replica_placement=int(rp),
+        version=3,
+        ttl=0,
+        disk_type="hdd",
+    )
+
+
+# -- 1. fid '_N' delta --------------------------------------------------------
+
+
+def test_parse_fid_count_suffix_is_needle_delta():
+    base = t.format_fid(3, 0x100, 0xDEADBEEF)
+    vid0, nid0, cookie0 = t.parse_fid(base)
+    assert (vid0, nid0, cookie0) == (3, 0x100, 0xDEADBEEF)
+    for i in (1, 2, 9, 15):
+        vid, nid, cookie = t.parse_fid(f"{base}_{i}")
+        assert vid == 3
+        assert nid == 0x100 + i, "suffix must ADD to the needle id (ParsePath)"
+        assert cookie == 0xDEADBEEF
+
+
+def test_parse_fid_bad_suffix_rejected():
+    with pytest.raises(ValueError):
+        t.parse_fid("3,0100deadbeef_x")
+
+
+# -- 2. layout writable recovery ---------------------------------------------
+
+
+class _FakeNode:
+    def __init__(self, url):
+        self.url = url
+        self.rack = None
+
+
+def test_layout_vid_returns_to_writable_pool():
+    vl = VolumeLayout(
+        t.ReplicaPlacement.parse("000"), t.TTL(), volume_size_limit=1000
+    )
+    node = _FakeNode("n1:8080")
+    vl.register(vol(1, size=1100), node)  # oversized
+    assert 1 not in vl.writables
+    vl.register(vol(1, size=100), node)  # vacuumed back under the limit
+    assert 1 in vl.writables, "post-vacuum heartbeat must restore writability"
+
+    vl.register(vol(2, size=10, read_only=True), node)
+    assert 2 not in vl.writables
+    vl.register(vol(2, size=10, read_only=False), node)  # marked writable
+    assert 2 in vl.writables
+
+    vl.register(vol(3, size=2000), node)
+    assert 3 not in vl.writables
+    vl.register(vol(3, size=10), node)
+    assert 3 in vl.writables
+
+
+def test_layout_oversized_tracked_per_replica():
+    """The largest replica rules: a freshly-vacuumed small replica must not
+    reopen a vid whose other replica is still over the limit."""
+    vl = VolumeLayout(
+        t.ReplicaPlacement.parse("001"), t.TTL(), volume_size_limit=1000
+    )
+    a, b = _FakeNode("a:8080"), _FakeNode("b:8080")
+    vl.register(vol(1, size=1100), a)
+    vl.register(vol(1, size=900), b)  # b vacuumed; a still over
+    assert 1 not in vl.writables
+    vl.register(vol(1, size=900), a)
+    assert 1 in vl.writables
+
+
+def test_layout_readonly_tracked_per_replica():
+    """One replica reporting writable must not mask another replica that is
+    still read-only (flat-set last-reporter-wins bug)."""
+    vl = VolumeLayout(
+        t.ReplicaPlacement.parse("001"), t.TTL(), volume_size_limit=1000
+    )
+    a, b = _FakeNode("a:8080"), _FakeNode("b:8080")
+    vl.register(vol(1, size=10, read_only=True), a)
+    vl.register(vol(1, size=10, read_only=False), b)  # b's heartbeat after a's
+    assert 1 not in vl.writables, "a's replica is still read-only"
+    vl.register(vol(1, size=10, read_only=False), a)  # a recovers
+    assert 1 in vl.writables
+
+    # admin override is independent of replica-reported state
+    vl.set_readonly(1, True)
+    vl.register(vol(1, size=10, read_only=False), a)
+    vl.register(vol(1, size=10, read_only=False), b)
+    assert 1 not in vl.writables
+    vl.set_readonly(1, False)
+    assert 1 in vl.writables
+
+
+# -- 3. store soft size limit -------------------------------------------------
+
+
+def test_limit_crossing_write_lands_then_readonly(tmp_path):
+    from seaweedfs_tpu.storage.disk_location import DiskLocation
+
+    store = Store([DiskLocation(str(tmp_path))])
+    store.volume_size_limit = 4096
+    store.add_volume(1)
+    n1 = Needle(id=1, cookie=7, data=b"x" * 3000)
+    store.write_needle(1, n1)
+    v = store.find_volume(1)
+    assert not v.read_only
+    # drain the add_volume delta
+    while not store.new_volumes.empty():
+        store.new_volumes.get()
+
+    n2 = Needle(id=2, cookie=7, data=b"y" * 3000)  # crosses the limit
+    store.write_needle(1, n2)  # must NOT raise
+    assert v.full, "volume stops accepting after the crossing write"
+    assert store.read_needle(1, 2, 7).data == b"y" * 3000
+    # the state flip is pushed as an immediate heartbeat delta
+    assert not store.new_volumes.empty()
+    msg = store.new_volumes.get()
+    assert msg.id == 1 and msg.read_only
+
+    with pytest.raises(Exception):
+        store.write_needle(1, Needle(id=3, cookie=7, data=b"z"))
+
+    # deletes stay allowed on a size-locked volume (noWriteCanDelete), so
+    # vacuum can shrink it back under the limit and reopen it
+    assert store.delete_needle(1, 1, 7) > 0
+    store.vacuum_volume(1)
+    assert not v.full, "vacuumed-under-limit volume reopens for writes"
+    store.write_needle(1, Needle(id=4, cookie=7, data=b"w" * 100))
+    assert store.read_needle(1, 4, 7).data == b"w" * 100
+
+
+# -- 4. replication fix placement ---------------------------------------------
+
+
+def test_fix_replication_respects_replica_placement():
+    from seaweedfs_tpu.shell.command_env import TopoNode
+    from seaweedfs_tpu.shell.command_volume import (
+        placement_feasible,
+        plan_replication_fixes,
+    )
+
+    def node(url, dc, rack, volumes=(), slots=10):
+        return TopoNode(
+            url=url,
+            grpc_port=18080,
+            data_center=dc,
+            rack=rack,
+            volumes=list(volumes),
+            max_volume_counts={"hdd": slots},
+        )
+
+    v = {
+        "id": 5,
+        "collection": "",
+        "size": 10,
+        "file_count": 1,
+        "delete_count": 0,
+        "read_only": False,
+        "replica_placement": 100,  # one replica in a DIFFERENT data center
+    }
+    nodes = [
+        node("a:8080", "dc1", "r1", volumes=[v]),
+        node("b:8080", "dc1", "r2", slots=100),  # same DC: invalid target
+        node("c:8080", "dc2", "r1", slots=1),  # different DC: the only valid
+    ]
+    plan = plan_replication_fixes(nodes)
+    assert len(plan) == 1
+    action, vid, _, src, dst = plan[0]
+    assert (action, vid) == ("copy", 5)
+    assert dst.url == "c:8080", "rp=100 replica must land in a different DC"
+
+    # no valid target -> skip rather than violate placement
+    plan = plan_replication_fixes(nodes[:2])
+    assert plan == []
+
+    # over-replication: must NOT delete the one replica keeping rp valid,
+    # even when it sits on the fullest node
+    filler = [dict(v, id=100 + i) for i in range(5)]
+    nodes2 = [
+        node("a:8080", "dc1", "r1", volumes=[v]),
+        node("b:8080", "dc1", "r2", volumes=[v]),
+        node("c:8080", "dc2", "r1", volumes=[v] + filler),
+    ]
+    plan = plan_replication_fixes(nodes2)
+    deletes = [(p[1], p[3].url) for p in plan if p[0] == "delete"]
+    assert len(deletes) == 1 and deletes[0][0] == 5
+    assert deletes[0][1] != "c:8080", "must keep the only different-DC replica"
+
+    # have = want+2: the combination search must keep one replica per DC
+    nodes3 = [
+        node("a:8080", "dc1", "r1", volumes=[v] + filler),  # fullest holder
+        node("b:8080", "dc2", "r1", volumes=[v]),
+        node("c:8080", "dc2", "r2", volumes=[v]),
+        node("d:8080", "dc2", "r3", volumes=[v]),
+    ]
+    plan = plan_replication_fixes(nodes3)
+    deletes = {p[3].url for p in plan if p[0] == "delete"}
+    assert len(deletes) == 2
+    assert "a:8080" not in deletes, "must keep the only dc1 replica"
+
+    # sanity on the feasibility predicate itself
+    rp = t.ReplicaPlacement.parse("010")
+    assert placement_feasible([("dc1", "r1", "a"), ("dc1", "r2", "b")], rp)
+    assert not placement_feasible([("dc1", "r1", "a"), ("dc1", "r1", "b")], rp)
+    rp = t.ReplicaPlacement.parse("001")
+    assert placement_feasible([("dc1", "r1", "a"), ("dc1", "r1", "b")], rp)
+    assert not placement_feasible([("dc1", "r1", "a"), ("dc1", "r1", "a")], rp)
+
+
+# -- 5. EC shard full-resync removal ------------------------------------------
+
+
+def ec_msg(vid, bits):
+    return EcShardMessage(id=vid, collection="", ec_index_bits=bits, disk_type="hdd")
+
+
+def test_full_resync_removes_vanished_ec_shards():
+    topo = Topology(sequencer=MemorySequencer())
+    node = topo.get_or_create_node("dc1", "r1", "10.0.0.1", 8080)
+
+    hs = HeartbeatState(
+        volumes=[], ec_shards=[ec_msg(7, 0b111)], max_volume_counts={"hdd": 10}
+    )
+    topo.sync_node(node, hs)
+    locs = topo.lookup_ec_shards(7)
+    assert all(locs.locations[s] for s in (0, 1, 2))
+
+    # reconnect full-sync: shard 2 no longer on this node
+    hs2 = HeartbeatState(
+        volumes=[], ec_shards=[ec_msg(7, 0b011)], max_volume_counts={"hdd": 10}
+    )
+    topo.sync_node(node, hs2)
+    locs = topo.lookup_ec_shards(7)
+    assert locs.locations[0] and locs.locations[1]
+    assert not locs.locations[2], "vanished shard id must be unregistered"
