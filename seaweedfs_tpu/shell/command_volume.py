@@ -6,6 +6,8 @@ command_volume_vacuum.go, command_volume_mark.go.
 """
 from __future__ import annotations
 
+import itertools
+
 from ..pb import master_pb2, volume_server_pb2
 from ..storage import types as t
 from .command_env import TopoNode
@@ -282,8 +284,6 @@ def plan_replication_fixes(nodes: list[TopoNode]):
             # satisfyReplicaPlacement on what stays); among valid sets,
             # prefer deleting from the fullest nodes.  Replica counts are
             # tiny, so exhaustive combinations are fine.
-            import itertools
-
             best = None
             for combo in itertools.combinations(range(have), have - want):
                 rest = [

@@ -42,6 +42,12 @@ LAST_MODIFIED_BYTES = 5
 _HDR = struct.Struct(">IQi")  # cookie, id, size
 
 
+def mask_crc(c: int) -> int:
+    """The deprecated CRC.Value() transform (rotl 17 + const) that legacy
+    volumes stored on disk; reference weed/storage/needle/crc.go:25-27."""
+    return (((c >> 15) | (c << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
 def padding_length(size: int, version: int) -> int:
     base = t.NEEDLE_HEADER_SIZE + size + t.NEEDLE_CHECKSUM_SIZE
     if version == VERSION3:
@@ -170,11 +176,17 @@ class Needle:
         off += 4
         if version == VERSION3 and len(buf) >= off + 8:
             (n.append_at_ns,) = struct.unpack_from(">Q", buf, off)
-        if verify and crc32c(n.data) != n.checksum:
-            raise CrcError(
-                f"needle {n.id:x} CRC mismatch: stored {n.checksum:08x} "
-                f"computed {crc32c(n.data):08x}"
-            )
+        if verify:
+            computed = crc32c(n.data)
+            # Older volumes store the *masked* CRC (the deprecated
+            # CRC.Value(), needle/crc.go:25-27); the read path accepts raw
+            # or masked exactly like needle_read.go:74-78.
+            if n.checksum not in (computed, mask_crc(computed)):
+                raise CrcError(
+                    f"needle {n.id:x} CRC mismatch: stored {n.checksum:08x} "
+                    f"computed {computed:08x} (masked {mask_crc(computed):08x})"
+                )
+            n.checksum = computed
         return n
 
     def _parse_body_v2(self, body: bytes) -> None:

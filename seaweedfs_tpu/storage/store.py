@@ -214,6 +214,9 @@ class Store:
         v.read_only = read_only
         if not read_only:
             v.full = False  # admin override re-opens a size-locked volume
+        # push the flip immediately (both directions) so the master's
+        # writable pool tracks it without waiting for a full re-sync
+        self._push_volume_delta(v)
 
     # -- needle ops ----------------------------------------------------------
 

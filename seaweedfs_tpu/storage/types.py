@@ -145,6 +145,9 @@ def parse_fid(fid: str) -> tuple[int, int, int]:
         delta = 0
         if "_" in rest:
             rest, delta_s = rest.split("_", 1)
+            if not (delta_s.isascii() and delta_s.isdigit()):
+                # strconv.ParseUint semantics: ASCII digits only
+                raise ValueError
             delta = int(delta_s)
         volume_id = int(vid_s)
         if len(rest) <= 8:

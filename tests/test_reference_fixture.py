@@ -1,0 +1,128 @@
+"""Byte-compatibility tests against the reference's committed binary volume
+fixture (/root/reference/weed/storage/erasure_coding/1.dat + 1.idx — the same
+files ec_test.go:21-207 runs TestEncodingDecoding over).
+
+The fixture is a real-world v3 volume whose needles store the legacy *masked*
+CRC (needle/crc.go:25-27), so it exercises exactly the read-compat path that
+synthetic self-generated volumes cannot: every needle must parse, EC-encode,
+and degraded-read back through our interval math and GF(256) reconstruction.
+"""
+import os
+import random
+import shutil
+
+import pytest
+
+from seaweedfs_tpu.storage import ec, idx
+from seaweedfs_tpu.storage.needle import mask_crc
+from seaweedfs_tpu.storage.volume import Volume
+
+FIXTURE_DIR = "/root/reference/weed/storage/erasure_coding"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(os.path.join(FIXTURE_DIR, "1.dat")),
+    reason="reference fixture not available",
+)
+
+
+@pytest.fixture
+def fixture_volume(tmp_path):
+    for ext in (".dat", ".idx"):
+        shutil.copy(os.path.join(FIXTURE_DIR, "1" + ext), tmp_path / ("1" + ext))
+    os.chmod(tmp_path / "1.dat", 0o644)
+    os.chmod(tmp_path / "1.idx", 0o644)
+    v = Volume(str(tmp_path), 1)
+    yield v
+    v.close()
+
+
+def live_entries(idx_path):
+    """Latest entry per needle id, tombstones dropped (CompactMap replay)."""
+    latest = {}
+    for nid, off, size in idx.walk(idx_path):
+        latest[nid] = (off, size)
+    return {nid: os for nid, os in latest.items() if os[1] >= 0}
+
+
+class TestFixtureVolume:
+    def test_all_needles_readable(self, fixture_volume, tmp_path):
+        entries = live_entries(str(tmp_path / "1.idx"))
+        assert len(entries) > 200, "fixture should hold hundreds of needles"
+        read = 0
+        for nid in entries:
+            n = fixture_volume.read(nid)  # raises CrcError before the fix
+            assert n.id == nid
+            read += 1
+        assert read == len(entries)
+
+    def test_fixture_stores_masked_crcs(self, fixture_volume, tmp_path):
+        """Sanity: this fixture really does store CRC.Value() checksums, so
+        it regression-guards the masked-accept path (needle_read.go:74-78).
+        Note from_bytes normalizes n.checksum to the raw CRC on success, so
+        we inspect the on-disk footer directly."""
+        import struct
+
+        from seaweedfs_tpu.ops.crc import crc32c
+        from seaweedfs_tpu.storage import types as t
+
+        entries = live_entries(str(tmp_path / "1.idx"))
+        nid, (off, size) = next(iter(sorted(entries.items())))
+        n = fixture_volume.read(nid)
+        with open(tmp_path / "1.dat", "rb") as f:
+            f.seek(off + t.NEEDLE_HEADER_SIZE + size)
+            (stored,) = struct.unpack(">I", f.read(4))
+        assert stored == mask_crc(crc32c(n.data))
+        assert stored != crc32c(n.data)
+
+    def test_ec_encode_and_full_read(self, fixture_volume, tmp_path):
+        entries = live_entries(str(tmp_path / "1.idx"))
+        base = Volume.base_name(str(tmp_path), 1)
+        ec.write_ec_files(base, backend="cpu")
+        ec.write_sorted_file_from_idx(base)
+        ev = ec.EcVolume(str(tmp_path), 1)
+        for i in range(14):
+            ev.add_shard(i)
+        for nid in entries:
+            want = fixture_volume.read(nid)
+            got = ev.read_needle(nid)
+            assert got.data == want.data, f"needle {nid:x} mismatch via EC"
+        ev.close()
+
+    def test_degraded_read_two_shards_down(self, fixture_volume, tmp_path):
+        """The ec_test.go:143-174 shape on the real fixture: drop shards,
+        reconstruct every needle from the survivors."""
+        entries = live_entries(str(tmp_path / "1.idx"))
+        base = Volume.base_name(str(tmp_path), 1)
+        ec.write_ec_files(base, backend="cpu")
+        ec.write_sorted_file_from_idx(base)
+        rng = random.Random(42)
+        for _ in range(2):
+            down = set(rng.sample(range(14), 2))
+            ev = ec.EcVolume(str(tmp_path), 1)
+            for i in range(14):
+                if i not in down:
+                    ev.add_shard(i)
+            for nid in entries:
+                want = fixture_volume.read(nid)
+                got = ev.read_needle(nid)
+                assert got.data == want.data, (
+                    f"needle {nid:x} mismatch, shards {sorted(down)} down"
+                )
+            ev.close()
+
+    def test_decode_back_to_dat(self, fixture_volume, tmp_path):
+        """ec.decode reassembles a .dat whose live needles byte-match the
+        original fixture records (ec_decoder shape, ec_decoder.go:154-201)."""
+        entries = live_entries(str(tmp_path / "1.idx"))
+        base = Volume.base_name(str(tmp_path), 1)
+        with open(base + ".dat", "rb") as f:
+            original = f.read()
+        ec.write_ec_files(base, backend="cpu")
+        ec.write_sorted_file_from_idx(base)
+        os.rename(base + ".dat", base + ".dat.orig")
+        os.rename(base + ".idx", base + ".idx.orig")
+        ec.write_dat_file(base, len(original))
+        with open(base + ".dat", "rb") as f:
+            rebuilt = f.read()
+        assert rebuilt == original
+        assert len(entries) > 0
