@@ -1,0 +1,267 @@
+"""FilerStore plugin API + the two embedded stores.
+
+Reference: weed/filer/filerstore.go:21-44 (the interface), the sqlite
+adapter (weed/filer/sqlite + abstract_sql), and the in-memory shape of
+leveldb2.  The reference ships 27 adapters; the plugin surface here is the
+same, so more can be slotted in, but an embedded sqlite store (durable,
+transactional, zero-dependency) plus a dict-backed memory store cover the
+single-node and test cases.
+
+Stores are synchronous; the Filer/FilerServer call them via
+asyncio.to_thread when on the event loop.
+"""
+from __future__ import annotations
+
+import sqlite3
+import threading
+from bisect import bisect_left, insort
+
+from .entry import Entry, new_full_path
+
+
+class NotFoundError(KeyError):
+    pass
+
+
+class FilerStore:
+    """Abstract store: path → serialized Entry + a kv sideband."""
+
+    name = "abstract"
+
+    def insert_entry(self, entry: Entry) -> None:
+        raise NotImplementedError
+
+    def update_entry(self, entry: Entry) -> None:
+        raise NotImplementedError
+
+    def find_entry(self, full_path: str) -> Entry:
+        raise NotImplementedError
+
+    def delete_entry(self, full_path: str) -> None:
+        raise NotImplementedError
+
+    def delete_folder_children(self, full_path: str) -> None:
+        raise NotImplementedError
+
+    def list_directory_entries(
+        self,
+        dir_path: str,
+        start_file_name: str = "",
+        include_start: bool = False,
+        limit: int = 1 << 30,
+        prefix: str = "",
+    ) -> list[Entry]:
+        raise NotImplementedError
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def kv_get(self, key: bytes) -> bytes:
+        raise NotImplementedError
+
+    def kv_delete(self, key: bytes) -> None:
+        raise NotImplementedError
+
+    # transactions are no-ops unless the backend supports them
+    def begin_transaction(self) -> None:
+        pass
+
+    def commit_transaction(self) -> None:
+        pass
+
+    def rollback_transaction(self) -> None:
+        pass
+
+    def shutdown(self) -> None:
+        pass
+
+
+class MemoryStore(FilerStore):
+    """Dict-backed store: dir → sorted child-name list, path → Entry."""
+
+    name = "memory"
+
+    def __init__(self):
+        self._entries: dict[str, Entry] = {}
+        self._children: dict[str, list[str]] = {}
+        self._kv: dict[bytes, bytes] = {}
+        self._lock = threading.RLock()
+
+    def insert_entry(self, entry: Entry) -> None:
+        with self._lock:
+            existed = entry.full_path in self._entries
+            self._entries[entry.full_path] = entry
+            if not existed:
+                names = self._children.setdefault(entry.directory, [])
+                i = bisect_left(names, entry.name)
+                if i >= len(names) or names[i] != entry.name:
+                    insort(names, entry.name)
+
+    update_entry = insert_entry
+
+    def find_entry(self, full_path: str) -> Entry:
+        with self._lock:
+            e = self._entries.get(full_path)
+            if e is None:
+                raise NotFoundError(full_path)
+            return e
+
+    def delete_entry(self, full_path: str) -> None:
+        with self._lock:
+            e = self._entries.pop(full_path, None)
+            if e is not None:
+                names = self._children.get(e.directory, [])
+                i = bisect_left(names, e.name)
+                if i < len(names) and names[i] == e.name:
+                    names.pop(i)
+
+    def delete_folder_children(self, full_path: str) -> None:
+        with self._lock:
+            for name in list(self._children.get(full_path, [])):
+                self.delete_entry(new_full_path(full_path, name))
+
+    def list_directory_entries(
+        self, dir_path, start_file_name="", include_start=False, limit=1 << 30, prefix=""
+    ):
+        with self._lock:
+            names = self._children.get(dir_path.rstrip("/") or "/", [])
+            i = bisect_left(names, start_file_name) if start_file_name else 0
+            out = []
+            for name in names[i:]:
+                if name == start_file_name and not include_start:
+                    continue
+                if prefix and not name.startswith(prefix):
+                    continue
+                out.append(self._entries[new_full_path(dir_path, name)])
+                if len(out) >= limit:
+                    break
+            return out
+
+    def kv_put(self, key, value):
+        self._kv[bytes(key)] = bytes(value)
+
+    def kv_get(self, key):
+        v = self._kv.get(bytes(key))
+        if v is None:
+            raise NotFoundError(key)
+        return v
+
+    def kv_delete(self, key):
+        self._kv.pop(bytes(key), None)
+
+
+class SqliteStore(FilerStore):
+    """Durable embedded store on sqlite3 (reference weed/filer/sqlite via
+    abstract_sql: one `filemeta(dirhash,name,directory,meta)` table; here
+    (directory, name) is the natural primary key)."""
+
+    name = "sqlite"
+
+    def __init__(self, path: str = ":memory:"):
+        self._path = path
+        self._local = threading.local()
+        self._conns: list[sqlite3.Connection] = []
+        self._conns_lock = threading.Lock()
+        c = self._conn()
+        c.execute(
+            "CREATE TABLE IF NOT EXISTS filemeta ("
+            " directory TEXT NOT NULL, name TEXT NOT NULL, meta BLOB,"
+            " PRIMARY KEY (directory, name))"
+        )
+        c.execute("CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB)")
+
+    def _conn(self) -> sqlite3.Connection:
+        c = getattr(self._local, "conn", None)
+        if c is None:
+            c = sqlite3.connect(self._path, timeout=30.0, isolation_level=None)
+            c.execute("PRAGMA journal_mode=WAL")
+            c.execute("PRAGMA synchronous=NORMAL")
+            self._local.conn = c
+            with self._conns_lock:
+                self._conns.append(c)
+        return c
+
+    def insert_entry(self, entry: Entry) -> None:
+        self._conn().execute(
+            "INSERT OR REPLACE INTO filemeta (directory, name, meta) VALUES (?,?,?)",
+            (entry.directory, entry.name, entry.encode()),
+        )
+
+    update_entry = insert_entry
+
+    def find_entry(self, full_path: str) -> Entry:
+        from .entry import dir_and_name
+
+        d, n = dir_and_name(full_path)
+        row = self._conn().execute(
+            "SELECT meta FROM filemeta WHERE directory=? AND name=?", (d, n)
+        ).fetchone()
+        if row is None:
+            raise NotFoundError(full_path)
+        return Entry.decode(full_path, row[0])
+
+    def delete_entry(self, full_path: str) -> None:
+        from .entry import dir_and_name
+
+        d, n = dir_and_name(full_path)
+        self._conn().execute(
+            "DELETE FROM filemeta WHERE directory=? AND name=?", (d, n)
+        )
+
+    def delete_folder_children(self, full_path: str) -> None:
+        self._conn().execute(
+            "DELETE FROM filemeta WHERE directory=?", (full_path.rstrip("/") or "/",)
+        )
+
+    def list_directory_entries(
+        self, dir_path, start_file_name="", include_start=False, limit=1 << 30, prefix=""
+    ):
+        dir_path = dir_path.rstrip("/") or "/"
+        op = ">=" if include_start else ">"
+        sql = f"SELECT name, meta FROM filemeta WHERE directory=? AND name {op} ?"
+        args: list = [dir_path, start_file_name]
+        if prefix:
+            sql += " AND name GLOB ?"
+            args.append(_glob_escape(prefix) + "*")
+        sql += " ORDER BY name LIMIT ?"
+        args.append(limit)
+        return [
+            Entry.decode(new_full_path(dir_path, name), meta)
+            for name, meta in self._conn().execute(sql, args)
+        ]
+
+    def kv_put(self, key, value):
+        self._conn().execute(
+            "INSERT OR REPLACE INTO kv (k, v) VALUES (?,?)", (bytes(key), bytes(value))
+        )
+
+    def kv_get(self, key):
+        row = self._conn().execute("SELECT v FROM kv WHERE k=?", (bytes(key),)).fetchone()
+        if row is None:
+            raise NotFoundError(key)
+        return row[0]
+
+    def kv_delete(self, key):
+        self._conn().execute("DELETE FROM kv WHERE k=?", (bytes(key),))
+
+    def begin_transaction(self):
+        self._conn().execute("BEGIN")
+
+    def commit_transaction(self):
+        self._conn().execute("COMMIT")
+
+    def rollback_transaction(self):
+        self._conn().execute("ROLLBACK")
+
+    def shutdown(self):
+        with self._conns_lock:
+            for c in self._conns:
+                try:
+                    c.close()
+                except Exception:
+                    pass
+            self._conns.clear()
+
+
+def _glob_escape(s: str) -> str:
+    return s.replace("[", "[[]").replace("*", "[*]").replace("?", "[?]")

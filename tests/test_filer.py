@@ -1,0 +1,369 @@
+"""Filer tier unit tests: chunk interval algebra (vectors mirrored from
+reference weed/filer/filechunks_test.go), randomized differential checks
+against a byte-level model, FilerStore behavior, and Filer core ops."""
+from __future__ import annotations
+
+import asyncio
+import random
+
+import pytest
+
+from seaweedfs_tpu.filer import (
+    Attr,
+    Entry,
+    Filer,
+    FilerError,
+    MemoryStore,
+    MODE_DIR,
+    NotEmptyError,
+    NotFoundError,
+    SqliteStore,
+    compact_file_chunks,
+    make_chunk,
+    maybe_manifestize,
+    read_resolved_chunks,
+    resolve_chunk_manifest,
+    total_size,
+    view_from_chunks,
+)
+from seaweedfs_tpu.pb import filer_pb2
+
+
+def C(offset, size, fid, ts):
+    return make_chunk(fid, offset, size, modified_ts_ns=ts)
+
+
+# ---------------------------------------------------------------- intervals
+
+
+INTERVAL_CASES = [
+    # (chunks, expected [(start, stop, fid, offset_in_chunk)])
+    (
+        [C(0, 100, "abc", 123), C(100, 100, "asdf", 134), C(200, 100, "fsad", 353)],
+        [(0, 100, "abc", 0), (100, 200, "asdf", 0), (200, 300, "fsad", 0)],
+    ),
+    ([C(0, 100, "abc", 123), C(0, 200, "asdf", 134)], [(0, 200, "asdf", 0)]),
+    (
+        [C(0, 100, "a", 123), C(0, 70, "b", 134)],
+        [(0, 70, "b", 0), (70, 100, "a", 70)],
+    ),
+    (
+        [C(0, 100, "abc", 123), C(0, 200, "asdf", 134), C(50, 250, "xxxx", 154)],
+        [(0, 50, "asdf", 0), (50, 300, "xxxx", 0)],
+    ),
+    (
+        [C(0, 100, "abc", 123), C(0, 200, "asdf", 134), C(250, 250, "xxxx", 154)],
+        [(0, 200, "asdf", 0), (250, 500, "xxxx", 0)],
+    ),
+    (
+        [C(0, 100, "a", 123), C(0, 200, "d", 184), C(70, 150, "c", 143), C(80, 100, "b", 134)],
+        [(0, 200, "d", 0), (200, 220, "c", 130)],
+    ),
+    (
+        [C(0, 100, "abc", 123), C(0, 100, "axf", 124), C(0, 100, "xyz", 125)],
+        [(0, 100, "xyz", 0)],
+    ),
+    (
+        [
+            C(0, 2097152, "7,0294cbb9892b", 123),
+            C(0, 3145728, "3,029565bf3092", 130),
+            C(2097152, 3145728, "6,029632f47ae2", 140),
+            C(5242880, 3145728, "2,029734c5aa10", 150),
+            C(8388608, 3145728, "5,02982f80de50", 160),
+            C(11534336, 2842193, "7,0299ad723803", 170),
+        ],
+        [
+            (0, 2097152, "3,029565bf3092", 0),
+            (2097152, 5242880, "6,029632f47ae2", 0),
+            (5242880, 8388608, "2,029734c5aa10", 0),
+            (8388608, 11534336, "5,02982f80de50", 0),
+            (11534336, 14376529, "7,0299ad723803", 0),
+        ],
+    ),
+]
+
+
+@pytest.mark.parametrize("chunks,expected", INTERVAL_CASES)
+def test_interval_merging(chunks, expected):
+    got = read_resolved_chunks(chunks)
+    assert [(v.start, v.stop, v.file_id, v.offset_in_chunk) for v in got] == expected
+
+
+def test_interval_merging_randomized_vs_byte_model():
+    rng = random.Random(7)
+    for _ in range(60):
+        n = rng.randint(1, 25)
+        chunks = []
+        model = {}  # byte offset -> (ts, fid)
+        for i in range(n):
+            off = rng.randint(0, 400)
+            size = rng.randint(1, 150)
+            ts = rng.randint(1, 10**6)
+            fid = f"f{i}"
+            chunks.append(C(off, size, fid, ts))
+        order = sorted(range(n), key=lambda i: (chunks[i].modified_ts_ns, i))
+        for i in order:
+            c = chunks[i]
+            for b in range(c.offset, c.offset + int(c.size)):
+                model[b] = c.file_id
+        visibles = read_resolved_chunks(chunks)
+        # disjoint + sorted
+        for a, b in zip(visibles, visibles[1:]):
+            assert a.stop <= b.start
+        covered = {}
+        for v in visibles:
+            chunk = next(c for c in chunks if c.file_id == v.file_id)
+            assert v.offset_in_chunk == v.start - chunk.offset
+            for b in range(v.start, v.stop):
+                covered[b] = v.file_id
+        assert covered == model
+
+
+def test_view_from_chunks_clipping():
+    chunks = [C(0, 100, "a", 1), C(100, 100, "b", 2)]
+    views = view_from_chunks(chunks, 50, 100)
+    assert [(v.file_id, v.offset_in_chunk, v.view_size, v.view_offset) for v in views] == [
+        ("a", 50, 50, 50),
+        ("b", 0, 50, 100),
+    ]
+    # read past EOF clips
+    assert view_from_chunks(chunks, 150, 500)[0].view_size == 50
+    assert view_from_chunks(chunks, 900, 10) == []
+
+
+def test_compact_file_chunks():
+    chunks = [C(0, 100, "abc", 50), C(100, 100, "def", 100), C(0, 200, "xyz", 150)]
+    compacted, garbage = compact_file_chunks(chunks)
+    assert {c.file_id for c in compacted} == {"xyz"}
+    assert {c.file_id for c in garbage} == {"abc", "def"}
+    assert total_size(chunks) == 200
+
+
+def test_manifest_round_trip():
+    blobs = {}
+
+    def save(blob):
+        fid = f"m{len(blobs)}"
+        blobs[fid] = blob
+        return filer_pb2.FileChunk(file_id=fid, e_tag="")
+
+    chunks = [C(i * 10, 10, f"c{i}", i + 1) for i in range(2500)]
+    folded = maybe_manifestize(save, chunks, batch=1000)
+    manifests = [c for c in folded if c.is_chunk_manifest]
+    plain = [c for c in folded if not c.is_chunk_manifest]
+    assert len(manifests) == 2 and len(plain) == 500
+    data, mchunks = resolve_chunk_manifest(
+        lambda fid: blobs[fid], folded, 0, 1 << 62
+    )
+    assert len(data) == 2500 and len(mchunks) == 2
+    assert {c.file_id for c in data} == {f"c{i}" for i in range(2500)}
+    # a bounded read only expands overlapping manifests
+    data2, _ = resolve_chunk_manifest(lambda fid: blobs[fid], folded, 20000, 20010)
+    assert all(c.file_id.startswith("c2") for c in data2 if int(c.file_id[1:]) >= 2000)
+
+
+# ------------------------------------------------------------------- stores
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def store(request, tmp_path):
+    if request.param == "memory":
+        s = MemoryStore()
+    else:
+        s = SqliteStore(str(tmp_path / "filer.db"))
+    yield s
+    s.shutdown()
+
+
+def _entry(path, size=0, mode=0o660):
+    return Entry(full_path=path, attr=Attr(mode=mode, file_size=size))
+
+
+def _dir(path):
+    return Entry(full_path=path, attr=Attr(mode=0o770 | MODE_DIR))
+
+
+def test_store_crud_and_listing(store):
+    store.insert_entry(_dir("/a"))
+    for name in ["x.txt", "y.txt", "z.log", "aa.txt"]:
+        store.insert_entry(_entry(f"/a/{name}", size=5))
+    got = store.find_entry("/a/x.txt")
+    assert got.attr.file_size == 5 and not got.is_directory
+    assert store.find_entry("/a").is_directory
+
+    names = [e.name for e in store.list_directory_entries("/a")]
+    assert names == ["aa.txt", "x.txt", "y.txt", "z.log"]
+    # pagination
+    page = store.list_directory_entries("/a", limit=2)
+    assert [e.name for e in page] == ["aa.txt", "x.txt"]
+    page2 = store.list_directory_entries("/a", start_file_name="x.txt", limit=2)
+    assert [e.name for e in page2] == ["y.txt", "z.log"]
+    page2i = store.list_directory_entries(
+        "/a", start_file_name="x.txt", include_start=True, limit=2
+    )
+    assert [e.name for e in page2i] == ["x.txt", "y.txt"]
+    # prefix
+    assert [e.name for e in store.list_directory_entries("/a", prefix="a")] == ["aa.txt"]
+
+    store.delete_entry("/a/x.txt")
+    with pytest.raises(NotFoundError):
+        store.find_entry("/a/x.txt")
+    store.delete_folder_children("/a")
+    assert store.list_directory_entries("/a") == []
+    assert store.find_entry("/a").is_directory  # the dir itself survives
+
+    store.kv_put(b"k", b"v")
+    assert store.kv_get(b"k") == b"v"
+    store.kv_delete(b"k")
+    with pytest.raises(NotFoundError):
+        store.kv_get(b"k")
+
+
+def test_store_update_overwrites(store):
+    store.insert_entry(_entry("/f", size=1))
+    store.update_entry(_entry("/f", size=2))
+    assert store.find_entry("/f").attr.file_size == 2
+    assert len(store.list_directory_entries("/")) == 1
+
+
+def test_sqlite_store_persistence(tmp_path):
+    path = str(tmp_path / "filer.db")
+    s = SqliteStore(path)
+    e = _entry("/data/f.bin", size=42)
+    e.chunks = [C(0, 42, "3,ab12", 1)]
+    s.insert_entry(e)
+    s.shutdown()
+    s2 = SqliteStore(path)
+    got = s2.find_entry("/data/f.bin")
+    assert got.attr.file_size == 42
+    assert got.chunks[0].file_id == "3,ab12"
+    s2.shutdown()
+
+
+# --------------------------------------------------------------- filer core
+
+
+def run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop().run_until_complete(coro)
+
+
+def test_filer_create_makes_parents(store):
+    f = Filer(store)
+
+    async def go():
+        e = _entry("/a/b/c/file.txt", size=3)
+        await f.create_entry(e)
+        assert f.find_entry("/a").is_directory
+        assert f.find_entry("/a/b/c").is_directory
+        assert f.find_entry("/a/b/c/file.txt").attr.file_size == 3
+        with pytest.raises(FilerError):
+            await f.create_entry(e, o_excl=True)
+
+    run(go())
+
+
+def test_filer_recursive_delete_collects_chunks(store):
+    deleted: list[str] = []
+
+    async def deleter(fids):
+        deleted.extend(fids)
+
+    f = Filer(store, delete_file_ids_fn=deleter)
+
+    async def go():
+        e1 = _entry("/d/sub/f1", size=10)
+        e1.chunks = [C(0, 10, "1,aa", 1)]
+        e2 = _entry("/d/f2", size=10)
+        e2.chunks = [C(0, 10, "2,bb", 1), C(10, 5, "2,cc", 2)]
+        await f.create_entry(e1)
+        await f.create_entry(e2)
+        with pytest.raises(NotEmptyError):
+            await f.delete_entry_meta_and_data("/d", is_recursive=False)
+        await f.delete_entry_meta_and_data("/d", is_recursive=True)
+        with pytest.raises(NotFoundError):
+            f.find_entry("/d")
+        assert sorted(deleted) == ["1,aa", "2,bb", "2,cc"]
+
+    run(go())
+
+
+def test_filer_rename_subtree(store):
+    f = Filer(store)
+
+    async def go():
+        for p in ["/src/a.txt", "/src/sub/b.txt"]:
+            await f.create_entry(_entry(p, size=1))
+        await f.atomic_rename("/", "src", "/", "dst")
+        assert f.find_entry("/dst/a.txt")
+        assert f.find_entry("/dst/sub/b.txt")
+        with pytest.raises(NotFoundError):
+            f.find_entry("/src")
+        # rename into a new directory chain
+        await f.atomic_rename("/dst", "a.txt", "/new/deep", "c.txt")
+        assert f.find_entry("/new/deep/c.txt")
+
+    run(go())
+
+
+def test_filer_append_chunks(store):
+    f = Filer(store)
+
+    async def go():
+        await f.append_chunks("/log.bin", [C(0, 100, "1,x", 1)])
+        e = await f.append_chunks("/log.bin", [C(0, 50, "1,y", 2)])
+        assert e.size() == 150
+        assert [c.offset for c in e.chunks] == [0, 100]
+
+    run(go())
+
+
+def test_meta_log_replay_and_tail(store):
+    f = Filer(store)
+
+    async def go():
+        await f.create_entry(_entry("/a/1", size=1))
+        await f.create_entry(_entry("/a/2", size=1))
+
+        seen = []
+
+        async def consume():
+            async for ev in f.meta_log.subscribe(since_ns=0):
+                seen.append(ev)
+                if len(seen) >= 3:
+                    return
+
+        task = asyncio.ensure_future(consume())
+        await asyncio.sleep(0.05)
+        await f.delete_entry_meta_and_data("/a/1", is_delete_data=False)
+        await asyncio.wait_for(task, 5)
+        # replayed two creations + live-tailed the deletion
+        kinds = [
+            (e.event_notification.HasField("old_entry"), e.event_notification.HasField("new_entry"))
+            for e in seen
+        ]
+        assert kinds == [(False, True), (False, True), (True, False)]
+        assert [e.ts_ns for e in seen] == sorted(e.ts_ns for e in seen)
+
+    run(go())
+
+
+def test_meta_log_disk_persistence(tmp_path, store):
+    path = str(tmp_path / "meta.log")
+    f = Filer(store, meta_log_path=path)
+
+    async def go():
+        await f.create_entry(_entry("/x", size=1))
+
+    run(go())
+    f.meta_log.close()
+
+    from seaweedfs_tpu.filer import MetaLog
+
+    log2 = MetaLog(path)
+
+    async def read_one():
+        async for ev in log2.subscribe(0):
+            return ev
+
+    ev = run(read_one())
+    assert ev.event_notification.new_entry.name == "x"
