@@ -184,7 +184,7 @@ def view_from_chunks(
     return view_from_visibles(visibles, offset, size)
 
 
-def compact_file_chunks(chunks, lookup_fn=None):
+def compact_file_chunks(chunks):
     """Split chunks into (still-visible, garbage) — garbage chunks are fully
     shadowed by newer writes (filechunks.go CompactFileChunks)."""
     visibles = read_resolved_chunks(chunks)

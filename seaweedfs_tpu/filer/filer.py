@@ -60,10 +60,21 @@ class Filer:
         limit: int = 1024,
         prefix: str = "",
     ) -> list[Entry]:
-        entries = self.store.list_directory_entries(
-            dir_path, start_file_name, include_start, limit, prefix
-        )
-        return [e for e in entries if not _is_expired(e)]
+        """Up to `limit` live entries; TTL-expired rows are filtered and
+        backfilled from the store so a short batch always means the
+        directory is exhausted (pagination callers rely on that)."""
+        out: list[Entry] = []
+        start, inclusive = start_file_name, include_start
+        while len(out) < limit:
+            ask = limit - len(out)
+            batch = self.store.list_directory_entries(
+                dir_path, start, inclusive, ask, prefix
+            )
+            out.extend(e for e in batch if not _is_expired(e))
+            if len(batch) < ask:
+                break
+            start, inclusive = batch[-1].name, False
+        return out
 
     # ----------------------------------------------------------------- writes
 
@@ -160,6 +171,7 @@ class Filer:
             )
         chunks.extend(entry.chunks)
         self.store.delete_entry(entry.full_path)
+        self._dir_cache.pop(entry.full_path, None)
         await self.meta_log.append(
             entry.directory, entry, None, delete_chunks=is_delete_data,
             signatures=signatures or [],
@@ -186,6 +198,7 @@ class Filer:
                         )
                     chunks.extend(child.chunks)
                     self.store.delete_entry(child.full_path)
+                    self._dir_cache.pop(child.full_path, None)
                     await self.meta_log.append(child.directory, child, None)
                 except NotEmptyError:
                     if not ignore_errors:
@@ -248,6 +261,7 @@ class Filer:
                 self._move_subtree(
                     child, new_full_path(new_path, child.name), events
                 )
+        self._dir_cache.pop(entry.full_path, None)
         moved = Entry(
             full_path=new_path,
             attr=entry.attr,

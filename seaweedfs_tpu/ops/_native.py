@@ -7,19 +7,42 @@ signatures on the shared handle.  Returns False when the library isn't built
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
+import subprocess
 
 _handle: ctypes.CDLL | bool | None = None
+
+log = logging.getLogger("native")
+
+
+def _build(native_dir: str) -> None:
+    """Build libswfs_native.so in place (one `make`, ~2s).  The numpy
+    fallbacks are orders of magnitude slower (byte-loop CRC32C), so an
+    unbuilt library is a performance bug, not a soft degrade — build
+    eagerly unless explicitly disabled."""
+    if os.environ.get("SWFS_NO_NATIVE_BUILD"):
+        return
+    try:
+        subprocess.run(
+            ["make", "-C", native_dir],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except Exception as e:  # noqa: BLE001 — fall back to numpy paths
+        log.warning("native build failed (%s); using slow numpy fallbacks", e)
 
 
 def load() -> ctypes.CDLL | bool:
     global _handle
     if _handle is None:
-        so = os.path.join(
-            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-            "native",
-            "libswfs_native.so",
+        native_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native"
         )
+        so = os.path.join(native_dir, "libswfs_native.so")
+        if not os.path.exists(so):
+            _build(native_dir)
         if not os.path.exists(so):
             _handle = False
         else:

@@ -4,7 +4,8 @@ Reference: weed/server/ (10.2k LoC).  Each server is a plain class with
 async start()/stop(); the `weed server` all-in-one launcher lives in
 cluster.py.
 """
+from .filer import FilerServer
 from .master import MasterServer
 from .volume import VolumeServer
 
-__all__ = ["MasterServer", "VolumeServer"]
+__all__ = ["FilerServer", "MasterServer", "VolumeServer"]

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import asyncio
 
+from .filer import FilerServer
 from .master import MasterServer
 from .volume import VolumeServer
 
@@ -24,6 +25,8 @@ class LocalCluster:
         ec_backend: str = "auto",
         data_centers: list[str] | None = None,
         racks: list[str] | None = None,
+        with_filer: bool = False,
+        filer_kwargs: dict | None = None,
     ):
         import os
 
@@ -31,6 +34,9 @@ class LocalCluster:
             port=0, volume_size_limit_mb=volume_size_limit_mb,
             pulse_seconds=pulse_seconds,
         )
+        self.with_filer = with_filer
+        self.filer_kwargs = filer_kwargs or {}
+        self.filer: FilerServer | None = None
         self.base_dir = base_dir
         self._specs = []
         for i in range(n_volume_servers):
@@ -61,6 +67,12 @@ class LocalCluster:
             await vs.start()
             self.volume_servers.append(vs)
         await self.wait_for_nodes(len(self.volume_servers))
+        if self.with_filer:
+            self.filer = FilerServer(
+                masters=[self.master.advertise_url], port=0, grpc_port=0,
+                **self.filer_kwargs,
+            )
+            await self.filer.start()
 
     async def wait_for_nodes(self, n: int, timeout: float = 10.0) -> None:
         deadline = asyncio.get_event_loop().time() + timeout
@@ -71,6 +83,8 @@ class LocalCluster:
         raise TimeoutError(f"only {len(self.master.topo.data_nodes())}/{n} nodes joined")
 
     async def stop(self) -> None:
+        if self.filer is not None:
+            await self.filer.stop()
         for vs in self.volume_servers:
             await vs.stop()
         await self.master.stop()

@@ -1,0 +1,728 @@
+"""FilerServer: the namespace tier's HTTP + gRPC host.
+
+Reference: weed/server/filer_server.go, filer_server_handlers_read.go (261),
+filer_server_handlers_write_autochunk.go:25-130, filer_grpc_server.go (368),
+filer_grpc_server_rename.go, filer_grpc_server_sub_meta.go.
+
+One asyncio process:
+  - aiohttp data plane on /{path}: POST/PUT auto-chunking uploads (body is
+    split into maxMB chunks, each assigned+uploaded to volume servers),
+    GET/HEAD streaming reads with Range support and directory listings,
+    DELETE with recursive.
+  - grpc.aio `SeaweedFiler` service: entry CRUD, AtomicRenameEntry,
+    AssignVolume proxy, metadata subscription (replay + live tail).
+  - a MasterClient subscription for vid→location lookups and leader
+    tracking (the reference filer does the same, filer.go:35-75).
+"""
+from __future__ import annotations
+
+import asyncio
+import base64
+import hashlib
+import json
+import logging
+import time
+
+import aiohttp
+import grpc
+from aiohttp import web
+
+from ..filer import (
+    Attr,
+    Entry,
+    Filer,
+    FilerError,
+    MODE_DIR,
+    MemoryStore,
+    NotEmptyError,
+    NotFoundError,
+    SqliteStore,
+    etag_of_chunks,
+    maybe_manifestize,
+    new_full_path,
+    view_from_chunks,
+)
+from ..operation.assign import assign as assign_rpc
+from ..operation.delete import delete_files
+from ..operation.upload import upload_data
+from ..pb import Stub, channel, filer_pb2, generic_handler, master_pb2, server_address
+from ..pb.rpc import GRPC_OPTIONS
+from ..wdclient import MasterClient
+
+log = logging.getLogger("filer")
+
+
+class FilerServer:
+    def __init__(
+        self,
+        masters: list[str],
+        store=None,
+        ip: str = "127.0.0.1",
+        port: int = 8888,
+        grpc_port: int = 0,
+        max_mb: int = 4,
+        collection: str = "",
+        replication: str = "",
+        data_center: str = "",
+        rack: str = "",
+        meta_log_path: str | None = None,
+        save_inside_limit: int = 0,  # inline files <= this many bytes in metadata
+        dir_buckets: str = "/buckets",
+    ):
+        self.masters = masters
+        self.ip = ip
+        self.port = port
+        self.grpc_port = grpc_port or (port + 10000 if port else 0)
+        self.max_mb = max_mb
+        self.collection = collection
+        self.replication = replication
+        self.data_center = data_center
+        self.rack = rack
+        self.save_inside_limit = save_inside_limit
+        self.dir_buckets = dir_buckets
+        self.filer = Filer(
+            store if store is not None else MemoryStore(),
+            delete_file_ids_fn=self._delete_file_ids,
+            meta_log_path=meta_log_path,
+        )
+        self.master_client = MasterClient(
+            masters,
+            client_type="filer",
+            client_address=f"{ip}:{port}",
+            data_center=data_center,
+        )
+        self._grpc_server: grpc.aio.Server | None = None
+        self._http_runner: web.AppRunner | None = None
+        self._session: aiohttp.ClientSession | None = None
+
+    # ----------------------------------------------------------- lifecycle
+
+    @property
+    def url(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession()
+        self._grpc_server = grpc.aio.server(options=GRPC_OPTIONS)
+        self._grpc_server.add_generic_rpc_handlers(
+            [generic_handler(filer_pb2, "SeaweedFiler", self)]
+        )
+        self.grpc_port = self._grpc_server.add_insecure_port(
+            f"{self.ip}:{self.grpc_port}"
+        )
+        await self._grpc_server.start()
+
+        app = web.Application(client_max_size=1024 * 1024 * 1024)
+        app.router.add_route("*", "/{path:.*}", self._http_dispatch)
+        self._http_runner = web.AppRunner(app)
+        await self._http_runner.setup()
+        site = web.TCPSite(self._http_runner, self.ip, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        self.master_client.client_address = f"{self.ip}:{self.port}"
+        await self.master_client.start()
+        log.info("filer listening http=%s grpc=%s", self.port, self.grpc_port)
+
+    async def stop(self) -> None:
+        await self.master_client.stop()
+        if self._grpc_server:
+            await self._grpc_server.stop(0.5)
+        if self._http_runner:
+            await self._http_runner.cleanup()
+        if self._session:
+            await self._session.close()
+        self.filer.shutdown()
+
+    # -------------------------------------------------- chunk data movement
+
+    async def _delete_file_ids(self, fids: list[str]) -> None:
+        await delete_files(self.master_client.current_master, fids)
+
+    async def _assign(self, count: int = 1, collection: str = "", replication: str = "",
+                      ttl: str = "", data_center: str = ""):
+        return await assign_rpc(
+            self.master_client.current_master,
+            count=count,
+            collection=collection or self.collection,
+            replication=replication or self.replication,
+            ttl=ttl,
+            data_center=data_center or self.data_center,
+        )
+
+    async def _upload_chunk(
+        self, data: bytes, offset: int, filename: str,
+        collection: str = "", replication: str = "", ttl: str = "",
+    ) -> filer_pb2.FileChunk:
+        a = await self._assign(1, collection, replication, ttl)
+        result = await upload_data(
+            f"http://{a.url}/{a.fid}", data, filename=filename, compress=False
+        )
+        return filer_pb2.FileChunk(
+            file_id=a.fid,
+            offset=offset,
+            size=len(data),
+            modified_ts_ns=time.time_ns(),
+            e_tag=result.get("eTag", ""),
+        )
+
+    async def _lookup_urls(self, file_id: str) -> list[str]:
+        vid = int(file_id.split(",")[0])
+        locs = await self.master_client.lookup_or_fetch(vid)
+        return [f"http://{l.url}/{file_id}" for l in locs]
+
+    async def _fetch_view(self, view) -> bytes:
+        """One ChunkView's bytes from a volume server (Range read)."""
+        urls = await self._lookup_urls(view.file_id)
+        if not urls:
+            raise web.HTTPInternalServerError(
+                text=f"chunk {view.file_id}: no locations"
+            )
+        last_err = None
+        for url in urls:
+            hdr = {}
+            if not (view.offset_in_chunk == 0 and view.view_size == view.chunk_size):
+                hdr["Range"] = (
+                    f"bytes={view.offset_in_chunk}-"
+                    f"{view.offset_in_chunk + view.view_size - 1}"
+                )
+            try:
+                async with self._session.get(url, headers=hdr) as r:
+                    if r.status >= 300:
+                        raise RuntimeError(f"{url}: HTTP {r.status}")
+                    return await r.read()
+            except Exception as e:  # noqa: BLE001 — try the next replica
+                last_err = e
+        raise web.HTTPInternalServerError(text=f"chunk {view.file_id}: {last_err}")
+
+    async def _fetch_whole(self, file_id: str) -> bytes:
+        urls = await self._lookup_urls(file_id)
+        last_err: Exception | None = None
+        for url in urls:
+            try:
+                async with self._session.get(url) as r:
+                    if r.status < 300:
+                        return await r.read()
+                    last_err = RuntimeError(f"{url}: HTTP {r.status}")
+            except Exception as e:  # noqa: BLE001 — try the next replica
+                last_err = e
+        raise RuntimeError(f"{file_id}: unreachable ({last_err})")
+
+    async def _resolve_views(self, chunks, offset: int, size: int):
+        """view_from_chunks with async manifest resolution."""
+        from ..filer.manifest import resolve_chunk_manifest
+
+        has_manifest = any(c.is_chunk_manifest for c in chunks)
+        if has_manifest:
+            blobs: dict[str, bytes] = {}
+            for c in chunks:
+                if c.is_chunk_manifest:
+                    blobs[c.file_id] = await self._fetch_whole(c.file_id)
+
+            def lookup(fid):
+                if fid not in blobs:
+                    raise KeyError(fid)
+                return blobs[fid]
+
+            chunks, _ = resolve_chunk_manifest(lookup, chunks, offset, offset + size)
+        return view_from_chunks(chunks, offset, size)
+
+    # ------------------------------------------------------- HTTP handlers
+
+    async def _http_dispatch(self, request: web.Request) -> web.StreamResponse:
+        try:
+            if request.method in ("GET", "HEAD"):
+                return await self.h_get(request)
+            if request.method in ("POST", "PUT"):
+                return await self.h_write(request)
+            if request.method == "DELETE":
+                return await self.h_delete(request)
+        except web.HTTPException:
+            raise
+        except NotFoundError:
+            raise web.HTTPNotFound()
+        except (FilerError, NotEmptyError) as e:
+            raise web.HTTPConflict(text=str(e))
+        raise web.HTTPMethodNotAllowed(request.method, ["GET", "POST", "PUT", "DELETE"])
+
+    def _req_path(self, request: web.Request) -> tuple[str, bool]:
+        p = "/" + request.match_info["path"]
+        return p.rstrip("/") or "/", p.endswith("/") and p != "/"
+
+    async def h_get(self, request: web.Request) -> web.StreamResponse:
+        path, _ = self._req_path(request)
+        entry = self.filer.find_entry(path)  # NotFoundError → 404
+        if entry.is_directory:
+            return await self._list_dir(request, path)
+        return await self._stream_file(request, entry)
+
+    async def _list_dir(self, request: web.Request, path: str) -> web.Response:
+        q = request.query
+        limit = int(q.get("limit", 100))
+        last = q.get("lastFileName", "")
+        prefix = q.get("namePattern", "").rstrip("*")
+        entries = self.filer.list_directory_entries(
+            path, start_file_name=last, limit=limit + 1, prefix=prefix
+        )
+        more = len(entries) > limit
+        entries = entries[:limit]
+        return web.json_response(
+            {
+                "Path": path,
+                "Entries": [_entry_json(e) for e in entries],
+                "Limit": limit,
+                "LastFileName": entries[-1].name if entries else "",
+                "ShouldDisplayLoadMore": more,
+            }
+        )
+
+    async def _stream_file(self, request: web.Request, entry: Entry) -> web.StreamResponse:
+        total = entry.size()
+        mime = entry.attr.mime or "application/octet-stream"
+        headers = {
+            "Accept-Ranges": "bytes",
+            "Last-Modified": time.strftime(
+                "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(entry.attr.mtime)
+            ),
+        }
+        if entry.chunks:
+            headers["ETag"] = f'"{etag_of_chunks(entry.chunks)}"'
+        if entry.attr.md5:
+            headers["Content-MD5"] = base64.b64encode(entry.attr.md5).decode()
+
+        offset, size, status = 0, total, 200
+        rng = request.http_range
+        if rng.start is not None or rng.stop is not None:
+            start = rng.start or 0
+            if start < 0:  # suffix range "bytes=-N"
+                start, stop = max(total + start, 0), total
+            else:
+                stop = min(rng.stop if rng.stop is not None else total, total)
+            if start >= stop:
+                raise web.HTTPRequestRangeNotSatisfiable()
+            offset, size, status = start, stop - start, 206
+            headers["Content-Range"] = f"bytes {start}-{start + size - 1}/{total}"
+
+        if request.method == "HEAD":
+            headers["Content-Length"] = str(size)
+            return web.Response(status=status, headers=headers, content_type=mime)
+
+        resp = web.StreamResponse(status=status, headers={**headers, "Content-Length": str(size)})
+        resp.content_type = mime
+        await resp.prepare(request)
+        pos = offset
+        stop = offset + size
+        if entry.content and pos < len(entry.content):
+            # inlined head (appends may have added chunks past it)
+            end = min(stop, len(entry.content))
+            await resp.write(bytes(entry.content[pos:end]))
+            pos = end
+        if pos < stop:
+            views = await self._resolve_views(entry.chunks, pos, stop - pos)
+            for v in views:
+                if v.view_offset > pos:  # hole → zeros
+                    await resp.write(b"\x00" * (v.view_offset - pos))
+                await resp.write(await self._fetch_view(v))
+                pos = v.view_offset + v.view_size
+            if pos < stop:
+                await resp.write(b"\x00" * (stop - pos))
+        await resp.write_eof()
+        return resp
+
+    async def h_write(self, request: web.Request) -> web.Response:
+        path, had_slash = self._req_path(request)
+        q = request.query
+        # mkdir: POST to a path ending in "/" with no content-type
+        if (
+            request.method == "POST"
+            and had_slash
+            and not request.headers.get("Content-Type")
+        ):
+            await self.filer.create_entry(
+                Entry(
+                    full_path=path,
+                    attr=Attr(
+                        mtime=int(time.time()), crtime=int(time.time()),
+                        mode=0o770 | MODE_DIR,
+                    ),
+                )
+            )
+            return web.json_response({"name": path}, status=201)
+
+        chunk_size = int(q.get("maxMB", self.max_mb)) * 1024 * 1024
+        collection = q.get("collection", self.collection)
+        replication = q.get("replication", self.replication)
+        ttl_str = q.get("ttl", "")
+        try:
+            from ..storage.types import TTL
+
+            ttl_sec = TTL.parse(ttl_str).minutes * 60
+        except ValueError as e:
+            raise web.HTTPBadRequest(text=str(e))
+        is_append = q.get("op") == "append"
+
+        filename = ""
+        content_type = request.headers.get("Content-Type", "")
+        reader = request.content
+        if request.method == "POST" and content_type.startswith("multipart/"):
+            mp = await request.multipart()
+            part = await mp.next()
+            if part is None:
+                raise web.HTTPBadRequest(text="empty multipart body")
+            filename = part.filename or ""
+            content_type = part.headers.get("Content-Type", "")
+            reader = part
+        if content_type == "application/octet-stream":
+            content_type = ""
+
+        # if POSTing to a directory, the file lands inside it
+        if had_slash and filename:
+            path = new_full_path(path, filename)
+        elif filename and path != "/":
+            try:
+                if self.filer.find_entry(path).is_directory:
+                    path = new_full_path(path, filename)
+            except NotFoundError:
+                pass
+
+        md5 = hashlib.md5()
+        chunks: list[filer_pb2.FileChunk] = []
+        small_content = b""
+        offset = 0
+        buf = bytearray()
+        eof = False
+        while not eof:
+            while len(buf) < chunk_size and not eof:
+                piece = await reader.read(min(chunk_size - len(buf), 1 << 20))
+                if not piece:
+                    eof = True
+                else:
+                    buf.extend(piece)
+            data = bytes(buf)
+            buf.clear()
+            if not data and offset > 0:
+                break
+            md5.update(data)
+            if (
+                eof
+                and offset == 0
+                and len(data) <= self.save_inside_limit
+                and not is_append
+            ):
+                small_content = data
+                offset = len(data)
+                break
+            if not data:  # empty file: an entry with no chunks
+                break
+            chunk = await self._upload_chunk(
+                data, offset, filename or path.rsplit("/", 1)[-1],
+                collection, replication, ttl_str,
+            )
+            chunks.append(chunk)
+            offset += len(data)
+
+        if is_append:
+            entry = await self.filer.append_chunks(path, chunks)
+            size = entry.size()
+        else:
+            # fold huge chunk lists into manifests before saving metadata
+            if len(chunks) > 1000:
+                chunks = await self._manifestize_async(
+                    chunks, collection, replication
+                )
+            now = int(time.time())
+            mode = int(q.get("mode", "0660"), 8)
+            entry = Entry(
+                full_path=path,
+                attr=Attr(
+                    mtime=now, crtime=now, mode=mode,
+                    uid=0, gid=0, mime=content_type,
+                    ttl_sec=ttl_sec, md5=md5.digest(), file_size=offset,
+                ),
+                chunks=chunks,
+                content=small_content,
+            )
+            old_chunks = []
+            try:
+                old_chunks = list(self.filer.find_entry(path).chunks)
+            except NotFoundError:
+                pass
+            await self.filer.create_entry(entry)
+            if old_chunks:
+                await self.filer.delete_unused_chunks(old_chunks, chunks)
+            size = offset
+
+        return web.json_response(
+            {"name": path.rsplit("/", 1)[-1], "size": size},
+            status=201,
+            headers={"Content-MD5": base64.b64encode(md5.digest()).decode()},
+        )
+
+    async def _manifestize_async(self, chunks, collection, replication):
+        """Async wrapper: pre-upload manifest blobs then fold the list."""
+        uploaded: dict[bytes, filer_pb2.FileChunk] = {}
+
+        def save(blob: bytes) -> filer_pb2.FileChunk:
+            return uploaded[blob]
+
+        # first pass to learn which blobs are needed
+        pending: list[bytes] = []
+
+        def collect(blob: bytes) -> filer_pb2.FileChunk:
+            pending.append(blob)
+            return filer_pb2.FileChunk(file_id="pending")
+
+        maybe_manifestize(collect, chunks)
+        for blob in pending:
+            uploaded[blob] = await self._upload_chunk(blob, 0, "manifest", collection, replication)
+        return maybe_manifestize(save, chunks)
+
+    async def h_delete(self, request: web.Request) -> web.Response:
+        path, _ = self._req_path(request)
+        q = request.query
+        try:
+            await self.filer.delete_entry_meta_and_data(
+                path,
+                is_recursive=q.get("recursive") == "true",
+                ignore_recursive_error=q.get("ignoreRecursiveError") == "true",
+                is_delete_data=q.get("skipChunkDeletion") != "true",
+            )
+        except NotFoundError:
+            raise web.HTTPNotFound()
+        except NotEmptyError as e:
+            raise web.HTTPConflict(text=str(e))
+        return web.Response(status=204)
+
+    # -------------------------------------------------------- gRPC service
+
+    async def LookupDirectoryEntry(self, request, context):
+        try:
+            entry = self.filer.find_entry(
+                new_full_path(request.directory, request.name)
+            )
+        except NotFoundError:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "not found")
+        return filer_pb2.LookupDirectoryEntryResponse(entry=entry.to_pb())
+
+    async def ListEntries(self, request, context):
+        remaining = request.limit or (1 << 31)
+        start = request.start_from_file_name
+        inclusive = request.inclusive_start_from
+        while remaining > 0:
+            ask = min(remaining, 1024)
+            batch = self.filer.list_directory_entries(
+                request.directory,
+                start_file_name=start,
+                include_start=inclusive,
+                limit=ask,
+                prefix=request.prefix,
+            )
+            for e in batch:
+                yield filer_pb2.ListEntriesResponse(entry=e.to_pb())
+            if len(batch) < ask:
+                return
+            remaining -= len(batch)
+            start, inclusive = batch[-1].name, False
+
+    async def CreateEntry(self, request, context):
+        entry = Entry.from_pb(request.directory, request.entry)
+        old_chunks: list = []
+        try:
+            old_chunks = list(self.filer.find_entry(entry.full_path).chunks)
+        except NotFoundError:
+            pass
+        try:
+            await self.filer.create_entry(
+                entry,
+                o_excl=request.o_excl,
+                is_from_other_cluster=request.is_from_other_cluster,
+                signatures=list(request.signatures),
+            )
+        except FilerError as e:
+            return filer_pb2.CreateEntryResponse(error=str(e))
+        if old_chunks:
+            await self.filer.delete_unused_chunks(old_chunks, entry.chunks)
+        return filer_pb2.CreateEntryResponse()
+
+    async def UpdateEntry(self, request, context):
+        entry = Entry.from_pb(request.directory, request.entry)
+        old = None
+        try:
+            old = self.filer.find_entry(entry.full_path)
+        except NotFoundError:
+            pass
+        await self.filer.update_entry(old, entry)
+        if old is not None:
+            await self.filer.delete_unused_chunks(old.chunks, entry.chunks)
+        return filer_pb2.UpdateEntryResponse()
+
+    async def AppendToEntry(self, request, context):
+        await self.filer.append_chunks(
+            new_full_path(request.directory, request.entry_name),
+            list(request.chunks),
+        )
+        return filer_pb2.AppendToEntryResponse()
+
+    async def DeleteEntry(self, request, context):
+        try:
+            await self.filer.delete_entry_meta_and_data(
+                new_full_path(request.directory, request.name),
+                is_recursive=request.is_recursive,
+                ignore_recursive_error=request.ignore_recursive_error,
+                is_delete_data=request.is_delete_data,
+                signatures=list(request.signatures),
+            )
+        except NotFoundError:
+            return filer_pb2.DeleteEntryResponse()
+        except NotEmptyError as e:
+            return filer_pb2.DeleteEntryResponse(error=str(e))
+        return filer_pb2.DeleteEntryResponse()
+
+    async def AtomicRenameEntry(self, request, context):
+        try:
+            await self.filer.atomic_rename(
+                request.old_directory,
+                request.old_name,
+                request.new_directory,
+                request.new_name,
+                signatures=list(request.signatures),
+            )
+        except NotFoundError:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "source not found")
+        return filer_pb2.AtomicRenameEntryResponse()
+
+    async def AssignVolume(self, request, context):
+        try:
+            a = await self._assign(
+                max(request.count, 1),
+                request.collection,
+                request.replication,
+                _seconds_to_ttl(request.ttl_sec),
+                request.data_center,
+            )
+        except Exception as e:  # noqa: BLE001
+            return filer_pb2.AssignVolumeResponse(error=str(e))
+        return filer_pb2.AssignVolumeResponse(
+            file_id=a.fid,
+            count=a.count,
+            collection=request.collection or self.collection,
+            replication=request.replication or self.replication,
+            location=filer_pb2.Location(
+                url=a.url, public_url=a.public_url, grpc_port=a.grpc_port
+            ),
+        )
+
+    async def LookupVolume(self, request, context):
+        resp = filer_pb2.LookupVolumeResponse()
+        for vid_str in request.volume_ids:
+            vid = int(vid_str.split(",")[0])
+            locs = await self.master_client.lookup_or_fetch(vid)
+            resp.locations_map[vid_str].CopyFrom(
+                filer_pb2.Locations(
+                    locations=[
+                        filer_pb2.Location(
+                            url=l.url, public_url=l.public_url, grpc_port=l.grpc_port
+                        )
+                        for l in locs
+                    ]
+                )
+            )
+        return resp
+
+    async def CollectionList(self, request, context):
+        stub = self._master_stub()
+        resp = await stub.CollectionList(
+            master_pb2.CollectionListRequest(
+                include_normal_volumes=request.include_normal_volumes,
+                include_ec_volumes=request.include_ec_volumes,
+            )
+        )
+        return filer_pb2.CollectionListResponse(
+            collections=[filer_pb2.Collection(name=c.name) for c in resp.collections]
+        )
+
+    async def DeleteCollection(self, request, context):
+        stub = self._master_stub()
+        await stub.CollectionDelete(
+            master_pb2.CollectionDeleteRequest(name=request.collection)
+        )
+        return filer_pb2.DeleteCollectionResponse()
+
+    async def Statistics(self, request, context):
+        stub = self._master_stub()
+        resp = await stub.Statistics(
+            master_pb2.StatisticsRequest(
+                replication=request.replication,
+                collection=request.collection,
+                ttl=request.ttl,
+                disk_type=request.disk_type,
+            )
+        )
+        return filer_pb2.StatisticsResponse(
+            total_size=resp.total_size,
+            used_size=resp.used_size,
+            file_count=resp.file_count,
+        )
+
+    async def GetFilerConfiguration(self, request, context):
+        return filer_pb2.GetFilerConfigurationResponse(
+            masters=self.masters,
+            replication=self.replication,
+            collection=self.collection,
+            max_mb=self.max_mb,
+            dir_buckets=self.dir_buckets,
+        )
+
+    async def SubscribeMetadata(self, request, context):
+        async for ev in self.filer.meta_log.subscribe(
+            since_ns=request.since_ns, path_prefix=request.path_prefix
+        ):
+            sigs = ev.event_notification.signatures
+            if request.signature and request.signature in sigs:
+                continue  # originated from this subscriber — loop guard
+            yield ev
+
+    async def KvGet(self, request, context):
+        try:
+            value = self.filer.store.kv_get(bytes(request.key))
+        except NotFoundError:
+            return filer_pb2.KvGetResponse()
+        return filer_pb2.KvGetResponse(value=value)
+
+    async def KvPut(self, request, context):
+        self.filer.store.kv_put(bytes(request.key), bytes(request.value))
+        return filer_pb2.KvPutResponse()
+
+    def _master_stub(self):
+        return Stub(
+            channel(server_address.grpc_address(self.master_client.current_master)),
+            master_pb2,
+            "Seaweed",
+        )
+
+
+def _seconds_to_ttl(sec: int) -> str:
+    """Seconds → the master's TTL string units (m/h/d/w; rounds up to a
+    minute — the reference's needle.SecondsToTTL does the same)."""
+    if sec <= 0:
+        return ""
+    if sec % 86400 == 0:
+        return f"{sec // 86400}d"
+    if sec % 3600 == 0:
+        return f"{sec // 3600}h"
+    return f"{max(1, (sec + 59) // 60)}m"
+
+
+def _entry_json(e: Entry) -> dict:
+    return {
+        "FullPath": e.full_path,
+        "Mtime": e.attr.mtime,
+        "Crtime": e.attr.crtime,
+        "Mode": e.attr.mode,
+        "Uid": e.attr.uid,
+        "Gid": e.attr.gid,
+        "Mime": e.attr.mime,
+        "TtlSec": e.attr.ttl_sec,
+        "FileSize": e.size(),
+        "IsDirectory": e.is_directory,
+        "Md5": base64.b64encode(e.attr.md5).decode() if e.attr.md5 else "",
+    }

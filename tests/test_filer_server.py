@@ -1,0 +1,329 @@
+"""FilerServer e2e: a live in-process master + volume servers + filer,
+exercising auto-chunked writes, range reads through chunk resolution,
+overwrites, appends, directory ops, gRPC CRUD/rename, and metadata
+subscription (reference e2e shape: docker compose + fio over the filer)."""
+import asyncio
+import hashlib
+import os
+
+import aiohttp
+import pytest
+
+from seaweedfs_tpu.filer import SqliteStore
+from seaweedfs_tpu.pb import Stub, channel, filer_pb2
+from seaweedfs_tpu.server.cluster import LocalCluster
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def make_cluster(tmp_path, **filer_kwargs):
+    cluster = LocalCluster(
+        base_dir=str(tmp_path), n_volume_servers=2, with_filer=True,
+        filer_kwargs=filer_kwargs,
+    )
+    await cluster.start()
+    return cluster
+
+
+async def put(base, path, data: bytes, **params):
+    async with aiohttp.ClientSession() as s:
+        async with s.put(f"http://{base}{path}", data=data, params=params) as r:
+            return r.status, await r.json() if r.status < 300 else await r.read()
+
+
+async def get(base, path, headers=None):
+    async with aiohttp.ClientSession() as s:
+        async with s.get(f"http://{base}{path}", headers=headers or {}) as r:
+            return r.status, await r.read(), dict(r.headers)
+
+
+def test_filer_write_read_e2e(tmp_path):
+    async def go():
+        cluster = await make_cluster(tmp_path, max_mb=1)
+        f = cluster.filer
+        base = f.url
+        try:
+            # 5MB file → 5 chunks of 1MB
+            payload = os.urandom(5 * 1024 * 1024 + 123)
+            status, reply = await put(base, "/dir/big.bin", payload)
+            assert status == 201, reply
+            assert reply["size"] == len(payload)
+            entry = f.filer.find_entry("/dir/big.bin")
+            assert len(entry.chunks) == 6  # 5 full + 1 tail
+            assert entry.attr.md5 == hashlib.md5(payload).digest()
+
+            # full read
+            status, body, hdrs = await get(base, "/dir/big.bin")
+            assert status == 200 and body == payload
+            # range read across a chunk boundary
+            status, body, hdrs = await get(
+                base, "/dir/big.bin",
+                {"Range": "bytes=1048000-1049000"},
+            )
+            assert status == 206
+            assert body == payload[1048000:1049001]
+            # suffix range
+            status, body, _ = await get(base, "/dir/big.bin", {"Range": "bytes=-100"})
+            assert status == 206 and body == payload[-100:]
+
+            # overwrite shadows earlier chunks and frees them
+            payload2 = os.urandom(1024)
+            status, reply = await put(base, "/dir/big.bin", payload2)
+            assert status == 201
+            status, body, _ = await get(base, "/dir/big.bin")
+            assert body == payload2
+
+            # append op
+            status, _ = await put(base, "/dir/log.bin", b"aaaa")
+            status, _ = await put(base, "/dir/log.bin", b"bbbb", op="append")
+            status, body, _ = await get(base, "/dir/log.bin")
+            assert body == b"aaaabbbb"
+
+            # directory listing
+            status, body, _ = await get(base, "/dir")
+            import json
+
+            listing = json.loads(body)
+            names = [e["FullPath"].rsplit("/", 1)[-1] for e in listing["Entries"]]
+            assert names == ["big.bin", "log.bin"]
+
+            # delete
+            async with aiohttp.ClientSession() as s:
+                async with s.delete(f"http://{base}/dir/big.bin") as r:
+                    assert r.status == 204
+            status, _, _ = await get(base, "/dir/big.bin")
+            assert status == 404
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_filer_small_content_inline_and_mkdir(tmp_path):
+    async def go():
+        cluster = await make_cluster(tmp_path, save_inside_limit=1024)
+        f = cluster.filer
+        base = f.url
+        try:
+            status, _ = await put(base, "/inline.txt", b"tiny payload")
+            assert status == 201
+            entry = f.filer.find_entry("/inline.txt")
+            assert entry.content == b"tiny payload" and not entry.chunks
+            status, body, _ = await get(base, "/inline.txt")
+            assert body == b"tiny payload"
+            status, body, _ = await get(base, "/inline.txt", {"Range": "bytes=2-5"})
+            assert status == 206 and body == b"ny p"
+
+            # empty file
+            status, _ = await put(base, "/empty", b"")
+            assert status == 201
+            status, body, _ = await get(base, "/empty")
+            assert status == 200 and body == b""
+
+            # mkdir via POST with trailing slash
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"http://{base}/newdir/", skip_auto_headers=["Content-Type"]) as r:
+                    assert r.status == 201
+            assert f.filer.find_entry("/newdir").is_directory
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_filer_grpc_crud_rename_subscribe(tmp_path):
+    async def go():
+        cluster = await make_cluster(
+            tmp_path, store=SqliteStore(str(tmp_path / "meta.db"))
+        )
+        f = cluster.filer
+        stub = Stub(
+            channel(f"{f.ip}:{f.grpc_port}"), filer_pb2, "SeaweedFiler"
+        )
+        try:
+            # subscribe from the beginning
+            events = []
+
+            async def subscriber():
+                async for resp in stub.SubscribeMetadata(
+                    filer_pb2.SubscribeMetadataRequest(client_name="t", since_ns=0)
+                ):
+                    events.append(resp)
+
+            sub_task = asyncio.create_task(subscriber())
+
+            # CreateEntry
+            resp = await stub.CreateEntry(
+                filer_pb2.CreateEntryRequest(
+                    directory="/g",
+                    entry=filer_pb2.Entry(
+                        name="f1",
+                        attributes=filer_pb2.FuseAttributes(
+                            file_mode=0o660, file_size=3
+                        ),
+                        content=b"abc",
+                    ),
+                )
+            )
+            assert resp.error == ""
+            # Lookup
+            resp = await stub.LookupDirectoryEntry(
+                filer_pb2.LookupDirectoryEntryRequest(directory="/g", name="f1")
+            )
+            assert resp.entry.name == "f1" and resp.entry.content == b"abc"
+            # ListEntries streaming
+            got = []
+            async for r in stub.ListEntries(
+                filer_pb2.ListEntriesRequest(directory="/g")
+            ):
+                got.append(r.entry.name)
+            assert got == ["f1"]
+            # AtomicRenameEntry
+            await stub.AtomicRenameEntry(
+                filer_pb2.AtomicRenameEntryRequest(
+                    old_directory="/g", old_name="f1",
+                    new_directory="/h/deep", new_name="f2",
+                )
+            )
+            resp = await stub.LookupDirectoryEntry(
+                filer_pb2.LookupDirectoryEntryRequest(directory="/h/deep", name="f2")
+            )
+            assert resp.entry.content == b"abc"
+            # AssignVolume proxy
+            resp = await stub.AssignVolume(
+                filer_pb2.AssignVolumeRequest(count=1)
+            )
+            assert resp.file_id and resp.location.url
+            # KV
+            await stub.KvPut(filer_pb2.KvPutRequest(key=b"k", value=b"v"))
+            resp = await stub.KvGet(filer_pb2.KvGetRequest(key=b"k"))
+            assert resp.value == b"v"
+            # DeleteEntry
+            resp = await stub.DeleteEntry(
+                filer_pb2.DeleteEntryRequest(
+                    directory="/h", name="deep", is_recursive=True,
+                    is_delete_data=True,
+                )
+            )
+            assert resp.error == ""
+            # events flowed: create f1 + rename events + delete
+            await asyncio.sleep(0.2)
+            sub_task.cancel()
+            assert len(events) >= 3
+            dirs = {e.directory for e in events}
+            assert "/g" in dirs
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_filer_100mb_roundtrip_with_range_reads(tmp_path):
+    """VERDICT round-1 done-criterion: write a 100MB file through the filer
+    in chunks; read arbitrary ranges back through chunk resolution."""
+
+    async def go():
+        cluster = await make_cluster(tmp_path, max_mb=4)
+        base = cluster.filer.url
+        try:
+            import random
+
+            rng = random.Random(42)
+            # deterministic pseudo-random 100MB without holding two copies
+            block = rng.randbytes(1024 * 1024)
+            n_blocks = 100
+            payload = block * n_blocks  # 100MB, repeating — ranges still unique offsets
+            status, reply = await put(base, "/big/hundred.bin", payload)
+            assert status == 201 and reply["size"] == len(payload)
+            entry = cluster.filer.filer.find_entry("/big/hundred.bin")
+            assert len(entry.chunks) == 25  # 100MB / 4MB
+
+            for _ in range(8):
+                start = rng.randrange(0, len(payload) - 1)
+                stop = min(start + rng.randrange(1, 6 * 1024 * 1024), len(payload) - 1)
+                status, body, _ = await get(
+                    base, "/big/hundred.bin", {"Range": f"bytes={start}-{stop}"}
+                )
+                assert status == 206
+                assert body == payload[start : stop + 1], (start, stop)
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_filer_review_regressions(tmp_path):
+    """Round-2 code-review findings: ?ttl= uploads, deleted-dir recreation
+    (stale dir cache), inline-content + append reads, gRPC overwrite GC."""
+
+    async def go():
+        cluster = await make_cluster(tmp_path, save_inside_limit=64)
+        f = cluster.filer
+        base = f.url
+        try:
+            # ttl param must parse master units (no 's' unit) and stick
+            status, _ = await put(base, "/ttl.bin", os.urandom(200), ttl="5m")
+            assert status == 201
+            assert f.filer.find_entry("/ttl.bin").attr.ttl_sec == 300
+
+            # recreate a file under a deleted directory
+            status, _ = await put(base, "/dc/f1", b"one")
+            async with aiohttp.ClientSession() as s:
+                async with s.delete(f"http://{base}/dc?recursive=true") as r:
+                    assert r.status == 204
+            status, _ = await put(base, "/dc/f2", b"two")
+            assert status == 201
+            assert f.filer.find_entry("/dc").is_directory  # parent re-created
+            status, body, _ = await get(base, "/dc")
+            assert status == 200
+
+            # inline content then append: both halves served
+            status, _ = await put(base, "/mix", b"tiny")  # inlined (<=64)
+            status, _ = await put(base, "/mix", os.urandom(100), op="append")
+            status, body, _ = await get(base, "/mix")
+            assert status == 200 and len(body) == 104 and body[:4] == b"tiny"
+
+            # gRPC CreateEntry overwrite frees orphaned chunks
+            status, _ = await put(base, "/gc.bin", os.urandom(200000))
+            old_fid = f.filer.find_entry("/gc.bin").chunks[0].file_id
+            stub = Stub(channel(f"{f.ip}:{f.grpc_port}"), filer_pb2, "SeaweedFiler")
+            await stub.CreateEntry(
+                filer_pb2.CreateEntryRequest(
+                    directory="/",
+                    entry=filer_pb2.Entry(name="gc.bin", content=b"small now"),
+                )
+            )
+            await asyncio.sleep(0.3)
+            async with aiohttp.ClientSession() as s:
+                urls = []
+                from seaweedfs_tpu.operation import lookup_file_id
+
+                urls = await lookup_file_id(
+                    cluster.master.advertise_url, old_fid
+                )
+                async with s.get(urls[0]) as r:
+                    assert r.status == 404  # chunk was deleted
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_filer_grpc_configuration(tmp_path):
+    async def go():
+        cluster = await make_cluster(tmp_path)
+        f = cluster.filer
+        stub = Stub(channel(f"{f.ip}:{f.grpc_port}"), filer_pb2, "SeaweedFiler")
+        try:
+            resp = await stub.GetFilerConfiguration(
+                filer_pb2.GetFilerConfigurationRequest()
+            )
+            assert resp.max_mb == 4 and resp.dir_buckets == "/buckets"
+            stats = await stub.Statistics(filer_pb2.StatisticsRequest())
+            assert stats.total_size >= 0
+        finally:
+            await cluster.stop()
+
+    run(go())
