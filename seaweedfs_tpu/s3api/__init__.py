@@ -1,0 +1,18 @@
+"""S3 gateway (reference weed/s3api/, 12.8k LoC): SigV4 auth, bucket and
+object APIs, multipart uploads — all backed by the filer namespace."""
+from .auth import (
+    Identity,
+    IdentityAccessManagement,
+    S3AuthError,
+    sign_request_headers,
+)
+from .server import S3ApiServer, S3Error
+
+__all__ = [
+    "Identity",
+    "IdentityAccessManagement",
+    "S3ApiServer",
+    "S3AuthError",
+    "S3Error",
+    "sign_request_headers",
+]

@@ -1,0 +1,941 @@
+"""S3 API gateway over the filer.
+
+Reference: weed/s3api/s3api_server.go:93-250 (route table),
+s3api_object_handlers.go, s3api_bucket_handlers.go, filer_multipart.go
+(metadata-only multipart compose), s3api_object_handlers_list.go.
+
+Objects live in the filer namespace at {buckets_path}/{bucket}/{key};
+object data moves through the filer's HTTP data plane (so auto-chunking
+and streaming range reads are reused), metadata ops go over the filer's
+gRPC surface.  Multipart parts are staged under
+{buckets_path}/{bucket}/.uploads/{uploadId}/ and completion just
+concatenates the parts' chunk lists into the final entry — no data copy.
+"""
+from __future__ import annotations
+
+import asyncio
+import base64
+import hashlib
+import logging
+import time
+import urllib.parse
+import uuid
+import xml.etree.ElementTree as ET
+
+import aiohttp
+import grpc
+from aiohttp import web
+
+from ..pb import Stub, filer_pb2
+from ..pb.rpc import GRPC_OPTIONS, channel
+from .auth import (
+    ACTION_ADMIN,
+    ACTION_LIST,
+    ACTION_READ,
+    ACTION_WRITE,
+    STREAMING_PAYLOAD,
+    IdentityAccessManagement,
+    S3AuthError,
+    decode_aws_chunked,
+    verify_payload_hash,
+)
+
+log = logging.getLogger("s3api")
+
+S3_XMLNS = "http://s3.amazonaws.com/doc/2006-03-01/"
+UPLOADS_DIR = ".uploads"
+
+
+class S3Error(Exception):
+    def __init__(self, code: str, message: str, status: int):
+        super().__init__(message)
+        self.code = code
+        self.status = status
+
+
+ERR_NO_SUCH_BUCKET = ("NoSuchBucket", "The specified bucket does not exist", 404)
+ERR_NO_SUCH_KEY = ("NoSuchKey", "The specified key does not exist", 404)
+ERR_NO_SUCH_UPLOAD = ("NoSuchUpload", "The specified upload does not exist", 404)
+ERR_BUCKET_NOT_EMPTY = ("BucketNotEmpty", "The bucket you tried to delete is not empty", 409)
+ERR_BUCKET_EXISTS = ("BucketAlreadyExists", "The requested bucket name is not available", 409)
+
+
+class S3ApiServer:
+    def __init__(
+        self,
+        filer_address: str,  # host:port (HTTP); gRPC = +10000 or explicit
+        filer_grpc_address: str = "",
+        ip: str = "127.0.0.1",
+        port: int = 8333,
+        buckets_path: str = "/buckets",
+        iam: IdentityAccessManagement | None = None,
+    ):
+        self.filer_address = filer_address
+        host, _, p = filer_address.partition(":")
+        self.filer_grpc_address = filer_grpc_address or f"{host}:{int(p) + 10000}"
+        self.ip = ip
+        self.port = port
+        self.buckets_path = buckets_path
+        self.iam = iam or IdentityAccessManagement()
+        self._http_runner: web.AppRunner | None = None
+        self._session: aiohttp.ClientSession | None = None
+        self._stub_cache = None
+
+    @property
+    def url(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    def _stub(self):
+        if self._stub_cache is None:
+            self._stub_cache = Stub(
+                channel(self.filer_grpc_address), filer_pb2, "SeaweedFiler"
+            )
+        return self._stub_cache
+
+    async def start(self) -> None:
+        self._session = aiohttp.ClientSession()
+        app = web.Application(client_max_size=1024 * 1024 * 1024)
+        app.router.add_route("*", "/{tail:.*}", self._dispatch)
+        self._http_runner = web.AppRunner(app)
+        await self._http_runner.setup()
+        site = web.TCPSite(self._http_runner, self.ip, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        log.info("s3 gateway listening on %s", self.port)
+
+    async def stop(self) -> None:
+        if self._http_runner:
+            await self._http_runner.cleanup()
+        if self._session:
+            await self._session.close()
+
+    # -------------------------------------------------------------- routing
+
+    async def _dispatch(self, request: web.Request) -> web.StreamResponse:
+        try:
+            identity = self.iam.authenticate(request)
+            body = await verify_payload_hash(request)
+            if body is not None:
+                request["s3_body"] = body
+        except S3AuthError as e:
+            return _error_response(e.code, str(e), e.status)
+
+        tail = request.match_info["tail"]
+        bucket, _, key = tail.partition("/")
+        q = request.query
+        m = request.method
+
+        err = _validate_names(bucket, key)
+        if err:
+            return _error_response("InvalidArgument", err, 400)
+
+        def allowed(action: str) -> bool:
+            return identity is None or identity.can_do(action, bucket)
+
+        try:
+            if not bucket:
+                if m == "GET":
+                    return await self.list_buckets(identity)
+                raise S3Error("MethodNotAllowed", "bad request", 405)
+            if not key:
+                bucket_action = ACTION_LIST
+                if m in ("PUT", "DELETE"):
+                    bucket_action = ACTION_ADMIN
+                elif m == "POST" and "delete" in q:
+                    bucket_action = ACTION_WRITE
+                if not allowed(bucket_action):
+                    raise S3Error("AccessDenied", "access denied", 403)
+                if m == "PUT":
+                    return await self.put_bucket(bucket)
+                if m == "HEAD":
+                    return await self.head_bucket(bucket)
+                if m == "DELETE":
+                    return await self.delete_bucket(bucket)
+                if m == "GET" and "uploads" in q:
+                    return await self.list_multipart_uploads(bucket, q)
+                if m == "GET":
+                    return await self.list_objects(bucket, q)
+                if m == "POST" and "delete" in q:
+                    return await self.delete_multiple_objects(bucket, request)
+                raise S3Error("MethodNotAllowed", "bad request", 405)
+            # object-level
+            write_like = m in ("PUT", "POST", "DELETE")
+            if not allowed(ACTION_WRITE if write_like else ACTION_READ):
+                raise S3Error("AccessDenied", "access denied", 403)
+            if m == "POST" and "uploads" in q:
+                return await self.create_multipart_upload(bucket, key, request)
+            if m == "POST" and "uploadId" in q:
+                return await self.complete_multipart_upload(bucket, key, q["uploadId"], request)
+            if m == "PUT" and "partNumber" in q and "uploadId" in q:
+                return await self.upload_part(bucket, key, q["uploadId"], int(q["partNumber"]), request)
+            if m == "DELETE" and "uploadId" in q:
+                return await self.abort_multipart_upload(bucket, q["uploadId"])
+            if m == "GET" and "uploadId" in q:
+                return await self.list_parts(bucket, key, q["uploadId"], q)
+            if m == "PUT" and "tagging" in q:
+                return await self.put_object_tagging(bucket, key, request)
+            if m == "GET" and "tagging" in q:
+                return await self.get_object_tagging(bucket, key)
+            if m == "DELETE" and "tagging" in q:
+                return await self.delete_object_tagging(bucket, key)
+            if m == "PUT" and "x-amz-copy-source" in request.headers:
+                return await self.copy_object(bucket, key, request)
+            if m == "PUT":
+                return await self.put_object(bucket, key, request)
+            if m in ("GET", "HEAD"):
+                return await self.get_object(bucket, key, request)
+            if m == "DELETE":
+                return await self.delete_object(bucket, key)
+            raise S3Error("MethodNotAllowed", "bad request", 405)
+        except S3Error as e:
+            return _error_response(e.code, str(e), e.status)
+        except grpc.aio.AioRpcError as e:
+            if e.code() == grpc.StatusCode.NOT_FOUND:
+                return _error_response(*ERR_NO_SUCH_KEY)
+            log.exception("filer rpc failed")
+            return _error_response("InternalError", e.details() or "rpc error", 500)
+
+    # -------------------------------------------------------------- buckets
+
+    async def _bucket_exists(self, bucket: str) -> bool:
+        try:
+            await self._stub().LookupDirectoryEntry(
+                filer_pb2.LookupDirectoryEntryRequest(
+                    directory=self.buckets_path, name=bucket
+                )
+            )
+            return True
+        except grpc.aio.AioRpcError:
+            return False
+
+    async def list_buckets(self, identity) -> web.Response:
+        entries = []
+        async for r in self._stub().ListEntries(
+            filer_pb2.ListEntriesRequest(directory=self.buckets_path, limit=10000)
+        ):
+            if r.entry.is_directory:
+                entries.append(r.entry)
+        root = _el("ListAllMyBucketsResult")
+        owner = ET.SubElement(root, "Owner")
+        ET.SubElement(owner, "ID").text = identity.name if identity else "anonymous"
+        buckets = ET.SubElement(root, "Buckets")
+        for e in entries:
+            if identity is not None and not identity.can_do(ACTION_LIST, e.name):
+                continue
+            b = ET.SubElement(buckets, "Bucket")
+            ET.SubElement(b, "Name").text = e.name
+            ET.SubElement(b, "CreationDate").text = _iso(e.attributes.crtime)
+        return _xml_response(root)
+
+    async def put_bucket(self, bucket: str) -> web.Response:
+        if await self._bucket_exists(bucket):
+            raise S3Error(*ERR_BUCKET_EXISTS)
+        resp = await self._stub().CreateEntry(
+            filer_pb2.CreateEntryRequest(
+                directory=self.buckets_path,
+                entry=filer_pb2.Entry(
+                    name=bucket,
+                    is_directory=True,
+                    attributes=filer_pb2.FuseAttributes(
+                        crtime=int(time.time()), file_mode=0o770
+                    ),
+                ),
+            )
+        )
+        if resp.error:
+            raise S3Error("InternalError", resp.error, 500)
+        return web.Response(status=200, headers={"Location": f"/{bucket}"})
+
+    async def head_bucket(self, bucket: str) -> web.Response:
+        if not await self._bucket_exists(bucket):
+            raise S3Error(*ERR_NO_SUCH_BUCKET)
+        return web.Response(status=200)
+
+    async def delete_bucket(self, bucket: str) -> web.Response:
+        if not await self._bucket_exists(bucket):
+            raise S3Error(*ERR_NO_SUCH_BUCKET)
+        # only objects (files) count as content — empty directory husks
+        # left by deleted keys don't exist in the S3 data model
+        if await self._has_objects(f"{self.buckets_path}/{bucket}", top=True):
+            raise S3Error(*ERR_BUCKET_NOT_EMPTY)
+        await self._stub().DeleteEntry(
+            filer_pb2.DeleteEntryRequest(
+                directory=self.buckets_path,
+                name=bucket,
+                is_delete_data=True,
+                is_recursive=True,
+            )
+        )
+        return web.Response(status=204)
+
+    async def _has_objects(self, directory: str, top: bool = False) -> bool:
+        async for r in self._stub().ListEntries(
+            filer_pb2.ListEntriesRequest(directory=directory)
+        ):
+            e = r.entry
+            if top and e.name == UPLOADS_DIR:
+                continue
+            if not e.is_directory:
+                return True
+            if await self._has_objects(f"{directory}/{e.name}"):
+                return True
+        return False
+
+    # -------------------------------------------------------------- objects
+
+    def _object_url(self, bucket: str, key: str) -> str:
+        return (
+            f"http://{self.filer_address}{self.buckets_path}/{bucket}/"
+            + urllib.parse.quote(key)
+        )
+
+    async def _body(self, request: web.Request):
+        """Request payload for PUT/POST: the auth layer's verified bytes if
+        the payload hash was signed, aws-chunked frames decoded, else the
+        raw stream."""
+        if "s3_body" in request:
+            return request["s3_body"]
+        if (
+            request.headers.get("x-amz-content-sha256") == STREAMING_PAYLOAD
+            or "aws-chunked" in request.headers.get("Content-Encoding", "")
+        ):
+            return decode_aws_chunked(await request.read())
+        return request.content
+
+    async def put_object(self, bucket: str, key: str, request: web.Request) -> web.Response:
+        if not await self._bucket_exists(bucket):
+            raise S3Error(*ERR_NO_SUCH_BUCKET)
+        if key.endswith("/"):
+            # directory marker ("create folder"): a real directory entry,
+            # not a zero-byte file that would shadow the prefix
+            d, n = _split_key(f"{self.buckets_path}/{bucket}/{key.rstrip('/')}")
+            await self._stub().CreateEntry(
+                filer_pb2.CreateEntryRequest(
+                    directory=d,
+                    entry=filer_pb2.Entry(
+                        name=n,
+                        is_directory=True,
+                        attributes=filer_pb2.FuseAttributes(
+                            crtime=int(time.time()), file_mode=0o770
+                        ),
+                    ),
+                )
+            )
+            return web.Response(
+                status=200, headers={"ETag": f'"{hashlib.md5(b"").hexdigest()}"'}
+            )
+        data = await self._body(request)
+        headers = {}
+        if request.headers.get("Content-Type"):
+            headers["Content-Type"] = request.headers["Content-Type"]
+        if isinstance(data, (bytes, bytearray)):
+            headers["Content-Length"] = str(len(data))
+        elif request.content_length is not None:
+            headers["Content-Length"] = str(request.content_length)
+        async with self._session.put(
+            self._object_url(bucket, key), data=data, headers=headers
+        ) as r:
+            if r.status >= 300:
+                raise S3Error("InternalError", await r.text(), 500)
+            md5_b64 = r.headers.get("Content-MD5", "")
+        etag = base64.b64decode(md5_b64).hex() if md5_b64 else ""
+        tagging = request.headers.get("X-Amz-Tagging", "")
+        amz_meta = {
+            k.lower(): v
+            for k, v in request.headers.items()
+            if k.lower().startswith("x-amz-meta-")
+        }
+        if tagging or amz_meta:
+            await self._set_extended(bucket, key, tagging, amz_meta)
+        return web.Response(status=200, headers={"ETag": f'"{etag}"'})
+
+    async def _set_extended(self, bucket, key, tagging: str, amz_meta: dict) -> None:
+        entry = await self._get_entry(bucket, key)
+        for kv in tagging.split("&"):
+            if kv:
+                k, _, v = kv.partition("=")
+                entry.extended[f"x-amz-tag-{urllib.parse.unquote_plus(k)}"] = (
+                    urllib.parse.unquote_plus(v).encode()
+                )
+        for k, v in amz_meta.items():
+            entry.extended[k] = v.encode()
+        d, n = _split_key(f"{self.buckets_path}/{bucket}/{key}")
+        await self._stub().UpdateEntry(
+            filer_pb2.UpdateEntryRequest(directory=d, entry=entry)
+        )
+
+    async def _get_entry(self, bucket: str, key: str) -> filer_pb2.Entry:
+        d, n = _split_key(f"{self.buckets_path}/{bucket}/{key}")
+        try:
+            resp = await self._stub().LookupDirectoryEntry(
+                filer_pb2.LookupDirectoryEntryRequest(directory=d, name=n)
+            )
+        except grpc.aio.AioRpcError:
+            raise S3Error(*ERR_NO_SUCH_KEY)
+        return resp.entry
+
+    async def get_object(self, bucket: str, key: str, request: web.Request) -> web.StreamResponse:
+        entry = await self._get_entry(bucket, key)
+        if entry.is_directory:
+            raise S3Error(*ERR_NO_SUCH_KEY)
+        headers = {}
+        if "Range" in request.headers:
+            headers["Range"] = request.headers["Range"]
+        async with self._session.request(
+            request.method, self._object_url(bucket, key), headers=headers
+        ) as r:
+            if r.status == 404:
+                raise S3Error(*ERR_NO_SUCH_KEY)
+            out_headers = {
+                "ETag": f'"{_entry_etag(entry)}"',
+                "Accept-Ranges": "bytes",
+                "Content-Length": r.headers.get("Content-Length", "0"),
+                "Last-Modified": r.headers.get("Last-Modified", ""),
+            }
+            if r.headers.get("Content-Range"):
+                out_headers["Content-Range"] = r.headers["Content-Range"]
+            for k, v in entry.extended.items():
+                if k.startswith("x-amz-meta-"):
+                    out_headers[k] = v.decode()
+            resp = web.StreamResponse(status=r.status, headers=out_headers)
+            resp.content_type = r.content_type or "application/octet-stream"
+            await resp.prepare(request)
+            if request.method != "HEAD":
+                async for piece in r.content.iter_chunked(1 << 20):
+                    await resp.write(piece)
+            await resp.write_eof()
+            return resp
+
+    async def delete_object(self, bucket: str, key: str) -> web.Response:
+        """S3 delete is idempotent and only removes the named object —
+        never a prefix subtree that happens to share the name."""
+        is_marker = key.endswith("/")
+        d, n = _split_key(f"{self.buckets_path}/{bucket}/{key.rstrip('/')}")
+        try:
+            resp = await self._stub().LookupDirectoryEntry(
+                filer_pb2.LookupDirectoryEntryRequest(directory=d, name=n)
+            )
+        except grpc.aio.AioRpcError:
+            return web.Response(status=204)  # already gone
+        entry = resp.entry
+        if entry.is_directory and not is_marker:
+            return web.Response(status=204)  # no object by this name
+        if entry.is_directory and await self._has_objects(f"{d}/{n}"):
+            return web.Response(status=204)  # marker of a non-empty prefix
+        await self._stub().DeleteEntry(
+            filer_pb2.DeleteEntryRequest(
+                directory=d,
+                name=n,
+                is_delete_data=True,
+                is_recursive=entry.is_directory,  # empty-marker husks only
+            )
+        )
+        return web.Response(status=204)
+
+    async def copy_object(self, bucket: str, key: str, request: web.Request) -> web.Response:
+        src = urllib.parse.unquote(request.headers["x-amz-copy-source"]).lstrip("/")
+        src_bucket, _, src_key = src.partition("/")
+        src_entry = await self._get_entry(src_bucket, src_key)
+        # stream data filer→filer (chunks must not be shared across entries:
+        # deleting one object would free the other's data)
+        headers = {}
+        mime = src_entry.attributes.mime
+        if request.headers.get("x-amz-metadata-directive", "COPY") == "REPLACE":
+            mime = request.headers.get("Content-Type", "")
+        if mime:
+            headers["Content-Type"] = mime
+        async with self._session.get(self._object_url(src_bucket, src_key)) as r:
+            if r.status >= 300:
+                raise S3Error(*ERR_NO_SUCH_KEY)
+            async with self._session.put(
+                self._object_url(bucket, key), data=r.content, headers=headers
+            ) as w:
+                if w.status >= 300:
+                    raise S3Error("InternalError", await w.text(), 500)
+        # carry over user metadata and tags (AWS metadata-directive COPY)
+        if request.headers.get("x-amz-metadata-directive", "COPY") == "REPLACE":
+            tagging = request.headers.get("X-Amz-Tagging", "")
+            amz_meta = {
+                k.lower(): v
+                for k, v in request.headers.items()
+                if k.lower().startswith("x-amz-meta-")
+            }
+            if tagging or amz_meta:
+                await self._set_extended(bucket, key, tagging, amz_meta)
+        else:
+            copied = {
+                k: bytes(v)
+                for k, v in src_entry.extended.items()
+                if k.startswith(("x-amz-meta-", "x-amz-tag-"))
+            }
+            if copied:
+                entry = await self._get_entry(bucket, key)
+                entry.extended.update(copied)
+                d, _ = _split_key(f"{self.buckets_path}/{bucket}/{key}")
+                await self._stub().UpdateEntry(
+                    filer_pb2.UpdateEntryRequest(directory=d, entry=entry)
+                )
+        entry = await self._get_entry(bucket, key)
+        root = _el("CopyObjectResult")
+        ET.SubElement(root, "ETag").text = f'"{_entry_etag(entry)}"'
+        ET.SubElement(root, "LastModified").text = _iso(entry.attributes.mtime)
+        return _xml_response(root)
+
+    async def delete_multiple_objects(self, bucket: str, request: web.Request) -> web.Response:
+        body = await request.read()
+        doc = ET.fromstring(body)
+        ns = _ns_of(doc)
+        root = _el("DeleteResult")
+        quiet = doc.findtext(f"{ns}Quiet") == "true"
+        for obj in doc.findall(f"{ns}Object"):
+            key = obj.findtext(f"{ns}Key") or ""
+            try:
+                await self.delete_object(bucket, key)
+                if not quiet:
+                    d = ET.SubElement(root, "Deleted")
+                    ET.SubElement(d, "Key").text = key
+            except Exception as e:  # noqa: BLE001
+                err = ET.SubElement(root, "Error")
+                ET.SubElement(err, "Key").text = key
+                ET.SubElement(err, "Message").text = str(e)
+        return _xml_response(root)
+
+    # ------------------------------------------------------------- listing
+
+    async def list_objects(self, bucket: str, q) -> web.Response:
+        if not await self._bucket_exists(bucket):
+            raise S3Error(*ERR_NO_SUCH_BUCKET)
+        v2 = q.get("list-type") == "2"
+        prefix = q.get("prefix", "")
+        delimiter = q.get("delimiter", "")
+        max_keys = int(q.get("max-keys", 1000))
+        if v2:
+            marker = q.get("continuation-token", "") or q.get("start-after", "")
+        else:
+            marker = q.get("marker", "")
+
+        contents, prefixes, truncated, next_marker = await self._walk_keys(
+            bucket, prefix, delimiter, marker, max_keys
+        )
+
+        root = _el("ListBucketResult")
+        ET.SubElement(root, "Name").text = bucket
+        ET.SubElement(root, "Prefix").text = prefix
+        ET.SubElement(root, "MaxKeys").text = str(max_keys)
+        if delimiter:
+            ET.SubElement(root, "Delimiter").text = delimiter
+        ET.SubElement(root, "IsTruncated").text = "true" if truncated else "false"
+        ET.SubElement(root, "KeyCount" if v2 else "Marker").text = (
+            str(len(contents)) if v2 else marker
+        )
+        if truncated:
+            tag = "NextContinuationToken" if v2 else "NextMarker"
+            ET.SubElement(root, tag).text = next_marker
+        for key, entry in contents:
+            c = ET.SubElement(root, "Contents")
+            ET.SubElement(c, "Key").text = key
+            ET.SubElement(c, "LastModified").text = _iso(entry.attributes.mtime)
+            ET.SubElement(c, "ETag").text = f'"{_entry_etag(entry)}"'
+            ET.SubElement(c, "Size").text = str(_entry_size(entry))
+            ET.SubElement(c, "StorageClass").text = "STANDARD"
+        for p in prefixes:
+            cp = ET.SubElement(root, "CommonPrefixes")
+            ET.SubElement(cp, "Prefix").text = p
+        return _xml_response(root)
+
+    async def _walk_keys(
+        self, bucket: str, prefix: str, delimiter: str, marker: str, max_keys: int
+    ):
+        """S3 listing semantics over the filer tree.  delimiter '' (full
+        recursive walk) and '/' (single level + CommonPrefixes) are
+        supported — the cases every real client uses."""
+        base = f"{self.buckets_path}/{bucket}"
+        contents: list[tuple[str, filer_pb2.Entry]] = []
+        prefixes: list[str] = []
+        truncated = False
+        next_marker = ""
+
+        if delimiter == "/":
+            dir_part, _, name_prefix = prefix.rpartition("/")
+            directory = f"{base}/{dir_part}" if dir_part else base
+            start = ""
+            if dir_part == "" or marker.startswith(f"{dir_part}/"):
+                start = marker[len(dir_part) :].lstrip("/").split("/")[0]
+            async for r in self._stub().ListEntries(
+                filer_pb2.ListEntriesRequest(
+                    directory=directory,
+                    prefix=name_prefix,
+                    start_from_file_name=start,
+                    inclusive_start_from=True,
+                )
+            ):
+                e = r.entry
+                if e.name == UPLOADS_DIR and not dir_part:
+                    continue
+                key = f"{dir_part}/{e.name}" if dir_part else e.name
+                # list tokens: "key" for objects, "key/" for common prefixes
+                token = f"{key}/" if e.is_directory else key
+                if marker and token <= marker:
+                    continue
+                if len(contents) + len(prefixes) >= max_keys:
+                    truncated = True
+                    break
+                if e.is_directory:
+                    prefixes.append(token)
+                else:
+                    contents.append((key, e))
+                next_marker = token
+            return contents, prefixes, truncated, next_marker
+
+        # recursive walk (no delimiter)
+        async def walk(directory: str, rel: str):
+            nonlocal truncated, next_marker
+            async for r in self._stub().ListEntries(
+                filer_pb2.ListEntriesRequest(directory=directory, limit=1 << 31)
+            ):
+                e = r.entry
+                if e.name == UPLOADS_DIR and directory == base:
+                    continue
+                key = f"{rel}{e.name}"
+                if truncated:
+                    return
+                if e.is_directory:
+                    sub = f"{key}/"
+                    # prune subtrees outside the prefix...
+                    if prefix and not (sub.startswith(prefix) or prefix.startswith(sub)):
+                        continue
+                    # ...or wholly <= marker (marker bigger than, and not
+                    # inside, the subtree ⇒ every sub-key sorts below it)
+                    if marker and marker > sub and not marker.startswith(sub):
+                        continue
+                    await walk(f"{directory}/{e.name}", sub)
+                else:
+                    if prefix and not key.startswith(prefix):
+                        continue
+                    if marker and key <= marker:
+                        continue
+                    if len(contents) >= max_keys:
+                        truncated = True
+                        return
+                    contents.append((key, e))
+                    next_marker = key
+
+        await walk(base, "")
+        return contents, prefixes, truncated, next_marker
+
+    # ------------------------------------------------------------ multipart
+
+    def _uploads_dir(self, bucket: str) -> str:
+        return f"{self.buckets_path}/{bucket}/{UPLOADS_DIR}"
+
+    async def create_multipart_upload(self, bucket, key, request) -> web.Response:
+        if not await self._bucket_exists(bucket):
+            raise S3Error(*ERR_NO_SUCH_BUCKET)
+        upload_id = uuid.uuid4().hex
+        mime = request.headers.get("Content-Type", "")
+        resp = await self._stub().CreateEntry(
+            filer_pb2.CreateEntryRequest(
+                directory=self._uploads_dir(bucket),
+                entry=filer_pb2.Entry(
+                    name=upload_id,
+                    is_directory=True,
+                    attributes=filer_pb2.FuseAttributes(
+                        crtime=int(time.time()), file_mode=0o770, mime=mime
+                    ),
+                    extended={"key": key.encode()},
+                ),
+            )
+        )
+        if resp.error:
+            raise S3Error("InternalError", resp.error, 500)
+        root = _el("InitiateMultipartUploadResult")
+        ET.SubElement(root, "Bucket").text = bucket
+        ET.SubElement(root, "Key").text = key
+        ET.SubElement(root, "UploadId").text = upload_id
+        return _xml_response(root)
+
+    async def _upload_entry(self, bucket: str, upload_id: str) -> filer_pb2.Entry:
+        try:
+            resp = await self._stub().LookupDirectoryEntry(
+                filer_pb2.LookupDirectoryEntryRequest(
+                    directory=self._uploads_dir(bucket), name=upload_id
+                )
+            )
+            return resp.entry
+        except grpc.aio.AioRpcError:
+            raise S3Error(*ERR_NO_SUCH_UPLOAD)
+
+    async def upload_part(self, bucket, key, upload_id, part_number, request) -> web.Response:
+        await self._upload_entry(bucket, upload_id)
+        name = f"{part_number:04d}.part"
+        url = (
+            f"http://{self.filer_address}{self._uploads_dir(bucket)}/"
+            f"{upload_id}/{name}"
+        )
+        data = await self._body(request)
+        headers = {}
+        if isinstance(data, (bytes, bytearray)):
+            headers["Content-Length"] = str(len(data))
+        elif request.content_length is not None:
+            headers["Content-Length"] = str(request.content_length)
+        async with self._session.put(url, data=data, headers=headers) as r:
+            if r.status >= 300:
+                raise S3Error("InternalError", await r.text(), 500)
+            md5_b64 = r.headers.get("Content-MD5", "")
+        etag = base64.b64decode(md5_b64).hex() if md5_b64 else ""
+        return web.Response(status=200, headers={"ETag": f'"{etag}"'})
+
+    async def complete_multipart_upload(self, bucket, key, upload_id, request) -> web.Response:
+        pentry = await self._upload_entry(bucket, upload_id)
+        body = await request.read()
+        requested: list[tuple[int, str]] = []
+        if body:
+            doc = ET.fromstring(body)
+            ns = _ns_of(doc)
+            for part in doc.findall(f"{ns}Part"):
+                num = int(part.findtext(f"{ns}PartNumber") or 0)
+                etag = (part.findtext(f"{ns}ETag") or "").strip('"')
+                requested.append((num, etag))
+        requested.sort()
+
+        parts: dict[int, filer_pb2.Entry] = {}
+        async for r in self._stub().ListEntries(
+            filer_pb2.ListEntriesRequest(
+                directory=f"{self._uploads_dir(bucket)}/{upload_id}", limit=10000
+            )
+        ):
+            if r.entry.name.endswith(".part"):
+                parts[int(r.entry.name[:-5])] = r.entry
+        if not parts:
+            raise S3Error(*ERR_NO_SUCH_UPLOAD)
+        order = [n for n, _ in requested] if requested else sorted(parts)
+
+        final_chunks: list[filer_pb2.FileChunk] = []
+        md5s = b""
+        offset = 0
+        for num, want_etag in requested or [(n, "") for n in order]:
+            entry = parts.get(num)
+            if entry is None:
+                raise S3Error("InvalidPart", f"part {num} not found", 400)
+            entry_md5 = bytes(entry.attributes.md5)
+            if want_etag and len(want_etag) == 32 and entry_md5.hex() != want_etag:
+                raise S3Error("InvalidPart", f"part {num} etag mismatch", 400)
+            md5s += entry_md5
+            for c in entry.chunks:
+                final_chunks.append(
+                    filer_pb2.FileChunk(
+                        file_id=c.file_id,
+                        offset=offset,
+                        size=c.size,
+                        modified_ts_ns=c.modified_ts_ns,
+                        e_tag=c.e_tag,
+                    )
+                )
+                offset += int(c.size)
+            if entry.content:  # tiny inlined part — re-home as real content?
+                raise S3Error("InternalError", "inlined part unsupported", 500)
+        multipart_etag = f"{hashlib.md5(md5s).hexdigest()}-{len(order)}"
+
+        d, n = _split_key(f"{self.buckets_path}/{bucket}/{key}")
+        resp = await self._stub().CreateEntry(
+            filer_pb2.CreateEntryRequest(
+                directory=d,
+                entry=filer_pb2.Entry(
+                    name=n,
+                    chunks=final_chunks,
+                    attributes=filer_pb2.FuseAttributes(
+                        mtime=int(time.time()),
+                        crtime=int(time.time()),
+                        file_mode=0o660,
+                        file_size=offset,
+                        mime=pentry.attributes.mime,
+                    ),
+                    extended={
+                        **{
+                            k: bytes(v)
+                            for k, v in pentry.extended.items()
+                            if k != "key"
+                        },
+                        "s3-etag": multipart_etag.encode(),
+                    },
+                ),
+            )
+        )
+        if resp.error:
+            raise S3Error("InternalError", resp.error, 500)
+        # drop the staging dir (metadata only — chunks now belong to the key)
+        await self._stub().DeleteEntry(
+            filer_pb2.DeleteEntryRequest(
+                directory=self._uploads_dir(bucket),
+                name=upload_id,
+                is_delete_data=False,
+                is_recursive=True,
+            )
+        )
+        root = _el("CompleteMultipartUploadResult")
+        ET.SubElement(root, "Location").text = f"http://{self.url}/{bucket}/{key}"
+        ET.SubElement(root, "Bucket").text = bucket
+        ET.SubElement(root, "Key").text = key
+        ET.SubElement(root, "ETag").text = f'"{multipart_etag}"'
+        return _xml_response(root)
+
+    async def abort_multipart_upload(self, bucket, upload_id) -> web.Response:
+        await self._stub().DeleteEntry(
+            filer_pb2.DeleteEntryRequest(
+                directory=self._uploads_dir(bucket),
+                name=upload_id,
+                is_delete_data=True,
+                is_recursive=True,
+            )
+        )
+        return web.Response(status=204)
+
+    async def list_multipart_uploads(self, bucket, q) -> web.Response:
+        root = _el("ListMultipartUploadsResult")
+        ET.SubElement(root, "Bucket").text = bucket
+        try:
+            async for r in self._stub().ListEntries(
+                filer_pb2.ListEntriesRequest(
+                    directory=self._uploads_dir(bucket), limit=1000
+                )
+            ):
+                e = r.entry
+                if not e.is_directory:
+                    continue
+                u = ET.SubElement(root, "Upload")
+                ET.SubElement(u, "Key").text = e.extended.get("key", b"").decode()
+                ET.SubElement(u, "UploadId").text = e.name
+                ET.SubElement(u, "Initiated").text = _iso(e.attributes.crtime)
+        except grpc.aio.AioRpcError:
+            pass
+        return _xml_response(root)
+
+    async def list_parts(self, bucket, key, upload_id, q) -> web.Response:
+        await self._upload_entry(bucket, upload_id)
+        root = _el("ListPartsResult")
+        ET.SubElement(root, "Bucket").text = bucket
+        ET.SubElement(root, "Key").text = key
+        ET.SubElement(root, "UploadId").text = upload_id
+        async for r in self._stub().ListEntries(
+            filer_pb2.ListEntriesRequest(
+                directory=f"{self._uploads_dir(bucket)}/{upload_id}", limit=10000
+            )
+        ):
+            e = r.entry
+            if not e.name.endswith(".part"):
+                continue
+            p = ET.SubElement(root, "Part")
+            ET.SubElement(p, "PartNumber").text = str(int(e.name[:-5]))
+            ET.SubElement(p, "ETag").text = f'"{bytes(e.attributes.md5).hex()}"'
+            ET.SubElement(p, "Size").text = str(_entry_size(e))
+            ET.SubElement(p, "LastModified").text = _iso(e.attributes.mtime)
+        return _xml_response(root)
+
+    # -------------------------------------------------------------- tagging
+
+    async def put_object_tagging(self, bucket, key, request) -> web.Response:
+        entry = await self._get_entry(bucket, key)
+        doc = ET.fromstring(await request.read())
+        ns = _ns_of(doc)
+        for k in list(entry.extended):
+            if k.startswith("x-amz-tag-"):
+                del entry.extended[k]
+        for tag in doc.iter(f"{ns}Tag"):
+            k = tag.findtext(f"{ns}Key") or ""
+            v = tag.findtext(f"{ns}Value") or ""
+            entry.extended[f"x-amz-tag-{k}"] = v.encode()
+        d, _ = _split_key(f"{self.buckets_path}/{bucket}/{key}")
+        await self._stub().UpdateEntry(
+            filer_pb2.UpdateEntryRequest(directory=d, entry=entry)
+        )
+        return web.Response(status=200)
+
+    async def get_object_tagging(self, bucket, key) -> web.Response:
+        entry = await self._get_entry(bucket, key)
+        root = _el("Tagging")
+        ts = ET.SubElement(root, "TagSet")
+        for k, v in entry.extended.items():
+            if k.startswith("x-amz-tag-"):
+                t = ET.SubElement(ts, "Tag")
+                ET.SubElement(t, "Key").text = k[len("x-amz-tag-") :]
+                ET.SubElement(t, "Value").text = v.decode()
+        return _xml_response(root)
+
+    async def delete_object_tagging(self, bucket, key) -> web.Response:
+        entry = await self._get_entry(bucket, key)
+        for k in list(entry.extended):
+            if k.startswith("x-amz-tag-"):
+                del entry.extended[k]
+        d, _ = _split_key(f"{self.buckets_path}/{bucket}/{key}")
+        await self._stub().UpdateEntry(
+            filer_pb2.UpdateEntryRequest(directory=d, entry=entry)
+        )
+        return web.Response(status=204)
+
+
+# ------------------------------------------------------------------ helpers
+
+
+def _validate_names(bucket: str, key: str) -> str:
+    """Reject names that would escape the bucket subtree in the filer
+    namespace (the gateway authorizes per bucket, so traversal is an
+    authorization bypass, not just an oddity)."""
+    if bucket and not all(c.isalnum() or c in ".-_" for c in bucket):
+        return f"invalid bucket name {bucket!r}"
+    if bucket in (".", "..", UPLOADS_DIR):
+        return f"invalid bucket name {bucket!r}"
+    for seg in key.split("/"):
+        if seg in (".", ".."):
+            return "key must not contain '.' or '..' path segments"
+    if "//" in key:
+        return "key must not contain empty path segments"
+    return ""
+
+
+def _split_key(full_path: str) -> tuple[str, str]:
+    full_path = full_path.rstrip("/")
+    d, _, n = full_path.rpartition("/")
+    return d or "/", n
+
+
+def _entry_size(e: filer_pb2.Entry) -> int:
+    return max(
+        e.attributes.file_size,
+        sum(int(c.size) for c in e.chunks) if e.chunks else 0,
+        len(e.content),
+    )
+
+
+def _entry_etag(e: filer_pb2.Entry) -> str:
+    s3_etag = e.extended.get("s3-etag")
+    if s3_etag:
+        return s3_etag.decode()
+    if e.attributes.md5:
+        return bytes(e.attributes.md5).hex()
+    return ""
+
+
+def _el(name: str) -> ET.Element:
+    return ET.Element(name, xmlns=S3_XMLNS)
+
+
+def _ns_of(doc: ET.Element) -> str:
+    if doc.tag.startswith("{"):
+        return doc.tag.split("}")[0] + "}"
+    return ""
+
+
+def _xml_response(root: ET.Element, status: int = 200) -> web.Response:
+    body = b'<?xml version="1.0" encoding="UTF-8"?>\n' + ET.tostring(root)
+    return web.Response(status=status, body=body, content_type="application/xml")
+
+
+def _error_response(code: str, message: str, status: int) -> web.Response:
+    root = ET.Element("Error")
+    ET.SubElement(root, "Code").text = code
+    ET.SubElement(root, "Message").text = message
+    return _xml_response(root, status)
+
+
+def _iso(ts: int) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(ts or 0))

@@ -27,6 +27,8 @@ class LocalCluster:
         racks: list[str] | None = None,
         with_filer: bool = False,
         filer_kwargs: dict | None = None,
+        with_s3: bool = False,
+        s3_kwargs: dict | None = None,
     ):
         import os
 
@@ -34,9 +36,12 @@ class LocalCluster:
             port=0, volume_size_limit_mb=volume_size_limit_mb,
             pulse_seconds=pulse_seconds,
         )
-        self.with_filer = with_filer
+        self.with_filer = with_filer or with_s3
         self.filer_kwargs = filer_kwargs or {}
         self.filer: FilerServer | None = None
+        self.with_s3 = with_s3
+        self.s3_kwargs = s3_kwargs or {}
+        self.s3 = None
         self.base_dir = base_dir
         self._specs = []
         for i in range(n_volume_servers):
@@ -73,6 +78,16 @@ class LocalCluster:
                 **self.filer_kwargs,
             )
             await self.filer.start()
+        if self.with_s3:
+            from ..s3api import S3ApiServer
+
+            self.s3 = S3ApiServer(
+                filer_address=self.filer.url,
+                filer_grpc_address=f"{self.filer.ip}:{self.filer.grpc_port}",
+                port=0,
+                **self.s3_kwargs,
+            )
+            await self.s3.start()
 
     async def wait_for_nodes(self, n: int, timeout: float = 10.0) -> None:
         deadline = asyncio.get_event_loop().time() + timeout
@@ -83,6 +98,8 @@ class LocalCluster:
         raise TimeoutError(f"only {len(self.master.topo.data_nodes())}/{n} nodes joined")
 
     async def stop(self) -> None:
+        if self.s3 is not None:
+            await self.s3.stop()
         if self.filer is not None:
             await self.filer.stop()
         for vs in self.volume_servers:
