@@ -1,0 +1,97 @@
+import time
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from seaweedfs_tpu.ops import rs, rs_tpu
+
+
+def measure(fn, x, n_small=8, n_large=72, reps=3):
+    @jax.jit
+    def many(x, n):
+        def body(i, acc):
+            xi = x ^ i.astype(jnp.uint8)
+            out = fn(xi)
+            return acc + jnp.sum(out[:, ::65536].astype(jnp.int32))
+        return jax.lax.fori_loop(0, n, body, jnp.int32(0))
+    int(many(x, 1))
+    best = 0
+    for _ in range(reps):
+        times = {}
+        for n in (n_small, n_large):
+            t0 = time.perf_counter()
+            int(many(x, n))
+            times[n] = time.perf_counter() - t0
+        best = max(best, x.nbytes / ((times[n_large] - times[n_small]) / (n_large - n_small)))
+    return best
+
+
+def _unpack(x, out_dtype):
+    xi = x.astype(jnp.int32)
+    planes = [((xi >> i) & 1) for i in range(8)]
+    return jnp.concatenate(planes, axis=0).astype(out_dtype)
+
+
+def _pack(counts, m):
+    obits = counts.astype(jnp.int32) & 1
+    acc = obits[0:m]
+    for i in range(1, 8):
+        acc = acc | (obits[i * m : (i + 1) * m] << i)
+    return acc.astype(jnp.uint8)
+
+
+def run(name, a_np, x, tile, dt):
+    m8, k8 = a_np.shape
+    k, b = x.shape
+    m = m8 // 8
+    a = jnp.asarray(a_np, dtype=dt)
+
+    def kernel(a_ref, x_ref, o_ref):
+        bits = _unpack(x_ref[:], dt)
+        counts = jnp.dot(a_ref[:], bits, preferred_element_type=jnp.int32)
+        o_ref[:] = _pack(counts, m)
+
+    def apply(xi):
+        return pl.pallas_call(
+            kernel,
+            grid=(pl.cdiv(b, tile),),
+            in_specs=[
+                pl.BlockSpec((m8, k8), lambda i: (0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((k, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((m, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((m, b), jnp.uint8),
+        )(a, xi)
+
+    try:
+        bps = measure(apply, x)
+    except Exception as e:  # noqa: BLE001
+        print(f"{name:26s} tile={tile:6d}  FAILED: {str(e)[:110]}")
+        return
+    # correctness spot check
+    out = np.asarray(apply(x)[:, :4096])
+    from seaweedfs_tpu.ops import rs_cpu
+    codec = rs.RSCodec()
+    ref = rs_cpu.apply_matrix_numpy(np.asarray(codec.matrix[10:], np.uint8), np.asarray(x)[:10, :4096])
+    ok = np.array_equal(out[:4], ref)
+    print(f"{name:26s} tile={tile:6d}  {bps/1e9:7.2f} GB/s  correct={ok}")
+
+
+def main():
+    codec = rs.RSCodec()
+    m_gf = np.zeros((4, 16), dtype=np.uint8)
+    m_gf[:, :10] = np.asarray(codec.matrix[10:], np.uint8)
+    a16 = np.asarray(rs_tpu.prepare_matrix(m_gf), np.float32).astype(np.int8)
+    rng = np.random.default_rng(1)
+    b = 256 * 1024 * 1024 // 10
+    b -= b % 32768
+    x10 = rng.integers(0, 256, size=(10, b), dtype=np.uint8)
+    x16 = jax.device_put(np.concatenate([x10, np.zeros((6, b), np.uint8)], axis=0))
+    run("int8 k=16", a16, x16, 16384, jnp.int8)
+    run("int4 k=16", a16, x16, 16384, jnp.int4)
+    run("int4 k=16", a16, x16, 32768, jnp.int4)
+
+
+if __name__ == "__main__":
+    main()

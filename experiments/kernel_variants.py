@@ -1,0 +1,195 @@
+"""Kernel variant sweep on the real TPU: find where time goes and which
+formulation of the bitsliced GF(2) matmul is fastest.
+
+MXU accounting (v5e, 128x128 tile): the current [32,80] bf16 matrix pads to
+one 128x128 pass per 128 lanes -> 16384 MACs per 10 useful input bytes
+= 1638 MACs/byte -> ~60 GB/s ceiling at 98 TMAC/s bf16.  int8 doubles the
+MAC rate; block-diagonal packing of 4 independent stripe groups
+([128, 320] -> 1 M-tile x 3 K-tiles) cuts MACs/byte to 1229.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from seaweedfs_tpu.ops import rs, rs_tpu
+
+
+def measure(fn, x, n_small=4, n_large=36):
+    @jax.jit
+    def many(x, n):
+        def body(i, acc):
+            xi = x ^ i.astype(jnp.uint8)
+            out = fn(xi)
+            return acc + jnp.sum(out[:, ::65536].astype(jnp.int32))
+
+        return jax.lax.fori_loop(0, n, body, jnp.int32(0))
+
+    int(many(x, 1))
+    times = {}
+    for n in (n_small, n_large):
+        t0 = time.perf_counter()
+        int(many(x, n))
+        times[n] = time.perf_counter() - t0
+    per_iter = (times[n_large] - times[n_small]) / (n_large - n_small)
+    return x.nbytes / per_iter
+
+
+def _unpack(x, out_dtype):
+    xi = x.astype(jnp.int32)
+    planes = [((xi >> i) & 1) for i in range(8)]
+    return jnp.concatenate(planes, axis=0).astype(out_dtype)
+
+
+def _pack(counts, m):
+    obits = counts.astype(jnp.int32) & 1
+    acc = obits[0:m]
+    for i in range(1, 8):
+        acc = acc | (obits[i * m : (i + 1) * m] << i)
+    return acc.astype(jnp.uint8)
+
+
+def kernel_bf16(a_ref, x_ref, o_ref):
+    m = o_ref.shape[0]
+    bits = _unpack(x_ref[:], jnp.bfloat16)
+    counts = jnp.dot(a_ref[:], bits, preferred_element_type=jnp.float32)
+    o_ref[:] = _pack(counts, m)
+
+
+def kernel_int8(a_ref, x_ref, o_ref):
+    m = o_ref.shape[0]
+    bits = _unpack(x_ref[:], jnp.int8)
+    counts = jnp.dot(a_ref[:], bits, preferred_element_type=jnp.int32)
+    o_ref[:] = _pack(counts, m)
+
+
+def kernel_unpack_only(a_ref, x_ref, o_ref):
+    bits = _unpack(x_ref[:], jnp.int8)
+    o_ref[:] = bits[:4].astype(jnp.int32).astype(jnp.uint8)
+
+
+def kernel_unpack_pack(a_ref, x_ref, o_ref):
+    m = o_ref.shape[0]
+    bits = _unpack(x_ref[:], jnp.int8)
+    # fake counts from bits without a dot: slice 32 rows
+    o_ref[:] = _pack(bits[: 8 * m].astype(jnp.int32), m)
+
+
+def kernel_dot_only_int8(a_ref, x_ref, o_ref):
+    # no unpack: replicate byte rows to [80, tile] int8 and dot
+    bits = jnp.concatenate([x_ref[:].astype(jnp.int8)] * 8, axis=0)
+    counts = jnp.dot(a_ref[:], bits, preferred_element_type=jnp.int32)
+    o_ref[:] = counts[:4].astype(jnp.uint8)
+
+
+def run_variant(name, kern, a_bm, x, tile, out_rows=4, a_dtype=jnp.bfloat16):
+    k, b = x.shape
+    m8, k8 = a_bm.shape
+    a = a_bm.astype(a_dtype)
+
+    def apply(xi):
+        return pl.pallas_call(
+            kern,
+            grid=(pl.cdiv(b, tile),),
+            in_specs=[
+                pl.BlockSpec((m8, k8), lambda i: (0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((k, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec(
+                (out_rows, tile), lambda i: (0, i), memory_space=pltpu.VMEM
+            ),
+            out_shape=jax.ShapeDtypeStruct((out_rows, b), jnp.uint8),
+            cost_estimate=pl.CostEstimate(
+                flops=2 * m8 * k8 * b, bytes_accessed=k * b + out_rows * b,
+                transcendentals=0,
+            ),
+        )(a, xi)
+
+    try:
+        bps = measure(apply, x)
+    except Exception as e:  # noqa: BLE001
+        print(f"{name:28s} tile={tile:6d}  FAILED: {str(e)[:90]}")
+        return 0.0
+    print(f"{name:28s} tile={tile:6d}  {bps/1e9:7.2f} GB/s")
+    return bps
+
+
+def run_blockdiag(a_bm, x, tile, groups, a_dtype=jnp.int8):
+    """g independent stripe groups packed block-diagonally:
+    A_blk [g*32, g*80], input [g*10, tile]."""
+    m8, k8 = a_bm.shape
+    a_np = np.asarray(a_bm, dtype=np.float32)
+    blk = np.zeros((groups * m8, groups * k8), dtype=np.float32)
+    for g in range(groups):
+        blk[g * m8 : (g + 1) * m8, g * k8 : (g + 1) * k8] = a_np
+    a = jnp.asarray(blk, dtype=a_dtype)
+    k, b = x.shape
+    xg = jnp.concatenate([x] * groups, axis=0)  # [g*10, b]
+
+    def kern(a_ref, x_ref, o_ref):
+        m = o_ref.shape[0]
+        bits = _unpack(x_ref[:], jnp.int8)
+        counts = jnp.dot(a_ref[:], bits, preferred_element_type=jnp.int32)
+        o_ref[:] = _pack(counts, m)
+
+    gk, gm8 = groups * k, groups * m8
+    out_rows = gm8 // 8
+
+    def apply(xi):
+        return pl.pallas_call(
+            kern,
+            grid=(pl.cdiv(b, tile),),
+            in_specs=[
+                pl.BlockSpec(
+                    (gm8, groups * k8), lambda i: (0, 0), memory_space=pltpu.VMEM
+                ),
+                pl.BlockSpec((gk, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec(
+                (out_rows, tile), lambda i: (0, i), memory_space=pltpu.VMEM
+            ),
+            out_shape=jax.ShapeDtypeStruct((out_rows, b), jnp.uint8),
+            cost_estimate=pl.CostEstimate(
+                flops=2 * gm8 * groups * k8 * b,
+                bytes_accessed=gk * b + out_rows * b,
+                transcendentals=0,
+            ),
+        )(a, xi)
+
+    try:
+        bps = measure(apply, xg)
+    except Exception as e:  # noqa: BLE001
+        print(f"blockdiag g={groups:2d}            tile={tile:6d}  FAILED: {str(e)[:90]}")
+        return 0.0
+    print(f"blockdiag g={groups:2d} ({a_dtype.__name__})    tile={tile:6d}  {bps/1e9:7.2f} GB/s")
+    return bps
+
+
+def main():
+    codec = rs.RSCodec()
+    a_bm = rs_tpu.prepare_matrix(codec.matrix[10:])
+    rng = np.random.default_rng(1)
+    b = 256 * 1024 * 1024 // 10
+    b -= b % 32768
+    x = jax.device_put(rng.integers(0, 256, size=(10, b), dtype=np.uint8))
+
+    run_variant("bf16(current)", kernel_bf16, a_bm, x, 32768)
+    for tile in (12288, 16384, 24576, 32768):
+        run_variant("int8", kernel_int8, a_bm, x, tile, a_dtype=jnp.int8)
+    run_variant("unpack_only", kernel_unpack_only, a_bm, x, 16384, a_dtype=jnp.int8)
+    run_variant("unpack+pack", kernel_unpack_pack, a_bm, x, 16384, a_dtype=jnp.int8)
+    run_variant("dot_only_int8", kernel_dot_only_int8, a_bm, x, 16384, a_dtype=jnp.int8)
+
+    xb = jax.device_put(
+        rng.integers(0, 256, size=(10, b // 4), dtype=np.uint8)
+    )
+    for g in (2, 4):
+        for tile in (8192, 16384):
+            run_blockdiag(a_bm, xb, tile, g)
+
+
+if __name__ == "__main__":
+    main()

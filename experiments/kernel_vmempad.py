@@ -1,0 +1,97 @@
+"""Variant: x stays [10, B] in HBM; kernel concats 6 zero rows in VMEM."""
+import time
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from seaweedfs_tpu.ops import rs, rs_tpu
+
+
+def measure(fn, x, n_small=8, n_large=72, reps=3):
+    @jax.jit
+    def many(x, n):
+        def body(i, acc):
+            xi = x ^ i.astype(jnp.uint8)
+            out = fn(xi)
+            return acc + jnp.sum(out[:, ::65536].astype(jnp.int32))
+        return jax.lax.fori_loop(0, n, body, jnp.int32(0))
+    int(many(x, 1))
+    best = 0
+    for _ in range(reps):
+        times = {}
+        for n in (n_small, n_large):
+            t0 = time.perf_counter()
+            int(many(x, n))
+            times[n] = time.perf_counter() - t0
+        best = max(best, x.nbytes / ((times[n_large] - times[n_small]) / (n_large - n_small)))
+    return best
+
+
+def run(name, a_np, x, tile, pad_where):
+    m8, k8 = a_np.shape   # k8 = 128 (k_pad=16)
+    k, b = x.shape        # k = 10
+    m = m8 // 8
+    k_pad = k8 // 8
+    a = jnp.asarray(a_np, dtype=jnp.int8)
+
+    def kernel(a_ref, x_ref, o_ref):
+        xv = x_ref[:]
+        if pad_where == "vmem_concat":
+            zeros = jnp.zeros((k_pad - k, xv.shape[1]), jnp.uint8)
+            xv = jnp.concatenate([xv, zeros], axis=0)
+            bits = rs_tpu._unpack_bits_bitmajor(xv)
+        else:  # unpack 10 rows, pad each plane
+            xi = xv.astype(jnp.int32)
+            planes = []
+            z = jnp.zeros((k_pad - k, xv.shape[1]), jnp.int32)
+            for i in range(8):
+                planes.append((xi >> i) & 1)
+                planes.append(z)
+            bits = jnp.concatenate(planes, axis=0).astype(jnp.int8)
+        counts = jnp.dot(a_ref[:], bits, preferred_element_type=jnp.int32)
+        o_ref[:] = rs_tpu._pack_bits_bitmajor(counts, m)
+
+    def apply(xi):
+        return pl.pallas_call(
+            kernel,
+            grid=(pl.cdiv(b, tile),),
+            in_specs=[
+                pl.BlockSpec((m8, k8), lambda i: (0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((k, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((m, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((m, b), jnp.uint8),
+            cost_estimate=pl.CostEstimate(
+                flops=2 * m8 * k8 * b, bytes_accessed=k * b + m * b, transcendentals=0
+            ),
+        )(a, xi)
+
+    try:
+        bps = measure(apply, x)
+    except Exception as e:  # noqa: BLE001
+        print(f"{name:26s} tile={tile:6d}  FAILED: {str(e)[:110]}")
+        return
+    out = np.asarray(apply(x)[:, :4096])
+    from seaweedfs_tpu.ops import rs_cpu
+    codec = rs.RSCodec()
+    ref = rs_cpu.apply_matrix_numpy(np.asarray(codec.matrix[10:], np.uint8), np.asarray(x)[:, :4096])
+    ok = np.array_equal(out[:4], ref)
+    print(f"{name:26s} tile={tile:6d}  {bps/1e9:7.2f} GB/s  correct={ok}")
+
+
+def main():
+    codec = rs.RSCodec()
+    a16 = np.asarray(rs_tpu.prepare_matrix(codec.matrix[10:]), np.int32).astype(np.int8)
+    rng = np.random.default_rng(1)
+    b = 256 * 1024 * 1024 // 10
+    b -= b % 32768
+    x = jax.device_put(rng.integers(0, 256, size=(10, b), dtype=np.uint8))
+    for tile in (8192, 16384, 24576):
+        run("vmem_concat", a16, x, tile, "vmem_concat")
+    for tile in (8192, 16384):
+        run("plane_interleave", a16, x, tile, "plane")
+
+
+if __name__ == "__main__":
+    main()

@@ -13,17 +13,30 @@ hardware.  Instead we use the GF(2) structure of the code:
 
       out_bits[8m, B] = A[8m, 8k] @ in_bits[8k, B]   (mod 2)
 
-  — a plain matmul with a parity reduction.  Bits are 0/1 bf16 values, the
-  products accumulate exactly in f32 (counts <= 8k = 80 << 2^24), and
+  — a plain matmul with a parity reduction.  Bits are 0/1 int8 values, the
+  products accumulate exactly in int32 (counts <= 8k << 2^31), and
   `count & 1` recovers the XOR.  This maps the whole codec onto the MXU
   systolic array: encode, rebuild, and degraded-read reconstruction are the
-  same kernel with different 32x80 matrices.
+  same kernel with different matrices.
 
-Layout trick: rows/cols are permuted *bit-major* (row = bit*m + shard) so the
-Pallas kernel unpacks bytes to bits with a sublane concatenation of eight
-shifted copies and repacks with eight static row-slices — no gathers, no
-Mosaic-hostile reshapes.  The permutation is folded into the matrix on the
-host, where it costs nothing.
+Layout (v5e sweep, experiments/kernel_variants*.py):
+
+  * int8 operands with int32 accumulation — the v5e MXU runs int8 at twice
+    the bf16 MAC rate (394 vs 197 TOPS), and every element here is a 0/1
+    bit, so the narrow type is exact.
+  * rows/cols permuted *bit-major* (row = bit*k_pad + shard) so the kernel
+    unpacks bytes to bits with a sublane concatenation of eight shifted
+    copies and repacks with eight static row-slices — no gathers.  The
+    permutation is folded into the matrix on the host.
+  * matrix cols padded to k_pad = 16 shards (so the MXU contraction dim
+    8*k_pad is an exact 128 tile and every unpacked bit-plane starts on a
+    sublane-tile boundary).  The input stays [k, B] in HBM; the kernel
+    concatenates the k_pad-k zero rows in VMEM, which costs ~5% vs a
+    pre-padded input but avoids any HBM pad copy in the pipeline.
+    Head-to-head on v5e-1 (same run, useful-byte GB/s): bf16 k=10: 49;
+    int8 + per-batch HBM pad: 52; int8 + VMEM concat: 67; int8
+    pre-padded: 70.  Roof for this shape: one 128x128 int8 MXU pass per
+    128 lanes = 1638 MACs/useful-byte -> ~120 GB/s.
 
 Two kernels:
   "xla"    — the formulation in plain jnp; XLA materialises the bit matrix
@@ -43,10 +56,16 @@ from jax.experimental.pallas import tpu as pltpu
 
 from . import gf256
 
-# Lane tile for the batch dimension. Profiler sweep on v5e-1 (axon): 60.8
-# GB/s @4096 -> 64.8 @32768, flat beyond; 32768 keeps the fused kernel's
-# VMEM footprint ~6MB with headroom for double buffering.
-BATCH_TILE = 32768
+# Lane tile for the batch dimension.  v5e sweep: 16384 is the knee for the
+# int8 kernel (8192: 112, 16384: 115, 24576: 113 GB/s); VMEM footprint at
+# 16384 is ~(16+4)*16K input/output + 128*16K bits ~= 2.4MB with headroom
+# for double buffering.
+BATCH_TILE = 16384
+
+# Input-shard padding: k rounds up to a multiple of 16 so the unpacked bit
+# planes are sublane-tile aligned and 8*k_pad is a multiple of the 128 MXU
+# contraction tile.
+K_ALIGN = 16
 
 
 def _pad_rows(m_gf: np.ndarray) -> np.ndarray:
@@ -62,32 +81,46 @@ def _pad_rows(m_gf: np.ndarray) -> np.ndarray:
     return m_gf
 
 
-def prepare_matrix(m_gf: np.ndarray) -> jax.Array:
-    """GF(256) matrix [m,k] -> bit-major GF(2) bf16 matrix [8*m_pad, 8*k].
+def _pad_cols(m_gf: np.ndarray) -> np.ndarray:
+    """Pad the GF matrix to a multiple of K_ALIGN input columns.  Zero
+    columns multiply zero-padded input rows: no effect on the result."""
+    cols = m_gf.shape[1]
+    pad = (-cols) % K_ALIGN
+    if pad:
+        m_gf = np.concatenate(
+            [m_gf, np.zeros((m_gf.shape[0], pad), dtype=np.uint8)], axis=1
+        )
+    return m_gf
 
-    a_bm[i*m + p, j*k + d] == bit i of (G[p,d] * 2^j), i.e. standard
-    expand_to_gf2 with rows/cols permuted bit-major.
-    """
-    m_gf = _pad_rows(np.asarray(m_gf, dtype=np.uint8))
+
+def prepare_matrix(m_gf: np.ndarray) -> jax.Array:
+    """GF(256) matrix [m,k] -> bit-major GF(2) int8 matrix
+    [8*m_pad, 8*k_pad].
+
+    a_bm[i*m_pad + p, j*k_pad + d] == bit i of (G[p,d] * 2^j), i.e.
+    standard expand_to_gf2 with rows/cols permuted bit-major, rows padded
+    to a multiple of 4 and cols to a multiple of K_ALIGN."""
+    m_gf = _pad_cols(_pad_rows(np.asarray(m_gf, dtype=np.uint8)))
     m, k = m_gf.shape
     a_std = gf256.expand_to_gf2(m_gf)  # [8m, 8k], row p*8+i
     a_bm = (
         a_std.reshape(m, 8, k, 8).transpose(1, 0, 3, 2).reshape(8 * m, 8 * k)
     )
-    return jnp.asarray(a_bm, dtype=jnp.bfloat16)
+    return jnp.asarray(a_bm, dtype=jnp.int8)
 
 
-def _unpack_bits_bitmajor(x: jax.Array) -> jax.Array:
-    """u8 [k, B] -> bf16 0/1 bits [8k, B], row = bit*k + shard (concat of
-    eight shifted planes along sublanes)."""
+def _unpack_bits_bitmajor(x: jax.Array, dtype=jnp.int8) -> jax.Array:
+    """u8 [k, B] -> 0/1 bits [8k, B], row = bit*k + shard (concat of eight
+    shifted planes along sublanes).  Shifts run in int32 (Mosaic can't
+    legalize sub-word shrui); the bits narrow to `dtype` for the MXU."""
     xi = x.astype(jnp.int32)
     planes = [((xi >> i) & 1) for i in range(8)]
-    return jnp.concatenate(planes, axis=0).astype(jnp.bfloat16)
+    return jnp.concatenate(planes, axis=0).astype(dtype)
 
 
 def _pack_bits_bitmajor(counts: jax.Array, m: int) -> jax.Array:
-    """f32 counts [8m, B] -> u8 [m, B]: mod-2 then byte-pack via eight
-    static row slices."""
+    """int32/f32 counts [8m, B] -> u8 [m, B]: mod-2 then byte-pack via
+    eight static row slices."""
     obits = counts.astype(jnp.int32) & 1
     acc = obits[0:m]
     for i in range(1, 8):
@@ -95,13 +128,31 @@ def _pack_bits_bitmajor(counts: jax.Array, m: int) -> jax.Array:
     return acc.astype(jnp.uint8)
 
 
+def _check_x_rows(x: jax.Array, k_pad: int, k_true: int | None) -> None:
+    """Guard matrix/input shard-count mismatches.  The matrix cols are
+    padded to k_pad, so a wrong-but-smaller shard count would silently
+    multiply zero columns; callers that know the matrix's true k pass it
+    so the mismatch raises instead."""
+    if k_true is not None and x.shape[0] != k_true:
+        raise ValueError(
+            f"input has {x.shape[0]} shards but matrix was built for {k_true}"
+        )
+    if x.shape[0] > k_pad:
+        raise ValueError(
+            f"input has {x.shape[0]} shards but matrix covers {k_pad}"
+        )
+
+
 # --- XLA kernel -------------------------------------------------------------
 
 
 def _apply_xla(a_bm: jax.Array, x: jax.Array) -> jax.Array:
     m = a_bm.shape[0] // 8
+    k_pad = a_bm.shape[1] // 8
+    if x.shape[0] < k_pad:  # XLA fuses the row pad into the unpack
+        x = jnp.pad(x, ((0, k_pad - x.shape[0]), (0, 0)))
     bits = _unpack_bits_bitmajor(x)
-    counts = jnp.dot(a_bm, bits, preferred_element_type=jnp.float32)
+    counts = jnp.dot(a_bm, bits, preferred_element_type=jnp.int32)
     return _pack_bits_bitmajor(counts, m)
 
 
@@ -110,14 +161,19 @@ def _apply_xla(a_bm: jax.Array, x: jax.Array) -> jax.Array:
 
 def _gf2_matmul_kernel(a_ref, x_ref, o_ref):
     m = o_ref.shape[0]
-    bits = _unpack_bits_bitmajor(x_ref[:])
-    counts = jnp.dot(a_ref[:], bits, preferred_element_type=jnp.float32)
+    k_pad = a_ref.shape[1] // 8
+    xv = x_ref[:]
+    if xv.shape[0] < k_pad:  # align shards to k_pad with a VMEM-local
+        zeros = jnp.zeros((k_pad - xv.shape[0], xv.shape[1]), jnp.uint8)
+        xv = jnp.concatenate([xv, zeros], axis=0)  # zero block (no HBM pad)
+    bits = _unpack_bits_bitmajor(xv)
+    counts = jnp.dot(a_ref[:], bits, preferred_element_type=jnp.int32)
     o_ref[:] = _pack_bits_bitmajor(counts, m)
 
 
 def _tile_for(b: int) -> int:
     """Block tile: full BATCH_TILE for large batches, shrunk (128-aligned)
-    for small ones so degraded reads of single needles don't pay for a 32K
+    for small ones so degraded reads of single needles don't pay for a 16K
     pad and interpret-mode tests stay fast."""
     return min(BATCH_TILE, max(128, -(-b // 128) * 128))
 
@@ -127,7 +183,6 @@ def _apply_pallas(
 ) -> jax.Array:
     m8, k8 = a_bm.shape
     k, b = x.shape
-    assert k8 == 8 * k, (a_bm.shape, x.shape)
     m = m8 // 8
     grid = (pl.cdiv(b, tile),)
     return pl.pallas_call(
@@ -149,19 +204,26 @@ def _apply_pallas(
 # --- jitted entry points ----------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("kernel", "interpret", "tile"))
+@functools.partial(
+    jax.jit, static_argnames=("kernel", "interpret", "tile", "k_true")
+)
 def apply_matrix_device(
     a_bm: jax.Array,
     x: jax.Array,
     kernel: str = "pallas",
     interpret: bool = False,
     tile: int | None = None,
+    k_true: int | None = None,
 ) -> jax.Array:
-    """Device-resident apply: bit-major matrix [8m,8k] bf16, shards [k,B] u8
-    -> [m,B] u8.  For the pallas kernel B is padded to the block tile (the
-    pad region computes garbage that is sliced off); XLA needs no pad.
-    `tile` is an explicit static override (tests, tuning) — by default it is
-    derived from B so the jit cache stays consistent."""
+    """Device-resident apply: bit-major matrix [8m,8k_pad] int8, shards
+    [k,B] u8 (k <= k_pad; the missing rows are treated as zeros inside the
+    kernel) -> [m,B] u8.  For the pallas kernel B is padded to the block
+    tile (the pad region computes garbage that is sliced off).  `tile` is
+    an explicit static override (tests, tuning) — by default it is derived
+    from B so the jit cache stays consistent.  `k_true` is the matrix's
+    pre-padding shard count; pass it to catch shard-count mismatches that
+    the column padding would otherwise absorb silently."""
+    _check_x_rows(x, a_bm.shape[1] // 8, k_true)
     if kernel == "pallas":
         b = x.shape[1]
         tile = tile or _tile_for(b)
@@ -200,10 +262,15 @@ def apply_matrix(
     """Host-convenience apply (numpy in/out). Pipelines that care about
     staging (storage/ec/encoder.py) use apply_matrix_device directly."""
     m_gf = np.asarray(m_gf, dtype=np.uint8)
-    rows = m_gf.shape[0]
+    rows, k = m_gf.shape
     a_bm = _prepared(m_gf.tobytes(), *m_gf.shape)
     x = jnp.asarray(np.ascontiguousarray(shards, dtype=np.uint8))
     out = apply_matrix_device(
-        a_bm, x, kernel=kernel, interpret=_interpret_default(), tile=tile
+        a_bm,
+        x,
+        kernel=kernel,
+        interpret=_interpret_default(),
+        tile=tile,
+        k_true=k,
     )
     return np.asarray(out)[:rows]

@@ -5,9 +5,9 @@ Two parallel axes (SURVEY.md §2.10 mapping):
   "shard" — the RS shard dimension (the reference's 10-way striping over
             volume servers becomes a sharded array axis).  The bitsliced
             matmul out = (A @ bits(x)) mod 2 decomposes over column groups:
-            each device computes partial f32 bit-counts from its local
+            each device computes partial int32 bit-counts from its local
             shard rows, one `psum` over the shard axis sums counts
-            (exact: counts <= 80 per output bit), mod-2 recovers the XOR.
+            (exact: counts <= 8k per output bit), mod-2 recovers the XOR.
             This turns the reference's per-shard gRPC interval streams
             (store_ec.go:299-337) into a single ICI collective.
 
@@ -44,7 +44,7 @@ def make_mesh(
 
 def split_matrix_bitmajor(m_gf: np.ndarray, n_groups: int) -> jax.Array:
     """GF(256) matrix [m, k] -> per-group bit-major GF(2) blocks
-    [n_groups, 8m, 8*(k/n_groups)] bf16, group g covering input shards
+    [n_groups, 8m, 8*(k/n_groups)] int8, group g covering input shards
     [g*k/n, (g+1)*k/n).  Each device's block is bit-major over its LOCAL
     k so the kernel's unpack/pack layout is unchanged."""
     m_gf = np.asarray(m_gf, dtype=np.uint8)
@@ -60,7 +60,7 @@ def split_matrix_bitmajor(m_gf: np.ndarray, n_groups: int) -> jax.Array:
     for g in range(n_groups):
         blk = a_bm_rows[:, :, g * k_loc : (g + 1) * k_loc]  # [8m, 8, k_loc]
         groups.append(blk.reshape(8 * m, 8 * k_loc))
-    return jnp.asarray(np.stack(groups), dtype=jnp.bfloat16)
+    return jnp.asarray(np.stack(groups), dtype=jnp.int8)
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "m_rows"))
@@ -71,7 +71,7 @@ def _distributed_apply(mesh: Mesh, a_groups: jax.Array, x: jax.Array, m_rows: in
     def kernel(a_loc, x_loc):
         bits = _unpack_bits_bitmajor(x_loc)  # [8k_loc, B_loc]
         partial = jnp.dot(
-            a_loc[0], bits, preferred_element_type=jnp.float32
+            a_loc[0], bits, preferred_element_type=jnp.int32
         )  # [8m, B_loc]
         counts = jax.lax.psum(partial, axis_name="shard")
         return _pack_bits_bitmajor(counts, m_rows)  # [m, B_loc]

@@ -67,7 +67,11 @@ class _Codec:
 
             x = jnp.asarray(np.ascontiguousarray(shards))
             return self._tpu.apply_matrix_device(
-                self._a_bm, x, kernel=self.backend, interpret=self._interpret
+                self._a_bm,
+                x,
+                kernel=self.backend,
+                interpret=self._interpret,
+                k_true=self.matrix.shape[1],
             )
         return self._codec.apply_matrix(self.matrix, shards)
 
