@@ -1,15 +1,44 @@
-"""North-star benchmark: RS(10,4) encode throughput, TPU vs CPU reference.
+"""North-star benchmark: RS(10,4) erasure-coding pipeline, TPU vs CPU.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 
-The metric is device-resident encode throughput (input bytes/s) of the
-bitsliced GF(2) MXU kernel — the hot loop of `ec.encode`
-(reference weed/storage/erasure_coding/ec_encoder.go:162-192, whose CPU
-equivalent is klauspost/reedsolomon's AVX2/GFNI SIMD).  vs_baseline is the
-speedup over this repo's own C++ CPU kernel (GFNI/AVX2 nibble shuffles)
-measured on the same host — BASELINE.md's "measure the denominator" rule.
+Primary metric: device-resident encode throughput (useful input bytes/s) of
+the bitsliced GF(2) MXU kernel — the hot loop of `ec.encode` (reference
+weed/storage/erasure_coding/ec_encoder.go:162-192, whose CPU equivalent is
+klauspost/reedsolomon's AVX2/GFNI SIMD).  vs_baseline is the speedup over
+this repo's own C++ CPU kernel (GFNI/AVX2 nibble shuffles) measured on the
+same host — BASELINE.md's "measure the denominator" rule.  The native
+library is REQUIRED: the benchmark builds it and exits non-zero if that
+fails, so the baseline can never silently degrade to numpy.
+
+`extra` covers the remaining BASELINE.json configs, measured end to end:
+
+  rebuild_device_gbps        RS(10,4) rebuild (4 lost shards) on device
+  encode_e2e_native_gbps     file ec.encode disk->CPU kernel->disk
+  encode_e2e_device_gbps     file ec.encode disk->TPU->disk
+  degraded_p99_ms_*          per-needle degraded read (2 shards down,
+                             mixed 4KB..1MB needles).  The volume server
+                             serves these via the native CPU kernel by
+                             default (storage/ec/volume.py backend="cpu"),
+                             so `native` IS the system p99; the device
+                             variants document why (per-needle dispatch
+                             pays tunnel RTT + H2D, amortized by batching)
+  multi_volume_device_gbps   8 volumes' stripes batched into one call
+  disk_write_mbps            measured sequential write bandwidth
+  h2d_mbps                   measured host->device bandwidth
+
+Rig physics (recorded so the e2e numbers can be read honestly): this box
+reaches the TPU through a network tunnel (h2d_mbps ~ 10-20 MB/s) and has a
+single CPU core with ~175 MB/s disk writes, so every end-to-end file path
+is transfer/disk-bound far below both kernels.  The device-resident number
+is the deployable one on co-located TPU hosts; pod-scale rebuild over ICI
+(BASELINE config 5) is validated functionally by __graft_entry__.py's
+dryrun_multichip, not timed here (single chip).
 """
 import json
+import os
+import sys
+import tempfile
 import time
 
 import numpy as np
@@ -26,40 +55,45 @@ def _measure(fn, iters=5, warmup=2):
     return min(times)
 
 
+def require_native():
+    """Build the C++ kernel if needed; hard-fail when unavailable so the
+    baseline is never a numpy strawman."""
+    from seaweedfs_tpu.ops import rs_cpu
+
+    if not rs_cpu.native_available():
+        print(
+            json.dumps(
+                {
+                    "metric": "rs_10_4_encode",
+                    "value": 0,
+                    "unit": "GB/s",
+                    "vs_baseline": 0,
+                    "error": "native C++ baseline kernel failed to build",
+                }
+            )
+        )
+        sys.exit(1)
+
+
 def bench_cpu(parity_m, mb=64):
     from seaweedfs_tpu.ops import rs_cpu
 
     rng = np.random.default_rng(0)
     x = rng.integers(0, 256, size=(10, mb * 1024 * 1024 // 8), dtype=np.uint8)
-    apply_fn = (
-        rs_cpu.apply_matrix_native
-        if rs_cpu.native_available()
-        else rs_cpu.apply_matrix_numpy
-    )
-    dt = _measure(lambda: apply_fn(parity_m, x), iters=3, warmup=1)
+    dt = _measure(lambda: rs_cpu.apply_matrix_native(parity_m, x), iters=3, warmup=1)
     return x.nbytes / dt
 
 
-def bench_device(parity_m, mb=256, n_small=8, n_large=72, reps=3):
-    """On this rig block_until_ready() returns before the tunneled device
-    finishes, and per-dispatch tunnel latency is tens of ms — so the
-    kernel is timed inside an on-device fori_loop and the cost of n_large
-    vs n_small iterations is differenced.  The per-iteration input XOR
-    (defeats loop-invariant hoisting) is counted against us, making the
-    reported number a conservative lower bound on kernel throughput."""
+def _device_loop_gbps(a_bm, x, kernel, interpret, n_small=8, n_large=72, reps=3):
+    """Time the kernel inside an on-device fori_loop and difference the
+    cost of n_large vs n_small iterations (block_until_ready returns
+    before the tunneled device finishes; per-dispatch tunnel latency is
+    tens of ms).  The per-iteration input XOR (defeats loop-invariant
+    hoisting) is counted against us — a conservative lower bound."""
     import jax
     import jax.numpy as jnp
 
     from seaweedfs_tpu.ops import rs_tpu
-
-    kernel = "pallas" if rs_tpu.on_tpu() else "xla"
-    interpret = not rs_tpu.on_tpu()
-    a_bm = rs_tpu.prepare_matrix(parity_m)
-    rng = np.random.default_rng(1)
-    b = mb * 1024 * 1024 // 10
-    b -= b % rs_tpu.BATCH_TILE  # whole tiles: no pad copy in the timed loop
-    x = jax.device_put(rng.integers(0, 256, size=(10, b), dtype=np.uint8))
-    useful = x.nbytes  # [10, B]: exactly the bytes the pipeline ships
 
     @jax.jit
     def many(a_bm, x, n):
@@ -81,18 +115,196 @@ def bench_device(parity_m, mb=256, n_small=8, n_large=72, reps=3):
             int(many(a_bm, x, n))  # scalar fetch = completion barrier
             times[n] = time.perf_counter() - t0
         per_iter = (times[n_large] - times[n_small]) / (n_large - n_small)
-        estimates.append(useful / per_iter)
+        estimates.append(x.nbytes / per_iter)
     # median over reps: a noise hiccup in one n_small run inflates that
     # rep's differenced estimate, so max would be upward-biased.
-    return float(np.median(estimates)), kernel
+    return float(np.median(estimates))
+
+
+def _device_setup(matrix, mb, seed, k_rows):
+    """Shared device-bench preamble: kernel selection, prepared matrix, and
+    a whole-tile [k_rows, B] device-resident input batch."""
+    import jax
+
+    from seaweedfs_tpu.ops import rs_tpu
+
+    kernel = "pallas" if rs_tpu.on_tpu() else "xla"
+    interpret = not rs_tpu.on_tpu()
+    a_bm = rs_tpu.prepare_matrix(matrix)
+    rng = np.random.default_rng(seed)
+    b = mb * 1024 * 1024 // k_rows
+    b -= b % rs_tpu.BATCH_TILE  # whole tiles: no pad copy in the timed loop
+    x = jax.device_put(
+        rng.integers(0, 256, size=(k_rows, b), dtype=np.uint8)
+    )
+    return a_bm, x, kernel, interpret
+
+
+def bench_device_encode(parity_m, mb=256):
+    a_bm, x, kernel, interpret = _device_setup(parity_m, mb, seed=1, k_rows=10)
+    return _device_loop_gbps(a_bm, x, kernel, interpret), kernel
+
+
+def bench_device_rebuild(mb=256):
+    """RS(10,4) rebuild with 4 shards lost: one reconstruction matrix
+    applied to the 10 survivors (ec.rebuild's hot loop,
+    reference ec_encoder.go:233-287 / store_ec.go:339-393)."""
+    from seaweedfs_tpu.ops import gf256
+
+    missing = [1, 4, 10, 12]
+    present = [i for i in range(14) if i not in missing]
+    rmat, use = gf256.reconstruction_matrix(10, 14, present, missing)
+    a_bm, x, kernel, interpret = _device_setup(
+        rmat, mb, seed=2, k_rows=len(use)
+    )
+    return _device_loop_gbps(a_bm, x, kernel, interpret)
+
+
+def bench_multi_volume(n_volumes=8, mb_per_volume=32):
+    """Batched multi-volume encode: n volumes' stripe batches concatenated
+    along the byte axis into one device call (BASELINE config 4)."""
+    from seaweedfs_tpu.ops import rs
+
+    parity_m = rs.RSCodec().matrix[10:]
+    a_bm, x, kernel, interpret = _device_setup(
+        parity_m, n_volumes * mb_per_volume, seed=3, k_rows=10
+    )
+    return _device_loop_gbps(a_bm, x, kernel, interpret)
+
+
+def bench_e2e_encode(backend, mb=256):
+    """File-to-file ec.encode through storage/ec/encoder.py (the deliverable
+    path: disk read -> stripe staging -> kernel -> 14 shard files)."""
+    from seaweedfs_tpu.storage.ec import encoder
+
+    with tempfile.TemporaryDirectory(dir=".") as tmp:
+        base = os.path.join(tmp, "1")
+        size = mb * 1024 * 1024
+        rng = np.random.default_rng(4)
+        with open(base + ".dat", "wb") as f:
+            chunk = 64 * 1024 * 1024
+            remaining = size
+            while remaining > 0:
+                n = min(chunk, remaining)
+                f.write(rng.integers(0, 256, n, dtype=np.uint8).tobytes())
+                remaining -= n
+        t0 = time.perf_counter()
+        encoder.write_ec_files(base, backend=backend)
+        return size / (time.perf_counter() - t0)
+
+
+def bench_degraded_read(sizes=(4096, 65536, 1048576), n=40, batch=64):
+    """Per-needle degraded read: 2 shards down, reconstruct the needle's
+    interval bytes from 10 survivors (store_ec.go:339-393 shape).  Reports
+    p99 per-needle latency for the CPU kernel, a single device call
+    (pays full tunnel/dispatch RTT), and a 64-needle batched device call
+    (the design's amortization: one call reconstructs a whole read burst).
+    """
+    from seaweedfs_tpu.ops import gf256, rs, rs_tpu, rs_cpu
+
+    missing = [3, 11]
+    present = [i for i in range(14) if i not in missing]
+    # degraded read of a data shard: want shard 3's bytes
+    rmat, use = gf256.reconstruction_matrix(10, 14, present, [3])
+    kernel = "pallas" if rs_tpu.on_tpu() else "xla"
+    interpret = not rs_tpu.on_tpu()
+    a_bm = rs_tpu.prepare_matrix(rmat)
+    codec = rs.RSCodec(backend="numpy")
+    rng = np.random.default_rng(5)
+
+    def p99(latencies):
+        return float(np.percentile(np.asarray(latencies) * 1e3, 99))
+
+    out = {}
+
+    def timed_run(apply_fn, n_iters, width):
+        """Warm every distinct input shape (each is a separate jit compile)
+        untimed, then time n_iters calls cycling through the shapes."""
+        for size in sizes:
+            data = rng.integers(0, 256, size=(10, size * width), dtype=np.uint8)
+            apply_fn(np.ascontiguousarray(codec.encode_all(data)[use]))
+        lats = []
+        for i in range(n_iters):
+            size = sizes[i % len(sizes)]
+            data = rng.integers(0, 256, size=(10, size * width), dtype=np.uint8)
+            stack = np.ascontiguousarray(codec.encode_all(data)[use])
+            t0 = time.perf_counter()
+            apply_fn(stack)
+            lats.append((time.perf_counter() - t0) / width)
+        return lats
+
+    for label, fn in (
+        (
+            "native",
+            lambda stack: rs_cpu.apply_matrix_native(rmat, stack),
+        ),
+        (
+            "device_single",
+            lambda stack: np.asarray(
+                rs_tpu.apply_matrix_device(
+                    a_bm,
+                    stack,
+                    kernel=kernel,
+                    interpret=interpret,
+                    k_true=len(use),
+                )
+            ),
+        ),
+    ):
+        out[label] = p99(timed_run(fn, n, width=1))
+
+    # batched: one device call reconstructs `batch` needles (concatenated)
+    out["device_batched"] = p99(
+        timed_run(
+            lambda stack: np.asarray(
+                rs_tpu.apply_matrix_device(
+                    a_bm,
+                    stack,
+                    kernel=kernel,
+                    interpret=interpret,
+                    k_true=len(use),
+                )
+            ),
+            max(9, n // 4),
+            width=batch,
+        )
+    )
+    return out
+
+
+def bench_rig_bandwidths(mb=64):
+    """Measured rig limits that cap every e2e path: sequential disk write
+    and host->device transfer."""
+    import jax
+
+    buf = np.random.default_rng(6).integers(0, 256, mb << 20, dtype=np.uint8)
+    with tempfile.NamedTemporaryFile(dir=".", delete=True) as f:
+        t0 = time.perf_counter()
+        f.write(buf.tobytes())
+        f.flush()
+        os.fsync(f.fileno())
+        disk = buf.nbytes / (time.perf_counter() - t0)
+    jax.device_put(buf[: 1 << 20]).block_until_ready()  # warm
+    t0 = time.perf_counter()
+    jax.device_put(buf).block_until_ready()
+    h2d = buf.nbytes / (time.perf_counter() - t0)
+    return disk / 1e6, h2d / 1e6
 
 
 def main():
+    require_native()
     from seaweedfs_tpu.ops import rs
 
     parity_m = rs.RSCodec().matrix[10:]
     cpu_bps = bench_cpu(parity_m)
-    dev_bps, kernel = bench_device(parity_m)
+    dev_bps, kernel = bench_device_encode(parity_m)
+    rebuild_bps = bench_device_rebuild()
+    multi_bps = bench_multi_volume()
+    degraded = bench_degraded_read()
+    e2e_native = bench_e2e_encode("native")
+    e2e_device = bench_e2e_encode(kernel, mb=64)  # tunnel-bound: keep short
+    disk_mbps, h2d_mbps = bench_rig_bandwidths()
+
     print(
         json.dumps(
             {
@@ -100,6 +312,22 @@ def main():
                 "value": round(dev_bps / 1e9, 3),
                 "unit": "GB/s",
                 "vs_baseline": round(dev_bps / cpu_bps, 2),
+                "extra": {
+                    "cpu_native_gbps": round(cpu_bps / 1e9, 3),
+                    "rebuild_device_gbps": round(rebuild_bps / 1e9, 3),
+                    "multi_volume_device_gbps": round(multi_bps / 1e9, 3),
+                    "encode_e2e_native_gbps": round(e2e_native / 1e9, 3),
+                    "encode_e2e_device_gbps": round(e2e_device / 1e9, 3),
+                    "degraded_p99_ms_native": round(degraded["native"], 3),
+                    "degraded_p99_ms_device_single": round(
+                        degraded["device_single"], 3
+                    ),
+                    "degraded_p99_ms_device_batched": round(
+                        degraded["device_batched"], 3
+                    ),
+                    "disk_write_mbps": round(disk_mbps, 1),
+                    "h2d_mbps": round(h2d_mbps, 1),
+                },
             }
         )
     )
