@@ -6,15 +6,16 @@ import asyncio
 
 import aiohttp
 
-from .lookup import lookup_file_id
+from .lookup import lookup_file_id_with_auth
 
 
 async def delete_file(master: str, fid: str) -> bool:
-    urls = await lookup_file_id(master, fid)
+    urls, auth = await lookup_file_id_with_auth(master, fid)
     if not urls:
         return False
+    headers = {"Authorization": f"Bearer {auth}"} if auth else {}
     async with aiohttp.ClientSession() as s:
-        async with s.delete(urls[0]) as r:
+        async with s.delete(urls[0], headers=headers) as r:
             return r.status < 300
 
 

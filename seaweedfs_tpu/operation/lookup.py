@@ -26,6 +26,20 @@ async def lookup_volume_ids(
 
 async def lookup_file_id(master: str, fid: str) -> list[str]:
     """fid -> list of full data URLs for it."""
-    vid = fid.split(",")[0]
-    locs = await lookup_volume_ids(master, [vid])
-    return [f"http://{l['url']}/{fid}" for l in locs.get(vid, [])]
+    urls, _ = await lookup_file_id_with_auth(master, fid)
+    return urls
+
+
+async def lookup_file_id_with_auth(master: str, fid: str) -> tuple[list[str], str]:
+    """fid -> (full data URLs, master-signed write jwt for that fid).
+    The token authorizes delete/overwrite on the volume servers when the
+    cluster runs with a jwt signing key (LookupVolume auth,
+    reference master_grpc_server_volume.go)."""
+    stub = Stub(channel(server_address.grpc_address(master)), master_pb2, "Seaweed")
+    resp = await stub.LookupVolume(
+        master_pb2.LookupVolumeRequest(volume_or_file_ids=[fid])
+    )
+    entry = resp.volume_id_locations[0]
+    if entry.error:
+        return [], ""
+    return [f"http://{l.url}/{fid}" for l in entry.locations], entry.auth

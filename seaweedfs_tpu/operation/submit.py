@@ -18,5 +18,5 @@ async def submit_data(
     a = await assign(
         master, collection=collection, replication=replication, ttl=ttl
     )
-    await upload_data(f"http://{a.url}/{a.fid}", data, filename, mime)
+    await upload_data(f"http://{a.url}/{a.fid}", data, filename, mime, jwt=a.auth)
     return a.fid

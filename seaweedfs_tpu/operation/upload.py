@@ -16,6 +16,10 @@ def _should_gzip(mime: str, data: bytes) -> bool:
     return any(mime.startswith(p) for p in _COMPRESSIBLE)
 
 
+def _auth_headers(jwt: str) -> dict:
+    return {"Authorization": f"Bearer {jwt}"} if jwt else {}
+
+
 async def upload_data(
     url: str,
     data: bytes,
@@ -23,6 +27,7 @@ async def upload_data(
     mime: str = "",
     compress: bool = True,
     retries: int = 2,
+    jwt: str = "",
 ) -> dict:
     """POST to http://volume/fid as multipart/form-data; returns the
     volume server's JSON ({name, size, eTag})."""
@@ -47,7 +52,7 @@ async def upload_data(
                 if gzipped:
                     part.headers["Content-Encoding"] = "gzip"
                 async with aiohttp.ClientSession() as s:
-                    async with s.post(url, data=mpw) as r:
+                    async with s.post(url, data=mpw, headers=_auth_headers(jwt)) as r:
                         if r.status >= 300:
                             raise RuntimeError(
                                 f"upload {url}: HTTP {r.status} {await r.text()}"
@@ -58,12 +63,14 @@ async def upload_data(
     raise RuntimeError(f"upload {url} failed after {retries + 1} tries: {last_err}")
 
 
-async def upload_multipart_body(url: str, body: bytes, content_type: str = "") -> dict:
+async def upload_multipart_body(
+    url: str, body: bytes, content_type: str = "", jwt: str = ""
+) -> dict:
     """Re-post an already-multipart body (master /submit proxy path)."""
+    headers = {"Content-Type": content_type} if content_type else {}
+    headers.update(_auth_headers(jwt))
     async with aiohttp.ClientSession() as s:
-        async with s.post(
-            url, data=body, headers={"Content-Type": content_type} if content_type else {}
-        ) as r:
+        async with s.post(url, data=body, headers=headers) as r:
             if r.status >= 300:
                 raise RuntimeError(f"upload {url}: HTTP {r.status}")
             return await r.json()

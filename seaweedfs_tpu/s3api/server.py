@@ -112,6 +112,24 @@ class S3ApiServer:
     # -------------------------------------------------------------- routing
 
     async def _dispatch(self, request: web.Request) -> web.StreamResponse:
+        from .. import stats
+
+        code = 500  # unhandled exceptions surface as aiohttp 500s
+        try:
+            resp = await self._dispatch_authed(request)
+            code = resp.status
+            return resp
+        except web.HTTPException as e:
+            code = e.status
+            raise
+        finally:
+            stats.S3_REQUEST_COUNTER.labels(
+                type=request.method,
+                code=str(code),
+                bucket=request.match_info["tail"].partition("/")[0],
+            ).inc()
+
+    async def _dispatch_authed(self, request: web.Request) -> web.StreamResponse:
         try:
             identity = self.iam.authenticate(request)
             body = await verify_payload_hash(request)

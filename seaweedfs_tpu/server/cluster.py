@@ -29,13 +29,16 @@ class LocalCluster:
         filer_kwargs: dict | None = None,
         with_s3: bool = False,
         s3_kwargs: dict | None = None,
+        jwt_signing_key: str = "",
     ):
         import os
 
         self.master = MasterServer(
             port=0, volume_size_limit_mb=volume_size_limit_mb,
             pulse_seconds=pulse_seconds,
+            jwt_signing_key=jwt_signing_key,
         )
+        self.jwt_signing_key = jwt_signing_key
         self.with_filer = with_filer or with_s3
         self.filer_kwargs = filer_kwargs or {}
         self.filer: FilerServer | None = None
@@ -64,7 +67,13 @@ class LocalCluster:
     async def start(self) -> None:
         await self.master.start()
         for spec in self._specs:
-            vs = VolumeServer(masters=[self.master.url], port=0, grpc_port=0, **spec)
+            vs = VolumeServer(
+                masters=[self.master.url],
+                port=0,
+                grpc_port=0,
+                jwt_signing_key=self.jwt_signing_key,
+                **spec,
+            )
             # master http port == grpc port resolution needs master.grpc_port;
             # VolumeServer resolves host:port -> grpc via +10000, so pass the
             # explicit grpc address form

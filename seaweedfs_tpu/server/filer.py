@@ -42,6 +42,7 @@ from ..filer import (
     new_full_path,
     view_from_chunks,
 )
+from .. import stats
 from ..operation.assign import assign as assign_rpc
 from ..operation.delete import delete_files
 from ..operation.upload import upload_data
@@ -68,6 +69,7 @@ class FilerServer:
         meta_log_path: str | None = None,
         save_inside_limit: int = 0,  # inline files <= this many bytes in metadata
         dir_buckets: str = "/buckets",
+        metrics_port: int | None = 0,  # 0 = auto-assign; None = disabled
     ):
         self.masters = masters
         self.ip = ip
@@ -80,6 +82,7 @@ class FilerServer:
         self.rack = rack
         self.save_inside_limit = save_inside_limit
         self.dir_buckets = dir_buckets
+        self.metrics_port = metrics_port
         self.filer = Filer(
             store if store is not None else MemoryStore(),
             delete_file_ids_fn=self._delete_file_ids,
@@ -93,6 +96,7 @@ class FilerServer:
         )
         self._grpc_server: grpc.aio.Server | None = None
         self._http_runner: web.AppRunner | None = None
+        self._metrics_runner: web.AppRunner | None = None
         self._session: aiohttp.ClientSession | None = None
 
     # ----------------------------------------------------------- lifecycle
@@ -119,6 +123,19 @@ class FilerServer:
         site = web.TCPSite(self._http_runner, self.ip, self.port)
         await site.start()
         self.port = site._server.sockets[0].getsockname()[1]
+
+        # /metrics on its own port: the data app's catch-all route owns the
+        # whole namespace, so a filer path "/metrics" must stay a file path
+        # (the reference also serves metrics on a dedicated -metricsPort).
+        if self.metrics_port is not None:
+            mapp = web.Application()
+            mapp.router.add_get("/metrics", stats.metrics_handler)
+            self._metrics_runner = web.AppRunner(mapp)
+            await self._metrics_runner.setup()
+            msite = web.TCPSite(self._metrics_runner, self.ip, self.metrics_port)
+            await msite.start()
+            self.metrics_port = msite._server.sockets[0].getsockname()[1]
+
         self.master_client.client_address = f"{self.ip}:{self.port}"
         await self.master_client.start()
         log.info("filer listening http=%s grpc=%s", self.port, self.grpc_port)
@@ -129,6 +146,8 @@ class FilerServer:
             await self._grpc_server.stop(0.5)
         if self._http_runner:
             await self._http_runner.cleanup()
+        if self._metrics_runner:
+            await self._metrics_runner.cleanup()
         if self._session:
             await self._session.close()
         self.filer.shutdown()
@@ -155,7 +174,11 @@ class FilerServer:
     ) -> filer_pb2.FileChunk:
         a = await self._assign(1, collection, replication, ttl)
         result = await upload_data(
-            f"http://{a.url}/{a.fid}", data, filename=filename, compress=False
+            f"http://{a.url}/{a.fid}",
+            data,
+            filename=filename,
+            compress=False,
+            jwt=a.auth,
         )
         return filer_pb2.FileChunk(
             file_id=a.fid,
@@ -231,11 +254,20 @@ class FilerServer:
     async def _http_dispatch(self, request: web.Request) -> web.StreamResponse:
         try:
             if request.method in ("GET", "HEAD"):
-                return await self.h_get(request)
+                with stats.time_request(
+                    stats.FILER_REQUEST_COUNTER, stats.FILER_REQUEST_HISTOGRAM, "get"
+                ):
+                    return await self.h_get(request)
             if request.method in ("POST", "PUT"):
-                return await self.h_write(request)
+                with stats.time_request(
+                    stats.FILER_REQUEST_COUNTER, stats.FILER_REQUEST_HISTOGRAM, "post"
+                ):
+                    return await self.h_write(request)
             if request.method == "DELETE":
-                return await self.h_delete(request)
+                with stats.time_request(
+                    stats.FILER_REQUEST_COUNTER, stats.FILER_REQUEST_HISTOGRAM, "delete"
+                ):
+                    return await self.h_delete(request)
         except web.HTTPException:
             raise
         except NotFoundError:
@@ -604,6 +636,7 @@ class FilerServer:
         return filer_pb2.AssignVolumeResponse(
             file_id=a.fid,
             count=a.count,
+            auth=a.auth,
             collection=request.collection or self.collection,
             replication=request.replication or self.replication,
             location=filer_pb2.Location(

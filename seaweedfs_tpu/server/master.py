@@ -28,8 +28,10 @@ from dataclasses import dataclass, field
 import grpc
 from aiohttp import web
 
+from .. import stats
 from ..pb import Stub, generic_handler, master_pb2, volume_server_pb2
 from ..pb.rpc import GRPC_OPTIONS, channel
+from ..security import gen_volume_write_jwt
 from ..storage import types as t
 from ..topology import (
     MemorySequencer,
@@ -77,6 +79,8 @@ class MasterServer:
         garbage_threshold: float = 0.3,
         sequencer: MemorySequencer | None = None,
         auto_vacuum: bool = False,
+        jwt_signing_key: str = "",
+        jwt_expires_sec: int = 10,
     ):
         self.ip = ip
         self.port = port
@@ -85,6 +89,8 @@ class MasterServer:
         self.pulse_seconds = pulse_seconds
         self.garbage_threshold = garbage_threshold
         self.auto_vacuum = auto_vacuum
+        self.jwt_signing_key = jwt_signing_key
+        self.jwt_expires_sec = jwt_expires_sec
         self.topo = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             sequencer=sequencer,
@@ -135,6 +141,7 @@ class MasterServer:
         app.router.add_route("*", "/col/delete", self.h_col_delete)
         app.router.add_post("/submit", self.h_submit)
         app.router.add_get("/cluster/status", self.h_cluster_status)
+        app.router.add_get("/metrics", stats.metrics_handler)
         self._http_runner = web.AppRunner(app)
         await self._http_runner.setup()
         site = web.TCPSite(self._http_runner, self.ip, self.port)
@@ -173,6 +180,7 @@ class MasterServer:
                         hb.grpc_port,
                     )
                     log.info("volume server joined: %s", node.url)
+                stats.MASTER_RECEIVED_HEARTBEATS.labels(type="total").inc()
                 if hb.volumes or hb.has_no_volumes or hb.ec_shards or hb.has_no_ec_shards:
                     new_v, del_v, new_ec, del_ec = self.topo.sync_node(
                         node, heartbeat_state_from_pb(hb)
@@ -295,6 +303,9 @@ class MasterServer:
                     count=n,
                     location=node_to_location(nodes[0]),
                     replicas=[node_to_location(x) for x in nodes[1:]],
+                    auth=gen_volume_write_jwt(
+                        self.jwt_signing_key, fid, self.jwt_expires_sec
+                    ),
                 )
             except LookupError:
                 grown = await self._grow_now(option)
@@ -313,6 +324,13 @@ class MasterServer:
                     entry.error = f"volume {vid_s} not found"
                 else:
                     entry.locations.extend(node_to_location(n) for n in nodes)
+                    if "," in vof:
+                        # full-fid lookups get a write token so clients can
+                        # delete/overwrite (master_grpc_server_volume.go
+                        # LookupVolume auth)
+                        entry.auth = gen_volume_write_jwt(
+                            self.jwt_signing_key, vof, self.jwt_expires_sec
+                        )
             except ValueError:
                 entry.error = f"bad volume id {vof!r}"
         return resp
@@ -571,14 +589,15 @@ class MasterServer:
         resp = await self.Assign(req, None)
         if resp.error:
             return web.json_response({"error": resp.error}, status=404)
-        return web.json_response(
-            {
-                "fid": resp.fid,
-                "url": resp.location.url,
-                "publicUrl": resp.location.public_url,
-                "count": resp.count,
-            }
-        )
+        out = {
+            "fid": resp.fid,
+            "url": resp.location.url,
+            "publicUrl": resp.location.public_url,
+            "count": resp.count,
+        }
+        if resp.auth:
+            out["auth"] = resp.auth
+        return web.json_response(out)
 
     async def h_lookup(self, request: web.Request) -> web.Response:
         vof = request.query.get("volumeId", "")
@@ -666,6 +685,7 @@ class MasterServer:
             f"http://{resp.location.url}/{resp.fid}",
             body,
             content_type=request.content_type,
+            jwt=resp.auth,
         )
         result["fid"] = resp.fid
         result["fileUrl"] = f"{resp.location.public_url}/{resp.fid}"

@@ -42,6 +42,8 @@ from ..storage.ec import (
     write_dat_file,
     write_idx_file_from_ec_index,
 )
+from .. import stats
+from ..security import verify_volume_write_jwt
 from ..storage.needle import CrcError, Needle
 from ..storage.store import Store
 from ..storage.volume import CookieMismatch, NotFoundError, Volume, VolumeReadOnly
@@ -67,6 +69,7 @@ class VolumeServer:
         pulse_seconds: int = 5,
         ec_backend: str = "auto",
         read_mode: str = "proxy",  # local | proxy | redirect
+        jwt_signing_key: str = "",
     ):
         if isinstance(max_volume_counts, int):
             max_volume_counts = [max_volume_counts] * len(directories)
@@ -88,6 +91,7 @@ class VolumeServer:
         self.rack = rack
         self.pulse_seconds = pulse_seconds
         self.read_mode = read_mode
+        self.jwt_signing_key = jwt_signing_key
         self.current_master = masters[0] if masters else ""
         self._pending_compacts: dict[int, tuple[str, str, int]] = {}
         self._ec_locations: dict[int, tuple[float, dict[int, list[str]]]] = {}
@@ -118,6 +122,8 @@ class VolumeServer:
 
         app = web.Application(client_max_size=256 * 1024 * 1024)
         app.router.add_get("/status", self.h_status)
+        app.router.add_get("/metrics", stats.metrics_handler)
+        app[stats.metrics.metrics_collect_key()] = self._collect_metrics
         app.router.add_route("*", "/{fid:.*}", self.h_needle)
         self._http_runner = web.AppRunner(app)
         await self._http_runner.setup()
@@ -233,12 +239,58 @@ class VolumeServer:
 
     async def h_needle(self, request: web.Request) -> web.StreamResponse:
         if request.method in ("GET", "HEAD"):
-            return await self.h_read(request)
+            with stats.time_request(
+                stats.VOLUME_SERVER_REQUEST_COUNTER,
+                stats.VOLUME_SERVER_REQUEST_HISTOGRAM,
+                "get",
+            ):
+                return await self.h_read(request)
         if request.method in ("POST", "PUT"):
-            return await self.h_write(request)
+            self._check_write_jwt(request)
+            with stats.time_request(
+                stats.VOLUME_SERVER_REQUEST_COUNTER,
+                stats.VOLUME_SERVER_REQUEST_HISTOGRAM,
+                "post",
+            ):
+                return await self.h_write(request)
         if request.method == "DELETE":
-            return await self.h_delete(request)
+            self._check_write_jwt(request)
+            with stats.time_request(
+                stats.VOLUME_SERVER_REQUEST_COUNTER,
+                stats.VOLUME_SERVER_REQUEST_HISTOGRAM,
+                "delete",
+            ):
+                return await self.h_delete(request)
         raise web.HTTPMethodNotAllowed(request.method, ["GET", "POST", "PUT", "DELETE"])
+
+    def _check_write_jwt(self, request: web.Request) -> None:
+        """Reject unauthorized writes/deletes when a signing key is
+        configured (volume_server_handlers.go:33-120 write guard)."""
+        raw_fid = request.match_info["fid"].strip("/").split(".")[0]
+        if not verify_volume_write_jwt(self.jwt_signing_key, request, raw_fid):
+            raise web.HTTPUnauthorized(text="missing or invalid write jwt")
+
+    def _collect_metrics(self) -> None:
+        """Refresh volume/EC gauges from store state at scrape time
+        (reference gauges set on mount/unmount, ec_shard.go:46).  The gauge
+        is cleared first so deleted collections drop to absent instead of
+        reporting stale counts; with several in-process volume servers
+        sharing the registry (LocalCluster), a scrape reflects the server
+        that answered it — separate processes (the deployed shape) each
+        have their own registry, like the reference."""
+        stats.VOLUME_SERVER_VOLUME_GAUGE.clear()
+        by_key: dict[tuple[str, str], int] = {}
+        for loc in self.store.locations:
+            for v in loc.volumes.values():
+                key = (v.collection, "volume")
+                by_key[key] = by_key.get(key, 0) + 1
+            for ev in loc.ec_volumes.values():
+                key = (ev.collection, "ec_shards")
+                by_key[key] = by_key.get(key, 0) + len(ev.shards)
+        for (collection, kind), count in by_key.items():
+            stats.VOLUME_SERVER_VOLUME_GAUGE.labels(
+                collection=collection, type=kind
+            ).set(count)
 
     def _parse_fid(self, request: web.Request) -> tuple[int, int, int]:
         fid = request.match_info["fid"].strip("/")
@@ -423,6 +475,11 @@ class VolumeServer:
         qs = f"?{request.query_string}{sep}type=replicate"
         errors = []
 
+        headers = {"Content-Type": request.headers.get("Content-Type", "")}
+        if request.headers.get("Authorization"):
+            # replicas validate the same master-issued write jwt
+            headers["Authorization"] = request.headers["Authorization"]
+
         async def one(peer):
             try:
                 async with aiohttp.ClientSession() as s:
@@ -430,7 +487,7 @@ class VolumeServer:
                         request.method,
                         f"http://{peer}{request.path}{qs}",
                         data=body,
-                        headers={"Content-Type": request.headers.get("Content-Type", "")},
+                        headers=headers,
                     ) as r:
                         if r.status >= 300:
                             errors.append(f"{peer}: HTTP {r.status}")

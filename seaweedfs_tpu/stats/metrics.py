@@ -1,0 +1,125 @@
+"""Prometheus metrics, mirroring the reference's key series.
+
+Reference: /root/reference/weed/stats/metrics.go:30-300 — namespace
+"SeaweedFS", per-subsystem counters/gauges/histograms, exposed by every
+server on a /metrics endpoint.  The series kept here are the ones its
+dashboards and the EC inventory rely on:
+
+  SeaweedFS_master_received_heartbeats{type}        metrics.go:57-64
+  SeaweedFS_volumeServer_request_total{type}        metrics.go:206-213
+  SeaweedFS_volumeServer_request_seconds{type}      metrics.go:215-223
+  SeaweedFS_volumeServer_volumes{collection,type}   metrics.go:225-232
+                                                    (type="volume" |
+                                                    "ec_shards", set from
+                                                    store state at scrape —
+                                                    ec_shard.go:46,
+                                                    store_ec.go:41)
+  SeaweedFS_filer_request_total{type}               metrics.go:81-88
+  SeaweedFS_filer_request_seconds{type}             metrics.go:89-97
+  SeaweedFS_s3_request_total{type,code,bucket}      metrics.go:248-255
+
+One process-wide registry: in-process clusters (server/cluster.py) run all
+roles in one interpreter, so the roles share a registry exactly like the
+reference's shared default Gatherer when roles share a `weed server`
+process.
+"""
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+from prometheus_client.exposition import CONTENT_TYPE_LATEST
+
+REGISTRY = CollectorRegistry()
+
+
+def metrics_collect_key():
+    """aiohttp AppKey for a per-server gauge-refresh callback, created
+    lazily so importing stats never pulls in aiohttp."""
+    global _COLLECT_KEY
+    try:
+        return _COLLECT_KEY
+    except NameError:
+        from aiohttp import web
+
+        _COLLECT_KEY = web.AppKey("metrics_collect", object)
+        return _COLLECT_KEY
+
+MASTER_RECEIVED_HEARTBEATS = Counter(
+    "SeaweedFS_master_received_heartbeats",
+    "Counter of master received heartbeats.",
+    ["type"],
+    registry=REGISTRY,
+)
+
+VOLUME_SERVER_REQUEST_COUNTER = Counter(
+    "SeaweedFS_volumeServer_request_total",
+    "Counter of volume server requests.",
+    ["type"],
+    registry=REGISTRY,
+)
+VOLUME_SERVER_REQUEST_HISTOGRAM = Histogram(
+    "SeaweedFS_volumeServer_request_seconds",
+    "Bucketed histogram of volume server request processing time.",
+    ["type"],
+    registry=REGISTRY,
+    buckets=(0.0001, 0.001, 0.01, 0.1, 1.0, 10.0),
+)
+VOLUME_SERVER_VOLUME_GAUGE = Gauge(
+    "SeaweedFS_volumeServer_volumes",
+    "Number of volumes or EC shards.",
+    ["collection", "type"],
+    registry=REGISTRY,
+)
+
+FILER_REQUEST_COUNTER = Counter(
+    "SeaweedFS_filer_request_total",
+    "Counter of filer requests.",
+    ["type"],
+    registry=REGISTRY,
+)
+FILER_REQUEST_HISTOGRAM = Histogram(
+    "SeaweedFS_filer_request_seconds",
+    "Bucketed histogram of filer request processing time.",
+    ["type"],
+    registry=REGISTRY,
+    buckets=(0.0001, 0.001, 0.01, 0.1, 1.0, 10.0),
+)
+
+S3_REQUEST_COUNTER = Counter(
+    "SeaweedFS_s3_request_total",
+    "Counter of s3 requests.",
+    ["type", "code", "bucket"],
+    registry=REGISTRY,
+)
+
+
+@contextmanager
+def time_request(counter: Counter, histogram: Histogram, kind: str):
+    """Count + time one request under the given label."""
+    counter.labels(type=kind).inc()
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        histogram.labels(type=kind).observe(time.perf_counter() - t0)
+
+
+async def metrics_handler(request):
+    """aiohttp GET /metrics handler (the reference's per-server metrics
+    listener, metrics.go StartMetricsServer)."""
+    from aiohttp import web
+
+    collect = request.app.get(metrics_collect_key())
+    if collect is not None:
+        collect()
+    return web.Response(
+        body=generate_latest(REGISTRY), content_type=CONTENT_TYPE_LATEST.split(";")[0]
+    )
