@@ -19,4 +19,4 @@ Layer map (mirrors reference SURVEY.md §1, re-architected):
   utils/     — config, logging, metrics
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"

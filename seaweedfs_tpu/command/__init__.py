@@ -1,0 +1,38 @@
+"""CLI command registry (reference: weed/command/command.go:11-45).
+
+Each command module exposes NAME, HELP, add_args(parser), and
+async run(args).  `python -m seaweedfs_tpu <command> ...` dispatches here.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+from . import benchmark, filer, master, scaffold, server, shell, s3, version, volume
+
+COMMANDS = {
+    m.NAME: m
+    for m in (master, volume, filer, s3, server, shell, benchmark, scaffold, version)
+}
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    parser = argparse.ArgumentParser(
+        prog="seaweedfs_tpu",
+        description="TPU-native SeaweedFS-compatible distributed storage",
+    )
+    sub = parser.add_subparsers(dest="command", metavar="command")
+    for name, mod in sorted(COMMANDS.items()):
+        p = sub.add_parser(name, help=mod.HELP)
+        mod.add_args(p)
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.print_help()
+        return 1
+    try:
+        asyncio.run(COMMANDS[args.command].run(args))
+    except KeyboardInterrupt:
+        return 130
+    return 0

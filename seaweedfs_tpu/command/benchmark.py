@@ -1,0 +1,131 @@
+"""`benchmark` — concurrent cluster write/read benchmark
+(reference: weed/command/benchmark.go:26-196 — `weed benchmark`,
+defaults -c=16 -n=1048576 -size=1024; prints throughput + latency
+percentiles in the README.md:533-583 format)."""
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+
+NAME = "benchmark"
+HELP = "benchmark concurrent writes/reads against a running cluster"
+
+
+def add_args(p) -> None:
+    p.add_argument("-master", dest="master", default="127.0.0.1:9333")
+    p.add_argument("-c", dest="concurrency", type=int, default=16)
+    p.add_argument("-n", dest="count", type=int, default=4096)
+    p.add_argument("-size", dest="size", type=int, default=1024)
+    p.add_argument("-collection", default="")
+    p.add_argument("-replication", default="000")
+    p.add_argument("-noread", dest="no_read", action="store_true")
+
+
+def _percentiles(lats: list[float]) -> dict:
+    if not lats:  # all requests failed: report zeros, not a traceback
+        return {k: 0.0 for k in ("avg", "p50", "p95", "p99", "max")}
+    lats = sorted(lats)
+
+    def at(p):
+        return lats[min(len(lats) - 1, int(p / 100 * len(lats)))] * 1000
+
+    return {
+        "avg": sum(lats) / len(lats) * 1000,
+        "p50": at(50),
+        "p95": at(95),
+        "p99": at(99),
+        "max": lats[-1] * 1000,
+    }
+
+
+def _report(title: str, n_ok: int, n_err: int, total_bytes: int, dt: float, lats):
+    p = _percentiles(lats)
+    dt = dt or 1e-9
+    print(f"\n{title}:")
+    print(f"Completed requests:      {n_ok}")
+    print(f"Failed requests:         {n_err}")
+    print(f"Requests per second:     {n_ok / dt:.2f}")
+    print(f"Transfer rate:           {total_bytes / dt / 1024:.2f} KB/s")
+    print(
+        f"Latency ms (avg/p50/p95/p99/max): "
+        f"{p['avg']:.1f} / {p['p50']:.1f} / {p['p95']:.1f} / "
+        f"{p['p99']:.1f} / {p['max']:.1f}"
+    )
+
+
+async def run(args) -> None:
+    from ..operation import assign, upload_data
+
+    import aiohttp
+
+    fids: list[str] = []
+    lats: list[float] = []
+    errors = 0
+    payload = os.urandom(args.size)
+    sem = asyncio.Semaphore(args.concurrency)
+
+    async with aiohttp.ClientSession() as upload_session:
+
+        async def write_one(i: int):
+            nonlocal errors
+            async with sem:
+                t0 = time.perf_counter()
+                try:
+                    a = await assign(
+                        args.master,
+                        collection=args.collection,
+                        replication=args.replication,
+                    )
+                    await upload_data(
+                        f"http://{a.url}/{a.fid}",
+                        payload,
+                        f"bench{i}",
+                        compress=False,
+                        jwt=a.auth,
+                        session=upload_session,
+                    )
+                    fids.append(a.fid)
+                    lats.append(time.perf_counter() - t0)
+                except Exception:  # noqa: BLE001
+                    errors += 1
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(write_one(i) for i in range(args.count)))
+        dt = time.perf_counter() - t0
+    _report("Write Benchmark", len(fids), errors, len(fids) * args.size, dt, lats)
+
+    if args.no_read or not fids:
+        return
+
+    import aiohttp
+
+    from ..operation import lookup_file_id
+
+    read_lats: list[float] = []
+    read_errors = 0
+
+    async with aiohttp.ClientSession() as session:
+
+        async def read_one(fid: str):
+            nonlocal read_errors
+            async with sem:
+                t0 = time.perf_counter()
+                try:
+                    urls = await lookup_file_id(args.master, fid)
+                    async with session.get(urls[0]) as r:
+                        body = await r.read()
+                        if r.status != 200 or len(body) != args.size:
+                            read_errors += 1
+                            return
+                    read_lats.append(time.perf_counter() - t0)
+                except Exception:  # noqa: BLE001
+                    read_errors += 1
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(read_one(f) for f in fids))
+        dt = time.perf_counter() - t0
+    _report(
+        "Read Benchmark", len(read_lats), read_errors,
+        len(read_lats) * args.size, dt, read_lats,
+    )

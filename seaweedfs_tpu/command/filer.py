@@ -1,0 +1,65 @@
+"""`filer` — run a filer server (reference: weed/command/filer.go)."""
+from __future__ import annotations
+
+import asyncio
+
+NAME = "filer"
+HELP = "start a filer server (namespace tier over the object store)"
+
+
+def add_args(p) -> None:
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8888)
+    p.add_argument(
+        "-port.grpc", dest="grpc_port", type=int, default=0,
+        help="grpc port (default: port+10000)",
+    )
+    p.add_argument(
+        "-master", dest="masters", default="127.0.0.1:9333",
+        help="comma-separated master servers",
+    )
+    p.add_argument("-collection", default="")
+    p.add_argument("-defaultReplicaPlacement", dest="replication", default="")
+    p.add_argument("-dataCenter", dest="data_center", default="")
+    p.add_argument(
+        "-maxMB", dest="max_mb", type=int, default=4,
+        help="auto-chunk uploads into chunks of this size",
+    )
+    p.add_argument(
+        "-db", dest="db_path", default="",
+        help="sqlite metadata store path (default: in-memory)",
+    )
+    p.add_argument(
+        "-metaLog", dest="meta_log_path", default="",
+        help="append-only metadata event log path",
+    )
+    p.add_argument(
+        "-metricsPort", dest="metrics_port", type=int, default=0,
+        help="prometheus /metrics port (0 = auto-assign)",
+    )
+
+
+def build_filer_server(args):
+    from ..filer.filerstore import MemoryStore, SqliteStore
+    from ..server.filer import FilerServer
+
+    store = SqliteStore(args.db_path) if args.db_path else MemoryStore()
+    return FilerServer(
+        masters=[m.strip() for m in args.masters.split(",") if m.strip()],
+        store=store,
+        ip=args.ip,
+        port=args.port,
+        grpc_port=args.grpc_port,
+        max_mb=args.max_mb,
+        collection=args.collection,
+        replication=args.replication,
+        data_center=args.data_center,
+        meta_log_path=args.meta_log_path or None,
+        metrics_port=args.metrics_port,
+    )
+
+
+async def run(args) -> None:
+    fs = build_filer_server(args)
+    await fs.start()
+    await asyncio.Event().wait()

@@ -1,0 +1,54 @@
+"""`master` — run a master server (reference: weed/command/master.go)."""
+from __future__ import annotations
+
+import asyncio
+
+from ..utils import config as config_util
+
+NAME = "master"
+HELP = "start a master server"
+
+
+def add_args(p) -> None:
+    p.add_argument("-ip", default="127.0.0.1", help="listen address")
+    p.add_argument("-port", type=int, default=9333, help="http port")
+    p.add_argument(
+        "-port.grpc", dest="grpc_port", type=int, default=0,
+        help="grpc port (default: port+10000)",
+    )
+    p.add_argument(
+        "-volumeSizeLimitMB", dest="volume_size_limit_mb", type=int,
+        default=30 * 1024, help="roll to a new volume past this size",
+    )
+    p.add_argument(
+        "-defaultReplication", dest="default_replication", default="000",
+        help="XYZ replica placement when an assign doesn't specify one",
+    )
+    p.add_argument("-pulseSeconds", dest="pulse_seconds", type=int, default=5)
+    p.add_argument(
+        "-garbageThreshold", dest="garbage_threshold", type=float, default=0.3,
+        help="vacuum when garbage ratio exceeds this",
+    )
+    p.add_argument(
+        "-autoVacuum", dest="auto_vacuum", action="store_true",
+        help="periodically drive the vacuum protocol",
+    )
+
+
+async def run(args) -> None:
+    from ..server.master import MasterServer
+
+    ms = MasterServer(
+        ip=args.ip,
+        port=args.port,
+        grpc_port=args.grpc_port,
+        volume_size_limit_mb=args.volume_size_limit_mb,
+        default_replication=args.default_replication,
+        pulse_seconds=args.pulse_seconds,
+        garbage_threshold=args.garbage_threshold,
+        auto_vacuum=args.auto_vacuum,
+        jwt_signing_key=config_util.jwt_signing_key(),
+        jwt_expires_sec=config_util.jwt_expires_sec(),
+    )
+    await ms.start()
+    await asyncio.Event().wait()  # serve until interrupted

@@ -1,0 +1,49 @@
+"""`s3` — run the S3 gateway (reference: weed/command/s3.go)."""
+from __future__ import annotations
+
+import asyncio
+import json
+
+NAME = "s3"
+HELP = "start an S3-compatible gateway over a filer"
+
+
+def add_args(p) -> None:
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8333)
+    p.add_argument(
+        "-filer", dest="filer", default="127.0.0.1:8888",
+        help="filer host:port",
+    )
+    p.add_argument(
+        "-filer.grpc", dest="filer_grpc", default="",
+        help="filer grpc host:port (default: filer port+10000)",
+    )
+    p.add_argument(
+        "-config", dest="s3_config", default="",
+        help="s3 identities json (reference s3.json: "
+        '{"identities":[{"name",...,"credentials":[...],"actions":[...]}]})',
+    )
+
+
+def build_s3_server(args):
+    from ..s3api import S3ApiServer
+    from ..s3api.auth import IdentityAccessManagement
+
+    iam = None
+    if args.s3_config:
+        with open(args.s3_config) as f:
+            iam = IdentityAccessManagement.from_config(json.load(f))
+    return S3ApiServer(
+        filer_address=args.filer,
+        filer_grpc_address=args.filer_grpc,
+        ip=args.ip,
+        port=args.port,
+        iam=iam,
+    )
+
+
+async def run(args) -> None:
+    s3 = build_s3_server(args)
+    await s3.start()
+    await asyncio.Event().wait()

@@ -1,0 +1,35 @@
+"""`scaffold` — print commented config templates
+(reference: weed/command/scaffold.go + command/scaffold/*.toml)."""
+from __future__ import annotations
+
+NAME = "scaffold"
+HELP = "print a commented TOML config template"
+
+# Only templates whose keys are actually consumed belong here — an
+# operator tuning a scaffolded knob must see an effect (security.toml is
+# read by utils/config.py jwt_signing_key/jwt_expires_sec; the filer store
+# and master growth knobs are CLI flags, not config files, for now).
+TEMPLATES = {
+    "security": """\
+# security.toml — discovered in ./, ~/.seaweedfs/, /etc/seaweedfs/
+# (seaweedfs_tpu/utils/config.py; reference weed/util/config.go)
+
+[jwt.signing]
+# When set, the master signs a JWT for every assigned fid and volume
+# servers reject writes/deletes without a valid matching token.
+key = ""
+# Seconds an issued write token stays valid.
+expires_after_seconds = 10
+""",
+}
+
+
+def add_args(p) -> None:
+    p.add_argument(
+        "-config", dest="which", default="security",
+        choices=sorted(TEMPLATES),
+    )
+
+
+async def run(args) -> None:
+    print(TEMPLATES[args.which], end="")

@@ -1,0 +1,100 @@
+"""`server` — master + volume (+ filer, + s3) in one process
+(reference: weed/command/server.go)."""
+from __future__ import annotations
+
+import asyncio
+
+from ..utils import config as config_util
+
+NAME = "server"
+HELP = "start master + volume server (+ -filer, + -s3) in one process"
+
+
+def add_args(p) -> None:
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-master.port", dest="master_port", type=int, default=9333)
+    p.add_argument("-volume.port", dest="volume_port", type=int, default=8080)
+    p.add_argument("-dir", default=".", help="volume data directories (comma-separated)")
+    p.add_argument("-volume.max", dest="volume_max", default="8")
+    p.add_argument(
+        "-volumeSizeLimitMB", dest="volume_size_limit_mb", type=int, default=30 * 1024
+    )
+    p.add_argument("-defaultReplication", dest="default_replication", default="000")
+    p.add_argument(
+        "-ec.backend", dest="ec_backend", default="auto",
+        choices=["auto", "cpu", "native", "numpy", "xla", "pallas"],
+    )
+    p.add_argument("-filer", action="store_true", help="also run a filer")
+    p.add_argument("-filer.port", dest="filer_port", type=int, default=8888)
+    p.add_argument("-filer.db", dest="filer_db", default="")
+    p.add_argument("-s3", action="store_true", help="also run the S3 gateway")
+    p.add_argument("-s3.port", dest="s3_port", type=int, default=8333)
+    p.add_argument("-s3.config", dest="s3_config", default="")
+
+
+async def run(args) -> None:
+    from ..server.master import MasterServer
+    from ..server.volume import VolumeServer
+
+    jwt_key = config_util.jwt_signing_key()
+    ms = MasterServer(
+        ip=args.ip,
+        port=args.master_port,
+        volume_size_limit_mb=args.volume_size_limit_mb,
+        default_replication=args.default_replication,
+        jwt_signing_key=jwt_key,
+        jwt_expires_sec=config_util.jwt_expires_sec(),
+    )
+    await ms.start()
+
+    dirs = [d.strip() for d in args.dir.split(",") if d.strip()]
+    counts = [int(c) for c in str(args.volume_max).split(",")]
+    if len(counts) == 1:
+        counts = counts * len(dirs)
+    vs = VolumeServer(
+        masters=[ms.advertise_url],
+        directories=dirs,
+        ip=args.ip,
+        port=args.volume_port,
+        max_volume_counts=counts,
+        ec_backend=args.ec_backend,
+        jwt_signing_key=jwt_key,
+    )
+    await vs.start()
+
+    if args.filer or args.s3:
+        from argparse import Namespace
+
+        from .filer import build_filer_server
+
+        fs = build_filer_server(
+            Namespace(
+                masters=ms.advertise_url,
+                db_path=args.filer_db,
+                ip=args.ip,
+                port=args.filer_port,
+                grpc_port=0,
+                max_mb=4,
+                collection="",
+                replication="",
+                data_center="",
+                meta_log_path="",
+                metrics_port=0,
+            )
+        )
+        await fs.start()
+        if args.s3:
+            from .s3 import build_s3_server
+
+            s3 = build_s3_server(
+                Namespace(
+                    filer=f"{args.ip}:{fs.port}",
+                    filer_grpc=f"{fs.ip}:{fs.grpc_port}",
+                    ip=args.ip,
+                    port=args.s3_port,
+                    s3_config=args.s3_config,
+                )
+            )
+            await s3.start()
+
+    await asyncio.Event().wait()

@@ -1,0 +1,18 @@
+"""`shell` — interactive admin REPL (reference: weed/command/shell.go)."""
+from __future__ import annotations
+
+NAME = "shell"
+HELP = "interactive admin shell (ec.encode, volume.balance, ...)"
+
+
+def add_args(p) -> None:
+    p.add_argument(
+        "-master", dest="masters", default="127.0.0.1:9333",
+        help="comma-separated master servers (host:port or host:port.grpcport)",
+    )
+
+
+async def run(args) -> None:
+    from ..shell import repl
+
+    await repl([m.strip() for m in args.masters.split(",") if m.strip()])

@@ -1,0 +1,17 @@
+"""`version` — print the framework version."""
+from __future__ import annotations
+
+NAME = "version"
+HELP = "print version"
+
+
+def add_args(p) -> None:
+    pass
+
+
+async def run(args) -> None:
+    import jax
+
+    from .. import __version__
+
+    print(f"seaweedfs-tpu {__version__} (jax {jax.__version__})")

@@ -1,0 +1,68 @@
+"""`volume` — run a volume server (reference: weed/command/volume.go)."""
+from __future__ import annotations
+
+import asyncio
+
+from ..utils import config as config_util
+
+NAME = "volume"
+HELP = "start a volume server"
+
+
+def add_args(p) -> None:
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8080)
+    p.add_argument(
+        "-port.grpc", dest="grpc_port", type=int, default=0,
+        help="grpc port (default: port+10000)",
+    )
+    p.add_argument(
+        "-dir", default=".", help="comma-separated data directories"
+    )
+    p.add_argument(
+        "-max", dest="max_volume_counts", default="8",
+        help="max volumes per dir (comma-separated to match -dir)",
+    )
+    p.add_argument(
+        "-mserver", dest="masters", default="127.0.0.1:9333",
+        help="comma-separated master servers",
+    )
+    p.add_argument("-publicUrl", dest="public_url", default="")
+    p.add_argument("-dataCenter", dest="data_center", default="")
+    p.add_argument("-rack", default="")
+    p.add_argument("-pulseSeconds", dest="pulse_seconds", type=int, default=5)
+    p.add_argument(
+        "-ec.backend", dest="ec_backend", default="auto",
+        choices=["auto", "cpu", "native", "numpy", "xla", "pallas"],
+        help="erasure-coding kernel backend (auto = pallas on TPU)",
+    )
+    p.add_argument(
+        "-readMode", dest="read_mode", default="proxy",
+        choices=["local", "proxy", "redirect"],
+    )
+
+
+async def run(args) -> None:
+    from ..server.volume import VolumeServer
+
+    dirs = [d.strip() for d in args.dir.split(",") if d.strip()]
+    counts = [int(c) for c in str(args.max_volume_counts).split(",")]
+    if len(counts) == 1:
+        counts = counts * len(dirs)
+    vs = VolumeServer(
+        masters=[m.strip() for m in args.masters.split(",") if m.strip()],
+        directories=dirs,
+        ip=args.ip,
+        port=args.port,
+        grpc_port=args.grpc_port,
+        public_url=args.public_url,
+        max_volume_counts=counts,
+        data_center=args.data_center,
+        rack=args.rack,
+        pulse_seconds=args.pulse_seconds,
+        ec_backend=args.ec_backend,
+        read_mode=args.read_mode,
+        jwt_signing_key=config_util.jwt_signing_key(),
+    )
+    await vs.start()
+    await asyncio.Event().wait()

@@ -28,6 +28,7 @@ async def upload_data(
     compress: bool = True,
     retries: int = 2,
     jwt: str = "",
+    session: aiohttp.ClientSession | None = None,
 ) -> dict:
     """POST to http://volume/fid as multipart/form-data; returns the
     volume server's JSON ({name, size, eTag})."""
@@ -51,13 +52,17 @@ async def upload_data(
                 )
                 if gzipped:
                     part.headers["Content-Encoding"] = "gzip"
-                async with aiohttp.ClientSession() as s:
+                s = session if session is not None else aiohttp.ClientSession()
+                try:
                     async with s.post(url, data=mpw, headers=_auth_headers(jwt)) as r:
                         if r.status >= 300:
                             raise RuntimeError(
                                 f"upload {url}: HTTP {r.status} {await r.text()}"
                             )
                         return await r.json()
+                finally:
+                    if session is None:
+                        await s.close()
         except Exception as e:  # noqa: BLE001 — retry any transport error
             last_err = e
     raise RuntimeError(f"upload {url} failed after {retries + 1} tries: {last_err}")
