@@ -4,7 +4,8 @@ gateways, shell, and tests.
 """
 from .assign import assign
 from .delete import delete_file
-from .lookup import lookup_file_id, lookup_volume_ids
+from .lookup import lookup_file_id, lookup_file_id_with_auth, lookup_volume_ids
+from .tail_volume import tail_volume_from_source
 from .upload import upload_data, upload_multipart_body
 from .submit import submit_data
 
@@ -12,7 +13,9 @@ __all__ = [
     "assign",
     "delete_file",
     "lookup_file_id",
+    "lookup_file_id_with_auth",
     "lookup_volume_ids",
+    "tail_volume_from_source",
     "upload_data",
     "upload_multipart_body",
     "submit_data",

@@ -694,6 +694,74 @@ class VolumeServer:
                     os.remove(p)
         return volume_server_pb2.VacuumVolumeCleanupResponse()
 
+    # ------------------------------------------------------------------ gRPC: tail sync
+
+    async def VolumeTailSender(self, request, context):
+        """Stream records appended after since_ns; with a nonzero idle
+        timeout, drain that many idle seconds then end the stream
+        (volume_grpc_tail.go VolumeTailSender)."""
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND, f"volume {request.volume_id} not found"
+            )
+        chunk_limit = 256 * 1024
+        since_ns = request.since_ns
+        draining = request.idle_timeout_seconds
+        # position once by timestamp, then follow appends by byte offset —
+        # a cursor that advances even for v1/v2 records (no timestamps)
+        # and never re-reads the index per poll
+        pos = await asyncio.to_thread(v.find_offset_since, since_ns)
+        while True:
+            advanced = False
+            for offset, hdr, rest, n in v.scan_records(pos):
+                pos = offset + len(hdr) + len(rest)
+                advanced = True
+                if 0 < n.append_at_ns <= since_ns:
+                    continue  # initial positioning backs up one record
+                for i in range(0, max(len(rest), 1), chunk_limit):
+                    part = rest[i : i + chunk_limit]
+                    yield volume_server_pb2.VolumeTailSenderResponse(
+                        needle_header=hdr,
+                        needle_body=part,
+                        is_last_chunk=i + chunk_limit >= len(rest),
+                    )
+            if not advanced:
+                # no new data: keepalive + drain countdown
+                yield volume_server_pb2.VolumeTailSenderResponse(is_last_chunk=True)
+                if request.idle_timeout_seconds > 0:
+                    draining -= 1
+                    if draining <= 0:
+                        return
+            else:
+                draining = request.idle_timeout_seconds
+            await asyncio.sleep(1)
+
+    async def VolumeTailReceiver(self, request, context):
+        """Pull another server's appends into the local volume — how a new
+        or stale replica catches up (volume_grpc_tail.go
+        VolumeTailReceiver)."""
+        from ..operation.tail_volume import tail_volume_from_source
+
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            await context.abort(
+                grpc.StatusCode.NOT_FOUND, f"volume {request.volume_id} not found"
+            )
+
+        async def write(n):
+            await asyncio.to_thread(self.store.write_needle, request.volume_id, n)
+
+        await tail_volume_from_source(
+            request.source_volume_server,
+            request.volume_id,
+            request.since_ns,
+            int(request.idle_timeout_seconds),
+            write,
+            version=v.version,
+        )
+        return volume_server_pb2.VolumeTailReceiverResponse()
+
     # ------------------------------------------------------------------ gRPC: copy
 
     async def CopyFile(self, request, context):

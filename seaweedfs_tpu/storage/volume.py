@@ -98,10 +98,20 @@ class Volume:
         base = self.base_name(dirname, vid, collection)
         self.dat_path = base + ".dat"
         self.idx_path = base + ".idx"
+        self.note_path = base + ".note"
 
         if os.path.exists(self.dat_path):
             with open(self.dat_path, "rb") as f:
                 self.super_block = SuperBlock.from_bytes(f.read(SUPER_BLOCK_SIZE))
+            if os.path.exists(self.note_path):
+                # .note marks a volume that was open for writing and not
+                # cleanly closed (crash / kill); _recover_tail below heals
+                # the torn tail (reference volume_write.go:85 marker)
+                import logging
+
+                logging.getLogger("volume").warning(
+                    "volume %d was not cleanly closed; recovering tail", vid
+                )
             nm = needle_map.CompactMap.load_from_idx(self.idx_path, self.version)
             self._recover_tail(nm)
         else:
@@ -116,6 +126,10 @@ class Volume:
             nm = needle_map.CompactMap()
         self._state = _ReadState(open(self.dat_path, "r+b"), nm)
         self._idx = open(self.idx_path, "ab")
+        # dirty marker: present while the volume is open for writing, so a
+        # crash is detectable on the next load; removed on clean close
+        with open(self.note_path, "w") as f:
+            f.write("open for writing\n")
 
     @property
     def nm(self) -> needle_map.CompactMap:
@@ -303,27 +317,36 @@ class Volume:
             compact_revision=self.super_block.compaction_revision,
         )
 
+    def _walk_records(self, start_offset: int, st: _ReadState | None = None):
+        """Yield (offset, header_bytes, rest_bytes, header_size, Needle) for
+        every record from start_offset to EOF.  One _ReadState is captured
+        for the whole walk so a concurrent vacuum swap can't mix old
+        offsets with the compacted file (same discipline as read())."""
+        st = st or self._state
+        fd = st.dat.fileno()
+        size = os.fstat(fd).st_size
+        offset = max(start_offset, SUPER_BLOCK_SIZE)
+        while offset + t.NEEDLE_HEADER_SIZE <= size:
+            hdr = os.pread(fd, t.NEEDLE_HEADER_SIZE, offset)
+            if len(hdr) < t.NEEDLE_HEADER_SIZE:
+                break
+            _, _, nsize = Needle.parse_header(hdr)
+            body_size = max(nsize, 0)
+            total = needle_mod.actual_size(body_size, self.version)
+            if offset + total > size:
+                break  # torn record at EOF — stop, don't crash
+            rest = os.pread(fd, total - t.NEEDLE_HEADER_SIZE, offset + len(hdr))
+            n = Needle.from_bytes(hdr + rest, self.version, verify=False)
+            yield offset, hdr, rest, nsize, n
+            offset += total
+
     def scan(self, include_deleted: bool = False):
         """Yield (offset, Needle) for every record in .dat file order —
         the scan_volume_file analogue used by vacuum/fsck/ec.decode."""
-        size = self.content_size
-        offset = SUPER_BLOCK_SIZE
-        with open(self.dat_path, "rb") as f:
-            f.seek(offset)
-            while offset + t.NEEDLE_HEADER_SIZE <= size:
-                hdr = f.read(t.NEEDLE_HEADER_SIZE)
-                if len(hdr) < t.NEEDLE_HEADER_SIZE:
-                    break
-                cookie, nid, nsize = Needle.parse_header(hdr)
-                body_size = max(nsize, 0)
-                total = needle_mod.actual_size(body_size, self.version)
-                if offset + total > size:
-                    break  # torn record at EOF — stop, don't crash
-                rest = f.read(total - t.NEEDLE_HEADER_SIZE)
-                n = Needle.from_bytes(hdr + rest, self.version, verify=False)
-                if include_deleted or t.size_is_valid(nsize):
-                    yield offset, n
-                offset += total
+        self._dat.flush()
+        for offset, _, _, nsize, n in self._walk_records(SUPER_BLOCK_SIZE):
+            if include_deleted or t.size_is_valid(nsize):
+                yield offset, n
 
     def sync(self) -> None:
         with self._lock:
@@ -334,15 +357,70 @@ class Volume:
 
     def close(self) -> None:
         with self._lock:
+            clean = not self._dat.closed or not self._idx.closed
             if not self._dat.closed:
                 self._dat.flush()
                 self._dat.close()
             if not self._idx.closed:
                 self._idx.flush()
                 self._idx.close()
+            if clean and os.path.exists(self.note_path):
+                os.remove(self.note_path)
 
     def destroy(self) -> None:
         self.close()
-        for p in (self.dat_path, self.idx_path):
+        for p in (self.dat_path, self.idx_path, self.note_path):
             if os.path.exists(p):
                 os.remove(p)
+
+    # -- tail sync (incremental replica catch-up) ---------------------------
+
+    def _append_at_ns_at(self, fd: int, offset: int, size: int) -> int:
+        """The v3 append timestamp of the record at `offset` (8 bytes just
+        before the padding, needle.py to_bytes)."""
+        total = needle_mod.actual_size(size, self.version)
+        pad = needle_mod.padding_length(size, self.version)
+        buf = os.pread(fd, 8, offset + total - pad - 8)
+        return int.from_bytes(buf, "big")
+
+    def find_offset_since(self, since_ns: int) -> int:
+        """A .dat offset from which scanning forward covers every record
+        with append_at_ns > since_ns — the BinarySearchByAppendAtNs
+        analogue (volume_backup.go).  Binary search runs over the
+        live-needle map entries (offsets increase in append order); the
+        result backs up to the preceding live record so delete-tombstone
+        records between live needles are never skipped — callers filter by
+        timestamp.  One _ReadState capture keeps the search consistent
+        under a concurrent vacuum swap (a swap rewrites offsets AND
+        timestamps' offsets together)."""
+        if since_ns == 0 or self.version != needle_mod.VERSION3:
+            # from the beginning; v1/v2 records carry no timestamps, so a
+            # nonzero cursor can't be honored — resend everything
+            return SUPER_BLOCK_SIZE
+        st = self._state
+        fd = st.dat.fileno()
+        entries = sorted(
+            (off, size)
+            for _, off, size in st.nm.items()
+            if off > 0 and t.size_is_valid(size)
+        )
+        lo, hi = 0, len(entries)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if self._append_at_ns_at(fd, *entries[mid]) > since_ns:
+                hi = mid
+            else:
+                lo = mid + 1
+        # back up one live record: tombstones appended between live needle
+        # lo-1 and live needle lo may still be newer than the cursor
+        if lo == 0:
+            return SUPER_BLOCK_SIZE
+        return entries[lo - 1][0]
+
+    def scan_records(self, start_offset: int):
+        """Yield (offset, header_bytes, rest_bytes, Needle) for every record
+        from start_offset to EOF — the wire-shaped scan tail sync streams
+        (ScanVolumeFileFrom, volume_grpc_tail.go)."""
+        self._dat.flush()
+        for offset, hdr, rest, _, n in self._walk_records(start_offset):
+            yield offset, hdr, rest, n
