@@ -10,6 +10,8 @@ the volume server's configured backend (TPU MXU kernels by default).
 """
 from __future__ import annotations
 
+import math
+
 from ..pb import master_pb2, volume_server_pb2
 from ..storage.ec import TOTAL_SHARDS
 from .command_env import CommandEnv, TopoNode
@@ -27,23 +29,77 @@ def node_shards(node: TopoNode, vid: int) -> list[int]:
     return []
 
 
+def rack_of(node: TopoNode) -> tuple[str, str]:
+    return (node.data_center, node.rack)
+
+
+def held_shard_count(n: TopoNode) -> int:
+    """Total EC shards a node holds across all volumes."""
+    return sum(bin(s["ec_index_bits"]).count("1") for s in n.ec_shards)
+
+
+def rack_cap(n_shards: int, racks) -> int:
+    """Per-rack shard ceiling: ceil(n_shards / n_racks)."""
+    return math.ceil(n_shards / len(racks)) if racks else n_shards
+
+
+def free_shard_slots(n: TopoNode) -> int:
+    """Receive capacity in SHARD units: volume slots not taken by regular
+    volumes, times 14, minus EC shards already held.  (free_slots() is in
+    volume-slot units and counts one held shard as a whole slot — using it
+    directly would declare a receiver full after one shard.)"""
+    return (
+        sum(n.max_volume_counts.values()) - len(n.volumes)
+    ) * TOTAL_SHARDS - held_shard_count(n)
+
+
+def group_by_rack(nodes: list[TopoNode]) -> dict[tuple[str, str], list[TopoNode]]:
+    racks: dict[tuple[str, str], list[TopoNode]] = {}
+    for n in nodes:
+        racks.setdefault(rack_of(n), []).append(n)
+    return racks
+
+
 def balanced_ec_distribution(nodes: list[TopoNode], n_shards: int = TOTAL_SHARDS):
-    """Round-robin shards over nodes sorted by free slots
-    (balancedEcDistribution command_ec_encode.go:253-269).  Returns
-    [(node, [shard ids])]."""
+    """Spread shards rack-aware: each (dc, rack) holds at most
+    ceil(n_shards / n_racks) shards, minimising how many shards one rack
+    failure takes out (with >=4 racks and free capacity that stays within
+    the 4-shard RS tolerance; fewer racks or a full cluster can exceed it
+    — the capacity fallbacks below prefer placing somewhere over failing);
+    within a rack,
+    shards round-robin over nodes by free slots (the reference balances
+    across racks in command_ec_common.go pickRackToBalanceShardsInto and
+    within them via balancedEcDistribution, command_ec_encode.go:253-269).
+    Returns [(node, [shard ids])]."""
     ranked = ec_nodes_by_freeness(nodes)
     if not ranked:
         return []
+    racks = group_by_rack(ranked)
+    rack_limit = rack_cap(n_shards, racks)
+    rack_count = {r: 0 for r in racks}
+    rack_rr = {r: 0 for r in racks}  # round-robin cursor within the rack
     alloc = {n.url: [] for n in ranked}
-    free = {n.url: max(0, n.free_slots() * TOTAL_SHARDS) for n in ranked}
-    i = 0
+    free = {n.url: max(0, free_shard_slots(n)) for n in ranked}
+
+    def rack_free(r):
+        return sum(free[n.url] for n in racks[r])
+
     for sid in range(n_shards):
-        for _ in range(len(ranked)):
-            n = ranked[i % len(ranked)]
-            i += 1
-            if free[n.url] > 0 or all(f <= 0 for f in free.values()):
+        # least-loaded rack under the cap with free space; fall back to
+        # ignoring the cap, then to ignoring free space, so every shard
+        # lands somewhere even on tiny clusters
+        candidates = [
+            r for r in racks if rack_count[r] < rack_limit and rack_free(r) > 0
+        ] or [r for r in racks if rack_free(r) > 0] or list(racks)
+        r = min(candidates, key=lambda r: (rack_count[r], -rack_free(r)))
+        members = racks[r]
+        for _ in range(len(members)):
+            n = members[rack_rr[r] % len(members)]
+            rack_rr[r] += 1
+            if free[n.url] > 0 or all(free[m.url] <= 0 for m in members):
                 alloc[n.url].append(sid)
                 free[n.url] -= 1
+                rack_count[r] += 1
                 break
     return [(n, alloc[n.url]) for n in ranked if alloc[n.url]]
 
@@ -244,44 +300,170 @@ async def cmd_ec_rebuild(env, args):
         env.write(f"ec volume {vid}: rebuilt {list(resp.rebuilt_shard_ids)}")
 
 
+def plan_rack_moves(nodes: list[TopoNode]) -> list[tuple[int, str, int, TopoNode, TopoNode]]:
+    """Per EC volume: move shards out of racks holding more than
+    ceil(14 / n_racks) of its shards, into the rack holding fewest
+    (balanceEcShardsAcrossRacks, command_ec_common.go).  Mutates the
+    nodes' ec_index_bits to reflect planned moves; returns
+    [(vid, collection, shard_id, src_node, dst_node)]."""
+    racks = group_by_rack(nodes)
+    if len(racks) <= 1:
+        return []
+    rack_limit = rack_cap(TOTAL_SHARDS, racks)
+    moves = []
+    vids = sorted(
+        {s["id"] for n in nodes for s in n.ec_shards}
+    )
+    for vid in vids:
+        collection = next(
+            (s["collection"] for n in nodes for s in n.ec_shards if s["id"] == vid),
+            "",
+        )
+        # one scan per volume; maintained incrementally across its moves
+        holders = {n.url: node_shards(n, vid) for n in nodes}
+        loads = {
+            r: sum(len(holders[n.url]) for n in racks[r]) for r in racks
+        }
+        while True:
+            over = [r for r in racks if loads[r] > rack_limit]
+            if not over:
+                break
+            src_rack = max(over, key=lambda r: loads[r])
+            # only racks with free EC capacity can receive
+            # (pickRackToBalanceShardsInto's freeEcSlot requirement)
+            open_racks = [
+                r
+                for r in racks
+                if r != src_rack
+                and any(free_shard_slots(n) > 0 for n in racks[r])
+            ]
+            if not open_racks:
+                break
+            dst_rack = min(open_racks, key=lambda r: loads[r])
+            if loads[dst_rack] >= rack_limit:
+                break
+            src_node = next(
+                n for n in reversed(racks[src_rack]) if holders[n.url]
+            )
+            sid = holders[src_node.url][-1]
+            # within the destination rack, the freest node without this
+            # volume's shards
+            dst_node = min(
+                (n for n in racks[dst_rack] if free_shard_slots(n) > 0),
+                key=lambda n: (len(holders[n.url]), -free_shard_slots(n)),
+            )
+            moves.append((vid, collection, sid, src_node, dst_node))
+            _move_shard_bits(src_node, dst_node, vid, collection, sid)
+            holders[src_node.url].remove(sid)
+            holders[dst_node.url].append(sid)
+            loads[src_rack] -= 1
+            loads[dst_rack] += 1
+    return moves
+
+
+def _move_shard_bits(src: TopoNode, dst: TopoNode, vid, collection, sid) -> None:
+    """Update the in-memory topology snapshot to reflect a planned move."""
+    for s in src.ec_shards:
+        if s["id"] == vid:
+            s["ec_index_bits"] &= ~(1 << sid)
+    for s in dst.ec_shards:
+        if s["id"] == vid:
+            s["ec_index_bits"] |= 1 << sid
+            return
+    dst.ec_shards.append(
+        {"id": vid, "collection": collection, "ec_index_bits": 1 << sid}
+    )
+
+
+def plan_node_moves(nodes: list[TopoNode]) -> list[tuple[int, str, int, TopoNode, TopoNode]]:
+    """Even aggregate shard counts across nodes (the reference's
+    balanceEcShardsWithinRacks + balanceEcRacks rolled into one hi/lo
+    loop) — a cross-rack move is only allowed while it keeps the
+    destination rack under the per-volume cap plan_rack_moves enforces.
+    Mutates the nodes' ec_index_bits; returns
+    [(vid, collection, shard_id, src_node, dst_node)]."""
+    racks = group_by_rack(nodes)
+    rack_limit = rack_cap(TOTAL_SHARDS, racks)
+
+    def vid_rack_load(rack: tuple[str, str], vid: int) -> int:
+        return sum(len(node_shards(n, vid)) for n in racks[rack])
+
+    counts = {
+        n.url: held_shard_count(n) for n in nodes
+    }
+    by_url = {n.url: n for n in nodes}
+    moves: list[tuple[int, str, int, TopoNode, TopoNode]] = []
+
+    def try_move(hi: str, lo: str) -> bool:
+        src, dst = by_url[hi], by_url[lo]
+        if free_shard_slots(dst) <= 0:
+            # receivers need free EC capacity (the reference's freeEcSlot
+            # requirement, command_ec_common.go)
+            return False
+        for s in src.ec_shards:
+            vid = s["id"]
+            cross_rack = rack_of(src) != rack_of(dst)
+            if cross_rack and vid_rack_load(rack_of(dst), vid) >= rack_limit:
+                continue
+            sids = [i for i in range(TOTAL_SHARDS) if s["ec_index_bits"] >> i & 1]
+            dst_held = node_shards(dst, vid)
+            movable = [sid for sid in sids if sid not in dst_held]
+            if movable:
+                moves.append((vid, s["collection"], movable[0], src, dst))
+                _move_shard_bits(src, dst, vid, s["collection"], movable[0])
+                counts[hi] -= 1
+                counts[lo] += 1
+                return True
+        return False
+
+    while counts:
+        # try every donor (fullest first) against every recipient
+        # (emptiest first): the top pair may be blocked by the rack cap
+        # while e.g. a same-rack move still improves balance
+        moved = False
+        for hi in sorted(counts, key=counts.get, reverse=True):
+            for lo in sorted(counts, key=counts.get):
+                if counts[hi] - counts[lo] <= 1:
+                    break  # later recipients are even fuller
+                if try_move(hi, lo):
+                    moved = True
+                    break
+            if moved:
+                break
+        if not moved:
+            break
+    return moves
+
+
 @command("ec.balance")
 async def cmd_ec_balance(env, args):
-    """[-force] : even EC shard counts across nodes (command_ec_balance.go)"""
+    """[-force] : even EC shards across racks, then across nodes
+    (command_ec_balance.go, command_ec_common.go)"""
     env.confirm_is_locked()
     flags = parse_flags(args)
     apply = "force" in flags
     nodes, _ = await env.collect_topology()
-    counts = {
-        n.url: sum(bin(s["ec_index_bits"]).count("1") for s in n.ec_shards)
-        for n in nodes
-    }
-    by_url = {n.url: n for n in nodes}
-    moves = []
-    while True:
-        hi = max(counts, key=counts.get)
-        lo = min(counts, key=counts.get)
-        if counts[hi] - counts[lo] <= 1:
-            break
-        src = by_url[hi]
-        moved = False
-        for s in src.ec_shards:
-            sids = [i for i in range(TOTAL_SHARDS) if s["ec_index_bits"] >> i & 1]
-            dst_held = node_shards(by_url[lo], s["id"])
-            movable = [sid for sid in sids if sid not in dst_held]
-            if movable:
-                moves.append((s["id"], s["collection"], movable[0], src, by_url[lo]))
-                s["ec_index_bits"] &= ~(1 << movable[0])
-                counts[hi] -= 1
-                counts[lo] += 1
-                moved = True
-                break
-        if not moved:
-            break
+
+    # pass 1: rack dimension — no rack holds more of a volume's shards
+    # than ceil(14 / n_racks)
+    rack_moves = plan_rack_moves(nodes)
+    for vid, collection, sid, src, dst in rack_moves:
+        env.write(
+            f"move ec shard {vid}.{sid}: {src.url} -> {dst.url} (rack balance)"
+        )
+        if apply:
+            await move_ec_shard(env, vid, collection, sid, src, dst)
+
+    # pass 2: aggregate node counts across the cluster
+    moves = plan_node_moves(nodes)
     for vid, collection, sid, src, dst in moves:
         env.write(f"move ec shard {vid}.{sid}: {src.url} -> {dst.url}")
         if apply:
             await move_ec_shard(env, vid, collection, sid, src, dst)
-    env.write(f"{len(moves)} shard moves{' applied' if apply else ' planned (use -force)'}")
+    total = len(rack_moves) + len(moves)
+    env.write(
+        f"{total} shard moves{' applied' if apply else ' planned (use -force)'}"
+    )
 
 
 async def move_ec_shard(env, vid, collection, sid, src, dst):

@@ -1,0 +1,205 @@
+"""Rack/DC-aware EC shard placement and balancing.
+
+Reference: command_ec_common.go:19-58 (rack-aware spread),
+command_ec_balance.go (across-racks then within-racks passes).  The
+fabricated-topology style mirrors the reference's shell-command tests
+(SURVEY.md §4: canned TopologyInfo, no cluster spins).
+"""
+import math
+
+from seaweedfs_tpu.shell.command_ec import (
+    balanced_ec_distribution,
+    plan_node_moves,
+    plan_rack_moves,
+    rack_of,
+)
+from seaweedfs_tpu.shell.command_env import TopoNode
+from seaweedfs_tpu.storage.ec import TOTAL_SHARDS
+
+
+def make_node(url, dc, rack, max_volumes=10, ec_shards=None):
+    return TopoNode(
+        url=url,
+        grpc_port=0,
+        data_center=dc,
+        rack=rack,
+        volumes=[],
+        ec_shards=ec_shards or [],
+        max_volume_counts={"hdd": max_volumes},
+    )
+
+
+def two_dc_four_rack(nodes_per_rack=2):
+    nodes = []
+    for dc in ("dc1", "dc2"):
+        for rack in ("r1", "r2"):
+            for i in range(nodes_per_rack):
+                nodes.append(make_node(f"{dc}-{rack}-n{i}:8080", dc, rack))
+    return nodes
+
+
+def shards_per_rack(targets):
+    by_rack = {}
+    for node, sids in targets:
+        key = rack_of(node)
+        by_rack[key] = by_rack.get(key, 0) + len(sids)
+    return by_rack
+
+
+def test_spread_respects_rack_cap():
+    nodes = two_dc_four_rack()
+    targets = balanced_ec_distribution(nodes, TOTAL_SHARDS)
+    assert sum(len(s) for _, s in targets) == TOTAL_SHARDS
+    per_rack = shards_per_rack(targets)
+    cap = math.ceil(TOTAL_SHARDS / 4)
+    assert len(per_rack) == 4, "every rack participates"
+    assert all(c <= cap for c in per_rack.values()), per_rack
+    # no duplicate shard assignments
+    all_sids = [sid for _, sids in targets for sid in sids]
+    assert sorted(all_sids) == list(range(TOTAL_SHARDS))
+
+
+def test_spread_two_racks_cap_seven():
+    nodes = [
+        make_node("a:1", "dc1", "r1"),
+        make_node("b:1", "dc1", "r1"),
+        make_node("c:1", "dc1", "r2"),
+        make_node("d:1", "dc1", "r2"),
+    ]
+    per_rack = shards_per_rack(balanced_ec_distribution(nodes, TOTAL_SHARDS))
+    assert all(c <= 7 for c in per_rack.values()), per_rack
+
+
+def test_spread_single_node_still_places_everything():
+    nodes = [make_node("solo:1", "dc1", "r1", max_volumes=1)]
+    targets = balanced_ec_distribution(nodes, TOTAL_SHARDS)
+    assert sum(len(s) for _, s in targets) == TOTAL_SHARDS
+
+
+def test_spread_prefers_free_space_within_rack():
+    nodes = [
+        make_node("big:1", "dc1", "r1", max_volumes=100),
+        make_node("small:1", "dc1", "r1", max_volumes=1),
+        make_node("other:1", "dc1", "r2", max_volumes=100),
+    ]
+    targets = dict(
+        (n.url, sids) for n, sids in balanced_ec_distribution(nodes, TOTAL_SHARDS)
+    )
+    assert len(targets.get("big:1", [])) > len(targets.get("small:1", []))
+
+
+def test_plan_rack_moves_drains_overloaded_rack():
+    """All 14 shards on one rack of a 4-rack topology: the plan must leave
+    no rack above ceil(14/4)=4."""
+    nodes = two_dc_four_rack()
+    # all shards of volume 5 on the two dc1/r1 nodes
+    nodes[0].ec_shards.append(
+        {"id": 5, "collection": "", "ec_index_bits": 0b0000000001111111}
+    )
+    nodes[1].ec_shards.append(
+        {"id": 5, "collection": "", "ec_index_bits": 0b0011111110000000}
+    )
+    moves = plan_rack_moves(nodes)
+    assert moves, "overloaded rack must shed shards"
+    per_rack: dict = {}
+    for n in nodes:
+        for s in n.ec_shards:
+            if s["id"] == 5:
+                key = rack_of(n)
+                per_rack[key] = per_rack.get(key, 0) + bin(
+                    s["ec_index_bits"]
+                ).count("1")
+    cap = math.ceil(TOTAL_SHARDS / 4)
+    assert all(c <= cap for c in per_rack.values()), per_rack
+    # nothing lost in the shuffle
+    assert sum(per_rack.values()) == TOTAL_SHARDS
+
+
+def test_plan_node_moves_same_rack_when_top_pair_blocked():
+    """The fullest->emptiest pair (A->B) is cross-rack and blocked by the
+    rack cap, but A->E within A's own rack still improves balance — the
+    planner must not abort on the blocked pair."""
+    nodes = [
+        # rack r1: A has 7 shards of volume 1, E has 5 of volume 2
+        make_node("A:1", "dc1", "r1",
+                  ec_shards=[{"id": 1, "collection": "", "ec_index_bits": 0b1111111}]),
+        make_node("E:1", "dc1", "r1",
+                  ec_shards=[{"id": 2, "collection": "", "ec_index_bits": 0b11111}]),
+        # rack r2 already holds 7 of volume 1 = the 2-rack cap
+        make_node("B:1", "dc1", "r2",
+                  ec_shards=[{"id": 1, "collection": "", "ec_index_bits": 1 << 7}]),
+        make_node("D:1", "dc1", "r2",
+                  ec_shards=[{"id": 1, "collection": "",
+                              "ec_index_bits": 0b111111 << 8}]),
+    ]
+    moves = plan_node_moves(nodes)
+    assert moves, "same-rack rebalancing moves must still be planned"
+    counts = {
+        n.url: sum(bin(s["ec_index_bits"]).count("1") for s in n.ec_shards)
+        for n in nodes
+    }
+    assert max(counts.values()) - min(counts.values()) <= 2, counts
+    # the rack cap stayed honored for volume 1 in r2
+    r2_v1 = sum(
+        bin(s["ec_index_bits"]).count("1")
+        for n in nodes if n.rack == "r2"
+        for s in n.ec_shards if s["id"] == 1
+    )
+    assert r2_v1 <= 7
+
+
+def test_plan_node_moves_empty_topology():
+    assert plan_node_moves([]) == []
+
+
+def test_plan_node_moves_skips_full_recipients():
+    """A node with zero free slots must not receive shards even though its
+    shard count makes it the emptiest (freeEcSlot, command_ec_common.go)."""
+    full = make_node("full:1", "dc1", "r1", max_volumes=0)
+    donor = make_node(
+        "donor:1", "dc1", "r1",
+        ec_shards=[{"id": 3, "collection": "", "ec_index_bits": 0b11111111}],
+    )
+    roomy = make_node("roomy:1", "dc1", "r1")
+    moves = plan_node_moves([full, donor, roomy])
+    assert moves
+    assert all(dst.url != "full:1" for _, _, _, _, dst in moves)
+    assert not full.ec_shards
+
+
+def test_capacity_counted_in_shard_units():
+    """One volume slot holds 14 shards: a 1-slot empty recipient must be
+    able to absorb several shards, not be declared full after one (the
+    free_slots() volume-slot rounding bug)."""
+    donor = make_node(
+        "donor:1", "dc1", "r1", max_volumes=10,
+        ec_shards=[{"id": 3, "collection": "", "ec_index_bits": 0b11111111}],
+    )
+    tiny = make_node("tiny:1", "dc1", "r1", max_volumes=1)
+    moves = plan_node_moves([donor, tiny])
+    counts = {
+        n.url: sum(bin(s["ec_index_bits"]).count("1") for s in n.ec_shards)
+        for n in (donor, tiny)
+    }
+    assert counts == {"donor:1": 4, "tiny:1": 4}, (counts, moves)
+
+
+def test_plan_rack_moves_into_one_slot_rack():
+    """A rack with a single free volume slot can still take its full
+    ceil-cap share of shards."""
+    a = make_node(
+        "a:1", "dc1", "r1", max_volumes=10,
+        ec_shards=[{"id": 7, "collection": "", "ec_index_bits": (1 << 14) - 1}],
+    )
+    b = make_node("b:1", "dc1", "r2", max_volumes=1)
+    moves = plan_rack_moves([a, b])
+    held_b = sum(bin(s["ec_index_bits"]).count("1") for s in b.ec_shards)
+    assert held_b == 7, (held_b, moves)  # down to the 2-rack cap
+
+
+def test_plan_rack_moves_noop_when_balanced():
+    nodes = two_dc_four_rack(nodes_per_rack=1)
+    bits = [0b1111, 0b11110000, 0b111100000000, 0b11000000000000]  # 4+4+4+2
+    for n, b in zip(nodes, bits):
+        n.ec_shards.append({"id": 9, "collection": "", "ec_index_bits": b})
+    assert plan_rack_moves(nodes) == []
