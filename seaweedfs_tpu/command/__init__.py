@@ -9,11 +9,14 @@ import argparse
 import asyncio
 import sys
 
-from . import benchmark, filer, master, scaffold, server, shell, s3, version, volume
+from . import benchmark, filer, master, scaffold, server, shell, s3, version, volume, webdav
 
 COMMANDS = {
     m.NAME: m
-    for m in (master, volume, filer, s3, server, shell, benchmark, scaffold, version)
+    for m in (
+        master, volume, filer, s3, webdav, server, shell, benchmark, scaffold,
+        version,
+    )
 }
 
 

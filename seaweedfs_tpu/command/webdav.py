@@ -1,0 +1,42 @@
+"""`webdav` — run the WebDAV gateway (reference: weed/command/webdav.go)."""
+from __future__ import annotations
+
+import asyncio
+
+NAME = "webdav"
+HELP = "start a WebDAV gateway over a filer"
+
+
+def add_args(p) -> None:
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=7333)
+    p.add_argument(
+        "-filer", dest="filer", default="127.0.0.1:8888", help="filer host:port"
+    )
+    p.add_argument(
+        "-filer.grpc", dest="filer_grpc", default="",
+        help="filer grpc host:port (default: filer port+10000)",
+    )
+    p.add_argument(
+        "-filer.path", dest="filer_path", default="/",
+        help="filer directory served as the DAV root",
+    )
+
+
+def build_webdav_server(args):
+    from ..server.webdav import WebDavServer
+
+    return WebDavServer(
+        filer_address=args.filer,
+        filer_grpc_address=args.filer_grpc,
+        ip=args.ip,
+        port=args.port,
+        root=args.filer_path,
+    )
+
+
+async def run(args) -> None:
+    dav = build_webdav_server(args)
+    await dav.start()
+    print(f"webdav server ready at http://{dav.url}/")
+    await asyncio.Event().wait()

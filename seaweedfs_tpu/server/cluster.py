@@ -29,6 +29,7 @@ class LocalCluster:
         filer_kwargs: dict | None = None,
         with_s3: bool = False,
         s3_kwargs: dict | None = None,
+        with_webdav: bool = False,
         jwt_signing_key: str = "",
     ):
         import os
@@ -39,7 +40,9 @@ class LocalCluster:
             jwt_signing_key=jwt_signing_key,
         )
         self.jwt_signing_key = jwt_signing_key
-        self.with_filer = with_filer or with_s3
+        self.with_filer = with_filer or with_s3 or with_webdav
+        self.with_webdav = with_webdav
+        self.webdav = None
         self.filer_kwargs = filer_kwargs or {}
         self.filer: FilerServer | None = None
         self.with_s3 = with_s3
@@ -97,6 +100,15 @@ class LocalCluster:
                 **self.s3_kwargs,
             )
             await self.s3.start()
+        if self.with_webdav:
+            from .webdav import WebDavServer
+
+            self.webdav = WebDavServer(
+                filer_address=self.filer.url,
+                filer_grpc_address=f"{self.filer.ip}:{self.filer.grpc_port}",
+                port=0,
+            )
+            await self.webdav.start()
 
     async def wait_for_nodes(self, n: int, timeout: float = 10.0) -> None:
         deadline = asyncio.get_event_loop().time() + timeout
@@ -107,6 +119,8 @@ class LocalCluster:
         raise TimeoutError(f"only {len(self.master.topo.data_nodes())}/{n} nodes joined")
 
     async def stop(self) -> None:
+        if self.webdav is not None:
+            await self.webdav.stop()
         if self.s3 is not None:
             await self.s3.stop()
         if self.filer is not None:
