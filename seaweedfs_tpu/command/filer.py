@@ -1,6 +1,7 @@
 """`filer` — run a filer server (reference: weed/command/filer.go)."""
 from __future__ import annotations
 
+import argparse
 import asyncio
 
 NAME = "filer"
@@ -37,6 +38,24 @@ def add_args(p) -> None:
         "-metricsPort", dest="metrics_port", type=int, default=0,
         help="prometheus /metrics port (0 = auto-assign)",
     )
+    p.add_argument(
+        "-encryptVolumeData", dest="cipher", action="store_true",
+        help="AES-GCM encrypt chunk data at rest",
+    )
+    p.add_argument(
+        "-compressChunks", dest="compress_chunks",
+        action=argparse.BooleanOptionalAction, default=True,
+        help="zstd-compress compressible chunks (default on; "
+        "--no-compressChunks to disable)",
+    )
+    p.add_argument(
+        "-cacheDir", dest="chunk_cache_dir", default="",
+        help="directory for the on-disk chunk cache tier",
+    )
+    p.add_argument(
+        "-cacheSizeMB", dest="chunk_cache_mb", type=int, default=64,
+        help="memory chunk cache budget",
+    )
 
 
 def build_filer_server(args):
@@ -56,6 +75,10 @@ def build_filer_server(args):
         data_center=args.data_center,
         meta_log_path=args.meta_log_path or None,
         metrics_port=args.metrics_port,
+        cipher=args.cipher,
+        compress_chunks=args.compress_chunks,
+        chunk_cache_dir=args.chunk_cache_dir or None,
+        chunk_cache_mb=args.chunk_cache_mb,
     )
 
 

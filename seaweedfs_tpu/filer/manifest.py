@@ -64,4 +64,8 @@ def _manifestize(save_fn, group) -> filer_pb2.FileChunk:
         modified_ts_ns=max(c.modified_ts_ns for c in group),
         e_tag=saved.e_tag,
         is_chunk_manifest=True,
+        # the manifest blob itself may be encrypted/compressed by the
+        # uploader — readers need these to decode it
+        cipher_key=saved.cipher_key,
+        is_compressed=saved.is_compressed,
     )

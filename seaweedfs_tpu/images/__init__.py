@@ -1,0 +1,3 @@
+from .resizing import resized
+
+__all__ = ["resized"]

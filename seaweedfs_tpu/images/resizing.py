@@ -1,0 +1,53 @@
+"""On-read image resizing (reference: weed/images/resizing.go).
+
+Same query semantics as the reference volume server read path:
+`?width=&height=&mode=` where
+
+- both dims + ``mode=fit``  -> scale to fit inside the box, keep ratio
+- both dims + ``mode=fill`` -> scale to cover the box, center-crop
+- both dims, no mode        -> exact resize (ratio may change)
+- one dim                   -> scale preserving aspect ratio
+
+Non-image payloads and zero dimensions pass through untouched.
+"""
+from __future__ import annotations
+
+import io
+
+
+def resized(
+    data: bytes, width: int = 0, height: int = 0, mode: str = ""
+) -> bytes:
+    if not (width or height):
+        return data
+    try:
+        from PIL import Image
+    except ImportError:  # pragma: no cover - PIL is in the image
+        return data
+    try:
+        img = Image.open(io.BytesIO(data))
+        fmt = img.format
+        if fmt not in ("PNG", "JPEG", "GIF"):
+            return data
+        ow, oh = img.size
+        if width and height:
+            if mode == "fit":
+                img.thumbnail((width, height))
+            elif mode == "fill":
+                scale = max(width / ow, height / oh)
+                img = img.resize((round(ow * scale), round(oh * scale)))
+                left = (img.width - width) // 2
+                top = (img.height - height) // 2
+                img = img.crop((left, top, left + width, top + height))
+            else:
+                img = img.resize((width, height))
+        elif width:
+            img = img.resize((width, max(1, round(oh * width / ow))))
+        else:
+            img = img.resize((max(1, round(ow * height / oh)), height))
+        buf = io.BytesIO()
+        img.save(buf, format=fmt)
+        return buf.getvalue()
+    except Exception:
+        # never fail a read because a thumbnail couldn't be produced
+        return data

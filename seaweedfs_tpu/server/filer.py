@@ -70,6 +70,10 @@ class FilerServer:
         save_inside_limit: int = 0,  # inline files <= this many bytes in metadata
         dir_buckets: str = "/buckets",
         metrics_port: int | None = 0,  # 0 = auto-assign; None = disabled
+        cipher: bool = False,  # AES-GCM encrypt chunks at rest (util/cipher.go)
+        compress_chunks: bool = True,  # zstd compressible chunks (util/compression.go)
+        chunk_cache_mb: int = 64,
+        chunk_cache_dir: str | None = None,
     ):
         self.masters = masters
         self.ip = ip
@@ -83,6 +87,14 @@ class FilerServer:
         self.save_inside_limit = save_inside_limit
         self.dir_buckets = dir_buckets
         self.metrics_port = metrics_port
+        self.cipher = cipher
+        self.compress_chunks = compress_chunks
+        from ..filer.chunk_cache import ChunkCache
+
+        self.chunk_cache = ChunkCache(
+            mem_limit_bytes=chunk_cache_mb * 1024 * 1024,
+            disk_dir=chunk_cache_dir,
+        )
         self.filer = Filer(
             store if store is not None else MemoryStore(),
             delete_file_ids_fn=self._delete_file_ids,
@@ -171,11 +183,27 @@ class FilerServer:
     async def _upload_chunk(
         self, data: bytes, offset: int, filename: str,
         collection: str = "", replication: str = "", ttl: str = "",
+        mime: str = "",
     ) -> filer_pb2.FileChunk:
+        # compress-then-encrypt; chunk.size stays the logical (plaintext)
+        # length so the interval algebra never sees wire sizes
+        payload = data
+        is_compressed = False
+        cipher_key = b""
+        if self.compress_chunks:
+            from ..utils.compression import maybe_compress
+
+            ext = "." + filename.rsplit(".", 1)[-1] if "." in filename else ""
+            payload, is_compressed = maybe_compress(payload, mime, ext)
+        if self.cipher:
+            from ..utils.cipher import encrypt, gen_cipher_key
+
+            cipher_key = gen_cipher_key()
+            payload = encrypt(payload, cipher_key)
         a = await self._assign(1, collection, replication, ttl)
         result = await upload_data(
             f"http://{a.url}/{a.fid}",
-            data,
+            payload,
             filename=filename,
             compress=False,
             jwt=a.auth,
@@ -186,6 +214,8 @@ class FilerServer:
             size=len(data),
             modified_ts_ns=time.time_ns(),
             e_tag=result.get("eTag", ""),
+            cipher_key=cipher_key,
+            is_compressed=is_compressed,
         )
 
     async def _lookup_urls(self, file_id: str) -> list[str]:
@@ -193,8 +223,53 @@ class FilerServer:
         locs = await self.master_client.lookup_or_fetch(vid)
         return [f"http://{l.url}/{file_id}" for l in locs]
 
+    async def _cache_get(self, file_id: str) -> bytes | None:
+        # the disk tier blocks; keep it off the event loop
+        if self.chunk_cache.disk_dir:
+            return await asyncio.to_thread(self.chunk_cache.get, file_id)
+        return self.chunk_cache.get(file_id)
+
+    async def _cache_put(self, file_id: str, blob: bytes) -> None:
+        if self.chunk_cache.disk_dir:
+            await asyncio.to_thread(self.chunk_cache.put, file_id, blob)
+        else:
+            self.chunk_cache.put(file_id, blob)
+
+    async def _fetch_chunk_decoded(
+        self, file_id: str, cipher_key: bytes, is_compressed: bool
+    ) -> bytes:
+        """Whole chunk, decrypted/decompressed, through the chunk cache.
+        Cipher and compressed chunks can't be range-read, so they always
+        come through here (the reference streams them whole too)."""
+        blob = await self._cache_get(file_id)
+        if blob is not None:
+            return blob
+        raw = await self._fetch_whole(file_id)
+        if cipher_key:
+            from ..utils.cipher import decrypt
+
+            raw = decrypt(raw, cipher_key)
+        if is_compressed:
+            from ..utils.compression import decompress
+
+            raw = decompress(raw)
+        await self._cache_put(file_id, raw)
+        return raw
+
     async def _fetch_view(self, view) -> bytes:
         """One ChunkView's bytes from a volume server (Range read)."""
+        if view.cipher_key or view.is_gzipped:
+            blob = await self._fetch_chunk_decoded(
+                view.file_id, view.cipher_key, view.is_gzipped
+            )
+            return blob[
+                view.offset_in_chunk: view.offset_in_chunk + view.view_size
+            ]
+        cached = await self._cache_get(view.file_id)
+        if cached is not None:
+            return cached[
+                view.offset_in_chunk: view.offset_in_chunk + view.view_size
+            ]
         urls = await self._lookup_urls(view.file_id)
         if not urls:
             raise web.HTTPInternalServerError(
@@ -212,7 +287,10 @@ class FilerServer:
                 async with self._session.get(url, headers=hdr) as r:
                     if r.status >= 300:
                         raise RuntimeError(f"{url}: HTTP {r.status}")
-                    return await r.read()
+                    data = await r.read()
+                    if view.is_full_chunk:
+                        await self._cache_put(view.file_id, data)
+                    return data
             except Exception as e:  # noqa: BLE001 — try the next replica
                 last_err = e
         raise web.HTTPInternalServerError(text=f"chunk {view.file_id}: {last_err}")
@@ -239,7 +317,9 @@ class FilerServer:
             blobs: dict[str, bytes] = {}
             for c in chunks:
                 if c.is_chunk_manifest:
-                    blobs[c.file_id] = await self._fetch_whole(c.file_id)
+                    blobs[c.file_id] = await self._fetch_chunk_decoded(
+                        c.file_id, bytes(c.cipher_key), c.is_compressed
+                    )
 
             def lookup(fid):
                 if fid not in blobs:
@@ -447,7 +527,7 @@ class FilerServer:
                 break
             chunk = await self._upload_chunk(
                 data, offset, filename or path.rsplit("/", 1)[-1],
-                collection, replication, ttl_str,
+                collection, replication, ttl_str, mime=content_type,
             )
             chunks.append(chunk)
             offset += len(data)

@@ -334,6 +334,20 @@ class VolumeServer:
 
                 body = _gz.decompress(body)
         ct = n.mime.decode() if n.mime else "application/octet-stream"
+        if ct.startswith("image/") and (
+            "width" in request.query or "height" in request.query
+        ):
+            from ..images import resized
+
+            try:
+                rw = int(request.query.get("width") or 0)
+                rh = int(request.query.get("height") or 0)
+            except ValueError:
+                raise web.HTTPBadRequest(text="width/height must be integers")
+            rmode = request.query.get("mode", "")
+            body = await asyncio.to_thread(resized, body, rw, rh, rmode)
+            # resize variants must not share the original's cache identity
+            headers["Etag"] = f'"{n.etag}-{rw}x{rh}{rmode}"'
         if request.method == "HEAD":
             return web.Response(
                 status=200, headers={**headers, "Content-Length": str(len(body))},

@@ -1,0 +1,30 @@
+"""At-rest chunk encryption: AES-256-GCM, one random key per chunk.
+
+Reference: weed/util/cipher.go (Encrypt/Decrypt with AES-GCM, random
+nonce prepended to the ciphertext) — the per-chunk key travels in
+FileChunk.cipher_key metadata, never alongside the data.
+"""
+from __future__ import annotations
+
+import os
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+KEY_SIZE = 32
+NONCE_SIZE = 12
+
+
+def gen_cipher_key() -> bytes:
+    return os.urandom(KEY_SIZE)
+
+
+def encrypt(data: bytes, key: bytes) -> bytes:
+    """nonce || ciphertext+tag (cipher.go Encrypt layout)."""
+    nonce = os.urandom(NONCE_SIZE)
+    return nonce + AESGCM(key).encrypt(nonce, data, None)
+
+
+def decrypt(blob: bytes, key: bytes) -> bytes:
+    if len(blob) < NONCE_SIZE:
+        raise ValueError("cipher blob too short")
+    return AESGCM(key).decrypt(blob[:NONCE_SIZE], blob[NONCE_SIZE:], None)

@@ -162,6 +162,23 @@ def test_manifest_round_trip():
     assert all(c.file_id.startswith("c2") for c in data2 if int(c.file_id[1:]) >= 2000)
 
 
+def test_manifest_chunk_carries_cipher_key():
+    """An encrypting uploader returns chunks with cipher_key/is_compressed;
+    the folded manifest FileChunk must keep them or readers can't decode
+    the manifest blob (filechunk_manifest.go keeps the full saved chunk)."""
+
+    def save(blob):
+        return filer_pb2.FileChunk(
+            file_id="m0", e_tag="", cipher_key=b"k" * 32, is_compressed=True
+        )
+
+    chunks = [C(i * 10, 10, f"c{i}", i + 1) for i in range(1100)]
+    folded = maybe_manifestize(save, chunks, batch=1000)
+    manifest = next(c for c in folded if c.is_chunk_manifest)
+    assert bytes(manifest.cipher_key) == b"k" * 32
+    assert manifest.is_compressed
+
+
 # ------------------------------------------------------------------- stores
 
 
