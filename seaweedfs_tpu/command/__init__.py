@@ -9,13 +9,25 @@ import argparse
 import asyncio
 import sys
 
-from . import benchmark, filer, master, scaffold, server, shell, s3, version, volume, webdav
+from . import (
+    benchmark,
+    filer,
+    filer_sync,
+    master,
+    scaffold,
+    server,
+    shell,
+    s3,
+    version,
+    volume,
+    webdav,
+)
 
 COMMANDS = {
     m.NAME: m
     for m in (
-        master, volume, filer, s3, webdav, server, shell, benchmark, scaffold,
-        version,
+        master, volume, filer, filer_sync, s3, webdav, server, shell,
+        benchmark, scaffold, version,
     )
 }
 

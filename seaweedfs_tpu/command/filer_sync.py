@@ -1,0 +1,69 @@
+"""`filer.sync` — continuous filer-to-filer replication
+(reference: weed/command/filer_sync.go)."""
+from __future__ import annotations
+
+import asyncio
+import random
+
+NAME = "filer.sync"
+HELP = "continuously replicate one filer's tree to another"
+
+
+def add_args(p) -> None:
+    p.add_argument(
+        "-a", dest="filer_a", required=True,
+        help="filer A grpc host:port (or host:port of HTTP, +10000 assumed)",
+    )
+    p.add_argument(
+        "-b", dest="filer_b", required=True,
+        help="filer B grpc host:port",
+    )
+    p.add_argument(
+        "-a.path", dest="path_a", default="/", help="subtree to sync from A"
+    )
+    p.add_argument(
+        "-b.path", dest="path_b", default="/", help="subtree to sync from B"
+    )
+    p.add_argument(
+        "-isActivePassive", dest="active_passive", action="store_true",
+        help="only replicate A -> B (default: both directions)",
+    )
+
+
+def _grpc_addr(addr: str) -> str:
+    host, sep, port = addr.rpartition(":")
+    if not sep or not port.isdigit():
+        raise SystemExit(
+            f"filer.sync: address {addr!r} must be host:port "
+            "(HTTP port, +10000 assumed, or an explicit grpc port)"
+        )
+    p = int(port)
+    return f"{host}:{p + 10000}" if p < 10000 else addr
+
+
+async def run(args) -> None:
+    from ..replication import FilerSync
+
+    signature = random.randint(1, 1 << 30)
+    a, b = _grpc_addr(args.filer_a), _grpc_addr(args.filer_b)
+    syncs = [
+        FilerSync(
+            a, b, path_prefix=args.path_a, target_path=args.path_b,
+            signature=signature,
+        )
+    ]
+    if not args.active_passive:
+        syncs.append(
+            FilerSync(
+                b, a, path_prefix=args.path_b, target_path=args.path_a,
+                signature=signature,
+            )
+        )
+    for s in syncs:
+        s.start()
+    print(f"filer.sync running: {args.filer_a} {'->' if args.active_passive else '<->'} {args.filer_b}")
+    try:
+        await asyncio.Event().wait()
+    finally:
+        for s in syncs:
+            await s.stop()

@@ -63,25 +63,20 @@ async def run(args) -> None:
     await vs.start()
 
     if args.filer or args.s3:
-        from argparse import Namespace
+        import argparse
 
-        from .filer import build_filer_server
+        from . import filer as filer_cmd
 
-        fs = build_filer_server(
-            Namespace(
-                masters=ms.advertise_url,
-                db_path=args.filer_db,
-                ip=args.ip,
-                port=args.filer_port,
-                grpc_port=0,
-                max_mb=4,
-                collection="",
-                replication="",
-                data_center="",
-                meta_log_path="",
-                metrics_port=0,
-            )
-        )
+        # take every default from the filer command's own parser so new
+        # filer flags can never drift out of sync with `server`
+        fparser = argparse.ArgumentParser()
+        filer_cmd.add_args(fparser)
+        fargs = fparser.parse_args([])
+        fargs.masters = ms.advertise_url
+        fargs.db_path = args.filer_db
+        fargs.ip = args.ip
+        fargs.port = args.filer_port
+        fs = filer_cmd.build_filer_server(fargs)
         await fs.start()
         if args.s3:
             from .s3 import build_s3_server

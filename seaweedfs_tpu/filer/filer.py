@@ -35,9 +35,10 @@ class Filer:
         store: FilerStore,
         delete_file_ids_fn=None,  # async (list[str]) -> None; wired by the server
         meta_log_path: str | None = None,
+        notifier=None,  # replication.notification.Notifier
     ):
         self.store = store
-        self.meta_log = MetaLog(meta_log_path)
+        self.meta_log = MetaLog(meta_log_path, notifier=notifier)
         self._delete_file_ids_fn = delete_file_ids_fn
         self._dir_cache: dict[str, float] = {}  # known-directory memo
 

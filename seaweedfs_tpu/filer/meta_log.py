@@ -23,7 +23,8 @@ _MAX_MEMORY_EVENTS = 8192
 
 
 class MetaLog:
-    def __init__(self, persist_path: str | None = None):
+    def __init__(self, persist_path: str | None = None, notifier=None):
+        self.notifier = notifier  # replication.notification.Notifier
         self._events: deque[filer_pb2.SubscribeMetadataResponse] = deque(
             maxlen=_MAX_MEMORY_EVENTS
         )
@@ -82,6 +83,16 @@ class MetaLog:
         async with self._cond:
             self._events.append(ev)
             self._cond.notify_all()
+        if self.notifier is not None:
+            name = (new_entry or old_entry).name if (new_entry or old_entry) else ""
+            try:
+                await self.notifier.publish(
+                    f"{directory.rstrip('/')}/{name}", ev.event_notification
+                )
+            except Exception:  # noqa: BLE001 — notification must not fail writes
+                import logging
+
+                logging.getLogger("notification").exception("publish failed")
         return ts_ns
 
     async def subscribe(self, since_ns: int = 0, path_prefix: str = ""):

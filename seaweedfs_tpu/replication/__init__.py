@@ -1,0 +1,5 @@
+from .sink import FilerSink
+from .source import FilerSource
+from .sync import FilerSync
+
+__all__ = ["FilerSink", "FilerSource", "FilerSync"]

@@ -1,0 +1,82 @@
+"""Metadata-change notification publishers.
+
+Reference: weed/notification/configuration.go — a MessageQueue interface
+(SendMessage(key, proto)) with kafka/SQS/pub-sub/log backends, invoked
+for every filer meta mutation when notifications are configured.  Broker
+backends need external services (zero egress here), so the shipped
+implementations are the log publisher, a local spool file (length-
+prefixed records an external forwarder can drain), and an in-process
+callback for embedding.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import struct
+
+from ..pb import filer_pb2
+
+log = logging.getLogger("notification")
+
+
+class Notifier:
+    async def publish(
+        self, key: str, notification: filer_pb2.EventNotification
+    ) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class LogNotifier(Notifier):
+    """notification.log backend."""
+
+    async def publish(self, key, notification) -> None:
+        log.info(
+            "meta event %s: old=%s new=%s", key,
+            notification.old_entry.name or "-",
+            notification.new_entry.name or "-",
+        )
+
+
+class CallbackNotifier(Notifier):
+    def __init__(self, fn):
+        self.fn = fn
+
+    async def publish(self, key, notification) -> None:
+        r = self.fn(key, notification)
+        if asyncio.iscoroutine(r):
+            await r
+
+
+class FileQueueNotifier(Notifier):
+    """Spool events to a local file as <u16 key len><key><u32 proto
+    len><proto> records — the stand-in for an external queue."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._fh = open(path, "ab")
+
+    async def publish(self, key, notification) -> None:
+        kb = key.encode()
+        blob = notification.SerializeToString()
+        self._fh.write(struct.pack("<H", len(kb)) + kb)
+        self._fh.write(struct.pack("<I", len(blob)) + blob)
+        self._fh.flush()
+
+    def close(self) -> None:
+        self._fh.close()
+
+    @staticmethod
+    def read_all(path: str) -> list[tuple[str, filer_pb2.EventNotification]]:
+        out = []
+        with open(path, "rb") as f:
+            while True:
+                hdr = f.read(2)
+                if len(hdr) < 2:
+                    break
+                (kn,) = struct.unpack("<H", hdr)
+                key = f.read(kn).decode()
+                (bn,) = struct.unpack("<I", f.read(4))
+                ev = filer_pb2.EventNotification.FromString(f.read(bn))
+                out.append((key, ev))
+        return out

@@ -1,0 +1,174 @@
+"""Filer sink: apply one filer's metadata events to another filer,
+re-homing chunk data into the target cluster.
+
+Reference: weed/replication/sink/filersink/filer_sink.go
+(CreateEntry/UpdateEntry/DeleteEntry + replicateChunks which fetches
+from the source and re-uploads via the target's AssignVolume), driven by
+weed/replication/replicator.go event dispatch.
+"""
+from __future__ import annotations
+
+import logging
+
+import grpc
+
+from ..operation.upload import upload_data
+from ..pb import Stub, filer_pb2
+from ..pb.rpc import channel
+
+log = logging.getLogger("replication.sink")
+
+
+class FilerSink:
+    def __init__(
+        self,
+        filer_grpc_address: str,
+        fetch_chunk,  # async (file_id) -> bytes, from the source cluster
+        signature: int = 0,
+        collection: str = "",
+        replication: str = "",
+        source_path: str = "/",  # subtree on the source...
+        target_path: str = "/",  # ...lands here on the target (filer_sync.go key translation)
+    ):
+        self.filer_grpc_address = filer_grpc_address
+        self.fetch_chunk = fetch_chunk
+        self.signature = signature
+        self.collection = collection
+        self.replication = replication
+        self.source_path = source_path.rstrip("/")
+        self.target_path = target_path.rstrip("/")
+        self._stub_cache = None
+
+    def _map_dir(self, directory: str) -> str:
+        if self.source_path == self.target_path:
+            return directory
+        if directory == self.source_path or directory.startswith(
+            self.source_path + "/"
+        ):
+            return self.target_path + directory[len(self.source_path):]
+        return directory
+
+    def _stub(self):
+        if self._stub_cache is None:
+            self._stub_cache = Stub(
+                channel(self.filer_grpc_address), filer_pb2, "SeaweedFiler"
+            )
+        return self._stub_cache
+
+    async def apply(self, ev: filer_pb2.SubscribeMetadataResponse) -> None:
+        """Dispatch one event (replicator.go Replicate)."""
+        n = ev.event_notification
+        has_old = n.HasField("old_entry")
+        has_new = n.HasField("new_entry")
+        if has_old and not has_new:
+            await self._delete(ev.directory, n.old_entry)
+        elif has_new and not has_old:
+            await self._create(n.new_parent_path or ev.directory, n.new_entry)
+        elif has_old and has_new:
+            moved = n.new_parent_path and (
+                n.new_parent_path != ev.directory
+                or n.old_entry.name != n.new_entry.name
+            )
+            if moved:
+                # rename: drop the old location, create at the new one
+                await self._delete(ev.directory, n.old_entry, delete_data=False)
+                await self._create(n.new_parent_path, n.new_entry)
+            else:
+                await self._create(ev.directory, n.new_entry)
+
+    async def _existing_by_source(
+        self, directory: str, name: str
+    ) -> dict[str, filer_pb2.FileChunk]:
+        """Target chunks already replicated for this entry, keyed by the
+        source fid they came from — lets updates skip unchanged chunks
+        (filer_sink.go UpdateEntry's chunk diff)."""
+        try:
+            resp = await self._stub().LookupDirectoryEntry(
+                filer_pb2.LookupDirectoryEntryRequest(
+                    directory=directory, name=name
+                )
+            )
+        except grpc.aio.AioRpcError as e:
+            if e.code() == grpc.StatusCode.NOT_FOUND:
+                return {}
+            raise
+        if not resp.HasField("entry"):
+            return {}
+        return {
+            c.source_file_id: c for c in resp.entry.chunks if c.source_file_id
+        }
+
+    async def _replicate_chunks(
+        self, entry: filer_pb2.Entry, existing: dict[str, filer_pb2.FileChunk]
+    ) -> list[filer_pb2.FileChunk]:
+        out = []
+        for c in entry.chunks:
+            have = existing.get(c.file_id)
+            if have is not None:
+                # data already in the target cluster — keep its fid, take
+                # the source's logical placement
+                nc = filer_pb2.FileChunk()
+                nc.CopyFrom(c)
+                nc.file_id = have.file_id
+                nc.source_file_id = c.file_id
+                out.append(nc)
+                continue
+            blob = await self.fetch_chunk(c.file_id)
+            a = await self._stub().AssignVolume(
+                filer_pb2.AssignVolumeRequest(
+                    count=1,
+                    collection=self.collection,
+                    replication=self.replication,
+                )
+            )
+            if a.error:
+                raise RuntimeError(f"target assign failed: {a.error}")
+            await upload_data(
+                f"http://{a.location.url}/{a.file_id}",
+                blob,
+                compress=False,
+                jwt=a.auth,
+            )
+            nc = filer_pb2.FileChunk()
+            nc.CopyFrom(c)
+            nc.file_id = a.file_id
+            nc.source_file_id = c.file_id
+            out.append(nc)
+        return out
+
+    async def _create(self, directory: str, entry: filer_pb2.Entry) -> None:
+        directory = self._map_dir(directory)
+        existing = await self._existing_by_source(directory, entry.name)
+        new_entry = filer_pb2.Entry()
+        new_entry.CopyFrom(entry)
+        del new_entry.chunks[:]
+        new_entry.chunks.extend(await self._replicate_chunks(entry, existing))
+        resp = await self._stub().CreateEntry(
+            filer_pb2.CreateEntryRequest(
+                directory=directory,
+                entry=new_entry,
+                is_from_other_cluster=True,
+                signatures=[self.signature] if self.signature else [],
+            )
+        )
+        if resp.error:
+            raise RuntimeError(f"sink create {directory}/{entry.name}: {resp.error}")
+
+    async def _delete(
+        self, directory: str, entry: filer_pb2.Entry, delete_data: bool = True
+    ) -> None:
+        try:
+            await self._stub().DeleteEntry(
+                filer_pb2.DeleteEntryRequest(
+                    directory=self._map_dir(directory),
+                    name=entry.name,
+                    is_delete_data=delete_data,
+                    is_recursive=True,
+                    ignore_recursive_error=True,
+                    is_from_other_cluster=True,
+                    signatures=[self.signature] if self.signature else [],
+                )
+            )
+        except grpc.aio.AioRpcError as e:
+            if e.code() != grpc.StatusCode.NOT_FOUND:
+                raise

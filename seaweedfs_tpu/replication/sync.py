@@ -1,0 +1,160 @@
+"""Continuous filer-to-filer replication (the `filer.sync` command).
+
+Reference: weed/command/filer_sync.go — subscribe to the source filer's
+metadata stream from a checkpoint, apply each event through a sink, and
+persist the offset in the TARGET filer's KV store so restarts resume.
+For active-active sync run two FilerSyncs with the SAME signature: every
+entry a sync writes carries its signature, and its own subscription
+filters those events out (the reference's doSubscribeFilerMetaChanges
+loop guard).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+
+import grpc
+
+from ..pb import Stub, filer_pb2
+from ..pb.rpc import channel
+from .sink import FilerSink
+from .source import FilerSource
+
+log = logging.getLogger("replication.sync")
+
+
+def _checkpoint_key(source: str, prefix: str) -> bytes:
+    return f"filer.sync/{source}{prefix}".encode()
+
+
+class FilerSync:
+    def __init__(
+        self,
+        source_grpc_address: str,
+        target_grpc_address: str,
+        path_prefix: str = "/",
+        target_path: str = "",  # default: same subtree on the target
+        signature: int = 0,
+        checkpoint_every: int = 16,
+        event_retries: int = 3,
+    ):
+        self.source_grpc_address = source_grpc_address
+        self.target_grpc_address = target_grpc_address
+        self.path_prefix = path_prefix
+        self.signature = signature or (hash((source_grpc_address, target_grpc_address)) & 0x7FFFFFFF)
+        self.checkpoint_every = checkpoint_every
+        self.event_retries = event_retries
+        self.source = FilerSource(source_grpc_address)
+        self.sink = FilerSink(
+            target_grpc_address,
+            fetch_chunk=self.source.fetch_chunk,
+            signature=self.signature,
+            source_path=path_prefix,
+            target_path=target_path or path_prefix,
+        )
+        self.applied = 0
+        self.skipped = 0
+        self._task: asyncio.Task | None = None
+        self._source_stub = None
+        self._target_stub = None
+
+    def _src(self):
+        if self._source_stub is None:
+            self._source_stub = Stub(
+                channel(self.source_grpc_address), filer_pb2, "SeaweedFiler"
+            )
+        return self._source_stub
+
+    def _tgt(self):
+        if self._target_stub is None:
+            self._target_stub = Stub(
+                channel(self.target_grpc_address), filer_pb2, "SeaweedFiler"
+            )
+        return self._target_stub
+
+    async def load_checkpoint(self) -> int:
+        resp = await self._tgt().KvGet(
+            filer_pb2.KvGetRequest(
+                key=_checkpoint_key(self.source_grpc_address, self.path_prefix)
+            )
+        )
+        if resp.value:
+            return struct.unpack("<q", resp.value)[0]
+        return 0
+
+    async def save_checkpoint(self, ts_ns: int) -> None:
+        await self._tgt().KvPut(
+            filer_pb2.KvPutRequest(
+                key=_checkpoint_key(self.source_grpc_address, self.path_prefix),
+                value=struct.pack("<q", ts_ns),
+            )
+        )
+
+    async def run(self) -> None:
+        """Subscribe-apply-checkpoint loop; reconnects on stream errors."""
+        since = last_ts = 0
+        while True:
+            try:
+                since = await self.load_checkpoint()
+                log.info(
+                    "sync %s -> %s from ts=%d",
+                    self.source_grpc_address, self.target_grpc_address, since,
+                )
+                pending = 0
+                last_ts = since
+                async for ev in self._src().SubscribeMetadata(
+                    filer_pb2.SubscribeMetadataRequest(
+                        client_name=f"sync-{self.signature}",
+                        path_prefix=self.path_prefix,
+                        since_ns=since,
+                        signature=self.signature,
+                    )
+                ):
+                    await self._apply_with_retry(ev)
+                    last_ts = ev.ts_ns
+                    pending += 1
+                    if pending >= self.checkpoint_every:
+                        await self.save_checkpoint(last_ts)
+                        pending = 0
+            except asyncio.CancelledError:
+                if last_ts > since:
+                    await self.save_checkpoint(last_ts)
+                raise
+            except grpc.aio.AioRpcError as e:
+                log.warning("sync stream error (%s); reconnecting", e.code())
+                await asyncio.sleep(1.0)
+
+    async def _apply_with_retry(self, ev) -> None:
+        """Retry transient failures; a deterministically-failing event is
+        skipped (logged) so it can't wedge the stream forever — e.g. a
+        create whose source chunks were purged before the sync saw it."""
+        for attempt in range(self.event_retries):
+            try:
+                await self.sink.apply(ev)
+                self.applied += 1
+                return
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001
+                if attempt == self.event_retries - 1:
+                    self.skipped += 1
+                    log.exception(
+                        "sync event at ts=%d failed %d times; skipping",
+                        ev.ts_ns, self.event_retries,
+                    )
+                else:
+                    await asyncio.sleep(0.5 * (attempt + 1))
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self.run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        await self.source.close()

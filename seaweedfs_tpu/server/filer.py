@@ -74,6 +74,7 @@ class FilerServer:
         compress_chunks: bool = True,  # zstd compressible chunks (util/compression.go)
         chunk_cache_mb: int = 64,
         chunk_cache_dir: str | None = None,
+        notifier=None,  # replication.notification.Notifier
     ):
         self.masters = masters
         self.ip = ip
@@ -99,6 +100,7 @@ class FilerServer:
             store if store is not None else MemoryStore(),
             delete_file_ids_fn=self._delete_file_ids,
             meta_log_path=meta_log_path,
+            notifier=notifier,
         )
         self.master_client = MasterClient(
             masters,
