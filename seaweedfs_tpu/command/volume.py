@@ -40,6 +40,10 @@ def add_args(p) -> None:
         "-readMode", dest="read_mode", default="proxy",
         choices=["local", "proxy", "redirect"],
     )
+    p.add_argument(
+        "-tier.dir", dest="tier_dir", default="",
+        help="directory backing the 'local.default' tier storage backend",
+    )
 
 
 async def run(args) -> None:
@@ -63,6 +67,11 @@ async def run(args) -> None:
         ec_backend=args.ec_backend,
         read_mode=args.read_mode,
         jwt_signing_key=config_util.jwt_signing_key(),
+        tier_backends=(
+            {"local.default": {"type": "local", "dir": args.tier_dir}}
+            if args.tier_dir
+            else None
+        ),
     )
     await vs.start()
     await asyncio.Event().wait()

@@ -31,6 +31,7 @@ class LocalCluster:
         s3_kwargs: dict | None = None,
         with_webdav: bool = False,
         jwt_signing_key: str = "",
+        tier_backends: dict | None = None,  # default: local backend in base_dir/tier
     ):
         import os
 
@@ -49,6 +50,13 @@ class LocalCluster:
         self.s3_kwargs = s3_kwargs or {}
         self.s3 = None
         self.base_dir = base_dir
+        if tier_backends is None:
+            tier_backends = {
+                "local.default": {
+                    "type": "local",
+                    "dir": os.path.join(base_dir, "tier"),
+                }
+            }
         self._specs = []
         for i in range(n_volume_servers):
             dirs = [
@@ -63,6 +71,7 @@ class LocalCluster:
                     ec_backend=ec_backend,
                     data_center=(data_centers or ["dc1"])[i % len(data_centers or ["dc1"])],
                     rack=(racks or ["r1"])[i % len(racks or ["r1"])],
+                    tier_backends=tier_backends,
                 )
             )
         self.volume_servers: list[VolumeServer] = []

@@ -70,7 +70,12 @@ class VolumeServer:
         ec_backend: str = "auto",
         read_mode: str = "proxy",  # local | proxy | redirect
         jwt_signing_key: str = "",
+        tier_backends: dict | None = None,  # storage/backend.py configure()
     ):
+        if tier_backends:
+            from ..storage import backend as backend_mod
+
+            backend_mod.configure(tier_backends)
         if isinstance(max_volume_counts, int):
             max_volume_counts = [max_volume_counts] * len(directories)
         self.store = Store(
@@ -679,7 +684,9 @@ class VolumeServer:
         if v is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
         return volume_server_pb2.VacuumVolumeCheckResponse(
-            garbage_ratio=v.garbage_ratio
+            # tiered volumes must not be vacuum candidates: compaction
+            # would clash with the remote .dat the .vif records
+            garbage_ratio=0.0 if v.is_tiered else v.garbage_ratio
         )
 
     async def VacuumVolumeCompact(self, request, context):
@@ -709,6 +716,35 @@ class VolumeServer:
         return volume_server_pb2.VacuumVolumeCleanupResponse()
 
     # ------------------------------------------------------------------ gRPC: tail sync
+
+    async def VolumeTierMoveDatToRemote(self, request, context):
+        """Upload the .dat to a backend, keep serving reads from it
+        (volume_grpc_tier.go)."""
+        try:
+            size = await asyncio.to_thread(
+                self.store.tier_move_to_remote,
+                request.volume_id,
+                request.destination_backend_name,
+                request.keep_local_dat_file,
+            )
+        except (NotFoundError, ValueError, KeyError, OSError) as e:
+            await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        yield volume_server_pb2.VolumeTierMoveDatToRemoteResponse(
+            processed=size, processedPercentage=100.0
+        )
+
+    async def VolumeTierMoveDatFromRemote(self, request, context):
+        try:
+            size = await asyncio.to_thread(
+                self.store.tier_move_from_remote,
+                request.volume_id,
+                request.keep_remote_dat_file,
+            )
+        except (NotFoundError, ValueError, KeyError, OSError) as e:
+            await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        yield volume_server_pb2.VolumeTierMoveDatFromRemoteResponse(
+            processed=size, processedPercentage=100.0
+        )
 
     async def VolumeTailSender(self, request, context):
         """Stream records appended after since_ns; with a nonzero idle

@@ -325,3 +325,60 @@ async def cmd_volume_grow(env, args):
     async with aiohttp.ClientSession() as s:
         async with s.get(f"http://{master}/vol/grow?{qs}") as r:
             env.write(await r.text())
+
+
+async def _tier_nodes_for(env, vid: int):
+    """Every node holding volume `vid` (tiering runs on each replica)."""
+    nodes, _ = await env.collect_topology()
+    holders = [
+        n for n in nodes if any(v["id"] == vid for v in n.volumes)
+    ]
+    if not holders:
+        raise ValueError(f"volume {vid} not found in topology")
+    return holders
+
+
+@command("volume.tier.upload")
+async def cmd_volume_tier_upload(env, args):
+    """-volumeId N -dest <type.id> [-keepLocalDatFile] : move the volume's
+    .dat onto a storage backend; reads keep working via ranged fetches
+    (command_volume_tier_upload.go)"""
+    env.confirm_is_locked()
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    dest = flags.get("dest", "local.default")
+    for node in await _tier_nodes_for(env, vid):
+        # tiered volumes must be readonly first (the reference marks them)
+        await env.volume_stub(node.grpc_address).VolumeMarkReadonly(
+            volume_server_pb2.VolumeMarkReadonlyRequest(volume_id=vid)
+        )
+        async for resp in env.volume_stub(node.grpc_address).VolumeTierMoveDatToRemote(
+            volume_server_pb2.VolumeTierMoveDatToRemoteRequest(
+                volume_id=vid,
+                destination_backend_name=dest,
+                keep_local_dat_file="keepLocalDatFile" in flags,
+            )
+        ):
+            env.write(
+                f"volume {vid} @ {node.url}: uploaded {resp.processed} bytes "
+                f"to {dest}"
+            )
+
+
+@command("volume.tier.download")
+async def cmd_volume_tier_download(env, args):
+    """-volumeId N [-keepRemoteDatFile] : bring a tiered volume's .dat back
+    to local disk (command_volume_tier_download.go)"""
+    env.confirm_is_locked()
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    for node in await _tier_nodes_for(env, vid):
+        async for resp in env.volume_stub(node.grpc_address).VolumeTierMoveDatFromRemote(
+            volume_server_pb2.VolumeTierMoveDatFromRemoteRequest(
+                volume_id=vid,
+                keep_remote_dat_file="keepRemoteDatFile" in flags,
+            )
+        ):
+            env.write(
+                f"volume {vid} @ {node.url}: downloaded {resp.processed} bytes"
+            )

@@ -67,9 +67,24 @@ class DiskLocation:
     def load_existing_volumes(self) -> None:
         names = sorted(os.listdir(self.directory))
         for name in names:
-            if not name.endswith(".dat"):
+            # .vif-only volumes are tiered: their .dat lives on a storage
+            # backend (volume_tier.go), so both extensions mark a volume
+            if name.endswith(".dat"):
+                stem = name[: -len(".dat")]
+            elif name.endswith(".vif"):
+                # EC-encoded volumes leave .vif sidecars too — only a .vif
+                # recording remote files marks a tiered volume
+                from .volume_info import load_volume_info
+
+                vinfo = load_volume_info(os.path.join(self.directory, name))
+                if not any(f.get("key") for f in vinfo.get("files", [])):
+                    continue
+                stem = name[: -len(".vif")]
+                if os.path.exists(os.path.join(self.directory, stem + ".dat")):
+                    continue  # already handled via the .dat entry
+            else:
                 continue
-            parsed = parse_base_name(name[: -len(".dat")])
+            parsed = parse_base_name(stem)
             if parsed is None:
                 continue
             collection, vid = parsed
@@ -77,8 +92,8 @@ class DiskLocation:
                 continue
             try:
                 self.volumes[vid] = Volume(self.directory, vid, collection)
-            except ValueError:
-                continue  # not a volume (bad superblock)
+            except (ValueError, KeyError):
+                continue  # bad superblock, or tier backend not configured
         self._load_ec_volumes(names)
 
     def _load_ec_volumes(self, names: list[str]) -> None:

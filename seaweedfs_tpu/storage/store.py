@@ -207,10 +207,105 @@ class Store:
                     return
         raise NotFoundError(f"volume {vid} not found on disk")
 
+    def _tier_key(self, v: Volume) -> str:
+        """Backend object key for this replica's .dat — includes the server
+        address so replicas of the same volume never share (and never
+        delete) each other's objects."""
+        return f"{self.ip}_{self.port}_{os.path.basename(v.dat_path)}"
+
+    def tier_move_to_remote(
+        self, vid: int, dest_backend_name: str, keep_local: bool = False
+    ) -> int:
+        """Upload a readonly volume's .dat to a storage backend and reload
+        it tiered (volume_grpc_tier.go VolumeTierMoveDatToRemote).
+        Returns the uploaded size."""
+        import time as _time
+
+        from . import backend as backend_mod
+        from .volume_info import save_volume_info
+
+        v = self.find_volume(vid)
+        loc = self.location_of_volume(vid)
+        if v is None or loc is None:
+            raise NotFoundError(f"volume {vid} not found")
+        if v.is_tiered:
+            raise ValueError(f"volume {vid} is already tiered")
+        if not (v.read_only or v.full):
+            raise ValueError(f"volume {vid} must be readonly before tiering")
+        btype, _, bid = dest_backend_name.partition(".")
+        storage = backend_mod.get_backend(btype, bid or "default")
+        v.sync()
+        key = self._tier_key(v)
+        size = storage.upload(v.dat_path, key)
+        save_volume_info(
+            v.vif_path,
+            {
+                "version": v.version,
+                "files": [
+                    {
+                        "backendType": btype,
+                        "backendId": bid or "default",
+                        "key": key,
+                        "fileSize": size,
+                        "modifiedTime": int(_time.time()),
+                    }
+                ],
+            },
+        )
+        with self._lock:
+            # the old Volume object is deliberately NOT closed: lock-free
+            # readers may still hold its _ReadState (same discipline as the
+            # vacuum swap); its fds close via refcounting when they finish.
+            # unlink is safe for those readers — the fd keeps the inode.
+            if not keep_local:
+                os.remove(v.dat_path)
+                if os.path.exists(v.note_path):
+                    os.remove(v.note_path)
+            loc.volumes[vid] = Volume(loc.directory, vid, v.collection)
+        return size
+
+    def tier_move_from_remote(self, vid: int, keep_remote: bool = False) -> int:
+        """Download a tiered volume's .dat back to local disk
+        (VolumeTierMoveDatFromRemote).  Returns the local size."""
+        from . import backend as backend_mod
+        from .volume_info import load_volume_info, save_volume_info
+
+        v = self.find_volume(vid)
+        loc = self.location_of_volume(vid)
+        if v is None or loc is None:
+            raise NotFoundError(f"volume {vid} not found")
+        # detect tiering from the .vif — covers both remote-serving volumes
+        # and keep_local ones still holding a local copy
+        vinfo = load_volume_info(v.vif_path)
+        remote_files = [f for f in vinfo.get("files", []) if f.get("key")]
+        if not remote_files:
+            raise ValueError(f"volume {vid} is not tiered")
+        rf = remote_files[0]
+        storage = backend_mod.get_backend(
+            rf["backendType"], rf.get("backendId", "default")
+        )
+        if not os.path.exists(v.dat_path):
+            storage.download(rf["key"], v.dat_path)
+        size = os.path.getsize(v.dat_path)
+        save_volume_info(v.vif_path, {"version": v.version, "files": []})
+        with self._lock:
+            # old Volume left open for in-flight readers (see to_remote)
+            reloaded = Volume(loc.directory, vid, v.collection)
+            reloaded.read_only = True  # stays readonly like the reference
+            loc.volumes[vid] = reloaded
+        if not keep_remote:
+            storage.delete_key(rf["key"])
+        return size
+
     def mark_volume_readonly(self, vid: int, read_only: bool = True) -> None:
         v = self.find_volume(vid)
         if v is None:
             raise NotFoundError(f"volume {vid} not found")
+        if not read_only and v.is_tiered:
+            raise ValueError(
+                f"volume {vid} is tiered; volume.tier.download it before "
+                "marking writable"
+            )
         v.read_only = read_only
         if not read_only:
             v.full = False  # admin override re-opens a size-locked volume
@@ -262,6 +357,10 @@ class Store:
         v = self.find_volume(vid)
         if v is None:
             raise NotFoundError(f"volume {vid} not found")
+        if v.is_tiered:
+            raise ValueError(
+                f"volume {vid} is tiered; download before vacuuming"
+            )
         ratio = vacuum_volume(v)
         # a vacuumed volume that shrank back under the limit re-opens for
         # writes; tell the master right away

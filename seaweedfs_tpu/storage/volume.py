@@ -76,6 +76,19 @@ class _ReadState:
         self.nm = nm
 
 
+def _pread(dat, size: int, offset: int) -> bytes:
+    """Ranged read from a local file or a tiered RemoteDat backend."""
+    if hasattr(dat, "pread"):
+        return dat.pread(size, offset)
+    return os.pread(dat.fileno(), size, offset)
+
+
+def _dat_size(dat) -> int:
+    if hasattr(dat, "pread"):
+        return dat.size()
+    return os.fstat(dat.fileno()).st_size
+
+
 class Volume:
     def __init__(
         self,
@@ -99,6 +112,34 @@ class Volume:
         self.dat_path = base + ".dat"
         self.idx_path = base + ".idx"
         self.note_path = base + ".note"
+        self.vif_path = base + ".vif"
+        self.remote_dat = None
+
+        # tiered volume: .dat lives on a storage backend, .idx stays local
+        # (volume_tier.go LoadRemoteFile)
+        from .volume_info import load_volume_info
+
+        vinfo = load_volume_info(self.vif_path)
+        remote_files = [f for f in vinfo.get("files", []) if f.get("key")]
+        self.remote_files = remote_files
+        if remote_files and not os.path.exists(self.dat_path):
+            from . import backend as backend_mod
+
+            rf = remote_files[0]
+            storage = backend_mod.get_backend(
+                rf["backendType"], rf.get("backendId", "default")
+            )
+            self.remote_dat = backend_mod.RemoteDat(
+                storage, rf["key"], int(rf["fileSize"])
+            )
+            self.super_block = SuperBlock.from_bytes(
+                self.remote_dat.pread(SUPER_BLOCK_SIZE, 0)
+            )
+            nm = needle_map.CompactMap.load_from_idx(self.idx_path, self.version)
+            self._state = _ReadState(self.remote_dat, nm)
+            self._idx = None
+            self.read_only = True
+            return
 
         if os.path.exists(self.dat_path):
             with open(self.dat_path, "rb") as f:
@@ -126,10 +167,21 @@ class Volume:
             nm = needle_map.CompactMap()
         self._state = _ReadState(open(self.dat_path, "r+b"), nm)
         self._idx = open(self.idx_path, "ab")
+        if remote_files:
+            # tiered with keep_local_dat_file: serve the local copy but the
+            # .vif still records the remote — stay readonly so the copies
+            # can't diverge
+            self.read_only = True
         # dirty marker: present while the volume is open for writing, so a
         # crash is detectable on the next load; removed on clean close
         with open(self.note_path, "w") as f:
             f.write("open for writing\n")
+
+    @property
+    def is_tiered(self) -> bool:
+        """The .vif records a remote .dat (serving remotely, or a kept
+        local copy that must not diverge from the uploaded one)."""
+        return bool(self.remote_files)
 
     @property
     def nm(self) -> needle_map.CompactMap:
@@ -200,6 +252,8 @@ class Volume:
         """Append; returns (actual_offset, size). The volume's syncWrite
         (volume_write.go:93): record first, then index entry."""
         with self._lock:
+            if self.is_tiered:
+                raise VolumeReadOnly(f"volume {self.id} is tiered")
             if self.read_only or self.full:
                 raise VolumeReadOnly(f"volume {self.id} is read-only")
             record = n.to_bytes(self.version)
@@ -242,6 +296,8 @@ class Volume:
     def delete(self, needle_id: int, cookie: int | None = None) -> int:
         """Tombstone; returns reclaimed byte count (0 if absent)."""
         with self._lock:
+            if self.is_tiered:
+                raise VolumeReadOnly(f"volume {self.id} is tiered")
             if self.read_only:
                 raise VolumeReadOnly(f"volume {self.id} is read-only")
             loc = self.nm.get(needle_id)
@@ -270,7 +326,7 @@ class Volume:
     ) -> Needle:
         st = st or self._state
         total = needle_mod.actual_size(size, self.version)
-        buf = os.pread(st.dat.fileno(), total, offset)
+        buf = _pread(st.dat, total, offset)
         return Needle.from_bytes(buf, self.version)
 
     def read(self, needle_id: int, cookie: int | None = None) -> Needle:
@@ -292,6 +348,8 @@ class Volume:
 
     @property
     def content_size(self) -> int:
+        if self.remote_dat is not None:
+            return self.remote_dat.size()
         self._dat.flush()
         return os.path.getsize(self.dat_path)
 
@@ -323,11 +381,10 @@ class Volume:
         for the whole walk so a concurrent vacuum swap can't mix old
         offsets with the compacted file (same discipline as read())."""
         st = st or self._state
-        fd = st.dat.fileno()
-        size = os.fstat(fd).st_size
+        size = _dat_size(st.dat)
         offset = max(start_offset, SUPER_BLOCK_SIZE)
         while offset + t.NEEDLE_HEADER_SIZE <= size:
-            hdr = os.pread(fd, t.NEEDLE_HEADER_SIZE, offset)
+            hdr = _pread(st.dat, t.NEEDLE_HEADER_SIZE, offset)
             if len(hdr) < t.NEEDLE_HEADER_SIZE:
                 break
             _, _, nsize = Needle.parse_header(hdr)
@@ -335,7 +392,7 @@ class Volume:
             total = needle_mod.actual_size(body_size, self.version)
             if offset + total > size:
                 break  # torn record at EOF — stop, don't crash
-            rest = os.pread(fd, total - t.NEEDLE_HEADER_SIZE, offset + len(hdr))
+            rest = _pread(st.dat, total - t.NEEDLE_HEADER_SIZE, offset + len(hdr))
             n = Needle.from_bytes(hdr + rest, self.version, verify=False)
             yield offset, hdr, rest, nsize, n
             offset += total
@@ -350,6 +407,8 @@ class Volume:
 
     def sync(self) -> None:
         with self._lock:
+            if self.remote_dat is not None:
+                return
             self._dat.flush()
             os.fsync(self._dat.fileno())
             self._idx.flush()
@@ -357,6 +416,9 @@ class Volume:
 
     def close(self) -> None:
         with self._lock:
+            if self.remote_dat is not None:
+                self.remote_dat.close()
+                return
             clean = not self._dat.closed or not self._idx.closed
             if not self._dat.closed:
                 self._dat.flush()
@@ -369,18 +431,20 @@ class Volume:
 
     def destroy(self) -> None:
         self.close()
-        for p in (self.dat_path, self.idx_path, self.note_path):
+        if self.remote_dat is not None:
+            self.remote_dat.storage.delete_key(self.remote_dat.key)
+        for p in (self.dat_path, self.idx_path, self.note_path, self.vif_path):
             if os.path.exists(p):
                 os.remove(p)
 
     # -- tail sync (incremental replica catch-up) ---------------------------
 
-    def _append_at_ns_at(self, fd: int, offset: int, size: int) -> int:
+    def _append_at_ns_at(self, dat, offset: int, size: int) -> int:
         """The v3 append timestamp of the record at `offset` (8 bytes just
         before the padding, needle.py to_bytes)."""
         total = needle_mod.actual_size(size, self.version)
         pad = needle_mod.padding_length(size, self.version)
-        buf = os.pread(fd, 8, offset + total - pad - 8)
+        buf = _pread(dat, 8, offset + total - pad - 8)
         return int.from_bytes(buf, "big")
 
     def find_offset_since(self, since_ns: int) -> int:
@@ -398,7 +462,6 @@ class Volume:
             # nonzero cursor can't be honored — resend everything
             return SUPER_BLOCK_SIZE
         st = self._state
-        fd = st.dat.fileno()
         entries = sorted(
             (off, size)
             for _, off, size in st.nm.items()
@@ -407,7 +470,7 @@ class Volume:
         lo, hi = 0, len(entries)
         while lo < hi:
             mid = (lo + hi) // 2
-            if self._append_at_ns_at(fd, *entries[mid]) > since_ns:
+            if self._append_at_ns_at(st.dat, *entries[mid]) > since_ns:
                 hi = mid
             else:
                 lo = mid + 1

@@ -1,0 +1,211 @@
+"""Tiered storage: .dat files moved to a storage backend while reads keep
+working via ranged fetches; volume.tier.upload/download shell commands;
+reload-from-.vif discovery.
+
+Reference shapes: weed/storage/backend/backend.go,
+volume_grpc_tier.go, shell/command_volume_tier_upload.go /
+_download.go.
+"""
+import asyncio
+import io
+import os
+
+import aiohttp
+import pytest
+
+from seaweedfs_tpu.server.cluster import LocalCluster
+from seaweedfs_tpu.shell import CommandEnv, run_command
+from seaweedfs_tpu.storage import backend as backend_mod
+from seaweedfs_tpu.storage.volume import Volume
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_local_backend_roundtrip(tmp_path):
+    b = backend_mod.LocalBackendStorage("default", str(tmp_path / "store"))
+    src = tmp_path / "f.dat"
+    src.write_bytes(b"0123456789" * 1000)
+    assert b.upload(str(src), "1.dat") == 10_000
+    assert b.size("1.dat") == 10_000
+    assert b.pread("1.dat", 10, 20) == b"0123456789"
+    dst = tmp_path / "back.dat"
+    b.download("1.dat", str(dst))
+    assert dst.read_bytes() == src.read_bytes()
+    b.delete_key("1.dat")
+    with pytest.raises(FileNotFoundError):
+        b.size("1.dat")
+
+
+def test_backend_registry_configure(tmp_path):
+    backend_mod.configure(
+        {"local.cold": {"type": "local", "dir": str(tmp_path / "cold")}}
+    )
+    assert backend_mod.get_backend("local", "cold").name == "local.cold"
+    with pytest.raises(KeyError):
+        backend_mod.get_backend("local", "nope")
+
+
+def test_tier_upload_download_e2e(tmp_path):
+    async def go():
+        cluster = LocalCluster(
+            base_dir=str(tmp_path), n_volume_servers=1, volume_size_limit_mb=8
+        )
+        await cluster.start()
+        try:
+            from seaweedfs_tpu.operation import assign, upload_data
+
+            master = cluster.master.advertise_url
+            a0 = await assign(master)
+            vid = int(a0.fid.split(",")[0])
+            blobs = {}
+            for i in range(10):
+                ai = await assign(master)
+                if int(ai.fid.split(",")[0]) != vid:
+                    continue
+                data = os.urandom(5000 + i * 777)
+                await upload_data(f"http://{ai.url}/{ai.fid}", data)
+                blobs[ai.fid] = data
+            assert blobs
+
+            env = CommandEnv([master], out=io.StringIO())
+            await run_command(env, "lock")
+            await run_command(
+                env, f"volume.tier.upload -volumeId {vid} -dest local.default"
+            )
+            assert "uploaded" in env.out.getvalue()
+
+            vs = cluster.volume_servers[0]
+            v = vs.store.find_volume(vid)
+            assert v.remote_dat is not None, "volume should serve from the tier"
+            assert not os.path.exists(v.dat_path), ".dat must be gone locally"
+            tier_dir = os.path.join(str(tmp_path), "tier")
+            assert os.listdir(tier_dir), "backend holds the .dat"
+
+            async with aiohttp.ClientSession() as s:
+                for fid, data in blobs.items():
+                    async with s.get(f"http://{vs.url}/{fid}") as r:
+                        assert r.status == 200, fid
+                        assert await r.read() == data, fid
+
+            # writes must be refused on a tiered volume
+            import aiohttp as _a
+
+            async with _a.ClientSession() as s:
+                fid0 = next(iter(blobs))
+                async with s.post(
+                    f"http://{vs.url}/{fid0}", data=b"nope"
+                ) as r:
+                    assert r.status >= 400
+
+            # bring it back
+            await run_command(env, f"volume.tier.download -volumeId {vid}")
+            assert "downloaded" in env.out.getvalue()
+            v2 = vs.store.find_volume(vid)
+            assert v2.remote_dat is None
+            assert os.path.exists(v2.dat_path)
+            async with aiohttp.ClientSession() as s:
+                for fid, data in blobs.items():
+                    async with s.get(f"http://{vs.url}/{fid}") as r:
+                        assert r.status == 200 and await r.read() == data
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def _store_with_volume(tmp_path, vid=7, n_needles=10):
+    vdir = str(tmp_path / "v")
+    os.makedirs(vdir, exist_ok=True)
+    from seaweedfs_tpu.storage.disk_location import DiskLocation
+    from seaweedfs_tpu.storage.store import Store
+
+    v = Volume(vdir, vid)
+    payloads = {i: os.urandom(500 + i) for i in range(1, n_needles + 1)}
+    for nid, data in payloads.items():
+        v.write(nid, 0xABC, data)
+    v.read_only = True
+    loc = DiskLocation(vdir, max_volume_count=4)
+    loc.volumes[vid] = v
+    return Store([loc]), payloads
+
+
+def test_keep_local_stays_tiered_and_readonly(tmp_path):
+    """keep_local_dat_file: the volume serves the local copy, refuses
+    writes, can still be tier-downloaded, and reloads readonly."""
+    backend_mod.configure(
+        {"local.default": {"type": "local", "dir": str(tmp_path / "tier")}}
+    )
+    store, payloads = _store_with_volume(tmp_path)
+    store.tier_move_to_remote(7, "local.default", keep_local=True)
+    v = store.find_volume(7)
+    assert os.path.exists(v.dat_path), "local copy kept"
+    assert v.is_tiered and v.read_only
+    from seaweedfs_tpu.storage.volume import VolumeReadOnly
+
+    with pytest.raises(VolumeReadOnly):
+        v.write(999, 0xABC, b"divergence")
+    with pytest.raises(ValueError):
+        store.mark_volume_readonly(7, read_only=False)
+    with pytest.raises(ValueError):
+        store.vacuum_volume(7)
+    # download resolves the tiered state even though .dat never left
+    store.tier_move_from_remote(7)
+    v2 = store.find_volume(7)
+    assert not v2.is_tiered
+    for nid, data in payloads.items():
+        assert v2.read(nid, 0xABC).data == data
+
+
+def test_replicas_use_distinct_backend_keys(tmp_path):
+    """Two stores (replicas) tiering the same volume id must not share a
+    backend object — one replica's download+delete can't destroy the
+    other's data."""
+    backend_mod.configure(
+        {"local.default": {"type": "local", "dir": str(tmp_path / "tier")}}
+    )
+    s1, p1 = _store_with_volume(tmp_path / "r1")
+    s2, p2 = _store_with_volume(tmp_path / "r2")
+    s1.port, s2.port = 8081, 8082
+    s1.tier_move_to_remote(7, "local.default")
+    s2.tier_move_to_remote(7, "local.default")
+    assert len(os.listdir(str(tmp_path / "tier"))) == 2
+    s1.tier_move_from_remote(7)  # deletes ONLY s1's object
+    v2 = s2.find_volume(7)
+    for nid, data in p2.items():
+        assert v2.read(nid, 0xABC).data == data
+
+
+def test_tiered_volume_reloads_from_vif(tmp_path):
+    """A tiered volume (only .idx + .vif on disk) is rediscovered after a
+    volume-object reload and still serves every needle."""
+    backend_mod.configure(
+        {"local.default": {"type": "local", "dir": str(tmp_path / "tier")}}
+    )
+    vdir = str(tmp_path / "v")
+    os.makedirs(vdir)
+    v = Volume(vdir, 7)
+    payloads = {i: os.urandom(1000 + i) for i in range(1, 20)}
+    for nid, data in payloads.items():
+        v.write(nid, 0xABC, data)
+    v.read_only = True
+    from seaweedfs_tpu.storage.store import Store
+    from seaweedfs_tpu.storage.disk_location import DiskLocation
+
+    loc = DiskLocation(vdir, max_volume_count=4)
+    loc.volumes[7] = v
+    store = Store([loc])
+    store.tier_move_to_remote(7, "local.default")
+    assert not os.path.exists(v.dat_path)
+
+    # fresh discovery, as after a process restart
+    loc2 = DiskLocation(vdir, max_volume_count=4)
+    loc2.load_existing_volumes()
+    assert 7 in loc2.volumes
+    v2 = loc2.volumes[7]
+    assert v2.remote_dat is not None and v2.read_only
+    for nid, data in payloads.items():
+        assert v2.read(nid, 0xABC).data == data
+    # scan (vacuum/ec path) works over the remote dat too
+    assert len(list(v2.scan())) == len(payloads)
