@@ -44,6 +44,12 @@ def add_args(p) -> None:
         "-tier.dir", dest="tier_dir", default="",
         help="directory backing the 'local.default' tier storage backend",
     )
+    p.add_argument(
+        "-index", dest="index_kind", default="memory",
+        choices=["memory", "sqlite"],
+        help="needle map kind: memory (CompactMap) or sqlite (persistent, "
+        "O(1) RAM per volume — the reference's leveldb index)",
+    )
 
 
 async def run(args) -> None:
@@ -72,6 +78,7 @@ async def run(args) -> None:
             if args.tier_dir
             else None
         ),
+        index_kind=args.index_kind,
     )
     await vs.start()
     await asyncio.Event().wait()

@@ -71,6 +71,7 @@ class VolumeServer:
         read_mode: str = "proxy",  # local | proxy | redirect
         jwt_signing_key: str = "",
         tier_backends: dict | None = None,  # storage/backend.py configure()
+        index_kind: str = "memory",  # memory | sqlite (ref -index flag)
     ):
         if tier_backends:
             from ..storage import backend as backend_mod
@@ -80,7 +81,12 @@ class VolumeServer:
             max_volume_counts = [max_volume_counts] * len(directories)
         self.store = Store(
             [
-                DiskLocation(d, max_volume_count=c)
+                DiskLocation(
+                    d, max_volume_count=c,
+                    needle_map_kind=(
+                        "persistent" if index_kind == "sqlite" else None
+                    ),
+                )
                 for d, c in zip(directories, max_volume_counts)
             ],
             ip=ip,
@@ -98,7 +104,7 @@ class VolumeServer:
         self.read_mode = read_mode
         self.jwt_signing_key = jwt_signing_key
         self.current_master = masters[0] if masters else ""
-        self._pending_compacts: dict[int, tuple[str, str, int]] = {}
+        self._pending_compacts: dict[int, tuple[str, str, int, str | None]] = {}
         self._ec_locations: dict[int, tuple[float, dict[int, list[str]]]] = {}
         self._grpc_server: grpc.aio.Server | None = None
         self._http_runner: web.AppRunner | None = None
@@ -693,8 +699,8 @@ class VolumeServer:
         v = self.store.find_volume(request.volume_id)
         if v is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
-        cpd, cpx, snap = await asyncio.to_thread(vacuum_mod.compact, v)
-        self._pending_compacts[request.volume_id] = (cpd, cpx, snap)
+        cpd, cpx, snap, shadow = await asyncio.to_thread(vacuum_mod.compact, v)
+        self._pending_compacts[request.volume_id] = (cpd, cpx, snap, shadow)
         yield volume_server_pb2.VacuumVolumeCompactResponse(
             processed_bytes=os.path.getsize(cpd)
         )
@@ -710,8 +716,9 @@ class VolumeServer:
     async def VacuumVolumeCleanup(self, request, context):
         pending = self._pending_compacts.pop(request.volume_id, None)
         if pending:
-            for p in pending[:2]:
-                if os.path.exists(p):
+            cpd, cpx, _, shadow = pending
+            for p in (cpd, cpx, shadow):
+                if p and os.path.exists(p):
                     os.remove(p)
         return volume_server_pb2.VacuumVolumeCleanupResponse()
 

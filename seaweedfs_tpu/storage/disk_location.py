@@ -42,11 +42,13 @@ class DiskLocation:
         max_volume_count: int = 8,
         disk_type: str = "hdd",
         min_free_space_bytes: int = 0,
+        needle_map_kind: str | None = None,  # "compact" | "persistent"
     ):
         self.directory = os.path.abspath(directory)
         self.max_volume_count = max_volume_count
         self.disk_type = disk_type
         self.min_free_space_bytes = min_free_space_bytes
+        self.needle_map_kind = needle_map_kind
         os.makedirs(self.directory, exist_ok=True)
         self.uuid = self._load_or_create_uuid()
         self.volumes: dict[int, Volume] = {}
@@ -91,7 +93,10 @@ class DiskLocation:
             if vid in self.volumes:
                 continue
             try:
-                self.volumes[vid] = Volume(self.directory, vid, collection)
+                self.volumes[vid] = Volume(
+                    self.directory, vid, collection,
+                    needle_map_kind=self.needle_map_kind,
+                )
             except (ValueError, KeyError):
                 continue  # bad superblock, or tier backend not configured
         self._load_ec_volumes(names)

@@ -1,0 +1,221 @@
+"""Persistent needle map: O(1)-memory volume index backed by SQLite.
+
+Reference: weed/storage/needle_map_leveldb.go (459 LoC) — a LevelDB map
+so huge volumes don't replay their whole .idx into RAM at startup; a
+watermark records how many .idx bytes are already folded into the db,
+and open() replays only the tail.  SQLite's native B-tree plays the
+LevelDB role here (same asymptotics, already in the image); the class is
+interface-compatible with CompactMap (set/delete/get/has/items/len/
+stats/indexed_end) so Volume can swap kinds.
+
+Crash-safety: set/delete are idempotent on replay (a re-applied entry
+with identical values doesn't re-count stats), so a stale watermark
+after a crash just replays a little extra tail.  A watermark LARGER than
+the .idx (vacuum rewrote the index) triggers a full rebuild.
+"""
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+
+from . import idx as idx_mod
+from . import needle as needle_mod
+from . import types as t
+from .needle_map import MapStats
+
+_FLUSH_EVERY = 256  # ops between commits+watermark updates
+
+
+class SqliteNeedleMap:
+    def __init__(self, db_path: str, idx_path: str, version: int | None = None):
+        self.db_path = db_path
+        self.idx_path = idx_path
+        self.version = version
+        self._lock = threading.Lock()
+        self.conn = sqlite3.connect(db_path, check_same_thread=False)
+        self.conn.execute(
+            "CREATE TABLE IF NOT EXISTS needles"
+            " (nid INTEGER PRIMARY KEY, off INTEGER, size INTEGER)"
+        )
+        self.conn.execute(
+            "CREATE TABLE IF NOT EXISTS meta (k TEXT PRIMARY KEY, v INTEGER)"
+        )
+        self.stats = MapStats(
+            file_count=self._meta("file_count"),
+            deleted_count=self._meta("deleted_count"),
+            file_bytes=self._meta("file_bytes"),
+            deleted_bytes=self._meta("deleted_bytes"),
+            maximum_key=self._meta("maximum_key"),
+        )
+        self._live = self._meta("live")
+        self.indexed_end = self._meta("indexed_end")
+        self._ops = 0
+        self._replaying = False
+        self._replay_idx_tail()
+
+    def _meta(self, key: str) -> int:
+        row = self.conn.execute(
+            "SELECT v FROM meta WHERE k = ?", (key,)
+        ).fetchone()
+        return int(row[0]) if row else 0
+
+    def _save_meta(self) -> None:
+        s = self.stats
+        self.conn.executemany(
+            "INSERT OR REPLACE INTO meta (k, v) VALUES (?, ?)",
+            [
+                ("file_count", s.file_count),
+                ("deleted_count", s.deleted_count),
+                ("file_bytes", s.file_bytes),
+                ("deleted_bytes", s.deleted_bytes),
+                ("maximum_key", s.maximum_key),
+                ("live", self._live),
+                ("indexed_end", self.indexed_end),
+                ("watermark", self._meta_watermark),
+            ],
+        )
+
+    def _replay_idx_tail(self) -> None:
+        """Fold .idx entries past the watermark into the db
+        (needle_map_leveldb.go generateLevelDbFile's incremental path)."""
+        idx_size = (
+            os.path.getsize(self.idx_path)
+            if os.path.exists(self.idx_path)
+            else 0
+        )
+        watermark = self._meta("watermark")
+        if watermark > idx_size:
+            # .idx was rewritten (vacuum) — rebuild from scratch
+            self.conn.execute("DELETE FROM needles")
+            self.conn.execute("DELETE FROM meta")
+            self.stats = MapStats()
+            self._live = 0
+            self.indexed_end = 0
+            watermark = 0
+        if watermark >= idx_size:
+            self._meta_watermark = watermark
+            return
+        with open(self.idx_path, "rb") as f:
+            f.seek(watermark)
+            ids, offs, sizes = idx_mod.parse_buffer(f.read())
+        # during replay the watermark must track what's actually been
+        # folded — a periodic _bump commit with the full file size would
+        # make a mid-replay crash skip the unapplied tail forever
+        self._replaying = True
+        try:
+            for i in range(len(ids)):
+                self._meta_watermark = watermark + (i + 1) * idx_mod.ENTRY
+                nid, off, size = int(ids[i]), int(offs[i]), int(sizes[i])
+                if t.size_is_valid(size):
+                    self.set(nid, off, size)
+                else:
+                    self.delete(nid)
+        finally:
+            self._replaying = False
+        self._meta_watermark = idx_size
+        with self._lock:
+            self._save_meta()
+            self.conn.commit()
+
+    # -- CompactMap-compatible surface --------------------------------------
+
+    def set(self, needle_id: int, actual_offset: int, size: int) -> None:
+        with self._lock:
+            row = self.conn.execute(
+                "SELECT off, size FROM needles WHERE nid = ?", (needle_id,)
+            ).fetchone()
+            if row is not None and (row[0], row[1]) == (actual_offset, size):
+                return  # idempotent replay
+            old_live = row is not None and t.size_is_valid(row[1])
+            if old_live:
+                self.stats.deleted_count += 1
+                self.stats.deleted_bytes += row[1]
+            self._live += int(t.size_is_valid(size)) - int(old_live)
+            self.conn.execute(
+                "INSERT OR REPLACE INTO needles (nid, off, size) VALUES (?, ?, ?)",
+                (needle_id, actual_offset, size),
+            )
+            self.stats.file_count += 1
+            self.stats.file_bytes += max(size, 0)
+            self.stats.maximum_key = max(self.stats.maximum_key, needle_id)
+            # keep the persisted recovery watermark current on LIVE writes
+            # too — otherwise reopen rescans the whole .dat and can
+            # resurrect tombstoned needles from their stale live records
+            if self.version is not None and t.size_is_valid(size):
+                end = actual_offset + needle_mod.actual_size(size, self.version)
+                if end > self.indexed_end:
+                    self.indexed_end = end
+            self._bump()
+
+    def delete(self, needle_id: int) -> int:
+        with self._lock:
+            row = self.conn.execute(
+                "SELECT off, size FROM needles WHERE nid = ?", (needle_id,)
+            ).fetchone()
+            if row is None or not t.size_is_valid(row[1]):
+                return 0
+            self.conn.execute(
+                "UPDATE needles SET size = ? WHERE nid = ?",
+                (t.TOMBSTONE_FILE_SIZE, needle_id),
+            )
+            self.stats.deleted_count += 1
+            self.stats.deleted_bytes += row[1]
+            self._live -= 1
+            self._bump()
+            return row[1]
+
+    def _bump(self) -> None:
+        self._ops += 1
+        if self._ops >= _FLUSH_EVERY:
+            self._ops = 0
+            if not self._replaying:
+                self._meta_watermark = (
+                    os.path.getsize(self.idx_path)
+                    if os.path.exists(self.idx_path)
+                    else 0
+                )
+            self._save_meta()
+            self.conn.commit()
+
+    def get(self, needle_id: int) -> tuple[int, int] | None:
+        with self._lock:
+            row = self.conn.execute(
+                "SELECT off, size FROM needles WHERE nid = ?", (needle_id,)
+            ).fetchone()
+        if row is None or not t.size_is_valid(row[1]):
+            return None
+        return (row[0], row[1])
+
+    def has(self, needle_id: int) -> bool:
+        return self.get(needle_id) is not None
+
+    def __len__(self) -> int:
+        return self._live
+
+    def items(self):
+        with self._lock:
+            rows = self.conn.execute(
+                "SELECT nid, off, size FROM needles"
+            ).fetchall()
+        for nid, off, size in rows:
+            if t.size_is_valid(size):
+                yield nid, off, size
+
+    def flush(self) -> None:
+        with self._lock:
+            self._meta_watermark = (
+                os.path.getsize(self.idx_path)
+                if os.path.exists(self.idx_path)
+                else 0
+            )
+            self._save_meta()
+            self.conn.commit()
+
+    def close(self) -> None:
+        try:
+            self.flush()
+        finally:
+            self.conn.close()
+
+    _meta_watermark = 0

@@ -153,7 +153,10 @@ class Store:
                 replica_placement = t.ReplicaPlacement.parse(replica_placement)
             if isinstance(ttl, str):
                 ttl = t.TTL.parse(ttl)
-            v = Volume(loc.directory, vid, collection, replica_placement, ttl, version)
+            v = Volume(
+                loc.directory, vid, collection, replica_placement, ttl,
+                version, needle_map_kind=loc.needle_map_kind,
+            )
             loc.volumes[vid] = v
             self.new_volumes.put(self._volume_message(v, loc.disk_type))
             return v
@@ -201,7 +204,10 @@ class Store:
                     collection, _, vid_s = stem.rpartition("_")
                     if vid_s != str(vid):
                         continue
-                    v = Volume(loc.directory, vid, collection)
+                    v = Volume(
+                        loc.directory, vid, collection,
+                        needle_map_kind=loc.needle_map_kind,
+                    )
                     loc.volumes[vid] = v
                     self.new_volumes.put(self._volume_message(v, loc.disk_type))
                     return
@@ -261,7 +267,10 @@ class Store:
                 os.remove(v.dat_path)
                 if os.path.exists(v.note_path):
                     os.remove(v.note_path)
-            loc.volumes[vid] = Volume(loc.directory, vid, v.collection)
+            loc.volumes[vid] = Volume(
+                loc.directory, vid, v.collection,
+                needle_map_kind=loc.needle_map_kind,
+            )
         return size
 
     def tier_move_from_remote(self, vid: int, keep_remote: bool = False) -> int:
@@ -290,7 +299,10 @@ class Store:
         save_volume_info(v.vif_path, {"version": v.version, "files": []})
         with self._lock:
             # old Volume left open for in-flight readers (see to_remote)
-            reloaded = Volume(loc.directory, vid, v.collection)
+            reloaded = Volume(
+                loc.directory, vid, v.collection,
+                needle_map_kind=loc.needle_map_kind,
+            )
             reloaded.read_only = True  # stays readonly like the reference
             loc.volumes[vid] = reloaded
         if not keep_remote:

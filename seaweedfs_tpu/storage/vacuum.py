@@ -19,9 +19,12 @@ from .super_block import SUPER_BLOCK_SIZE, SuperBlock
 from .volume import Volume
 
 
-def compact(v: Volume) -> tuple[str, str, int]:
+def compact(v: Volume) -> tuple[str, str, int, str | None]:
     """Phase 1: copy live needles to .cpd/.cpx. Returns (cpd, cpx,
-    idx_snapshot_bytes) — the snapshot marks where makeupDiff starts."""
+    idx_snapshot_bytes, shadow_db) — the snapshot marks where makeupDiff
+    starts; shadow_db is a pre-built persistent needle map over .cpx
+    (built off-lock here so commit doesn't replay millions of entries
+    under the write lock), or None for in-memory maps."""
     base = Volume.base_name(v.dir, v.id, v.collection)
     cpd, cpx = base + ".cpd", base + ".cpx"
     v.sync()
@@ -45,10 +48,20 @@ def compact(v: Volume) -> tuple[str, str, int]:
             record = n.to_bytes(v.version)
             dat.write(record)
             xf.write(idx_mod.pack_entry(n.id, offset, n.size))
-    return cpd, cpx, idx_snapshot
+    shadow_db = None
+    if v.needle_map_kind == "persistent":
+        from .needle_map_persistent import SqliteNeedleMap
+
+        shadow_db = cpx + ".sdx"
+        if os.path.exists(shadow_db):
+            os.remove(shadow_db)
+        SqliteNeedleMap(shadow_db, cpx, v.version).close()
+    return cpd, cpx, idx_snapshot, shadow_db
 
 
-def commit(v: Volume, cpd: str, cpx: str, idx_snapshot: int) -> None:
+def commit(
+    v: Volume, cpd: str, cpx: str, idx_snapshot: int, shadow_db: str | None = None
+) -> None:
     """Phase 2: replay post-snapshot index entries onto the shadow files
     (makeupDiff, volume_vacuum.go:200), then rename over the originals."""
     with v._lock:
@@ -79,6 +92,12 @@ def commit(v: Volume, cpd: str, cpx: str, idx_snapshot: int) -> None:
         v._idx.close()
         os.replace(cpd, v.dat_path)
         os.replace(cpx, v.idx_path)
+        if shadow_db is not None:
+            # the pre-built map becomes the live .sdx; readers holding the
+            # old map keep the old (now-unlinked) inode open.  makeupDiff
+            # entries appended above fold in via the watermark tail replay
+            # when _build_map reopens it.
+            os.replace(shadow_db, v.sdx_path)
         with open(v.dat_path, "rb") as f:
             v.super_block = SuperBlock.from_bytes(f.read(SUPER_BLOCK_SIZE))
         # Publish the new (dat, nm) pair as one atomic reference swap; the
@@ -90,7 +109,7 @@ def commit(v: Volume, cpd: str, cpx: str, idx_snapshot: int) -> None:
 
         v._state = _ReadState(
             open(v.dat_path, "r+b"),
-            needle_map.CompactMap.load_from_idx(v.idx_path, v.version),
+            v._build_map(fresh=shadow_db is None),
         )
         v._idx = open(v.idx_path, "ab")
 
@@ -98,6 +117,6 @@ def commit(v: Volume, cpd: str, cpx: str, idx_snapshot: int) -> None:
 def vacuum(v: Volume) -> float:
     """Full compact+commit. Returns the garbage ratio that was reclaimed."""
     ratio = v.garbage_ratio
-    cpd, cpx, snap = compact(v)
-    commit(v, cpd, cpx, snap)
+    cpd, cpx, snap, shadow = compact(v)
+    commit(v, cpd, cpx, snap, shadow)
     return ratio

@@ -89,6 +89,13 @@ def _dat_size(dat) -> int:
     return os.fstat(dat.fileno()).st_size
 
 
+# process default for Volume.needle_map_kind — "compact" keeps the whole
+# map in RAM (CompactMap), "persistent" uses the SQLite-backed map so huge
+# volumes start without replaying their .idx (the reference's -index
+# memory|leveldb flag, needle_map_leveldb.go)
+DEFAULT_NEEDLE_MAP_KIND = "compact"
+
+
 class Volume:
     def __init__(
         self,
@@ -98,10 +105,12 @@ class Volume:
         replica_placement: t.ReplicaPlacement | None = None,
         ttl: t.TTL | None = None,
         version: int = needle_mod.CURRENT_VERSION,
+        needle_map_kind: str | None = None,  # "compact" | "persistent"
     ):
         self.dir = dirname
         self.id = vid
         self.collection = collection
+        self.needle_map_kind = needle_map_kind or DEFAULT_NEEDLE_MAP_KIND
         self.read_only = False
         # size-induced write lock (reference noWriteCanDelete): the volume
         # stops accepting appends but still takes deletes, so garbage can
@@ -135,7 +144,7 @@ class Volume:
             self.super_block = SuperBlock.from_bytes(
                 self.remote_dat.pread(SUPER_BLOCK_SIZE, 0)
             )
-            nm = needle_map.CompactMap.load_from_idx(self.idx_path, self.version)
+            nm = self._build_map()
             self._state = _ReadState(self.remote_dat, nm)
             self._idx = None
             self.read_only = True
@@ -153,7 +162,7 @@ class Volume:
                 logging.getLogger("volume").warning(
                     "volume %d was not cleanly closed; recovering tail", vid
                 )
-            nm = needle_map.CompactMap.load_from_idx(self.idx_path, self.version)
+            nm = self._build_map()
             self._recover_tail(nm)
         else:
             self.super_block = SuperBlock(
@@ -164,7 +173,7 @@ class Volume:
             with open(self.dat_path, "wb") as f:
                 f.write(self.super_block.to_bytes())
             open(self.idx_path, "ab").close()
-            nm = needle_map.CompactMap()
+            nm = self._build_map()
         self._state = _ReadState(open(self.dat_path, "r+b"), nm)
         self._idx = open(self.idx_path, "ab")
         if remote_files:
@@ -176,6 +185,23 @@ class Volume:
         # crash is detectable on the next load; removed on clean close
         with open(self.note_path, "w") as f:
             f.write("open for writing\n")
+
+    @property
+    def sdx_path(self) -> str:
+        return self.base_name(self.dir, self.id, self.collection) + ".sdx"
+
+    def _build_map(self, fresh: bool = False):
+        """The volume's needle map in its configured kind.  `fresh=True`
+        (vacuum commit) starts a NEW db file: lock-free readers may still
+        hold the old map over the old .dat, so the old db is unlinked (its
+        open connection keeps the inode) rather than rebuilt in place."""
+        if self.needle_map_kind == "persistent":
+            from .needle_map_persistent import SqliteNeedleMap
+
+            if fresh and os.path.exists(self.sdx_path):
+                os.remove(self.sdx_path)
+            return SqliteNeedleMap(self.sdx_path, self.idx_path, self.version)
+        return needle_map.CompactMap.load_from_idx(self.idx_path, self.version)
 
     @property
     def is_tiered(self) -> bool:
@@ -426,6 +452,8 @@ class Volume:
             if not self._idx.closed:
                 self._idx.flush()
                 self._idx.close()
+            if hasattr(self._state.nm, "close"):
+                self._state.nm.close()
             if clean and os.path.exists(self.note_path):
                 os.remove(self.note_path)
 

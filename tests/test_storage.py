@@ -277,10 +277,10 @@ def test_vacuum_with_racing_write(tmp_path):
     v = Volume(str(tmp_path), 6)
     _fill(v, count=5, seed=4)
     v.delete(1)
-    cpd, cpx, snap = vacuum.compact(v)
+    cpd, cpx, snap, shadow = vacuum.compact(v)
     v.write(50, 0xAA, b"racing write")  # lands after snapshot
     v.delete(2)  # racing delete
-    vacuum.commit(v, cpd, cpx, snap)
+    vacuum.commit(v, cpd, cpx, snap, shadow)
     assert v.read(50).data == b"racing write"
     assert not v.has(2)
     assert not v.has(1)
@@ -343,9 +343,9 @@ def test_tail_recovery_after_crash(tmp_path):
 def test_compact_leaves_live_superblock_untouched(tmp_path):
     v = Volume(str(tmp_path), 10)
     v.write(1, 0xA, b"x")
-    cpd, cpx, snap = vacuum.compact(v)
+    cpd, cpx, snap, shadow = vacuum.compact(v)
     assert v.super_block.compaction_revision == 0  # bump only lands at commit
-    vacuum.commit(v, cpd, cpx, snap)
+    vacuum.commit(v, cpd, cpx, snap, shadow)
     assert v.super_block.compaction_revision == 1
     v.close()
 
