@@ -14,6 +14,7 @@ from . import (
     filer,
     filer_sync,
     master,
+    mount,
     scaffold,
     server,
     shell,
@@ -26,7 +27,7 @@ from . import (
 COMMANDS = {
     m.NAME: m
     for m in (
-        master, volume, filer, filer_sync, s3, webdav, server, shell,
+        master, volume, filer, filer_sync, s3, webdav, mount, server, shell,
         benchmark, scaffold, version,
     )
 }

@@ -1,0 +1,41 @@
+"""`mount` — FUSE-mount a filer (reference: weed/command/mount.go)."""
+from __future__ import annotations
+
+import asyncio
+import os
+
+NAME = "mount"
+HELP = "mount a filer as a local FUSE filesystem"
+
+
+def add_args(p) -> None:
+    p.add_argument(
+        "-filer", dest="filer", default="127.0.0.1:8888", help="filer host:port"
+    )
+    p.add_argument(
+        "-filer.grpc", dest="filer_grpc", default="",
+        help="filer grpc host:port (default: filer port+10000)",
+    )
+    p.add_argument(
+        "-filer.path", dest="filer_path", default="/",
+        help="filer directory to mount",
+    )
+    p.add_argument("-dir", required=True, help="local mountpoint")
+
+
+async def run(args) -> None:
+    from ..mount import Mount
+
+    os.makedirs(args.dir, exist_ok=True)
+    m = Mount(
+        args.dir,
+        filer_address=args.filer,
+        filer_grpc_address=args.filer_grpc,
+        filer_path=args.filer_path,
+    )
+    await m.start()
+    print(f"mounted {args.filer}{args.filer_path} at {args.dir}")
+    try:
+        await m.wait()
+    finally:
+        await m.stop()

@@ -1,0 +1,26 @@
+"""Shared filer gRPC client helpers used by the gateways (WebDAV, FUSE
+mount, S3) — the pieces of weed/pb/filer_pb_helper.go they all need."""
+from __future__ import annotations
+
+from ..pb import filer_pb2
+
+_PAGE = 1024
+
+
+async def list_all_entries(stub, directory: str) -> list[filer_pb2.Entry]:
+    """Full paginated sweep of one directory (ListEntries pages by
+    start_from_file_name, exclusive)."""
+    out: list[filer_pb2.Entry] = []
+    last = ""
+    while True:
+        n = 0
+        async for resp in stub.ListEntries(
+            filer_pb2.ListEntriesRequest(
+                directory=directory, start_from_file_name=last, limit=_PAGE
+            )
+        ):
+            out.append(resp.entry)
+            last = resp.entry.name
+            n += 1
+        if n < _PAGE:
+            return out

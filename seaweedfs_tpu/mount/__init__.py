@@ -1,0 +1,45 @@
+"""FUSE mount of a filer (reference: weed/mount/, weed/command/mount.go)."""
+from __future__ import annotations
+
+import asyncio
+
+from .fusekernel import FuseConnection, kernel_mount, kernel_umount
+from .weedfs import WeedFS
+
+__all__ = ["FuseConnection", "Mount", "WeedFS", "kernel_mount", "kernel_umount"]
+
+
+class Mount:
+    """Mount a filer subtree at a local directory and serve it."""
+
+    def __init__(
+        self,
+        mountpoint: str,
+        filer_address: str,
+        filer_grpc_address: str = "",
+        filer_path: str = "/",
+    ):
+        self.mountpoint = mountpoint
+        self.fs = WeedFS(
+            filer_address,
+            filer_grpc_address=filer_grpc_address,
+            root=filer_path,
+        )
+        self.conn: FuseConnection | None = None
+
+    async def start(self) -> None:
+        fd = kernel_mount(self.mountpoint)
+        self.conn = FuseConnection(fd, self.fs)
+        self.conn.start()
+
+    async def wait(self) -> None:
+        if self.conn is not None:
+            await self.conn.wait_closed()
+
+    async def stop(self) -> None:
+        kernel_umount(self.mountpoint)
+        if self.conn is not None:
+            self.conn.close()
+            # drain in-flight op tasks before closing the HTTP session
+            await asyncio.sleep(0.1)
+        await self.fs.close()

@@ -1,0 +1,291 @@
+"""FUSE kernel wire protocol: structs, opcodes, and the /dev/fuse
+request/reply loop.
+
+Reference: weed/mount/ rides a Go FUSE library (hanwen/go-fuse); no such
+library exists in this image, so this module speaks the kernel ABI
+directly (linux/fuse.h, protocol 7.31): read one request from the fuse
+fd, dispatch by opcode to an async filesystem object, write one reply.
+The mount(2) syscall is issued via ctypes with fd= mount data, the way
+libfuse's mount helper does.
+"""
+from __future__ import annotations
+
+import asyncio
+import ctypes
+import ctypes.util
+import errno
+import logging
+import os
+import struct
+
+log = logging.getLogger("fuse")
+
+# opcodes (linux/fuse.h)
+LOOKUP = 1
+FORGET = 2
+GETATTR = 3
+SETATTR = 4
+READLINK = 5
+SYMLINK = 6
+MKNOD = 8
+MKDIR = 9
+UNLINK = 10
+RMDIR = 11
+RENAME = 12
+LINK = 13
+OPEN = 14
+READ = 15
+WRITE = 16
+STATFS = 17
+RELEASE = 18
+FSYNC = 20
+FLUSH = 25
+INIT = 26
+OPENDIR = 27
+READDIR = 28
+RELEASEDIR = 29
+FSYNCDIR = 30
+ACCESS = 34
+CREATE = 35
+INTERRUPT = 36
+DESTROY = 38
+BATCH_FORGET = 42
+RENAME2 = 45
+LSEEK = 46
+
+IN_HEADER = struct.Struct("<IIQQIIII")  # len opcode unique nodeid uid gid pid pad
+OUT_HEADER = struct.Struct("<IiQ")  # len error unique
+
+ATTR = struct.Struct("<QQQQQQIIIIIIIIII")  # 88 bytes
+ENTRY_OUT = struct.Struct("<QQQQII")  # 40 bytes + attr
+ATTR_OUT = struct.Struct("<QII")  # 16 bytes + attr
+OPEN_OUT = struct.Struct("<QII")  # fh open_flags padding
+WRITE_OUT = struct.Struct("<II")
+INIT_OUT = struct.Struct("<IIIIHHIIHHI28x")  # 7.28+ layout, 80 bytes
+STATFS_OUT = struct.Struct("<QQQQQIIII24x")  # kstatfs, 80 bytes
+
+FOPEN_DIRECT_IO = 1 << 0
+FOPEN_KEEP_CACHE = 1 << 1
+
+S_IFDIR = 0o040000
+S_IFREG = 0o100000
+S_IFLNK = 0o120000
+
+
+def pack_attr(
+    ino: int, mode: int, size: int, mtime: int, ctime: int,
+    nlink: int = 1, uid: int = 0, gid: int = 0,
+) -> bytes:
+    blocks = (size + 511) // 512
+    return ATTR.pack(
+        ino, size, blocks, mtime, mtime, ctime, 0, 0, 0,
+        mode, nlink, uid, gid, 0, 4096, 0,
+    )
+
+
+def pack_entry_out(
+    nodeid: int, attr: bytes, entry_valid: float = 1.0, attr_valid: float = 1.0
+) -> bytes:
+    ev, evn = int(entry_valid), int((entry_valid % 1) * 1e9)
+    av, avn = int(attr_valid), int((attr_valid % 1) * 1e9)
+    return ENTRY_OUT.pack(nodeid, 0, ev, av, evn, avn) + attr
+
+
+def pack_attr_out(attr: bytes, attr_valid: float = 1.0) -> bytes:
+    av, avn = int(attr_valid), int((attr_valid % 1) * 1e9)
+    return ATTR_OUT.pack(av, avn, 0) + attr
+
+
+def pack_dirent(ino: int, off: int, name: bytes, dtype: int) -> bytes:
+    ent = struct.pack("<QQII", ino, off, len(name), dtype) + name
+    pad = (8 - len(ent) % 8) % 8
+    return ent + b"\x00" * pad
+
+
+class FuseError(OSError):
+    """Raise inside a handler to reply with -errno."""
+
+    def __init__(self, err: int):
+        super().__init__(err, os.strerror(err))
+        self.errno_value = err
+
+
+_libc = None
+
+
+def _get_libc():
+    global _libc
+    if _libc is None:
+        _libc = ctypes.CDLL(ctypes.util.find_library("c"), use_errno=True)
+    return _libc
+
+
+def kernel_mount(mountpoint: str, max_read: int = 1 << 17) -> int:
+    """open /dev/fuse + mount(2).  Returns the fuse fd (root required —
+    the fusermount setuid dance is not needed in this environment)."""
+    fd = os.open("/dev/fuse", os.O_RDWR)
+    st = os.stat(mountpoint)
+    data = (
+        f"fd={fd},rootmode={st.st_mode & 0o170000:o},"
+        f"user_id=0,group_id=0,allow_other,max_read={max_read}"
+    ).encode()
+    libc = _get_libc()
+    MS_NOSUID, MS_NODEV = 2, 4
+    r = libc.mount(
+        b"seaweedfs_tpu", mountpoint.encode(), b"fuse.seaweedfs_tpu",
+        MS_NOSUID | MS_NODEV, data,
+    )
+    if r != 0:
+        e = ctypes.get_errno()
+        os.close(fd)
+        raise OSError(e, f"mount(2) failed: {os.strerror(e)}")
+    return fd
+
+
+def kernel_umount(mountpoint: str) -> None:
+    libc = _get_libc()
+    MNT_DETACH = 2
+    libc.umount2(mountpoint.encode(), MNT_DETACH)
+
+
+class FuseConnection:
+    """Pump requests from the fuse fd into an async ops object.
+
+    The ops object exposes async methods named after opcodes (lookup,
+    getattr, ...) returning reply payload bytes (or raising FuseError);
+    INIT/FORGET/INTERRUPT/DESTROY are handled here.
+    """
+
+    def __init__(self, fd: int, ops, max_write: int = 1 << 20):
+        self.fd = fd
+        self.ops = ops
+        self.max_write = max_write
+        self._bufsize = max_write + (1 << 16)
+        self._closed = asyncio.Event()
+        self.proto_minor = 31
+
+    def start(self) -> None:
+        os.set_blocking(self.fd, False)
+        asyncio.get_event_loop().add_reader(self.fd, self._readable)
+
+    def close(self) -> None:
+        try:
+            asyncio.get_event_loop().remove_reader(self.fd)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            os.close(self.fd)
+        except OSError:
+            pass
+        self._closed.set()
+
+    async def wait_closed(self) -> None:
+        await self._closed.wait()
+
+    def _readable(self) -> None:
+        while True:
+            try:
+                buf = os.read(self.fd, self._bufsize)
+            except BlockingIOError:
+                return
+            except OSError as e:
+                if e.errno == errno.ENODEV:  # unmounted
+                    self.close()
+                    return
+                if e.errno in (errno.EINTR, errno.EAGAIN):
+                    return
+                log.exception("fuse fd read failed")
+                self.close()
+                return
+            if not buf:
+                self.close()
+                return
+            asyncio.ensure_future(self._handle(buf))
+
+    def _reply(self, unique: int, error: int, payload: bytes = b"") -> None:
+        out = OUT_HEADER.pack(OUT_HEADER.size + len(payload), -error, unique)
+        try:
+            os.write(self.fd, out + payload)
+        except OSError as e:
+            # ENOENT: the request was interrupted/aborted — benign
+            if e.errno not in (errno.ENOENT, errno.EINVAL, errno.ENODEV):
+                log.warning("fuse reply failed: %s", e)
+
+    async def _handle(self, buf: bytes) -> None:
+        (length, opcode, unique, nodeid, uid, gid, pid, _) = IN_HEADER.unpack_from(buf)
+        body = buf[IN_HEADER.size:length]
+        if opcode == INIT:
+            major, minor = struct.unpack_from("<II", body)
+            self.proto_minor = min(31, minor)
+            flags = 0
+            payload = INIT_OUT.pack(
+                7, self.proto_minor, 1 << 17, flags,
+                12, 10, self.max_write, 1, 32, 0, 0,
+            )
+            self._reply(unique, 0, payload)
+            return
+        if opcode == FORGET:
+            (nlookup,) = struct.unpack_from("<Q", body)
+            fn = getattr(self.ops, "forget_inode", None)
+            if fn is not None:
+                fn(nodeid, nlookup)
+            return  # no reply, ever
+        if opcode == BATCH_FORGET:
+            (count, _) = struct.unpack_from("<II", body)
+            fn = getattr(self.ops, "forget_inode", None)
+            if fn is not None:
+                for i in range(count):
+                    ino, nl = struct.unpack_from("<QQ", body, 8 + i * 16)
+                    fn(ino, nl)
+            return  # no reply, ever
+        if opcode == INTERRUPT:
+            return
+        if opcode == DESTROY:
+            self._reply(unique, 0)
+            self.close()
+            return
+        handler = _DISPATCH.get(opcode)
+        if handler is None:
+            self._reply(unique, errno.ENOSYS)
+            return
+        fn = getattr(self.ops, handler, None)
+        if fn is None:
+            self._reply(unique, errno.ENOSYS)
+            return
+        try:
+            payload = await fn(nodeid, body, uid=uid, gid=gid, pid=pid)
+            self._reply(unique, 0, payload or b"")
+        except FuseError as e:
+            self._reply(unique, e.errno_value)
+        except Exception:  # noqa: BLE001
+            log.exception("fuse op %s failed", handler)
+            self._reply(unique, errno.EIO)
+
+
+_DISPATCH = {
+    LOOKUP: "lookup",
+    GETATTR: "getattr",
+    SETATTR: "setattr",
+    READLINK: "readlink",
+    MKDIR: "mkdir",
+    UNLINK: "unlink",
+    RMDIR: "rmdir",
+    RENAME: "rename",
+    RENAME2: "rename2",
+    OPEN: "open",
+    READ: "read",
+    WRITE: "write",
+    STATFS: "statfs",
+    RELEASE: "release",
+    FSYNC: "fsync",
+    FLUSH: "flush",
+    OPENDIR: "opendir",
+    READDIR: "readdir",
+    RELEASEDIR: "releasedir",
+    FSYNCDIR: "fsyncdir",
+    ACCESS: "access",
+    CREATE: "create",
+    MKNOD: "mknod",
+    SYMLINK: "symlink",
+    LSEEK: "lseek",
+}

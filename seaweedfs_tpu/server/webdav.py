@@ -142,20 +142,9 @@ class WebDavServer:
         return resp.entry if resp.HasField("entry") else None
 
     async def _list(self, directory: str) -> list[filer_pb2.Entry]:
-        out = []
-        last = ""
-        while True:
-            n = 0
-            async for resp in self._stub().ListEntries(
-                filer_pb2.ListEntriesRequest(
-                    directory=directory, start_from_file_name=last, limit=1024
-                )
-            ):
-                out.append(resp.entry)
-                last = resp.entry.name
-                n += 1
-            if n < 1024:
-                return out
+        from ..filer.client import list_all_entries
+
+        return await list_all_entries(self._stub(), directory)
 
     # ------------------------------------------------------------- methods
 
