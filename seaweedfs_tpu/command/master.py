@@ -33,6 +33,15 @@ def add_args(p) -> None:
         "-autoVacuum", dest="auto_vacuum", action="store_true",
         help="periodically drive the vacuum protocol",
     )
+    p.add_argument(
+        "-peers", default="",
+        help="comma-separated masters in the raft group (including this "
+        "one); empty = single-master",
+    )
+    p.add_argument(
+        "-mdir", dest="meta_dir", default="",
+        help="directory for durable raft state (term/vote/log)",
+    )
 
 
 async def run(args) -> None:
@@ -49,6 +58,8 @@ async def run(args) -> None:
         auto_vacuum=args.auto_vacuum,
         jwt_signing_key=config_util.jwt_signing_key(),
         jwt_expires_sec=config_util.jwt_expires_sec(),
+        peers=[p.strip() for p in args.peers.split(",") if p.strip()],
+        meta_dir=args.meta_dir or None,
     )
     await ms.start()
     await asyncio.Event().wait()  # serve until interrupted

@@ -1,0 +1,3 @@
+from .node import RaftNode
+
+__all__ = ["RaftNode"]

@@ -1,0 +1,414 @@
+"""Raft consensus for master HA.
+
+Reference: weed/server/raft_server.go + raft_hashicorp.go ride
+hashicorp/raft; no such library exists in this image, so this is a
+from-scratch implementation of the Raft paper's core: randomized-timeout
+leader election, AppendEntries heartbeat + log replication with the
+conflict-backoff rule, majority commit with the current-term guard
+(§5.4.2), and durable term/vote/log.  Scope matches what the masters
+need — a replicated command log for volume-id/sequence allocation — not
+snapshots or membership change.
+
+All state transitions run on the asyncio loop (no thread races); RPCs
+ride the same descriptor-driven grpc.aio plumbing as every other
+service (pb/rpc.py).
+"""
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+import logging
+import os
+import random
+
+import grpc
+
+from ..pb import Stub, raft_pb2
+from ..pb.rpc import channel
+
+log = logging.getLogger("raft")
+
+FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+
+class NotLeader(RuntimeError):
+    def __init__(self, leader: str | None):
+        super().__init__(f"not the leader (leader={leader})")
+        self.leader = leader
+
+
+class RaftNode:
+    def __init__(
+        self,
+        node_id: str,  # this node's raft grpc address
+        peers: list[str],  # other nodes' raft grpc addresses
+        apply_fn,  # (command: dict) -> None, called in log order
+        data_dir: str | None = None,
+        election_timeout: tuple[float, float] = (0.4, 0.8),
+        heartbeat_interval: float = 0.1,
+        dial_fn=None,  # peer id -> grpc address (default: identity)
+    ):
+        self.id = node_id
+        self.peers = [p for p in peers if p != node_id]
+        self.dial_fn = dial_fn or (lambda a: a)
+        self.apply_fn = apply_fn
+        self.data_dir = data_dir
+        self.election_timeout = election_timeout
+        self.heartbeat_interval = heartbeat_interval
+
+        self.state = FOLLOWER
+        self.term = 0
+        self.voted_for: str | None = None
+        # log[0] is a sentinel (term 0, index 0)
+        self.log: list[tuple[int, int, bytes]] = [(0, 0, b"")]
+        self.commit_index = 0
+        self.last_applied = 0
+        self.leader_id: str | None = None
+        self.next_index: dict[str, int] = {}
+        self.match_index: dict[str, int] = {}
+        self._commit_waiters: dict[int, asyncio.Future] = {}
+        self._election_deadline = 0.0
+        self._tasks: list[asyncio.Task] = []
+        self._stopped = False
+        self._stub_cache: dict[str, Stub] = {}
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            self._load()
+
+    # ------------------------------------------------------------ persistence
+
+    def _state_path(self) -> str:
+        return os.path.join(self.data_dir, "raft_state.json")
+
+    def _log_path(self) -> str:
+        return os.path.join(self.data_dir, "raft_log.jsonl")
+
+    def _load(self) -> None:
+        try:
+            with open(self._state_path()) as f:
+                st = json.load(f)
+            self.term = st["term"]
+            self.voted_for = st["voted_for"]
+        except (OSError, ValueError, KeyError):
+            pass
+        try:
+            with open(self._log_path()) as f:
+                for line in f:
+                    e = json.loads(line)
+                    self.log.append(
+                        (e["t"], e["i"], base64.b64decode(e["c"]))
+                    )
+        except (OSError, ValueError, KeyError):
+            pass
+
+    def _persist_state(self) -> None:
+        if not self.data_dir:
+            return
+        tmp = self._state_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"term": self.term, "voted_for": self.voted_for}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._state_path())
+
+    def _persist_log_rewrite(self) -> None:
+        if not self.data_dir:
+            return
+        tmp = self._log_path() + ".tmp"
+        with open(tmp, "w") as f:
+            for t_, i, c in self.log[1:]:
+                f.write(json.dumps(
+                    {"t": t_, "i": i, "c": base64.b64encode(c).decode()}
+                ) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._log_path())
+
+    def _persist_log_append(self, entries) -> None:
+        if not self.data_dir:
+            return
+        with open(self._log_path(), "a") as f:
+            for t_, i, c in entries:
+                f.write(json.dumps(
+                    {"t": t_, "i": i, "c": base64.b64encode(c).decode()}
+                ) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    # --------------------------------------------------------------- helpers
+
+    @property
+    def is_leader(self) -> bool:
+        return self.state == LEADER
+
+    @property
+    def quorum(self) -> int:
+        return (len(self.peers) + 1) // 2 + 1
+
+    def last_log(self) -> tuple[int, int]:
+        t_, i, _ = self.log[-1]
+        return i, t_
+
+    def _stub(self, peer: str) -> Stub:
+        s = self._stub_cache.get(peer)
+        if s is None:
+            s = Stub(channel(self.dial_fn(peer)), raft_pb2, "SeaweedRaft")
+            self._stub_cache[peer] = s
+        return s
+
+    def _reset_election_timer(self) -> None:
+        lo, hi = self.election_timeout
+        self._election_deadline = (
+            asyncio.get_event_loop().time() + random.uniform(lo, hi)
+        )
+
+    def _become_follower(self, term: int, leader: str | None = None) -> None:
+        if term > self.term:
+            self.term = term
+            self.voted_for = None
+            self._persist_state()
+        self.state = FOLLOWER
+        if leader:
+            self.leader_id = leader
+        self._reset_election_timer()
+
+    # -------------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        self._reset_election_timer()
+        if not self.peers:
+            # single-master deployment: win the 1-node election immediately
+            self.term += 1
+            self.voted_for = self.id
+            self._persist_state()
+            self._become_leader()
+        self._tasks.append(asyncio.create_task(self._ticker()))
+
+    async def stop(self) -> None:
+        self._stopped = True
+        for t_ in self._tasks:
+            t_.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        for _, fut in self._commit_waiters.values():
+            if not fut.done():
+                fut.cancel()
+        self._commit_waiters.clear()
+
+    async def _ticker(self) -> None:
+        while not self._stopped:
+            await asyncio.sleep(self.heartbeat_interval / 2)
+            now = asyncio.get_event_loop().time()
+            if self.state == LEADER:
+                await self._replicate_all()
+            elif now >= self._election_deadline:
+                await self._run_election()
+
+    # --------------------------------------------------------------- election
+
+    async def _run_election(self) -> None:
+        self.state = CANDIDATE
+        self.term += 1
+        self.voted_for = self.id
+        self._persist_state()
+        self._reset_election_timer()
+        term = self.term
+        li, lt = self.last_log()
+        votes = 1
+        log.info("%s: starting election for term %d", self.id, term)
+
+        async def ask(peer: str) -> bool:
+            try:
+                resp = await asyncio.wait_for(
+                    self._stub(peer).RequestVote(
+                        raft_pb2.VoteRequest(
+                            term=term, candidate_id=self.id,
+                            last_log_index=li, last_log_term=lt,
+                        )
+                    ),
+                    timeout=self.heartbeat_interval * 3,
+                )
+            except (grpc.aio.AioRpcError, asyncio.TimeoutError):
+                return False
+            if resp.term > self.term:
+                self._become_follower(resp.term)
+                return False
+            return resp.vote_granted
+
+        results = await asyncio.gather(*(ask(p) for p in self.peers))
+        if self.state != CANDIDATE or self.term != term:
+            return  # a leader appeared or a newer term started meanwhile
+        votes += sum(results)
+        if votes >= self.quorum:
+            self._become_leader()
+
+    def _become_leader(self) -> None:
+        self.state = LEADER
+        self.leader_id = self.id
+        li, _ = self.last_log()
+        self.next_index = {p: li + 1 for p in self.peers}
+        self.match_index = {p: 0 for p in self.peers}
+        # no-op entry in the new term: prior-term entries can only commit
+        # indirectly (§5.4.2), and this drives the commit index forward so
+        # the durable log replays on a restarted single node too
+        entry = (self.term, li + 1, b"")
+        self.log.append(entry)
+        self._persist_log_append([entry])
+        if not self.peers:
+            self._advance_commit()
+        log.info("%s: leader for term %d", self.id, self.term)
+
+    # ------------------------------------------------------------ replication
+
+    async def propose(self, command: dict, timeout: float = 5.0) -> None:
+        """Append a command and wait until it is committed AND applied.
+        Raises NotLeader on followers."""
+        if self.state != LEADER:
+            raise NotLeader(self.leader_id)
+        li, _ = self.last_log()
+        index = li + 1
+        term = self.term
+        entry = (term, index, json.dumps(command).encode())
+        self.log.append(entry)
+        self._persist_log_append([entry])
+        fut = asyncio.get_event_loop().create_future()
+        # the waiter records its term: if another leader overwrites this
+        # index, committing a DIFFERENT entry there must fail the propose,
+        # not confirm it
+        self._commit_waiters[index] = (term, fut)
+        if not self.peers:
+            self._advance_commit()
+        else:
+            await self._replicate_all()
+        try:
+            await asyncio.wait_for(fut, timeout)
+        finally:
+            self._commit_waiters.pop(index, None)
+
+    async def _replicate_all(self) -> None:
+        if self.peers:
+            await asyncio.gather(
+                *(self._replicate(p) for p in self.peers),
+                return_exceptions=True,
+            )
+        self._advance_commit()
+
+    async def _replicate(self, peer: str) -> None:
+        ni = self.next_index.get(peer, 1)
+        prev = self.log[ni - 1]
+        entries = [
+            raft_pb2.LogEntry(term=t_, index=i, command=c)
+            for t_, i, c in self.log[ni:]
+        ]
+        try:
+            resp = await asyncio.wait_for(
+                self._stub(peer).AppendEntries(
+                    raft_pb2.AppendRequest(
+                        term=self.term, leader_id=self.id,
+                        prev_log_index=prev[1], prev_log_term=prev[0],
+                        entries=entries, leader_commit=self.commit_index,
+                    )
+                ),
+                timeout=self.heartbeat_interval * 3,
+            )
+        except (grpc.aio.AioRpcError, asyncio.TimeoutError):
+            return
+        if resp.term > self.term:
+            self._become_follower(resp.term)
+            return
+        if self.state != LEADER:
+            return
+        if resp.success:
+            self.match_index[peer] = resp.match_index
+            self.next_index[peer] = resp.match_index + 1
+        else:
+            self.next_index[peer] = max(1, ni - 1)  # conflict backoff
+
+    def _advance_commit(self) -> None:
+        li, _ = self.last_log()
+        for n in range(self.commit_index + 1, li + 1):
+            if self.log[n][0] != self.term:
+                continue  # only current-term entries commit by counting (§5.4.2)
+            replicated = 1 + sum(
+                1 for p in self.peers if self.match_index.get(p, 0) >= n
+            )
+            if replicated >= self.quorum:
+                self.commit_index = n
+        self._apply_committed()
+
+    def _apply_committed(self) -> None:
+        while self.last_applied < self.commit_index:
+            self.last_applied += 1
+            t_, i, c = self.log[self.last_applied]
+            if c:
+                # own_live: this node proposed the entry in its current
+                # leadership — state machines can skip self-adjustments
+                # that only matter for followers/replay (e.g. sequence
+                # ceilings that would jump the leader's own counter)
+                own_live = self.state == LEADER and t_ == self.term
+                try:
+                    self.apply_fn(json.loads(c), term=t_, own_live=own_live)
+                except Exception:  # noqa: BLE001 — state machine must not kill raft
+                    log.exception("apply failed at index %d", i)
+            waiter = self._commit_waiters.get(i)
+            if waiter is not None:
+                wterm, fut = waiter
+                if not fut.done():
+                    if wterm == t_:
+                        fut.set_result(None)
+                    else:
+                        fut.set_exception(NotLeader(self.leader_id))
+
+    # ------------------------------------------------------------ rpc handlers
+
+    async def RequestVote(self, request, context):
+        if request.term > self.term:
+            self._become_follower(request.term)
+        granted = False
+        if request.term == self.term and self.voted_for in (None, request.candidate_id):
+            li, lt = self.last_log()
+            up_to_date = (request.last_log_term, request.last_log_index) >= (lt, li)
+            if up_to_date:
+                granted = True
+                self.voted_for = request.candidate_id
+                self._persist_state()
+                self._reset_election_timer()
+        return raft_pb2.VoteResponse(term=self.term, vote_granted=granted)
+
+    async def AppendEntries(self, request, context):
+        if request.term < self.term:
+            return raft_pb2.AppendResponse(term=self.term, success=False)
+        self._become_follower(request.term, leader=request.leader_id)
+        # log consistency check
+        pli, plt = request.prev_log_index, request.prev_log_term
+        if pli >= len(self.log) or self.log[pli][0] != plt:
+            return raft_pb2.AppendResponse(term=self.term, success=False)
+        # append, truncating conflicts; plain appends persist by appending
+        # (a full rewrite per batch would be O(n^2) across the log's life)
+        truncated = False
+        appended: list[tuple[int, int, bytes]] = []
+        for e in request.entries:
+            if e.index < len(self.log):
+                if self.log[e.index][0] != e.term:
+                    del self.log[e.index:]
+                    truncated = True
+                else:
+                    continue
+            entry = (e.term, e.index, bytes(e.command))
+            self.log.append(entry)
+            appended.append(entry)
+        if truncated:
+            self._persist_log_rewrite()
+        elif appended:
+            self._persist_log_append(appended)
+        if request.leader_commit > self.commit_index:
+            li, _ = self.last_log()
+            self.commit_index = min(request.leader_commit, li)
+            self._apply_committed()
+        # match through what THIS request proved, never the follower's own
+        # tail — stale extra entries here must not advance the leader
+        return raft_pb2.AppendResponse(
+            term=self.term,
+            success=True,
+            match_index=request.prev_log_index + len(request.entries),
+        )

@@ -29,7 +29,7 @@ import grpc
 from aiohttp import web
 
 from .. import stats
-from ..pb import Stub, generic_handler, master_pb2, volume_server_pb2
+from ..pb import Stub, generic_handler, master_pb2, raft_pb2, server_address, volume_server_pb2
 from ..pb.rpc import GRPC_OPTIONS, channel
 from ..security import gen_volume_write_jwt
 from ..storage import types as t
@@ -81,6 +81,8 @@ class MasterServer:
         auto_vacuum: bool = False,
         jwt_signing_key: str = "",
         jwt_expires_sec: int = 10,
+        peers: list[str] | None = None,  # other masters' advertise urls
+        meta_dir: str | None = None,  # durable raft state directory
     ):
         self.ip = ip
         self.port = port
@@ -100,6 +102,10 @@ class MasterServer:
         self._grow_queue: asyncio.Queue = asyncio.Queue()
         self._growing: set[tuple] = set()
         self.locks: dict[str, AdminLock] = {}
+        self.peers = peers or []
+        self.meta_dir = meta_dir
+        self.raft = None  # RaftNode once started (raft/node.py)
+        self._seq_committed = 0  # highest raft-replicated sequence ceiling
         self._grpc_server: grpc.aio.Server | None = None
         self._http_runner: web.AppRunner | None = None
         self._tasks: list[asyncio.Task] = []
@@ -127,6 +133,11 @@ class MasterServer:
         self._grpc_server.add_generic_rpc_handlers(
             [generic_handler(master_pb2, "Seaweed", self)]
         )
+        # raft RPCs delegate through self so the handler can register
+        # before the RaftNode exists (ports are only known after bind)
+        self._grpc_server.add_generic_rpc_handlers(
+            [generic_handler(raft_pb2, "SeaweedRaft", self)]
+        )
         self.grpc_port = self._grpc_server.add_insecure_port(
             f"{self.ip}:{self.grpc_port}"
         )
@@ -149,12 +160,32 @@ class MasterServer:
         port = site._server.sockets[0].getsockname()[1]
         self.port = port
 
+        from ..raft import RaftNode
+
+        others = [
+            p for p in self.peers
+            if server_address.http_address(p) != self.url
+        ]
+        self.raft = RaftNode(
+            self.advertise_url,
+            others,
+            apply_fn=self._apply_raft,
+            data_dir=self.meta_dir,
+            dial_fn=server_address.grpc_address,
+        )
+        await self.raft.start()
+
         self._tasks.append(asyncio.create_task(self._grower_loop()))
         if self.auto_vacuum:
             self._tasks.append(asyncio.create_task(self._vacuum_loop()))
-        log.info("master up http=%s grpc=%s", self.url, self.grpc_url)
+        log.info(
+            "master up http=%s grpc=%s peers=%s", self.url, self.grpc_url,
+            others,
+        )
 
     async def stop(self) -> None:
+        if self.raft is not None:
+            await self.raft.stop()
         for t_ in self._tasks:
             t_.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
@@ -165,7 +196,86 @@ class MasterServer:
 
     # ------------------------------------------------------------------ gRPC
 
+    # ------------------------------------------------------------------ raft
+
+    @property
+    def is_leader(self) -> bool:
+        return self.raft is None or self.raft.is_leader
+
+    @property
+    def leader_advertise(self) -> str:
+        if self.raft is None or self.raft.leader_id is None:
+            return self.advertise_url
+        return self.raft.leader_id
+
+    def _apply_raft(self, cmd: dict, term: int = 0, own_live: bool = False) -> None:
+        """Raft state machine: allocation ceilings replicated so any
+        future leader starts past every id ever handed out (the reference
+        replicates MaxVolumeIdCommand the same way, topology.go)."""
+        op = cmd.get("op")
+        if op == "max_vid":
+            self.topo.max_volume_id = max(self.topo.max_volume_id, cmd["vid"])
+        elif op == "seq":
+            if not own_live:
+                # followers / restart replay jump past the ceiling; the
+                # live proposer keeps minting from its lower counter so
+                # the 10k batch isn't burned per proposal
+                self.topo.sequencer.set_max(cmd["ceiling"])
+            self._seq_committed = max(self._seq_committed, cmd["ceiling"])
+
+    async def RequestVote(self, request, context):
+        if self.raft is None:
+            await context.abort(grpc.StatusCode.UNAVAILABLE, "raft not up")
+        return await self.raft.RequestVote(request, context)
+
+    async def AppendEntries(self, request, context):
+        if self.raft is None:
+            await context.abort(grpc.StatusCode.UNAVAILABLE, "raft not up")
+        return await self.raft.AppendEntries(request, context)
+
+    def _leader_stub(self) -> Stub:
+        return Stub(
+            channel(server_address.grpc_address(self.leader_advertise)),
+            master_pb2,
+            "Seaweed",
+        )
+
+    async def _proxy_to_leader(self, method: str, request):
+        """Followers forward control-plane calls: only the leader holds
+        topology state, since volume servers heartbeat to it alone
+        (masterclient proxyToMaster in the reference)."""
+        if self.leader_advertise == self.advertise_url:
+            raise RuntimeError("no raft leader elected yet")
+        return await getattr(self._leader_stub(), method)(request)
+
+    async def _replicate_seq_ceiling(self) -> None:
+        """After minting fids: make sure a crash/failover can't re-mint
+        them.  Batched — most assigns find the ceiling already covers."""
+        if self.raft is None or not self.raft.peers:
+            return
+        seq = self.topo.sequencer
+        peek = getattr(seq, "peek", None)
+        if peek is None:
+            return  # snowflake ids are collision-free without consensus
+        if seq.peek() <= self._seq_committed:
+            return
+        ceiling = seq.peek() + 10_000
+        await self.raft.propose({"op": "seq", "ceiling": ceiling})
+
     async def SendHeartbeat(self, request_iterator, context):
+        """Followers close the stream with a leader hint so volume
+        servers re-dial the leader (the only master holding topology).
+        """
+        if not self.is_leader:
+            yield master_pb2.HeartbeatResponse(
+                volume_size_limit=self.topo.volume_size_limit,
+                leader=self.leader_advertise,
+            )
+            return
+        async for resp in self._send_heartbeat_leader(request_iterator, context):
+            yield resp
+
+    async def _send_heartbeat_leader(self, request_iterator, context):
         """Volume-server registration stream (master_grpc_server.go:61-170)."""
         node: DataNode | None = None
         try:
@@ -218,6 +328,10 @@ class MasterServer:
     async def KeepConnected(self, request_iterator, context):
         """Client subscription stream: pushes VolumeLocation deltas
         (master_grpc_server.go broadcastToClients)."""
+        if not self.is_leader:
+            # hint then close: the wdclient re-dials the leader
+            yield master_pb2.KeepConnectedResponse(leader=self.leader_advertise)
+            return
         q: asyncio.Queue = asyncio.Queue()
         key = object()
         self._subscribers[key] = q
@@ -282,6 +396,11 @@ class MasterServer:
             q.put_nowait(msg)
 
     async def Assign(self, request, context):
+        if not self.is_leader:
+            try:
+                return await self._proxy_to_leader("Assign", request)
+            except Exception as e:  # noqa: BLE001
+                return master_pb2.AssignResponse(error=str(e))
         try:
             option = self._grow_option(
                 request.collection,
@@ -298,6 +417,7 @@ class MasterServer:
         for attempt in range(3):
             try:
                 fid, n, nodes = self.topo.pick_for_write(count, option)
+                await self._replicate_seq_ceiling()
                 return master_pb2.AssignResponse(
                     fid=fid,
                     count=n,
@@ -313,7 +433,32 @@ class MasterServer:
                     break
         return master_pb2.AssignResponse(error="no writable volumes and growth failed")
 
+    async def _maybe_proxy(self, name: str, request, context):
+        """None when leader (caller handles locally); else the response
+        proxied from the leader."""
+        if self.is_leader:
+            return None
+        try:
+            return await self._proxy_to_leader(name, request)
+        except grpc.aio.AioRpcError as e:
+            await context.abort(e.code(), e.details())
+        except RuntimeError as e:
+            await context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+
+    def _redirect_if_follower(self, request: web.Request) -> None:
+        if self.is_leader:
+            return
+        if self.leader_advertise == self.advertise_url:
+            # mid-election (or partitioned minority): redirecting to
+            # ourselves would loop — tell the client to retry instead
+            raise web.HTTPServiceUnavailable(text="no raft leader elected yet")
+        leader = server_address.http_address(self.leader_advertise)
+        raise web.HTTPTemporaryRedirect(f"http://{leader}{request.path_qs}")
+
     async def LookupVolume(self, request, context):
+        proxied = await self._maybe_proxy("LookupVolume", request, context)
+        if proxied is not None:
+            return proxied
         resp = master_pb2.LookupVolumeResponse()
         for vof in request.volume_or_file_ids:
             entry = resp.volume_id_locations.add(volume_or_file_id=vof)
@@ -336,6 +481,9 @@ class MasterServer:
         return resp
 
     async def LookupEcVolume(self, request, context):
+        proxied = await self._maybe_proxy("LookupEcVolume", request, context)
+        if proxied is not None:
+            return proxied
         locs = self.topo.lookup_ec_shards(request.volume_id)
         resp = master_pb2.LookupEcVolumeResponse(volume_id=request.volume_id)
         if locs is None:
@@ -349,6 +497,9 @@ class MasterServer:
         return resp
 
     async def Statistics(self, request, context):
+        proxied = await self._maybe_proxy("Statistics", request, context)
+        if proxied is not None:
+            return proxied
         total = used = files = 0
         for n in self.topo.data_nodes():
             for v in n.volumes.values():
@@ -362,6 +513,9 @@ class MasterServer:
         )
 
     async def CollectionList(self, request, context):
+        proxied = await self._maybe_proxy("CollectionList", request, context)
+        if proxied is not None:
+            return proxied
         return master_pb2.CollectionListResponse(
             collections=[
                 master_pb2.Collection(name=c) for c in sorted(self.topo.collections)
@@ -370,6 +524,9 @@ class MasterServer:
         )
 
     async def CollectionDelete(self, request, context):
+        proxied = await self._maybe_proxy("CollectionDelete", request, context)
+        if proxied is not None:
+            return proxied
         vids = set()
         for col_name, vl in self.topo.layouts():
             if col_name == request.name:
@@ -387,12 +544,18 @@ class MasterServer:
         return master_pb2.CollectionDeleteResponse()
 
     async def VolumeList(self, request, context):
+        proxied = await self._maybe_proxy("VolumeList", request, context)
+        if proxied is not None:
+            return proxied
         return master_pb2.VolumeListResponse(
             topology_info_json=json.dumps(self.topo.to_info()),
             volume_size_limit_mb=self.topo.volume_size_limit // (1024 * 1024),
         )
 
     async def LeaseAdminToken(self, request, context):
+        proxied = await self._maybe_proxy("LeaseAdminToken", request, context)
+        if proxied is not None:
+            return proxied
         lock = self.locks.setdefault(request.lock_name, AdminLock())
         now = time.time_ns()
         if lock.is_held() and lock.token != request.previous_token:
@@ -407,12 +570,18 @@ class MasterServer:
         return master_pb2.LeaseAdminTokenResponse(token=now, lock_ts_ns=now)
 
     async def ReleaseAdminToken(self, request, context):
+        proxied = await self._maybe_proxy("ReleaseAdminToken", request, context)
+        if proxied is not None:
+            return proxied
         lock = self.locks.get(request.lock_name)
         if lock and lock.token == request.previous_token:
             lock.token = 0
         return master_pb2.ReleaseAdminTokenResponse()
 
     async def VacuumVolume(self, request, context):
+        proxied = await self._maybe_proxy("VacuumVolume", request, context)
+        if proxied is not None:
+            return proxied
         await self._vacuum_pass(
             request.garbage_threshold or self.garbage_threshold,
             request.volume_id or 0,
@@ -463,6 +632,16 @@ class MasterServer:
             except NoFreeSpace as e:
                 log.warning("growth failed: %s", e)
                 return []
+            # replicate the ceiling BEFORE creating the volumes: a leader
+            # failover after this point starts past every allocated vid
+            if self.raft is not None and self.raft.peers:
+                try:
+                    await self.raft.propose(
+                        {"op": "max_vid", "vid": self.topo.max_volume_id}
+                    )
+                except Exception as e:  # noqa: BLE001 — lost leadership mid-grow
+                    log.warning("vid reservation not committed: %s", e)
+                    return []
             ok_vids = set(vids)
             for node, vid in allocations:
                 stub = self._volume_stub(node)
@@ -575,6 +754,7 @@ class MasterServer:
     # ------------------------------------------------------------------ HTTP
 
     async def h_assign(self, request: web.Request) -> web.Response:
+        self._redirect_if_follower(request)
         params = {**request.query, **(await request.post() if request.method == "POST" else {})}
         req = master_pb2.AssignRequest(
             count=int(params.get("count", 1)),
@@ -600,6 +780,7 @@ class MasterServer:
         return web.json_response(out)
 
     async def h_lookup(self, request: web.Request) -> web.Response:
+        self._redirect_if_follower(request)
         vof = request.query.get("volumeId", "")
         collection = request.query.get("collection", "")
         resp = await self.LookupVolume(
@@ -623,16 +804,23 @@ class MasterServer:
         )
 
     async def h_dir_status(self, request: web.Request) -> web.Response:
+        self._redirect_if_follower(request)
         return web.json_response(
             {"Topology": self.topo.to_info(), "Version": "seaweedfs-tpu"}
         )
 
     async def h_cluster_status(self, request: web.Request) -> web.Response:
         return web.json_response(
-            {"IsLeader": True, "Leader": self.url, "MaxVolumeId": self.topo.max_volume_id}
+            {
+                "IsLeader": self.is_leader,
+                "Leader": server_address.http_address(self.leader_advertise),
+                "Peers": self.peers,
+                "MaxVolumeId": self.topo.max_volume_id,
+            }
         )
 
     async def h_grow(self, request: web.Request) -> web.Response:
+        self._redirect_if_follower(request)
         params = request.query
         try:
             option = self._grow_option(
@@ -650,6 +838,7 @@ class MasterServer:
         return web.json_response({"count": len(vids), "vids": vids})
 
     async def h_vacuum(self, request: web.Request) -> web.Response:
+        self._redirect_if_follower(request)
         threshold = float(
             request.query.get("garbageThreshold", self.garbage_threshold)
         )
@@ -657,6 +846,7 @@ class MasterServer:
         return web.json_response({"vacuumed": n})
 
     async def h_col_delete(self, request: web.Request) -> web.Response:
+        self._redirect_if_follower(request)
         name = request.query.get("collection", "")
         await self.CollectionDelete(
             master_pb2.CollectionDeleteRequest(name=name), None
@@ -664,6 +854,7 @@ class MasterServer:
         return web.json_response({"deleted": name})
 
     async def h_submit(self, request: web.Request) -> web.Response:
+        self._redirect_if_follower(request)
         """One-shot upload: assign + proxy the body to the volume server
         (master_server_handlers.go submit)."""
         from ..operation.upload import upload_multipart_body

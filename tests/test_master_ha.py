@@ -1,0 +1,182 @@
+"""Master HA via raft: 3 masters, follower proxying/redirects, leader
+failover with no fid/vid reuse, volume servers re-homing to the new
+leader.  Reference: weed/server/raft_server.go behaviors.
+"""
+import asyncio
+import socket
+
+import aiohttp
+import pytest
+
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume import VolumeServer
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    return ports
+
+
+async def make_masters(tmp_path, n=3):
+    ports = free_ports(n)
+    urls = [f"127.0.0.1:{p}" for p in ports]
+    masters = []
+    for i, p in enumerate(ports):
+        m = MasterServer(
+            port=p, grpc_port=p + 10000, peers=list(urls),
+            meta_dir=str(tmp_path / f"m{i}"), pulse_seconds=1,
+            volume_size_limit_mb=64,
+        )
+        masters.append(m)
+    await asyncio.gather(*(m.start() for m in masters))
+    # raft elections are fast (0.4-0.8s timeouts)
+    for m in masters:
+        m.raft.election_timeout = (0.3, 0.6)
+    return masters, urls
+
+
+async def wait_for(pred, timeout=10.0, what="condition"):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if pred():
+            return
+        await asyncio.sleep(0.1)
+    raise TimeoutError(what)
+
+
+async def wait_leader(masters, timeout=10.0) -> MasterServer:
+    await wait_for(
+        lambda: sum(m.is_leader for m in masters) == 1,
+        timeout, "single leader",
+    )
+    return next(m for m in masters if m.is_leader)
+
+
+def test_master_ha_failover(tmp_path):
+    async def go():
+        masters, urls = await make_masters(tmp_path)
+        vs = None
+        try:
+            leader = await wait_leader(masters)
+            followers = [m for m in masters if m is not leader]
+
+            vs = VolumeServer(
+                masters=list(urls), directories=[str(tmp_path / "v")],
+                port=0, grpc_port=0, pulse_seconds=1, ec_backend="numpy",
+            )
+            await vs.start()
+            await wait_for(
+                lambda: len(leader.topo.data_nodes()) == 1, 15,
+                "volume server registered at leader",
+            )
+            # followers hold no topology of their own
+            assert all(not f.topo.data_nodes() for f in followers)
+
+            async with aiohttp.ClientSession() as s:
+                # assign through a FOLLOWER's HTTP endpoint: redirected
+                async with s.get(
+                    f"http://{followers[0].url}/dir/assign"
+                ) as r:
+                    assert r.status == 200
+                    a = await r.json()
+                    assert "fid" in a, a
+                # upload + read back
+                data = b"ha payload " * 1000
+                form = aiohttp.FormData()
+                form.add_field("file", data, filename="f.bin")
+                async with s.post(
+                    f"http://{a['url']}/{a['fid']}", data=form,
+                    headers={"Authorization": f"BEARER {a.get('auth', '')}"},
+                ) as r:
+                    assert r.status < 300
+                fid1 = a["fid"]
+                key1 = int(fid1.split(",")[1][:-8], 16)
+                vid1 = int(fid1.split(",")[0])
+
+                # kill the leader; a new one takes over
+                await leader.stop()
+                masters.remove(leader)
+                leader2 = await wait_leader(masters, 20)
+                await wait_for(
+                    lambda: len(leader2.topo.data_nodes()) == 1, 25,
+                    "volume server re-homed to the new leader",
+                )
+
+                # old file still readable via the new leader's lookup
+                async with s.get(
+                    f"http://{leader2.url}/dir/lookup?volumeId={vid1}"
+                ) as r:
+                    assert r.status == 200
+
+                # new assigns never re-mint ids from before the failover
+                async with s.get(
+                    f"http://{leader2.url}/dir/assign"
+                ) as r:
+                    a2 = await r.json()
+                    assert "fid" in a2, a2
+                key2 = int(a2["fid"].split(",")[1][:-8], 16)
+                assert key2 > key1, (key1, key2)
+                async with s.get(f"http://{a2['url']}/{a2['fid']}") as _:
+                    pass
+                assert a2["fid"] != fid1
+        finally:
+            if vs is not None:
+                await vs.stop()
+            for m in masters:
+                try:
+                    await m.stop()
+                except Exception:
+                    pass
+
+    run(go())
+
+
+def test_growth_replicates_vid_ceiling(tmp_path):
+    async def go():
+        masters, urls = await make_masters(tmp_path)
+        vs = None
+        try:
+            leader = await wait_leader(masters)
+            vs = VolumeServer(
+                masters=list(urls), directories=[str(tmp_path / "v")],
+                port=0, grpc_port=0, pulse_seconds=1, ec_backend="numpy",
+            )
+            await vs.start()
+            await wait_for(
+                lambda: len(leader.topo.data_nodes()) == 1, 15, "vs at leader"
+            )
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"http://{leader.url}/vol/grow?count=2"
+                ) as r:
+                    grown = await r.json()
+                    assert grown.get("count", 0) >= 1, grown
+            max_vid = leader.topo.max_volume_id
+            # every follower learned the ceiling through the raft log
+            for m in masters:
+                if m is not leader:
+                    await wait_for(
+                        lambda m=m: m.topo.max_volume_id >= max_vid, 10,
+                        "vid ceiling replicated",
+                    )
+        finally:
+            if vs is not None:
+                await vs.stop()
+            for m in masters:
+                try:
+                    await m.stop()
+                except Exception:
+                    pass
+
+    run(go())
