@@ -13,6 +13,7 @@ from . import (
     benchmark,
     filer,
     filer_sync,
+    iam,
     master,
     mount,
     scaffold,
@@ -27,7 +28,7 @@ from . import (
 COMMANDS = {
     m.NAME: m
     for m in (
-        master, volume, filer, filer_sync, s3, webdav, mount, server, shell,
+        master, volume, filer, filer_sync, s3, iam, webdav, mount, server, shell,
         benchmark, scaffold, version,
     )
 }

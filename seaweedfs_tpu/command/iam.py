@@ -1,0 +1,33 @@
+"""`iam` — run the IAM API (reference: weed/command/iam.go)."""
+from __future__ import annotations
+
+import asyncio
+
+NAME = "iam"
+HELP = "start an IAM-compatible API for S3 identity management"
+
+
+def add_args(p) -> None:
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=8111)
+    p.add_argument(
+        "-filer", dest="filer", default="127.0.0.1:8888", help="filer host:port"
+    )
+    p.add_argument(
+        "-filer.grpc", dest="filer_grpc", default="",
+        help="filer grpc host:port (default: filer port+10000)",
+    )
+
+
+async def run(args) -> None:
+    from ..iamapi import IamApiServer
+
+    srv = IamApiServer(
+        filer_address=args.filer,
+        filer_grpc_address=args.filer_grpc,
+        ip=args.ip,
+        port=args.port,
+    )
+    await srv.start()
+    print(f"iam api ready at http://{srv.url}/")
+    await asyncio.Event().wait()

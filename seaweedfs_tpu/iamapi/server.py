@@ -1,0 +1,370 @@
+"""IAM-compatible API: user/access-key/policy CRUD over the S3 identity
+registry.
+
+Reference: weed/iamapi/iamapi_server.go + iamapi_management_handlers.go
+— AWS IAM's form-POST + XML wire shape (Action=CreateUser&...), backed
+by the same identity config the S3 gateway enforces, persisted in the
+filer at /etc/iam/identity.json so gateways can load it at boot.
+IAM policy documents are translated to the gateway's action strings
+(s3:GetObject -> Read:bucket, ... — CanDo semantics in s3api/auth.py).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import secrets
+import string
+import time
+import xml.etree.ElementTree as ET
+
+import aiohttp
+import grpc
+from aiohttp import web
+
+from ..pb import Stub, filer_pb2
+from ..pb.rpc import channel
+from ..s3api.auth import (
+    IDENTITY_FILER_PATH as IDENTITY_PATH,
+    Identity,
+    IdentityAccessManagement,
+    S3AuthError,
+    verify_payload_hash,
+)
+
+log = logging.getLogger("iamapi")
+
+IAM_XMLNS = "https://iam.amazonaws.com/doc/2010-05-08/"
+# IAM policy action verbs -> gateway action strings (reference
+# iamapi_management_handlers.go GetActions).  Matching is by the verb
+# AFTER "s3:", never by bare prefix — "s3:" must not swallow unknown
+# actions into Admin.
+_VERB_MAP = [
+    ("*", "Admin"),
+    ("Get", "Read"),
+    ("List", "List"),
+    ("Put", "Write"),
+    ("Delete", "Write"),
+]
+
+
+def _map_action(action: str) -> str | None:
+    if not action.startswith("s3:"):
+        return None
+    verb = action[3:]
+    for prefix, mapped in _VERB_MAP:
+        if verb == prefix or (prefix != "*" and verb.startswith(prefix)):
+            return mapped
+    return None  # unknown s3 verbs grant NOTHING (fail closed)
+
+
+def policy_to_actions(policy: dict) -> list[str]:
+    """Statement(Action, Resource) pairs -> ["Read:bucket", ...].  Admin
+    from s3:* stays bucket-scoped ("Admin:bucket") unless the resource
+    really is *, matching the reference's GetActions."""
+    out: list[str] = []
+    statements = policy.get("Statement", [])
+    if isinstance(statements, dict):
+        statements = [statements]
+    for st in statements:
+        if st.get("Effect", "Allow") != "Allow":
+            continue
+        actions = st.get("Action", [])
+        if isinstance(actions, str):
+            actions = [actions]
+        resources = st.get("Resource", [])
+        if isinstance(resources, str):
+            resources = [resources]
+        buckets = []
+        for r in resources:
+            tail = r.split(":::", 1)[-1] if ":::" in r else r
+            bucket = tail.split("/", 1)[0]
+            buckets.append("" if bucket in ("*", "") else bucket)
+        for a in actions:
+            mapped = _map_action(a)
+            if mapped is None:
+                continue
+            for b in buckets or [""]:
+                out.append(f"{mapped}:{b}" if b else mapped)
+    return sorted(set(out))
+
+
+def _gen_key(n: int, alphabet=string.ascii_uppercase + string.digits) -> str:
+    return "".join(secrets.choice(alphabet) for _ in range(n))
+
+
+class IamError(Exception):
+    def __init__(self, code: str, message: str, status: int = 400):
+        super().__init__(message)
+        self.code = code
+        self.status = status
+
+
+class IamApiServer:
+    def __init__(
+        self,
+        filer_address: str = "",  # host:port; empty = in-memory only
+        filer_grpc_address: str = "",
+        ip: str = "127.0.0.1",
+        port: int = 8111,
+        iam: IdentityAccessManagement | None = None,
+    ):
+        self.filer_address = filer_address
+        if filer_address:
+            host, _, p = filer_address.partition(":")
+            self.filer_grpc_address = (
+                filer_grpc_address or f"{host}:{int(p) + 10000}"
+            )
+        else:
+            self.filer_grpc_address = filer_grpc_address
+        self.ip = ip
+        self.port = port
+        self.iam = iam if iam is not None else IdentityAccessManagement()
+        self._runner: web.AppRunner | None = None
+        self._stub_cache = None
+
+    @property
+    def url(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    def _stub(self):
+        if self._stub_cache is None:
+            self._stub_cache = Stub(
+                channel(self.filer_grpc_address), filer_pb2, "SeaweedFiler"
+            )
+        return self._stub_cache
+
+    async def start(self) -> None:
+        if self.filer_grpc_address:
+            await self._load_from_filer()
+        app = web.Application()
+        app.router.add_post("/", self._dispatch)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.ip, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        log.info("iam api listening on %s", self.port)
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    # ---------------------------------------------------------- persistence
+
+    async def _load_from_filer(self) -> None:
+        try:
+            resp = await self._stub().LookupDirectoryEntry(
+                filer_pb2.LookupDirectoryEntryRequest(
+                    directory=IDENTITY_PATH[0], name=IDENTITY_PATH[1]
+                )
+            )
+            if resp.HasField("entry") and resp.entry.content:
+                cfg = json.loads(resp.entry.content)
+                loaded = IdentityAccessManagement.from_config(cfg)
+                self.iam.identities[:] = loaded.identities
+                self.iam._by_access_key.clear()
+                self.iam._by_access_key.update(loaded._by_access_key)
+        except grpc.aio.AioRpcError as e:
+            if e.code() != grpc.StatusCode.NOT_FOUND:
+                raise
+
+    async def _persist(self) -> None:
+        if not self.filer_grpc_address:
+            return
+        blob = json.dumps(self.iam.to_config(), indent=2).encode()
+        now = int(time.time())
+        resp = await self._stub().CreateEntry(
+            filer_pb2.CreateEntryRequest(
+                directory=IDENTITY_PATH[0],
+                entry=filer_pb2.Entry(
+                    name=IDENTITY_PATH[1],
+                    content=blob,
+                    attributes=filer_pb2.FuseAttributes(
+                        file_mode=0o600, mtime=now, crtime=now,
+                        file_size=len(blob), mime="application/json",
+                    ),
+                ),
+            )
+        )
+        if resp.error:
+            raise IamError(
+                "ServiceFailure", f"identity store write failed: {resp.error}", 500
+            )
+
+    # -------------------------------------------------------------- serving
+
+    _MUTATING = {
+        "CreateUser", "DeleteUser", "CreateAccessKey", "DeleteAccessKey",
+        "PutUserPolicy", "DeleteUserPolicy",
+    }
+
+    async def _dispatch(self, request: web.Request) -> web.Response:
+        # the IAM API itself requires a signed admin identity once any
+        # SIGNABLE identity exists (iamapi_server.go rides the s3 SigV4
+        # auth); gating on mere user existence would let a bootstrap
+        # CreateUser with no credentials lock everyone out forever
+        signable = any(i.credentials for i in self.iam.identities)
+        if signable:
+            try:
+                ident = self.iam.authenticate(request)
+                # bind the signature to the actual body, or a captured
+                # request could be replayed with a swapped form
+                await verify_payload_hash(request)
+            except S3AuthError as e:
+                return self._error(e.code, str(e), 403)
+            if ident is not None and not ident.can_do("Admin"):
+                return self._error("AccessDenied", "admin credentials required", 403)
+        form = await request.post()
+        action = form.get("Action", "")
+        handler = getattr(self, f"do_{action}", None)
+        if handler is None:
+            return self._error(
+                "InvalidAction", f"unsupported action {action!r}", 400
+            )
+        try:
+            body = await handler(form)
+            if action in self._MUTATING:
+                await self._persist()
+        except (IamError, S3AuthError) as e:
+            return self._error(e.code, str(e), e.status)
+        return web.Response(body=body, content_type="text/xml")
+
+    def _error(self, code: str, message: str, status: int) -> web.Response:
+        root = ET.Element("ErrorResponse", xmlns=IAM_XMLNS)
+        err = ET.SubElement(root, "Error")
+        ET.SubElement(err, "Code").text = code
+        ET.SubElement(err, "Message").text = message
+        return web.Response(
+            body=ET.tostring(root, encoding="utf-8", xml_declaration=True),
+            status=status,
+            content_type="text/xml",
+        )
+
+    @staticmethod
+    def _resp(action: str, fill=None) -> bytes:
+        root = ET.Element(f"{action}Response", xmlns=IAM_XMLNS)
+        result = ET.SubElement(root, f"{action}Result")
+        if fill is not None:
+            fill(result)
+        meta = ET.SubElement(root, "ResponseMetadata")
+        ET.SubElement(meta, "RequestId").text = _gen_key(16)
+        return ET.tostring(root, encoding="utf-8", xml_declaration=True)
+
+    # --------------------------------------------------------------- actions
+
+    async def do_CreateUser(self, form) -> bytes:
+        name = form.get("UserName", "")
+        if not name:
+            raise IamError("InvalidInput", "UserName required")
+        self.iam.add_identity(Identity(name=name))
+
+        def fill(result):
+            user = ET.SubElement(result, "User")
+            ET.SubElement(user, "UserName").text = name
+            ET.SubElement(user, "UserId").text = name
+            ET.SubElement(user, "Arn").text = f"arn:aws:iam:::user/{name}"
+
+        return self._resp("CreateUser", fill)
+
+    async def do_GetUser(self, form) -> bytes:
+        name = form.get("UserName", "")
+        ident = self.iam.find(name)
+        if ident is None:
+            raise IamError("NoSuchEntity", f"user {name} not found", 404)
+
+        def fill(result):
+            user = ET.SubElement(result, "User")
+            ET.SubElement(user, "UserName").text = name
+            ET.SubElement(user, "Arn").text = f"arn:aws:iam:::user/{name}"
+
+        return self._resp("GetUser", fill)
+
+    async def do_DeleteUser(self, form) -> bytes:
+        self.iam.remove_identity(form.get("UserName", ""))
+        return self._resp("DeleteUser")
+
+    async def do_ListUsers(self, form) -> bytes:
+        def fill(result):
+            users = ET.SubElement(result, "Users")
+            for i in self.iam.identities:
+                m = ET.SubElement(users, "member")
+                ET.SubElement(m, "UserName").text = i.name
+                ET.SubElement(m, "Arn").text = f"arn:aws:iam:::user/{i.name}"
+            ET.SubElement(result, "IsTruncated").text = "false"
+
+        return self._resp("ListUsers", fill)
+
+    async def do_CreateAccessKey(self, form) -> bytes:
+        name = form.get("UserName", "")
+        access = "AKIA" + _gen_key(16)
+        secret = _gen_key(
+            40, string.ascii_letters + string.digits + "/+"
+        )
+        self.iam.add_credential(name, access, secret)
+
+        def fill(result):
+            key = ET.SubElement(result, "AccessKey")
+            ET.SubElement(key, "UserName").text = name
+            ET.SubElement(key, "AccessKeyId").text = access
+            ET.SubElement(key, "SecretAccessKey").text = secret
+            ET.SubElement(key, "Status").text = "Active"
+
+        return self._resp("CreateAccessKey", fill)
+
+    async def do_DeleteAccessKey(self, form) -> bytes:
+        self.iam.remove_credential(
+            form.get("UserName", ""), form.get("AccessKeyId", "")
+        )
+        return self._resp("DeleteAccessKey")
+
+    async def do_ListAccessKeys(self, form) -> bytes:
+        name = form.get("UserName", "")
+        ident = self.iam.find(name)
+        if ident is None:
+            raise IamError("NoSuchEntity", f"user {name} not found", 404)
+
+        def fill(result):
+            keys = ET.SubElement(result, "AccessKeyMetadata")
+            for access, _ in ident.credentials:
+                m = ET.SubElement(keys, "member")
+                ET.SubElement(m, "UserName").text = name
+                ET.SubElement(m, "AccessKeyId").text = access
+                ET.SubElement(m, "Status").text = "Active"
+
+        return self._resp("ListAccessKeys", fill)
+
+    async def do_PutUserPolicy(self, form) -> bytes:
+        name = form.get("UserName", "")
+        ident = self.iam.find(name)
+        if ident is None:
+            raise IamError("NoSuchEntity", f"user {name} not found", 404)
+        try:
+            # aiohttp's request.post() already form-decoded the field
+            policy = json.loads(form.get("PolicyDocument", ""))
+        except ValueError:
+            raise IamError("MalformedPolicyDocument", "bad policy json")
+        ident.actions = policy_to_actions(policy)
+        return self._resp("PutUserPolicy")
+
+    async def do_GetUserPolicy(self, form) -> bytes:
+        name = form.get("UserName", "")
+        ident = self.iam.find(name)
+        if ident is None:
+            raise IamError("NoSuchEntity", f"user {name} not found", 404)
+
+        def fill(result):
+            ET.SubElement(result, "UserName").text = name
+            ET.SubElement(result, "PolicyName").text = f"{name}-policy"
+            ET.SubElement(result, "PolicyDocument").text = json.dumps(
+                {"Statement": [{"Effect": "Allow", "Action": a} for a in ident.actions]}
+            )
+
+        return self._resp("GetUserPolicy", fill)
+
+    async def do_DeleteUserPolicy(self, form) -> bytes:
+        name = form.get("UserName", "")
+        ident = self.iam.find(name)
+        if ident is None:
+            raise IamError("NoSuchEntity", f"user {name} not found", 404)
+        ident.actions = []
+        return self._resp("DeleteUserPolicy")

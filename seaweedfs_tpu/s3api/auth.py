@@ -24,6 +24,11 @@ ACTION_LIST = "List"
 ACTION_TAGGING = "Tagging"
 
 
+# where the IAM API persists identities inside the filer (shared with
+# iamapi/server.py; reference: filer_etc /etc/iam/identity.json)
+IDENTITY_FILER_PATH = ("/etc/iam", "identity.json")
+
+
 class S3AuthError(Exception):
     def __init__(self, code: str, message: str, status: int = 403):
         super().__init__(message)
@@ -42,8 +47,13 @@ class Identity:
             return True
         for a in self.actions:
             base, _, limit = a.partition(":")
-            if base != action:
+            # "Admin:bucket" grants every action within that bucket only
+            if base != action and base != ACTION_ADMIN:
                 continue
+            if base == ACTION_ADMIN and not limit:
+                continue  # bare Admin handled above
+            if base == ACTION_ADMIN and not bucket:
+                continue  # bucket-scoped admin can't do global actions
             if not limit or limit == bucket or bucket.startswith(limit):
                 return True
         return False
@@ -78,6 +88,61 @@ class IdentityAccessManagement:
             for i in cfg.get("identities", [])
         ]
         return cls(idents)
+
+    def to_config(self) -> dict:
+        """Inverse of from_config (persisted by the IAM API)."""
+        return {
+            "identities": [
+                {
+                    "name": i.name,
+                    "credentials": [
+                        {"accessKey": a, "secretKey": s}
+                        for a, s in i.credentials
+                    ],
+                    "actions": list(i.actions),
+                }
+                for i in self.identities
+            ]
+        }
+
+    # -------------------------------------------------- mutation (IAM API)
+
+    def find(self, name: str) -> Identity | None:
+        return next((i for i in self.identities if i.name == name), None)
+
+    def add_identity(self, ident: Identity) -> None:
+        if self.find(ident.name) is not None:
+            raise S3AuthError("EntityAlreadyExists", f"user {ident.name} exists", 409)
+        self.identities.append(ident)
+        for access, secret in ident.credentials:
+            self._by_access_key[access] = (ident, secret)
+
+    def remove_identity(self, name: str) -> None:
+        ident = self.find(name)
+        if ident is None:
+            raise S3AuthError("NoSuchEntity", f"user {name} not found", 404)
+        self.identities.remove(ident)
+        for access, _ in ident.credentials:
+            self._by_access_key.pop(access, None)
+
+    def add_credential(self, name: str, access: str, secret: str) -> None:
+        ident = self.find(name)
+        if ident is None:
+            raise S3AuthError("NoSuchEntity", f"user {name} not found", 404)
+        ident.credentials.append((access, secret))
+        self._by_access_key[access] = (ident, secret)
+
+    def remove_credential(self, name: str, access: str) -> None:
+        ident = self.find(name)
+        if ident is None:
+            raise S3AuthError("NoSuchEntity", f"user {name} not found", 404)
+        if not any(c[0] == access for c in ident.credentials):
+            # never revoke another identity's key through the wrong user
+            raise S3AuthError(
+                "NoSuchEntity", f"access key not owned by {name}", 404
+            )
+        ident.credentials = [c for c in ident.credentials if c[0] != access]
+        self._by_access_key.pop(access, None)
 
     @property
     def enabled(self) -> bool:

@@ -80,6 +80,40 @@ class S3ApiServer:
         self._http_runner: web.AppRunner | None = None
         self._session: aiohttp.ClientSession | None = None
         self._stub_cache = None
+        self._iam_refresh: asyncio.Task | None = None
+
+    async def _load_iam_from_filer(self) -> None:
+        from .auth import IDENTITY_FILER_PATH, IdentityAccessManagement
+
+        try:
+            resp = await self._stub().LookupDirectoryEntry(
+                filer_pb2.LookupDirectoryEntryRequest(
+                    directory=IDENTITY_FILER_PATH[0],
+                    name=IDENTITY_FILER_PATH[1],
+                )
+            )
+        except grpc.aio.AioRpcError as e:
+            if e.code() == grpc.StatusCode.NOT_FOUND:
+                return
+            raise
+        if not (resp.HasField("entry") and resp.entry.content):
+            return
+        import json as _json
+
+        loaded = IdentityAccessManagement.from_config(
+            _json.loads(resp.entry.content)
+        )
+        self.iam.identities[:] = loaded.identities
+        self.iam._by_access_key.clear()
+        self.iam._by_access_key.update(loaded._by_access_key)
+
+    async def _iam_refresh_loop(self, interval: float = 10.0) -> None:
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                await self._load_iam_from_filer()
+            except Exception:  # noqa: BLE001 — keep serving with old config
+                log.exception("iam refresh failed")
 
     @property
     def url(self) -> str:
@@ -94,6 +128,12 @@ class S3ApiServer:
 
     async def start(self) -> None:
         self._session = aiohttp.ClientSession()
+        # no locally-configured identities: adopt (and follow) the
+        # IAM-API-managed config the filer holds, so `iam` and `s3` work
+        # as separate processes (reference: s3 subscribes to filer_etc)
+        if not self.iam.enabled:
+            await self._load_iam_from_filer()
+            self._iam_refresh = asyncio.create_task(self._iam_refresh_loop())
         app = web.Application(client_max_size=1024 * 1024 * 1024)
         app.router.add_route("*", "/{tail:.*}", self._dispatch)
         self._http_runner = web.AppRunner(app)
@@ -104,6 +144,12 @@ class S3ApiServer:
         log.info("s3 gateway listening on %s", self.port)
 
     async def stop(self) -> None:
+        if self._iam_refresh is not None:
+            self._iam_refresh.cancel()
+            try:
+                await self._iam_refresh
+            except asyncio.CancelledError:
+                pass
         if self._http_runner:
             await self._http_runner.cleanup()
         if self._session:

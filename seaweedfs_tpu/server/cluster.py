@@ -30,6 +30,7 @@ class LocalCluster:
         with_s3: bool = False,
         s3_kwargs: dict | None = None,
         with_webdav: bool = False,
+        with_iam: bool = False,
         jwt_signing_key: str = "",
         tier_backends: dict | None = None,  # default: local backend in base_dir/tier
     ):
@@ -41,9 +42,11 @@ class LocalCluster:
             jwt_signing_key=jwt_signing_key,
         )
         self.jwt_signing_key = jwt_signing_key
-        self.with_filer = with_filer or with_s3 or with_webdav
+        self.with_filer = with_filer or with_s3 or with_webdav or with_iam
         self.with_webdav = with_webdav
         self.webdav = None
+        self.with_iam = with_iam
+        self.iam_server = None
         self.filer_kwargs = filer_kwargs or {}
         self.filer: FilerServer | None = None
         self.with_s3 = with_s3
@@ -109,6 +112,19 @@ class LocalCluster:
                 **self.s3_kwargs,
             )
             await self.s3.start()
+        if self.with_iam:
+            from ..iamapi import IamApiServer
+
+            # share the S3 gateway's IAM registry so policy changes take
+            # effect immediately in-process (the reference shares the
+            # filer-stored config the same way)
+            self.iam_server = IamApiServer(
+                filer_address=self.filer.url,
+                filer_grpc_address=f"{self.filer.ip}:{self.filer.grpc_port}",
+                port=0,
+                iam=self.s3.iam if self.s3 is not None else None,
+            )
+            await self.iam_server.start()
         if self.with_webdav:
             from .webdav import WebDavServer
 
@@ -128,6 +144,8 @@ class LocalCluster:
         raise TimeoutError(f"only {len(self.master.topo.data_nodes())}/{n} nodes joined")
 
     async def stop(self) -> None:
+        if self.iam_server is not None:
+            await self.iam_server.stop()
         if self.webdav is not None:
             await self.webdav.stop()
         if self.s3 is not None:
