@@ -15,6 +15,7 @@ from . import (
     filer_sync,
     iam,
     master,
+    mq_broker,
     mount,
     scaffold,
     server,
@@ -28,7 +29,8 @@ from . import (
 COMMANDS = {
     m.NAME: m
     for m in (
-        master, volume, filer, filer_sync, s3, iam, webdav, mount, server, shell,
+        master, volume, filer, filer_sync, s3, iam, webdav, mount, mq_broker,
+        server, shell,
         benchmark, scaffold, version,
     )
 }

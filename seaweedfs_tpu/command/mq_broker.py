@@ -1,0 +1,37 @@
+"""`mq.broker` — run the message queue broker
+(reference: weed/command/mq_broker.go)."""
+from __future__ import annotations
+
+import asyncio
+
+NAME = "mq.broker"
+HELP = "start the pub/sub message queue broker"
+
+
+def add_args(p) -> None:
+    p.add_argument("-ip", default="127.0.0.1")
+    p.add_argument("-port", type=int, default=17777, help="grpc port")
+    p.add_argument(
+        "-filer", dest="filer", default="127.0.0.1:8888", help="filer host:port"
+    )
+    p.add_argument(
+        "-filer.grpc", dest="filer_grpc", default="",
+        help="filer grpc host:port (default: filer port+10000)",
+    )
+
+
+async def run(args) -> None:
+    from ..mq import MessageQueueBroker
+
+    broker = MessageQueueBroker(
+        filer_address=args.filer,
+        filer_grpc_address=args.filer_grpc,
+        ip=args.ip,
+        port=args.port,
+    )
+    await broker.start()
+    print(f"mq broker ready at {broker.grpc_url} (grpc)")
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await broker.stop()

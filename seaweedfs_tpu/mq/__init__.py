@@ -1,0 +1,4 @@
+from .broker import MessageQueueBroker
+from .client import MqClient
+
+__all__ = ["MessageQueueBroker", "MqClient"]

@@ -1,0 +1,393 @@
+"""Message queue broker: partitioned topic logs with pub/sub streams.
+
+Reference: weed/mq/broker/ — topics split into partitions, publishers
+stream DataMessages which land in per-partition logs persisted through
+the filer (the reference spools LogBuffers to /topics/... files the
+same way), subscribers replay from an offset then tail live; consumer
+group offsets live in the filer KV.  Single-broker scope here (the
+reference's balancer assigns partitions across brokers; the lookup RPC
+returns this broker for every partition so the client wiring matches).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+import time
+import zlib
+
+import aiohttp
+import grpc
+
+from ..pb import Stub, filer_pb2, generic_handler, mq_pb2
+from ..pb.rpc import GRPC_OPTIONS, channel
+
+log = logging.getLogger("mq")
+
+TOPICS_DIR = "/topics"
+_SEGMENT_FLUSH_EVERY = 256  # messages per filer append
+_MEM_TAIL_MAX = 4096  # messages kept in RAM per partition
+
+
+def topic_key(t: mq_pb2.Topic) -> str:
+    return f"{t.namespace or 'default'}/{t.name}"
+
+
+def _records_encode(msgs: list[tuple[int, bytes, bytes, int]]) -> bytes:
+    """[(offset, key, value, ts_ns)] -> length-prefixed frames."""
+    out = bytearray()
+    for offset, key, value, ts_ns in msgs:
+        body = struct.pack("<qqI", offset, ts_ns, len(key)) + key + value
+        out += struct.pack("<I", len(body)) + body
+    return bytes(out)
+
+
+def _records_decode(blob: bytes):
+    pos = 0
+    while pos + 4 <= len(blob):
+        (n,) = struct.unpack_from("<I", blob, pos)
+        pos += 4
+        if pos + n > len(blob):
+            return  # torn tail from a crashed append
+        offset, ts_ns, klen = struct.unpack_from("<qqI", blob, pos)
+        key = blob[pos + 20: pos + 20 + klen]
+        value = blob[pos + 20 + klen: pos + n]
+        yield offset, key, value, ts_ns
+        pos += n
+
+
+class Partition:
+    def __init__(self, broker: "MessageQueueBroker", tkey: str, idx: int):
+        self.broker = broker
+        self.tkey = tkey
+        self.idx = idx
+        self.next_offset = 0
+        self.mem: list[tuple[int, bytes, bytes, int]] = []  # recent tail
+        self.mem_base = 0  # offset of mem[0]
+        self.flushed_upto = 0  # first offset NOT yet durable
+        self.pending: list[tuple[int, bytes, bytes, int]] = []  # not yet flushed
+        self.cond = asyncio.Condition()
+        self._flushing = False
+
+    @property
+    def log_path(self) -> tuple[str, str]:
+        return f"{TOPICS_DIR}/{self.tkey}/{self.idx}", "log"
+
+    async def append(self, key: bytes, value: bytes) -> int:
+        async with self.cond:
+            offset = self.next_offset
+            self.next_offset += 1
+            rec = (offset, key, value, time.time_ns())
+            self.mem.append(rec)
+            # trim only DURABLE records: dropping unflushed ones would let
+            # a replay reader skip them forever (the durable log + memory
+            # walk must stay gap-free)
+            if len(self.mem) > _MEM_TAIL_MAX:
+                drop = min(
+                    len(self.mem) - _MEM_TAIL_MAX,
+                    max(0, self.flushed_upto - self.mem_base),
+                )
+                if drop:
+                    self.mem = self.mem[drop:]
+                    self.mem_base += drop
+            self.pending.append(rec)
+            self.cond.notify_all()
+        if len(self.pending) >= _SEGMENT_FLUSH_EVERY:
+            try:
+                await self.flush()
+            except Exception:  # noqa: BLE001 — record is accepted; the
+                # periodic flusher retries the re-queued batch
+                log.exception("inline flush failed for %s/%d", self.tkey, self.idx)
+        return offset
+
+    async def flush(self) -> None:
+        if self._flushing or not self.pending:
+            return
+        self._flushing = True
+        try:
+            batch, self.pending = self.pending, []
+            await self.broker._append_log(self, _records_encode(batch))
+            self.flushed_upto = batch[-1][0] + 1
+        except Exception:
+            # put the batch back; a later flush retries
+            self.pending = batch + self.pending
+            raise
+        finally:
+            self._flushing = False
+
+    async def read_from(self, offset: int):
+        """Yield records >= offset: durable segment first, then memory.
+        Indexing is by absolute offset so a concurrent tail-trim can't
+        skew the walk."""
+        if offset < self.mem_base:
+            blob = await self.broker._read_log(self)
+            for rec in _records_decode(blob):
+                if rec[0] >= offset and rec[0] < self.mem_base:
+                    yield rec
+        next_o = max(offset, self.mem_base)
+        while True:
+            idx = next_o - self.mem_base
+            if idx < 0 or idx >= len(self.mem):
+                return
+            rec = self.mem[idx]
+            yield rec
+            next_o = rec[0] + 1
+
+
+class MessageQueueBroker:
+    def __init__(
+        self,
+        filer_address: str,  # host:port HTTP
+        filer_grpc_address: str = "",
+        ip: str = "127.0.0.1",
+        port: int = 17777,  # grpc
+    ):
+        host, _, p = filer_address.partition(":")
+        self.filer_address = filer_address
+        self.filer_grpc_address = filer_grpc_address or f"{host}:{int(p) + 10000}"
+        self.ip = ip
+        self.port = port
+        self.topics: dict[str, list[Partition]] = {}
+        self._grpc_server: grpc.aio.Server | None = None
+        self._stub_cache = None
+        self._session: aiohttp.ClientSession | None = None
+        self._flusher: asyncio.Task | None = None
+
+    async def _sess(self) -> aiohttp.ClientSession:
+        if self._session is None:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    @property
+    def grpc_url(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    def _stub(self):
+        if self._stub_cache is None:
+            self._stub_cache = Stub(
+                channel(self.filer_grpc_address), filer_pb2, "SeaweedFiler"
+            )
+        return self._stub_cache
+
+    # -------------------------------------------------------------- lifecycle
+
+    async def start(self) -> None:
+        await self._load_topics()
+        self._grpc_server = grpc.aio.server(options=GRPC_OPTIONS)
+        self._grpc_server.add_generic_rpc_handlers(
+            [generic_handler(mq_pb2, "SeaweedMessaging", self)]
+        )
+        self.port = self._grpc_server.add_insecure_port(f"{self.ip}:{self.port}")
+        await self._grpc_server.start()
+        self._flusher = asyncio.create_task(self._flush_loop())
+        log.info("mq broker up grpc=%s", self.grpc_url)
+
+    async def stop(self) -> None:
+        # stop accepting publishes BEFORE the final flush, or a message
+        # acknowledged in the shutdown window would be lost
+        if self._grpc_server:
+            await self._grpc_server.stop(0.5)
+        if self._flusher is not None:
+            self._flusher.cancel()
+            try:
+                await self._flusher
+            except asyncio.CancelledError:
+                pass
+        for parts in self.topics.values():
+            for p in parts:
+                try:
+                    await p.flush()
+                except Exception:  # noqa: BLE001
+                    log.exception("final flush failed for %s/%d", p.tkey, p.idx)
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    async def _flush_loop(self) -> None:
+        while True:
+            await asyncio.sleep(1.0)
+            for parts in list(self.topics.values()):
+                for p in parts:
+                    try:
+                        await p.flush()
+                    except Exception:  # noqa: BLE001
+                        log.exception("flush failed for %s/%d", p.tkey, p.idx)
+
+    # ------------------------------------------------------- filer plumbing
+
+    async def _append_log(self, p: Partition, blob: bytes) -> None:
+        d, name = p.log_path
+        sess = await self._sess()
+        async with sess.post(
+            f"http://{self.filer_address}{d}/{name}?op=append",
+            data=blob,
+        ) as r:
+            if r.status >= 300:
+                raise RuntimeError(f"log append HTTP {r.status}")
+
+    async def _read_log(self, p: Partition) -> bytes:
+        d, name = p.log_path
+        sess = await self._sess()
+        async with sess.get(f"http://{self.filer_address}{d}/{name}") as r:
+            if r.status == 404:
+                return b""
+            if r.status >= 300:
+                raise RuntimeError(f"log read HTTP {r.status}")
+            return await r.read()
+
+    async def _load_topics(self) -> None:
+        """Recover topic configs + partition offsets from the filer."""
+        from ..filer.client import list_all_entries
+
+        try:
+            namespaces = await list_all_entries(self._stub(), TOPICS_DIR)
+        except grpc.aio.AioRpcError:
+            return
+        for ns in namespaces:
+            if not ns.is_directory:
+                continue
+            for t in await list_all_entries(self._stub(), f"{TOPICS_DIR}/{ns.name}"):
+                if not t.is_directory:
+                    continue
+                tkey = f"{ns.name}/{t.name}"
+                parts = []
+                pdirs = await list_all_entries(
+                    self._stub(), f"{TOPICS_DIR}/{tkey}"
+                )
+                n = sum(1 for e in pdirs if e.is_directory)
+                for i in range(n):
+                    part = Partition(self, tkey, i)
+                    blob = await self._read_log(part)
+                    last = -1
+                    for offset, *_ in _records_decode(blob):
+                        last = max(last, offset)
+                    part.next_offset = last + 1
+                    part.mem_base = last + 1
+                    parts.append(part)
+                if parts:
+                    self.topics[tkey] = parts
+
+    def _group_key(self, tkey: str, partition: int, group: str) -> bytes:
+        return f"mq.offset/{tkey}/{partition}/{group}".encode()
+
+    # ------------------------------------------------------------------ rpc
+
+    async def ConfigureTopic(self, request, context):
+        tkey = topic_key(request.topic)
+        n = max(1, request.partition_count or 1)
+        if tkey not in self.topics:
+            self.topics[tkey] = [Partition(self, tkey, i) for i in range(n)]
+            # materialize partition directories so restart discovery works
+            for i in range(n):
+                await self._stub().CreateEntry(
+                    filer_pb2.CreateEntryRequest(
+                        directory=f"{TOPICS_DIR}/{tkey}",
+                        entry=filer_pb2.Entry(
+                            name=str(i), is_directory=True,
+                            attributes=filer_pb2.FuseAttributes(
+                                file_mode=0o770, mtime=int(time.time()),
+                            ),
+                        ),
+                    )
+                )
+        return mq_pb2.ConfigureTopicResponse(
+            partition_count=len(self.topics[tkey])
+        )
+
+    async def ListTopics(self, request, context):
+        resp = mq_pb2.ListTopicsResponse()
+        for tkey, parts in sorted(self.topics.items()):
+            ns, _, name = tkey.partition("/")
+            resp.topics.append(mq_pb2.Topic(namespace=ns, name=name))
+            resp.partition_counts.append(len(parts))
+        return resp
+
+    async def LookupTopicBrokers(self, request, context):
+        tkey = topic_key(request.topic)
+        parts = self.topics.get(tkey)
+        if parts is None:
+            await context.abort(grpc.StatusCode.NOT_FOUND, f"topic {tkey}")
+        return mq_pb2.LookupTopicBrokersResponse(
+            topic=request.topic,
+            partition_count=len(parts),
+            broker=self.grpc_url,
+        )
+
+    def _partition_for(self, parts: list[Partition], req) -> Partition:
+        if req.partition >= 0:
+            if req.partition >= len(parts):
+                raise IndexError(f"partition {req.partition} out of range")
+            return parts[req.partition]
+        key = bytes(req.data.key)
+        return parts[zlib.crc32(key) % len(parts)] if key else parts[0]
+
+    async def Publish(self, request_iterator, context):
+        parts = None
+        async for req in request_iterator:
+            if parts is None:
+                tkey = topic_key(req.topic)
+                parts = self.topics.get(tkey)
+                if parts is None:
+                    yield mq_pb2.PublishResponse(error=f"unknown topic {tkey}")
+                    return
+            if not req.HasField("data"):
+                continue  # init-only message
+            try:
+                p = self._partition_for(parts, req)
+            except IndexError as e:
+                yield mq_pb2.PublishResponse(error=str(e))
+                continue
+            offset = await p.append(bytes(req.data.key), bytes(req.data.value))
+            yield mq_pb2.PublishResponse(offset=offset, partition=p.idx)
+
+    async def Subscribe(self, request, context):
+        tkey = topic_key(request.topic)
+        parts = self.topics.get(tkey)
+        if (
+            parts is None
+            or request.partition < 0
+            or request.partition >= len(parts)
+        ):
+            yield mq_pb2.SubscribeResponse(error=f"unknown topic/partition {tkey}")
+            return
+        p = parts[request.partition]
+        offset = request.start_offset
+        if offset == -1:  # committed group offset, else earliest
+            offset = 0
+            if request.consumer_group:
+                kv = await self._stub().KvGet(
+                    filer_pb2.KvGetRequest(
+                        key=self._group_key(
+                            tkey, request.partition, request.consumer_group
+                        )
+                    )
+                )
+                if kv.value:
+                    offset = struct.unpack("<q", kv.value)[0]
+        elif offset == -2:  # latest
+            offset = p.next_offset
+        while True:
+            async for rec in p.read_from(offset):
+                o, key, value, ts_ns = rec
+                offset = o + 1
+                yield mq_pb2.SubscribeResponse(
+                    data=mq_pb2.DataMessage(key=key, value=value, ts_ns=ts_ns),
+                    offset=o,
+                )
+            if not request.tail:
+                return
+            async with p.cond:
+                if p.next_offset <= offset:
+                    await p.cond.wait()
+
+    async def CommitOffset(self, request, context):
+        await self._stub().KvPut(
+            filer_pb2.KvPutRequest(
+                key=self._group_key(
+                    topic_key(request.topic), request.partition,
+                    request.consumer_group,
+                ),
+                value=struct.pack("<q", request.offset),
+            )
+        )
+        return mq_pb2.CommitOffsetResponse()

@@ -1,0 +1,99 @@
+"""MQ client: publish/subscribe helpers over the broker's gRPC surface
+(reference: weed/mq/client/pub_client + sub_client)."""
+from __future__ import annotations
+
+from ..pb import Stub, mq_pb2
+from ..pb.rpc import channel
+
+
+class MqClient:
+    def __init__(self, broker_grpc_address: str):
+        self.broker = broker_grpc_address
+        self._stub_cache = None
+
+    def _stub(self):
+        if self._stub_cache is None:
+            self._stub_cache = Stub(
+                channel(self.broker), mq_pb2, "SeaweedMessaging"
+            )
+        return self._stub_cache
+
+    @staticmethod
+    def topic(name: str, namespace: str = "default") -> mq_pb2.Topic:
+        return mq_pb2.Topic(namespace=namespace, name=name)
+
+    async def configure_topic(
+        self, topic: mq_pb2.Topic, partition_count: int = 4
+    ) -> int:
+        resp = await self._stub().ConfigureTopic(
+            mq_pb2.ConfigureTopicRequest(
+                topic=topic, partition_count=partition_count
+            )
+        )
+        return resp.partition_count
+
+    async def list_topics(self) -> list[tuple[mq_pb2.Topic, int]]:
+        resp = await self._stub().ListTopics(mq_pb2.ListTopicsRequest())
+        return list(zip(resp.topics, resp.partition_counts))
+
+    async def publish(
+        self,
+        topic: mq_pb2.Topic,
+        messages: list[tuple[bytes, bytes]],  # (key, value)
+        partition: int = -1,  # -1 = hash by key
+    ) -> list[tuple[int, int]]:
+        """Returns [(partition, offset)] per message, in order."""
+
+        async def feed():
+            for key, value in messages:
+                yield mq_pb2.PublishRequest(
+                    topic=topic,
+                    partition=partition,
+                    data=mq_pb2.DataMessage(key=key, value=value),
+                )
+
+        out = []
+        async for resp in self._stub().Publish(feed()):
+            if resp.error:
+                raise RuntimeError(f"publish failed: {resp.error}")
+            out.append((resp.partition, resp.offset))
+        return out
+
+    async def subscribe(
+        self,
+        topic: mq_pb2.Topic,
+        partition: int,
+        consumer_group: str = "",
+        start_offset: int = -1,  # -1 committed/earliest, -2 latest
+        tail: bool = False,
+    ):
+        """Async iterator of (offset, key, value)."""
+        async for resp in self._stub().Subscribe(
+            mq_pb2.SubscribeRequest(
+                topic=topic,
+                partition=partition,
+                consumer_group=consumer_group,
+                start_offset=start_offset,
+                tail=tail,
+            )
+        ):
+            if resp.error:
+                raise RuntimeError(resp.error)
+            yield resp.offset, bytes(resp.data.key), bytes(resp.data.value)
+
+    async def commit(
+        self,
+        topic: mq_pb2.Topic,
+        partition: int,
+        consumer_group: str,
+        offset: int,
+    ) -> None:
+        """Record the NEXT offset the group should read from."""
+        await self._stub().CommitOffset(
+            mq_pb2.CommitOffsetRequest(
+                topic=topic,
+                partition=partition,
+                consumer_group=consumer_group,
+                offset=offset,
+            )
+        )

@@ -1,4 +1,4 @@
 #!/bin/sh
 # Regenerate *_pb2.py from the .proto schemas (plain protoc; the gRPC
 # surface is derived from descriptors at runtime, see rpc.py).
-cd "$(dirname "$0")" && protoc -I. --python_out=. master.proto volume_server.proto filer.proto raft.proto
+cd "$(dirname "$0")" && protoc -I. --python_out=. master.proto volume_server.proto filer.proto raft.proto mq.proto
