@@ -42,6 +42,15 @@ def main(argv: list[str] | None = None) -> int:
         prog="seaweedfs_tpu",
         description="TPU-native SeaweedFS-compatible distributed storage",
     )
+    # global profiling flags before the subcommand (reference: every weed
+    # command honors -cpuprofile/-memprofile via grace/pprof)
+    parser.add_argument(
+        "-cpuprofile", default="", help="write a cProfile dump here on exit"
+    )
+    parser.add_argument(
+        "-memprofile", default="",
+        help="write tracemalloc top allocations here on exit",
+    )
     sub = parser.add_subparsers(dest="command", metavar="command")
     for name, mod in sorted(COMMANDS.items()):
         p = sub.add_parser(name, help=mod.HELP)
@@ -50,6 +59,9 @@ def main(argv: list[str] | None = None) -> int:
     if not args.command:
         parser.print_help()
         return 1
+    from ..utils import profiling
+
+    profiling.maybe_start(args)
     try:
         asyncio.run(COMMANDS[args.command].run(args))
     except KeyboardInterrupt:

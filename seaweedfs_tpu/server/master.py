@@ -22,6 +22,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import time
 from dataclasses import dataclass, field
 
@@ -104,6 +105,9 @@ class MasterServer:
         self.locks: dict[str, AdminLock] = {}
         self.peers = peers or []
         self.meta_dir = meta_dir
+        # (client_type, address) -> joined-at ns; fed by KeepConnected
+        # streams (reference weed/cluster/cluster.go membership)
+        self.cluster_nodes: dict[tuple[str, str], int] = {}
         self.raft = None  # RaftNode once started (raft/node.py)
         self._seq_committed = 0  # highest raft-replicated sequence ceiling
         self._grpc_server: grpc.aio.Server | None = None
@@ -153,6 +157,12 @@ class MasterServer:
         app.router.add_post("/submit", self.h_submit)
         app.router.add_get("/cluster/status", self.h_cluster_status)
         app.router.add_get("/metrics", stats.metrics_handler)
+        if os.environ.get("SWFS_DEBUG") == "1":
+            # stack dumps reveal internals; opt-in only (the reference
+            # gates pprof handlers the same way)
+            from ..utils.profiling import debug_stacks_handler
+
+            app.router.add_get("/debug/stacks", debug_stacks_handler)
         self._http_runner = web.AppRunner(app)
         await self._http_runner.setup()
         site = web.TCPSite(self._http_runner, self.ip, self.port)
@@ -349,10 +359,19 @@ class MasterServer:
                 volume_location=loc, leader=self.advertise_url
             )
 
+        registered: tuple[str, str] | None = None
+        registered_ts = 0
+
         async def drain_requests():
+            nonlocal registered, registered_ts
             try:
-                async for _ in request_iterator:
-                    pass
+                async for req in request_iterator:
+                    # first request names the client: track cluster
+                    # membership for cluster.ps (reference cluster.go)
+                    if registered is None and req.client_address:
+                        registered = (req.client_type, req.client_address)
+                        registered_ts = time.time_ns()
+                        self.cluster_nodes[registered] = registered_ts
             except Exception:
                 pass
             finally:
@@ -368,6 +387,29 @@ class MasterServer:
         finally:
             drainer.cancel()
             self._subscribers.pop(key, None)
+            if (
+                registered is not None
+                # a reconnect may have re-registered under the same key;
+                # only the stream that owns the entry may remove it
+                and self.cluster_nodes.get(registered) == registered_ts
+            ):
+                self.cluster_nodes.pop(registered, None)
+
+    async def ListClusterNodes(self, request, context):
+        # membership registers on the leader (clients follow leader hints)
+        proxied = await self._maybe_proxy("ListClusterNodes", request, context)
+        if proxied is not None:
+            return proxied
+        resp = master_pb2.ListClusterNodesResponse()
+        for (ctype, addr), ts in sorted(self.cluster_nodes.items()):
+            if request.client_type and ctype != request.client_type:
+                continue
+            resp.cluster_nodes.append(
+                master_pb2.ClusterNodeInfo(
+                    address=addr, client_type=ctype, created_at_ns=ts
+                )
+            )
+        return resp
 
     def _broadcast_location(
         self,

@@ -134,6 +134,12 @@ class VolumeServer:
         app = web.Application(client_max_size=256 * 1024 * 1024)
         app.router.add_get("/status", self.h_status)
         app.router.add_get("/metrics", stats.metrics_handler)
+        if os.environ.get("SWFS_DEBUG") == "1":
+            # stack dumps reveal internals; opt-in only (the reference
+            # gates pprof handlers the same way)
+            from ..utils.profiling import debug_stacks_handler
+
+            app.router.add_get("/debug/stacks", debug_stacks_handler)
         app[stats.metrics.metrics_collect_key()] = self._collect_metrics
         app.router.add_route("*", "/{fid:.*}", self.h_needle)
         self._http_runner = web.AppRunner(app)

@@ -1,12 +1,29 @@
 """cluster.* commands (reference: weed/shell/command_cluster_ps.go etc.)."""
+import grpc
+
 from ..pb import master_pb2
 from .commands import command, parse_flags
 
 
 @command("cluster.ps")
 async def cmd_cluster_ps(env, args):
-    """list volume servers and their usage"""
+    """list masters, filers/clients, and volume servers
+    (command_cluster_ps.go)"""
     nodes, limit_mb = await env.collect_topology()
+    env.write(f"masters: {', '.join(env.masters)}")
+    try:
+        resp = await env.master_stub.ListClusterNodes(
+            master_pb2.ListClusterNodesRequest()
+        )
+        by_type: dict[str, list[str]] = {}
+        for cn in resp.cluster_nodes:
+            by_type.setdefault(cn.client_type, []).append(cn.address)
+        for ctype in sorted(by_type):
+            env.write(f"{ctype}s: {', '.join(sorted(by_type[ctype]))}")
+    except grpc.RpcError as e:
+        # older masters lack the RPC; anything else is worth surfacing
+        if e.code() != grpc.StatusCode.UNIMPLEMENTED:
+            env.write(f"cluster node listing failed: {e.code()}")
     env.write(f"volume size limit: {limit_mb} MB")
     for n in nodes:
         env.write(

@@ -1,0 +1,107 @@
+"""Cluster membership registry + profiling hooks.
+
+Reference: weed/cluster/cluster.go (filer/broker membership via
+KeepConnected), util/grace/pprof (debug introspection).
+"""
+import asyncio
+import io
+
+import aiohttp
+import pytest
+
+from seaweedfs_tpu.pb import master_pb2
+from seaweedfs_tpu.server.cluster import LocalCluster
+from seaweedfs_tpu.shell import CommandEnv, run_command
+from seaweedfs_tpu.utils.profiling import thread_stacks
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_master_tracks_filer_membership(tmp_path):
+    async def go():
+        cluster = LocalCluster(
+            base_dir=str(tmp_path), n_volume_servers=1, with_filer=True
+        )
+        await cluster.start()
+        try:
+            # the filer's MasterClient registers through KeepConnected
+            async def filers():
+                resp = await cluster.master.ListClusterNodes(
+                    master_pb2.ListClusterNodesRequest(client_type="filer"),
+                    None,
+                )
+                return [n.address for n in resp.cluster_nodes]
+
+            deadline = asyncio.get_event_loop().time() + 10
+            while asyncio.get_event_loop().time() < deadline:
+                if await filers():
+                    break
+                await asyncio.sleep(0.1)
+            assert cluster.filer.url in await filers()
+
+            # cluster.ps surfaces it
+            env = CommandEnv(
+                [cluster.master.advertise_url], out=io.StringIO()
+            )
+            await run_command(env, "cluster.ps")
+            out = env.out.getvalue()
+            assert "filers:" in out and cluster.filer.url in out
+            assert "masters:" in out
+
+            # disconnect removes the entry
+            await cluster.filer.master_client.stop()
+            deadline = asyncio.get_event_loop().time() + 10
+            while asyncio.get_event_loop().time() < deadline:
+                if not await filers():
+                    break
+                await asyncio.sleep(0.1)
+            assert await filers() == []
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_debug_stacks_endpoint(tmp_path, monkeypatch):
+    monkeypatch.setenv("SWFS_DEBUG", "1")
+
+    async def go():
+        cluster = LocalCluster(base_dir=str(tmp_path), n_volume_servers=1)
+        await cluster.start()
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"http://{cluster.master.url}/debug/stacks"
+                ) as r:
+                    assert r.status == 200
+                    body = await r.text()
+                    assert "--- thread MainThread" in body
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_debug_stacks_gated_off_by_default(tmp_path, monkeypatch):
+    monkeypatch.delenv("SWFS_DEBUG", raising=False)
+
+    async def go():
+        cluster = LocalCluster(base_dir=str(tmp_path), n_volume_servers=1)
+        await cluster.start()
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"http://{cluster.master.url}/debug/stacks"
+                ) as r:
+                    assert r.status == 404, "debug surface must be opt-in"
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_thread_stacks_smoke():
+    out = thread_stacks()
+    assert "MainThread" in out and "test_thread_stacks_smoke" in out
