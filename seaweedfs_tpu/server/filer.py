@@ -150,7 +150,14 @@ class FilerServer:
             await msite.start()
             self.metrics_port = msite._server.sockets[0].getsockname()[1]
 
-        self.master_client.client_address = f"{self.ip}:{self.port}"
+        # advertise the explicit grpc form when the +10000 convention
+        # doesn't hold (dynamic test ports) so shells can dial us
+        if self.grpc_port == self.port + 10000:
+            self.master_client.client_address = f"{self.ip}:{self.port}"
+        else:
+            self.master_client.client_address = (
+                f"{self.ip}:{self.port}.{self.grpc_port}"
+            )
         await self.master_client.start()
         log.info("filer listening http=%s grpc=%s", self.port, self.grpc_port)
 
@@ -430,6 +437,37 @@ class FilerServer:
             end = min(stop, len(entry.content))
             await resp.write(bytes(entry.content[pos:end]))
             pos = end
+        if pos < stop and not entry.chunks and entry.extended.get("remote.key"):
+            # remote-mounted entry with no cached chunks: read through the
+            # storage backend (filer_server_handlers_read.go remote path)
+            from ..storage import backend as backend_mod
+
+            backend_name = entry.extended.get("remote.backend", b"").decode()
+            btype, _, bid = backend_name.partition(".")
+            try:
+                storage = backend_mod.get_backend(btype, bid or "default")
+            except KeyError:
+                # config was registered via remote.configure into our own
+                # KV (shells run in other processes) — lazy-load it
+                try:
+                    cfg = self.filer.store.kv_get(
+                        f"remote.conf/{backend_name}".encode()
+                    )
+                    backend_mod.configure(json.loads(cfg))
+                except NotFoundError:
+                    raise web.HTTPBadGateway(
+                        text=f"storage backend {backend_name} not configured"
+                    )
+                storage = backend_mod.get_backend(btype, bid or "default")
+            rkey = entry.extended["remote.key"].decode()
+            piece = 1 << 16
+            while pos < stop:
+                n = min(piece, stop - pos)
+                blob = await asyncio.to_thread(storage.pread, rkey, n, pos)
+                if not blob:
+                    break
+                await resp.write(blob)
+                pos += len(blob)
         if pos < stop:
             views = await self._resolve_views(entry.chunks, pos, stop - pos)
             for v in views:

@@ -1,0 +1,232 @@
+"""remote.* commands: mount external object stores into the filer.
+
+Reference: weed/shell/command_remote_mount.go / _cache.go / _uncache.go
+/ _unmount.go + weed/remote_storage — a remote store path is mirrored
+into a filer directory as entries carrying remote markers; reads stream
+through the backend until `remote.cache` materializes local chunks, and
+`remote.uncache` drops them back to remote-only.  The storage backend
+registry (storage/backend.py) stands in for the reference's s3/gcs
+remote clients.
+"""
+from __future__ import annotations
+
+import time
+
+from ..pb import filer_pb2
+from ..storage import backend as backend_mod
+from .commands import command, parse_flags
+
+
+@command("remote.configure")
+async def cmd_remote_configure(env, args):
+    """-name <type.id> -dir <path> : register a storage backend for
+    remote mounts.  The config persists in the filer KV (the reference
+    stores remote.conf in filer_etc) so the FILER process can lazy-load
+    it for read-through — shells and filers are separate processes."""
+    import json
+
+    flags = parse_flags(args)
+    name = flags.get("name", "local.default")
+    cfg = {name: {"type": "local", "dir": flags["dir"]}}
+    backend_mod.configure(cfg)
+    filer = await env.find_filer()
+    await env.filer_stub(filer).KvPut(
+        filer_pb2.KvPutRequest(
+            key=f"remote.conf/{name}".encode(),
+            value=json.dumps(cfg).encode(),
+        )
+    )
+    env.write(f"configured backend {name} -> {flags['dir']}")
+
+
+def _backend(remote: str):
+    """'type.id/prefix' -> (storage, prefix)."""
+    name, _, prefix = remote.partition("/")
+    btype, _, bid = name.partition(".")
+    return backend_mod.get_backend(btype, bid or "default"), prefix
+
+
+async def _ensure_dir(stub, path: str) -> None:
+    parts = [p for p in path.strip("/").split("/") if p]
+    cur = ""
+    for p in parts:
+        parent = cur or "/"
+        cur = f"{cur}/{p}"
+        await stub.CreateEntry(
+            filer_pb2.CreateEntryRequest(
+                directory=parent,
+                entry=filer_pb2.Entry(
+                    name=p, is_directory=True,
+                    attributes=filer_pb2.FuseAttributes(
+                        file_mode=0o770, mtime=int(time.time()),
+                    ),
+                ),
+            )
+        )
+
+
+@command("remote.mount")
+async def cmd_remote_mount(env, args):
+    """-dir /path -remote <type.id>/<prefix> : mirror the remote store's
+    objects into a filer directory (metadata only; reads stream through)"""
+    env.confirm_is_locked()
+    flags = parse_flags(args)
+    mount_dir = flags["dir"].rstrip("/")
+    storage, prefix = _backend(flags["remote"])
+    filer = await env.find_filer()
+    stub = env.filer_stub(filer)
+    await _ensure_dir(stub, mount_dir)
+    n = 0
+    norm = prefix.strip("/")
+    for key, size in storage.list_keys(norm):
+        # require a path-separator boundary: prefix "photos" must not
+        # swallow "photoshoot/x"
+        if norm and not (key == norm or key.startswith(norm + "/")):
+            continue
+        rel = key[len(norm):].strip("/") if norm else key
+        if not rel:
+            continue
+        d = mount_dir
+        if "/" in rel:
+            sub, _, name = rel.rpartition("/")
+            d = f"{mount_dir}/{sub}"
+            await _ensure_dir(stub, d)
+        else:
+            name = rel
+        await stub.CreateEntry(
+            filer_pb2.CreateEntryRequest(
+                directory=d,
+                entry=filer_pb2.Entry(
+                    name=name,
+                    attributes=filer_pb2.FuseAttributes(
+                        file_mode=0o644, mtime=int(time.time()),
+                        crtime=int(time.time()), file_size=size,
+                    ),
+                    extended={
+                        "remote.backend": storage.name.encode(),
+                        "remote.key": key.encode(),
+                    },
+                ),
+            )
+        )
+        n += 1
+    env.write(f"mounted {flags['remote']} at {mount_dir} ({n} objects)")
+
+
+async def _walk_remote_entries(env, stub, directory: str):
+    from ..filer.client import list_all_entries
+
+    for e in await list_all_entries(stub, directory):
+        path = f"{directory}/{e.name}"
+        if e.is_directory:
+            async for sub in _walk_remote_entries(env, stub, path):
+                yield sub
+        elif e.extended.get("remote.key"):
+            yield directory, e
+
+
+@command("remote.cache")
+async def cmd_remote_cache(env, args):
+    """-dir /path : materialize remote objects as local chunks so reads
+    stop paying the remote round trip (command_remote_cache.go)"""
+    env.confirm_is_locked()
+    flags = parse_flags(args)
+    mount_dir = flags["dir"].rstrip("/")
+    filer = await env.find_filer()
+    stub = env.filer_stub(filer)
+    import aiohttp
+
+    from ..pb import server_address
+
+    http = server_address.http_address(filer)
+    n = 0
+    async with aiohttp.ClientSession() as session:
+        async for directory, e in _walk_remote_entries(env, stub, mount_dir):
+            if e.chunks or e.content:
+                continue  # already cached (small files inline as content)
+            storage, _ = _backend(e.extended["remote.backend"].decode())
+            key = e.extended["remote.key"].decode()
+            total = storage.size(key)
+
+            async def pieces(storage=storage, key=key, total=total):
+                import asyncio as _a
+
+                pos = 0
+                while pos < total:
+                    n_ = min(1 << 20, total - pos)
+                    yield await _a.to_thread(storage.pread, key, n_, pos)
+                    pos += n_
+
+            path = f"{directory}/{e.name}"
+            async with session.put(f"http://{http}{path}", data=pieces()) as r:
+                if r.status >= 300:
+                    env.write(f"cache {path}: HTTP {r.status}")
+                    continue
+            # the PUT replaced the entry; restore the remote markers
+            resp = await stub.LookupDirectoryEntry(
+                filer_pb2.LookupDirectoryEntryRequest(
+                    directory=directory, name=e.name
+                )
+            )
+            ne = filer_pb2.Entry()
+            ne.CopyFrom(resp.entry)
+            ne.extended["remote.backend"] = e.extended["remote.backend"]
+            ne.extended["remote.key"] = e.extended["remote.key"]
+            await stub.UpdateEntry(
+                filer_pb2.UpdateEntryRequest(directory=directory, entry=ne)
+            )
+            n += 1
+    env.write(f"cached {n} objects under {mount_dir}")
+
+
+@command("remote.uncache")
+async def cmd_remote_uncache(env, args):
+    """-dir /path : drop cached chunks, keeping remote-only entries
+    (command_remote_uncache.go)"""
+    env.confirm_is_locked()
+    flags = parse_flags(args)
+    mount_dir = flags["dir"].rstrip("/")
+    filer = await env.find_filer()
+    stub = env.filer_stub(filer)
+    n = 0
+    async for directory, e in _walk_remote_entries(env, stub, mount_dir):
+        if not (e.chunks or e.content):
+            continue
+        # delete-with-data then recreate the marker: the filer's delete
+        # path GCs the chunk fids
+        await stub.DeleteEntry(
+            filer_pb2.DeleteEntryRequest(
+                directory=directory, name=e.name, is_delete_data=True,
+            )
+        )
+        ne = filer_pb2.Entry(
+            name=e.name,
+            attributes=e.attributes,
+            extended={
+                "remote.backend": e.extended["remote.backend"],
+                "remote.key": e.extended["remote.key"],
+            },
+        )
+        await stub.CreateEntry(
+            filer_pb2.CreateEntryRequest(directory=directory, entry=ne)
+        )
+        n += 1
+    env.write(f"uncached {n} objects under {mount_dir}")
+
+
+@command("remote.unmount")
+async def cmd_remote_unmount(env, args):
+    """-dir /path : remove the mounted mirror (remote objects untouched)"""
+    env.confirm_is_locked()
+    flags = parse_flags(args)
+    mount_dir = flags["dir"].rstrip("/")
+    filer = await env.find_filer()
+    stub = env.filer_stub(filer)
+    d, _, name = mount_dir.rpartition("/")
+    await stub.DeleteEntry(
+        filer_pb2.DeleteEntryRequest(
+            directory=d or "/", name=name, is_delete_data=True,
+            is_recursive=True, ignore_recursive_error=True,
+        )
+    )
+    env.write(f"unmounted {mount_dir}")

@@ -43,6 +43,11 @@ class BackendStorage:
     def size(self, key: str) -> int:
         raise NotImplementedError
 
+    def list_keys(self, prefix: str = "") -> list[tuple[str, int]]:
+        """[(key, size)] under a prefix — the remote-mount listing surface
+        (remote_storage.go ListDirectory)."""
+        raise NotImplementedError
+
 
 class LocalBackendStorage(BackendStorage):
     """Directory-rooted object store ("local" type) — the in-image stand-in
@@ -56,13 +61,18 @@ class LocalBackendStorage(BackendStorage):
         os.makedirs(root_dir, exist_ok=True)
 
     def _path(self, key: str) -> str:
-        return os.path.join(self.root, key.replace("/", "_"))
+        p = os.path.normpath(os.path.join(self.root, key.lstrip("/")))
+        if not p.startswith(self.root + os.sep) and p != self.root:
+            raise ValueError(f"key escapes the store root: {key!r}")
+        return p
 
     def upload(self, local_path: str, key: str) -> int:
-        tmp = self._path(key) + ".tmp"
+        dst = self._path(key)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        tmp = dst + ".tmp"
         shutil.copyfile(local_path, tmp)
-        os.replace(tmp, self._path(key))
-        return os.path.getsize(self._path(key))
+        os.replace(tmp, dst)
+        return os.path.getsize(dst)
 
     def download(self, key: str, local_path: str) -> None:
         tmp = local_path + ".tmp"
@@ -81,6 +91,20 @@ class LocalBackendStorage(BackendStorage):
 
     def size(self, key: str) -> int:
         return os.path.getsize(self._path(key))
+
+    def list_keys(self, prefix: str = "") -> list[tuple[str, int]]:
+        out = []
+        prefix = prefix.lstrip("/")
+        for dirpath, _, files in os.walk(self.root):
+            for name in files:
+                if name.endswith(".tmp"):
+                    continue
+                full = os.path.join(dirpath, name)
+                key = os.path.relpath(full, self.root)
+                if prefix and not key.startswith(prefix):
+                    continue
+                out.append((key, os.path.getsize(full)))
+        return sorted(out)
 
 
 _BACKEND_TYPES = {"local": LocalBackendStorage}

@@ -27,12 +27,18 @@ def test_master_tracks_filer_membership(tmp_path):
         await cluster.start()
         try:
             # the filer's MasterClient registers through KeepConnected
+            from seaweedfs_tpu.pb import server_address
+
             async def filers():
                 resp = await cluster.master.ListClusterNodes(
                     master_pb2.ListClusterNodesRequest(client_type="filer"),
                     None,
                 )
-                return [n.address for n in resp.cluster_nodes]
+                # filers advertise host:port[.grpc]; compare the http part
+                return [
+                    server_address.http_address(n.address)
+                    for n in resp.cluster_nodes
+                ]
 
             deadline = asyncio.get_event_loop().time() + 10
             while asyncio.get_event_loop().time() < deadline:

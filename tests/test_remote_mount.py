@@ -1,0 +1,110 @@
+"""Remote storage mounts: mirror an external object store into the filer
+namespace, stream reads through the backend, cache to local chunks,
+uncache back to remote-only.
+
+Reference: weed/shell/command_remote_mount.go/_cache.go/_uncache.go +
+weed/remote_storage.
+"""
+import asyncio
+import io
+import os
+
+import aiohttp
+import pytest
+
+from seaweedfs_tpu.server.cluster import LocalCluster
+from seaweedfs_tpu.shell import CommandEnv, run_command
+from seaweedfs_tpu.storage import backend as backend_mod
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_remote_mount_cache_uncache(tmp_path):
+    # fabricate the "external" object store
+    ext = tmp_path / "external"
+    (ext / "photos").mkdir(parents=True)
+    objects = {
+        "photos/a.jpg": os.urandom(50_000),
+        "photos/deep/b.bin": os.urandom(120_000),
+        "top.txt": b"hello remote world",
+    }
+    for key, data in objects.items():
+        p = ext / key
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_bytes(data)
+
+    async def go():
+        cluster = LocalCluster(
+            base_dir=str(tmp_path / "c"), n_volume_servers=1, with_filer=True
+        )
+        await cluster.start()
+        try:
+            env = CommandEnv(
+                [cluster.master.advertise_url], out=io.StringIO()
+            )
+            await run_command(env, "lock")
+            # remote.configure needs a registered filer; registration is
+            # asynchronous after cluster.start()
+            deadline = asyncio.get_event_loop().time() + 10
+            while asyncio.get_event_loop().time() < deadline:
+                try:
+                    await env.find_filer()
+                    break
+                except RuntimeError:
+                    await asyncio.sleep(0.1)
+            await run_command(
+                env, f"remote.configure -name local.ext -dir {ext}"
+            )
+            await run_command(env, "remote.mount -dir /mnt/ext -remote local.ext/")
+            assert "3 objects" in env.out.getvalue()
+
+            base = f"http://{cluster.filer.url}"
+
+            async def get(path):
+                async with aiohttp.ClientSession() as s:
+                    async with s.get(base + path) as r:
+                        return r.status, await r.read()
+
+            # reads stream through the backend (no chunks yet)
+            st, body = await get("/mnt/ext/top.txt")
+            assert st == 200 and body == objects["top.txt"]
+            st, body = await get("/mnt/ext/photos/deep/b.bin")
+            assert st == 200 and body == objects["photos/deep/b.bin"]
+            e = cluster.filer.filer.find_entry("/mnt/ext/photos/a.jpg")
+            assert not e.chunks and e.extended["remote.key"] == b"photos/a.jpg"
+
+            # range read through the remote
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    base + "/mnt/ext/photos/a.jpg",
+                    headers={"Range": "bytes=1000-1999"},
+                ) as r:
+                    assert r.status == 206
+                    assert await r.read() == objects["photos/a.jpg"][1000:2000]
+
+            # cache: entries gain chunks; contents identical
+            await run_command(env, "remote.cache -dir /mnt/ext")
+            assert "cached 3 objects" in env.out.getvalue()
+            e = cluster.filer.filer.find_entry("/mnt/ext/photos/a.jpg")
+            assert e.chunks and e.extended.get("remote.key") == b"photos/a.jpg"
+            st, body = await get("/mnt/ext/photos/a.jpg")
+            assert st == 200 and body == objects["photos/a.jpg"]
+
+            # uncache: chunks dropped, remote read-through again
+            await run_command(env, "remote.uncache -dir /mnt/ext")
+            e = cluster.filer.filer.find_entry("/mnt/ext/photos/a.jpg")
+            assert not e.chunks
+            st, body = await get("/mnt/ext/photos/a.jpg")
+            assert st == 200 and body == objects["photos/a.jpg"]
+
+            # unmount removes the mirror; the external store is untouched
+            await run_command(env, "remote.unmount -dir /mnt/ext")
+            st, _ = await get("/mnt/ext/top.txt")
+            assert st == 404
+            assert (ext / "top.txt").read_bytes() == objects["top.txt"]
+        finally:
+            await cluster.stop()
+
+    run(go())
