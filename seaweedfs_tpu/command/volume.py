@@ -50,6 +50,18 @@ def add_args(p) -> None:
         help="needle map kind: memory (CompactMap) or sqlite (persistent, "
         "O(1) RAM per volume — the reference's leveldb index)",
     )
+    p.add_argument(
+        "-fileSizeLimitMB", dest="client_max_size_mb", type=int, default=256,
+        help="reject uploads larger than this",
+    )
+    p.add_argument(
+        "-concurrentUploadLimitMB", dest="concurrent_upload_limit_mb",
+        type=int, default=0, help="total in-flight upload bytes (0 = off)",
+    )
+    p.add_argument(
+        "-concurrentDownloadLimitMB", dest="concurrent_download_limit_mb",
+        type=int, default=0, help="total in-flight download bytes (0 = off)",
+    )
 
 
 async def run(args) -> None:
@@ -79,6 +91,9 @@ async def run(args) -> None:
             else None
         ),
         index_kind=args.index_kind,
+        client_max_size_mb=args.client_max_size_mb,
+        concurrent_upload_limit_mb=args.concurrent_upload_limit_mb,
+        concurrent_download_limit_mb=args.concurrent_download_limit_mb,
     )
     await vs.start()
     await asyncio.Event().wait()

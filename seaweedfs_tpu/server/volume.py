@@ -54,6 +54,68 @@ log = logging.getLogger("volume")
 _EC_LOCATION_TTL = 10.0  # seconds; reference refreshes at 11s (store_ec.go:254)
 
 
+class ByteLimiter:
+    """Bound total in-flight bytes (the reference's inFlightUploadData /
+    inFlightDownloadData cond-var throttles, volume_server.go:23-53).
+    Admission is FIFO so an oversize request (> limit, which runs alone)
+    can't be starved by a stream of small ones.  limit<=0 disables."""
+
+    def __init__(self, limit_bytes: int, timeout: float = 30.0):
+        self.limit = limit_bytes
+        self.timeout = timeout
+        self.in_flight = 0
+        self._cond = asyncio.Condition()
+        from collections import deque
+
+        self._queue: deque = deque()
+
+    def __call__(self, n: int) -> "_ByteLease":
+        return _ByteLease(self, n)
+
+
+class _ByteLease:
+    def __init__(self, limiter: ByteLimiter, n: int):
+        self.limiter = limiter
+        self.n = n
+
+    async def __aenter__(self):
+        lim = self.limiter
+        if lim.limit <= 0:
+            return self
+        ticket = object()
+        async with lim._cond:
+            lim._queue.append(ticket)
+
+            def my_turn():
+                return lim._queue[0] is ticket and (
+                    lim.in_flight + self.n <= lim.limit
+                    or lim.in_flight == 0  # oversize requests run alone
+                )
+
+            try:
+                await asyncio.wait_for(
+                    lim._cond.wait_for(my_turn), lim.timeout
+                )
+            except asyncio.TimeoutError:
+                lim._queue.remove(ticket)
+                lim._cond.notify_all()
+                raise web.HTTPTooManyRequests(
+                    text="too many in-flight bytes; retry later"
+                )
+            lim._queue.popleft()
+            lim.in_flight += self.n
+            lim._cond.notify_all()  # the next ticket may also fit
+        return self
+
+    async def __aexit__(self, *exc):
+        lim = self.limiter
+        if lim.limit <= 0:
+            return
+        async with lim._cond:
+            lim.in_flight -= self.n
+            lim._cond.notify_all()
+
+
 class VolumeServer:
     def __init__(
         self,
@@ -72,6 +134,9 @@ class VolumeServer:
         jwt_signing_key: str = "",
         tier_backends: dict | None = None,  # storage/backend.py configure()
         index_kind: str = "memory",  # memory | sqlite (ref -index flag)
+        client_max_size_mb: int = 256,
+        concurrent_upload_limit_mb: int = 0,  # 0 = unlimited
+        concurrent_download_limit_mb: int = 0,
     ):
         if tier_backends:
             from ..storage import backend as backend_mod
@@ -104,6 +169,9 @@ class VolumeServer:
         self.read_mode = read_mode
         self.jwt_signing_key = jwt_signing_key
         self.current_master = masters[0] if masters else ""
+        self.client_max_size_mb = client_max_size_mb
+        self.upload_limiter = ByteLimiter(concurrent_upload_limit_mb << 20)
+        self.download_limiter = ByteLimiter(concurrent_download_limit_mb << 20)
         self._pending_compacts: dict[int, tuple[str, str, int, str | None]] = {}
         self._ec_locations: dict[int, tuple[float, dict[int, list[str]]]] = {}
         self._grpc_server: grpc.aio.Server | None = None
@@ -131,7 +199,9 @@ class VolumeServer:
         )
         await self._grpc_server.start()
 
-        app = web.Application(client_max_size=256 * 1024 * 1024)
+        app = web.Application(
+            client_max_size=self.client_max_size_mb * 1024 * 1024
+        )
         app.router.add_get("/status", self.h_status)
         app.router.add_get("/metrics", stats.metrics_handler)
         if os.environ.get("SWFS_DEBUG") == "1":
@@ -323,20 +393,37 @@ class VolumeServer:
         ev = self.store.find_ec_volume(vid) if v is None else None
         if v is None and ev is None:
             return await self._read_remote(request, vid)
-        try:
-            if v is not None:
-                n = await asyncio.to_thread(self.store.read_needle, vid, nid, cookie)
-            else:
-                n = await asyncio.to_thread(
-                    self.store.read_ec_needle, vid, nid, cookie, self._remote_shard_reader(vid)
+        # lease BEFORE the disk read so the throttle bounds memory; the
+        # index knows the size up front for normal volumes (EC locates
+        # during the read itself — those lease 0 and stay unthrottled)
+        size_hint = 0
+        if v is not None:
+            loc = v.nm.get(nid)
+            size_hint = loc[1] if loc else 0
+        async with self.download_limiter(size_hint):
+            try:
+                if v is not None:
+                    n = await asyncio.to_thread(
+                        self.store.read_needle, vid, nid, cookie
+                    )
+                else:
+                    n = await asyncio.to_thread(
+                        self.store.read_ec_needle, vid, nid, cookie,
+                        self._remote_shard_reader(vid),
+                    )
+            except (NotFoundError, KeyError):
+                raise web.HTTPNotFound()
+            except CookieMismatch:
+                raise web.HTTPForbidden()
+            except CrcError:
+                raise web.HTTPInternalServerError(
+                    text="data corruption: CRC mismatch"
                 )
-        except (NotFoundError, KeyError):
-            raise web.HTTPNotFound()
-        except CookieMismatch:
-            raise web.HTTPForbidden()
-        except CrcError:
-            raise web.HTTPInternalServerError(text="data corruption: CRC mismatch")
+            return await self._respond_needle(request, n)
 
+    async def _respond_needle(
+        self, request: web.Request, n: Needle
+    ) -> web.StreamResponse:
         headers = {"Etag": f'"{n.etag}"', "Accept-Ranges": "bytes"}
         if n.last_modified:
             headers["Last-Modified"] = time.strftime(
@@ -433,30 +520,53 @@ class VolumeServer:
         if not self.store.has_volume(vid):
             raise web.HTTPNotFound(text=f"volume {vid} not local")
 
-        body = await request.read()
-        name, mime, data, compressed = self._parse_upload(
-            request.headers.get("Content-Type", ""), body
-        )
-        from ..storage.needle import FLAG_IS_COMPRESSED
+        # lease BEFORE buffering the body, or the throttle bounds nothing;
+        # chunked uploads (no Content-Length) pass a 0 lease
+        async with self.upload_limiter(request.content_length or 0):
+            body = await request.read()
+            name, mime, data, compressed = self._parse_upload(
+                request.headers.get("Content-Type", ""), body
+            )
+            from ..storage.needle import FLAG_IS_COMPRESSED
 
-        n = Needle(
-            id=nid,
-            cookie=cookie,
-            data=data,
-            name=name,
-            mime=mime,
-            last_modified=int(time.time()),
-            flags=FLAG_IS_COMPRESSED if compressed else 0,
-        )
-        is_replicate = request.query.get("type") == "replicate"
-        try:
-            size = await asyncio.to_thread(self.store.write_needle, vid, n)
-        except VolumeReadOnly:
-            raise web.HTTPConflict(text=f"volume {vid} is read-only")
-        if not is_replicate:
-            err = await self._replicate(request, vid, body_override=body)
-            if err:
-                raise web.HTTPInternalServerError(text=f"replication failed: {err}")
+            n = Needle(
+                id=nid,
+                cookie=cookie,
+                data=data,
+                name=name,
+                mime=mime,
+                last_modified=int(time.time()),
+                flags=FLAG_IS_COMPRESSED if compressed else 0,
+            )
+            is_replicate = request.query.get("type") == "replicate"
+            v = self.store.find_volume(vid)
+            existed = v is not None and v.has(nid)
+            try:
+                size = await asyncio.to_thread(self.store.write_needle, vid, n)
+            except VolumeReadOnly:
+                raise web.HTTPConflict(text=f"volume {vid} is read-only")
+            if not is_replicate:
+                err, acked = await self._replicate(
+                    request, vid, body_override=body
+                )
+                if err:
+                    # un-commit so replicas can't diverge silently
+                    # (store_replicate.go deletes on fan-out failure):
+                    # tombstone the fresh needle locally AND on peers that
+                    # acked — but only for CREATES; rolling back an
+                    # overwrite would destroy the prior durable version,
+                    # so overwrite divergence is left to fix.replication
+                    if not existed:
+                        try:
+                            await asyncio.to_thread(
+                                self.store.delete_needle, vid, nid, cookie
+                            )
+                        except Exception:  # noqa: BLE001 — best effort
+                            log.exception("rollback of %d,%x failed", vid, nid)
+                        await self._rollback_acked(request, acked)
+                    raise web.HTTPInternalServerError(
+                        text=f"replication failed: {err}"
+                    )
         return web.json_response({"name": name.decode() or "", "size": size, "eTag": n.etag})
 
     @staticmethod
@@ -489,22 +599,24 @@ class VolumeServer:
 
     async def _replicate(
         self, request: web.Request, vid: int, body_override
-    ) -> str | None:
+    ) -> tuple[str | None, list[str]]:
         """Fan the original request out to every replica
-        (DistributedOperation store_replicate.go:60)."""
+        (DistributedOperation store_replicate.go:60).  Returns
+        (error_summary_or_None, peers_that_acked)."""
         v = self.store.find_volume(vid)
         if v is None or v.super_block.replica_placement.copy_count <= 1:
-            return None
+            return None, []
         locations = await self._lookup_volume_locations(vid)
         peers = [u for u in locations if u != self.url]
         if not peers:
-            return "no replica locations known"
+            return "no replica locations known", []
         import aiohttp
 
         body = body_override if body_override is not None else await request.read()
         sep = "&" if request.query_string else ""
         qs = f"?{request.query_string}{sep}type=replicate"
         errors = []
+        acked: list[str] = []
 
         headers = {"Content-Type": request.headers.get("Content-Type", "")}
         if request.headers.get("Authorization"):
@@ -522,11 +634,35 @@ class VolumeServer:
                     ) as r:
                         if r.status >= 300:
                             errors.append(f"{peer}: HTTP {r.status}")
+                        else:
+                            acked.append(peer)
             except Exception as e:
                 errors.append(f"{peer}: {e}")
 
         await asyncio.gather(*(one(p) for p in peers))
-        return "; ".join(errors) if errors else None
+        return ("; ".join(errors) if errors else None), acked
+
+    async def _rollback_acked(
+        self, request: web.Request, acked: list[str]
+    ) -> None:
+        """Best-effort delete of the fresh needle on replicas that took
+        the failed fan-out's write."""
+        if not acked:
+            return
+        import aiohttp
+
+        headers = {}
+        if request.headers.get("Authorization"):
+            headers["Authorization"] = request.headers["Authorization"]
+        async with aiohttp.ClientSession() as s:
+            for peer in acked:
+                try:
+                    await s.delete(
+                        f"http://{peer}{request.path}?type=replicate",
+                        headers=headers,
+                    )
+                except Exception:  # noqa: BLE001
+                    log.warning("rollback delete on %s failed", peer)
 
     async def h_delete(self, request: web.Request) -> web.Response:
         try:

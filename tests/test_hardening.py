@@ -1,0 +1,135 @@
+"""Data-plane hardening: in-flight byte throttles and replicated-write
+rollback (reference volume_server.go:23-53 cond-var throttles,
+store_replicate.go delete-on-failure).
+"""
+import asyncio
+
+import aiohttp
+import pytest
+
+from seaweedfs_tpu.server.cluster import LocalCluster
+from seaweedfs_tpu.server.volume import ByteLimiter
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_byte_limiter_serializes_and_allows_oversize():
+    async def go():
+        lim = ByteLimiter(1000, timeout=5.0)
+        order = []
+
+        async def job(name, n, hold):
+            async with lim(n):
+                order.append(("start", name))
+                await asyncio.sleep(hold)
+                order.append(("end", name))
+
+        # two 700-byte jobs can't overlap under a 1000-byte cap
+        await asyncio.gather(job("a", 700, 0.2), job("b", 700, 0.05))
+        a_end = order.index(("end", "a")) if ("end", "a") in order else -1
+        starts = [o for o in order if o[0] == "start"]
+        assert len(starts) == 2
+        first, second = starts[0][1], starts[1][1]
+        assert order.index(("end", first)) < order.index(("start", second))
+
+        # an oversize request still runs (alone)
+        async with lim(5000):
+            assert lim.in_flight == 5000
+        assert lim.in_flight == 0
+
+        # unlimited limiter is a no-op
+        lim0 = ByteLimiter(0)
+        async with lim0(1 << 30):
+            pass
+
+    run(go())
+
+
+def test_byte_limiter_fifo_no_oversize_starvation():
+    """A queued oversize request must not be starved by later small
+    requests — admission is FIFO."""
+
+    async def go():
+        lim = ByteLimiter(100, timeout=5.0)
+        done = []
+
+        async def job(name, n):
+            async with lim(n):
+                await asyncio.sleep(0.05)
+                done.append(name)
+
+        first = asyncio.create_task(job("small-0", 60))
+        await asyncio.sleep(0.01)
+        big = asyncio.create_task(job("BIG", 500))  # oversize, queued next
+        await asyncio.sleep(0.01)
+        smalls = [
+            asyncio.create_task(job(f"small-{i}", 30)) for i in range(1, 6)
+        ]
+        await asyncio.gather(first, big, *smalls)
+        assert done.index("BIG") == 1, done  # right after the head job
+
+    run(go())
+
+
+def test_byte_limiter_timeout():
+    async def go():
+        lim = ByteLimiter(100, timeout=0.2)
+
+        async def hog():
+            async with lim(100):
+                await asyncio.sleep(1.0)
+
+        from aiohttp import web
+
+        task = asyncio.create_task(hog())
+        await asyncio.sleep(0.05)
+        with pytest.raises(web.HTTPTooManyRequests):
+            async with lim(50):
+                pass
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+
+    run(go())
+
+
+def test_replicated_write_rolls_back_on_partial_failure(tmp_path):
+    """With a replica down, the primary must not keep the needle after the
+    fan-out fails — replicas can never diverge silently."""
+
+    async def go():
+        cluster = LocalCluster(
+            base_dir=str(tmp_path), n_volume_servers=2, pulse_seconds=1
+        )
+        await cluster.start()
+        try:
+            from seaweedfs_tpu.operation import assign
+
+            a = await assign(
+                cluster.master.advertise_url, replication="001"
+            )
+            primary_url = a.url
+            replica = next(
+                vs for vs in cluster.volume_servers if vs.url != primary_url
+            )
+            # hard-stop the replica so the fan-out must fail
+            await replica.stop()
+
+            async with aiohttp.ClientSession() as s:
+                form = aiohttp.FormData()
+                form.add_field("file", b"must roll back", filename="f.bin")
+                async with s.post(
+                    f"http://{primary_url}/{a.fid}", data=form
+                ) as r:
+                    assert r.status == 500, await r.text()
+                # the local write was rolled back: the needle is gone
+                async with s.get(f"http://{primary_url}/{a.fid}") as r:
+                    assert r.status == 404, "rollback must remove the needle"
+        finally:
+            await cluster.stop()
+
+    run(go())
