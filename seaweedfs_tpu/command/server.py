@@ -79,17 +79,18 @@ async def run(args) -> None:
         fs = filer_cmd.build_filer_server(fargs)
         await fs.start()
         if args.s3:
-            from .s3 import build_s3_server
+            from . import s3 as s3_cmd
 
-            s3 = build_s3_server(
-                Namespace(
-                    filer=f"{args.ip}:{fs.port}",
-                    filer_grpc=f"{fs.ip}:{fs.grpc_port}",
-                    ip=args.ip,
-                    port=args.s3_port,
-                    s3_config=args.s3_config,
-                )
-            )
+            # same derive-from-parser discipline as the filer block above
+            sparser = argparse.ArgumentParser()
+            s3_cmd.add_args(sparser)
+            sargs = sparser.parse_args([])
+            sargs.filer = f"{args.ip}:{fs.port}"
+            sargs.filer_grpc = f"{fs.ip}:{fs.grpc_port}"
+            sargs.ip = args.ip
+            sargs.port = args.s3_port
+            sargs.s3_config = args.s3_config
+            s3 = s3_cmd.build_s3_server(sargs)
             await s3.start()
 
     await asyncio.Event().wait()

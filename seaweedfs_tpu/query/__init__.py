@@ -1,0 +1,3 @@
+from .sql import QueryError, parse_select, run_select
+
+__all__ = ["QueryError", "parse_select", "run_select"]

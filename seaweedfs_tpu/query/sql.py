@@ -1,0 +1,217 @@
+"""Minimal SQL engine for S3 Select-style queries over CSV / JSON lines.
+
+Reference: weed/query/ (experimental SELECT support backing the s3
+SelectObjectContent surface).  Grammar (case-insensitive keywords):
+
+    SELECT <*|col[, col...]> FROM S3Object [alias]
+        [WHERE <predicate> [AND <predicate>...]] [LIMIT n]
+
+Columns: bare names (CSV header / JSON keys), `_N` positional (CSV),
+or alias-qualified (`s.name`, `s._2`).  Predicates: = != <> < <= > >=
+against string or numeric literals (numeric comparison when both sides
+parse as numbers).  Aggregates: COUNT(*).
+"""
+from __future__ import annotations
+
+import csv
+import io
+import json
+import re
+
+
+class QueryError(ValueError):
+    pass
+
+
+_SELECT_RE = re.compile(
+    r"^\s*select\s+(?P<cols>.+?)\s+from\s+s3object(?:\s+(?:as\s+)?(?P<alias>[a-z_]\w*))?"
+    r"(?:\s+where\s+(?P<where>.+?))?(?:\s+limit\s+(?P<limit>\d+))?\s*;?\s*$",
+    re.IGNORECASE | re.DOTALL,
+)
+_PRED_RE = re.compile(
+    r"^\s*(?P<col>[\w.]+)\s*(?P<op><=|>=|!=|<>|=|<|>)\s*(?P<val>'[^']*'|\"[^\"]*\"|\S+)\s*$"
+)
+
+
+def parse_select(expression: str) -> dict:
+    m = _SELECT_RE.match(expression)
+    if not m:
+        raise QueryError(f"unsupported expression: {expression!r}")
+    alias = m.group("alias") or ""
+    cols = [c.strip() for c in m.group("cols").split(",")]
+    preds = []
+    if m.group("where"):
+        for part in _split_and(m.group("where")):
+            pm = _PRED_RE.match(part)
+            if not pm:
+                raise QueryError(f"unsupported predicate: {part!r}")
+            val = pm.group("val")
+            if val[:1] in "'\"":
+                val = val[1:-1]
+            preds.append((_strip_alias(pm.group("col"), alias), pm.group("op"), val))
+    return {
+        "columns": [_strip_alias(c, alias) for c in cols],
+        "predicates": preds,
+        "limit": int(m.group("limit")) if m.group("limit") else None,
+    }
+
+
+def _split_and(clause: str) -> list[str]:
+    """Split on AND outside quoted literals ('war and peace' stays one
+    token)."""
+    parts, buf, quote = [], [], ""
+    i, n = 0, len(clause)
+    while i < n:
+        ch = clause[i]
+        if quote:
+            buf.append(ch)
+            if ch == quote:
+                quote = ""
+            i += 1
+            continue
+        if ch in "'\"":
+            quote = ch
+            buf.append(ch)
+            i += 1
+            continue
+        if (
+            clause[i:i + 3].lower() == "and"
+            and (i == 0 or clause[i - 1].isspace())
+            and (i + 3 >= n or clause[i + 3].isspace())
+        ):
+            parts.append("".join(buf))
+            buf = []
+            i += 3
+            continue
+        buf.append(ch)
+        i += 1
+    parts.append("".join(buf))
+    return [p for p in (x.strip() for x in parts) if p]
+
+
+def _strip_alias(col: str, alias: str) -> str:
+    if alias and col.lower().startswith(alias.lower() + "."):
+        return col[len(alias) + 1:]
+    return col
+
+
+def _compare(lhs: str, op: str, rhs: str) -> bool:
+    try:
+        a, b = float(lhs), float(rhs)
+    except (TypeError, ValueError):
+        a, b = lhs, rhs
+    if op == "=":
+        return a == b
+    if op in ("!=", "<>"):
+        return a != b
+    if op == "<":
+        return a < b
+    if op == "<=":
+        return a <= b
+    if op == ">":
+        return a > b
+    return a >= b
+
+
+def _rows_csv(data: bytes, header_mode: str):
+    """header_mode: "none" | "use" (skip + name columns) | "ignore"
+    (skip, positional only — AWS FileHeaderInfo semantics).  Yields
+    (record_dict, star_values)."""
+    text = data.decode("utf-8", errors="replace")
+    reader = csv.reader(io.StringIO(text))
+    header: list[str] | None = None
+    skipped = header_mode == "none"
+    for row in reader:
+        if not row:
+            continue
+        if not skipped:
+            skipped = True
+            if header_mode == "use":
+                header = row
+            continue
+        rec = {f"_{j + 1}": v for j, v in enumerate(row)}
+        if header:
+            rec.update({h: v for h, v in zip(header, row)})
+        yield rec, list(row)
+
+
+def _rows_json(data: bytes):
+    for line in data.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            raise QueryError("malformed JSON record")
+        if isinstance(obj, dict):
+            rec = {k: _scalar(v) for k, v in obj.items()}
+            yield rec, list(rec.values())
+
+
+def _scalar(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if v is None:
+        return ""
+    if isinstance(v, (dict, list)):
+        return json.dumps(v)
+    return str(v)
+
+
+def run_select(
+    expression: str,
+    data: bytes,
+    input_format: str = "csv",  # csv | json
+    csv_header: str | bool = "use",  # none | use | ignore
+    output_format: str = "csv",  # csv | json
+) -> bytes:
+    """Run the query; returns the serialized result records."""
+    if isinstance(csv_header, bool):  # tolerate the boolean spelling
+        csv_header = "use" if csv_header else "none"
+    q = parse_select(expression)
+    rows = (
+        _rows_csv(data, csv_header)
+        if input_format == "csv"
+        else _rows_json(data)
+    )
+
+    is_count = len(q["columns"]) == 1 and re.fullmatch(
+        r"count\(\s*\*\s*\)", q["columns"][0], re.IGNORECASE
+    )
+    out = io.StringIO()
+    writer = csv.writer(out, lineterminator="\n") if output_format == "csv" else None
+    n = 0
+    for rec, star in rows:
+        ok = True
+        for col, op, val in q["predicates"]:
+            if col not in rec or not _compare(rec[col], op, val):
+                ok = False
+                break
+        if not ok:
+            continue
+        n += 1
+        if is_count:
+            continue
+        if q["columns"] == ["*"]:
+            # the raw row, once — never the positional+named union
+            values = {f"_{j + 1}": v for j, v in enumerate(star)}
+            if input_format == "json":
+                values = rec
+        else:
+            missing = [c for c in q["columns"] if c not in rec]
+            if missing:
+                raise QueryError(f"unknown column(s): {missing}")
+            values = {c: rec[c] for c in q["columns"]}
+        if output_format == "csv":
+            writer.writerow(list(values.values()))
+        else:
+            out.write(json.dumps(values) + "\n")
+        if q["limit"] is not None and n >= q["limit"]:
+            break
+    if is_count:
+        if output_format == "csv":
+            writer.writerow([n])
+        else:
+            out.write(json.dumps({"_1": n}) + "\n")
+    return out.getvalue().encode()

@@ -1,0 +1,136 @@
+"""S3 SelectObjectContent: SQL over one object, AWS event-stream reply.
+
+Reference: the s3 surface of weed/query (experimental SELECT).  The
+response rides AWS's binary event-stream framing — prelude (total len,
+headers len, prelude CRC32), typed headers, payload, message CRC32 —
+with Records / Stats / End events, which is what real S3 SDK clients
+parse.
+"""
+from __future__ import annotations
+
+import struct
+import xml.etree.ElementTree as ET
+import zlib
+
+from ..query import QueryError, run_select
+
+_HDR_STRING = 7
+
+
+def _headers(pairs: dict[str, str]) -> bytes:
+    out = bytearray()
+    for name, value in pairs.items():
+        nb, vb = name.encode(), value.encode()
+        out += bytes([len(nb)]) + nb + bytes([_HDR_STRING])
+        out += struct.pack(">H", len(vb)) + vb
+    return bytes(out)
+
+
+def event_stream_message(headers: dict[str, str], payload: bytes) -> bytes:
+    hdr = _headers(headers)
+    total = 12 + len(hdr) + len(payload) + 4
+    prelude = struct.pack(">II", total, len(hdr))
+    prelude += struct.pack(">I", zlib.crc32(prelude))
+    body = prelude + hdr + payload
+    return body + struct.pack(">I", zlib.crc32(body))
+
+
+def records_event(payload: bytes) -> bytes:
+    return event_stream_message(
+        {
+            ":message-type": "event",
+            ":event-type": "Records",
+            ":content-type": "application/octet-stream",
+        },
+        payload,
+    )
+
+
+def stats_event(scanned: int, processed: int, returned: int) -> bytes:
+    xml = (
+        f"<Stats><BytesScanned>{scanned}</BytesScanned>"
+        f"<BytesProcessed>{processed}</BytesProcessed>"
+        f"<BytesReturned>{returned}</BytesReturned></Stats>"
+    ).encode()
+    return event_stream_message(
+        {
+            ":message-type": "event",
+            ":event-type": "Stats",
+            ":content-type": "text/xml",
+        },
+        xml,
+    )
+
+
+def end_event() -> bytes:
+    return event_stream_message(
+        {":message-type": "event", ":event-type": "End"}, b""
+    )
+
+
+def parse_event_stream(blob: bytes):
+    """Inverse of the framing (used by tests and debugging clients):
+    yields (headers, payload)."""
+    pos = 0
+    while pos + 16 <= len(blob):
+        total, hlen = struct.unpack_from(">II", blob, pos)
+        headers = {}
+        hpos = pos + 12
+        hend = hpos + hlen
+        while hpos < hend:
+            nlen = blob[hpos]
+            name = blob[hpos + 1: hpos + 1 + nlen].decode()
+            hpos += 1 + nlen + 1  # skip type byte (always string here)
+            (vlen,) = struct.unpack_from(">H", blob, hpos)
+            headers[name] = blob[hpos + 2: hpos + 2 + vlen].decode()
+            hpos += 2 + vlen
+        payload = blob[hend: pos + total - 4]
+        yield headers, payload
+        pos += total
+
+
+def parse_select_request(body: bytes) -> dict:
+    """SelectObjectContentRequest XML -> query options."""
+    try:
+        root = ET.fromstring(body)
+    except ET.ParseError:
+        raise QueryError("malformed SelectObjectContentRequest")
+
+    def find(path: str):
+        el = root.find(path)
+        if el is None:  # retry namespace-agnostic
+            for e in root.iter():
+                if e.tag.split("}")[-1] == path.split("/")[-1]:
+                    return e
+        return el
+
+    expr_el = find("Expression")
+    if expr_el is None or not (expr_el.text or "").strip():
+        raise QueryError("missing Expression")
+    opts = {
+        "expression": expr_el.text.strip(),
+        "input_format": "csv",
+        "csv_header": "none",
+        "output_format": "csv",
+    }
+    inp = find("InputSerialization")
+    if inp is not None:
+        for c in inp:
+            ctag = c.tag.split("}")[-1]
+            if ctag == "JSON":
+                opts["input_format"] = "json"
+            elif ctag == "CSV":
+                fh = next(
+                    (x for x in c if x.tag.split("}")[-1] == "FileHeaderInfo"),
+                    None,
+                )
+                if fh is not None:
+                    mode = (fh.text or "").strip().upper()
+                    if mode in ("USE", "IGNORE", "NONE"):
+                        opts["csv_header"] = mode.lower()
+    out = find("OutputSerialization")
+    if out is not None:
+        for c in out:
+            if c.tag.split("}")[-1] == "JSON":
+                opts["output_format"] = "json"
+    return opts

@@ -223,6 +223,11 @@ class S3ApiServer:
                     return await self.delete_multiple_objects(bucket, request)
                 raise S3Error("MethodNotAllowed", "bad request", 405)
             # object-level
+            if m == "POST" and "select" in q:
+                # SelectObjectContent is a READ in AWS's permission model
+                if not allowed(ACTION_READ):
+                    raise S3Error("AccessDenied", "access denied", 403)
+                return await self.select_object_content(bucket, key, request)
             write_like = m in ("PUT", "POST", "DELETE")
             if not allowed(ACTION_WRITE if write_like else ACTION_READ):
                 raise S3Error("AccessDenied", "access denied", 403)
@@ -437,6 +442,55 @@ class S3ApiServer:
         except grpc.aio.AioRpcError:
             raise S3Error(*ERR_NO_SUCH_KEY)
         return resp.entry
+
+    async def select_object_content(
+        self, bucket: str, key: str, request: web.Request
+    ) -> web.Response:
+        """SQL over one object with the AWS event-stream reply
+        (s3api/select.py; reference weed/query)."""
+        from ..query import QueryError, run_select
+        from .select import (
+            end_event,
+            parse_select_request,
+            records_event,
+            stats_event,
+        )
+
+        body = await self._body(request)
+        if not isinstance(body, bytes):
+            body = await request.read()
+        entry = await self._get_entry(bucket, key)
+        if entry.is_directory:
+            raise S3Error(*ERR_NO_SUCH_KEY)
+        async with self._session.get(self._object_url(bucket, key)) as r:
+            if r.status == 404:
+                raise S3Error(*ERR_NO_SUCH_KEY)
+            if r.status >= 300:
+                # a data-plane failure must not be scanned as object data
+                raise S3Error(
+                    "InternalError", f"object read failed: HTTP {r.status}", 500
+                )
+            data = await r.read()
+        try:
+            opts = parse_select_request(body)
+            result = await asyncio.to_thread(
+                run_select,
+                opts["expression"],
+                data,
+                opts["input_format"],
+                opts["csv_header"],
+                opts["output_format"],
+            )
+        except QueryError as e:
+            raise S3Error("InvalidRequest", str(e), 400)
+        stream = b""
+        if result:
+            stream += records_event(result)
+        stream += stats_event(len(data), len(data), len(result))
+        stream += end_event()
+        return web.Response(
+            body=stream, content_type="application/octet-stream"
+        )
 
     async def get_object(self, bucket: str, key: str, request: web.Request) -> web.StreamResponse:
         entry = await self._get_entry(bucket, key)
