@@ -11,8 +11,11 @@ import sys
 
 from . import (
     benchmark,
+    compact,
+    download,
     filer,
     filer_sync,
+    fix,
     iam,
     master,
     mq_broker,
@@ -22,6 +25,7 @@ from . import (
     shell,
     s3,
     version,
+    upload,
     volume,
     webdav,
 )
@@ -30,7 +34,7 @@ COMMANDS = {
     m.NAME: m
     for m in (
         master, volume, filer, filer_sync, s3, iam, webdav, mount, mq_broker,
-        server, shell,
+        server, shell, fix, compact, upload, download,
         benchmark, scaffold, version,
     )
 }

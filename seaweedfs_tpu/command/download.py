@@ -1,0 +1,46 @@
+"""`download` — fetch files by fid
+(reference: weed/command/download.go)."""
+from __future__ import annotations
+
+import os
+
+NAME = "download"
+HELP = "download files by fid via master lookup"
+
+
+def add_args(p) -> None:
+    p.add_argument("fids", nargs="+", help="file ids (vid,key...)")
+    p.add_argument(
+        "-master", dest="master", default="127.0.0.1:9333", help="master host:port"
+    )
+    p.add_argument("-dir", default=".", help="output directory")
+
+
+async def run(args) -> None:
+    import aiohttp
+
+    from ..operation import lookup_file_id
+
+    os.makedirs(args.dir, exist_ok=True)
+    async with aiohttp.ClientSession() as s:
+        for fid in args.fids:
+            urls = await lookup_file_id(args.master, fid)
+            if not urls:
+                raise SystemExit(f"{fid}: no locations")
+            data = None
+            last = None
+            for url in urls:
+                try:
+                    async with s.get(url) as r:
+                        if r.status < 300:
+                            data = await r.read()
+                            break
+                        last = f"HTTP {r.status}"
+                except aiohttp.ClientError as e:
+                    last = str(e)
+            if data is None:
+                raise SystemExit(f"{fid}: all replicas failed ({last})")
+            out = os.path.join(args.dir, fid.replace(",", "_"))
+            with open(out, "wb") as f:
+                f.write(data)
+            print(f"{fid} -> {out} ({len(data)} bytes)")
