@@ -13,9 +13,11 @@ from . import (
     benchmark,
     compact,
     download,
+    export,
     filer,
     filer_sync,
     fix,
+    fsck,
     iam,
     master,
     mq_broker,
@@ -34,7 +36,7 @@ COMMANDS = {
     m.NAME: m
     for m in (
         master, volume, filer, filer_sync, s3, iam, webdav, mount, mq_broker,
-        server, shell, fix, compact, upload, download,
+        server, shell, fix, fsck, compact, export, upload, download,
         benchmark, scaffold, version,
     )
 }

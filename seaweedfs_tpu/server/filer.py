@@ -75,6 +75,7 @@ class FilerServer:
         chunk_cache_mb: int = 64,
         chunk_cache_dir: str | None = None,
         notifier=None,  # replication.notification.Notifier
+        upload_parallelism: int = 4,  # concurrent chunk uploads per file
     ):
         self.masters = masters
         self.ip = ip
@@ -90,6 +91,7 @@ class FilerServer:
         self.metrics_port = metrics_port
         self.cipher = cipher
         self.compress_chunks = compress_chunks
+        self.upload_parallelism = max(1, upload_parallelism)
         from ..filer.chunk_cache import ChunkCache
 
         self.chunk_cache = ChunkCache(
@@ -537,40 +539,96 @@ class FilerServer:
                 pass
 
         md5 = hashlib.md5()
-        chunks: list[filer_pb2.FileChunk] = []
         small_content = b""
         offset = 0
         buf = bytearray()
         eof = False
-        while not eof:
-            while len(buf) < chunk_size and not eof:
-                piece = await reader.read(min(chunk_size - len(buf), 1 << 20))
-                if not piece:
-                    eof = True
-                else:
-                    buf.extend(piece)
-            data = bytes(buf)
-            buf.clear()
-            if not data and offset > 0:
-                break
-            md5.update(data)
-            if (
-                eof
-                and offset == 0
-                and len(data) <= self.save_inside_limit
-                and not is_append
-            ):
-                small_content = data
-                offset = len(data)
-                break
-            if not data:  # empty file: an entry with no chunks
-                break
-            chunk = await self._upload_chunk(
-                data, offset, filename or path.rsplit("/", 1)[-1],
-                collection, replication, ttl_str, mime=content_type,
+        # chunk uploads run in a bounded parallel window — the volume
+        # servers take them concurrently, so a big file's wall clock is
+        # ~window× better than the strictly sequential loop (the
+        # reference uploads chunks via a worker pool the same way)
+        tasks: list[asyncio.Task] = []
+        upload_name = filename or path.rsplit("/", 1)[-1]
+
+        def launch(data: bytes, off: int) -> None:
+            tasks.append(
+                asyncio.create_task(
+                    self._upload_chunk(
+                        data, off, upload_name,
+                        collection, replication, ttl_str, mime=content_type,
+                    )
+                )
             )
-            chunks.append(chunk)
-            offset += len(data)
+
+        async def abort_uploads() -> None:
+            """Cancel in-flight chunk tasks and GC whatever landed."""
+            for t_ in tasks:
+                if not t_.done():
+                    t_.cancel()
+            results = await asyncio.gather(*tasks, return_exceptions=True)
+            fids = [
+                r.file_id for r in results
+                if isinstance(r, filer_pb2.FileChunk)
+            ]
+            if fids:
+                await self._delete_file_ids(fids)
+
+        try:
+            while not eof:
+                while len(buf) < chunk_size and not eof:
+                    piece = await reader.read(min(chunk_size - len(buf), 1 << 20))
+                    if not piece:
+                        eof = True
+                    else:
+                        buf.extend(piece)
+                data = bytes(buf)
+                buf.clear()
+                if not data and offset > 0:
+                    break
+                md5.update(data)
+                if (
+                    eof
+                    and offset == 0
+                    and len(data) <= self.save_inside_limit
+                    and not is_append
+                ):
+                    small_content = data
+                    offset = len(data)
+                    break
+                if not data:  # empty file: an entry with no chunks
+                    break
+                launch(data, offset)
+                offset += len(data)
+                # bound read-ahead: at most `upload_parallelism` chunk
+                # buffers in flight (wait only on PENDING tasks — done
+                # ones would make FIRST_COMPLETED a hot spin)
+                while True:
+                    pending = [t_ for t_ in tasks if not t_.done()]
+                    if len(pending) < self.upload_parallelism:
+                        break
+                    await asyncio.wait(
+                        pending, return_when=asyncio.FIRST_COMPLETED
+                    )
+                # a failed chunk aborts the upload NOW, not after the
+                # remaining gigabytes have been read and uploaded
+                failed = next(
+                    (
+                        t_ for t_ in tasks
+                        if t_.done() and not t_.cancelled() and t_.exception()
+                    ),
+                    None,
+                )
+                if failed is not None:
+                    raise failed.exception()
+
+            results = await asyncio.gather(*tasks)
+        except asyncio.CancelledError:
+            await abort_uploads()
+            raise
+        except Exception as e:  # noqa: BLE001 — client abort, chunk failure
+            await abort_uploads()
+            raise web.HTTPInternalServerError(text=f"chunk upload failed: {e}")
+        chunks = list(results)
 
         if is_append:
             entry = await self.filer.append_chunks(path, chunks)

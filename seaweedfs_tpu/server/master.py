@@ -456,7 +456,7 @@ class MasterServer:
         except ValueError as e:
             return master_pb2.AssignResponse(error=str(e))
         count = int(request.count) or 1
-        for attempt in range(3):
+        for attempt in range(4):
             try:
                 fid, n, nodes = self.topo.pick_for_write(count, option)
                 await self._replicate_seq_ceiling()
@@ -471,8 +471,11 @@ class MasterServer:
                 )
             except LookupError:
                 grown = await self._grow_now(option)
-                if not grown:
-                    break
+                if not grown and attempt < 3:
+                    # a concurrent assign may be growing this layout right
+                    # now (_grow_now dedups by key) — give it a beat and
+                    # retry the pick instead of failing the burst
+                    await asyncio.sleep(0.25)
         return master_pb2.AssignResponse(error="no writable volumes and growth failed")
 
     async def _maybe_proxy(self, name: str, request, context):

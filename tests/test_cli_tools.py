@@ -70,6 +70,50 @@ def test_compact_reclaims_space(tmp_path, capsys):
     v2.close()
 
 
+def test_export_tar(tmp_path, capsys):
+    import tarfile
+
+    v = Volume(str(tmp_path), 11)
+    v.write(1, 0xAA, b"alpha contents", name=b"alpha.txt")
+    v.write(2, 0xAA, b"beta contents")
+    v.delete(1, 0xAA)
+    v.close()
+    out = tmp_path / "vol.tar"
+    asyncio.run(run_cmd(
+        "export",
+        ["-dir", str(tmp_path), "-volumeId", "11", "-o", str(out)],
+    ))
+    assert "exported 1 needles" in capsys.readouterr().out
+    with tarfile.open(out) as tar:
+        names = tar.getnames()
+        assert names == ["2_aa/b_2"]  # fid-unique dir / {vid:x}_{nid:x} fallback name
+        payload = tar.extractfile(names[0]).read()
+        assert payload == b"beta contents"
+
+
+def test_fsck_detects_corruption(tmp_path, capsys):
+    v = Volume(str(tmp_path), 13)
+    for i in range(1, 6):
+        v.write(i, 0xCC, os.urandom(800))
+    v.close()
+    asyncio.run(run_cmd("fsck", ["-dir", str(tmp_path), "-volumeId", "13"]))
+    assert "OK, 5 needles" in capsys.readouterr().out
+
+    # corrupt one indexed record header
+    import seaweedfs_tpu.storage.idx as idxm
+
+    with open(v.idx_path, "rb") as f:
+        entries = f.read()
+    # swap the first entry's needle id for a bogus one
+    bad = bytearray(entries)
+    bad[0:8] = (0xDEAD).to_bytes(8, "big")
+    with open(v.idx_path, "wb") as f:
+        f.write(bytes(bad))
+    with pytest.raises(SystemExit):
+        asyncio.run(run_cmd("fsck", ["-dir", str(tmp_path), "-volumeId", "13"]))
+    assert "CORRUPT" in capsys.readouterr().out
+
+
 def test_upload_download_roundtrip(tmp_path, capsys):
     async def go():
         cluster = LocalCluster(base_dir=str(tmp_path / "c"), n_volume_servers=1)
