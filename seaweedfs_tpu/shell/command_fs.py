@@ -1,0 +1,209 @@
+"""fs.* commands: filer namespace operations from the admin shell.
+
+Reference: weed/shell/command_fs_ls.go, _cat.go, _du.go, _rm.go,
+_mkdir.go, _mv.go — the shell resolves a filer via the master's cluster
+registry and drives its gRPC surface.
+"""
+from __future__ import annotations
+
+import time
+
+from ..filer.client import list_all_entries
+from ..pb import filer_pb2
+from .commands import command
+
+
+def _split(path: str) -> tuple[str, str]:
+    path = "/" + path.strip("/")
+    d, _, name = path.rpartition("/")
+    return d or "/", name
+
+
+async def _stub(env):
+    return env.filer_stub(await env.find_filer())
+
+
+async def _lookup(stub, path: str):
+    import grpc
+
+    d, name = _split(path)
+    try:
+        resp = await stub.LookupDirectoryEntry(
+            filer_pb2.LookupDirectoryEntryRequest(directory=d, name=name)
+        )
+    except grpc.aio.AioRpcError as e:
+        if e.code() == grpc.StatusCode.NOT_FOUND:
+            return None
+        raise
+    return resp.entry if resp.HasField("entry") else None
+
+
+def _fmt_size(n: int) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if n < 1024 or unit == "TB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+
+
+def _entry_size(e: filer_pb2.Entry) -> int:
+    extent = max((c.offset + int(c.size) for c in e.chunks), default=0)
+    return max(e.attributes.file_size, extent, len(e.content))
+
+
+def _positional(args: list[str]) -> list[str]:
+    return [a for a in args if not a.startswith("-")]
+
+
+@command("fs.ls")
+async def cmd_fs_ls(env, args):
+    """[-l] /dir : list a filer directory"""
+    long_form = "-l" in args
+    pos = _positional(args)
+    path = "/" + (pos[0].strip("/") if pos else "")
+    stub = await _stub(env)
+    for e in await list_all_entries(stub, path or "/"):
+        if long_form:
+            a = e.attributes
+            kind = "d" if e.is_directory else "-"
+            env.write(
+                f"{kind}{a.file_mode & 0o777:03o} "
+                f"{_fmt_size(_entry_size(e)):>10} "
+                f"{time.strftime('%Y-%m-%d %H:%M', time.localtime(a.mtime or 0))} "
+                f"{e.name}{'/' if e.is_directory else ''}"
+            )
+        else:
+            env.write(e.name + ("/" if e.is_directory else ""))
+
+
+@command("fs.cat")
+async def cmd_fs_cat(env, args):
+    """/path/to/file : print a filer file's contents"""
+    pos = _positional(args)
+    if not pos:
+        env.write("usage: fs.cat /path")
+        return
+    path = "/" + pos[0].strip("/")
+    import urllib.parse
+
+    import aiohttp
+
+    from ..pb import server_address
+
+    filer = await env.find_filer()
+    async with aiohttp.ClientSession() as s:
+        async with s.get(
+            f"http://{server_address.http_address(filer)}"
+            f"{urllib.parse.quote(path)}"
+        ) as r:
+            if r.status >= 300:
+                env.write(f"fs.cat {path}: HTTP {r.status}")
+                return
+            env.write((await r.read()).decode(errors="replace"))
+
+
+@command("fs.du")
+async def cmd_fs_du(env, args):
+    """/dir : disk usage of a filer subtree"""
+    pos = _positional(args)
+    path = "/" + (pos[0].strip("/") if pos else "")
+    stub = await _stub(env)
+
+    async def walk(d: str) -> tuple[int, int, int]:
+        files = dirs = size = 0
+        for e in await list_all_entries(stub, d):
+            if e.is_directory:
+                f2, d2, s2 = await walk(f"{d.rstrip('/')}/{e.name}")
+                files += f2
+                dirs += d2 + 1
+                size += s2
+            else:
+                files += 1
+                size += _entry_size(e)
+        return files, dirs, size
+
+    files, dirs, size = await walk(path or "/")
+    env.write(
+        f"{path or '/'}: {_fmt_size(size)} in {files} files, {dirs} dirs"
+    )
+
+
+@command("fs.mkdir")
+async def cmd_fs_mkdir(env, args):
+    """/dir/path : create a filer directory (and parents)"""
+    pos = _positional(args)
+    if not pos:
+        env.write("usage: fs.mkdir /dir")
+        return
+    path = "/" + pos[0].strip("/")
+    stub = await _stub(env)
+    existing = await _lookup(stub, path)
+    if existing is not None:
+        if existing.is_directory:
+            env.write(f"{path} already exists")
+        else:
+            env.write(f"fs.mkdir {path}: a file is in the way")
+        return
+    # one leaf create: the filer auto-creates parents and refuses to
+    # thread a directory through an existing file
+    d, name = _split(path)
+    resp = await stub.CreateEntry(
+        filer_pb2.CreateEntryRequest(
+            directory=d,
+            entry=filer_pb2.Entry(
+                name=name, is_directory=True,
+                attributes=filer_pb2.FuseAttributes(
+                    file_mode=0o770, mtime=int(time.time()),
+                ),
+            ),
+        )
+    )
+    if resp.error:
+        env.write(f"fs.mkdir {path}: {resp.error}")
+    else:
+        env.write(f"created {path}")
+
+
+@command("fs.rm")
+async def cmd_fs_rm(env, args):
+    """[-r] /path : delete a filer file or (with -r) directory tree"""
+    recursive = "-r" in args
+    pos = _positional(args)
+    if not pos:
+        env.write("usage: fs.rm [-r] /path")
+        return
+    path = "/" + pos[0].strip("/")
+    d, name = _split(path)
+    stub = await _stub(env)
+    if await _lookup(stub, path) is None:
+        env.write(f"fs.rm {path}: no such file or directory")
+        return
+    resp = await stub.DeleteEntry(
+        filer_pb2.DeleteEntryRequest(
+            directory=d, name=name, is_delete_data=True,
+            is_recursive=recursive, ignore_recursive_error=False,
+        )
+    )
+    if resp.error:
+        env.write(f"fs.rm {path}: {resp.error}")
+    else:
+        env.write(f"deleted {path}")
+
+
+@command("fs.mv")
+async def cmd_fs_mv(env, args):
+    """/src /dst : move/rename within the filer"""
+    parts = _positional(args)
+    if len(parts) != 2:
+        env.write("usage: fs.mv /src /dst")
+        return
+    src, dst = ("/" + p.strip("/") for p in parts)
+    sd, sn = _split(src)
+    dd, dn = _split(dst)
+    stub = await _stub(env)
+    await stub.AtomicRenameEntry(
+        filer_pb2.AtomicRenameEntryRequest(
+            old_directory=sd, old_name=sn,
+            new_directory=dd, new_name=dn,
+        )
+    )
+    env.write(f"moved {src} -> {dst}")

@@ -1,0 +1,79 @@
+"""fs.* shell commands against a live cluster (reference:
+weed/shell/command_fs_*.go)."""
+import asyncio
+import io
+
+import aiohttp
+import pytest
+
+from seaweedfs_tpu.server.cluster import LocalCluster
+from seaweedfs_tpu.shell import CommandEnv, run_command
+
+
+def test_fs_commands(tmp_path):
+    async def go():
+        cluster = LocalCluster(
+            base_dir=str(tmp_path), n_volume_servers=1, with_filer=True
+        )
+        await cluster.start()
+        try:
+            env = CommandEnv([cluster.master.advertise_url], out=io.StringIO())
+            # wait for the filer to register
+            deadline = asyncio.get_event_loop().time() + 10
+            while True:
+                try:
+                    await env.find_filer()
+                    break
+                except RuntimeError:
+                    if asyncio.get_event_loop().time() > deadline:
+                        pytest.fail("filer never registered with the master")
+                    await asyncio.sleep(0.1)
+
+            base = f"http://{cluster.filer.url}"
+            async with aiohttp.ClientSession() as s:
+                await s.put(base + "/docs/a.txt", data=b"alpha file")
+                await s.put(base + "/docs/sub/b.bin", data=b"x" * 2048)
+
+            await run_command(env, "fs.ls /docs")
+            out = env.out.getvalue()
+            assert "a.txt" in out and "sub/" in out
+
+            await run_command(env, "fs.ls -l /docs")
+            assert "2.0KB" in env.out.getvalue() or "10B" in env.out.getvalue()
+
+            await run_command(env, "fs.cat /docs/a.txt")
+            assert "alpha file" in env.out.getvalue()
+
+            await run_command(env, "fs.du /docs")
+            assert "2 files, 1 dirs" in env.out.getvalue()
+
+            await run_command(env, "fs.mkdir /new/deep/dir")
+            # mkdir must refuse to pave over a file
+            await run_command(env, "fs.mkdir /docs/sub/b.bin")
+            assert "a file is in the way" in env.out.getvalue()
+            await run_command(env, "fs.cat /docs/sub/b.bin")  # data intact
+            # rm of a missing path says so
+            await run_command(env, "fs.rm /no/such/thing")
+            assert "no such file" in env.out.getvalue()
+            await run_command(env, "fs.ls /new/deep")
+            assert "dir/" in env.out.getvalue()
+
+            await run_command(env, "fs.mv /docs/a.txt /new/renamed.txt")
+            await run_command(env, "fs.cat /new/renamed.txt")
+            assert env.out.getvalue().count("alpha file") == 2
+
+            await run_command(env, "fs.rm /new/renamed.txt")
+            async with aiohttp.ClientSession() as s:
+                async with s.get(base + "/new/renamed.txt") as r:
+                    assert r.status == 404
+            # non-recursive rm of a non-empty dir fails cleanly
+            await run_command(env, "fs.rm /docs")
+            assert "fs.rm /docs:" in env.out.getvalue()
+            await run_command(env, "fs.rm -r /docs")
+            async with aiohttp.ClientSession() as s:
+                async with s.get(base + "/docs/sub/b.bin") as r:
+                    assert r.status == 404
+        finally:
+            await cluster.stop()
+
+    asyncio.run(go())
