@@ -50,8 +50,34 @@ def _entry_size(e: filer_pb2.Entry) -> int:
     return max(e.attributes.file_size, extent, len(e.content))
 
 
-def _positional(args: list[str]) -> list[str]:
-    return [a for a in args if not a.startswith("-")]
+async def _walk_entries(stub, directory: str):
+    """DFS over a filer subtree; yields (dir, entry) with parents before
+    children (shared by fs.du and fs.meta.save)."""
+    for e in await list_all_entries(stub, directory):
+        yield directory, e
+        if e.is_directory:
+            async for pair in _walk_entries(
+                stub, f"{directory.rstrip('/')}/{e.name}"
+            ):
+                yield pair
+
+
+def _positional(args: list[str], value_flags: set[str] = frozenset()) -> list[str]:
+    """Non-flag tokens; tokens consumed as a value flag's argument (e.g.
+    `-o FILE`) are excluded."""
+    out = []
+    skip = False
+    for i, a in enumerate(args):
+        if skip:
+            skip = False
+            continue
+        if a.startswith("-"):
+            name = a.lstrip("-").partition("=")[0]
+            if name in value_flags and "=" not in a and i + 1 < len(args):
+                skip = True
+            continue
+        out.append(a)
+    return out
 
 
 @command("fs.ls")
@@ -107,21 +133,13 @@ async def cmd_fs_du(env, args):
     pos = _positional(args)
     path = "/" + (pos[0].strip("/") if pos else "")
     stub = await _stub(env)
-
-    async def walk(d: str) -> tuple[int, int, int]:
-        files = dirs = size = 0
-        for e in await list_all_entries(stub, d):
-            if e.is_directory:
-                f2, d2, s2 = await walk(f"{d.rstrip('/')}/{e.name}")
-                files += f2
-                dirs += d2 + 1
-                size += s2
-            else:
-                files += 1
-                size += _entry_size(e)
-        return files, dirs, size
-
-    files, dirs, size = await walk(path or "/")
+    files = dirs = size = 0
+    async for _, e in _walk_entries(stub, path or "/"):
+        if e.is_directory:
+            dirs += 1
+        else:
+            files += 1
+            size += _entry_size(e)
     env.write(
         f"{path or '/'}: {_fmt_size(size)} in {files} files, {dirs} dirs"
     )
@@ -207,3 +225,67 @@ async def cmd_fs_mv(env, args):
         )
     )
     env.write(f"moved {src} -> {dst}")
+
+
+@command("fs.meta.save")
+async def cmd_fs_meta_save(env, args):
+    """[-o file] [/dir] : dump the filer metadata tree as length-prefixed
+    FullEntry protos (command_fs_meta_save.go wire shape)"""
+    import struct
+
+    from .commands import parse_flags
+
+    flags = parse_flags(args)
+    pos = _positional(args, value_flags={"o"})
+    root = "/" + (pos[0].strip("/") if pos else "")
+    out_path = flags.get("o", "filer-meta.bin")
+    stub = await _stub(env)
+    n = 0
+    with open(out_path, "wb") as f:
+        async for d, e in _walk_entries(stub, root or "/"):
+            fe = filer_pb2.FullEntry(dir=d, entry=e)
+            blob = fe.SerializeToString()
+            # big-endian length prefix: byte-compatible with the
+            # reference's fs.meta.save files (util.Uint32toBytes)
+            f.write(struct.pack(">I", len(blob)) + blob)
+            n += 1
+    env.write(f"saved {n} entries from {root or '/'} to {out_path}")
+
+
+@command("fs.meta.load")
+async def cmd_fs_meta_load(env, args):
+    """-i file : restore filer metadata saved by fs.meta.save (entries
+    only — chunk data must still exist in the cluster)"""
+    import struct
+
+    from .commands import parse_flags
+
+    flags = parse_flags(args)
+    pos = _positional(args, value_flags={"i"})
+    in_path = flags.get("i") or (pos[0] if pos else "")
+    if not in_path:
+        env.write("usage: fs.meta.load -i file")
+        return
+    stub = await _stub(env)
+    n = 0
+    with open(in_path, "rb") as f:
+        while True:
+            hdr = f.read(4)
+            if len(hdr) < 4:
+                break
+            (size,) = struct.unpack(">I", hdr)
+            blob = f.read(size)
+            if len(blob) < size:
+                env.write(
+                    f"warning: truncated backup — last record dropped"
+                )
+                break
+            fe = filer_pb2.FullEntry.FromString(blob)
+            resp = await stub.CreateEntry(
+                filer_pb2.CreateEntryRequest(directory=fe.dir, entry=fe.entry)
+            )
+            if resp.error:
+                env.write(f"{fe.dir}/{fe.entry.name}: {resp.error}")
+                continue
+            n += 1
+    env.write(f"restored {n} entries from {in_path}")

@@ -62,6 +62,24 @@ def test_fs_commands(tmp_path):
             await run_command(env, "fs.cat /new/renamed.txt")
             assert env.out.getvalue().count("alpha file") == 2
 
+            # metadata save -> metadata-only wipe -> load round trip
+            meta = str(tmp_path / "meta.bin")
+            await run_command(env, f"fs.meta.save -o {meta} /docs")
+            assert "saved" in env.out.getvalue()
+            from seaweedfs_tpu.pb import filer_pb2
+
+            stub = env.filer_stub(await env.find_filer())
+            await stub.DeleteEntry(
+                filer_pb2.DeleteEntryRequest(
+                    directory="/", name="docs", is_delete_data=False,
+                    is_recursive=True, ignore_recursive_error=True,
+                )
+            )
+            await run_command(env, f"fs.meta.load -i {meta}")
+            assert "restored" in env.out.getvalue()
+            await run_command(env, "fs.cat /docs/sub/b.bin")
+            assert "xxxx" in env.out.getvalue(), "chunks resolve after reload"
+
             await run_command(env, "fs.rm /new/renamed.txt")
             async with aiohttp.ClientSession() as s:
                 async with s.get(base + "/new/renamed.txt") as r:
