@@ -223,7 +223,50 @@ class VolumeServer:
 
         if heartbeat and self.masters:
             self._tasks.append(asyncio.create_task(self._heartbeat_forever()))
+        self._tasks.append(asyncio.create_task(self._ttl_sweep_forever()))
         log.info("volume server up http=%s grpc=%s", self.url, self.grpc_url)
+
+    async def _ttl_sweep_forever(self, interval: float = 60.0) -> None:
+        while not self._stopping:
+            await asyncio.sleep(interval)
+            try:
+                await asyncio.to_thread(self.sweep_expired_ttl_volumes)
+            except Exception:  # noqa: BLE001
+                log.exception("ttl sweep failed")
+
+    def sweep_expired_ttl_volumes(self, grace: float = 0.1) -> list[int]:
+        """Delete volumes whose TTL fully lapsed since their last write
+        (the reference expires whole TTL volumes the same way,
+        store_vacuum/volume ttl handling).  Returns deleted vids."""
+        deleted = []
+        now = time.time()
+        for loc in self.store.locations:
+            for vid, v in list(loc.volumes.items()):
+                ttl_min = v.super_block.ttl.minutes
+                if not ttl_min or v.is_tiered:
+                    # tiered guard must be is_tiered: keep_local tiering
+                    # leaves remote_dat None but still owns a remote copy
+                    continue
+                try:
+                    last_write = os.path.getmtime(v.dat_path)
+                except OSError:
+                    continue
+                if last_write + ttl_min * 60 * (1 + grace) >= now:
+                    continue
+                # close the write window before deleting: mark readonly
+                # (pushed to the master immediately so assigns stop), then
+                # re-check — a write that raced the first mtime read keeps
+                # the volume for its records' full TTL
+                try:
+                    self.store.mark_volume_readonly(vid, True)
+                except Exception:  # noqa: BLE001 — volume may be mid-delete
+                    continue
+                if os.path.getmtime(v.dat_path) != last_write:
+                    continue
+                log.info("ttl volume %d expired; deleting", vid)
+                self.store.delete_volume(vid)
+                deleted.append(vid)
+        return deleted
 
     async def stop(self) -> None:
         self._stopping = True
@@ -419,6 +462,20 @@ class VolumeServer:
                 raise web.HTTPInternalServerError(
                     text="data corruption: CRC mismatch"
                 )
+            except ValueError:
+                # the volume was destroyed under us (TTL sweep / admin
+                # delete closed the dat file mid-read)
+                raise web.HTTPNotFound(text="volume is gone")
+            # TTL'd needles expire at read time even before the volume
+            # sweep removes the whole volume (GetOrHeadHandler's ttl check)
+            if v is not None:
+                ttl_min = v.super_block.ttl.minutes
+                if (
+                    ttl_min
+                    and n.last_modified
+                    and n.last_modified + ttl_min * 60 < time.time()
+                ):
+                    raise web.HTTPNotFound(text="needle expired")
             return await self._respond_needle(request, n)
 
     async def _respond_needle(

@@ -1,0 +1,72 @@
+"""TTL enforcement: expired needles 404 at read time; fully-lapsed TTL
+volumes are swept away (reference: volume ttl handling in
+volume_server_handlers_read.go + ttl volume expiry).
+"""
+import asyncio
+import os
+import time
+
+import aiohttp
+import pytest
+
+from seaweedfs_tpu.server.cluster import LocalCluster
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.types import TTL
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_ttl_read_expiry_and_sweep(tmp_path):
+    async def go():
+        cluster = LocalCluster(
+            base_dir=str(tmp_path), n_volume_servers=1, pulse_seconds=1
+        )
+        await cluster.start()
+        vs = cluster.volume_servers[0]
+        try:
+            from seaweedfs_tpu.operation import assign, upload_data
+
+            master = cluster.master.advertise_url
+            a = await assign(master, ttl="1m")
+            vid = int(a.fid.split(",")[0])
+            await upload_data(f"http://{a.url}/{a.fid}", b"short-lived")
+            v = vs.store.find_volume(vid)
+            assert v.super_block.ttl.minutes == 1
+
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"http://{a.url}/{a.fid}") as r:
+                    assert r.status == 200, "fresh needle readable"
+
+            # age the needle: rewrite with a last_modified in the past
+            nid = int(a.fid.split(",")[1][:-8] or "0", 16)
+            cookie = int(a.fid.split(",")[1][-8:], 16)
+            v.read_only = False
+            old = Needle(
+                id=nid, cookie=cookie, data=b"short-lived",
+                last_modified=int(time.time()) - 120,
+            )
+            v.append_needle(old)
+            async with aiohttp.ClientSession() as s:
+                async with s.get(f"http://{a.url}/{a.fid}") as r:
+                    assert r.status == 404, "expired needle must 404"
+
+            # volume sweep: backdate the .dat mtime past the ttl
+            stale = time.time() - 600
+            os.utime(v.dat_path, (stale, stale))
+            deleted = vs.sweep_expired_ttl_volumes()
+            assert vid in deleted
+            assert vs.store.find_volume(vid) is None
+            assert not os.path.exists(v.dat_path)
+            # non-ttl volumes survive sweeps
+            a2 = await assign(master)
+            vid2 = int(a2.fid.split(",")[0])
+            v2 = vs.store.find_volume(vid2)
+            os.utime(v2.dat_path, (stale, stale))
+            assert vs.sweep_expired_ttl_volumes() == []
+            assert vs.store.find_volume(vid2) is not None
+        finally:
+            await cluster.stop()
+
+    run(go())
