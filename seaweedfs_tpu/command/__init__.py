@@ -10,6 +10,7 @@ import asyncio
 import sys
 
 from . import (
+    backup,
     benchmark,
     compact,
     download,
@@ -36,7 +37,7 @@ COMMANDS = {
     m.NAME: m
     for m in (
         master, volume, filer, filer_sync, s3, iam, webdav, mount, mq_broker,
-        server, shell, fix, fsck, compact, export, upload, download,
+        server, shell, fix, fsck, compact, export, backup, upload, download,
         benchmark, scaffold, version,
     )
 }

@@ -856,6 +856,10 @@ class VolumeServer:
             volume_size=info.size,
             file_count=info.file_count,
             file_deleted_count=info.delete_count,
+            compact_revision=v.super_block.compaction_revision,
+            version=v.version,
+            ttl=str(v.super_block.ttl),
+            replication=str(v.super_block.replica_placement),
         )
 
     async def DeleteCollection(self, request, context):
