@@ -1107,7 +1107,10 @@ class VolumeServer:
             )
         except (NotFoundError, KeyError):
             await context.abort(grpc.StatusCode.NOT_FOUND, "needle not found")
-        return volume_server_pb2.ReadNeedleBlobResponse(needle_blob=n.data)
+        return volume_server_pb2.ReadNeedleBlobResponse(
+            needle_blob=n.data, cookie=n.cookie,
+            last_modified=n.last_modified,
+        )
 
     # ------------------------------------------------------------------ gRPC: erasure coding
 

@@ -66,6 +66,7 @@ from . import command_cluster  # noqa: E402,F401
 from . import command_collection  # noqa: E402,F401
 from . import command_ec  # noqa: E402,F401
 from . import command_fs  # noqa: E402,F401
+from . import command_fsck  # noqa: E402,F401
 from . import command_lock  # noqa: E402,F401
 from . import command_remote  # noqa: E402,F401
 from . import command_volume  # noqa: E402,F401
