@@ -291,12 +291,74 @@ def bench_rig_bandwidths(mb=64):
     return disk / 1e6, h2d / 1e6
 
 
+def probe_tpu(timeout_sec: int = 900) -> str | None:
+    """Confirm the device backend can initialize before committing to it.
+    A killed TPU process can leave the axon session grant held, making
+    jax.devices() sleep-retry FOREVER — a subprocess probe with a
+    deadline turns that into a fast, honest failure instead of a hung
+    benchmark run.  Returns None if ok, else the error string."""
+    import subprocess
+
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        _, stderr = proc.communicate(timeout=timeout_sec)
+    except subprocess.TimeoutExpired:
+        # terminate GRACEFULLY first: a SIGKILLed device client can leave
+        # the session grant held — the exact state this probe detects
+        proc.terminate()
+        try:
+            proc.communicate(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.communicate()
+        return (
+            f"device init did not complete within {timeout_sec}s "
+            "(session grant held?)"
+        )
+    if proc.returncode != 0:
+        lines = [
+            l for l in (stderr or "").strip().splitlines()
+            if l.strip() and not l.startswith("WARNING")
+        ]
+        for line in reversed(lines):  # the raised error beats tracebacks
+            if "Error" in line or "UNAVAILABLE" in line:
+                return line.strip()[:300]
+        return (lines[-1].strip() if lines else "device init failed")[:300]
+    return None
+
+
 def main():
     require_native()
     from seaweedfs_tpu.ops import rs
 
     parity_m = rs.RSCodec().matrix[10:]
     cpu_bps = bench_cpu(parity_m)
+
+    err = probe_tpu()
+    if err is not None:
+        # record the honest state: the CPU baseline was measured, the
+        # device could not be — and exit non-zero so the failure is
+        # visible rather than masked by a strawman number
+        print(
+            json.dumps(
+                {
+                    "metric": "rs_10_4_encode",
+                    "value": 0,
+                    "unit": "GB/s",
+                    "vs_baseline": 0,
+                    # same top-level failure shape as the native-baseline
+                    # guard above: consumers check one schema
+                    "error": f"device unavailable: {err}",
+                    "extra": {"cpu_native_gbps": round(cpu_bps / 1e9, 3)},
+                }
+            )
+        )
+        sys.exit(1)
     dev_bps, kernel = bench_device_encode(parity_m)
     rebuild_bps = bench_device_rebuild()
     multi_bps = bench_multi_volume()
