@@ -843,7 +843,13 @@ class VolumeServer:
         v = self.store.find_volume(request.volume_id)
         if v is None:
             return volume_server_pb2.VolumeConfigureResponse(error="not found")
-        v.super_block.replica_placement = t.ReplicaPlacement.parse(request.replication)
+        try:
+            await asyncio.to_thread(
+                v.update_replica_placement,
+                t.ReplicaPlacement.parse(request.replication),
+            )
+        except (ValueError, VolumeReadOnly) as e:
+            return volume_server_pb2.VolumeConfigureResponse(error=str(e))
         return volume_server_pb2.VolumeConfigureResponse()
 
     async def VolumeStatus(self, request, context):

@@ -382,3 +382,37 @@ async def cmd_volume_tier_download(env, args):
             env.write(
                 f"volume {vid} @ {node.url}: downloaded {resp.processed} bytes"
             )
+
+
+@command("volume.configure.replication")
+async def cmd_volume_configure_replication(env, args):
+    """-volumeId N -replication XYZ : change a volume's replica placement
+    on every holder (command_volume_configure_replication.go); persists
+    into the on-disk superblock"""
+    env.confirm_is_locked()
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    replication = flags["replication"]
+    nodes, _ = await env.collect_topology()
+    holders = [n for n in nodes if any(v["id"] == vid for v in n.volumes)]
+    if not holders:
+        raise ValueError(f"volume {vid} not found in topology")
+    failures = []
+    for node in holders:
+        resp = await env.volume_stub(node.grpc_address).VolumeConfigure(
+            volume_server_pb2.VolumeConfigureRequest(
+                volume_id=vid, replication=replication
+            )
+        )
+        if resp.error:
+            env.write(f"{node.url}: {resp.error}")
+            failures.append(node.url)
+        else:
+            env.write(f"{node.url}: volume {vid} -> replication {replication}")
+    if failures:
+        # a partial application leaves replicas with divergent superblocks
+        # — that must fail loudly, not read as success
+        raise ValueError(
+            f"replication change failed on {', '.join(failures)}; "
+            f"replicas may now disagree"
+        )

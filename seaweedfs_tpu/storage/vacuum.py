@@ -89,6 +89,10 @@ def commit(
                         xf.write(
                             idx_mod.pack_entry(nid, 0, t.TOMBSTONE_FILE_SIZE)
                         )
+        # a configure may have changed the replica placement since the
+        # shadow superblock was snapshotted off-lock in compact(); the
+        # live in-memory value is authoritative and must survive the swap
+        live_rp = v.super_block.replica_placement
         v._idx.close()
         os.replace(cpd, v.dat_path)
         os.replace(cpx, v.idx_path)
@@ -100,6 +104,10 @@ def commit(
             os.replace(shadow_db, v.sdx_path)
         with open(v.dat_path, "rb") as f:
             v.super_block = SuperBlock.from_bytes(f.read(SUPER_BLOCK_SIZE))
+        if str(v.super_block.replica_placement) != str(live_rp):
+            v.super_block.replica_placement = live_rp
+            with open(v.dat_path, "r+b") as f:
+                f.write(v.super_block.to_bytes())
         # Publish the new (dat, nm) pair as one atomic reference swap; the
         # old dat file object is deliberately NOT closed here — lock-free
         # readers that captured the previous _ReadState keep preading the

@@ -431,6 +431,18 @@ class Volume:
             if include_deleted or t.size_is_valid(nsize):
                 yield offset, n
 
+    def update_replica_placement(self, rp: t.ReplicaPlacement) -> None:
+        """Persist a new replica placement into the on-disk superblock
+        (volume_super_block.go maybeWriteSuperBlock on configure)."""
+        with self._lock:
+            if self.is_tiered or self.remote_dat is not None:
+                raise VolumeReadOnly(f"volume {self.id} is tiered")
+            self.super_block.replica_placement = rp
+            os.pwrite(
+                self._dat.fileno(), self.super_block.to_bytes(), 0
+            )
+            self._dat.flush()
+
     def sync(self) -> None:
         with self._lock:
             if self.remote_dat is not None:

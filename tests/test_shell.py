@@ -217,3 +217,32 @@ def test_fix_replication_restores_lost_replica(tmp_path):
             await cluster.stop()
 
     run(go())
+
+
+def test_volume_configure_replication(tmp_path):
+    async def go():
+        cluster, env = await make(tmp_path, n=1)
+        try:
+            vid, _ = await fill_volume(cluster, n_blobs=2)
+            await sh(env, "lock")
+            await sh(
+                env,
+                f"volume.configure.replication -volumeId {vid} -replication 001",
+            )
+            assert "replication 001" in env.out.getvalue()
+            vs = cluster.volume_servers[0]
+            v = vs.store.find_volume(vid)
+            assert str(v.super_block.replica_placement) == "001"
+            # persisted: survives a reload from disk
+            from seaweedfs_tpu.storage.super_block import (
+                SUPER_BLOCK_SIZE,
+                SuperBlock,
+            )
+
+            with open(v.dat_path, "rb") as f:
+                sb = SuperBlock.from_bytes(f.read(SUPER_BLOCK_SIZE))
+            assert str(sb.replica_placement) == "001"
+        finally:
+            await cluster.stop()
+
+    run(go())
