@@ -18,6 +18,10 @@ def add_args(p) -> None:
         "-filer.grpc", dest="filer_grpc", default="",
         help="filer grpc host:port (default: filer port+10000)",
     )
+    p.add_argument(
+        "-master", dest="masters", default="",
+        help="comma-separated masters (registers the broker in cluster.ps)",
+    )
 
 
 async def run(args) -> None:
@@ -28,6 +32,7 @@ async def run(args) -> None:
         filer_grpc_address=args.filer_grpc,
         ip=args.ip,
         port=args.port,
+        masters=[m.strip() for m in args.masters.split(",") if m.strip()],
     )
     await broker.start()
     print(f"mq broker ready at {broker.grpc_url} (grpc)")

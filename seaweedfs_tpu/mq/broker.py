@@ -141,7 +141,10 @@ class MessageQueueBroker:
         filer_grpc_address: str = "",
         ip: str = "127.0.0.1",
         port: int = 17777,  # grpc
+        masters: list[str] | None = None,  # register as a broker in cluster.ps
     ):
+        self.masters = masters or []
+        self._master_client = None
         host, _, p = filer_address.partition(":")
         self.filer_address = filer_address
         self.filer_grpc_address = filer_grpc_address or f"{host}:{int(p) + 10000}"
@@ -180,9 +183,24 @@ class MessageQueueBroker:
         self.port = self._grpc_server.add_insecure_port(f"{self.ip}:{self.port}")
         await self._grpc_server.start()
         self._flusher = asyncio.create_task(self._flush_loop())
+        if self.masters:
+            # membership via KeepConnected, like filers (cluster.go)
+            from ..wdclient import MasterClient
+
+            # explicit host:port.grpc form: consumers resolve registry
+            # addresses with server_address.grpc_address(), and a broker
+            # has no HTTP port for the +10000 convention to hang off
+            self._master_client = MasterClient(
+                self.masters,
+                client_type="broker",
+                client_address=f"{self.ip}:{self.port}.{self.port}",
+            )
+            await self._master_client.start()
         log.info("mq broker up grpc=%s", self.grpc_url)
 
     async def stop(self) -> None:
+        if self._master_client is not None:
+            await self._master_client.stop()
         # stop accepting publishes BEFORE the final flush, or a message
         # acknowledged in the shutdown window would be lost
         if self._grpc_server:

@@ -16,7 +16,7 @@ def run(coro):
     return asyncio.run(coro)
 
 
-async def make(tmp_path):
+async def make(tmp_path, masters=None):
     cluster = LocalCluster(
         base_dir=str(tmp_path), n_volume_servers=1, with_filer=True
     )
@@ -25,6 +25,9 @@ async def make(tmp_path):
         filer_address=cluster.filer.url,
         filer_grpc_address=f"{cluster.filer.ip}:{cluster.filer.grpc_port}",
         port=0,
+        masters=(
+            [cluster.master.advertise_url] if masters == "cluster" else masters
+        ),
     )
     await broker.start()
     return cluster, broker
@@ -143,6 +146,39 @@ def test_mq_broker_restart_recovers_log(tmp_path):
             finally:
                 await broker2.stop()
         finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_broker_registers_with_master(tmp_path):
+    async def go():
+        cluster, broker = await make(tmp_path, masters="cluster")
+        try:
+            from seaweedfs_tpu.pb import master_pb2
+
+            from seaweedfs_tpu.pb import server_address
+
+            async def brokers():
+                resp = await cluster.master.ListClusterNodes(
+                    master_pb2.ListClusterNodesRequest(client_type="broker"),
+                    None,
+                )
+                # registry rows are host:port[.grpc]; dialable via
+                # grpc_address like every other registrant
+                return [
+                    server_address.grpc_address(n.address)
+                    for n in resp.cluster_nodes
+                ]
+
+            deadline = asyncio.get_event_loop().time() + 10
+            while asyncio.get_event_loop().time() < deadline:
+                if broker.grpc_url in await brokers():
+                    break
+                await asyncio.sleep(0.1)
+            assert broker.grpc_url in await brokers()
+        finally:
+            await broker.stop()
             await cluster.stop()
 
     run(go())
