@@ -33,6 +33,7 @@ class LocalCluster:
         with_iam: bool = False,
         jwt_signing_key: str = "",
         tier_backends: dict | None = None,  # default: local backend in base_dir/tier
+        disk_types: list[str] | None = None,  # per-directory, all servers
     ):
         import os
 
@@ -75,6 +76,7 @@ class LocalCluster:
                     data_center=(data_centers or ["dc1"])[i % len(data_centers or ["dc1"])],
                     rack=(racks or ["r1"])[i % len(racks or ["r1"])],
                     tier_backends=tier_backends,
+                    disk_types=disk_types,
                 )
             )
         self.volume_servers: list[VolumeServer] = []

@@ -19,6 +19,7 @@ def volume_msg_to_pb(v: VolumeMessage) -> master_pb2.VolumeInformationMessage:
         version=v.version,
         ttl=v.ttl,
         disk_type=v.disk_type,
+        modified_at_second=v.modified_at_second,
     )
 
 
@@ -35,6 +36,7 @@ def volume_msg_from_pb(p: master_pb2.VolumeInformationMessage) -> VolumeMessage:
         version=p.version,
         ttl=p.ttl,
         disk_type=p.disk_type,
+        modified_at_second=p.modified_at_second,
     )
 
 

@@ -92,6 +92,7 @@ class MasterServer:
         self.pulse_seconds = pulse_seconds
         self.garbage_threshold = garbage_threshold
         self.auto_vacuum = auto_vacuum
+        self.vacuum_disabled = False
         self.jwt_signing_key = jwt_signing_key
         self.jwt_expires_sec = jwt_expires_sec
         self.topo = Topology(
@@ -633,6 +634,23 @@ class MasterServer:
         )
         return master_pb2.VacuumVolumeResponse()
 
+    async def DisableVacuum(self, request, context):
+        """volume.vacuum.disable (reference master_grpc_server_volume.go
+        DisableVacuum): stops the periodic scan AND manual passes until
+        re-enabled."""
+        proxied = await self._maybe_proxy("DisableVacuum", request, context)
+        if proxied is not None:
+            return proxied
+        self.vacuum_disabled = True
+        return master_pb2.DisableVacuumResponse()
+
+    async def EnableVacuum(self, request, context):
+        proxied = await self._maybe_proxy("EnableVacuum", request, context)
+        if proxied is not None:
+            return proxied
+        self.vacuum_disabled = False
+        return master_pb2.EnableVacuumResponse()
+
     # ------------------------------------------------------------------ growth
 
     def _grow_option(
@@ -753,6 +771,8 @@ class MasterServer:
     async def _vacuum_pass(self, threshold: float, only_vid: int = 0) -> int:
         """Drive Check → Compact → Commit over gRPC
         (topology_vacuum.go:220-269)."""
+        if self.vacuum_disabled:
+            return 0
         done = 0
         for _, vl in self.topo.layouts():
             for vid, loc in list(vl.vid2location.items()):

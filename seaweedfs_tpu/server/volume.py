@@ -137,6 +137,7 @@ class VolumeServer:
         client_max_size_mb: int = 256,
         concurrent_upload_limit_mb: int = 0,  # 0 = unlimited
         concurrent_download_limit_mb: int = 0,
+        disk_types: list[str] | None = None,  # per-directory (ref -disk flag)
     ):
         if tier_backends:
             from ..storage import backend as backend_mod
@@ -144,15 +145,23 @@ class VolumeServer:
             backend_mod.configure(tier_backends)
         if isinstance(max_volume_counts, int):
             max_volume_counts = [max_volume_counts] * len(directories)
+        if disk_types is None:
+            disk_types = ["hdd"] * len(directories)
+        if len(disk_types) != len(directories) or len(max_volume_counts) != len(
+            directories
+        ):
+            raise ValueError(
+                "disk_types / max_volume_counts must match directories 1:1"
+            )
         self.store = Store(
             [
                 DiskLocation(
-                    d, max_volume_count=c,
+                    d, max_volume_count=c, disk_type=dt,
                     needle_map_kind=(
                         "persistent" if index_kind == "sqlite" else None
                     ),
                 )
-                for d, c in zip(directories, max_volume_counts)
+                for d, c, dt in zip(directories, max_volume_counts, disk_types)
             ],
             ip=ip,
             port=port,
@@ -1091,8 +1100,9 @@ class VolumeServer:
 
     async def VolumeCopy(self, request, context):
         """Pull .dat/.idx of a volume from a peer and mount it
-        (volume_grpc_copy.go VolumeCopy)."""
-        loc = self.store._pick_location()
+        (volume_grpc_copy.go VolumeCopy).  `disk_type` pins the copy onto a
+        matching DiskLocation (volume.tier.move's hdd→ssd path)."""
+        loc = self.store._pick_location(request.disk_type or "")
         if loc is None:
             await context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, "no free slots")
         base = Volume.base_name(loc.directory, request.volume_id, request.collection)
@@ -1117,6 +1127,23 @@ class VolumeServer:
             needle_blob=n.data, cookie=n.cookie,
             last_modified=n.last_modified,
         )
+
+    async def WriteNeedleBlob(self, request, context):
+        """Append one needle to a local replica — volume.check.disk's sync
+        path (reference volume_grpc_read_write.go WriteNeedleBlob)."""
+        n = Needle(
+            id=request.needle_id,
+            cookie=request.cookie,
+            data=request.needle_blob,
+            last_modified=request.last_modified or int(time.time()),
+        )
+        try:
+            await asyncio.to_thread(self.store.write_needle, request.volume_id, n)
+        except NotFoundError:
+            await context.abort(grpc.StatusCode.NOT_FOUND, "volume not found")
+        except VolumeReadOnly as e:
+            await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        return volume_server_pb2.WriteNeedleBlobResponse()
 
     # ------------------------------------------------------------------ gRPC: erasure coding
 

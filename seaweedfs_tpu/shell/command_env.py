@@ -32,13 +32,24 @@ class TopoNode:
         host = self.url.rsplit(":", 1)[0]
         return f"{host}:{self.grpc_port}"
 
-    def free_slots(self) -> int:
+    def free_slots(self, disk_type: str = "") -> int:
         from ..storage.ec import TOTAL_SHARDS
 
-        used = len(self.volumes) + (
-            sum(bin(s["ec_index_bits"]).count("1") for s in self.ec_shards)
+        used = sum(
+            1
+            for v in self.volumes
+            if not disk_type or v.get("disk_type", "hdd") == disk_type
+        )
+        used += (
+            sum(
+                bin(s["ec_index_bits"]).count("1")
+                for s in self.ec_shards
+                if not disk_type or s.get("disk_type", "hdd") == disk_type
+            )
             + TOTAL_SHARDS - 1
         ) // TOTAL_SHARDS
+        if disk_type:
+            return self.max_volume_counts.get(disk_type, 0) - used
         return sum(self.max_volume_counts.values()) - used
 
 

@@ -384,6 +384,396 @@ async def cmd_volume_tier_download(env, args):
             )
 
 
+def parse_duration(s: str) -> float:
+    """'24h' / '30m' / '90s' / bare seconds -> seconds."""
+    s = str(s).strip()
+    mult = {"s": 1, "m": 60, "h": 3600, "d": 86400}.get(s[-1:], None)
+    if mult is None:
+        return float(s)
+    return float(s[:-1]) * mult
+
+
+@command("volume.copy")
+async def cmd_volume_copy(env, args):
+    """-volumeId N -source <grpc> -target <grpc> : copy a volume replica
+    to another server without deleting the source (command_volume_copy.go)"""
+    env.confirm_is_locked()
+    flags = parse_flags(args)
+    vid = int(flags["volumeId"])
+    nodes, _ = await env.collect_topology()
+    by_grpc = {n.grpc_address: n for n in nodes}
+    src = by_grpc[flags["source"]]
+    collection = next((v["collection"] for v in src.volumes if v["id"] == vid), "")
+    n = 0
+    async for resp in env.volume_stub(flags["target"]).VolumeCopy(
+        volume_server_pb2.VolumeCopyRequest(
+            volume_id=vid, collection=collection, source_data_node=flags["source"]
+        )
+    ):
+        n = resp.processed_bytes
+    env.write(f"copied volume {vid}: {flags['source']} -> {flags['target']} ({n} bytes)")
+
+
+@command("volume.vacuum.disable")
+async def cmd_volume_vacuum_disable(env, args):
+    """pause master vacuum (periodic + manual) — command_volume_vacuum_disable.go"""
+    await env.master_stub.DisableVacuum(master_pb2.DisableVacuumRequest())
+    env.write("vacuum disabled")
+
+
+@command("volume.vacuum.enable")
+async def cmd_volume_vacuum_enable(env, args):
+    """resume master vacuum — command_volume_vacuum_enable.go"""
+    await env.master_stub.EnableVacuum(master_pb2.EnableVacuumRequest())
+    env.write("vacuum enabled")
+
+
+@command("volume.server.leave")
+async def cmd_volume_server_leave(env, args):
+    """-node <grpc addr> : ask one volume server to stop heartbeating and
+    leave the cluster (command_volume_server_leave.go)"""
+    env.confirm_is_locked()
+    flags = parse_flags(args)
+    await env.volume_stub(flags["node"]).VolumeServerLeave(
+        volume_server_pb2.VolumeServerLeaveRequest()
+    )
+    env.write(f"volume server {flags['node']} asked to leave")
+
+
+@command("volume.delete.empty")
+async def cmd_volume_delete_empty(env, args):
+    """[-quietFor 24h] [-force] : delete volumes holding no live files that
+    have been quiet for the period (command_volume_delete_empty.go)"""
+    env.confirm_is_locked()
+    flags = parse_flags(args)
+    quiet_s = parse_duration(flags.get("quietFor", "24h"))
+    apply = "force" in flags
+    import time as _time
+
+    now = _time.time()
+    nodes, _ = await env.collect_topology()
+    deleted = 0
+    for n in nodes:
+        for v in n.volumes:
+            live = v["file_count"] - v["delete_count"]
+            quiet = now - v.get("modified_at_second", 0) >= quiet_s
+            if live > 0 or not quiet:
+                continue
+            env.write(f"delete empty volume {v['id']} on {n.url}")
+            if apply:
+                await env.volume_stub(n.grpc_address).VolumeDelete(
+                    volume_server_pb2.VolumeDeleteRequest(volume_id=v["id"])
+                )
+            deleted += 1
+    env.write(f"{deleted} empty volumes{' deleted' if apply else ' found (use -force)'}")
+
+
+async def _fetch_needle_states(
+    env, node: TopoNode, vid: int, collection: str
+) -> tuple[dict, set, set]:
+    """Pull a replica's .idx and fold it in file order to
+    ({needle_id: size} live, {needle_id} ending deleted, {needle_id}
+    deleted-then-re-added).  Any negative idx size is a deletion marker
+    (TOMBSTONE_FILE_SIZE is -1, but reference-written volumes may carry
+    other negative encodings); offset 0 + size 0 records deletions of
+    absent needles and is neither alive nor a tombstone."""
+    from ..storage import idx as idx_mod
+
+    buf = bytearray()
+    async for resp in env.volume_stub(node.grpc_address).CopyFile(
+        volume_server_pb2.CopyFileRequest(
+            volume_id=vid, collection=collection, ext=".idx"
+        )
+    ):
+        buf.extend(resp.file_content)
+    ids, offs, sizes = idx_mod.parse_buffer(bytes(buf))
+    alive: dict[int, int] = {}
+    deleted: set[int] = set()
+    resurrected: set[int] = set()
+    for i in range(len(ids)):
+        nid, off, size = int(ids[i]), int(offs[i]), int(sizes[i])
+        if size < 0:
+            alive.pop(nid, None)
+            deleted.add(nid)
+            resurrected.discard(nid)
+        elif size == 0 and off == 0:
+            pass  # delete-of-absent record: no state change
+        else:
+            if nid in deleted:
+                deleted.discard(nid)
+                resurrected.add(nid)
+            alive[nid] = size
+    return alive, deleted, resurrected
+
+
+@command("volume.check.disk")
+async def cmd_volume_check_disk(env, args):
+    """[-volumeId N] [-force] : cross-check replicas of each volume and sync
+    missing needles both ways (command_volume_check_disk.go)"""
+    env.confirm_is_locked()
+    flags = parse_flags(args)
+    only_vid = int(flags.get("volumeId", 0))
+    apply = "force" in flags
+    nodes, _ = await env.collect_topology()
+    by_vid: dict[int, list[tuple[TopoNode, dict]]] = {}
+    for n in nodes:
+        for v in n.volumes:
+            by_vid.setdefault(v["id"], []).append((n, v))
+    synced = 0
+    for vid, replicas in sorted(by_vid.items()):
+        if only_vid and vid != only_vid:
+            continue
+        if len(replicas) < 2:
+            continue
+        collection = replicas[0][1]["collection"]
+        states = [
+            await _fetch_needle_states(env, n, vid, collection)
+            for n, _ in replicas
+        ]
+        alive = [s[0] for s in states]
+        # deletions win: if ANY replica tombstoned a needle, propagate the
+        # delete (reference doVolumeCheckDisk syncs deletions, not just
+        # additions — an add-only sync would resurrect deleted files).
+        # EXCEPT when some replica shows a delete-then-re-add history for
+        # the id: the re-add is causally after the delete that the stale
+        # tombstone echoes, so the newest write must not be destroyed.
+        all_resurrected = set().union(*(s[2] for s in states))
+        all_deleted = (
+            set().union(*(s[1] for s in states)) - all_resurrected
+        )
+        import aiohttp
+
+        async with aiohttp.ClientSession() as http:
+            for j, (dst_node, _) in enumerate(replicas):
+                for nid in sorted(all_deleted & set(alive[j])):
+                    env.write(
+                        f"volume {vid}: needle {nid:x} deleted elsewhere, "
+                        f"still alive on {dst_node.url}"
+                    )
+                    if apply:
+                        blob = await env.volume_stub(
+                            dst_node.grpc_address
+                        ).ReadNeedleBlob(
+                            volume_server_pb2.ReadNeedleBlobRequest(
+                                volume_id=vid, needle_id=nid
+                            )
+                        )
+                        fid = f"{vid},{nid:x}{blob.cookie:08x}"
+                        await http.delete(f"http://{dst_node.url}/{fid}")
+                        del alive[j][nid]
+                    synced += 1
+        for i, (src_node, _) in enumerate(replicas):
+            for j, (dst_node, _) in enumerate(replicas):
+                if i == j:
+                    continue
+                missing = set(alive[i]) - set(alive[j]) - all_deleted
+                for nid in sorted(missing):
+                    env.write(
+                        f"volume {vid}: needle {nid:x} on {src_node.url} "
+                        f"missing from {dst_node.url}"
+                    )
+                    if apply:
+                        blob = await env.volume_stub(
+                            src_node.grpc_address
+                        ).ReadNeedleBlob(
+                            volume_server_pb2.ReadNeedleBlobRequest(
+                                volume_id=vid, needle_id=nid
+                            )
+                        )
+                        await env.volume_stub(
+                            dst_node.grpc_address
+                        ).WriteNeedleBlob(
+                            volume_server_pb2.WriteNeedleBlobRequest(
+                                volume_id=vid,
+                                needle_id=nid,
+                                needle_blob=blob.needle_blob,
+                                cookie=blob.cookie,
+                                last_modified=blob.last_modified,
+                            )
+                        )
+                        alive[j][nid] = alive[i][nid]
+                    synced += 1
+    env.write(
+        f"{synced} needles {'synced' if apply else 'out of sync (use -force)'}"
+    )
+
+
+@command("volume.server.evacuate")
+async def cmd_volume_server_evacuate(env, args):
+    """-node <url> [-force] : move every volume and EC shard off a server
+    before decommissioning it (command_volume_server_evacuate.go)"""
+    env.confirm_is_locked()
+    flags = parse_flags(args)
+    target_url = flags["node"]
+    apply = "force" in flags
+    nodes, _ = await env.collect_topology()
+    victim = next(
+        (n for n in nodes if n.url == target_url or n.grpc_address == target_url),
+        None,
+    )
+    if victim is None:
+        raise ValueError(f"volume server {target_url} not found in topology")
+    others = [n for n in nodes if n is not victim]
+    replica_urls: dict[int, set[str]] = {}
+    for n in nodes:
+        for v in n.volumes:
+            replica_urls.setdefault(v["id"], set()).add(n.url)
+    moved = skipped = 0
+    for v in list(victim.volumes):
+        vid = v["id"]
+        rp = t.ReplicaPlacement.from_byte(v["replica_placement"])
+        rest = [
+            (n.data_center, n.rack, n.url)
+            for n in others
+            if n.url in replica_urls.get(vid, set())
+        ]
+        valid = [
+            n
+            for n in others
+            if n.url not in replica_urls.get(vid, set())
+            and n.free_slots() > 0
+            and placement_feasible(rest + [(n.data_center, n.rack, n.url)], rp)
+        ]
+        if not valid:
+            env.write(f"volume {vid}: no placement-feasible target — skipped")
+            skipped += 1
+            continue
+        dst = max(valid, key=lambda n: n.free_slots())
+        env.write(f"move volume {vid}: {victim.url} -> {dst.url}")
+        if apply:
+            try:
+                await move_volume(env, vid, v["collection"], victim, dst)
+            except Exception as e:  # stale topology (already moved/deleted)
+                env.write(f"volume {vid}: move failed, skipped ({e})")
+                skipped += 1
+                continue
+        replica_urls.setdefault(vid, set()).discard(victim.url)
+        replica_urls[vid].add(dst.url)
+        moved += 1
+    # EC shards ride along too (evacuate moves both kinds); capacity is in
+    # SHARD units, not volume slots (command_ec.free_shard_slots)
+    from ..storage.ec import TOTAL_SHARDS
+    from .command_ec import free_shard_slots, move_ec_shard
+
+    for s in list(victim.ec_shards):
+        bits = s["ec_index_bits"]
+        for sid in [i for i in range(TOTAL_SHARDS) if bits >> i & 1]:
+            candidates = [n for n in others if free_shard_slots(n) > 0]
+            if not candidates:
+                env.write(f"ec shard {s['id']}.{sid}: no target — skipped")
+                skipped += 1
+                continue
+            dst = max(candidates, key=free_shard_slots)
+            env.write(f"move ec shard {s['id']}.{sid}: {victim.url} -> {dst.url}")
+            if apply:
+                try:
+                    await move_ec_shard(
+                        env, s["id"], s["collection"], sid, victim, dst
+                    )
+                except Exception as e:
+                    env.write(
+                        f"ec shard {s['id']}.{sid}: move failed, skipped ({e})"
+                    )
+                    skipped += 1
+                    continue
+            moved += 1
+    env.write(
+        f"{moved} moves{' applied' if apply else ' planned (use -force)'}, "
+        f"{skipped} skipped"
+    )
+
+
+@command("volume.tier.move")
+async def cmd_volume_tier_move(env, args):
+    """-fromDiskType hdd -toDiskType ssd [-collectionPattern p] [-fullPercent 95]
+    [-quietFor 0s] [-force] : re-home volumes onto a different disk type.
+    Only one replica is moved and the others are dropped — follow with
+    volume.fix.replication + volume.balance (command_volume_tier_move.go)."""
+    env.confirm_is_locked()
+    import fnmatch
+    import time as _time
+
+    flags = parse_flags(args)
+    src_type = flags["fromDiskType"]
+    dst_type = flags["toDiskType"]
+    if src_type == dst_type:
+        raise ValueError("source and target disk types are the same")
+    pattern = flags.get("collectionPattern", "")
+    full_pct = float(flags.get("fullPercent", 95))
+    quiet_s = parse_duration(flags.get("quietFor", "0s"))
+    apply = "force" in flags
+    now = _time.time()
+    nodes, size_limit_mb = await env.collect_topology()
+    by_vid: dict[int, list[tuple[TopoNode, dict]]] = {}
+    for n in nodes:
+        for v in n.volumes:
+            by_vid.setdefault(v["id"], []).append((n, v))
+    moved = 0
+    planned: dict[str, int] = {}  # url -> slots consumed by this run's moves
+    for vid, replicas in sorted(by_vid.items()):
+        # pick a replica actually sitting on the source tier (replicas can
+        # be tier-mixed after an interrupted move or a manual copy)
+        src_pair = next(
+            (
+                (n, v)
+                for n, v in replicas
+                if v.get("disk_type", "hdd") == src_type
+            ),
+            None,
+        )
+        if src_pair is None:
+            continue
+        src, v = src_pair
+        if pattern and not fnmatch.fnmatch(v["collection"], pattern):
+            continue
+        if full_pct and v["size"] < size_limit_mb * 1024 * 1024 * full_pct / 100:
+            continue
+        if quiet_s and now - v.get("modified_at_second", 0) < quiet_s:
+            continue
+        holder_urls = {n.url for n, _ in replicas}
+        targets = [
+            n
+            for n in nodes
+            if n.free_slots(dst_type) - planned.get(n.url, 0) > 0
+            and n.url not in holder_urls
+        ]
+        if not targets:
+            env.write(f"volume {vid}: no {dst_type} capacity — skipped")
+            continue
+        dst = max(
+            targets, key=lambda n: n.free_slots(dst_type) - planned.get(n.url, 0)
+        )
+        env.write(
+            f"move volume {vid} ({src_type} -> {dst_type}): {src.url} -> {dst.url}"
+        )
+        if apply:
+            try:
+                async for _ in env.volume_stub(dst.grpc_address).VolumeCopy(
+                    volume_server_pb2.VolumeCopyRequest(
+                        volume_id=vid,
+                        collection=v["collection"],
+                        source_data_node=src.grpc_address,
+                        disk_type=dst_type,
+                    )
+                ):
+                    pass
+            except Exception as e:  # keep draining the rest of the queue
+                env.write(f"volume {vid}: move failed, skipped ({e})")
+                continue
+            # drop the old-tier replicas (ref semantics: one replica changes
+            # tier, the rest are dropped); replicas already on the target
+            # tier are kept
+            for n, rv in replicas:
+                if rv.get("disk_type", "hdd") == dst_type:
+                    continue
+                await env.volume_stub(n.grpc_address).VolumeDelete(
+                    volume_server_pb2.VolumeDeleteRequest(volume_id=vid)
+                )
+        planned[dst.url] = planned.get(dst.url, 0) + 1
+        moved += 1
+    env.write(f"{moved} volumes{' moved' if apply else ' planned (use -force)'}")
+
+
 @command("volume.configure.replication")
 async def cmd_volume_configure_replication(env, args):
     """-volumeId N -replication XYZ : change a volume's replica placement

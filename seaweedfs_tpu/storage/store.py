@@ -53,6 +53,7 @@ class VolumeMessage:
     version: int
     ttl: int
     disk_type: str
+    modified_at_second: int = 0
 
 
 @dataclass
@@ -542,6 +543,7 @@ class Store:
             version=v.version,
             ttl=int.from_bytes(v.super_block.ttl.to_bytes(), "big"),
             disk_type=disk_type,
+            modified_at_second=getattr(v, "last_modified_at", 0),
         )
 
     def _disk_type_of(self, ev: EcVolume) -> str:

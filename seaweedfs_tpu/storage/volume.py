@@ -148,6 +148,9 @@ class Volume:
             self._state = _ReadState(self.remote_dat, nm)
             self._idx = None
             self.read_only = True
+            self.last_modified_at = int(
+                os.path.getmtime(self.idx_path)
+            ) if os.path.exists(self.idx_path) else 0
             return
 
         if os.path.exists(self.dat_path):
@@ -185,6 +188,10 @@ class Volume:
         # crash is detectable on the next load; removed on clean close
         with open(self.note_path, "w") as f:
             f.write("open for writing\n")
+        # last append/delete wall-clock second, persisted implicitly via the
+        # .dat mtime (reference data_node ModifiedAtSecond; feeds
+        # volume.delete.empty / volume.tier.move quiet-period checks)
+        self.last_modified_at = int(os.path.getmtime(self.dat_path))
 
     @property
     def sdx_path(self) -> str:
@@ -295,6 +302,7 @@ class Volume:
             self.nm.set(n.id, offset, n.size)
             self._idx.write(idx_mod.pack_entry(n.id, offset, n.size))
             self._idx.flush()
+            self.last_modified_at = int(time.time())
             return offset, n.size
 
     def write(
@@ -343,6 +351,7 @@ class Volume:
                 idx_mod.pack_entry(needle_id, 0, t.TOMBSTONE_FILE_SIZE)
             )
             self._idx.flush()
+            self.last_modified_at = int(time.time())
             return reclaimed
 
     # -- read path -----------------------------------------------------------

@@ -328,6 +328,7 @@ class Topology:
                                             "id": s.vid,
                                             "collection": s.collection,
                                             "ec_index_bits": int(s.shard_bits),
+                                            "disk_type": s.disk_type,
                                         }
                                         for s in n.ec_shards.values()
                                     ],

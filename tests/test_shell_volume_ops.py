@@ -1,0 +1,258 @@
+"""Round-2 shell long tail: volume.copy, volume.check.disk,
+volume.delete.empty, volume.server.evacuate/leave, volume.tier.move,
+volume.vacuum.disable/enable — each against a live in-process cluster
+(the reference's command_volume_*.go behaviors, SURVEY.md §4)."""
+import asyncio
+import io
+import os
+
+import aiohttp
+import pytest
+
+from seaweedfs_tpu.operation import assign, upload_data
+from seaweedfs_tpu.server.cluster import LocalCluster
+from seaweedfs_tpu.shell import CommandEnv, run_command
+from seaweedfs_tpu.storage.types import parse_fid
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def sh(env, line):
+    await run_command(env, line)
+
+
+async def make(tmp_path, n=2, **kw):
+    cluster = LocalCluster(
+        base_dir=str(tmp_path), n_volume_servers=n, pulse_seconds=1, **kw
+    )
+    await cluster.start()
+    env = CommandEnv([cluster.master.advertise_url], out=io.StringIO())
+    await env.acquire_lock()
+    return cluster, env
+
+
+async def fill_volume(cluster, n_blobs=6):
+    master = cluster.master.advertise_url
+    a = await assign(master)
+    vid = int(a.fid.split(",")[0])
+    data = os.urandom(512)
+    await upload_data(f"http://{a.url}/{a.fid}", data)
+    blobs = {a.fid: data}
+    for i in range(n_blobs - 1):
+        ai = await assign(master)
+        if int(ai.fid.split(",")[0]) != vid:
+            continue
+        data = os.urandom(500 + 31 * i)
+        await upload_data(f"http://{ai.url}/{ai.fid}", data)
+        blobs[ai.fid] = data
+    return vid, blobs
+
+
+def holders_of(cluster, vid):
+    return [
+        vs for vs in cluster.volume_servers
+        if vs.store.find_volume(vid) is not None
+    ]
+
+
+def test_volume_copy_and_check_disk_sync(tmp_path):
+    async def go():
+        cluster, env = await make(tmp_path, n=2)
+        try:
+            vid, blobs = await fill_volume(cluster)
+            src = holders_of(cluster, vid)[0]
+            dst = next(
+                vs for vs in cluster.volume_servers if vs is not src
+            )
+            await sh(
+                env,
+                f"volume.copy -volumeId {vid} "
+                f"-source {src.grpc_url} -target {dst.grpc_url}",
+            )
+            assert dst.store.find_volume(vid) is not None
+            # let the new replica reach the master's topology
+            for _ in range(40):
+                nodes, _ = await env.collect_topology()
+                if sum(
+                    1 for n in nodes for v in n.volumes if v["id"] == vid
+                ) == 2:
+                    break
+                await asyncio.sleep(0.25)
+
+            # diverge: append one needle straight to src only
+            async with aiohttp.ClientSession() as s:
+                fid = f"{vid},999deadbeef1"
+                async with s.post(
+                    f"http://{src.url}/{fid}",
+                    data={"file": b"only-on-src"},
+                ) as r:
+                    assert r.status in (200, 201), await r.text()
+
+            env.out = io.StringIO()
+            await sh(env, f"volume.check.disk -volumeId {vid}")
+            assert "missing from" in env.out.getvalue()
+
+            await sh(env, f"volume.check.disk -volumeId {vid} -force")
+            # dst now serves the needle locally
+            _, nid, _ = parse_fid(fid)
+            n = dst.store.read_needle(vid, nid)
+            assert n.data == b"only-on-src"
+
+            env.out = io.StringIO()
+            await sh(env, f"volume.check.disk -volumeId {vid}")
+            assert "0 needles" in env.out.getvalue()
+
+            # tombstones propagate too: delete on dst only, check.disk must
+            # delete on src rather than resurrect from it
+            async with aiohttp.ClientSession() as s:
+                async with s.delete(f"http://{dst.url}/{fid}") as r:
+                    assert r.status in (200, 202, 204), await r.text()
+            await sh(env, f"volume.check.disk -volumeId {vid} -force")
+            with pytest.raises(Exception):
+                src.store.read_needle(vid, nid)
+
+            # ...but a delete-then-RE-ADD beats a stale tombstone: the
+            # re-written needle must be synced, not destroyed
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"http://{src.url}/{fid}", data={"file": b"v2-after-del"}
+                ) as r:
+                    assert r.status in (200, 201)
+            await sh(env, f"volume.check.disk -volumeId {vid} -force")
+            assert src.store.read_needle(vid, nid).data == b"v2-after-del"
+            assert dst.store.read_needle(vid, nid).data == b"v2-after-del"
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_volume_delete_empty(tmp_path):
+    async def go():
+        cluster, env = await make(tmp_path, n=1)
+        try:
+            vid, blobs = await fill_volume(cluster, n_blobs=3)
+            # grow a second, never-written volume
+            from seaweedfs_tpu.pb import server_address
+
+            master_http = server_address.http_address(
+                cluster.master.advertise_url
+            )
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"http://{master_http}/vol/grow?count=1"
+                ) as r:
+                    assert r.status == 200
+            # wait until the master's view shows BOTH the new empty volume
+            # and a non-zero file_count on the filled one (full heartbeats
+            # are periodic, so the counters lag the writes)
+            for _ in range(60):
+                nodes, _ = await env.collect_topology()
+                vols = {v["id"]: v for n in nodes for v in n.volumes}
+                if len(vols) >= 2 and vols.get(vid, {}).get("file_count", 0) > 0:
+                    break
+                await asyncio.sleep(0.25)
+            assert vols[vid]["file_count"] > 0
+
+            await sh(env, "volume.delete.empty -quietFor 0s -force")
+            for _ in range(40):  # deltas reach the master on the next pulse
+                nodes, _ = await env.collect_topology()
+                left = {v["id"] for n in nodes for v in n.volumes}
+                if left == {vid}:
+                    break
+                await asyncio.sleep(0.25)
+            assert left == {vid}  # only the filled volume survives
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_vacuum_disable_enable(tmp_path):
+    async def go():
+        cluster, env = await make(tmp_path, n=1)
+        try:
+            await sh(env, "volume.vacuum.disable")
+            assert cluster.master.vacuum_disabled
+            assert await cluster.master._vacuum_pass(0.0) == 0
+            await sh(env, "volume.vacuum.enable")
+            assert not cluster.master.vacuum_disabled
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_volume_server_evacuate(tmp_path):
+    async def go():
+        cluster, env = await make(tmp_path, n=3)
+        try:
+            vid, blobs = await fill_volume(cluster)
+            victim = holders_of(cluster, vid)[0]
+            env.out = io.StringIO()
+            await sh(env, f"volume.server.evacuate -node {victim.url} -force")
+            assert "move volume" in env.out.getvalue()
+            assert victim.store.find_volume(vid) is None
+            others = holders_of(cluster, vid)
+            assert others, "volume must land somewhere else"
+            # data survives the move
+            n0 = others[0].store
+            for fid, data in blobs.items():
+                _, nid, _ = parse_fid(fid)
+                assert n0.read_needle(vid, nid).data == data
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_volume_server_leave(tmp_path):
+    async def go():
+        cluster, env = await make(tmp_path, n=2)
+        try:
+            victim = cluster.volume_servers[1]
+            await sh(env, f"volume.server.leave -node {victim.grpc_url}")
+            for _ in range(40):
+                nodes, _ = await env.collect_topology()
+                if len(nodes) == 1:
+                    break
+                await asyncio.sleep(0.25)
+            assert len(nodes) == 1
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_volume_tier_move(tmp_path):
+    async def go():
+        cluster, env = await make(
+            tmp_path, n=2, dirs_per_server=2, disk_types=["hdd", "ssd"]
+        )
+        try:
+            vid, blobs = await fill_volume(cluster)
+            src = holders_of(cluster, vid)[0]
+
+            env.out = io.StringIO()
+            await sh(env, "volume.tier.move -fromDiskType hdd -toDiskType ssd -fullPercent 0")
+            assert f"move volume {vid}" in env.out.getvalue()
+
+            await sh(
+                env,
+                "volume.tier.move -fromDiskType hdd -toDiskType ssd -fullPercent 0 -force",
+            )
+            assert src.store.find_volume(vid) is None
+            dst = holders_of(cluster, vid)
+            assert len(dst) == 1
+            loc = dst[0].store.location_of_volume(vid)
+            assert loc.disk_type == "ssd"
+            # blobs still readable from the ssd replica
+            for fid, data in blobs.items():
+                _, nid, _ = parse_fid(fid)
+                assert dst[0].store.read_needle(vid, nid).data == data
+        finally:
+            await cluster.stop()
+
+    run(go())
