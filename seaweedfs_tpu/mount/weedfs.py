@@ -286,6 +286,24 @@ class WeedFS:
             files = resp.file_count
         except Exception:  # noqa: BLE001
             total, used, files = 1 << 40, 0, 0
+        try:
+            # mount.configure quota on the mount root caps the reported fs
+            # size (reference mount_std.go quota + weedfs_stats.go); 2s TTL
+            # cache — statfs is kernel-hot and the quota changes rarely
+            import time as _time
+
+            now = _time.monotonic()
+            cached = getattr(self, "_quota_cache", None)
+            if cached is None or now - cached[1] > 2.0:
+                root_entry = await self._find(self.inodes.root)
+                quota_mb = int(
+                    (root_entry.extended.get("mount.quota_mb") or b"0").decode()
+                )
+                self._quota_cache = cached = (quota_mb, now)
+            if cached[0] > 0:
+                total = cached[0] * 1024 * 1024
+        except Exception:  # noqa: BLE001
+            pass
         bsize = 4096
         blocks = max(total // bsize, 1)
         bfree = max((total - used) // bsize, 0)

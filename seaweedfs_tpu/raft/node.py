@@ -48,8 +48,10 @@ class RaftNode:
         election_timeout: tuple[float, float] = (0.4, 0.8),
         heartbeat_interval: float = 0.1,
         dial_fn=None,  # peer id -> grpc address (default: identity)
+        voter: bool = True,  # False: joining server — replicate, never campaign
     ):
         self.id = node_id
+        self.voter = voter
         self.peers = [p for p in peers if p != node_id]
         self.dial_fn = dial_fn or (lambda a: a)
         self.apply_fn = apply_fn
@@ -90,6 +92,9 @@ class RaftNode:
                 st = json.load(f)
             self.term = st["term"]
             self.voted_for = st["voted_for"]
+            if "peers" in st:  # membership changes survive restart
+                self.peers = [p for p in st["peers"] if p != self.id]
+            self.voter = st.get("voter", self.voter)
         except (OSError, ValueError, KeyError):
             pass
         try:
@@ -107,7 +112,15 @@ class RaftNode:
             return
         tmp = self._state_path() + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"term": self.term, "voted_for": self.voted_for}, f)
+            json.dump(
+                {
+                    "term": self.term,
+                    "voted_for": self.voted_for,
+                    "peers": self.peers,
+                    "voter": self.voter,
+                },
+                f,
+            )
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._state_path())
@@ -177,8 +190,11 @@ class RaftNode:
 
     async def start(self) -> None:
         self._reset_election_timer()
-        if not self.peers:
-            # single-master deployment: win the 1-node election immediately
+        if not self.peers and self.voter:
+            # single-master deployment: win the 1-node election immediately.
+            # A non-voter (raft_join) must NOT take this path even with an
+            # empty peer list — self-electing would split-brain against the
+            # cluster it is about to join.
             self.term += 1
             self.voted_for = self.id
             self._persist_state()
@@ -201,7 +217,7 @@ class RaftNode:
             now = asyncio.get_event_loop().time()
             if self.state == LEADER:
                 await self._replicate_all()
-            elif now >= self._election_deadline:
+            elif self.voter and now >= self._election_deadline:
                 await self._run_election()
 
     # --------------------------------------------------------------- election
@@ -257,6 +273,33 @@ class RaftNode:
         if not self.peers:
             self._advance_commit()
         log.info("%s: leader for term %d", self.id, self.term)
+
+    # ----------------------------------------------------------- membership
+
+    def apply_config(self, members: list[str]) -> None:
+        """Membership change, called when a raft_conf log entry commits.
+        The entry carries the COMPLETE member list so every replica —
+        including a joining server that knew nobody — converges on the
+        same configuration.  One add/remove at a time keeps old and new
+        quorums overlapping (the hashicorp AddVoter/RemoveServer
+        discipline the reference relies on)."""
+        new_peers = [m for m in members if m != self.id]
+        if self.state == LEADER:
+            li, _ = self.last_log()
+            for p in new_peers:
+                if p not in self.next_index:
+                    self.next_index[p] = li + 1
+                    self.match_index[p] = 0
+            for p in list(self.next_index):
+                if p not in new_peers:
+                    self.next_index.pop(p, None)
+                    self.match_index.pop(p, None)
+        self.peers = new_peers
+        if self.id in members:
+            self.voter = True  # a joining server is promoted on commit
+        elif self.voter and self.state != LEADER:
+            self.voter = False  # removed: stop campaigning
+        self._persist_state()
 
     # ------------------------------------------------------------ replication
 

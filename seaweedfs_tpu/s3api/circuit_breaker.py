@@ -1,0 +1,66 @@
+"""S3 request circuit breaker.
+
+Reference: weed/s3api/s3api_circuit_breaker.go — concurrent request-count
+and in-flight-bytes limits, global and per-bucket, per-action, configured
+in /etc/s3/circuit_breaker.json (shell: s3.circuitbreaker) and applied
+live.  Exceeding any limit rejects the request with 503 SlowDown rather
+than queueing, so an overloaded gateway degrades predictably.
+"""
+from __future__ import annotations
+
+import json
+
+
+class CircuitBreakerError(Exception):
+    pass
+
+
+class CircuitBreaker:
+    def __init__(self):
+        self.cfg: dict = {}
+        # in-flight gauges: (scope, action, type) -> current value
+        self._inflight: dict[tuple[str, str, str], int] = {}
+
+    def load(self, blob: bytes) -> None:
+        self.cfg = json.loads(blob) if blob else {}
+
+    def _limits(self, bucket: str, action: str):
+        """Yield (scope_key, limit_type, limit, cost_multiplier_key)."""
+        for scope_key, scope_cfg in (
+            ("", self.cfg.get("global") or {}),
+            (bucket, (self.cfg.get("buckets") or {}).get(bucket) or {}),
+        ):
+            if not scope_cfg or scope_cfg.get("enabled") is False:
+                continue
+            actions = scope_cfg.get("actions") or {}
+            for key, limit in actions.items():
+                act, _, ltype = key.partition(":")
+                if act in (action, "Total"):
+                    yield scope_key, act, ltype, int(limit)
+
+    def acquire(self, bucket: str, action: str, content_length: int):
+        """Reserve capacity or raise; returns a release() callable."""
+        costs = {"Count": 1, "MB": content_length}
+        taken: list[tuple[tuple[str, str, str], int]] = []
+        for scope, act, ltype, limit in self._limits(bucket, action):
+            cost = costs.get(ltype)
+            if cost is None:
+                continue
+            limit_abs = limit * 1024 * 1024 if ltype == "MB" else limit
+            k = (scope, act, ltype)
+            cur = self._inflight.get(k, 0)
+            if cur + cost > limit_abs:
+                for kk, cc in taken:  # roll back partial reservations
+                    self._inflight[kk] -= cc
+                raise CircuitBreakerError(
+                    f"concurrent {act}:{ltype} limit {limit} reached"
+                    + (f" for bucket {scope}" if scope else "")
+                )
+            self._inflight[k] = cur + cost
+            taken.append((k, cost))
+
+        def release():
+            for kk, cc in taken:
+                self._inflight[kk] -= cc
+
+        return release

@@ -81,6 +81,9 @@ class S3ApiServer:
         self._session: aiohttp.ClientSession | None = None
         self._stub_cache = None
         self._iam_refresh: asyncio.Task | None = None
+        from .circuit_breaker import CircuitBreaker
+
+        self.circuit_breaker = CircuitBreaker()
 
     async def _load_iam_from_filer(self) -> None:
         from .auth import IDENTITY_FILER_PATH, IdentityAccessManagement
@@ -107,13 +110,37 @@ class S3ApiServer:
         self.iam._by_access_key.clear()
         self.iam._by_access_key.update(loaded._by_access_key)
 
+    async def _load_cb_from_filer(self) -> None:
+        try:
+            resp = await self._stub().LookupDirectoryEntry(
+                filer_pb2.LookupDirectoryEntryRequest(
+                    directory="/etc/s3", name="circuit_breaker.json"
+                )
+            )
+        except grpc.aio.AioRpcError as e:
+            if e.code() == grpc.StatusCode.NOT_FOUND:
+                # conf deleted ⇒ limits lifted (stale limits must not
+                # outlive the entry that configured them)
+                self.circuit_breaker.load(b"")
+                return
+            raise
+        if resp.HasField("entry") and resp.entry.content:
+            self.circuit_breaker.load(bytes(resp.entry.content))
+        else:
+            self.circuit_breaker.load(b"")
+
     async def _iam_refresh_loop(self, interval: float = 10.0) -> None:
         while True:
             await asyncio.sleep(interval)
+            if self._follow_filer_iam:
+                try:
+                    await self._load_iam_from_filer()
+                except Exception:  # noqa: BLE001 — keep old config
+                    log.exception("iam refresh failed")
             try:
-                await self._load_iam_from_filer()
-            except Exception:  # noqa: BLE001 — keep serving with old config
-                log.exception("iam refresh failed")
+                await self._load_cb_from_filer()
+            except Exception:  # noqa: BLE001
+                log.exception("circuit breaker refresh failed")
 
     @property
     def url(self) -> str:
@@ -131,9 +158,14 @@ class S3ApiServer:
         # no locally-configured identities: adopt (and follow) the
         # IAM-API-managed config the filer holds, so `iam` and `s3` work
         # as separate processes (reference: s3 subscribes to filer_etc)
-        if not self.iam.enabled:
+        self._follow_filer_iam = not self.iam.enabled
+        if self._follow_filer_iam:
             await self._load_iam_from_filer()
-            self._iam_refresh = asyncio.create_task(self._iam_refresh_loop())
+        try:
+            await self._load_cb_from_filer()
+        except Exception:  # noqa: BLE001 — filer may not be up yet
+            pass
+        self._iam_refresh = asyncio.create_task(self._iam_refresh_loop())
         app = web.Application(client_max_size=1024 * 1024 * 1024)
         app.router.add_route("*", "/{tail:.*}", self._dispatch)
         self._http_runner = web.AppRunner(app)
@@ -159,10 +191,26 @@ class S3ApiServer:
 
     async def _dispatch(self, request: web.Request) -> web.StreamResponse:
         from .. import stats
+        from .circuit_breaker import CircuitBreakerError
 
+        bucket = request.match_info["tail"].partition("/")[0]
         code = 500  # unhandled exceptions surface as aiohttp 500s
         try:
-            resp = await self._dispatch_authed(request)
+            # circuit breaker: concurrent count/bytes limits, global and
+            # per-bucket (s3api_circuit_breaker.go Limit)
+            m = request.method
+            action = "Read" if m in ("GET", "HEAD") else "Write"
+            try:
+                release = self.circuit_breaker.acquire(
+                    bucket, action, request.content_length or 0
+                )
+            except CircuitBreakerError as e:
+                code = 503
+                return _error_response("SlowDown", str(e), 503)
+            try:
+                resp = await self._dispatch_authed(request)
+            finally:
+                release()
             code = resp.status
             return resp
         except web.HTTPException as e:
@@ -172,7 +220,7 @@ class S3ApiServer:
             stats.S3_REQUEST_COUNTER.labels(
                 type=request.method,
                 code=str(code),
-                bucket=request.match_info["tail"].partition("/")[0],
+                bucket=bucket,
             ).inc()
 
     async def _dispatch_authed(self, request: web.Request) -> web.StreamResponse:

@@ -114,6 +114,45 @@ class FilerServer:
         self._http_runner: web.AppRunner | None = None
         self._metrics_runner: web.AppRunner | None = None
         self._session: aiohttp.ClientSession | None = None
+        self._conf_cache = None
+        self._conf_cache_ts = 0.0
+
+    # -------------------------------------------------- path storage rules
+
+    def _filer_conf(self):
+        """Cached /etc/seaweedfs/filer.conf (filer_conf.go); the 2s TTL
+        bounds staleness after a live fs.configure edit without a store
+        read per request."""
+        from ..filer.path_conf import CONF_PATH, FilerConf
+
+        now = time.time()
+        if self._conf_cache is not None and now - self._conf_cache_ts < 2.0:
+            return self._conf_cache
+        try:
+            blob = bytes(self.filer.find_entry(CONF_PATH).content)
+            conf = FilerConf.from_bytes(blob)
+        except Exception:  # noqa: BLE001 — absent/garbled conf = no rules
+            conf = FilerConf()
+        self._conf_cache = conf
+        self._conf_cache_ts = now
+        return conf
+
+    def _conf_rule(self, path: str):
+        return self._filer_conf().match(path)
+
+    def _check_writable(self, path: str) -> None:
+        """Raise 403 when a filer.conf rule marks the path read-only —
+        shared by HTTP writes AND the gRPC mutation surface so FUSE /
+        S3 multipart / replication clients can't bypass a quota lock."""
+        from ..filer.path_conf import CONF_PATH
+
+        if path == CONF_PATH:
+            return  # editing the conf itself must never be locked out
+        rule = self._conf_rule(path)
+        if rule and rule.read_only:
+            raise web.HTTPForbidden(
+                text=f"{rule.location_prefix} is read-only (filer.conf)"
+            )
 
     # ----------------------------------------------------------- lifecycle
 
@@ -485,6 +524,7 @@ class FilerServer:
     async def h_write(self, request: web.Request) -> web.Response:
         path, had_slash = self._req_path(request)
         q = request.query
+        self._check_writable(path)
         # mkdir: POST to a path ending in "/" with no content-type
         if (
             request.method == "POST"
@@ -503,9 +543,14 @@ class FilerServer:
             return web.json_response({"name": path}, status=201)
 
         chunk_size = int(q.get("maxMB", self.max_mb)) * 1024 * 1024
-        collection = q.get("collection", self.collection)
-        replication = q.get("replication", self.replication)
-        ttl_str = q.get("ttl", "")
+        rule = self._conf_rule(path)
+        collection = q.get("collection") or (
+            rule.collection if rule else ""
+        ) or self.collection
+        replication = q.get("replication") or (
+            rule.replication if rule else ""
+        ) or self.replication
+        ttl_str = q.get("ttl") or (rule.ttl if rule else "")
         try:
             from ..storage.types import TTL
 
@@ -734,6 +779,12 @@ class FilerServer:
             start, inclusive = batch[-1].name, False
 
     async def CreateEntry(self, request, context):
+        try:
+            self._check_writable(
+                f"{request.directory.rstrip('/')}/{request.entry.name}"
+            )
+        except web.HTTPForbidden as e:
+            return filer_pb2.CreateEntryResponse(error=e.text)
         entry = Entry.from_pb(request.directory, request.entry)
         old_chunks: list = []
         try:
@@ -754,6 +805,12 @@ class FilerServer:
         return filer_pb2.CreateEntryResponse()
 
     async def UpdateEntry(self, request, context):
+        try:
+            self._check_writable(
+                f"{request.directory.rstrip('/')}/{request.entry.name}"
+            )
+        except web.HTTPForbidden as e:
+            await context.abort(grpc.StatusCode.PERMISSION_DENIED, e.text)
         entry = Entry.from_pb(request.directory, request.entry)
         old = None
         try:
@@ -766,6 +823,12 @@ class FilerServer:
         return filer_pb2.UpdateEntryResponse()
 
     async def AppendToEntry(self, request, context):
+        try:
+            self._check_writable(
+                f"{request.directory.rstrip('/')}/{request.entry_name}"
+            )
+        except web.HTTPForbidden as e:
+            await context.abort(grpc.StatusCode.PERMISSION_DENIED, e.text)
         await self.filer.append_chunks(
             new_full_path(request.directory, request.entry_name),
             list(request.chunks),
@@ -789,6 +852,14 @@ class FilerServer:
 
     async def AtomicRenameEntry(self, request, context):
         try:
+            # renames must not GROW a read-only subtree (moving OUT of one
+            # is allowed — quota locks block growth, not shrinkage)
+            self._check_writable(
+                f"{request.new_directory.rstrip('/')}/{request.new_name}"
+            )
+        except web.HTTPForbidden as e:
+            await context.abort(grpc.StatusCode.PERMISSION_DENIED, e.text)
+        try:
             await self.filer.atomic_rename(
                 request.old_directory,
                 request.old_name,
@@ -801,12 +872,18 @@ class FilerServer:
         return filer_pb2.AtomicRenameEntryResponse()
 
     async def AssignVolume(self, request, context):
+        rule = self._conf_rule(request.path) if request.path else None
+        if rule and rule.read_only:
+            return filer_pb2.AssignVolumeResponse(
+                error=f"{rule.location_prefix} is read-only (filer.conf)"
+            )
         try:
             a = await self._assign(
                 max(request.count, 1),
-                request.collection,
-                request.replication,
-                _seconds_to_ttl(request.ttl_sec),
+                request.collection or (rule.collection if rule else ""),
+                request.replication or (rule.replication if rule else ""),
+                _seconds_to_ttl(request.ttl_sec)
+                or (rule.ttl if rule else ""),
                 request.data_center,
             )
         except Exception as e:  # noqa: BLE001

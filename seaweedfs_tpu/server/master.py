@@ -84,7 +84,9 @@ class MasterServer:
         jwt_expires_sec: int = 10,
         peers: list[str] | None = None,  # other masters' advertise urls
         meta_dir: str | None = None,  # durable raft state directory
+        raft_join: bool = False,  # start as non-voter until cluster.raft.add
     ):
+        self.raft_join = raft_join
         self.ip = ip
         self.port = port
         self.grpc_port = grpc_port or (port + 10000 if port else 0)
@@ -183,6 +185,7 @@ class MasterServer:
             apply_fn=self._apply_raft,
             data_dir=self.meta_dir,
             dial_fn=server_address.grpc_address,
+            voter=not self.raft_join,
         )
         await self.raft.start()
 
@@ -233,6 +236,9 @@ class MasterServer:
                 # the 10k batch isn't burned per proposal
                 self.topo.sequencer.set_max(cmd["ceiling"])
             self._seq_committed = max(self._seq_committed, cmd["ceiling"])
+        elif op == "raft_conf":
+            if self.raft is not None:
+                self.raft.apply_config(cmd["members"])
 
     async def RequestVote(self, request, context):
         if self.raft is None:
@@ -650,6 +656,59 @@ class MasterServer:
             return proxied
         self.vacuum_disabled = False
         return master_pb2.EnableVacuumResponse()
+
+    # -------------------------------------------------- raft administration
+
+    async def RaftListClusterServers(self, request, context):
+        """cluster.raft.ps (reference master_grpc_server_raft.go)."""
+        resp = master_pb2.RaftListClusterServersResponse()
+        if self.raft is None:
+            resp.cluster_servers.append(
+                master_pb2.ClusterServer(id=self.advertise_url, is_leader=True)
+            )
+            return resp
+        resp.term = self.raft.term
+        for sid in [self.raft.id, *self.raft.peers]:
+            resp.cluster_servers.append(
+                master_pb2.ClusterServer(
+                    id=sid, is_leader=sid == self.raft.leader_id
+                )
+            )
+        return resp
+
+    async def RaftAddServer(self, request, context):
+        """Single-server joint-free membership add, replicated through the
+        log so every node (and any future leader) converges on the new
+        peer set."""
+        proxied = await self._maybe_proxy("RaftAddServer", request, context)
+        if proxied is not None:
+            return proxied
+        if self.raft is None:
+            await context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION, "raft not enabled"
+            )
+        members = sorted({self.raft.id, *self.raft.peers, request.id})
+        await self.raft.propose({"op": "raft_conf", "members": members})
+        return master_pb2.RaftAddServerResponse()
+
+    async def RaftRemoveServer(self, request, context):
+        proxied = await self._maybe_proxy("RaftRemoveServer", request, context)
+        if proxied is not None:
+            return proxied
+        if self.raft is None:
+            await context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION, "raft not enabled"
+            )
+        if request.id == self.raft.id:
+            await context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                "cannot remove the current leader; transfer leadership first",
+            )
+        members = sorted(
+            {self.raft.id, *self.raft.peers} - {request.id}
+        )
+        await self.raft.propose({"op": "raft_conf", "members": members})
+        return master_pb2.RaftRemoveServerResponse()
 
     # ------------------------------------------------------------------ growth
 

@@ -33,6 +33,42 @@ async def cmd_cluster_ps(env, args):
         )
 
 
+@command("cluster.raft.ps")
+async def cmd_cluster_raft_ps(env, args):
+    """list raft cluster servers (command_cluster_raft_ps.go)"""
+    resp = await env.master_stub.RaftListClusterServers(
+        master_pb2.RaftListClusterServersRequest()
+    )
+    env.write(f"term: {resp.term}")
+    for s in resp.cluster_servers:
+        env.write(f"  {s.id}{'  leader' if s.is_leader else ''}")
+
+
+@command("cluster.raft.add")
+async def cmd_cluster_raft_add(env, args):
+    """-id <raft grpc addr> : add a master to the raft cluster
+    (command_cluster_raft_add.go).  Start the new master with -peers
+    including the existing members, then add it here."""
+    env.confirm_is_locked()
+    flags = parse_flags(args)
+    await env.master_stub.RaftAddServer(
+        master_pb2.RaftAddServerRequest(id=flags["id"])
+    )
+    env.write(f"added raft server {flags['id']}")
+
+
+@command("cluster.raft.remove")
+async def cmd_cluster_raft_remove(env, args):
+    """-id <raft grpc addr> : remove a master from the raft cluster
+    (command_cluster_raft_remove.go)"""
+    env.confirm_is_locked()
+    flags = parse_flags(args)
+    await env.master_stub.RaftRemoveServer(
+        master_pb2.RaftRemoveServerRequest(id=flags["id"])
+    )
+    env.write(f"removed raft server {flags['id']}")
+
+
 @command("cluster.check")
 async def cmd_cluster_check(env, args):
     """sanity-check cluster connectivity (master + every volume server)"""

@@ -19,6 +19,29 @@ def _split(path: str) -> tuple[str, str]:
     return d or "/", name
 
 
+def _cwd(env) -> str:
+    return env.option.get("fs_cwd", "/")
+
+
+def _resolve(env, p: str | None) -> str:
+    """Join a (possibly relative) shell path against fs.cd's cwd, with
+    `.`/`..` normalization (the reference shell keeps the same state in
+    commandEnv.option.Directory)."""
+    if not p:
+        return _cwd(env)
+    base = "/" if p.startswith("/") else _cwd(env)
+    out = [x for x in base.strip("/").split("/") if x]
+    for x in p.split("/"):
+        if not x or x == ".":
+            continue
+        if x == "..":
+            if out:
+                out.pop()
+        else:
+            out.append(x)
+    return "/" + "/".join(out)
+
+
 async def _stub(env):
     return env.filer_stub(await env.find_filer())
 
@@ -85,7 +108,7 @@ async def cmd_fs_ls(env, args):
     """[-l] /dir : list a filer directory"""
     long_form = "-l" in args
     pos = _positional(args)
-    path = "/" + (pos[0].strip("/") if pos else "")
+    path = _resolve(env, pos[0] if pos else None)
     stub = await _stub(env)
     for e in await list_all_entries(stub, path or "/"):
         if long_form:
@@ -108,7 +131,7 @@ async def cmd_fs_cat(env, args):
     if not pos:
         env.write("usage: fs.cat /path")
         return
-    path = "/" + pos[0].strip("/")
+    path = _resolve(env, pos[0])
     import urllib.parse
 
     import aiohttp
@@ -131,7 +154,7 @@ async def cmd_fs_cat(env, args):
 async def cmd_fs_du(env, args):
     """/dir : disk usage of a filer subtree"""
     pos = _positional(args)
-    path = "/" + (pos[0].strip("/") if pos else "")
+    path = _resolve(env, pos[0] if pos else None)
     stub = await _stub(env)
     files = dirs = size = 0
     async for _, e in _walk_entries(stub, path or "/"):
@@ -152,7 +175,7 @@ async def cmd_fs_mkdir(env, args):
     if not pos:
         env.write("usage: fs.mkdir /dir")
         return
-    path = "/" + pos[0].strip("/")
+    path = _resolve(env, pos[0])
     stub = await _stub(env)
     existing = await _lookup(stub, path)
     if existing is not None:
@@ -189,7 +212,7 @@ async def cmd_fs_rm(env, args):
     if not pos:
         env.write("usage: fs.rm [-r] /path")
         return
-    path = "/" + pos[0].strip("/")
+    path = _resolve(env, pos[0])
     d, name = _split(path)
     stub = await _stub(env)
     if await _lookup(stub, path) is None:
@@ -214,7 +237,7 @@ async def cmd_fs_mv(env, args):
     if len(parts) != 2:
         env.write("usage: fs.mv /src /dst")
         return
-    src, dst = ("/" + p.strip("/") for p in parts)
+    src, dst = (_resolve(env, p) for p in parts)
     sd, sn = _split(src)
     dd, dn = _split(dst)
     stub = await _stub(env)
@@ -237,7 +260,7 @@ async def cmd_fs_meta_save(env, args):
 
     flags = parse_flags(args)
     pos = _positional(args, value_flags={"o"})
-    root = "/" + (pos[0].strip("/") if pos else "")
+    root = _resolve(env, pos[0] if pos else None)
     out_path = flags.get("o", "filer-meta.bin")
     stub = await _stub(env)
     n = 0
@@ -289,3 +312,163 @@ async def cmd_fs_meta_load(env, args):
                 continue
             n += 1
     env.write(f"restored {n} entries from {in_path}")
+
+
+@command("fs.pwd")
+async def cmd_fs_pwd(env, args):
+    """print the shell's current filer directory (command_fs_pwd.go)"""
+    env.write(_cwd(env))
+
+
+@command("fs.cd")
+async def cmd_fs_cd(env, args):
+    """/dir | relative/dir | .. : change the shell's current filer
+    directory (command_fs_cd.go)"""
+    pos = _positional(args)
+    path = _resolve(env, pos[0] if pos else "/")
+    if path != "/":
+        stub = await _stub(env)
+        e = await _lookup(stub, path)
+        if e is None or not e.is_directory:
+            env.write(f"fs.cd {path}: no such directory")
+            return
+    env.option["fs_cwd"] = path
+
+
+@command("fs.tree")
+async def cmd_fs_tree(env, args):
+    """[/dir] : recursively print the filer subtree (command_fs_tree.go)"""
+    pos = _positional(args)
+    root = _resolve(env, pos[0] if pos else None)
+    stub = await _stub(env)
+    files = dirs = 0
+
+    async def walk(directory: str, depth: int):
+        nonlocal files, dirs
+        for e in await list_all_entries(stub, directory):
+            env.write("  " * depth + e.name + ("/" if e.is_directory else ""))
+            if e.is_directory:
+                dirs += 1
+                await walk(f"{directory.rstrip('/')}/{e.name}", depth + 1)
+            else:
+                files += 1
+
+    env.write(root)
+    await walk(root, 1)
+    env.write(f"{dirs} directories, {files} files")
+
+
+@command("fs.meta.cat")
+async def cmd_fs_meta_cat(env, args):
+    """/path : print one entry's metadata as the raw pb text
+    (command_fs_meta_cat.go)"""
+    pos = _positional(args)
+    if not pos:
+        env.write("usage: fs.meta.cat /path")
+        return
+    path = _resolve(env, pos[0])
+    stub = await _stub(env)
+    e = await _lookup(stub, path)
+    if e is None:
+        env.write(f"fs.meta.cat {path}: not found")
+        return
+    env.write(str(e))
+
+
+@command("fs.verify")
+async def cmd_fs_verify(env, args):
+    """[-v] [/dir] : check that every file chunk under the subtree is
+    readable from some volume server (command_fs_verify.go)"""
+    import aiohttp
+
+    from ..operation.lookup import lookup_file_id
+
+    verbose = "-v" in args
+    pos = _positional(args)
+    root = _resolve(env, pos[0] if pos else None)
+    stub = await _stub(env)
+    master = env.masters[0]
+    ok = broken = 0
+    vol_locations: dict[str, list[str]] = {}  # vid -> server urls (cached)
+    async with aiohttp.ClientSession() as http:
+        async for d, e in _walk_entries(stub, root):
+            if e.is_directory:
+                continue
+            for c in e.chunks:
+                fid = c.file_id
+                vid = fid.partition(",")[0]
+                try:
+                    if vid not in vol_locations:
+                        urls = await lookup_file_id(master, fid)
+                        vol_locations[vid] = [
+                            u.rsplit("/", 1)[0] for u in urls
+                        ]
+                    servers = vol_locations[vid]
+                    if not servers:
+                        raise RuntimeError("no locations")
+                    async with http.head(f"{servers[0]}/{fid}") as r:
+                        if r.status >= 300:
+                            raise RuntimeError(f"HTTP {r.status}")
+                    ok += 1
+                    if verbose:
+                        env.write(f"  ok {d}/{e.name} chunk {fid}")
+                except Exception as err:  # noqa: BLE001
+                    broken += 1
+                    env.write(
+                        f"  BROKEN {d}/{e.name} chunk {fid}: {err}"
+                    )
+    env.write(f"verified {ok} chunks, {broken} broken")
+
+
+@command("fs.configure")
+async def cmd_fs_configure(env, args):
+    """[-locationPrefix /p/ -collection c -replication XYZ -ttl 1h
+    -disk ssd -readOnly] [-delete] [-apply] : view or edit per-path
+    storage rules in /etc/seaweedfs/filer.conf (command_fs_configure.go).
+    Without -apply the resulting conf is printed but not saved."""
+    from .commands import parse_flags
+    from ..filer.path_conf import CONF_DIR, CONF_NAME, CONF_PATH, FilerConf, PathConf
+
+    flags = parse_flags(args)
+    stub = await _stub(env)
+    existing = await _lookup(stub, CONF_PATH)
+    conf = FilerConf.from_bytes(
+        bytes(existing.content) if existing is not None else b""
+    )
+    prefix = flags.get("locationPrefix", "")
+    if prefix:
+        if "delete" in flags:
+            if not conf.delete(prefix):
+                env.write(f"no rule for {prefix}")
+        else:
+            # merge into any existing rule: fields not passed on THIS
+            # invocation survive (so editing the ttl can't silently clear
+            # a quota lock's read_only flag, and vice versa)
+            rule = next(
+                (
+                    l
+                    for l in conf.locations
+                    if l.location_prefix == prefix
+                ),
+                PathConf(location_prefix=prefix),
+            )
+            if "collection" in flags:
+                rule.collection = flags["collection"]
+            if "replication" in flags:
+                rule.replication = flags["replication"]
+            if "ttl" in flags:
+                rule.ttl = flags["ttl"]
+            if "disk" in flags:
+                rule.disk_type = flags["disk"]
+            if "readOnly" in flags:
+                rule.read_only = flags["readOnly"] != "false"
+            conf.upsert(rule)
+    env.write(conf.to_bytes().decode())
+    if "apply" not in flags:
+        if prefix:
+            env.write("(not saved — add -apply)")
+        return
+    from ..filer.path_conf import save_conf_entry
+
+    await save_conf_entry(stub, CONF_DIR, CONF_NAME, conf.to_bytes())
+    env.write(f"saved {CONF_PATH}")

@@ -1,0 +1,34 @@
+"""mount.configure (reference weed/shell/command_mount_configure.go):
+set or clear a quota on a FUSE-mounted filer directory.  The quota lives
+in the directory entry's extended attributes; the mount's statfs reports
+it as the filesystem size (mount/weedfs.py statfs)."""
+from __future__ import annotations
+
+from ..pb import filer_pb2
+from .commands import command, parse_flags
+
+
+@command("mount.configure")
+async def cmd_mount_configure(env, args):
+    """-dir /path [-quotaMB N] : set (or with 0 clear) the mount quota"""
+    flags = parse_flags(args)
+    path = "/" + flags["dir"].strip("/")
+    quota_mb = int(flags.get("quotaMB", 0))
+    d, _, name = path.rpartition("/")
+    stub = env.filer_stub(await env.find_filer())
+    resp = await stub.LookupDirectoryEntry(
+        filer_pb2.LookupDirectoryEntryRequest(directory=d or "/", name=name)
+    )
+    if not resp.HasField("entry") or not resp.entry.is_directory:
+        raise ValueError(f"{path} is not a filer directory")
+    entry = resp.entry
+    if quota_mb > 0:
+        entry.extended["mount.quota_mb"] = str(quota_mb).encode()
+    else:
+        entry.extended.pop("mount.quota_mb", None)
+    await stub.UpdateEntry(
+        filer_pb2.UpdateEntryRequest(directory=d or "/", entry=entry)
+    )
+    env.write(
+        f"{path}: quota {'cleared' if quota_mb <= 0 else f'{quota_mb} MB'}"
+    )

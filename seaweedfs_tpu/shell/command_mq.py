@@ -1,0 +1,31 @@
+"""mq.* admin commands (reference weed/shell/command_mq_topic_list.go)."""
+from __future__ import annotations
+
+from ..pb import master_pb2, mq_pb2
+from ..pb.rpc import Stub, channel
+from ..pb import server_address
+from .commands import command
+
+
+async def _broker_stub(env) -> Stub:
+    resp = await env.master_stub.ListClusterNodes(
+        master_pb2.ListClusterNodesRequest(client_type="broker")
+    )
+    if not resp.cluster_nodes:
+        raise RuntimeError("no mq broker registered with the master")
+    addr = resp.cluster_nodes[0].address
+    return Stub(
+        channel(server_address.grpc_address(addr)), mq_pb2, "SeaweedMessaging"
+    )
+
+
+@command("mq.topic.list")
+async def cmd_mq_topic_list(env, args):
+    """list message-queue topics with partition counts"""
+    stub = await _broker_stub(env)
+    resp = await stub.ListTopics(mq_pb2.ListTopicsRequest())
+    if not resp.topics:
+        env.write("no topics")
+        return
+    for t, n in zip(resp.topics, resp.partition_counts):
+        env.write(f"{t.namespace}/{t.name}  partitions={n}")

@@ -46,6 +46,20 @@ def _backend(remote: str):
     return backend_mod.get_backend(btype, bid or "default"), prefix
 
 
+def _list_remote(storage, prefix: str):
+    """Yield (rel_path, full_key, size) under the prefix, enforcing a
+    path-separator boundary (prefix 'photos' must not swallow
+    'photoshoot/x').  Shared by remote.mount and remote.meta.sync so the
+    two commands can't diverge on what the remote contains."""
+    norm = prefix.strip("/")
+    for key, size in storage.list_keys(norm):
+        if norm and not (key == norm or key.startswith(norm + "/")):
+            continue
+        rel = key[len(norm):].strip("/") if norm else key
+        if rel:
+            yield rel, key, size
+
+
 async def _ensure_dir(stub, path: str) -> None:
     parts = [p for p in path.strip("/").split("/") if p]
     cur = ""
@@ -77,15 +91,7 @@ async def cmd_remote_mount(env, args):
     stub = env.filer_stub(filer)
     await _ensure_dir(stub, mount_dir)
     n = 0
-    norm = prefix.strip("/")
-    for key, size in storage.list_keys(norm):
-        # require a path-separator boundary: prefix "photos" must not
-        # swallow "photoshoot/x"
-        if norm and not (key == norm or key.startswith(norm + "/")):
-            continue
-        rel = key[len(norm):].strip("/") if norm else key
-        if not rel:
-            continue
+    for rel, key, size in _list_remote(storage, prefix):
         d = mount_dir
         if "/" in rel:
             sub, _, name = rel.rpartition("/")
@@ -110,6 +116,14 @@ async def cmd_remote_mount(env, args):
             )
         )
         n += 1
+    # record the mapping so remote.meta.sync can re-list the same remote
+    # (the reference keeps mount mappings in filer_etc/remote.mount)
+    await stub.KvPut(
+        filer_pb2.KvPutRequest(
+            key=f"remote.mount{mount_dir}".encode(),
+            value=flags["remote"].encode(),
+        )
+    )
     env.write(f"mounted {flags['remote']} at {mount_dir} ({n} objects)")
 
 
@@ -229,4 +243,89 @@ async def cmd_remote_unmount(env, args):
             is_recursive=True, ignore_recursive_error=True,
         )
     )
+    await stub.KvPut(
+        filer_pb2.KvPutRequest(key=f"remote.mount{mount_dir}".encode(), value=b"")
+    )
     env.write(f"unmounted {mount_dir}")
+
+@command("remote.meta.sync")
+async def cmd_remote_meta_sync(env, args):
+    """-dir /path : re-list the mounted remote store and reconcile the
+    filer mirror — new keys appear, vanished keys are removed, size
+    changes on uncached entries are refreshed (command_remote_meta_sync.go)"""
+    env.confirm_is_locked()
+    flags = parse_flags(args)
+    mount_dir = flags["dir"].rstrip("/")
+    filer = await env.find_filer()
+    stub = env.filer_stub(filer)
+    kv = await stub.KvGet(
+        filer_pb2.KvGetRequest(key=f"remote.mount{mount_dir}".encode())
+    )
+    remote = bytes(kv.value).decode()
+    if not remote:
+        raise ValueError(f"{mount_dir} is not a remote mount")
+    storage, prefix = _backend(remote)
+    remote_keys: dict[str, tuple[str, int]] = {}
+    for rel, key, size in _list_remote(storage, prefix):
+        remote_keys[rel] = (key, size)
+    local: dict[str, tuple[str, object]] = {}
+    async for directory, e in _walk_remote_entries(env, stub, mount_dir):
+        rel = f"{directory}/{e.name}"[len(mount_dir):].strip("/")
+        local[rel] = (directory, e)
+    added = updated = removed = 0
+    for rel, (key, size) in remote_keys.items():
+        if rel not in local:
+            d = mount_dir
+            name = rel
+            if "/" in rel:
+                sub, _, name = rel.rpartition("/")
+                d = f"{mount_dir}/{sub}"
+                await _ensure_dir(stub, d)
+            # a LOCAL file (no remote marker) at this path must not be
+            # clobbered by a remote stub — CreateEntry would GC its chunks
+            from .command_fs import _lookup
+
+            probe = await _lookup(stub, f"{d}/{name}")
+            if probe is not None and not probe.extended.get("remote.key"):
+                env.write(
+                    f"conflict: {d}/{name} exists locally — remote key "
+                    f"{key} skipped"
+                )
+                continue
+            await stub.CreateEntry(
+                filer_pb2.CreateEntryRequest(
+                    directory=d,
+                    entry=filer_pb2.Entry(
+                        name=name,
+                        attributes=filer_pb2.FuseAttributes(
+                            file_mode=0o644, mtime=int(time.time()),
+                            crtime=int(time.time()), file_size=size,
+                        ),
+                        extended={
+                            "remote.backend": storage.name.encode(),
+                            "remote.key": key.encode(),
+                        },
+                    ),
+                )
+            )
+            added += 1
+        else:
+            d, e = local[rel]
+            if not e.chunks and e.attributes.file_size != size:
+                e.attributes.file_size = size
+                e.attributes.mtime = int(time.time())
+                await stub.UpdateEntry(
+                    filer_pb2.UpdateEntryRequest(directory=d, entry=e)
+                )
+                updated += 1
+    for rel, (d, e) in local.items():
+        if rel not in remote_keys:
+            await stub.DeleteEntry(
+                filer_pb2.DeleteEntryRequest(
+                    directory=d, name=e.name, is_delete_data=True,
+                )
+            )
+            removed += 1
+    env.write(
+        f"meta sync {mount_dir}: +{added} ~{updated} -{removed}"
+    )

@@ -1,0 +1,308 @@
+"""s3.* admin commands.
+
+Reference: weed/shell/command_s3_bucket_*.go, command_s3_configure.go,
+command_s3_clean_uploads.go, command_s3_circuitbreaker.go — bucket
+lifecycle lives in the filer under /buckets, identities in
+/etc/iam/identity.json, circuit-breaker limits in
+/etc/s3/circuit_breaker.json; the S3 gateway follows those entries live.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+from ..pb import filer_pb2
+from .command_fs import _lookup, _split
+from .commands import command, parse_flags
+
+BUCKETS_PATH = "/buckets"
+CB_DIR = "/etc/s3"
+CB_NAME = "circuit_breaker.json"
+QUOTA_ATTR = "s3.quota_mb"
+
+
+async def _stub(env):
+    return env.filer_stub(await env.find_filer())
+
+
+async def _list_buckets(env, stub):
+    from ..filer.client import list_all_entries
+
+    return [
+        e
+        for e in await list_all_entries(stub, BUCKETS_PATH)
+        if e.is_directory
+    ]
+
+
+@command("s3.bucket.list")
+async def cmd_s3_bucket_list(env, args):
+    """list buckets with their quota settings (command_s3_bucket_list.go)"""
+    stub = await _stub(env)
+    buckets = await _list_buckets(env, stub)
+    if not buckets:
+        env.write("no buckets")
+        return
+    for e in buckets:
+        quota = (e.extended.get(QUOTA_ATTR) or b"").decode()
+        env.write(
+            f"{e.name}" + (f"  quota: {quota} MB" if quota else "")
+        )
+
+
+@command("s3.bucket.create")
+async def cmd_s3_bucket_create(env, args):
+    """-name <bucket> : create a bucket (command_s3_bucket_create.go)"""
+    env.confirm_is_locked()
+    flags = parse_flags(args)
+    name = flags["name"]
+    stub = await _stub(env)
+    resp = await stub.CreateEntry(
+        filer_pb2.CreateEntryRequest(
+            directory=BUCKETS_PATH,
+            entry=filer_pb2.Entry(
+                name=name, is_directory=True,
+                attributes=filer_pb2.FuseAttributes(
+                    file_mode=0o770, mtime=int(time.time()),
+                    crtime=int(time.time()),
+                ),
+            ),
+        )
+    )
+    if resp.error:
+        raise ValueError(resp.error)
+    env.write(f"created bucket {name}")
+
+
+@command("s3.bucket.delete")
+async def cmd_s3_bucket_delete(env, args):
+    """-name <bucket> : delete a bucket and all its objects
+    (command_s3_bucket_delete.go)"""
+    env.confirm_is_locked()
+    flags = parse_flags(args)
+    name = flags["name"]
+    stub = await _stub(env)
+    resp = await stub.DeleteEntry(
+        filer_pb2.DeleteEntryRequest(
+            directory=BUCKETS_PATH, name=name, is_delete_data=True,
+            is_recursive=True, ignore_recursive_error=True,
+        )
+    )
+    if resp.error:
+        raise ValueError(resp.error)
+    env.write(f"deleted bucket {name}")
+
+
+@command("s3.bucket.quota")
+async def cmd_s3_bucket_quota(env, args):
+    """-name <bucket> [-sizeMB N | -remove] : set or clear a bucket's
+    storage quota (command_s3_bucket_quota.go)"""
+    env.confirm_is_locked()
+    flags = parse_flags(args)
+    name = flags["name"]
+    stub = await _stub(env)
+    e = await _lookup(stub, f"{BUCKETS_PATH}/{name}")
+    if e is None or not e.is_directory:
+        raise ValueError(f"bucket {name} not found")
+    if "remove" in flags:
+        e.extended.pop(QUOTA_ATTR, None)
+    else:
+        e.extended[QUOTA_ATTR] = flags["sizeMB"].encode()
+    await stub.UpdateEntry(
+        filer_pb2.UpdateEntryRequest(directory=BUCKETS_PATH, entry=e)
+    )
+    env.write(
+        f"bucket {name}: quota "
+        + ("removed" if "remove" in flags else f"{flags['sizeMB']} MB")
+    )
+
+
+async def _bucket_usage(stub, bucket: str) -> int:
+    from .command_fs import _entry_size, _walk_entries
+
+    total = 0
+    async for _, e in _walk_entries(stub, f"{BUCKETS_PATH}/{bucket}"):
+        if not e.is_directory:
+            total += _entry_size(e)
+    return total
+
+
+@command("s3.bucket.quota.check")
+async def cmd_s3_bucket_quota_check(env, args):
+    """[-apply] : compare each bucket's usage against its quota; with
+    -apply, over-quota buckets get a read-only filer.conf rule and
+    under-quota buckets get it lifted (command_s3_bucket_quota_check.go)"""
+    from ..filer.path_conf import CONF_DIR, CONF_NAME, CONF_PATH, FilerConf, PathConf
+
+    flags = parse_flags(args)
+    apply = "apply" in flags
+    stub = await _stub(env)
+    conf_entry = await _lookup(stub, CONF_PATH)
+    conf = FilerConf.from_bytes(
+        bytes(conf_entry.content) if conf_entry is not None else b""
+    )
+    changed = False
+    for e in await _list_buckets(env, stub):
+        quota = (e.extended.get(QUOTA_ATTR) or b"").decode()
+        if not quota:
+            continue
+        limit = int(quota) * 1024 * 1024
+        usage = await _bucket_usage(stub, e.name)
+        prefix = f"{BUCKETS_PATH}/{e.name}/"
+        # exact-prefix rule only: quota lock must compose with (not clobber
+        # or delete) operator-authored collection/ttl rules on the bucket
+        rule = next(
+            (l for l in conf.locations if l.location_prefix == prefix), None
+        )
+        locked = bool(rule and rule.read_only)
+        over = usage > limit
+        env.write(
+            f"{e.name}: {usage} / {limit} bytes"
+            + (" OVER QUOTA" if over else "")
+            + (" (read-only)" if locked else "")
+        )
+        if over and not locked:
+            if rule is None:
+                conf.upsert(PathConf(location_prefix=prefix, read_only=True))
+            else:
+                rule.read_only = True
+            changed = True
+        elif not over and locked:
+            rule.read_only = False
+            if not (
+                rule.collection or rule.replication or rule.ttl
+                or rule.disk_type
+            ):
+                conf.delete(prefix)
+            changed = True
+    if changed and apply:
+        from ..filer.path_conf import save_conf_entry
+
+        await save_conf_entry(stub, CONF_DIR, CONF_NAME, conf.to_bytes())
+        env.write("filer.conf updated")
+    elif changed:
+        env.write("(changes not saved — add -apply)")
+
+
+@command("s3.configure")
+async def cmd_s3_configure(env, args):
+    """[-user u -access_key ak -secret_key sk -actions a,b] [-delete]
+    [-apply] : view or edit the S3 identities in /etc/iam/identity.json
+    (command_s3_configure.go)"""
+    from ..s3api.auth import IDENTITY_FILER_PATH
+
+    flags = parse_flags(args)
+    stub = await _stub(env)
+    path = "/".join(IDENTITY_FILER_PATH)
+    e = await _lookup(stub, path)
+    cfg = json.loads(bytes(e.content)) if e is not None and e.content else {
+        "identities": []
+    }
+    user = flags.get("user", "")
+    if user:
+        cfg["identities"] = [
+            i for i in cfg["identities"] if i.get("name") != user
+        ]
+        if "delete" not in flags:
+            ident = {"name": user}
+            if flags.get("access_key"):
+                ident["credentials"] = [
+                    {
+                        "accessKey": flags["access_key"],
+                        "secretKey": flags.get("secret_key", ""),
+                    }
+                ]
+            ident["actions"] = [
+                a for a in flags.get("actions", "").split(",") if a
+            ]
+            cfg["identities"].append(ident)
+    blob = json.dumps(cfg, indent=2).encode()
+    env.write(blob.decode())
+    if not user:
+        return
+    if "apply" not in flags:
+        env.write("(not saved — add -apply)")
+        return
+    from ..filer.path_conf import save_conf_entry
+
+    await save_conf_entry(
+        stub, IDENTITY_FILER_PATH[0], IDENTITY_FILER_PATH[1], blob,
+        mode=0o600,
+    )
+    env.write(f"saved /{path.strip('/')}")
+
+
+@command("s3.clean.uploads")
+async def cmd_s3_clean_uploads(env, args):
+    """[-timeAgo 24h] : abort multipart uploads older than the cutoff in
+    every bucket (command_s3_clean_uploads.go)"""
+    from ..s3api.server import UPLOADS_DIR
+    from ..filer.client import list_all_entries
+    from .command_volume import parse_duration
+
+    env.confirm_is_locked()
+    flags = parse_flags(args)
+    cutoff = time.time() - parse_duration(flags.get("timeAgo", "24h"))
+    stub = await _stub(env)
+    n = 0
+    for bucket in await _list_buckets(env, stub):
+        updir = f"{BUCKETS_PATH}/{bucket.name}/{UPLOADS_DIR}"
+        try:
+            uploads = await list_all_entries(stub, updir)
+        except Exception:  # noqa: BLE001 — no uploads dir
+            continue
+        for u in uploads:
+            if u.attributes.crtime and u.attributes.crtime > cutoff:
+                continue
+            await stub.DeleteEntry(
+                filer_pb2.DeleteEntryRequest(
+                    directory=updir, name=u.name, is_delete_data=True,
+                    is_recursive=True, ignore_recursive_error=True,
+                )
+            )
+            env.write(f"aborted stale upload {bucket.name}/{u.name}")
+            n += 1
+    env.write(f"cleaned {n} stale multipart uploads")
+
+
+@command("s3.circuitbreaker")
+async def cmd_s3_circuitbreaker(env, args):
+    """[-global] [-buckets b1,b2] -actions Read,Write -type Count|MB
+    -values N [-delete] [-apply] : view or edit S3 request limits in
+    /etc/s3/circuit_breaker.json (command_s3_circuitbreaker.go)"""
+    flags = parse_flags(args)
+    stub = await _stub(env)
+    e = await _lookup(stub, f"{CB_DIR}/{CB_NAME}")
+    cfg = json.loads(bytes(e.content)) if e is not None and e.content else {
+        "global": {"enabled": True, "actions": {}},
+        "buckets": {},
+    }
+
+    def targets():
+        if "global" in flags:
+            yield cfg["global"]
+        for b in [x for x in flags.get("buckets", "").split(",") if x]:
+            yield cfg["buckets"].setdefault(
+                b, {"enabled": True, "actions": {}}
+            )
+
+    actions = [a for a in flags.get("actions", "").split(",") if a] or [""]
+    limit_type = flags.get("type", "Count")
+    if "values" in flags or "delete" in flags:
+        for t in targets():
+            for a in actions:
+                key = f"{a or 'Total'}:{limit_type}"
+                if "delete" in flags:
+                    t["actions"].pop(key, None)
+                else:
+                    t["actions"][key] = int(flags["values"])
+    blob = json.dumps(cfg, indent=2).encode()
+    env.write(blob.decode())
+    if "apply" not in flags:
+        if "values" in flags or "delete" in flags:
+            env.write("(not saved — add -apply)")
+        return
+    from ..filer.path_conf import save_conf_entry
+
+    await save_conf_entry(stub, CB_DIR, CB_NAME, blob)
+    env.write(f"saved {CB_DIR}/{CB_NAME}")

@@ -519,8 +519,11 @@ async def cmd_volume_check_disk(env, args):
     for n in nodes:
         for v in n.volumes:
             by_vid.setdefault(v["id"], []).append((n, v))
+    import aiohttp
+
     synced = 0
-    for vid, replicas in sorted(by_vid.items()):
+    async with aiohttp.ClientSession() as http:
+      for vid, replicas in sorted(by_vid.items()):
         if only_vid and vid != only_vid:
             continue
         if len(replicas) < 2:
@@ -541,9 +544,7 @@ async def cmd_volume_check_disk(env, args):
         all_deleted = (
             set().union(*(s[1] for s in states)) - all_resurrected
         )
-        import aiohttp
-
-        async with aiohttp.ClientSession() as http:
+        if True:
             for j, (dst_node, _) in enumerate(replicas):
                 for nid in sorted(all_deleted & set(alive[j])):
                     env.write(

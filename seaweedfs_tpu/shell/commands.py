@@ -68,5 +68,8 @@ from . import command_ec  # noqa: E402,F401
 from . import command_fs  # noqa: E402,F401
 from . import command_fsck  # noqa: E402,F401
 from . import command_lock  # noqa: E402,F401
+from . import command_mount  # noqa: E402,F401
+from . import command_mq  # noqa: E402,F401
 from . import command_remote  # noqa: E402,F401
+from . import command_s3  # noqa: E402,F401
 from . import command_volume  # noqa: E402,F401

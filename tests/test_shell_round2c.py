@@ -1,0 +1,446 @@
+"""Round-2 shell tail: fs.cd/pwd/tree/meta.cat/verify/configure,
+mount.configure, mq.topic.list, remote.meta.sync, cluster.raft.*,
+s3.* admin commands — live in-process clusters throughout."""
+import asyncio
+import io
+import json
+import os
+
+import aiohttp
+import pytest
+
+from seaweedfs_tpu.server.cluster import LocalCluster
+from seaweedfs_tpu.shell import CommandEnv, run_command
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def sh(env, line):
+    await run_command(env, line)
+
+
+async def make(tmp_path, **kw):
+    cluster = LocalCluster(
+        base_dir=str(tmp_path), n_volume_servers=1, pulse_seconds=1,
+        with_filer=True, **kw
+    )
+    await cluster.start()
+    env = CommandEnv([cluster.master.advertise_url], out=io.StringIO())
+    await env.acquire_lock()
+    return cluster, env
+
+
+async def put(cluster, path, data: bytes):
+    async with aiohttp.ClientSession() as s:
+        async with s.put(
+            f"http://{cluster.filer.url}{path}", data=data
+        ) as r:
+            assert r.status in (200, 201), await r.text()
+
+
+def test_fs_cd_pwd_tree_meta_cat(tmp_path):
+    async def go():
+        cluster, env = await make(tmp_path)
+        try:
+            await put(cluster, "/a/b/file.txt", b"hello")
+            await sh(env, "fs.pwd")
+            assert env.out.getvalue().strip() == "/"
+            await sh(env, "fs.cd /a")
+            await sh(env, "fs.pwd")
+            assert env.out.getvalue().splitlines()[-1] == "/a"
+            # relative listing from cwd
+            env.out = io.StringIO()
+            await sh(env, "fs.ls b")
+            assert "file.txt" in env.out.getvalue()
+            await sh(env, "fs.cd ..")
+            await sh(env, "fs.pwd")
+            assert env.out.getvalue().splitlines()[-1] == "/"
+            env.out = io.StringIO()
+            await sh(env, "fs.cd /a/nonexistent")
+            assert "no such directory" in env.out.getvalue()
+            env.out = io.StringIO()
+            await sh(env, "fs.tree /a")
+            out = env.out.getvalue()
+            assert "b/" in out and "file.txt" in out
+            assert "1 directories, 1 files" in out
+            env.out = io.StringIO()
+            await sh(env, "fs.meta.cat /a/b/file.txt")
+            assert "file.txt" in env.out.getvalue()
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_fs_verify(tmp_path):
+    async def go():
+        cluster, env = await make(tmp_path)
+        try:
+            await put(cluster, "/v/big.bin", os.urandom(256 * 1024))
+            env.out = io.StringIO()
+            await sh(env, "fs.verify /v")
+            out = env.out.getvalue()
+            assert "0 broken" in out and "verified" in out
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_fs_configure_rules_apply(tmp_path):
+    async def go():
+        cluster, env = await make(tmp_path)
+        try:
+            await sh(
+                env,
+                "fs.configure -locationPrefix /special/ -collection vip -apply",
+            )
+            assert "saved" in env.out.getvalue()
+            # a write under the prefix lands in the 'vip' collection
+            await put(cluster, "/special/x.bin", os.urandom(8192))
+            for _ in range(40):
+                nodes, _ = await env.collect_topology()
+                cols = {v["collection"] for n in nodes for v in n.volumes}
+                if "vip" in cols:
+                    break
+                await asyncio.sleep(0.25)
+            assert "vip" in cols
+            # read-only prefix rejects writes
+            await sh(
+                env,
+                "fs.configure -locationPrefix /frozen/ -readOnly -apply",
+            )
+            await asyncio.sleep(2.1)  # conf cache TTL
+            async with aiohttp.ClientSession() as s:
+                async with s.put(
+                    f"http://{cluster.filer.url}/frozen/no.bin", data=b"x"
+                ) as r:
+                    assert r.status == 403
+            # delete the rule
+            env.out = io.StringIO()
+            await sh(
+                env,
+                "fs.configure -locationPrefix /frozen/ -delete -apply",
+            )
+            assert "/frozen/" not in env.out.getvalue().split("saved")[0]
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_mount_configure_quota(tmp_path):
+    async def go():
+        cluster, env = await make(tmp_path)
+        try:
+            await put(cluster, "/mnt/data/f.txt", b"x")
+            await sh(env, "mount.configure -dir /mnt/data -quotaMB 100")
+            assert "quota 100 MB" in env.out.getvalue()
+            from seaweedfs_tpu.pb import filer_pb2
+
+            stub = env.filer_stub(await env.find_filer())
+            resp = await stub.LookupDirectoryEntry(
+                filer_pb2.LookupDirectoryEntryRequest(
+                    directory="/mnt", name="data"
+                )
+            )
+            assert resp.entry.extended["mount.quota_mb"] == b"100"
+            await sh(env, "mount.configure -dir /mnt/data -quotaMB 0")
+            resp = await stub.LookupDirectoryEntry(
+                filer_pb2.LookupDirectoryEntryRequest(
+                    directory="/mnt", name="data"
+                )
+            )
+            assert "mount.quota_mb" not in resp.entry.extended
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_mq_topic_list(tmp_path):
+    async def go():
+        cluster, env = await make(tmp_path)
+        broker = None
+        try:
+            from seaweedfs_tpu.mq import MessageQueueBroker, MqClient
+
+            broker = MessageQueueBroker(
+                filer_address=cluster.filer.url,
+                filer_grpc_address=f"{cluster.filer.ip}:{cluster.filer.grpc_port}",
+                port=0,
+                masters=[cluster.master.advertise_url],
+            )
+            await broker.start()
+            c = MqClient(broker.grpc_url)
+            await c.configure_topic(MqClient.topic("events"), 3)
+            # wait for the broker to appear in the cluster registry
+            for _ in range(40):
+                try:
+                    env.out = io.StringIO()
+                    await sh(env, "mq.topic.list")
+                    break
+                except RuntimeError:
+                    await asyncio.sleep(0.25)
+            out = env.out.getvalue()
+            assert "default/events" in out and "partitions=3" in out
+        finally:
+            if broker is not None:
+                await broker.stop()
+            await cluster.stop()
+
+    run(go())
+
+
+def test_remote_meta_sync(tmp_path):
+    async def go():
+        backing = tmp_path / "remote-store"
+        backing.mkdir()
+        (backing / "one.txt").write_bytes(b"1")
+        cluster, env = await make(tmp_path / "cluster")
+        try:
+            await sh(
+                env, f"remote.configure -name local.r1 -dir {backing}"
+            )
+            await sh(env, "remote.mount -dir /m -remote local.r1")
+            env.out = io.StringIO()
+            await sh(env, "fs.ls /m")
+            assert "one.txt" in env.out.getvalue()
+            # remote gains and loses files
+            (backing / "two.txt").write_bytes(b"22")
+            (backing / "one.txt").unlink()
+            env.out = io.StringIO()
+            await sh(env, "remote.meta.sync -dir /m")
+            assert "+1" in env.out.getvalue()
+            assert "-1" in env.out.getvalue()
+            env.out = io.StringIO()
+            await sh(env, "fs.ls /m")
+            out = env.out.getvalue()
+            assert "two.txt" in out and "one.txt" not in out
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_cluster_raft_ps_single_master(tmp_path):
+    async def go():
+        cluster, env = await make(tmp_path)
+        try:
+            await sh(env, "cluster.raft.ps")
+            out = env.out.getvalue()
+            assert "leader" in out
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_s3_bucket_lifecycle_and_quota(tmp_path):
+    async def go():
+        cluster, env = await make(tmp_path)
+        try:
+            await sh(env, "s3.bucket.create -name demo")
+            env.out = io.StringIO()
+            await sh(env, "s3.bucket.list")
+            assert "demo" in env.out.getvalue()
+            await sh(env, "s3.bucket.quota -name demo -sizeMB 1")
+            # over-fill the 1MB quota
+            await put(cluster, "/buckets/demo/big.bin", os.urandom(2 * 1024 * 1024))
+            env.out = io.StringIO()
+            await sh(env, "s3.bucket.quota.check -apply")
+            assert "OVER QUOTA" in env.out.getvalue()
+            await asyncio.sleep(2.1)  # filer.conf cache TTL
+            async with aiohttp.ClientSession() as s:
+                async with s.put(
+                    f"http://{cluster.filer.url}/buckets/demo/more.bin",
+                    data=b"x",
+                ) as r:
+                    assert r.status == 403  # bucket frozen
+            # shrink below quota -> rule lifted
+            await sh(env, "fs.rm /buckets/demo/big.bin")
+            env.out = io.StringIO()
+            await sh(env, "s3.bucket.quota.check -apply")
+            await asyncio.sleep(2.1)
+            async with aiohttp.ClientSession() as s:
+                async with s.put(
+                    f"http://{cluster.filer.url}/buckets/demo/more.bin",
+                    data=b"x",
+                ) as r:
+                    assert r.status in (200, 201)
+            await sh(env, "s3.bucket.delete -name demo")
+            env.out = io.StringIO()
+            await sh(env, "s3.bucket.list")
+            assert "demo" not in env.out.getvalue()
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_s3_configure_and_circuitbreaker(tmp_path):
+    async def go():
+        cluster, env = await make(tmp_path)
+        try:
+            await sh(
+                env,
+                "s3.configure -user alice -access_key AK1 -secret_key SK1 "
+                "-actions Read,Write -apply",
+            )
+            env.out = io.StringIO()
+            await sh(env, "s3.configure")
+            cfg = json.loads(env.out.getvalue())
+            assert cfg["identities"][0]["name"] == "alice"
+            assert cfg["identities"][0]["credentials"][0]["accessKey"] == "AK1"
+
+            await sh(
+                env,
+                "s3.circuitbreaker -global -actions Read -type Count "
+                "-values 100 -apply",
+            )
+            env.out = io.StringIO()
+            await sh(env, "s3.circuitbreaker")
+            cb = json.loads(env.out.getvalue())
+            assert cb["global"]["actions"]["Read:Count"] == 100
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_s3_clean_uploads(tmp_path):
+    async def go():
+        cluster, env = await make(tmp_path)
+        try:
+            from seaweedfs_tpu.pb import filer_pb2
+            from seaweedfs_tpu.s3api.server import UPLOADS_DIR
+
+            await sh(env, "s3.bucket.create -name up")
+            stub = env.filer_stub(await env.find_filer())
+            # fabricate an old dangling multipart upload
+            await stub.CreateEntry(
+                filer_pb2.CreateEntryRequest(
+                    directory=f"/buckets/up/{UPLOADS_DIR}",
+                    entry=filer_pb2.Entry(
+                        name="deadbeef", is_directory=True,
+                        attributes=filer_pb2.FuseAttributes(crtime=1000),
+                    ),
+                )
+            )
+            env.out = io.StringIO()
+            await sh(env, "s3.clean.uploads -timeAgo 1h")
+            assert "cleaned 1" in env.out.getvalue()
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_cluster_raft_membership(tmp_path):
+    """cluster.raft.add/remove drive live raft membership change."""
+    from tests.test_master_ha import free_ports, wait_leader
+
+    async def go():
+        from seaweedfs_tpu.server.master import MasterServer
+
+        ports = free_ports(3)
+        urls = [f"127.0.0.1:{p}" for p in ports]
+        # start a 2-node cluster; the third master starts with full peer
+        # list but isn't a member until cluster.raft.add
+        masters = []
+        for i, p in enumerate(ports[:2]):
+            m = MasterServer(
+                port=p, grpc_port=p + 10000, peers=list(urls[:2]),
+                meta_dir=str(tmp_path / f"m{i}"), pulse_seconds=1,
+            )
+            masters.append(m)
+        await asyncio.gather(*(m.start() for m in masters))
+        extra = MasterServer(
+            port=ports[2], grpc_port=ports[2] + 10000, peers=list(urls),
+            meta_dir=str(tmp_path / "m2"), pulse_seconds=1,
+            raft_join=True,  # non-voter until cluster.raft.add
+        )
+        await extra.start()
+        try:
+            leader = await wait_leader(masters)
+            env = CommandEnv([leader.advertise_url], out=io.StringIO())
+            await env.acquire_lock()
+            await sh(env, "cluster.raft.ps")
+            before = env.out.getvalue()
+            assert urls[2] + ":" not in before  # extra not a member yet
+
+            raft_id = extra.raft.id
+            assert not extra.raft.voter
+            await sh(env, f"cluster.raft.add -id {raft_id}")
+            env.out = io.StringIO()
+            await sh(env, "cluster.raft.ps")
+            assert raft_id in env.out.getvalue()
+            assert raft_id in leader.raft.peers
+            # the joiner receives the config entry via replication and is
+            # promoted to voter with the full member list
+            for _ in range(40):
+                if extra.raft.voter and len(extra.raft.peers) == 2:
+                    break
+                await asyncio.sleep(0.25)
+            assert extra.raft.voter
+            assert set(extra.raft.peers) == {m.raft.id for m in masters}
+
+            await sh(env, f"cluster.raft.remove -id {raft_id}")
+            env.out = io.StringIO()
+            await sh(env, "cluster.raft.ps")
+            assert raft_id not in env.out.getvalue()
+            assert raft_id not in leader.raft.peers
+        finally:
+            await asyncio.gather(
+                *(m.stop() for m in [*masters, extra]),
+                return_exceptions=True,
+            )
+
+    run(go())
+
+
+def test_s3_circuitbreaker_enforced(tmp_path):
+    """A Write:Count limit of 0 rejects every write with 503 SlowDown;
+    removing the rule restores service."""
+
+    async def go():
+        cluster = LocalCluster(
+            base_dir=str(tmp_path), n_volume_servers=1, pulse_seconds=1,
+            with_s3=True,
+        )
+        await cluster.start()
+        env = CommandEnv([cluster.master.advertise_url], out=io.StringIO())
+        await env.acquire_lock()
+        try:
+            s3 = f"http://{cluster.s3.url}"
+            async with aiohttp.ClientSession() as s:
+                async with s.put(f"{s3}/cbbucket") as r:
+                    assert r.status == 200
+            await sh(
+                env,
+                "s3.circuitbreaker -global -actions Write -type Count "
+                "-values 0 -apply",
+            )
+            await cluster.s3._load_cb_from_filer()
+            async with aiohttp.ClientSession() as s:
+                async with s.put(f"{s3}/cbbucket/x.bin", data=b"x") as r:
+                    assert r.status == 503
+                    assert "SlowDown" in await r.text()
+                # reads unaffected
+                async with s.get(f"{s3}/cbbucket?list-type=2") as r:
+                    assert r.status == 200
+            await sh(
+                env,
+                "s3.circuitbreaker -global -actions Write -type Count "
+                "-delete -apply",
+            )
+            await cluster.s3._load_cb_from_filer()
+            async with aiohttp.ClientSession() as s:
+                async with s.put(f"{s3}/cbbucket/x.bin", data=b"x") as r:
+                    assert r.status == 200
+        finally:
+            await cluster.stop()
+
+    run(go())
