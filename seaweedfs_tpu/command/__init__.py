@@ -16,11 +16,17 @@ from . import (
     download,
     export,
     filer,
+    filer_backup,
+    filer_cat,
+    filer_copy,
+    filer_meta_backup,
+    filer_meta_tail,
     filer_sync,
     fix,
     fsck,
     iam,
     master,
+    master_follower,
     mq_broker,
     mount,
     scaffold,
@@ -36,7 +42,9 @@ from . import (
 COMMANDS = {
     m.NAME: m
     for m in (
-        master, volume, filer, filer_sync, s3, iam, webdav, mount, mq_broker,
+        master, master_follower, volume, filer, filer_sync, filer_copy,
+        filer_cat, filer_backup, filer_meta_backup, filer_meta_tail,
+        s3, iam, webdav, mount, mq_broker,
         server, shell, fix, fsck, compact, export, backup, upload, download,
         benchmark, scaffold, version,
     )

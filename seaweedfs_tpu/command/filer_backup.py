@@ -1,0 +1,128 @@
+"""`filer.backup` — mirror filer DATA to a local directory
+(reference: weed/command/filer_backup.go, which streams metadata events
+into a local-disk sink).  First run replays the subtree from the filer;
+the metadata subscription then applies live creates/updates/deletes.
+Progress (the last applied event timestamp) persists in the target dir,
+so a restart resumes from where it stopped instead of re-copying."""
+from __future__ import annotations
+
+import os
+
+NAME = "filer.backup"
+HELP = "continuously mirror a filer path to a local directory"
+
+
+def add_args(p) -> None:
+    p.add_argument("-filer", required=True, help="filer host:port")
+    p.add_argument("-path", default="/", help="filer subtree to mirror")
+    p.add_argument("-dir", dest="target", required=True, help="local target dir")
+    p.add_argument(
+        "-oneTime", action="store_true",
+        help="stop after the initial replay instead of tailing forever",
+    )
+
+
+PROGRESS_FILE = ".filer_backup_progress"
+
+
+def _local_path(target: str, root: str, full: str) -> str:
+    rel = full[len(root):].strip("/")
+    return os.path.join(target, rel) if rel else target
+
+
+async def run(args) -> None:
+    import time
+
+    import aiohttp
+
+    from ..pb import Stub, channel, filer_pb2, server_address
+
+    root = "/" + args.path.strip("/") if args.path != "/" else "/"
+    filer_http = server_address.http_address(args.filer)
+    os.makedirs(args.target, exist_ok=True)
+    progress_path = os.path.join(args.target, PROGRESS_FILE)
+    since_ns = 0
+    if os.path.exists(progress_path):
+        with open(progress_path) as f:
+            since_ns = int(f.read().strip() or 0)
+
+    stub = Stub(
+        channel(server_address.grpc_address(args.filer)),
+        filer_pb2,
+        "SeaweedFiler",
+    )
+
+    async with aiohttp.ClientSession() as session:
+
+        async def fetch(full_path: str, local: str) -> None:
+            import urllib.parse
+
+            os.makedirs(os.path.dirname(local) or ".", exist_ok=True)
+            async with session.get(
+                f"http://{filer_http}{urllib.parse.quote(full_path)}"
+            ) as r:
+                if r.status >= 300:
+                    print(f"skip {full_path}: HTTP {r.status}")
+                    return
+                with open(local, "wb") as f:
+                    async for chunk in r.content.iter_chunked(1 << 20):
+                        f.write(chunk)
+
+        async def replay(directory: str) -> int:
+            from ..filer.client import list_all_entries
+
+            n = 0
+            for e in await list_all_entries(stub, directory):
+                full = f"{directory.rstrip('/')}/{e.name}"
+                local = _local_path(args.target, root, full)
+                if e.is_directory:
+                    os.makedirs(local, exist_ok=True)
+                    n += await replay(full)
+                else:
+                    await fetch(full, local)
+                    n += 1
+            return n
+
+        if since_ns == 0:
+            start_ns = time.time_ns()
+            n = await replay(root)
+            since_ns = start_ns
+            with open(progress_path, "w") as f:
+                f.write(str(since_ns))
+            print(f"initial replay: {n} files into {args.target}")
+        if args.oneTime:
+            return
+
+        print(f"tailing {root} on {filer_http} from ts {since_ns}")
+        async for ev in stub.SubscribeMetadata(
+            filer_pb2.SubscribeMetadataRequest(
+                client_name="filer.backup",
+                path_prefix=root if root != "/" else "",
+                since_ns=since_ns,
+            )
+        ):
+            note = ev.event_notification
+            directory = ev.directory
+            if note.HasField("old_entry") and (
+                not note.HasField("new_entry") or note.new_parent_path
+            ):
+                old_full = f"{directory.rstrip('/')}/{note.old_entry.name}"
+                local = _local_path(args.target, root, old_full)
+                if os.path.isdir(local):
+                    import shutil
+
+                    shutil.rmtree(local, ignore_errors=True)
+                elif os.path.exists(local):
+                    os.remove(local)
+                print(f"- {old_full}")
+            if note.HasField("new_entry"):
+                new_dir = note.new_parent_path or directory
+                full = f"{new_dir.rstrip('/')}/{note.new_entry.name}"
+                local = _local_path(args.target, root, full)
+                if note.new_entry.is_directory:
+                    os.makedirs(local, exist_ok=True)
+                else:
+                    await fetch(full, local)
+                print(f"+ {full}")
+            with open(progress_path, "w") as f:
+                f.write(str(ev.ts_ns))

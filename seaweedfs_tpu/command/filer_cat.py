@@ -1,0 +1,31 @@
+"""`filer.cat` — stream one filer file to stdout
+(reference: weed/command/filer_cat.go)."""
+from __future__ import annotations
+
+import sys
+
+NAME = "filer.cat"
+HELP = "write a filer file's bytes to stdout"
+
+
+def add_args(p) -> None:
+    p.add_argument("url", help="filer file url: http://host:port/path/to/file")
+
+
+async def run(args) -> None:
+    import aiohttp
+
+    from .filer_copy import _dest_parts
+
+    import urllib.parse
+
+    filer, path = _dest_parts(args.url)
+    async with aiohttp.ClientSession() as session:
+        async with session.get(
+            f"http://{filer}{urllib.parse.quote(path)}"
+        ) as r:
+            if r.status >= 300:
+                raise RuntimeError(f"{path}: HTTP {r.status}")
+            async for chunk in r.content.iter_chunked(1 << 20):
+                sys.stdout.buffer.write(chunk)
+    sys.stdout.buffer.flush()

@@ -184,6 +184,22 @@ class WeedFS:
 
         return await list_all_entries(self._stub(), directory)
 
+    async def _subtree_size(self, directory: str) -> int:
+        """Total file bytes under a directory (quota accounting)."""
+        total = 0
+        for e in await self._list(directory):
+            if e.is_directory:
+                total += await self._subtree_size(
+                    f"{directory.rstrip('/')}/{e.name}"
+                )
+            else:
+                total += max(
+                    e.attributes.file_size,
+                    sum(int(c.size) for c in e.chunks),
+                    len(e.content),
+                )
+        return total
+
     def forget_inode(self, ino: int, nlookup: int) -> None:
         self.inodes.forget(ino, nlookup)
 
@@ -288,20 +304,28 @@ class WeedFS:
             total, used, files = 1 << 40, 0, 0
         try:
             # mount.configure quota on the mount root caps the reported fs
-            # size (reference mount_std.go quota + weedfs_stats.go); 2s TTL
-            # cache — statfs is kernel-hot and the quota changes rarely
+            # size, with `used` scoped to the SUBTREE (global cluster usage
+            # against a per-mount quota would read as a full disk).  2s TTL
+            # cache — statfs is kernel-hot and the numbers change slowly
+            # (reference mount_std.go quota + weedfs_stats.go).
             import time as _time
 
             now = _time.monotonic()
             cached = getattr(self, "_quota_cache", None)
-            if cached is None or now - cached[1] > 2.0:
+            if cached is None or now - cached[2] > 2.0:
                 root_entry = await self._find(self.inodes.root)
                 quota_mb = int(
                     (root_entry.extended.get("mount.quota_mb") or b"0").decode()
                 )
-                self._quota_cache = cached = (quota_mb, now)
+                subtree_used = (
+                    await self._subtree_size(self.inodes.root)
+                    if quota_mb > 0
+                    else 0
+                )
+                self._quota_cache = cached = (quota_mb, subtree_used, now)
             if cached[0] > 0:
                 total = cached[0] * 1024 * 1024
+                used = cached[1]
         except Exception:  # noqa: BLE001
             pass
         bsize = 4096

@@ -276,6 +276,12 @@ class RaftNode:
 
     # ----------------------------------------------------------- membership
 
+    def same_node(self, a: str, b: str) -> bool:
+        """Node identity comparison through the dial mapping: 'host:port'
+        and 'host:port.grpc' flag/advertise forms of one server must not
+        read as two members."""
+        return a == b or self.dial_fn(a) == self.dial_fn(b)
+
     def apply_config(self, members: list[str]) -> None:
         """Membership change, called when a raft_conf log entry commits.
         The entry carries the COMPLETE member list so every replica —
@@ -283,7 +289,8 @@ class RaftNode:
         same configuration.  One add/remove at a time keeps old and new
         quorums overlapping (the hashicorp AddVoter/RemoveServer
         discipline the reference relies on)."""
-        new_peers = [m for m in members if m != self.id]
+        is_member = any(self.same_node(m, self.id) for m in members)
+        new_peers = [m for m in members if not self.same_node(m, self.id)]
         if self.state == LEADER:
             li, _ = self.last_log()
             for p in new_peers:
@@ -295,7 +302,7 @@ class RaftNode:
                     self.next_index.pop(p, None)
                     self.match_index.pop(p, None)
         self.peers = new_peers
-        if self.id in members:
+        if is_member:
             self.voter = True  # a joining server is promoted on commit
         elif self.voter and self.state != LEADER:
             self.voter = False  # removed: stop campaigning

@@ -22,7 +22,23 @@ class CircuitBreaker:
         self._inflight: dict[tuple[str, str, str], int] = {}
 
     def load(self, blob: bytes) -> None:
-        self.cfg = json.loads(blob) if blob else {}
+        """Parse + validate; malformed limit values are dropped at load
+        time (a bad hand-edit must not 500 every request at acquire time)."""
+        cfg = json.loads(blob) if blob else {}
+        for scope_cfg in [
+            cfg.get("global") or {},
+            *(cfg.get("buckets") or {}).values(),
+        ]:
+            actions = scope_cfg.get("actions")
+            if not isinstance(actions, dict):
+                scope_cfg.pop("actions", None)
+                continue
+            for key in list(actions):
+                try:
+                    actions[key] = int(actions[key])
+                except (TypeError, ValueError):
+                    del actions[key]
+        self.cfg = cfg
 
     def _limits(self, bucket: str, action: str):
         """Yield (scope_key, limit_type, limit, cost_multiplier_key)."""
@@ -38,12 +54,20 @@ class CircuitBreaker:
                 if act in (action, "Total"):
                     yield scope_key, act, ltype, int(limit)
 
-    def acquire(self, bucket: str, action: str, content_length: int):
-        """Reserve capacity or raise; returns a release() callable."""
+    def acquire(self, bucket: str, action: str, content_length: int | None):
+        """Reserve capacity or raise; returns a release() callable.
+        `content_length=None` (chunked upload) under an MB limit is
+        rejected — an unbounded body must not slip past a byte cap."""
         costs = {"Count": 1, "MB": content_length}
         taken: list[tuple[tuple[str, str, str], int]] = []
         for scope, act, ltype, limit in self._limits(bucket, action):
             cost = costs.get(ltype)
+            if ltype == "MB" and cost is None:
+                for kk, cc in taken:
+                    self._inflight[kk] -= cc
+                raise CircuitBreakerError(
+                    "Content-Length required under an MB limit"
+                )
             if cost is None:
                 continue
             limit_abs = limit * 1024 * 1024 if ltype == "MB" else limit

@@ -200,10 +200,15 @@ class S3ApiServer:
             # per-bucket (s3api_circuit_breaker.go Limit)
             m = request.method
             action = "Read" if m in ("GET", "HEAD") else "Write"
+            # body-less methods cost 0 bytes; body methods with NO length
+            # (chunked) pass None so an MB limit can reject them
+            length = (
+                request.content_length
+                if m in ("PUT", "POST")
+                else (request.content_length or 0)
+            )
             try:
-                release = self.circuit_breaker.acquire(
-                    bucket, action, request.content_length or 0
-                )
+                release = self.circuit_breaker.acquire(bucket, action, length)
             except CircuitBreakerError as e:
                 code = 503
                 return _error_response("SlowDown", str(e), 503)

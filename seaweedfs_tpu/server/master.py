@@ -687,8 +687,12 @@ class MasterServer:
             await context.abort(
                 grpc.StatusCode.FAILED_PRECONDITION, "raft not enabled"
             )
-        members = sorted({self.raft.id, *self.raft.peers, request.id})
-        await self.raft.propose({"op": "raft_conf", "members": members})
+        members = [self.raft.id, *self.raft.peers]
+        if not any(self.raft.same_node(m, request.id) for m in members):
+            members.append(request.id)
+        await self.raft.propose(
+            {"op": "raft_conf", "members": sorted(members)}
+        )
         return master_pb2.RaftAddServerResponse()
 
     async def RaftRemoveServer(self, request, context):
@@ -699,13 +703,15 @@ class MasterServer:
             await context.abort(
                 grpc.StatusCode.FAILED_PRECONDITION, "raft not enabled"
             )
-        if request.id == self.raft.id:
+        if self.raft.same_node(request.id, self.raft.id):
             await context.abort(
                 grpc.StatusCode.FAILED_PRECONDITION,
                 "cannot remove the current leader; transfer leadership first",
             )
         members = sorted(
-            {self.raft.id, *self.raft.peers} - {request.id}
+            m
+            for m in [self.raft.id, *self.raft.peers]
+            if not self.raft.same_node(m, request.id)
         )
         await self.raft.propose({"op": "raft_conf", "members": members})
         return master_pb2.RaftRemoveServerResponse()

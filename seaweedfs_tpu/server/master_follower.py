@@ -1,0 +1,155 @@
+"""Read-only master follower.
+
+Reference: weed/command/master_follower.go — a lookup-only master that
+does NOT join raft: it mirrors the leader's volume locations over the
+KeepConnected stream (wdclient vidMap) and serves /dir/lookup (HTTP) and
+LookupVolume (gRPC) locally, offloading read traffic from the leader.
+Assign and every other control-plane verb proxy to the real leader.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+
+import grpc
+from aiohttp import web
+
+from ..pb import Stub, channel, generic_handler, master_pb2, server_address
+from ..pb.rpc import GRPC_OPTIONS
+from ..wdclient import MasterClient
+
+log = logging.getLogger("master-follower")
+
+
+class MasterFollowerServer:
+    def __init__(
+        self,
+        masters: list[str],
+        ip: str = "127.0.0.1",
+        port: int = 9334,
+        grpc_port: int = 0,
+    ):
+        self.masters = masters
+        self.ip = ip
+        self.port = port
+        self.grpc_port = grpc_port or (port + 10000 if port else 0)
+        self.master_client = MasterClient(
+            masters, client_type="master_follower",
+            client_address=f"{ip}:{port}",
+        )
+        self._grpc_server: grpc.aio.Server | None = None
+        self._http_runner: web.AppRunner | None = None
+
+    @property
+    def url(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    @property
+    def advertise_url(self) -> str:
+        return f"{self.ip}:{self.port}.{self.grpc_port}"
+
+    async def start(self) -> None:
+        self._grpc_server = grpc.aio.server(options=GRPC_OPTIONS)
+        self._grpc_server.add_generic_rpc_handlers(
+            [generic_handler(master_pb2, "Seaweed", self)]
+        )
+        self.grpc_port = self._grpc_server.add_insecure_port(
+            f"{self.ip}:{self.grpc_port}"
+        )
+        await self._grpc_server.start()
+
+        app = web.Application()
+        app.router.add_get("/dir/lookup", self.h_lookup)
+        app.router.add_get("/cluster/status", self.h_cluster_status)
+        self._http_runner = web.AppRunner(app)
+        await self._http_runner.setup()
+        site = web.TCPSite(self._http_runner, self.ip, self.port)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+
+        await self.master_client.start()
+        log.info(
+            "master follower on %s (following %s)", self.url, self.masters
+        )
+
+    async def stop(self) -> None:
+        await self.master_client.stop()
+        if self._grpc_server:
+            await self._grpc_server.stop(grace=0.5)
+        if self._http_runner:
+            await self._http_runner.cleanup()
+
+    # ---------------------------------------------------------------- reads
+
+    def _lookup(self, vof: str):
+        vid = int(str(vof).split(",")[0])
+        return self.master_client.vid_map.lookup(vid)
+
+    async def LookupVolume(self, request, context):
+        resp = master_pb2.LookupVolumeResponse()
+        for vof in request.volume_or_file_ids:
+            entry = resp.volume_id_locations.add()
+            entry.volume_or_file_id = str(vof)
+            try:
+                locs = self._lookup(vof)
+            except ValueError:
+                entry.error = f"invalid volume id {vof!r}"
+                continue
+            if not locs:
+                entry.error = f"volume {vof} not found"
+                continue
+            for l in locs:
+                entry.locations.add(url=l.url, public_url=l.public_url or l.url)
+        return resp
+
+    async def h_lookup(self, request: web.Request) -> web.Response:
+        vof = request.query.get("volumeId", "")
+        try:
+            locs = self._lookup(vof)
+        except ValueError:
+            locs = []
+        if not locs:
+            return web.json_response(
+                {"volumeOrFileId": vof, "error": "not found"}, status=404
+            )
+        return web.json_response(
+            {
+                "volumeOrFileId": vof,
+                "locations": [
+                    {"url": l.url, "publicUrl": l.public_url or l.url}
+                    for l in locs
+                ],
+            }
+        )
+
+    async def h_cluster_status(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "IsLeader": False,
+                "Leader": self.master_client.current_master,
+                "Peers": self.masters,
+            }
+        )
+
+    # ------------------------------------------------- control-plane proxy
+
+    def _leader_stub(self) -> Stub:
+        return Stub(
+            channel(
+                server_address.grpc_address(self.master_client.current_master)
+            ),
+            master_pb2,
+            "Seaweed",
+        )
+
+    async def Assign(self, request, context):
+        return await self._leader_stub().Assign(request)
+
+    async def Statistics(self, request, context):
+        return await self._leader_stub().Statistics(request)
+
+    async def VolumeList(self, request, context):
+        return await self._leader_stub().VolumeList(request)
+
+    async def ListClusterNodes(self, request, context):
+        return await self._leader_stub().ListClusterNodes(request)

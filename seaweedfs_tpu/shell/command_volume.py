@@ -506,10 +506,82 @@ async def _fetch_needle_states(
     return alive, deleted, resurrected
 
 
+async def _check_disk_one_volume(env, http, vid, replicas, apply) -> int:
+    """Cross-check ONE volume's replicas and (with apply) sync them.
+    Returns the number of out-of-sync needles found."""
+    synced = 0
+    collection = replicas[0][1]["collection"]
+    states = [
+        await _fetch_needle_states(env, n, vid, collection)
+        for n, _ in replicas
+    ]
+    alive = [s[0] for s in states]
+    # deletions win: if ANY replica tombstoned a needle, propagate the
+    # delete (reference doVolumeCheckDisk syncs deletions, not just
+    # additions — an add-only sync would resurrect deleted files).
+    # EXCEPT when some replica shows a delete-then-re-add history for
+    # the id: the re-add is causally after the delete that the stale
+    # tombstone echoes, so the newest write must not be destroyed.
+    all_resurrected = set().union(*(s[2] for s in states))
+    all_deleted = set().union(*(s[1] for s in states)) - all_resurrected
+    for j, (dst_node, _) in enumerate(replicas):
+        for nid in sorted(all_deleted & set(alive[j])):
+            env.write(
+                f"volume {vid}: needle {nid:x} deleted elsewhere, "
+                f"still alive on {dst_node.url}"
+            )
+            if apply:
+                blob = await env.volume_stub(
+                    dst_node.grpc_address
+                ).ReadNeedleBlob(
+                    volume_server_pb2.ReadNeedleBlobRequest(
+                        volume_id=vid, needle_id=nid
+                    )
+                )
+                fid = f"{vid},{nid:x}{blob.cookie:08x}"
+                await http.delete(f"http://{dst_node.url}/{fid}")
+                del alive[j][nid]
+            synced += 1
+    for i, (src_node, _) in enumerate(replicas):
+        for j, (dst_node, _) in enumerate(replicas):
+            if i == j:
+                continue
+            missing = set(alive[i]) - set(alive[j]) - all_deleted
+            for nid in sorted(missing):
+                env.write(
+                    f"volume {vid}: needle {nid:x} on {src_node.url} "
+                    f"missing from {dst_node.url}"
+                )
+                if apply:
+                    blob = await env.volume_stub(
+                        src_node.grpc_address
+                    ).ReadNeedleBlob(
+                        volume_server_pb2.ReadNeedleBlobRequest(
+                            volume_id=vid, needle_id=nid
+                        )
+                    )
+                    await env.volume_stub(
+                        dst_node.grpc_address
+                    ).WriteNeedleBlob(
+                        volume_server_pb2.WriteNeedleBlobRequest(
+                            volume_id=vid,
+                            needle_id=nid,
+                            needle_blob=blob.needle_blob,
+                            cookie=blob.cookie,
+                            last_modified=blob.last_modified,
+                        )
+                    )
+                    alive[j][nid] = alive[i][nid]
+                synced += 1
+    return synced
+
+
 @command("volume.check.disk")
 async def cmd_volume_check_disk(env, args):
     """[-volumeId N] [-force] : cross-check replicas of each volume and sync
     missing needles both ways (command_volume_check_disk.go)"""
+    import aiohttp
+
     env.confirm_is_locked()
     flags = parse_flags(args)
     only_vid = int(flags.get("volumeId", 0))
@@ -519,81 +591,16 @@ async def cmd_volume_check_disk(env, args):
     for n in nodes:
         for v in n.volumes:
             by_vid.setdefault(v["id"], []).append((n, v))
-    import aiohttp
-
     synced = 0
     async with aiohttp.ClientSession() as http:
-      for vid, replicas in sorted(by_vid.items()):
-        if only_vid and vid != only_vid:
-            continue
-        if len(replicas) < 2:
-            continue
-        collection = replicas[0][1]["collection"]
-        states = [
-            await _fetch_needle_states(env, n, vid, collection)
-            for n, _ in replicas
-        ]
-        alive = [s[0] for s in states]
-        # deletions win: if ANY replica tombstoned a needle, propagate the
-        # delete (reference doVolumeCheckDisk syncs deletions, not just
-        # additions — an add-only sync would resurrect deleted files).
-        # EXCEPT when some replica shows a delete-then-re-add history for
-        # the id: the re-add is causally after the delete that the stale
-        # tombstone echoes, so the newest write must not be destroyed.
-        all_resurrected = set().union(*(s[2] for s in states))
-        all_deleted = (
-            set().union(*(s[1] for s in states)) - all_resurrected
-        )
-        if True:
-            for j, (dst_node, _) in enumerate(replicas):
-                for nid in sorted(all_deleted & set(alive[j])):
-                    env.write(
-                        f"volume {vid}: needle {nid:x} deleted elsewhere, "
-                        f"still alive on {dst_node.url}"
-                    )
-                    if apply:
-                        blob = await env.volume_stub(
-                            dst_node.grpc_address
-                        ).ReadNeedleBlob(
-                            volume_server_pb2.ReadNeedleBlobRequest(
-                                volume_id=vid, needle_id=nid
-                            )
-                        )
-                        fid = f"{vid},{nid:x}{blob.cookie:08x}"
-                        await http.delete(f"http://{dst_node.url}/{fid}")
-                        del alive[j][nid]
-                    synced += 1
-        for i, (src_node, _) in enumerate(replicas):
-            for j, (dst_node, _) in enumerate(replicas):
-                if i == j:
-                    continue
-                missing = set(alive[i]) - set(alive[j]) - all_deleted
-                for nid in sorted(missing):
-                    env.write(
-                        f"volume {vid}: needle {nid:x} on {src_node.url} "
-                        f"missing from {dst_node.url}"
-                    )
-                    if apply:
-                        blob = await env.volume_stub(
-                            src_node.grpc_address
-                        ).ReadNeedleBlob(
-                            volume_server_pb2.ReadNeedleBlobRequest(
-                                volume_id=vid, needle_id=nid
-                            )
-                        )
-                        await env.volume_stub(
-                            dst_node.grpc_address
-                        ).WriteNeedleBlob(
-                            volume_server_pb2.WriteNeedleBlobRequest(
-                                volume_id=vid,
-                                needle_id=nid,
-                                needle_blob=blob.needle_blob,
-                                cookie=blob.cookie,
-                                last_modified=blob.last_modified,
-                            )
-                        )
-                        alive[j][nid] = alive[i][nid]
-                    synced += 1
+        for vid, replicas in sorted(by_vid.items()):
+            if only_vid and vid != only_vid:
+                continue
+            if len(replicas) < 2:
+                continue
+            synced += await _check_disk_one_volume(
+                env, http, vid, replicas, apply
+            )
     env.write(
         f"{synced} needles {'synced' if apply else 'out of sync (use -force)'}"
     )

@@ -1,0 +1,204 @@
+"""filer.copy / filer.cat / filer.backup / filer.meta.backup /
+filer.meta.tail / master.follower CLI commands (reference:
+weed/command/filer_copy.go, filer_cat.go, filer_backup.go,
+filer_meta_backup.go, filer_meta_tail.go, master_follower.go)."""
+import argparse
+import asyncio
+import json
+import os
+
+import aiohttp
+
+from seaweedfs_tpu.command import COMMANDS
+from seaweedfs_tpu.server.cluster import LocalCluster
+
+
+def run_cmd(name, argv):
+    mod = COMMANDS[name]
+    p = argparse.ArgumentParser()
+    mod.add_args(p)
+    args = p.parse_args(argv)
+    return mod.run(args)
+
+
+async def make(tmp_path):
+    cluster = LocalCluster(
+        base_dir=str(tmp_path / "cluster"), n_volume_servers=1,
+        pulse_seconds=1, with_filer=True,
+    )
+    await cluster.start()
+    return cluster
+
+
+def test_filer_copy_and_cat(tmp_path, capsys):
+    async def go():
+        cluster = await make(tmp_path)
+        try:
+            src = tmp_path / "src"
+            (src / "sub").mkdir(parents=True)
+            (src / "a.txt").write_bytes(b"alpha")
+            (src / "sub" / "b.txt").write_bytes(b"beta" * 1000)
+            await run_cmd(
+                "filer.copy",
+                [str(src), f"http://{cluster.filer.url}/data/"],
+            )
+            out = capsys.readouterr().out
+            assert "copied 2 files" in out
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"http://{cluster.filer.url}/data/src/sub/b.txt"
+                ) as r:
+                    assert r.status == 200
+                    assert await r.read() == b"beta" * 1000
+            await run_cmd(
+                "filer.cat", [f"http://{cluster.filer.url}/data/src/a.txt"]
+            )
+        finally:
+            await cluster.stop()
+
+    asyncio.run(go())
+    assert "alpha" in capsys.readouterr().out
+
+
+def test_filer_backup_one_time(tmp_path, capsys):
+    async def go():
+        cluster = await make(tmp_path)
+        try:
+            async with aiohttp.ClientSession() as s:
+                for path, data in [
+                    ("/tree/x.bin", os.urandom(2048)),
+                    ("/tree/deep/y.bin", b"yy" * 500),
+                ]:
+                    async with s.put(
+                        f"http://{cluster.filer.url}{path}", data=data
+                    ) as r:
+                        assert r.status in (200, 201)
+            target = tmp_path / "mirror"
+            await run_cmd(
+                "filer.backup",
+                [
+                    "-filer", f"{cluster.filer.url}.{cluster.filer.grpc_port}",
+                    "-path", "/tree",
+                    "-dir", str(target), "-oneTime",
+                ],
+            )
+            assert (target / "deep" / "y.bin").read_bytes() == b"yy" * 500
+            assert (target / "x.bin").stat().st_size == 2048
+        finally:
+            await cluster.stop()
+
+    asyncio.run(go())
+
+
+def test_filer_meta_backup_and_restore(tmp_path, capsys):
+    async def go():
+        cluster = await make(tmp_path)
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.put(
+                    f"http://{cluster.filer.url}/meta/doc.txt", data=b"d" * 100
+                ) as r:
+                    assert r.status in (200, 201)
+            store = str(tmp_path / "meta.db")
+            await run_cmd(
+                "filer.meta.backup",
+                ["-filer", f"{cluster.filer.url}.{cluster.filer.grpc_port}",
+                 "-store", store, "-oneTime"],
+            )
+            from seaweedfs_tpu.command.filer_meta_backup import (
+                open_store,
+                restore_entry,
+            )
+
+            db = open_store(store)
+            e = restore_entry(db, "/meta/doc.txt")
+            assert e is not None and e.attributes.file_size == 100
+            db.close()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(go())
+
+
+def test_filer_meta_tail(tmp_path, capsys):
+    async def go():
+        cluster = await make(tmp_path)
+        try:
+            async def writer():
+                await asyncio.sleep(0.4)
+                async with aiohttp.ClientSession() as s:
+                    await s.put(
+                        f"http://{cluster.filer.url}/tailed/new.txt",
+                        data=b"n",
+                    )
+
+            w = asyncio.create_task(writer())
+            await run_cmd(
+                "filer.meta.tail",
+                [
+                    "-filer", f"{cluster.filer.url}.{cluster.filer.grpc_port}",
+                    "-pathPrefix", "/tailed",
+                    "-timeoutSec", "2.5",
+                ],
+            )
+            await w
+        finally:
+            await cluster.stop()
+
+    asyncio.run(go())
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.startswith("{")]
+    assert any(
+        json.loads(l).get("new_entry", {}).get("name") == "new.txt"
+        for l in lines
+    )
+
+
+def test_master_follower_lookup(tmp_path):
+    async def go():
+        from seaweedfs_tpu.operation import assign, upload_data
+        from seaweedfs_tpu.server.master_follower import MasterFollowerServer
+
+        cluster = await make(tmp_path)
+        follower = None
+        try:
+            a = await assign(cluster.master.advertise_url)
+            await upload_data(f"http://{a.url}/{a.fid}", b"follow-me")
+            vid = a.fid.split(",")[0]
+            follower = MasterFollowerServer(
+                masters=[cluster.master.advertise_url], port=0, grpc_port=0
+            )
+            await follower.start()
+            await follower.master_client.wait_connected()
+            # the follower learns locations via KeepConnected broadcast
+            for _ in range(40):
+                if follower.master_client.vid_map.lookup(int(vid)):
+                    break
+                await asyncio.sleep(0.25)
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"http://{follower.url}/dir/lookup?volumeId={vid}"
+                ) as r:
+                    assert r.status == 200
+                    doc = await r.json()
+                    assert doc["locations"], doc
+            # gRPC surface too
+            from seaweedfs_tpu.pb import Stub, master_pb2
+            from seaweedfs_tpu.pb.rpc import channel
+
+            stub = Stub(
+                channel(f"{follower.ip}:{follower.grpc_port}"),
+                master_pb2, "Seaweed",
+            )
+            resp = await stub.LookupVolume(
+                master_pb2.LookupVolumeRequest(volume_or_file_ids=[vid])
+            )
+            assert resp.volume_id_locations[0].locations
+            # control-plane verbs proxy to the real leader
+            a2 = await stub.Assign(master_pb2.AssignRequest(count=1))
+            assert a2.fid
+        finally:
+            if follower is not None:
+                await follower.stop()
+            await cluster.stop()
+
+    asyncio.run(go())

@@ -385,13 +385,22 @@ def test_cluster_raft_membership(tmp_path):
                     break
                 await asyncio.sleep(0.25)
             assert extra.raft.voter
-            assert set(extra.raft.peers) == {m.raft.id for m in masters}
+            # id forms may mix flag-form and advertise-form strings for
+            # the same node; compare canonically through the dial mapping
+            from seaweedfs_tpu.pb import server_address
+
+            canon = server_address.grpc_address
+            assert {canon(p) for p in extra.raft.peers} == {
+                canon(m.raft.id) for m in masters
+            }
 
             await sh(env, f"cluster.raft.remove -id {raft_id}")
             env.out = io.StringIO()
             await sh(env, "cluster.raft.ps")
             assert raft_id not in env.out.getvalue()
-            assert raft_id not in leader.raft.peers
+            assert all(
+                canon(p) != canon(raft_id) for p in leader.raft.peers
+            )
         finally:
             await asyncio.gather(
                 *(m.stop() for m in [*masters, extra]),
