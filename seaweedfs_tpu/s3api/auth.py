@@ -1,12 +1,15 @@
-"""S3 authentication: AWS Signature V4 (header + presigned query) and the
-identity/action model.
+"""S3 authentication: AWS Signature V4 (header + presigned query +
+streaming chunk chain), legacy Signature V2 (header + presigned), POST
+policy verification, and the identity/action model.
 
 Reference: weed/s3api/auth_signature_v4.go (771 LoC — canonical request,
-string-to-sign, signing-key chain), auth_credentials.go (identity config,
-per-bucket actions).  Signature V2 is legacy and intentionally omitted.
+string-to-sign, signing-key chain), auth_signature_v2.go,
+chunked_reader_v4.go, s3api_object_handlers_postpolicy.go,
+auth_credentials.go (identity config, per-bucket actions).
 """
 from __future__ import annotations
 
+import base64
 import calendar
 import hashlib
 import hmac
@@ -166,6 +169,10 @@ class IdentityAccessManagement:
             return self._verify_header_sig(request, auth_header)
         if request.query.get("X-Amz-Algorithm") == "AWS4-HMAC-SHA256":
             return self._verify_presigned(request)
+        if auth_header.startswith("AWS "):
+            return self._verify_v2_header(request, auth_header)
+        if "Signature" in request.query and "AWSAccessKeyId" in request.query:
+            return self._verify_v2_presigned(request)
         anon = next((i for i in self.identities if i.name == "anonymous"), None)
         if anon is not None:
             return anon
@@ -204,6 +211,13 @@ class IdentityAccessManagement:
         )
         if not hmac.compare_digest(expect, got_sig):
             raise S3AuthError("SignatureDoesNotMatch", "signature mismatch")
+        if payload_hash == STREAMING_PAYLOAD:
+            # the seed signature anchors each chunk's signature chain;
+            # the body reader verifies every chunk against this context
+            # (chunked_reader_v4.go)
+            request["s3_chunk_ctx"] = (
+                secret, datestamp, region, service, amz_date, got_sig,
+            )
         return identity
 
     def _verify_presigned(self, request) -> Identity:
@@ -235,6 +249,129 @@ class IdentityAccessManagement:
         return identity
 
 
+    # ------------------------------------------------- signature V2 (legacy)
+
+    def _verify_v2_header(self, request, auth_header: str) -> Identity:
+        """Authorization: AWS AccessKey:Base64(HMAC-SHA1(StringToSign))
+        (auth_signature_v2.go)."""
+        access_key, _, got_sig = auth_header[4:].strip().partition(":")
+        identity, secret = self.lookup(access_key)
+        _check_skew_v2(request.headers)
+        expect = _signature_v2(secret, _string_to_sign_v2(request))
+        if not hmac.compare_digest(expect, got_sig):
+            raise S3AuthError("SignatureDoesNotMatch", "signature mismatch")
+        return identity
+
+    def _verify_v2_presigned(self, request) -> Identity:
+        """?AWSAccessKeyId=..&Expires=epoch&Signature=.. query auth."""
+        q = request.query
+        try:
+            expires = int(q["Expires"])
+        except (KeyError, ValueError):
+            raise S3AuthError("AccessDenied", "bad Expires")
+        if expires < time.time():
+            raise S3AuthError("AccessDenied", "request has expired")
+        identity, secret = self.lookup(q["AWSAccessKeyId"])
+        expect = _signature_v2(
+            secret, _string_to_sign_v2(request, date_value=str(expires))
+        )
+        if not hmac.compare_digest(expect, q["Signature"]):
+            raise S3AuthError("SignatureDoesNotMatch", "signature mismatch")
+        return identity
+
+    # ---------------------------------------------------------- POST policy
+
+    def verify_post_policy(self, fields: dict) -> Identity | None:
+        """Authenticate a browser-form POST upload from its form fields
+        (s3api_object_handlers_postpolicy.go).  Returns the Identity, or
+        None when auth is disabled."""
+        if not self.enabled:
+            return None
+        policy_b64 = fields.get("policy", "")
+        if not policy_b64:
+            raise S3AuthError("AccessDenied", "POST without policy")
+        if "x-amz-signature" in fields:  # V4-signed form
+            try:
+                credential = fields["x-amz-credential"]
+                amz_date = fields["x-amz-date"]
+                got_sig = fields["x-amz-signature"]
+                access_key, datestamp, region, service, _ = credential.split("/")
+            except (KeyError, ValueError):
+                raise S3AuthError("AccessDenied", "malformed POST credential")
+            identity, secret = self.lookup(access_key)
+            key = _signing_key(secret, datestamp, region, service)
+            expect = hmac.new(key, policy_b64.encode(), hashlib.sha256).hexdigest()
+            if not hmac.compare_digest(expect, got_sig):
+                raise S3AuthError("SignatureDoesNotMatch", "policy signature mismatch")
+            return identity
+        if "signature" in fields and "AWSAccessKeyId" in fields:  # V2 form
+            identity, secret = self.lookup(fields["AWSAccessKeyId"])
+            expect = _signature_v2(secret, policy_b64)
+            if not hmac.compare_digest(expect, fields["signature"]):
+                raise S3AuthError("SignatureDoesNotMatch", "policy signature mismatch")
+            return identity
+        raise S3AuthError("AccessDenied", "POST form carries no signature")
+
+
+# v2 sub-resources that participate in the canonical resource
+# (auth_signature_v2.go resourceList)
+_V2_SUBRESOURCES = (
+    "acl", "delete", "lifecycle", "location", "logging", "notification",
+    "partNumber", "policy", "requestPayment", "response-cache-control",
+    "response-content-disposition", "response-content-encoding",
+    "response-content-language", "response-content-type", "response-expires",
+    "tagging", "torrent", "uploadId", "uploads", "versionId", "versioning",
+    "versions", "website",
+)
+
+
+def _string_to_sign_v2(request, date_value: str | None = None) -> str:
+    h = request.headers
+    if date_value is None:
+        # x-amz-date supersedes Date, in which case Date's slot is empty
+        date_value = "" if "x-amz-date" in h else h.get("Date", "")
+    amz = sorted(
+        (k.lower(), v.strip())
+        for k, v in h.items()
+        if k.lower().startswith("x-amz-")
+    )
+    canonical_amz = "".join(f"{k}:{v}\n" for k, v in amz)
+    sub = sorted(k for k in request.query if k in _V2_SUBRESOURCES)
+    resource = request.path
+    if sub:
+        resource += "?" + "&".join(
+            k if not request.query[k] else f"{k}={request.query[k]}"
+            for k in sub
+        )
+    return (
+        f"{request.method}\n{h.get('Content-MD5', '')}\n"
+        f"{h.get('Content-Type', '')}\n{date_value}\n"
+        f"{canonical_amz}{resource}"
+    )
+
+
+def _signature_v2(secret: str, string_to_sign: str) -> str:
+    return base64.b64encode(
+        hmac.new(secret.encode(), string_to_sign.encode(), hashlib.sha1).digest()
+    ).decode()
+
+
+def _check_skew_v2(headers) -> None:
+    """The 15-minute replay window applies to V2 too; the signed Date /
+    x-amz-date must be fresh (AWS RequestTimeTooSkewed semantics)."""
+    raw = headers.get("x-amz-date") or headers.get("Date", "")
+    for fmt in ("%a, %d %b %Y %H:%M:%S GMT", "%Y%m%dT%H%M%SZ"):
+        try:
+            t = time.strptime(raw, fmt)
+            break
+        except ValueError:
+            continue
+    else:
+        raise S3AuthError("AccessDenied", f"bad request date {raw!r}")
+    if abs(calendar.timegm(t) - time.time()) > MAX_SKEW_SECONDS:
+        raise S3AuthError("RequestTimeTooSkewed", "request time too skewed")
+
+
 MAX_SKEW_SECONDS = 15 * 60  # the reference's 15-minute window
 
 
@@ -263,29 +400,91 @@ async def verify_payload_hash(request) -> bytes | None:
     return body
 
 
-def decode_aws_chunked(data: bytes) -> bytes:
-    """Strip aws-chunked framing:
-    `<hex-size>;chunk-signature=<sig>\\r\\n<data>\\r\\n...0;...\\r\\n\\r\\n`
-    (reference chunked_reader_v4.go).  Per-chunk signatures are not
-    re-verified — the seed signature authenticated the sender and the
-    filer checksums the stored data."""
-    out = bytearray()
+def _iter_aws_chunks(data: bytes):
+    """Yield (chunk_bytes, chunk_signature_hex) per frame, ending with the
+    zero-length terminal frame."""
     pos = 0
     while pos < len(data):
         nl = data.find(b"\r\n", pos)
         if nl < 0:
             break
         header = data[pos:nl]
-        size_hex = header.split(b";", 1)[0]
+        size_hex, _, attrs = header.partition(b";")
+        sig = b""
+        for kv in attrs.split(b";"):
+            k, _, v = kv.partition(b"=")
+            if k.strip() == b"chunk-signature":
+                sig = v.strip()
         try:
             size = int(size_hex, 16)
         except ValueError:
             raise S3AuthError("InvalidRequest", "bad aws-chunked framing", 400)
-        if size == 0:
-            break
         start = nl + 2
-        out += data[start : start + size]
+        yield data[start : start + size], sig.decode()
+        if size == 0:
+            return
         pos = start + size + 2  # skip trailing \r\n
+
+
+def decode_aws_chunked(data: bytes) -> bytes:
+    """Strip aws-chunked framing:
+    `<hex-size>;chunk-signature=<sig>\\r\\n<data>\\r\\n...0;...\\r\\n\\r\\n`
+    (reference chunked_reader_v4.go) WITHOUT verifying chunk signatures —
+    used only when auth is disabled (no secret to verify against)."""
+    out = bytearray()
+    for chunk, _sig in _iter_aws_chunks(data):
+        out += chunk
+    return bytes(out)
+
+
+def decode_aws_chunked_verified(
+    data: bytes,
+    secret: str,
+    datestamp: str,
+    region: str,
+    service: str,
+    amz_date: str,
+    seed_signature: str,
+) -> bytes:
+    """Strip aws-chunked framing AND verify every chunk signature against
+    the V4 chain anchored at the request's seed signature
+    (chunked_reader_v4.go getChunkSignature): each chunk signs
+    AWS4-HMAC-SHA256-PAYLOAD \\n date \\n scope \\n prev_sig \\n
+    sha256('') \\n sha256(chunk)."""
+    key = _signing_key(secret, datestamp, region, service)
+    scope = f"{datestamp}/{region}/{service}/aws4_request"
+    empty_hash = hashlib.sha256(b"").hexdigest()
+    prev = seed_signature
+    out = bytearray()
+    saw_terminal = False
+    for chunk, got_sig in _iter_aws_chunks(data):
+        string_to_sign = "\n".join(
+            [
+                "AWS4-HMAC-SHA256-PAYLOAD",
+                amz_date,
+                scope,
+                prev,
+                empty_hash,
+                hashlib.sha256(chunk).hexdigest(),
+            ]
+        )
+        expect = hmac.new(
+            key, string_to_sign.encode(), hashlib.sha256
+        ).hexdigest()
+        if not hmac.compare_digest(expect, got_sig):
+            raise S3AuthError(
+                "SignatureDoesNotMatch", "chunk signature mismatch", 403
+            )
+        prev = expect
+        if not chunk:
+            saw_terminal = True
+        out += chunk
+    if not saw_terminal:
+        # without the signed zero-length terminal frame a truncated
+        # prefix would verify — the chain must cover the WHOLE stream
+        raise S3AuthError(
+            "IncompleteBody", "chunked stream missing terminal frame", 400
+        )
     return bytes(out)
 
 
@@ -369,13 +568,14 @@ def sign_request_headers(
     access_key: str,
     secret_key: str,
     region: str = "us-east-1",
+    payload_hash: str = "",  # override: UNSIGNED-PAYLOAD / STREAMING-...
 ) -> dict[str, str]:
     """Client-side SigV4 header signing (used by tests and wdclient-style
     tools; the inverse of _verify_header_sig)."""
     parsed = urllib.parse.urlsplit(url)
     amz_date = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
     datestamp = amz_date[:8]
-    payload_hash = hashlib.sha256(payload).hexdigest()
+    payload_hash = payload_hash or hashlib.sha256(payload).hexdigest()
     out = dict(headers)
     out["host"] = parsed.netloc
     out["x-amz-date"] = amz_date

@@ -15,7 +15,9 @@ from __future__ import annotations
 
 import asyncio
 import base64
+import calendar
 import hashlib
+import json
 import logging
 import time
 import urllib.parse
@@ -37,6 +39,7 @@ from .auth import (
     IdentityAccessManagement,
     S3AuthError,
     decode_aws_chunked,
+    decode_aws_chunked_verified,
     verify_payload_hash,
 )
 
@@ -229,6 +232,20 @@ class S3ApiServer:
             ).inc()
 
     async def _dispatch_authed(self, request: web.Request) -> web.StreamResponse:
+        # POST policy (browser form) uploads carry their auth inside the
+        # form body, not the Authorization header — route them before the
+        # header-based authentication
+        pp_bucket, _, pp_key = request.match_info["tail"].partition("/")
+        if (
+            request.method == "POST"
+            and pp_bucket
+            and not pp_key
+            and request.content_type == "multipart/form-data"
+        ):
+            try:
+                return await self.post_object(pp_bucket, request)
+            except S3Error as e:
+                return _error_response(e.code, str(e), e.status)
         try:
             identity = self.iam.authenticate(request)
             body = await verify_payload_hash(request)
@@ -310,6 +327,10 @@ class S3ApiServer:
                 return await self.delete_object(bucket, key)
             raise S3Error("MethodNotAllowed", "bad request", 405)
         except S3Error as e:
+            return _error_response(e.code, str(e), e.status)
+        except S3AuthError as e:
+            # raised mid-handler, e.g. a streaming chunk signature mismatch
+            # discovered while reading the body
             return _error_response(e.code, str(e), e.status)
         except grpc.aio.AioRpcError as e:
             if e.code() == grpc.StatusCode.NOT_FOUND:
@@ -421,8 +442,136 @@ class S3ApiServer:
             request.headers.get("x-amz-content-sha256") == STREAMING_PAYLOAD
             or "aws-chunked" in request.headers.get("Content-Encoding", "")
         ):
+            ctx = request.get("s3_chunk_ctx")
+            if ctx is not None:
+                return decode_aws_chunked_verified(await request.read(), *ctx)
             return decode_aws_chunked(await request.read())
         return request.content
+
+    async def post_object(self, bucket: str, request: web.Request) -> web.Response:
+        """Browser-form (POST policy) upload
+        (s3api_object_handlers_postpolicy.go): multipart form with key,
+        policy, signature fields and a trailing `file` part.  The policy
+        document authenticates the form and constrains what it may upload."""
+        if not await self._bucket_exists(bucket):
+            raise S3Error(*ERR_NO_SUCH_BUCKET)
+        reader = await request.multipart()
+        fields: dict[str, str] = {}
+        file_bytes = None
+        filename = ""
+        while True:
+            part = await reader.next()
+            if part is None:
+                break
+            if part.name == "file":
+                filename = part.filename or ""
+                file_bytes = await part.read(decode=False)
+                break  # per the S3 spec, fields after `file` are ignored
+            try:
+                fields[part.name] = (await part.read(decode=False)).decode()
+            except UnicodeDecodeError:
+                raise S3Error(
+                    "InvalidArgument",
+                    f"form field {part.name!r} is not valid UTF-8",
+                    400,
+                )
+        if file_bytes is None:
+            raise S3Error("InvalidArgument", "POST form has no file field", 400)
+        try:
+            identity = self.iam.verify_post_policy(fields)
+        except S3AuthError as e:
+            return _error_response(e.code, str(e), e.status)
+        key = fields.get("key", "")
+        if not key:
+            raise S3Error("InvalidArgument", "POST form has no key field", 400)
+        key = key.replace("${filename}", filename)
+        if fields.get("policy"):
+            self._check_post_policy(fields, bucket, key, len(file_bytes))
+        if identity is not None and not identity.can_do(ACTION_WRITE, bucket):
+            raise S3Error("AccessDenied", "access denied", 403)
+        headers = {"Content-Length": str(len(file_bytes))}
+        if fields.get("Content-Type"):
+            headers["Content-Type"] = fields["Content-Type"]
+        async with self._session.put(
+            self._object_url(bucket, key), data=file_bytes, headers=headers
+        ) as r:
+            if r.status >= 300:
+                raise S3Error("InternalError", await r.text(), 500)
+        try:
+            status = int(fields.get("success_action_status", "204"))
+        except ValueError:
+            status = 204  # AWS ignores unparseable values
+        if status not in (200, 201, 204):
+            status = 204
+        if status == 201:
+            root = _el("PostResponse")
+            ET.SubElement(root, "Bucket").text = bucket
+            ET.SubElement(root, "Key").text = key
+            ET.SubElement(root, "Location").text = f"/{bucket}/{key}"
+            return _xml_response(root, status=201)
+        return web.Response(status=status)
+
+    def _check_post_policy(
+        self, fields: dict, bucket: str, key: str, size: int
+    ) -> None:
+        """Enforce the signed policy document's expiration and conditions
+        (policy/post-policy.go)."""
+        try:
+            policy = json.loads(base64.b64decode(fields["policy"]))
+        except (ValueError, KeyError):
+            raise S3Error("InvalidPolicyDocument", "policy is not valid JSON", 400)
+        exp = str(policy.get("expiration", ""))
+        if exp:
+            try:
+                t = time.strptime(exp.split(".")[0].rstrip("Z"), "%Y-%m-%dT%H:%M:%S")
+            except ValueError:
+                raise S3Error("InvalidPolicyDocument", "bad expiration", 400)
+            if calendar.timegm(t) < time.time():
+                raise S3Error("AccessDenied", "policy expired", 403)
+        values = {"bucket": bucket, "key": key}
+        for k, v in fields.items():
+            values.setdefault(k.lower(), v)
+        for cond in policy.get("conditions", []):
+            if isinstance(cond, dict):
+                items = [["eq", f"${k}", v] for k, v in cond.items()]
+            else:
+                items = [cond]
+            for item in items:
+                try:
+                    op = str(item[0]).lower()
+                    if op == "content-length-range":
+                        lo, hi = int(item[1]), int(item[2])
+                        if not lo <= size <= hi:
+                            raise S3Error(
+                                "EntityTooLarge"
+                                if size > hi
+                                else "EntityTooSmall",
+                                f"size {size} outside [{lo}, {hi}]",
+                                400,
+                            )
+                        continue
+                    name = str(item[1]).lstrip("$").lower()
+                    want = str(item[2])
+                except (ValueError, IndexError, TypeError):
+                    # malformed condition is the POLICY's fault: 400, not
+                    # an unhandled 500
+                    raise S3Error(
+                        "InvalidPolicyDocument",
+                        f"malformed policy condition {item!r}",
+                        400,
+                    )
+                got = values.get(name, "")
+                ok = (
+                    got.startswith(want)
+                    if op == "starts-with"
+                    else got == want
+                )
+                if not ok:
+                    raise S3Error(
+                        "AccessDenied",
+                        f"policy condition failed on {name}",
+                        403,
+                    )
 
     async def put_object(self, bucket: str, key: str, request: web.Request) -> web.Response:
         if not await self._bucket_exists(bucket):
