@@ -32,6 +32,13 @@ ACTION_TAGGING = "Tagging"
 IDENTITY_FILER_PATH = ("/etc/iam", "identity.json")
 
 
+def scope_covers(limit: str, bucket: str) -> bool:
+    """Does an action's ':bucket' scope cover this bucket?  Single source
+    of truth shared by enforcement (Identity.can_do) and the ACL view
+    (get_bucket_acl) so the two can't drift."""
+    return not limit or limit == bucket or bucket.startswith(limit)
+
+
 class S3AuthError(Exception):
     def __init__(self, code: str, message: str, status: int = 403):
         super().__init__(message)
@@ -57,7 +64,7 @@ class Identity:
                 continue  # bare Admin handled above
             if base == ACTION_ADMIN and not bucket:
                 continue  # bucket-scoped admin can't do global actions
-            if not limit or limit == bucket or bucket.startswith(limit):
+            if scope_covers(limit, bucket):
                 return True
         return False
 
@@ -420,7 +427,9 @@ def _iter_aws_chunks(data: bytes):
         except ValueError:
             raise S3AuthError("InvalidRequest", "bad aws-chunked framing", 400)
         start = nl + 2
-        yield data[start : start + size], sig.decode()
+        # errors="replace": a garbage signature must FAIL verification
+        # (compare_digest mismatch), not 500 on the decode
+        yield data[start : start + size], sig.decode(errors="replace")
         if size == 0:
             return
         pos = start + size + 2  # skip trailing \r\n

@@ -277,8 +277,39 @@ class S3ApiServer:
                     bucket_action = ACTION_ADMIN
                 elif m == "POST" and "delete" in q:
                     bucket_action = ACTION_WRITE
+                elif m == "GET" and "acl" in q:
+                    # the ACL view enumerates other identities' names and
+                    # access key ids — owner/admin only, not every reader
+                    bucket_action = ACTION_ADMIN
                 if not allowed(bucket_action):
                     raise S3Error("AccessDenied", "access denied", 403)
+                if m == "GET" and "acl" in q:
+                    return await self.get_bucket_acl(bucket)
+                if m == "PUT" and "acl" in q:
+                    # the reference leaves bucket ACL writes unimplemented
+                    # (s3api_bucket_skip_handlers.go PutBucketAclHandler)
+                    raise S3Error(
+                        "NotImplemented", "PutBucketAcl is not implemented", 501
+                    )
+                if m == "GET" and "lifecycle" in q:
+                    return await self.get_bucket_lifecycle(bucket)
+                if m == "PUT" and "lifecycle" in q:
+                    raise S3Error(
+                        "NotImplemented",
+                        "PutBucketLifecycle is not implemented; use "
+                        "fs.configure -ttl",
+                        501,
+                    )
+                if m == "DELETE" and "lifecycle" in q:
+                    return await self.delete_bucket_lifecycle(bucket)
+                if m == "GET" and "location" in q:
+                    if not await self._bucket_exists(bucket):
+                        raise S3Error(*ERR_NO_SUCH_BUCKET)
+                    return _xml_response(_el("LocationConstraint"))
+                if "object-lock" in q:
+                    # bucket-level object-lock configuration is a
+                    # documented no-op (reference skip handlers)
+                    return web.Response(status=204)
                 if m == "PUT":
                     return await self.put_bucket(bucket)
                 if m == "HEAD":
@@ -311,6 +342,10 @@ class S3ApiServer:
                 return await self.abort_multipart_upload(bucket, q["uploadId"])
             if m == "GET" and "uploadId" in q:
                 return await self.list_parts(bucket, key, q["uploadId"], q)
+            if "acl" in q or "retention" in q or "legal-hold" in q:
+                # documented no-ops, mirroring the reference's
+                # s3api_object_skip_handlers.go (204 No Content)
+                return web.Response(status=204)
             if m == "PUT" and "tagging" in q:
                 return await self.put_object_tagging(bucket, key, request)
             if m == "GET" and "tagging" in q:
@@ -448,6 +483,126 @@ class S3ApiServer:
             return decode_aws_chunked(await request.read())
         return request.content
 
+    async def get_bucket_acl(self, bucket: str) -> web.Response:
+        """Synthesize an AccessControlPolicy from the IAM identities that
+        can reach this bucket (reference s3api_bucket_handlers.go
+        GetBucketAclHandler — ACLs are a VIEW of identity actions, not a
+        separately stored policy)."""
+        if not await self._bucket_exists(bucket):
+            raise S3Error(*ERR_NO_SUCH_BUCKET)
+        perm_of = {
+            ACTION_ADMIN: "FULL_CONTROL",
+            ACTION_WRITE: "WRITE",
+            ACTION_READ: "READ",
+            ACTION_LIST: "READ",
+        }
+        from .auth import scope_covers
+
+        root = _el("AccessControlPolicy")
+        owner = ET.SubElement(root, "Owner")
+        grants = ET.SubElement(root, "AccessControlList")
+        for ident in self.iam.identities:
+            if not ident.credentials:
+                continue
+            access_id = ident.credentials[0][0]
+            for action in ident.actions:
+                base, _, limit = action.partition(":")
+                if not scope_covers(limit, bucket):
+                    continue
+                perm = perm_of.get(base, "")
+                if not perm:
+                    continue
+                if base == ACTION_ADMIN and not owner.findall("ID"):
+                    ET.SubElement(owner, "ID").text = access_id
+                    ET.SubElement(owner, "DisplayName").text = ident.name
+                g = ET.SubElement(grants, "Grant")
+                grantee = ET.SubElement(g, "Grantee")
+                grantee.set("xmlns:xsi", "http://www.w3.org/2001/XMLSchema-instance")
+                grantee.set("xsi:type", "CanonicalUser")
+                ET.SubElement(grantee, "ID").text = access_id
+                ET.SubElement(grantee, "DisplayName").text = ident.name
+                ET.SubElement(g, "Permission").text = perm
+        return _xml_response(root)
+
+    async def _load_filer_conf(self):
+        """filer.conf fetched over the filer gRPC surface; absent or
+        garbled reads as empty (shared by the lifecycle view + delete)."""
+        from ..filer.path_conf import CONF_PATH, FilerConf
+
+        d, n = CONF_PATH.rsplit("/", 1)
+        try:
+            resp = await self._stub().LookupDirectoryEntry(
+                filer_pb2.LookupDirectoryEntryRequest(directory=d, name=n)
+            )
+            return FilerConf.from_bytes(bytes(resp.entry.content))
+        except (grpc.aio.AioRpcError, ValueError):
+            return FilerConf()
+
+    async def get_bucket_lifecycle(self, bucket: str) -> web.Response:
+        """Lifecycle as a VIEW of the filer.conf TTL rules under the
+        bucket's prefix (reference GetBucketLifecycleConfigurationHandler
+        + filer.ReadFilerConf)."""
+        from ..storage.types import TTL
+
+        if not await self._bucket_exists(bucket):
+            raise S3Error(*ERR_NO_SUCH_BUCKET)
+        conf = await self._load_filer_conf()
+        prefix = f"{self.buckets_path}/{bucket}/"
+        rules = []
+        for loc in conf.locations:
+            if not loc.location_prefix.startswith(prefix) or not loc.ttl:
+                continue
+            try:
+                minutes = TTL.parse(loc.ttl).minutes
+            except ValueError:
+                continue  # a malformed stored rule must not 500 the view
+            if minutes == 0:
+                continue
+            # sub-day TTLs round UP: hiding them would contradict the
+            # DELETE handler that clears exactly these rules
+            days = max(1, minutes // (60 * 24))
+            rules.append((loc.location_prefix[len(prefix):], days))
+        if not rules:
+            raise S3Error(
+                "NoSuchLifecycleConfiguration",
+                "The lifecycle configuration does not exist",
+                404,
+            )
+        root = _el("LifecycleConfiguration")
+        for key_prefix, days in rules:
+            rule = ET.SubElement(root, "Rule")
+            ET.SubElement(rule, "Status").text = "Enabled"
+            f = ET.SubElement(rule, "Filter")
+            ET.SubElement(f, "Prefix").text = key_prefix
+            exp = ET.SubElement(rule, "Expiration")
+            ET.SubElement(exp, "Days").text = str(days)
+        return _xml_response(root)
+
+    async def delete_bucket_lifecycle(self, bucket: str) -> web.Response:
+        """Clear the bucket's TTL rules from filer.conf (the inverse of
+        the lifecycle view — a 204 that left the rules in place would lie
+        to the next GET)."""
+        from ..filer.path_conf import CONF_PATH, save_conf_entry
+
+        if not await self._bucket_exists(bucket):
+            raise S3Error(*ERR_NO_SUCH_BUCKET)
+        d, n = CONF_PATH.rsplit("/", 1)
+        conf = await self._load_filer_conf()
+        prefix = f"{self.buckets_path}/{bucket}/"
+        changed = False
+        for loc in list(conf.locations):
+            if loc.location_prefix.startswith(prefix) and loc.ttl:
+                loc.ttl = ""
+                if not (
+                    loc.collection or loc.replication or loc.disk_type
+                    or loc.read_only
+                ):
+                    conf.delete(loc.location_prefix)
+                changed = True
+        if changed:
+            await save_conf_entry(self._stub(), d, n, conf.to_bytes())
+        return web.Response(status=204)
+
     async def post_object(self, bucket: str, request: web.Request) -> web.Response:
         """Browser-form (POST policy) upload
         (s3api_object_handlers_postpolicy.go): multipart form with key,
@@ -463,6 +618,10 @@ class S3ApiServer:
             part = await reader.next()
             if part is None:
                 break
+            if part.name is None:
+                raise S3Error(
+                    "InvalidArgument", "form part without a name", 400
+                )
             if part.name == "file":
                 filename = part.filename or ""
                 file_bytes = await part.read(decode=False)
@@ -485,6 +644,12 @@ class S3ApiServer:
         if not key:
             raise S3Error("InvalidArgument", "POST form has no key field", 400)
         key = key.replace("${filename}", filename)
+        # POST policy skips the header-auth dispatch path, so it must run
+        # the same traversal guard — a '../..' key after ${filename}
+        # substitution would escape the authorized bucket
+        err = _validate_names(bucket, key)
+        if err:
+            raise S3Error("InvalidArgument", err, 400)
         if fields.get("policy"):
             self._check_post_policy(fields, bucket, key, len(file_bytes))
         if identity is not None and not identity.can_do(ACTION_WRITE, bucket):

@@ -222,6 +222,12 @@ def test_post_policy_upload(tmp_path):
                 ) as r:
                     assert r.status == 400
 
+                # traversal in the key must not escape the bucket
+                async with s.post(
+                    f"{s3}/forms", data=form("uploads/../../other/x", b"x")
+                ) as r:
+                    assert r.status == 400
+
                 # tampered signature
                 fd = aiohttp.FormData()
                 fields = _signed_policy_form("forms", "uploads/", 1024)
@@ -303,6 +309,93 @@ def test_streaming_chunked_signatures(tmp_path):
                 get2 = sign_request_headers("GET", url2, {}, b"", ACCESS, SECRET)
                 async with s.get(url2, headers=get2) as r:
                     assert r.status == 404  # nothing stored
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_bucket_acl_and_skip_handlers(tmp_path):
+    async def go():
+        cluster = await make_cluster(tmp_path)
+        try:
+            s3 = f"http://{cluster.s3.url}"
+            mk = sign_request_headers("PUT", f"{s3}/aclb", {}, b"", ACCESS, SECRET)
+            async with aiohttp.ClientSession() as s:
+                async with s.put(f"{s3}/aclb", headers=mk) as r:
+                    assert r.status == 200
+                g = sign_request_headers(
+                    "GET", f"{s3}/aclb?acl=", {}, b"", ACCESS, SECRET
+                )
+                async with s.get(f"{s3}/aclb?acl=", headers=g) as r:
+                    body = await r.text()
+                    assert r.status == 200, body
+                    assert "FULL_CONTROL" in body and ACCESS in body
+                # PutBucketAcl mirrors the reference's NotImplemented
+                p = sign_request_headers(
+                    "PUT", f"{s3}/aclb?acl=", {}, b"", ACCESS, SECRET
+                )
+                async with s.put(f"{s3}/aclb?acl=", headers=p) as r:
+                    assert r.status == 501
+                # object acl/retention/legal-hold are documented no-ops
+                put = sign_request_headers(
+                    "PUT", f"{s3}/aclb/o.txt", {}, b"data", ACCESS, SECRET
+                )
+                async with s.put(f"{s3}/aclb/o.txt", data=b"data", headers=put) as r:
+                    assert r.status == 200
+                for sub in ("acl", "retention", "legal-hold"):
+                    gg = sign_request_headers(
+                        "GET", f"{s3}/aclb/o.txt?{sub}=", {}, b"", ACCESS, SECRET
+                    )
+                    async with s.get(f"{s3}/aclb/o.txt?{sub}=", headers=gg) as r:
+                        assert r.status == 204, (sub, r.status)
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_bucket_lifecycle_view(tmp_path):
+    """GET ?lifecycle reflects filer.conf TTL rules under the bucket."""
+
+    async def go():
+        import io
+
+        from seaweedfs_tpu.shell import CommandEnv, run_command
+
+        cluster = await make_cluster(tmp_path)
+        try:
+            s3 = f"http://{cluster.s3.url}"
+            mk = sign_request_headers("PUT", f"{s3}/lc", {}, b"", ACCESS, SECRET)
+            async with aiohttp.ClientSession() as s:
+                async with s.put(f"{s3}/lc", headers=mk) as r:
+                    assert r.status == 200
+                g = sign_request_headers(
+                    "GET", f"{s3}/lc?lifecycle=", {}, b"", ACCESS, SECRET
+                )
+                async with s.get(f"{s3}/lc?lifecycle=", headers=g) as r:
+                    assert r.status == 404  # no rules yet
+                env = CommandEnv(
+                    [cluster.master.advertise_url], out=io.StringIO()
+                )
+                await run_command(
+                    env,
+                    "fs.configure -locationPrefix /buckets/lc/logs/ "
+                    "-ttl 48h -apply",
+                )
+                async with s.get(f"{s3}/lc?lifecycle=", headers=g) as r:
+                    body = await r.text()
+                    assert r.status == 200, body
+                    assert "<Prefix>logs/</Prefix>" in body
+                    assert "<Days>2</Days>" in body
+                # DELETE actually clears the rules (not a lying 204)
+                d = sign_request_headers(
+                    "DELETE", f"{s3}/lc?lifecycle=", {}, b"", ACCESS, SECRET
+                )
+                async with s.delete(f"{s3}/lc?lifecycle=", headers=d) as r:
+                    assert r.status == 204
+                async with s.get(f"{s3}/lc?lifecycle=", headers=g) as r:
+                    assert r.status == 404
         finally:
             await cluster.stop()
 
