@@ -1,0 +1,148 @@
+"""Unpack-variant sweep: the current kernel unpacks bytes to bits with
+eight int32 shifts (Mosaic can't shift sub-word types), paying a 4x
+widening on the VPU.  Bit i is equally (x & (1<<i)) != 0 — a bytewise AND
+plus compare that stays in int8 end to end.  Also tries m padded to 8
+(pack row-slices land on aligned 8-row sublane tiles) and the combination.
+
+Run: PYTHONPATH=/root/.axon_site:/root/repo python experiments/kernel_cmp_unpack.py
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from seaweedfs_tpu.ops import gf256, rs, rs_tpu
+
+
+def measure(fn, x, n_small=8, n_large=72, reps=3):
+    @jax.jit
+    def many(x, n):
+        def body(i, acc):
+            xi = x ^ i.astype(jnp.uint8)
+            out = fn(xi)
+            return acc + jnp.sum(out[:, ::65536].astype(jnp.int32))
+
+        return jax.lax.fori_loop(0, n, body, jnp.int32(0))
+
+    int(many(x, 1))
+    ests = []
+    for _ in range(reps):
+        times = {}
+        for n in (n_small, n_large):
+            t0 = time.perf_counter()
+            int(many(x, n))
+            times[n] = time.perf_counter() - t0
+        ests.append(x.nbytes / ((times[n_large] - times[n_small]) / (n_large - n_small)))
+    return float(np.median(ests))
+
+
+def unpack_cmp(x, k_pad):
+    """int8-native unpack: (x & bit) != 0, no widening."""
+    xv = x
+    if xv.shape[0] < k_pad:
+        zeros = jnp.zeros((k_pad - xv.shape[0], xv.shape[1]), jnp.uint8)
+        xv = jnp.concatenate([xv, zeros], axis=0)
+    planes = [
+        ((xv & np.uint8(1 << i)) != 0).astype(jnp.int8) for i in range(8)
+    ]
+    return jnp.concatenate(planes, axis=0)
+
+
+def kernel_cmp(a_ref, x_ref, o_ref):
+    m = o_ref.shape[0]
+    k_pad = a_ref.shape[1] // 8
+    bits = unpack_cmp(x_ref[:], k_pad)
+    counts = jnp.dot(a_ref[:], bits, preferred_element_type=jnp.int32)
+    obits = counts & 1
+    acc = obits[0:m]
+    for i in range(1, 8):
+        acc = acc | (obits[i * m : (i + 1) * m] << i)
+    o_ref[:] = acc.astype(jnp.uint8)
+
+
+def run_variant(kernel_fn, a_bm, x, m_rows, tile=rs_tpu.BATCH_TILE):
+    m8, k8 = a_bm.shape
+    k, b = x.shape
+
+    def apply(xi):
+        return pl.pallas_call(
+            kernel_fn,
+            grid=(pl.cdiv(b, tile),),
+            in_specs=[
+                pl.BlockSpec((m8, k8), lambda i: (0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((k, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec(
+                (m_rows, tile), lambda i: (0, i), memory_space=pltpu.VMEM
+            ),
+            out_shape=jax.ShapeDtypeStruct((m_rows, b), jnp.uint8),
+            cost_estimate=pl.CostEstimate(
+                flops=2 * m8 * k8 * b, bytes_accessed=k * b + m_rows * b,
+                transcendentals=0,
+            ),
+        )(a_bm, xi)
+
+    return measure(apply, x)
+
+
+def pad_rows_to(m_gf, rows):
+    pad = rows - m_gf.shape[0]
+    if pad > 0:
+        m_gf = np.concatenate(
+            [m_gf, np.zeros((pad, m_gf.shape[1]), dtype=np.uint8)]
+        )
+    return m_gf
+
+
+def main():
+    assert rs_tpu.on_tpu()
+    codec = rs.RSCodec()
+    parity = codec.matrix[10:]  # [4, 10]
+    rng = np.random.default_rng(3)
+    b = 160 * 1024 * 1024 // 10
+    b -= b % rs_tpu.BATCH_TILE
+    x = jax.device_put(rng.integers(0, 256, size=(10, b), dtype=np.uint8))
+
+    # baseline: current production kernel
+    a4 = rs_tpu.prepare_matrix(parity)
+    base = measure(
+        lambda xi: rs_tpu.apply_matrix_device(a4, xi, kernel="pallas"), x
+    )
+    print(f"baseline (shift unpack, m=4): {base/1e9:.1f} GB/s")
+
+    # correctness + speed of cmp unpack, m=4
+    v = run_variant(kernel_cmp, a4, x, 4)
+    print(f"cmp unpack, m=4:              {v/1e9:.1f} GB/s")
+
+    # m padded to 8 (aligned pack slices), cmp unpack
+    a8_gf = pad_rows_to(np.asarray(parity, np.uint8), 8)
+    a8 = rs_tpu.prepare_matrix(a8_gf)
+    v8 = run_variant(kernel_cmp, a8, x, 8)
+    print(f"cmp unpack, m=8:              {v8/1e9:.1f} GB/s (same useful bytes)")
+
+    # correctness check for cmp kernel vs production
+    xs = np.asarray(rng.integers(0, 256, size=(10, rs_tpu.BATCH_TILE), dtype=np.uint8))
+    want = np.asarray(
+        rs_tpu.apply_matrix_device(a4, jax.device_put(xs), kernel="pallas")
+    )
+    m8v, k8v = a4.shape
+    got = np.asarray(
+        pl.pallas_call(
+            kernel_cmp,
+            grid=(1,),
+            in_specs=[
+                pl.BlockSpec((m8v, k8v), lambda i: (0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((10, rs_tpu.BATCH_TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((4, rs_tpu.BATCH_TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((4, rs_tpu.BATCH_TILE), jnp.uint8),
+        )(a4, jax.device_put(xs))
+    )
+    print("cmp kernel correct:", bool((want == got).all()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,94 @@
+"""int8-accumulator variant: the MXU dot's counts only matter mod 2, and
+int8 wraparound (mod 256) preserves bit 0 exactly, so the accumulator can
+stay int8 end to end.  Pack avoids sub-word shifts with disjoint-bit
+multiply+add (bits*2^i summed — equal to OR for disjoint bits).
+
+Run: PYTHONPATH=/root/.axon_site:/root/repo python experiments/kernel_i8acc.py
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from seaweedfs_tpu.ops import rs, rs_tpu
+from experiments.kernel_cmp_unpack import measure, run_variant, unpack_cmp
+
+
+def kernel_cmp_i8acc(a_ref, x_ref, o_ref):
+    m = o_ref.shape[0]
+    k_pad = a_ref.shape[1] // 8
+    bits = unpack_cmp(x_ref[:], k_pad)
+    counts = jnp.dot(a_ref[:], bits, preferred_element_type=jnp.int8)
+    obits = (counts & 1).astype(jnp.uint8)
+    acc = obits[0:m]
+    for i in range(1, 8):
+        acc = acc + obits[i * m : (i + 1) * m] * np.uint8(1 << i)
+    o_ref[:] = acc
+
+
+def kernel_cmp_i32acc_i8pack(a_ref, x_ref, o_ref):
+    """cmp unpack + int32 accum + int8 mul-add pack."""
+    m = o_ref.shape[0]
+    k_pad = a_ref.shape[1] // 8
+    bits = unpack_cmp(x_ref[:], k_pad)
+    counts = jnp.dot(a_ref[:], bits, preferred_element_type=jnp.int32)
+    obits = (counts & 1).astype(jnp.uint8)
+    acc = obits[0:m]
+    for i in range(1, 8):
+        acc = acc + obits[i * m : (i + 1) * m] * np.uint8(1 << i)
+    o_ref[:] = acc
+
+
+def main():
+    assert rs_tpu.on_tpu()
+    codec = rs.RSCodec()
+    parity = codec.matrix[10:]
+    rng = np.random.default_rng(3)
+    b = 160 * 1024 * 1024 // 10
+    b -= b % rs_tpu.BATCH_TILE
+    x = jax.device_put(rng.integers(0, 256, size=(10, b), dtype=np.uint8))
+    a4 = rs_tpu.prepare_matrix(parity)
+
+    for name, kf in (
+        ("cmp + i32acc + i8 mulpack", kernel_cmp_i32acc_i8pack),
+        ("cmp + i8acc  + i8 mulpack", kernel_cmp_i8acc),
+    ):
+        try:
+            v = run_variant(kf, a4, x, 4)
+            print(f"{name}: {v/1e9:.1f} GB/s")
+        except Exception as e:
+            print(f"{name}: FAILED {type(e).__name__}: {str(e)[:200]}")
+
+    # correctness of both against production
+    xs = jax.device_put(
+        np.asarray(rng.integers(0, 256, size=(10, rs_tpu.BATCH_TILE), dtype=np.uint8))
+    )
+    want = np.asarray(rs_tpu.apply_matrix_device(a4, xs, kernel="pallas"))
+    m8v, k8v = a4.shape
+    for name, kf in (
+        ("i32acc+i8pack", kernel_cmp_i32acc_i8pack),
+        ("i8acc", kernel_cmp_i8acc),
+    ):
+        try:
+            got = np.asarray(
+                pl.pallas_call(
+                    kf,
+                    grid=(1,),
+                    in_specs=[
+                        pl.BlockSpec((m8v, k8v), lambda i: (0, 0), memory_space=pltpu.VMEM),
+                        pl.BlockSpec((10, rs_tpu.BATCH_TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
+                    ],
+                    out_specs=pl.BlockSpec((4, rs_tpu.BATCH_TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
+                    out_shape=jax.ShapeDtypeStruct((4, rs_tpu.BATCH_TILE), jnp.uint8),
+                )(a4, xs)
+            )
+            print(f"{name} correct:", bool((want == got).all()))
+        except Exception as e:
+            print(f"{name} correctness: FAILED {type(e).__name__}: {str(e)[:160]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,105 @@
+"""Measurement-overhead isolation on the real TPU.
+
+The bench/sweep harness defeats loop-invariant hoisting by XORing the
+input with the loop index — that costs one extra full read+write pass
+over the input per iteration, charged against the kernel.  A rotating
+pre-staged buffer bank gets the same hoisting defeat with no per-iter
+transform: each iteration reads DIFFERENT real data from HBM, which is
+exactly what the production encode loop does.
+
+Variants timed (useful-input GB/s, higher is better):
+  xor        — current bench harness (lower bound)
+  rot4       — 4 rotating buffers, dynamic index
+  rot4_pad   — same, inputs pre-padded to k_pad rows (kernel skips concat)
+  rot4_t32k  — rotating + 32768-lane tile
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from seaweedfs_tpu.ops import rs, rs_tpu
+
+
+def measure_xor(apply_fn, x, n_small=4, n_large=36):
+    @jax.jit
+    def many(x, n):
+        def body(i, acc):
+            xi = x ^ i.astype(jnp.uint8)
+            out = apply_fn(xi)
+            return acc + jnp.sum(out[:, ::65536].astype(jnp.int32))
+
+        return jax.lax.fori_loop(0, n, body, jnp.int32(0))
+
+    int(many(x, 1))
+    times = {}
+    for n in (n_small, n_large):
+        t0 = time.perf_counter()
+        int(many(x, n))
+        times[n] = time.perf_counter() - t0
+    per_iter = (times[n_large] - times[n_small]) / (n_large - n_small)
+    return x.nbytes / per_iter
+
+
+def measure_rot(apply_fn, xs, n_small=4, n_large=36):
+    """xs: [R, k, B] rotating bank; each iteration consumes a different
+    buffer, so nothing is loop-invariant but nothing extra is computed."""
+    r = xs.shape[0]
+
+    @jax.jit
+    def many(xs, n):
+        def body(i, acc):
+            xi = jax.lax.dynamic_index_in_dim(xs, i % r, 0, keepdims=False)
+            out = apply_fn(xi)
+            return acc + jnp.sum(out[:, ::65536].astype(jnp.int32))
+
+        return jax.lax.fori_loop(0, n, body, jnp.int32(0))
+
+    int(many(xs, 1))
+    times = {}
+    for n in (n_small, n_large):
+        t0 = time.perf_counter()
+        int(many(xs, n))
+        times[n] = time.perf_counter() - t0
+    per_iter = (times[n_large] - times[n_small]) / (n_large - n_small)
+    return xs.nbytes / r / per_iter
+
+
+def main():
+    assert rs_tpu.on_tpu(), "run on the real TPU"
+    codec = rs.RSCodec()
+    parity_m = codec.matrix[10:]
+    a_bm = rs_tpu.prepare_matrix(parity_m)
+    rng = np.random.default_rng(7)
+    mb = 160
+    b = mb * 1024 * 1024 // 10
+    b -= b % rs_tpu.BATCH_TILE
+
+    def apply_k(xi, tile=None):
+        return rs_tpu.apply_matrix_device(a_bm, xi, kernel="pallas", tile=tile)
+
+    x = jax.device_put(rng.integers(0, 256, size=(10, b), dtype=np.uint8))
+    print("xor       :", measure_xor(apply_k, x) / 1e9, "GB/s")
+
+    xs = jax.device_put(rng.integers(0, 256, size=(4, 10, b), dtype=np.uint8))
+    print("rot4      :", measure_rot(apply_k, xs) / 1e9, "GB/s")
+
+    xs_pad = jnp.pad(xs, ((0, 0), (0, 6), (0, 0)))
+
+    def apply_pad(xi):
+        return rs_tpu.apply_matrix_device(a_bm, xi, kernel="pallas")
+
+    gbps = measure_rot(apply_pad, xs_pad) * 10 / 16  # useful bytes only
+    print("rot4_pad  :", gbps / 1e9, "GB/s")
+
+    def apply_32k(xi):
+        return rs_tpu.apply_matrix_device(
+            a_bm, xi, kernel="pallas", tile=32768
+        )
+
+    print("rot4_t32k :", measure_rot(apply_32k, xs) / 1e9, "GB/s")
+
+
+if __name__ == "__main__":
+    main()
