@@ -472,3 +472,82 @@ async def cmd_fs_configure(env, args):
 
     await save_conf_entry(stub, CONF_DIR, CONF_NAME, conf.to_bytes())
     env.write(f"saved {CONF_PATH}")
+
+
+@command("fs.meta.notify")
+async def cmd_fs_meta_notify(env, args):
+    """[-spool file] [/dir] : re-publish every entry under the subtree as
+    a metadata-change notification (command_fs_meta_notify.go — seeds an
+    external consumer that missed the live stream).  Events go to the
+    spool-file queue backend (replication/notification.py), the stand-in
+    for kafka/SQS in this environment."""
+    from .commands import parse_flags
+    from ..replication.notification import FileQueueNotifier, LogNotifier
+
+    flags = parse_flags(args)
+    pos = _positional(args, value_flags={"spool"})
+    root = _resolve(env, pos[0] if pos else None)
+    notifier = (
+        FileQueueNotifier(flags["spool"]) if "spool" in flags else LogNotifier()
+    )
+    stub = await _stub(env)
+    n = 0
+    async for d, e in _walk_entries(stub, root):
+        await notifier.publish(
+            f"{d.rstrip('/')}/{e.name}",
+            filer_pb2.EventNotification(new_entry=e),
+        )
+        n += 1
+    close = getattr(notifier, "close", None)
+    if close:
+        close()
+    env.write(f"notified {n} entries under {root}")
+
+
+@command("fs.meta.change.volume.id")
+async def cmd_fs_meta_change_volume_id(env, args):
+    """-from N -to M [-force] [/dir] : rewrite chunk volume ids in filer
+    metadata after a volume id migration (command_fs_meta_change_volume_id.go)"""
+    from .commands import parse_flags
+
+    env.confirm_is_locked()
+    flags = parse_flags(args)
+    vid_from = int(flags["from"])
+    vid_to = int(flags["to"])
+    apply = "force" in flags
+    pos = _positional(args, value_flags={"from", "to"})
+    root = _resolve(env, pos[0] if pos else None)
+    stub = await _stub(env)
+    changed = skipped = 0
+    async for d, e in _walk_entries(stub, root):
+        if e.is_directory:
+            continue
+        if any(c.is_chunk_manifest for c in e.chunks):
+            # nested chunk ids live in a serialized manifest blob this
+            # command can't rewrite — claiming success would leave reads
+            # pointing at the old volume
+            env.write(
+                f"{d.rstrip('/')}/{e.name}: has manifest chunks — "
+                f"skipped (re-write the file to re-home it)"
+            )
+            skipped += 1
+            continue
+        hit = False
+        for c in e.chunks:
+            vid_s, _, rest = c.file_id.partition(",")
+            if vid_s and int(vid_s) == vid_from:
+                hit = True
+                if apply:
+                    c.file_id = f"{vid_to},{rest}"
+        if not hit:
+            continue
+        env.write(f"{d.rstrip('/')}/{e.name}: volume {vid_from} -> {vid_to}")
+        if apply:
+            await stub.UpdateEntry(
+                filer_pb2.UpdateEntryRequest(directory=d, entry=e)
+            )
+        changed += 1
+    env.write(
+        f"{changed} entries{' rewritten' if apply else ' affected (use -force)'}"
+        + (f", {skipped} skipped (manifest chunks)" if skipped else "")
+    )

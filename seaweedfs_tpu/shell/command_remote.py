@@ -329,3 +329,32 @@ async def cmd_remote_meta_sync(env, args):
     env.write(
         f"meta sync {mount_dir}: +{added} ~{updated} -{removed}"
     )
+
+
+@command("remote.mount.buckets")
+async def cmd_remote_mount_buckets(env, args):
+    """-remote <type.id> [-bucketPattern p] : mount every top-level
+    prefix ("bucket") of the remote store as its own bucket directory
+    under /buckets (command_remote_mount_buckets.go)"""
+    env.confirm_is_locked()
+    import fnmatch
+
+    flags = parse_flags(args)
+    storage, prefix = _backend(flags["remote"])
+    pattern = flags.get("bucketPattern", "")
+    # buckets = first path component UNDER the remote's prefix, so a
+    # prefixed -remote enumerates and mounts consistently
+    buckets = sorted(
+        {rel.partition("/")[0] for rel, _, _ in _list_remote(storage, prefix)
+         if "/" in rel}
+    )
+    n = 0
+    base = flags["remote"].rstrip("/")
+    for b in buckets:
+        if pattern and not fnmatch.fnmatch(b, pattern):
+            continue
+        await cmd_remote_mount(
+            env, ["-dir", f"/buckets/{b}", "-remote", f"{base}/{b}"]
+        )
+        n += 1
+    env.write(f"mounted {n} remote buckets")

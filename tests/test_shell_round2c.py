@@ -345,20 +345,26 @@ def test_cluster_raft_membership(tmp_path):
     async def go():
         from seaweedfs_tpu.server.master import MasterServer
 
-        ports = free_ports(3)
-        urls = [f"127.0.0.1:{p}" for p in ports]
+        # explicit grpc ports in the peer urls: the +10000 convention can
+        # collide with another allocated port on a busy test host, and a
+        # rebound grpc port would silently break flag-form peer dialing
+        ports = free_ports(6)
+        http, grpc_ports = ports[:3], ports[3:]
+        urls = [
+            f"127.0.0.1:{p}.{g}" for p, g in zip(http, grpc_ports)
+        ]
         # start a 2-node cluster; the third master starts with full peer
         # list but isn't a member until cluster.raft.add
         masters = []
-        for i, p in enumerate(ports[:2]):
+        for i in range(2):
             m = MasterServer(
-                port=p, grpc_port=p + 10000, peers=list(urls[:2]),
+                port=http[i], grpc_port=grpc_ports[i], peers=list(urls[:2]),
                 meta_dir=str(tmp_path / f"m{i}"), pulse_seconds=1,
             )
             masters.append(m)
         await asyncio.gather(*(m.start() for m in masters))
         extra = MasterServer(
-            port=ports[2], grpc_port=ports[2] + 10000, peers=list(urls),
+            port=http[2], grpc_port=grpc_ports[2], peers=list(urls),
             meta_dir=str(tmp_path / "m2"), pulse_seconds=1,
             raft_join=True,  # non-voter until cluster.raft.add
         )
@@ -369,7 +375,7 @@ def test_cluster_raft_membership(tmp_path):
             await env.acquire_lock()
             await sh(env, "cluster.raft.ps")
             before = env.out.getvalue()
-            assert urls[2] + ":" not in before  # extra not a member yet
+            assert extra.raft.id not in before  # extra not a member yet
 
             raft_id = extra.raft.id
             assert not extra.raft.voter
@@ -449,6 +455,81 @@ def test_s3_circuitbreaker_enforced(tmp_path):
             async with aiohttp.ClientSession() as s:
                 async with s.put(f"{s3}/cbbucket/x.bin", data=b"x") as r:
                     assert r.status == 200
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_fs_meta_notify_and_change_volume_id(tmp_path):
+    async def go():
+        from seaweedfs_tpu.pb import filer_pb2
+        from seaweedfs_tpu.replication.notification import FileQueueNotifier
+
+        cluster, env = await make(tmp_path)
+        try:
+            await put(cluster, "/n/a.txt", os.urandom(4096))
+            await put(cluster, "/n/sub/b.txt", b"bb")
+            spool = str(tmp_path / "spool.bin")
+            await sh(env, f"fs.meta.notify -spool {spool} /n")
+            assert "notified" in env.out.getvalue()
+            events = FileQueueNotifier.read_all(spool)
+            names = {e.new_entry.name for _, e in events}
+            assert {"a.txt", "sub", "b.txt"} <= names
+
+            # volume id rewrite in chunk metadata
+            stub = env.filer_stub(await env.find_filer())
+            resp = await stub.LookupDirectoryEntry(
+                filer_pb2.LookupDirectoryEntryRequest(directory="/n", name="a.txt")
+            )
+            old_vid = int(resp.entry.chunks[0].file_id.partition(",")[0])
+            new_vid = old_vid + 500
+            env.out = io.StringIO()
+            await sh(
+                env,
+                f"fs.meta.change.volume.id -from {old_vid} -to {new_vid} -force /n",
+            )
+            assert "rewritten" in env.out.getvalue()
+            resp = await stub.LookupDirectoryEntry(
+                filer_pb2.LookupDirectoryEntryRequest(directory="/n", name="a.txt")
+            )
+            assert all(
+                c.file_id.startswith(f"{new_vid},") for c in resp.entry.chunks
+            )
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_remote_mount_buckets(tmp_path):
+    async def go():
+        backing = tmp_path / "store"
+        (backing / "alpha").mkdir(parents=True)
+        (backing / "beta").mkdir()
+        (backing / "alpha" / "x.txt").write_bytes(b"ax")
+        (backing / "beta" / "y.txt").write_bytes(b"by")
+        cluster, env = await make(tmp_path / "cluster")
+        try:
+            await sh(env, f"remote.configure -name local.rb -dir {backing}")
+            env.out = io.StringIO()
+            await sh(env, "remote.mount.buckets -remote local.rb")
+            assert "mounted 2 remote buckets" in env.out.getvalue()
+            env.out = io.StringIO()
+            await sh(env, "fs.ls /buckets/alpha")
+            assert "x.txt" in env.out.getvalue()
+            env.out = io.StringIO()
+            await sh(env, "fs.ls /buckets/beta")
+            assert "y.txt" in env.out.getvalue()
+            # a prefixed -remote enumerates buckets UNDER the prefix
+            (backing / "deep" / "gamma").mkdir(parents=True)
+            (backing / "deep" / "gamma" / "z.txt").write_bytes(b"gz")
+            env.out = io.StringIO()
+            await sh(env, "remote.mount.buckets -remote local.rb/deep")
+            assert "mounted 1 remote buckets" in env.out.getvalue()
+            env.out = io.StringIO()
+            await sh(env, "fs.ls /buckets/gamma")
+            assert "z.txt" in env.out.getvalue()
         finally:
             await cluster.stop()
 
