@@ -28,7 +28,13 @@ def add_args(p) -> None:
     )
     p.add_argument(
         "-db", dest="db_path", default="",
-        help="sqlite metadata store path (default: in-memory)",
+        help="metadata store path (default: in-memory)",
+    )
+    p.add_argument(
+        "-store", dest="store_kind", default="",
+        choices=["", "memory", "sqlite", "native"],
+        help="metadata store kind; default: sqlite when -db is set, else "
+        "memory.  'native' uses the embedded C++ KV (native/kvstore.cpp)",
     )
     p.add_argument(
         "-metaLog", dest="meta_log_path", default="",
@@ -59,10 +65,20 @@ def add_args(p) -> None:
 
 
 def build_filer_server(args):
-    from ..filer.filerstore import MemoryStore, SqliteStore
+    from ..filer.filerstore import MemoryStore, NativeKvStore, SqliteStore
     from ..server.filer import FilerServer
 
-    store = SqliteStore(args.db_path) if args.db_path else MemoryStore()
+    kind = getattr(args, "store_kind", "") or (
+        "sqlite" if args.db_path else "memory"
+    )
+    if kind == "native":
+        if not args.db_path:
+            raise SystemExit("-store native requires -db <path>")
+        store = NativeKvStore(args.db_path)
+    elif kind == "sqlite":
+        store = SqliteStore(args.db_path or ":memory:")
+    else:
+        store = MemoryStore()
     return FilerServer(
         masters=[m.strip() for m in args.masters.split(",") if m.strip()],
         store=store,

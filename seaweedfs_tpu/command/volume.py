@@ -46,9 +46,10 @@ def add_args(p) -> None:
     )
     p.add_argument(
         "-index", dest="index_kind", default="memory",
-        choices=["memory", "sqlite"],
-        help="needle map kind: memory (CompactMap) or sqlite (persistent, "
-        "O(1) RAM per volume — the reference's leveldb index)",
+        choices=["memory", "sqlite", "native"],
+        help="needle map kind: memory (CompactMap), sqlite (persistent, "
+        "O(1) RAM per volume), or native (embedded C++ KV, "
+        "native/kvstore.cpp — the reference's leveldb index role)",
     )
     p.add_argument(
         "-fileSizeLimitMB", dest="client_max_size_mb", type=int, default=256,

@@ -265,3 +265,111 @@ class SqliteStore(FilerStore):
 
 def _glob_escape(s: str) -> str:
     return s.replace("[", "[[]").replace("*", "[*]").replace("?", "[?]")
+
+
+class NativeKvStore(FilerStore):
+    """Durable embedded store on the native C++ KV (native/kvstore.cpp —
+    the role leveldb plays as the reference's default filer store,
+    weed/filer/leveldb2).  Records: b'E'+full_path -> Entry bytes,
+    b'K'+key -> kv sideband.  The bitcask index is a hash (no ordered
+    scans), so directory ordering lives in an in-memory sorted-children
+    map seeded by one startup iteration — bounded by namespace size, the
+    same RAM class the reference's leveldb block cache spends."""
+
+    name = "native"
+
+    def __init__(self, path: str):
+        from ..storage.kvstore import NativeKv
+
+        self._kv_store = NativeKv(path)
+        self._children: dict[str, list[str]] = {}
+        self._lock = threading.RLock()
+        from .entry import dir_and_name
+
+        for k in self._kv_store.keys():  # keys only: no value copies
+            if not k.startswith(b"E"):
+                continue
+            full_path = k[1:].decode()
+            d, n = dir_and_name(full_path)
+            names = self._children.setdefault(d, [])
+            i = bisect_left(names, n)
+            if i >= len(names) or names[i] != n:
+                names.insert(i, n)
+
+    def insert_entry(self, entry: Entry) -> None:
+        with self._lock:
+            self._kv_store.put(
+                b"E" + entry.full_path.encode(), entry.encode()
+            )
+            names = self._children.setdefault(entry.directory, [])
+            i = bisect_left(names, entry.name)
+            if i >= len(names) or names[i] != entry.name:
+                names.insert(i, entry.name)
+
+    update_entry = insert_entry
+
+    def find_entry(self, full_path: str) -> Entry:
+        with self._lock:
+            blob = self._kv_store.get(b"E" + full_path.encode())
+        if blob is None:
+            raise NotFoundError(full_path)
+        return Entry.decode(full_path, blob)
+
+    def delete_entry(self, full_path: str) -> None:
+        from .entry import dir_and_name
+
+        with self._lock:
+            self._kv_store.delete(b"E" + full_path.encode())
+            d, n = dir_and_name(full_path)
+            names = self._children.get(d, [])
+            i = bisect_left(names, n)
+            if i < len(names) and names[i] == n:
+                names.pop(i)
+
+    def delete_folder_children(self, full_path: str) -> None:
+        with self._lock:
+            d = full_path.rstrip("/") or "/"
+            for name in list(self._children.get(d, [])):
+                self.delete_entry(new_full_path(d, name))
+
+    def list_directory_entries(
+        self, dir_path, start_file_name="", include_start=False, limit=1 << 30, prefix=""
+    ):
+        with self._lock:
+            d = dir_path.rstrip("/") or "/"
+            names = self._children.get(d, [])
+            i = bisect_left(names, start_file_name) if start_file_name else 0
+            out = []
+            for name in names[i:]:
+                if name == start_file_name and not include_start:
+                    continue
+                if prefix and not name.startswith(prefix):
+                    continue
+                blob = self._kv_store.get(
+                    b"E" + new_full_path(d, name).encode()
+                )
+                if blob is not None:
+                    out.append(Entry.decode(new_full_path(d, name), blob))
+                if len(out) >= limit:
+                    break
+            return out
+
+    def kv_put(self, key, value):
+        self._kv_store.put(b"K" + bytes(key), bytes(value))
+
+    def kv_get(self, key):
+        v = self._kv_store.get(b"K" + bytes(key))
+        if v is None:
+            raise NotFoundError(key)
+        return v
+
+    def kv_delete(self, key):
+        self._kv_store.delete(b"K" + bytes(key))
+
+    def compact(self) -> int:
+        """Reclaim superseded log records (exposed for ops tooling)."""
+        with self._lock:
+            return self._kv_store.compact()
+
+    def shutdown(self):
+        self._kv_store.close()

@@ -158,7 +158,9 @@ class VolumeServer:
                 DiskLocation(
                     d, max_volume_count=c, disk_type=dt,
                     needle_map_kind=(
-                        "persistent" if index_kind == "sqlite" else None
+                        {"sqlite": "persistent", "native": "native"}.get(
+                            index_kind
+                        )
                     ),
                 )
                 for d, c, dt in zip(directories, max_volume_counts, disk_types)

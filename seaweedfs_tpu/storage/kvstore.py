@@ -1,0 +1,163 @@
+"""ctypes binding for the native embedded KV (native/kvstore.cpp).
+
+The TPU-framework counterpart of the reference's leveldb dependency
+(weed/storage/needle_map_leveldb.go, weed/filer/leveldb): a bitcask-style
+append-only log + in-memory hash index, compiled into libswfs_native.so.
+Used by storage/needle_map_persistent.NativeNeedleMap (`-index native`)
+and filer/filerstore.NativeKvStore.
+"""
+from __future__ import annotations
+
+import ctypes
+import threading
+
+from ..ops import _native
+
+_ITER_CB = ctypes.CFUNCTYPE(
+    ctypes.c_int,
+    ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint32,
+    ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint32,
+    ctypes.c_void_p,
+)
+
+
+def _load():
+    lib = _native.load()
+    if lib and not getattr(lib, "_kv_bound", False):
+        lib.kv_open.argtypes = [ctypes.c_char_p]
+        lib.kv_open.restype = ctypes.c_void_p
+        lib.kv_put.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.c_char_p, ctypes.c_uint32,
+        ]
+        lib.kv_put.restype = ctypes.c_int
+        lib.kv_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.c_void_p, ctypes.c_uint64,
+        ]
+        lib.kv_get.restype = ctypes.c_int64
+        lib.kv_delete.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+        ]
+        lib.kv_delete.restype = ctypes.c_int
+        lib.kv_count.argtypes = [ctypes.c_void_p]
+        lib.kv_count.restype = ctypes.c_uint64
+        lib.kv_dead_bytes.argtypes = [ctypes.c_void_p]
+        lib.kv_dead_bytes.restype = ctypes.c_uint64
+        lib.kv_flush.argtypes = [ctypes.c_void_p]
+        lib.kv_flush.restype = ctypes.c_int
+        lib.kv_iterate.argtypes = [ctypes.c_void_p, _ITER_CB, ctypes.c_void_p]
+        lib.kv_iterate.restype = ctypes.c_int
+        lib.kv_iterate_keys.argtypes = [
+            ctypes.c_void_p, _ITER_CB, ctypes.c_void_p,
+        ]
+        lib.kv_iterate_keys.restype = ctypes.c_int
+        lib.kv_compact.argtypes = [ctypes.c_void_p]
+        lib.kv_compact.restype = ctypes.c_int64
+        lib.kv_close.argtypes = [ctypes.c_void_p]
+        lib.kv_close.restype = None
+        lib._kv_bound = True
+    return lib
+
+
+def native_available() -> bool:
+    return bool(_load())
+
+
+class NativeKv:
+    """One store file.  Thread-safe via a lock: the underlying FILE* seeks
+    are stateful, and the engine's callers mix threads (asyncio.to_thread)."""
+
+    def __init__(self, path: str):
+        lib = _load()
+        if not lib:
+            raise RuntimeError(
+                "native library not built; run make -C seaweedfs_tpu/native"
+            )
+        self._lib = lib
+        self._h = lib.kv_open(path.encode())
+        if not self._h:
+            raise OSError(f"kv_open({path!r}) failed")
+        self.path = path
+        self._lock = threading.Lock()
+
+    def put(self, key: bytes, value: bytes) -> None:
+        with self._lock:
+            rc = self._lib.kv_put(self._h, key, len(key), value, len(value))
+        if rc != 0:
+            raise OSError(f"kv_put failed (rc={rc})")
+
+    def get(self, key: bytes) -> bytes | None:
+        cap = 4096
+        with self._lock:
+            while True:
+                buf = ctypes.create_string_buffer(cap)
+                n = self._lib.kv_get(self._h, key, len(key), buf, cap)
+                if n == -1:
+                    return None
+                if n == -2:
+                    cap *= 8
+                    continue
+                return buf.raw[:n]
+
+    def delete(self, key: bytes) -> bool:
+        with self._lock:
+            return self._lib.kv_delete(self._h, key, len(key)) == 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return self._lib.kv_count(self._h)
+
+    @property
+    def dead_bytes(self) -> int:
+        with self._lock:
+            return self._lib.kv_dead_bytes(self._h)
+
+    def items(self) -> list[tuple[bytes, bytes]]:
+        out: list[tuple[bytes, bytes]] = []
+
+        @_ITER_CB
+        def cb(kp, kn, vp, vn, _ctx):
+            out.append(
+                (bytes(bytearray(kp[:kn])), bytes(bytearray(vp[:vn])))
+            )
+            return 0
+
+        with self._lock:
+            rc = self._lib.kv_iterate(self._h, cb, None)
+        if rc != 0:
+            raise OSError(f"kv_iterate failed (rc={rc})")
+        return out
+
+    def keys(self) -> list[bytes]:
+        """Live keys only — no value copies across the ctypes boundary
+        (startup seeding of namespace indexes)."""
+        out: list[bytes] = []
+
+        @_ITER_CB
+        def cb(kp, kn, _vp, _vn, _ctx):
+            out.append(bytes(bytearray(kp[:kn])))
+            return 0
+
+        with self._lock:
+            rc = self._lib.kv_iterate_keys(self._h, cb, None)
+        if rc != 0:
+            raise OSError(f"kv_iterate_keys failed (rc={rc})")
+        return out
+
+    def flush(self) -> None:
+        with self._lock:
+            self._lib.kv_flush(self._h)
+
+    def compact(self) -> int:
+        with self._lock:
+            reclaimed = self._lib.kv_compact(self._h)
+        if reclaimed < 0:
+            raise OSError("kv_compact failed")
+        return reclaimed
+
+    def close(self) -> None:
+        with self._lock:
+            if self._h:
+                self._lib.kv_close(self._h)
+                self._h = None

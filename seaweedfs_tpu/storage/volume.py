@@ -197,6 +197,10 @@ class Volume:
     def sdx_path(self) -> str:
         return self.base_name(self.dir, self.id, self.collection) + ".sdx"
 
+    @property
+    def ndx_path(self) -> str:
+        return self.base_name(self.dir, self.id, self.collection) + ".ndx"
+
     def _build_map(self, fresh: bool = False):
         """The volume's needle map in its configured kind.  `fresh=True`
         (vacuum commit) starts a NEW db file: lock-free readers may still
@@ -208,6 +212,12 @@ class Volume:
             if fresh and os.path.exists(self.sdx_path):
                 os.remove(self.sdx_path)
             return SqliteNeedleMap(self.sdx_path, self.idx_path, self.version)
+        if self.needle_map_kind == "native":
+            from .needle_map_persistent import NativeNeedleMap
+
+            if fresh and os.path.exists(self.ndx_path):
+                os.remove(self.ndx_path)
+            return NativeNeedleMap(self.ndx_path, self.idx_path, self.version)
         return needle_map.CompactMap.load_from_idx(self.idx_path, self.version)
 
     @property
@@ -482,7 +492,10 @@ class Volume:
         self.close()
         if self.remote_dat is not None:
             self.remote_dat.storage.delete_key(self.remote_dat.key)
-        for p in (self.dat_path, self.idx_path, self.note_path, self.vif_path):
+        for p in (
+            self.dat_path, self.idx_path, self.note_path, self.vif_path,
+            self.sdx_path, self.ndx_path,
+        ):
             if os.path.exists(p):
                 os.remove(p)
 

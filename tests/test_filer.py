@@ -182,10 +182,14 @@ def test_manifest_chunk_carries_cipher_key():
 # ------------------------------------------------------------------- stores
 
 
-@pytest.fixture(params=["memory", "sqlite"])
+@pytest.fixture(params=["memory", "sqlite", "native"])
 def store(request, tmp_path):
     if request.param == "memory":
         s = MemoryStore()
+    elif request.param == "native":
+        from seaweedfs_tpu.filer.filerstore import NativeKvStore
+
+        s = NativeKvStore(str(tmp_path / "filer.kv"))
     else:
         s = SqliteStore(str(tmp_path / "filer.db"))
     yield s

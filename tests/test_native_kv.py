@@ -1,0 +1,150 @@
+"""Native embedded KV (native/kvstore.cpp via storage/kvstore.py) — the
+leveldb-role component: bitcask log + hash index, crash replay, torn-tail
+recovery, compaction; plus the NativeKvStore filer adapter's durability."""
+import os
+
+import pytest
+
+from seaweedfs_tpu.storage.kvstore import NativeKv, native_available
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native library not built"
+)
+
+
+def test_kv_basic_ops(tmp_path):
+    kv = NativeKv(str(tmp_path / "t.kv"))
+    kv.put(b"alpha", b"1" * 10)
+    kv.put(b"beta", b"2" * 5000)  # exceeds the first get buffer
+    kv.put(b"alpha", b"updated")
+    assert kv.get(b"alpha") == b"updated"
+    assert kv.get(b"beta") == b"2" * 5000
+    assert kv.get(b"missing") is None
+    assert len(kv) == 2
+    assert kv.delete(b"beta")
+    assert not kv.delete(b"beta")  # double delete reports absent
+    assert kv.get(b"beta") is None
+    assert len(kv) == 1
+    assert dict(kv.items()) == {b"alpha": b"updated"}
+    kv.close()
+
+
+def test_kv_reopen_replays_log(tmp_path):
+    p = str(tmp_path / "t.kv")
+    kv = NativeKv(p)
+    for i in range(200):
+        kv.put(f"k{i}".encode(), os.urandom(50 + i))
+    kv.put(b"k7", b"second-version")
+    kv.delete(b"k9")
+    kv.close()
+    kv2 = NativeKv(p)
+    assert len(kv2) == 199
+    assert kv2.get(b"k7") == b"second-version"
+    assert kv2.get(b"k9") is None
+    kv2.close()
+
+
+def test_kv_torn_tail_recovery(tmp_path):
+    p = str(tmp_path / "t.kv")
+    kv = NativeKv(p)
+    kv.put(b"good", b"data")
+    kv.close()
+    with open(p, "ab") as f:
+        f.write(b"\x30\x00\x00\x00\xff")  # half a record header
+    kv2 = NativeKv(p)
+    assert kv2.get(b"good") == b"data"
+    kv2.put(b"after", b"crash")  # appends land on a clean boundary
+    kv2.close()
+    kv3 = NativeKv(p)
+    assert kv3.get(b"after") == b"crash" and len(kv3) == 2
+    kv3.close()
+
+
+def test_kv_compaction_reclaims_and_preserves(tmp_path):
+    p = str(tmp_path / "t.kv")
+    kv = NativeKv(p)
+    for i in range(50):
+        kv.put(b"hot", os.urandom(1000))  # 49 superseded versions
+    kv.put(b"cold", b"keep")
+    kv.delete(b"hot")
+    size_before = os.path.getsize(p)
+    assert kv.dead_bytes > 0
+    reclaimed = kv.compact()
+    assert reclaimed > 0
+    assert os.path.getsize(p) < size_before
+    assert kv.get(b"cold") == b"keep"
+    assert kv.get(b"hot") is None
+    assert len(kv) == 1
+    # still writable + durable after the swap
+    kv.put(b"post", b"compact")
+    kv.close()
+    kv2 = NativeKv(p)
+    assert kv2.get(b"post") == b"compact" and kv2.get(b"cold") == b"keep"
+    kv2.close()
+
+
+def test_filer_native_store_durability(tmp_path):
+    from seaweedfs_tpu.filer.entry import MODE_DIR, Attr, Entry
+    from seaweedfs_tpu.filer.filerstore import NativeKvStore, NotFoundError
+
+    p = str(tmp_path / "filer.kv")
+    s = NativeKvStore(p)
+    s.insert_entry(Entry(full_path="/docs", attr=Attr(mode=0o770 | MODE_DIR)))
+    for i in range(20):
+        s.insert_entry(
+            Entry(full_path=f"/docs/f{i:02d}", attr=Attr(file_size=i))
+        )
+    s.delete_entry("/docs/f03")
+    s.kv_put(b"cursor", b"42")
+    s.shutdown()
+
+    s2 = NativeKvStore(p)
+    names = [e.name for e in s2.list_directory_entries("/docs")]
+    assert names == sorted(f"f{i:02d}" for i in range(20) if i != 3)
+    page = s2.list_directory_entries("/docs", start_file_name="f05", limit=3)
+    assert [e.name for e in page] == ["f06", "f07", "f08"]
+    assert s2.find_entry("/docs/f10").attr.file_size == 10
+    with pytest.raises(NotFoundError):
+        s2.find_entry("/docs/f03")
+    assert s2.kv_get(b"cursor") == b"42"
+    assert s2.compact() >= 0
+    assert s2.find_entry("/docs/f10").attr.file_size == 10
+    s2.shutdown()
+
+
+def test_kv_tombstone_churn_does_not_fill_table(tmp_path):
+    """Delete-heavy workloads leave tombstone slots in the hash index;
+    growth must gate on occupancy or probing spins forever once the
+    initial 1024 slots fill."""
+    kv = NativeKv(str(tmp_path / "churn.kv"))
+    for i in range(3000):
+        k = f"churn-{i}".encode()
+        kv.put(k, b"v")
+        kv.delete(k)
+    assert len(kv) == 0
+    assert kv.get(b"absent-after-churn") is None  # must not hang
+    kv.put(b"alive", b"yes")
+    assert kv.get(b"alive") == b"yes"
+    kv.close()
+    kv2 = NativeKv(str(tmp_path / "churn.kv"))  # replay must not hang either
+    assert len(kv2) == 1 and kv2.get(b"alive") == b"yes"
+    kv2.close()
+
+
+def test_kv_torn_value_not_zero_extended(tmp_path):
+    """A record whose VALUE was half-written must be dropped at replay,
+    not zero-extended into a corrupt 'live' value."""
+    import struct as _s
+
+    p = str(tmp_path / "torn.kv")
+    kv = NativeKv(p)
+    kv.put(b"ok", b"fine")
+    kv.close()
+    with open(p, "ab") as f:
+        # header claims a 100-byte value but only 10 bytes follow
+        f.write(_s.pack("<II", 4, 100) + b"torn" + b"x" * 10)
+    kv2 = NativeKv(p)
+    assert kv2.get(b"torn") is None
+    assert kv2.get(b"ok") == b"fine"
+    assert len(kv2) == 1
+    kv2.close()

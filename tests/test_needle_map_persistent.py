@@ -11,7 +11,20 @@ import pytest
 from seaweedfs_tpu.storage import idx as idx_mod
 from seaweedfs_tpu.storage import types as t
 from seaweedfs_tpu.storage.needle_map import CompactMap
-from seaweedfs_tpu.storage.needle_map_persistent import SqliteNeedleMap
+from seaweedfs_tpu.storage.needle_map_persistent import (
+    NativeNeedleMap,
+    SqliteNeedleMap,
+)
+
+
+@pytest.fixture(params=["persistent", "native"])
+def map_kind(request):
+    return request.param
+
+
+def make_map(map_kind, db, idx, version=None):
+    cls = SqliteNeedleMap if map_kind == "persistent" else NativeNeedleMap
+    return cls(db, idx, version)
 from seaweedfs_tpu.storage.volume import Volume
 from seaweedfs_tpu.storage.vacuum import vacuum
 
@@ -32,11 +45,11 @@ def random_ops(rng, n=500):
     return ops
 
 
-def test_parity_with_compact_map(tmp_path):
+def test_parity_with_compact_map(tmp_path, map_kind):
     rng = random.Random(3)
     ops = random_ops(rng)
     cm = CompactMap()
-    sm = SqliteNeedleMap(str(tmp_path / "m.sdx"), str(tmp_path / "m.idx"))
+    sm = make_map(map_kind, str(tmp_path / "m.sdx"), str(tmp_path / "m.idx"))
     apply_ops(cm, ops)
     apply_ops(sm, ops)
     for nid in range(1, 60):
@@ -51,28 +64,28 @@ def test_parity_with_compact_map(tmp_path):
         s2.maximum_key)
 
 
-def test_incremental_open_via_watermark(tmp_path):
+def test_incremental_open_via_watermark(tmp_path, map_kind):
     """Open replays only the .idx tail past the watermark."""
     idx = str(tmp_path / "v.idx")
     db = str(tmp_path / "v.sdx")
     with open(idx, "ab") as f:
         for nid in range(1, 101):
             f.write(idx_mod.pack_entry(nid, nid * 16, 100))
-    m = SqliteNeedleMap(db, idx)
+    m = make_map(map_kind, db, idx)
     assert len(m) == 100 and m.get(50) == (800, 100)
     m.close()
     # append more entries while "down", reopen -> only the tail replays
     with open(idx, "ab") as f:
         for nid in range(101, 121):
             f.write(idx_mod.pack_entry(nid, nid * 16, 200))
-    m2 = SqliteNeedleMap(db, idx)
+    m2 = make_map(map_kind, db, idx)
     assert len(m2) == 120 and m2.get(110) == (1760, 200)
     # stats correct across the incremental open
     assert m2.stats.file_count == 120
     m2.close()
 
 
-def test_crash_replay_is_idempotent(tmp_path):
+def test_crash_replay_is_idempotent(tmp_path, map_kind):
     """A stale watermark (crash before flush) re-applies tail entries
     without double-counting stats."""
     idx = str(tmp_path / "v.idx")
@@ -80,46 +93,52 @@ def test_crash_replay_is_idempotent(tmp_path):
     with open(idx, "ab") as f:
         for nid in range(1, 11):
             f.write(idx_mod.pack_entry(nid, nid * 16, 100))
-    m = SqliteNeedleMap(db, idx)
+    m = make_map(map_kind, db, idx)
     m.flush()
     stats1 = (m.stats.file_count, m.stats.file_bytes, len(m))
     # simulate crash: reopen with watermark forced stale
-    m.conn.execute("UPDATE meta SET v = 0 WHERE k = 'watermark'")
-    m.conn.commit()
-    m.conn.close()
-    m2 = SqliteNeedleMap(db, idx)
+    if map_kind == "persistent":
+        m.conn.execute("UPDATE meta SET v = 0 WHERE k = 'watermark'")
+        m.conn.commit()
+        m.conn.close()
+    else:
+        m._meta_watermark = 0
+        m._save_meta()
+        m.kv.close()
+    m2 = make_map(map_kind, db, idx)
     assert (m2.stats.file_count, m2.stats.file_bytes, len(m2)) == stats1
     m2.close()
 
 
-def test_rebuild_when_idx_shrinks(tmp_path):
+def test_rebuild_when_idx_shrinks(tmp_path, map_kind):
     """Vacuum rewrote the .idx smaller than the watermark -> full rebuild."""
     idx = str(tmp_path / "v.idx")
     db = str(tmp_path / "v.sdx")
     with open(idx, "ab") as f:
         for nid in range(1, 21):
             f.write(idx_mod.pack_entry(nid, nid * 16, 100))
-    SqliteNeedleMap(db, idx).close()
+    make_map(map_kind, db, idx).close()
     with open(idx, "wb") as f:  # compacted: fewer entries, new offsets
         for nid in range(1, 6):
             f.write(idx_mod.pack_entry(nid, nid * 32, 77))
-    m = SqliteNeedleMap(db, idx)
+    m = make_map(map_kind, db, idx)
     assert len(m) == 5 and m.get(3) == (96, 77) and m.get(15) is None
     m.close()
 
 
-def test_reopen_does_not_resurrect_deleted_needles(tmp_path):
+def test_reopen_does_not_resurrect_deleted_needles(tmp_path, map_kind):
+    kind = map_kind
     """Write, delete, clean close, reopen: the deleted needle must stay
     deleted and reopen must not rescan the whole .dat (stale indexed_end
     would re-apply the needle's live record from disk)."""
     vdir = str(tmp_path)
-    v = Volume(vdir, 3, needle_map_kind="persistent")
+    v = Volume(vdir, 3, needle_map_kind=kind)
     v.write(1, 0xAA, b"first")
     v.write(2, 0xAA, b"second")
     v.delete(1, 0xAA)
     v.close()
 
-    v2 = Volume(vdir, 3, needle_map_kind="persistent")
+    v2 = Volume(vdir, 3, needle_map_kind=kind)
     with pytest.raises(KeyError):
         v2.read(1)
     assert v2.read(2, 0xAA).data == b"second"
@@ -133,15 +152,16 @@ def test_reopen_does_not_resurrect_deleted_needles(tmp_path):
     v2.close()
 
 
-def test_volume_lifecycle_persistent(tmp_path):
+def test_volume_lifecycle_persistent(tmp_path, map_kind):
+    kind = map_kind
     vdir = str(tmp_path)
-    v = Volume(vdir, 9, needle_map_kind="persistent")
+    v = Volume(vdir, 9, needle_map_kind=kind)
     payloads = {i: os.urandom(200 + i) for i in range(1, 40)}
     for nid, data in payloads.items():
         v.write(nid, 0xCAFE, data)
     v.delete(5, 0xCAFE)
     v.delete(17, 0xCAFE)
-    assert os.path.exists(v.sdx_path)
+    assert os.path.exists(v.sdx_path if kind == "persistent" else v.ndx_path)
     for nid, data in payloads.items():
         if nid in (5, 17):
             with pytest.raises(KeyError):
@@ -158,8 +178,10 @@ def test_volume_lifecycle_persistent(tmp_path):
     v.close()
 
     # reopen: persistent map comes back without manual idx replay
-    v2 = Volume(vdir, 9, needle_map_kind="persistent")
-    assert type(v2.nm).__name__ == "SqliteNeedleMap"
+    v2 = Volume(vdir, 9, needle_map_kind=kind)
+    assert type(v2.nm).__name__ == (
+        "SqliteNeedleMap" if kind == "persistent" else "NativeNeedleMap"
+    )
     for nid, data in payloads.items():
         if nid not in (5, 17):
             assert v2.read(nid, 0xCAFE).data == data
