@@ -9,6 +9,7 @@ SubscribeMetadata / filer.sync consumers.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 
 from .entry import Attr, Entry, MODE_DIR, dir_and_name, new_full_path
@@ -41,6 +42,10 @@ class Filer:
         self.meta_log = MetaLog(meta_log_path, notifier=notifier)
         self._delete_file_ids_fn = delete_file_ids_fn
         self._dir_cache: dict[str, float] = {}  # known-directory memo
+        # hard links: shared content + name refcount live in the store KV
+        # under the hard_link_id; all counter math happens under this lock
+        # (stores are sync and called from threads)
+        self._hl_lock = threading.Lock()
 
     # ------------------------------------------------------------------ reads
 
@@ -51,7 +56,44 @@ class Filer:
         entry = self.store.find_entry(full_path)
         if _is_expired(entry):
             raise NotFoundError(full_path)
-        return entry
+        return self._hl_overlay(entry)
+
+    # ---------------------------------------------------------- hard links
+    #
+    # POSIX hard links share one inode: chunks/attributes/xattrs written
+    # through ANY name must be visible through every other name, and data
+    # is released only when the LAST name goes (reference weedfs_link.go +
+    # filer hard-link resolution).  The shared content lives in the store
+    # KV at HL!<id>; named rows are pointers carrying the id, and HC!<id>
+    # counts the names.
+
+    def _hl_overlay(self, entry: Entry) -> Entry:
+        if not entry.hard_link_id:
+            return entry
+        try:
+            blob = self.store.kv_get(b"HL!" + entry.hard_link_id)
+        except NotFoundError:
+            return entry  # pre-link entry or missing content: serve the row
+        shared = Entry.decode(entry.full_path, blob)
+        shared.hard_link_id = entry.hard_link_id
+        return shared
+
+    def _hl_on_write(self, entry: Entry, new_name: bool) -> None:
+        """Publish an updated hard-linked entry's content and maintain the
+        name refcount.  Called after any named-row write."""
+        if not entry.hard_link_id:
+            return
+        with self._hl_lock:
+            self.store.kv_put(b"HL!" + entry.hard_link_id, entry.encode())
+            ckey = b"HC!" + entry.hard_link_id
+            try:
+                refs = int(self.store.kv_get(ckey))
+            except (NotFoundError, ValueError):
+                refs = 0
+            if new_name:
+                refs += 1
+            refs = max(refs, 1)  # first assignment: the existing name
+            self.store.kv_put(ckey, str(refs).encode())
 
     def list_directory_entries(
         self,
@@ -100,6 +142,7 @@ class Filer:
         if not skip_create_parents:
             self._ensure_parents(entry.directory)
         self.store.insert_entry(entry)
+        self._hl_on_write(entry, new_name=old is None)
         await self.meta_log.append(
             entry.directory, old, entry, signatures=signatures or []
         )
@@ -133,6 +176,7 @@ class Filer:
             if not old_entry.is_directory and entry.is_directory:
                 raise FilerError(f"existing {entry.full_path} is a file")
         self.store.update_entry(entry)
+        self._hl_on_write(entry, new_name=False)
         await self.meta_log.append(entry.directory, old_entry, entry)
 
     async def append_chunks(self, full_path: str, chunks: list) -> Entry:
@@ -151,6 +195,7 @@ class Filer:
         entry.attr.mtime = int(time.time())
         entry.attr.file_size = offset
         self.store.insert_entry(entry)
+        self._hl_on_write(entry, new_name=False)
         await self.meta_log.append(entry.directory, None, entry)
         return entry
 
@@ -170,7 +215,8 @@ class Filer:
             await self._delete_children(
                 entry, is_recursive, ignore_recursive_error, chunks
             )
-        chunks.extend(entry.chunks)
+        if self._release_hard_link(entry):
+            chunks.extend(entry.chunks)
         self.store.delete_entry(entry.full_path)
         self._dir_cache.pop(entry.full_path, None)
         await self.meta_log.append(
@@ -197,7 +243,8 @@ class Filer:
                         await self._delete_children(
                             child, is_recursive, ignore_errors, chunks
                         )
-                    chunks.extend(child.chunks)
+                    if self._release_hard_link(child):
+                        chunks.extend(child.chunks)
                     self.store.delete_entry(child.full_path)
                     self._dir_cache.pop(child.full_path, None)
                     await self.meta_log.append(child.directory, child, None)
@@ -206,6 +253,28 @@ class Filer:
                         raise
             if len(children) < 1024:
                 return
+
+    def _release_hard_link(self, entry: Entry) -> bool:
+        """-> True when the entry's chunks may be GC'd: not hard-linked,
+        or this was the LAST name referencing the shared chunk list
+        (reference weedfs_link.go + filer hard-link counters)."""
+        if not entry.hard_link_id:
+            return True
+        with self._hl_lock:
+            ckey = b"HC!" + entry.hard_link_id
+            try:
+                refs = int(self.store.kv_get(ckey))
+            except (NotFoundError, ValueError):
+                # counter absent: sole owner (pre-link entry)
+                self.store.kv_delete(b"HL!" + entry.hard_link_id)
+                return True
+            refs -= 1
+            if refs <= 0:
+                self.store.kv_delete(ckey)
+                self.store.kv_delete(b"HL!" + entry.hard_link_id)
+                return True
+            self.store.kv_put(ckey, str(refs).encode())
+            return False
 
     async def _delete_chunks(self, chunks: list) -> None:
         if self._delete_file_ids_fn is None:

@@ -23,6 +23,38 @@ class NotFoundError(KeyError):
     pass
 
 
+def _children_add(names: list[str], name: str) -> None:
+    """Insert into a sorted child-name list if absent."""
+    i = bisect_left(names, name)
+    if i >= len(names) or names[i] != name:
+        names.insert(i, name)
+
+
+def _children_discard(names: list[str], name: str) -> None:
+    i = bisect_left(names, name)
+    if i < len(names) and names[i] == name:
+        names.pop(i)
+
+
+def _children_page(
+    names: list[str], start_file_name: str, include_start: bool,
+    prefix: str, limit: int,
+) -> list[str]:
+    """One listing page over a sorted child-name list — the pagination
+    rules live ONCE for every store that keeps a sorted children index."""
+    i = bisect_left(names, start_file_name) if start_file_name else 0
+    out: list[str] = []
+    for name in names[i:]:
+        if name == start_file_name and not include_start:
+            continue
+        if prefix and not name.startswith(prefix):
+            continue
+        out.append(name)
+        if len(out) >= limit:
+            break
+    return out
+
+
 class FilerStore:
     """Abstract store: path → serialized Entry + a kv sideband."""
 
@@ -92,10 +124,10 @@ class MemoryStore(FilerStore):
             existed = entry.full_path in self._entries
             self._entries[entry.full_path] = entry
             if not existed:
-                names = self._children.setdefault(entry.directory, [])
-                i = bisect_left(names, entry.name)
-                if i >= len(names) or names[i] != entry.name:
-                    insort(names, entry.name)
+                _children_add(
+                    self._children.setdefault(entry.directory, []),
+                    entry.name,
+                )
 
     update_entry = insert_entry
 
@@ -110,10 +142,7 @@ class MemoryStore(FilerStore):
         with self._lock:
             e = self._entries.pop(full_path, None)
             if e is not None:
-                names = self._children.get(e.directory, [])
-                i = bisect_left(names, e.name)
-                if i < len(names) and names[i] == e.name:
-                    names.pop(i)
+                _children_discard(self._children.get(e.directory, []), e.name)
 
     def delete_folder_children(self, full_path: str) -> None:
         with self._lock:
@@ -125,17 +154,12 @@ class MemoryStore(FilerStore):
     ):
         with self._lock:
             names = self._children.get(dir_path.rstrip("/") or "/", [])
-            i = bisect_left(names, start_file_name) if start_file_name else 0
-            out = []
-            for name in names[i:]:
-                if name == start_file_name and not include_start:
-                    continue
-                if prefix and not name.startswith(prefix):
-                    continue
-                out.append(self._entries[new_full_path(dir_path, name)])
-                if len(out) >= limit:
-                    break
-            return out
+            page = _children_page(
+                names, start_file_name, include_start, prefix, limit
+            )
+            return [
+                self._entries[new_full_path(dir_path, name)] for name in page
+            ]
 
     def kv_put(self, key, value):
         self._kv[bytes(key)] = bytes(value)
@@ -291,20 +315,16 @@ class NativeKvStore(FilerStore):
                 continue
             full_path = k[1:].decode()
             d, n = dir_and_name(full_path)
-            names = self._children.setdefault(d, [])
-            i = bisect_left(names, n)
-            if i >= len(names) or names[i] != n:
-                names.insert(i, n)
+            _children_add(self._children.setdefault(d, []), n)
 
     def insert_entry(self, entry: Entry) -> None:
         with self._lock:
             self._kv_store.put(
                 b"E" + entry.full_path.encode(), entry.encode()
             )
-            names = self._children.setdefault(entry.directory, [])
-            i = bisect_left(names, entry.name)
-            if i >= len(names) or names[i] != entry.name:
-                names.insert(i, entry.name)
+            _children_add(
+                self._children.setdefault(entry.directory, []), entry.name
+            )
 
     update_entry = insert_entry
 
@@ -321,10 +341,7 @@ class NativeKvStore(FilerStore):
         with self._lock:
             self._kv_store.delete(b"E" + full_path.encode())
             d, n = dir_and_name(full_path)
-            names = self._children.get(d, [])
-            i = bisect_left(names, n)
-            if i < len(names) and names[i] == n:
-                names.pop(i)
+            _children_discard(self._children.get(d, []), n)
 
     def delete_folder_children(self, full_path: str) -> None:
         with self._lock:
@@ -337,21 +354,17 @@ class NativeKvStore(FilerStore):
     ):
         with self._lock:
             d = dir_path.rstrip("/") or "/"
-            names = self._children.get(d, [])
-            i = bisect_left(names, start_file_name) if start_file_name else 0
+            page = _children_page(
+                self._children.get(d, []), start_file_name, include_start,
+                prefix, limit,
+            )
             out = []
-            for name in names[i:]:
-                if name == start_file_name and not include_start:
-                    continue
-                if prefix and not name.startswith(prefix):
-                    continue
+            for name in page:
                 blob = self._kv_store.get(
                     b"E" + new_full_path(d, name).encode()
                 )
                 if blob is not None:
                     out.append(Entry.decode(new_full_path(d, name), blob))
-                if len(out) >= limit:
-                    break
             return out
 
     def kv_put(self, key, value):

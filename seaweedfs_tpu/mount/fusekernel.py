@@ -39,6 +39,10 @@ WRITE = 16
 STATFS = 17
 RELEASE = 18
 FSYNC = 20
+SETXATTR = 21
+GETXATTR = 22
+LISTXATTR = 23
+REMOVEXATTR = 24
 FLUSH = 25
 INIT = 26
 OPENDIR = 27
@@ -63,6 +67,10 @@ OPEN_OUT = struct.Struct("<QII")  # fh open_flags padding
 WRITE_OUT = struct.Struct("<II")
 INIT_OUT = struct.Struct("<IIIIHHIIHHI28x")  # 7.28+ layout, 80 bytes
 STATFS_OUT = struct.Struct("<QQQQQIIII24x")  # kstatfs, 80 bytes
+GETXATTR_IN = struct.Struct("<II")  # size padding (+ name\0)
+GETXATTR_OUT = struct.Struct("<II")  # size padding
+SETXATTR_IN = struct.Struct("<II")  # size flags (+ name\0 + value)
+LINK_IN = struct.Struct("<Q")  # oldnodeid (+ newname\0)
 
 FOPEN_DIRECT_IO = 1 << 0
 FOPEN_KEEP_CACHE = 1 << 1
@@ -288,4 +296,9 @@ _DISPATCH = {
     MKNOD: "mknod",
     SYMLINK: "symlink",
     LSEEK: "lseek",
+    LINK: "link",
+    SETXATTR: "setxattr",
+    GETXATTR: "getxattr",
+    LISTXATTR: "listxattr",
+    REMOVEXATTR: "removexattr",
 }

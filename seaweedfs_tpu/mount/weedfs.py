@@ -261,6 +261,19 @@ class WeedFS:
         path = self.inodes.path(nodeid)
         if valid & FATTR_SIZE:
             h = self.handles.get(fh)
+            if h is None or not h.writable:
+                # O_TRUNC truncates arrive WITHOUT FATTR_FH on this kernel;
+                # route them to any open writable handle for the path — the
+                # no-handle filer rewrite below would RACE the first WRITE's
+                # spool seeding (seed reads old content while the truncate
+                # PUT is in flight) and resurrect the old tail on flush
+                h = next(
+                    (
+                        x for x in self.handles.values()
+                        if x.path == path and x.writable
+                    ),
+                    None,
+                )
             if h is not None and h.writable:
                 await self._ensure_spool(h)
                 h.spool.truncate(size)
@@ -490,6 +503,101 @@ class WeedFS:
         ino = self.inodes.lookup(path)
         entry = await self._find(path)
         return fk.pack_entry_out(ino, self._attr_of(ino, entry))
+
+    async def _update_entry(self, path: str, entry) -> None:
+        d, _, _n = path.rpartition("/")
+        await self._stub().UpdateEntry(
+            filer_pb2.UpdateEntryRequest(directory=d or "/", entry=entry)
+        )
+
+    async def link(self, nodeid: int, body: bytes, **kw) -> bytes:
+        """Hard link (weedfs_link.go): names become pointers to shared
+        content keyed by hard_link_id; the FILER owns the refcount and
+        content publication (Filer._hl_on_write / _release_hard_link), so
+        this op just assigns the id and creates the second name."""
+        import uuid
+
+        (old_ino,) = fk.LINK_IN.unpack_from(body)
+        newname = body[fk.LINK_IN.size:].rstrip(b"\x00").decode()
+        old_path = self.inodes.path(old_ino)
+        new_parent = self.inodes.path(nodeid)
+        old = await self._find(old_path)
+        if old.is_directory:
+            raise fk.FuseError(errno.EPERM)
+        if not old.hard_link_id:
+            old.hard_link_id = uuid.uuid4().bytes
+            await self._update_entry(old_path, old)  # filer inits count=1
+        new_entry = filer_pb2.Entry()
+        new_entry.CopyFrom(old)
+        new_entry.name = newname
+        resp = await self._stub().CreateEntry(
+            filer_pb2.CreateEntryRequest(
+                directory=new_parent, entry=new_entry
+            )
+        )
+        if resp.error:
+            raise fk.FuseError(errno.EEXIST)
+        new_path = (new_parent.rstrip("/") or "") + "/" + newname
+        ino = self.inodes.lookup(new_path)
+        entry = await self._find(new_path)
+        return fk.pack_entry_out(ino, self._attr_of(ino, entry))
+
+    # xattrs: stored in the entry's extended map under an "xattr-" prefix
+    # so mount-internal markers (remote.*, mount.*) never surface
+
+    async def setxattr(self, nodeid: int, body: bytes, **kw) -> bytes:
+        XATTR_CREATE, XATTR_REPLACE = 1, 2
+        size, flags = fk.SETXATTR_IN.unpack_from(body)
+        rest = body[fk.SETXATTR_IN.size:]
+        name, _, value_and_pad = rest.partition(b"\x00")
+        value = value_and_pad[:size]
+        path = self.inodes.path(nodeid)
+        entry = await self._find(path)
+        key = "xattr-" + name.decode()
+        exists = key in entry.extended
+        if flags & XATTR_CREATE and exists:
+            raise fk.FuseError(errno.EEXIST)
+        if flags & XATTR_REPLACE and not exists:
+            raise fk.FuseError(errno.ENODATA)
+        entry.extended[key] = value
+        await self._update_entry(path, entry)
+        return b""
+
+    async def getxattr(self, nodeid: int, body: bytes, **kw) -> bytes:
+        size, _ = fk.GETXATTR_IN.unpack_from(body)
+        name = body[fk.GETXATTR_IN.size:].rstrip(b"\x00").decode()
+        entry = await self._find(self.inodes.path(nodeid))
+        value = entry.extended.get("xattr-" + name)
+        if value is None:
+            raise fk.FuseError(errno.ENODATA)
+        if size == 0:  # size probe
+            return fk.GETXATTR_OUT.pack(len(value), 0)
+        if len(value) > size:
+            raise fk.FuseError(errno.ERANGE)
+        return bytes(value)
+
+    async def listxattr(self, nodeid: int, body: bytes, **kw) -> bytes:
+        size, _ = fk.GETXATTR_IN.unpack_from(body)
+        entry = await self._find(self.inodes.path(nodeid))
+        names = sorted(
+            k[len("xattr-"):] for k in entry.extended if k.startswith("xattr-")
+        )
+        blob = b"".join(n.encode() + b"\x00" for n in names)
+        if size == 0:
+            return fk.GETXATTR_OUT.pack(len(blob), 0)
+        if len(blob) > size:
+            raise fk.FuseError(errno.ERANGE)
+        return blob
+
+    async def removexattr(self, nodeid: int, body: bytes, **kw) -> bytes:
+        name = body.rstrip(b"\x00").decode()
+        path = self.inodes.path(nodeid)
+        entry = await self._find(path)
+        if ("xattr-" + name) not in entry.extended:
+            raise fk.FuseError(errno.ENODATA)
+        del entry.extended["xattr-" + name]
+        await self._update_entry(path, entry)
+        return b""
 
     # files
 

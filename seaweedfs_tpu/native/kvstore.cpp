@@ -209,6 +209,17 @@ void* kv_open(const char* path) {
   }
   s->log = f;
   s->table.assign(1024, Slot{0, 0});
+  if (!fresh) {
+    // a file shorter than the header means a crash between create and
+    // the magic flush — treat as fresh rather than bricking the store
+    fseeko(f, 0, SEEK_END);
+    if ((uint64_t)ftello(f) < sizeof(MAGIC)) {
+      fresh = true;
+      fseeko(f, 0, SEEK_SET);
+    } else {
+      fseeko(f, 0, SEEK_SET);
+    }
+  }
   if (fresh) {
     fwrite(MAGIC, 1, sizeof(MAGIC), f);
     fflush(f);

@@ -698,7 +698,13 @@ class FilerServer:
             )
             old_chunks = []
             try:
-                old_chunks = list(self.filer.find_entry(path).chunks)
+                old = self.filer.find_entry(path)
+                # overwriting a hard-linked name rewrites the SHARED
+                # content: inherit the id so every other name sees the new
+                # data, and the replaced chunks are safe to GC exactly
+                # because all names now point at the replacement
+                entry.hard_link_id = old.hard_link_id
+                old_chunks = list(old.chunks)
             except NotFoundError:
                 pass
             await self.filer.create_entry(entry)
@@ -786,9 +792,9 @@ class FilerServer:
         except web.HTTPForbidden as e:
             return filer_pb2.CreateEntryResponse(error=e.text)
         entry = Entry.from_pb(request.directory, request.entry)
-        old_chunks: list = []
+        old = None
         try:
-            old_chunks = list(self.filer.find_entry(entry.full_path).chunks)
+            old = self.filer.find_entry(entry.full_path)
         except NotFoundError:
             pass
         try:
@@ -800,8 +806,15 @@ class FilerServer:
             )
         except FilerError as e:
             return filer_pb2.CreateEntryResponse(error=str(e))
-        if old_chunks:
-            await self.filer.delete_unused_chunks(old_chunks, entry.chunks)
+        if old is not None and old.chunks:
+            if old.hard_link_id and old.hard_link_id != entry.hard_link_id:
+                # the name detached from its link group: drop ONE ref;
+                # the shared chunks live on for the other names
+                self.filer._release_hard_link(old)
+            else:
+                await self.filer.delete_unused_chunks(
+                    old.chunks, entry.chunks
+                )
         return filer_pb2.CreateEntryResponse()
 
     async def UpdateEntry(self, request, context):
@@ -819,7 +832,12 @@ class FilerServer:
             pass
         await self.filer.update_entry(old, entry)
         if old is not None:
-            await self.filer.delete_unused_chunks(old.chunks, entry.chunks)
+            if old.hard_link_id and old.hard_link_id != entry.hard_link_id:
+                self.filer._release_hard_link(old)  # name left the group
+            else:
+                await self.filer.delete_unused_chunks(
+                    old.chunks, entry.chunks
+                )
         return filer_pb2.UpdateEntryResponse()
 
     async def AppendToEntry(self, request, context):

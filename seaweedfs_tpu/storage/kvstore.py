@@ -81,9 +81,18 @@ class NativeKv:
         self.path = path
         self._lock = threading.Lock()
 
+    def _handle(self):
+        """The live handle, or raise — a closed store must error in
+        Python, not hand ctypes a NULL to segfault on."""
+        if not self._h:
+            raise ValueError(f"kv store {self.path!r} is closed")
+        return self._h
+
     def put(self, key: bytes, value: bytes) -> None:
         with self._lock:
-            rc = self._lib.kv_put(self._h, key, len(key), value, len(value))
+            rc = self._lib.kv_put(
+                self._handle(), key, len(key), value, len(value)
+            )
         if rc != 0:
             raise OSError(f"kv_put failed (rc={rc})")
 
@@ -92,7 +101,7 @@ class NativeKv:
         with self._lock:
             while True:
                 buf = ctypes.create_string_buffer(cap)
-                n = self._lib.kv_get(self._h, key, len(key), buf, cap)
+                n = self._lib.kv_get(self._handle(), key, len(key), buf, cap)
                 if n == -1:
                     return None
                 if n == -2:
@@ -102,16 +111,16 @@ class NativeKv:
 
     def delete(self, key: bytes) -> bool:
         with self._lock:
-            return self._lib.kv_delete(self._h, key, len(key)) == 0
+            return self._lib.kv_delete(self._handle(), key, len(key)) == 0
 
     def __len__(self) -> int:
         with self._lock:
-            return self._lib.kv_count(self._h)
+            return self._lib.kv_count(self._handle())
 
     @property
     def dead_bytes(self) -> int:
         with self._lock:
-            return self._lib.kv_dead_bytes(self._h)
+            return self._lib.kv_dead_bytes(self._handle())
 
     def items(self) -> list[tuple[bytes, bytes]]:
         out: list[tuple[bytes, bytes]] = []
@@ -124,7 +133,7 @@ class NativeKv:
             return 0
 
         with self._lock:
-            rc = self._lib.kv_iterate(self._h, cb, None)
+            rc = self._lib.kv_iterate(self._handle(), cb, None)
         if rc != 0:
             raise OSError(f"kv_iterate failed (rc={rc})")
         return out
@@ -140,18 +149,18 @@ class NativeKv:
             return 0
 
         with self._lock:
-            rc = self._lib.kv_iterate_keys(self._h, cb, None)
+            rc = self._lib.kv_iterate_keys(self._handle(), cb, None)
         if rc != 0:
             raise OSError(f"kv_iterate_keys failed (rc={rc})")
         return out
 
     def flush(self) -> None:
         with self._lock:
-            self._lib.kv_flush(self._h)
+            self._lib.kv_flush(self._handle())
 
     def compact(self) -> int:
         with self._lock:
-            reclaimed = self._lib.kv_compact(self._h)
+            reclaimed = self._lib.kv_compact(self._handle())
         if reclaimed < 0:
             raise OSError("kv_compact failed")
         return reclaimed

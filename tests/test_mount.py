@@ -148,3 +148,122 @@ def test_mount_sees_filer_writes_and_vice_versa(tmp_path):
             await cluster.stop()
 
     run(go())
+
+
+def test_mount_xattrs(tmp_path):
+    async def go():
+        cluster, m, mnt = await mounted(tmp_path)
+        try:
+            def fsops():
+                p = mnt + "/x.txt"
+                with open(p, "wb") as f:
+                    f.write(b"data")
+                os.setxattr(p, "user.color", b"blue")
+                os.setxattr(p, "user.shape", b"round")
+                assert os.getxattr(p, "user.color") == b"blue"
+                assert sorted(os.listxattr(p)) == ["user.color", "user.shape"]
+                os.setxattr(p, "user.color", b"red")  # overwrite
+                assert os.getxattr(p, "user.color") == b"red"
+                os.removexattr(p, "user.shape")
+                assert os.listxattr(p) == ["user.color"]
+                with pytest.raises(OSError):
+                    os.getxattr(p, "user.shape")
+                with pytest.raises(OSError):
+                    os.removexattr(p, "user.absent")
+
+            await asyncio.wait_for(asyncio.to_thread(fsops), 60)
+        finally:
+            await m.stop()
+            await cluster.stop()
+
+    run(go())
+
+
+def test_mount_hard_links(tmp_path):
+    async def go():
+        cluster, m, mnt = await mounted(tmp_path)
+        try:
+            blob = os.urandom(200_000)
+
+            def fsops():
+                a = mnt + "/orig.bin"
+                b = mnt + "/linked.bin"
+                with open(a, "wb") as f:
+                    f.write(blob)
+                os.link(a, b)
+                with open(b, "rb") as f:
+                    assert f.read() == blob
+                # shared inode: writes through ONE name are visible
+                # through the other
+                with open(a, "wb") as f:
+                    f.write(b"rewritten-via-a")
+                with open(b, "rb") as f:
+                    assert f.read() == b"rewritten-via-a"
+                # xattrs ride the shared inode too
+                os.setxattr(a, "user.tag", b"shared")
+                assert os.getxattr(b, "user.tag") == b"shared"
+                # restore big content for the filer-side check below
+                with open(a, "wb") as f:
+                    f.write(blob)
+                # deleting ONE name must not GC the shared chunks
+                os.remove(a)
+                with open(b, "rb") as f:
+                    assert f.read() == blob
+
+            await asyncio.wait_for(asyncio.to_thread(fsops), 60)
+            # the surviving name still reads through the filer (chunks
+            # intact on the volume servers, not just cached)
+            cluster.filer.chunk_cache.clear() if hasattr(
+                cluster.filer.chunk_cache, "clear"
+            ) else None
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"http://{cluster.filer.url}/linked.bin"
+                ) as r:
+                    assert r.status == 200
+                    assert await r.read() == blob
+
+            def fsops2():
+                # removing the LAST name releases the data
+                os.remove(mnt + "/linked.bin")
+                assert not os.path.exists(mnt + "/linked.bin")
+
+            await asyncio.wait_for(asyncio.to_thread(fsops2), 60)
+        finally:
+            await m.stop()
+            await cluster.stop()
+
+    run(go())
+
+
+def test_mount_wb_overwrite_truncates(tmp_path):
+    """Reopening an existing file with open('wb') must truncate: the
+    kernel's no-fh SETATTR size=0 used to race the first WRITE's spool
+    seeding and resurrect the old tail on flush."""
+
+    async def go():
+        cluster, m, mnt = await mounted(tmp_path)
+        try:
+            blob = os.urandom(100_000)
+
+            def fsops():
+                p = mnt + "/over.bin"
+                with open(p, "wb") as f:
+                    f.write(blob)
+                with open(p, "wb") as f:
+                    f.write(b"short")
+                assert os.stat(p).st_size == 5
+                with open(p, "rb") as f:
+                    assert f.read() == b"short"
+
+            await asyncio.wait_for(asyncio.to_thread(fsops), 60)
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"http://{cluster.filer.url}/over.bin"
+                ) as r:
+                    assert await r.read() == b"short"
+        finally:
+            await m.stop()
+            await cluster.stop()
+
+    run(go())
