@@ -10,6 +10,7 @@ import asyncio
 import sys
 
 from . import (
+    autocomplete,
     backup,
     benchmark,
     compact,
@@ -46,7 +47,7 @@ COMMANDS = {
         filer_cat, filer_backup, filer_meta_backup, filer_meta_tail,
         s3, iam, webdav, mount, mq_broker,
         server, shell, fix, fsck, compact, export, backup, upload, download,
-        benchmark, scaffold, version,
+        benchmark, scaffold, autocomplete, version,
     )
 }
 
@@ -81,4 +82,11 @@ def main(argv: list[str] | None = None) -> int:
         asyncio.run(COMMANDS[args.command].run(args))
     except KeyboardInterrupt:
         return 130
+    except BrokenPipeError:
+        # only stdout-streaming commands treat a closed pipe (head, less)
+        # as success; for servers a broken pipe is a real failure that
+        # must not read as a clean exit to supervisors
+        if getattr(COMMANDS[args.command], "STDOUT_STREAM", False):
+            return 0
+        raise
     return 0

@@ -1,0 +1,37 @@
+"""`autocomplete` — emit a bash completion script for the CLI
+(reference: weed/command/autocomplete.go installs fish/bash/zsh
+completion; zero-egress here, so the script prints to stdout and the
+user sources it)."""
+from __future__ import annotations
+
+NAME = "autocomplete"
+HELP = "print a bash completion script for python -m seaweedfs_tpu"
+STDOUT_STREAM = True  # piping into head/less is expected
+
+
+def add_args(p) -> None:
+    pass
+
+
+async def run(args) -> None:
+    from . import COMMANDS
+
+    names = " ".join(sorted(COMMANDS))
+    # bash keys completion specs on the command's FIRST word, so
+    # `python -m seaweedfs_tpu` can't carry a spec directly — the script
+    # ships a `seaweedfs_tpu` wrapper function and completes THAT
+    print(
+        f"""# bash completion for seaweedfs_tpu
+# install:  python -m seaweedfs_tpu autocomplete > ~/.seaweedfs_tpu-completion
+#           echo 'source ~/.seaweedfs_tpu-completion' >> ~/.bashrc
+seaweedfs_tpu() {{
+    python -m seaweedfs_tpu "$@"
+}}
+_seaweedfs_tpu() {{
+    local cur=${{COMP_WORDS[COMP_CWORD]}}
+    if [ $COMP_CWORD -eq 1 ]; then
+        COMPREPLY=( $(compgen -W "{names}" -- "$cur") )
+    fi
+}}
+complete -F _seaweedfs_tpu seaweedfs_tpu"""
+    )

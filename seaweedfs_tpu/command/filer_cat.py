@@ -6,6 +6,7 @@ import sys
 
 NAME = "filer.cat"
 HELP = "write a filer file's bytes to stdout"
+STDOUT_STREAM = True  # piping into head/less is expected
 
 
 def add_args(p) -> None:

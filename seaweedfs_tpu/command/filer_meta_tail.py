@@ -6,6 +6,7 @@ import json
 
 NAME = "filer.meta.tail"
 HELP = "tail filer metadata change events as JSON lines"
+STDOUT_STREAM = True  # piping into head/less is expected
 
 
 def add_args(p) -> None:

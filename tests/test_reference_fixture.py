@@ -126,3 +126,37 @@ class TestFixtureVolume:
             rebuilt = f.read()
         assert rebuilt == original
         assert len(entries) > 0
+
+
+NEEDLE_FIXTURE = "/root/reference/weed/storage/needle/43.dat"
+
+
+@pytest.mark.skipif(
+    not os.path.exists(NEEDLE_FIXTURE), reason="reference fixture not available"
+)
+def test_reference_needle_volume_reindexes_and_reads(tmp_path):
+    """43.dat is a reference-written v3 volume committed WITHOUT its .idx:
+    opening it exercises the reindex-from-.dat recovery path on real
+    reference bytes (CRC verify + record walking), and the recovered
+    needle must read back clean."""
+    shutil.copy(NEEDLE_FIXTURE, tmp_path / "43.dat")
+    os.chmod(tmp_path / "43.dat", 0o644)
+    v = Volume(str(tmp_path), 43)
+    try:
+        assert v.version == 3
+        assert len(v.nm) >= 1, "recovery must reindex the reference needle"
+        nid = next(iter(v.nm.items()))[0]
+        n = v.read(nid)
+        assert n.id == nid
+        assert len(n.data) > 0
+        assert n.data[:2] == b"PK", "fixture payload is a zip archive"
+        # the rebuilt index round-trips: reopen reads the same needle
+        v.close()
+        v2 = Volume(str(tmp_path), 43)
+        assert v2.read(nid).data == n.data
+        v2.close()
+    finally:
+        try:
+            v.close()
+        except Exception:
+            pass
