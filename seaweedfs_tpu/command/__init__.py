@@ -87,6 +87,13 @@ def main(argv: list[str] | None = None) -> int:
         # as success; for servers a broken pipe is a real failure that
         # must not read as a clean exit to supervisors
         if getattr(COMMANDS[args.command], "STDOUT_STREAM", False):
+            # the interpreter's exit-time stdout flush would hit the same
+            # broken fd and override the status to 120 — point stdout at
+            # devnull first (the python docs' SIGPIPE note pattern)
+            import os as _os
+
+            devnull = _os.open(_os.devnull, _os.O_WRONLY)
+            _os.dup2(devnull, sys.stdout.fileno())
             return 0
         raise
     return 0

@@ -4,7 +4,6 @@ from __future__ import annotations
 
 NAME = "export"
 HELP = "export a volume's needles to a tar file"
-STDOUT_STREAM = True  # piping into head/less is expected
 
 
 def add_args(p) -> None:

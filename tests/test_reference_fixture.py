@@ -19,7 +19,9 @@ from seaweedfs_tpu.storage.volume import Volume
 
 FIXTURE_DIR = "/root/reference/weed/storage/erasure_coding"
 
-pytestmark = pytest.mark.skipif(
+# class-level (not module-level): the needle-volume test below has its own
+# fixture and must not be masked when only the EC fixture is absent
+ec_fixture_required = pytest.mark.skipif(
     not os.path.exists(os.path.join(FIXTURE_DIR, "1.dat")),
     reason="reference fixture not available",
 )
@@ -44,6 +46,7 @@ def live_entries(idx_path):
     return {nid: os for nid, os in latest.items() if os[1] >= 0}
 
 
+@ec_fixture_required
 class TestFixtureVolume:
     def test_all_needles_readable(self, fixture_volume, tmp_path):
         entries = live_entries(str(tmp_path / "1.idx"))
