@@ -1,0 +1,96 @@
+"""Concurrency-hygiene gate: the Python analogue of the reference CI's
+`-race` e2e (build with -race, run fio, grep logs for DATA RACE —
+.github/workflows/e2e.yml:40-105).  Python's races surface as asyncio
+debug findings instead: coroutines never awaited, task exceptions never
+retrieved, and error-level logs out of the server loops.  This test runs
+a deliberately concurrent mixed workload against a live cluster with
+asyncio debug mode on and fails on any of those findings."""
+import asyncio
+import logging
+import os
+import warnings
+
+import aiohttp
+import pytest
+
+from seaweedfs_tpu.server.cluster import LocalCluster
+
+
+class _Collector(logging.Handler):
+    def __init__(self):
+        super().__init__(level=logging.ERROR)
+        self.records: list[str] = []
+
+    def emit(self, record):
+        # server loops must not leak unhandled exceptions under load
+        self.records.append(f"{record.name}: {record.getMessage()}")
+
+
+def test_concurrent_workload_is_clean(tmp_path):
+    collector = _Collector()
+
+    async def go():
+        cluster = LocalCluster(
+            base_dir=str(tmp_path), n_volume_servers=2, with_filer=True,
+            pulse_seconds=1,
+        )
+        await cluster.start()
+        try:
+            base = f"http://{cluster.filer.url}"
+            payloads = {
+                f"/load/f{i:03d}.bin": os.urandom(1024 * (1 + i % 64))
+                for i in range(96)
+            }
+            async with aiohttp.ClientSession() as s:
+
+                async def writer(path, data):
+                    async with s.put(base + path, data=data) as r:
+                        assert r.status in (200, 201)
+
+                async def reader(path, data):
+                    for _ in range(3):
+                        async with s.get(base + path) as r:
+                            if r.status == 200:
+                                assert await r.read() == data
+                                return
+                            await asyncio.sleep(0.05)
+
+                async def deleter(path):
+                    async with s.delete(base + path) as r:
+                        assert r.status < 500
+
+                await asyncio.gather(
+                    *(writer(p, d) for p, d in payloads.items())
+                )
+                items = list(payloads.items())
+                await asyncio.gather(
+                    *(reader(p, d) for p, d in items[:48]),
+                    *(writer(p, d + b"!") for p, d in items[48:72]),
+                    *(deleter(p) for p, _ in items[72:]),
+                )
+        finally:
+            await cluster.stop()
+        # let any stray callbacks fire before the loop closes
+        await asyncio.sleep(0.2)
+
+    root = logging.getLogger()
+    root.addHandler(collector)
+    try:
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            asyncio.run(go(), debug=True)
+    finally:
+        root.removeHandler(collector)
+
+    never_awaited = [
+        str(w.message) for w in caught
+        if "was never awaited" in str(w.message)
+    ]
+    assert not never_awaited, never_awaited
+    # "Task exception was never retrieved" arrives via the asyncio logger
+    # at ERROR level -> the collector; so do unhandled server errors
+    leaks = [
+        r for r in collector.records
+        if "never retrieved" in r or "Unhandled" in r or "exception" in r.lower()
+    ]
+    assert not leaks, leaks
