@@ -76,7 +76,11 @@ def _distributed_apply(mesh: Mesh, a_groups: jax.Array, x: jax.Array, m_rows: in
         partial = jnp.dot(
             a_loc[0], bits, preferred_element_type=jnp.int32
         )  # [8m, B_loc]
-        counts = jax.lax.psum(partial, axis_name="shard")
+        # mod-2 BEFORE the collective: (Σ cᵢ) mod 2 == (Σ (cᵢ mod 2)) mod 2,
+        # so psum'ing the int8 bit-planes is exact (sums ≤ n_shard < 128)
+        # and moves 4x fewer bytes over ICI than the raw int32 counts
+        pbits = (partial & 1).astype(jnp.int8)
+        counts = jax.lax.psum(pbits, axis_name="shard")
         return _pack_bits_bitmajor(counts, m_rows)  # [m, B_loc]
 
     return shard_map(
