@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import sqlite3
 import threading
-from bisect import bisect_left, insort
+from bisect import bisect_left
 
 from .entry import Entry, new_full_path
 
