@@ -41,6 +41,12 @@ def add_args(p) -> None:
         help="append-only metadata event log path",
     )
     p.add_argument(
+        "-notifySpool", dest="notify_spool", default="",
+        help="publish every metadata change to this spool file "
+        "(the queue `filer.replicate` consumes; reference: "
+        "notification.toml backends)",
+    )
+    p.add_argument(
         "-metricsPort", dest="metrics_port", type=int, default=0,
         help="prometheus /metrics port (0 = auto-assign)",
     )
@@ -79,9 +85,15 @@ def build_filer_server(args):
         store = SqliteStore(args.db_path or ":memory:")
     else:
         store = MemoryStore()
+    notifier = None
+    if getattr(args, "notify_spool", ""):
+        from ..replication.notification import FileQueueNotifier
+
+        notifier = FileQueueNotifier(args.notify_spool)
     return FilerServer(
         masters=[m.strip() for m in args.masters.split(",") if m.strip()],
         store=store,
+        notifier=notifier,
         ip=args.ip,
         port=args.port,
         grpc_port=args.grpc_port,

@@ -1,0 +1,127 @@
+"""`filer.replicate` — apply a notification queue to another filer
+(reference: weed/command/filer_replication.go — listens on filer
+notifications (kafka/SQS/...) and replays each change, fetching updated
+content, into a replication sink).
+
+Here the queue is the spool file a filer writes with `-notifySpool`
+(replication/notification.FileQueueNotifier — the zero-egress stand-in
+for the broker backends); progress persists next to the spool so
+restarts resume."""
+from __future__ import annotations
+
+import os
+
+NAME = "filer.replicate"
+HELP = "replicate a filer's notification-queue changes to another filer"
+
+
+def add_args(p) -> None:
+    p.add_argument(
+        "-spool", required=True,
+        help="notification spool file (source filer's -notifySpool)",
+    )
+    p.add_argument(
+        "-sourceFiler", dest="source_filer", required=True,
+        help="source filer host:port[.grpc] (chunk content is fetched here)",
+    )
+    p.add_argument(
+        "-targetFiler", dest="target_filer", required=True,
+        help="target filer host:port[.grpc]",
+    )
+    p.add_argument("-sourcePath", dest="source_path", default="/")
+    p.add_argument("-targetPath", dest="target_path", default="/")
+    p.add_argument(
+        "-follow", action="store_true",
+        help="keep polling the spool for new events instead of exiting "
+        "when caught up",
+    )
+
+
+async def run(args) -> None:
+    import asyncio
+
+    from ..pb import filer_pb2, server_address
+    from ..replication.sink import FilerSink
+    from ..replication.source import FilerSource
+
+    progress_path = args.spool + ".replicate_offset"
+    offset = 0
+    if os.path.exists(progress_path):
+        with open(progress_path) as f:
+            offset = int(f.read().strip() or 0)
+
+    source = FilerSource(server_address.grpc_address(args.source_filer))
+    sink = FilerSink(
+        server_address.grpc_address(args.target_filer),
+        fetch_chunk=source.fetch_chunk,
+        source_path=args.source_path,
+        target_path=args.target_path,
+    )
+    import aiohttp
+    import grpc
+
+    from ..replication.notification import FileQueueNotifier
+
+    RETRYABLE = (grpc.StatusCode.UNAVAILABLE, grpc.StatusCode.DEADLINE_EXCEEDED)
+
+    def is_transient(e: Exception) -> bool:
+        """Transport-level failures must be RETRIED (the event is not at
+        fault); only poison events (e.g. a chunk GC'd by a later delete in
+        the same queue) may be skipped with the offset advanced."""
+        if isinstance(e, grpc.aio.AioRpcError):
+            return e.code() in RETRYABLE
+        return isinstance(e, (aiohttp.ClientConnectionError, ConnectionError))
+
+    def commit_offset(value: int) -> None:
+        tmp = progress_path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(value))
+        os.replace(tmp, progress_path)  # atomic: no half-written offsets
+
+    applied = skipped = 0
+    try:
+        while True:
+            progressed = False
+            if os.path.exists(args.spool):
+                if offset > os.path.getsize(args.spool):
+                    print("spool rotated/replaced: restarting from 0")
+                    offset = 0
+                stalled = False
+                committed = offset
+                for offset, key, note in FileQueueNotifier.read_from(
+                    args.spool, offset
+                ):
+                    d, _, _name = key.rpartition("/")
+                    ev = filer_pb2.SubscribeMetadataResponse(
+                        directory=d or "/", event_notification=note
+                    )
+                    try:
+                        await sink.apply(ev)
+                        applied += 1
+                    except Exception as e:  # noqa: BLE001
+                        if is_transient(e):
+                            # rewind to the last committed boundary so the
+                            # failed event is retried, not skipped
+                            print(f"transient failure at {key}: {e}")
+                            offset = committed
+                            stalled = True
+                            break
+                        print(f"skip poison event {key}: {e}")
+                        skipped += 1
+                    progressed = True
+                    commit_offset(offset)
+                    committed = offset
+                if stalled and not args.follow:
+                    raise SystemExit(
+                        "target/source unreachable; offset preserved — rerun"
+                    )
+            if not args.follow:
+                break
+            if not progressed:
+                await asyncio.sleep(1.0)
+        print(
+            f"replicated {applied} events to {args.target_filer}"
+            + (f", {skipped} skipped" if skipped else "")
+        )
+    finally:
+        await source.close()

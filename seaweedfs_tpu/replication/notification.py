@@ -67,16 +67,37 @@ class FileQueueNotifier(Notifier):
         self._fh.close()
 
     @staticmethod
-    def read_all(path: str) -> list[tuple[str, filer_pb2.EventNotification]]:
-        out = []
+    def read_from(path: str, offset: int = 0):
+        """Yield (next_offset, key, EventNotification) records starting at
+        a byte offset; stops cleanly at a torn tail (a concurrent writer's
+        half-flushed record) so pollers can resume from the SAME offset.
+        The single reader of the wire format — filer.replicate and
+        read_all both ride it."""
         with open(path, "rb") as f:
+            f.seek(offset)
             while True:
                 hdr = f.read(2)
                 if len(hdr) < 2:
-                    break
+                    return
                 (kn,) = struct.unpack("<H", hdr)
-                key = f.read(kn).decode()
-                (bn,) = struct.unpack("<I", f.read(4))
-                ev = filer_pb2.EventNotification.FromString(f.read(bn))
-                out.append((key, ev))
-        return out
+                key = f.read(kn)
+                ln = f.read(4)
+                if len(key) < kn or len(ln) < 4:
+                    return
+                (bn,) = struct.unpack("<I", ln)
+                blob = f.read(bn)
+                if len(blob) < bn:
+                    return
+                offset = f.tell()
+                yield (
+                    offset,
+                    key.decode(),
+                    filer_pb2.EventNotification.FromString(blob),
+                )
+
+    @staticmethod
+    def read_all(path: str) -> list[tuple[str, filer_pb2.EventNotification]]:
+        return [
+            (key, ev)
+            for _, key, ev in FileQueueNotifier.read_from(path)
+        ]

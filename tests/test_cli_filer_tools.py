@@ -202,3 +202,80 @@ def test_master_follower_lookup(tmp_path):
             await cluster.stop()
 
     asyncio.run(go())
+
+
+def test_filer_replicate_from_spool(tmp_path):
+    """filer -notifySpool writes the queue; filer.replicate drains it
+    into a second filer (the reference's filer.replicate pipeline with
+    the spool queue standing in for kafka)."""
+
+    async def go():
+        from seaweedfs_tpu.replication.notification import FileQueueNotifier
+
+        spool = str(tmp_path / "events.spool")
+        src_cluster = LocalCluster(
+            base_dir=str(tmp_path / "src"), n_volume_servers=1,
+            pulse_seconds=1, with_filer=True,
+            filer_kwargs=dict(notifier=FileQueueNotifier(spool)),
+        )
+        dst_cluster = LocalCluster(
+            base_dir=str(tmp_path / "dst"), n_volume_servers=1,
+            pulse_seconds=1, with_filer=True,
+        )
+        await src_cluster.start()
+        await dst_cluster.start()
+        try:
+            data = os.urandom(64 * 1024)
+            async with aiohttp.ClientSession() as s:
+                async with s.put(
+                    f"http://{src_cluster.filer.url}/r/doc.bin", data=data
+                ) as r:
+                    assert r.status in (200, 201)
+                async with s.put(
+                    f"http://{src_cluster.filer.url}/r/gone.bin", data=b"x"
+                ) as r:
+                    assert r.status in (200, 201)
+                async with s.delete(
+                    f"http://{src_cluster.filer.url}/r/gone.bin"
+                ) as r:
+                    assert r.status < 400
+
+            await run_cmd(
+                "filer.replicate",
+                [
+                    "-spool", spool,
+                    "-sourceFiler",
+                    f"{src_cluster.filer.url}.{src_cluster.filer.grpc_port}",
+                    "-targetFiler",
+                    f"{dst_cluster.filer.url}.{dst_cluster.filer.grpc_port}",
+                ],
+            )
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"http://{dst_cluster.filer.url}/r/doc.bin"
+                ) as r:
+                    assert r.status == 200
+                    assert await r.read() == data
+                async with s.get(
+                    f"http://{dst_cluster.filer.url}/r/gone.bin"
+                ) as r:
+                    assert r.status == 404
+
+            # resume: nothing new -> no duplicate application, offset holds
+            await run_cmd(
+                "filer.replicate",
+                [
+                    "-spool", spool,
+                    "-sourceFiler",
+                    f"{src_cluster.filer.url}.{src_cluster.filer.grpc_port}",
+                    "-targetFiler",
+                    f"{dst_cluster.filer.url}.{dst_cluster.filer.grpc_port}",
+                ],
+            )
+            with open(spool + ".replicate_offset") as f:
+                assert int(f.read()) == os.path.getsize(spool)
+        finally:
+            await src_cluster.stop()
+            await dst_cluster.stop()
+
+    asyncio.run(go())
