@@ -22,6 +22,8 @@ from . import (
     filer_copy,
     filer_meta_backup,
     filer_meta_tail,
+    filer_remote_gateway,
+    filer_remote_sync,
     filer_replicate,
     filer_sync,
     fix,
@@ -46,7 +48,7 @@ COMMANDS = {
     for m in (
         master, master_follower, volume, filer, filer_sync, filer_copy,
         filer_cat, filer_backup, filer_meta_backup, filer_meta_tail,
-        filer_replicate,
+        filer_replicate, filer_remote_sync, filer_remote_gateway,
         s3, iam, webdav, mount, mq_broker,
         server, shell, fix, fsck, compact, export, backup, upload, download,
         benchmark, scaffold, autocomplete, version,

@@ -1,0 +1,28 @@
+"""`filer.remote.gateway` — write-back sync of /buckets to a remote
+store (reference: weed/command/filer_remote_gateway.go — the bucket-level
+variant of filer.remote.sync: S3 buckets created/written locally appear
+on the remote under their bucket-name prefixes)."""
+from __future__ import annotations
+
+from . import filer_remote_sync as _sync
+
+NAME = "filer.remote.gateway"
+HELP = "write back /buckets changes to a remote store"
+
+
+def add_args(p) -> None:
+    p.add_argument("-filer", required=True, help="filer host:port[.grpc]")
+    p.add_argument(
+        "-remote", required=True,
+        help="type.id[/prefix] remote to mirror buckets into",
+    )
+    p.add_argument(
+        "-dir", dest="mount_dir", default="/buckets",
+        help="bucket root to watch",
+    )
+    p.add_argument("-timeAgo", default="0s")
+    p.add_argument("-timeoutSec", type=float, default=0)
+
+
+async def run(args) -> None:
+    await _sync.run(args)

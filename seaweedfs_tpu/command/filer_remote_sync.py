@@ -1,0 +1,189 @@
+"""`filer.remote.sync` — push local changes under a remote mount back to
+the remote store (reference: weed/command/filer_remote_sync.go — follows
+the filer metadata stream and uploads/deletes on the remote so the mount
+is write-back, not read-only).
+
+`filer.remote.gateway` (filer_remote_gateway.go) is the /buckets variant
+of the same loop, with the remote given explicitly."""
+from __future__ import annotations
+
+import os
+
+NAME = "filer.remote.sync"
+HELP = "continuously write back local changes under a remote mount"
+
+SYNC_SIGNATURE = 0x52535953  # "RSYS": loop guard for our own updates
+
+
+def add_args(p) -> None:
+    p.add_argument(
+        "-filer", required=True, help="filer host:port[.grpc]"
+    )
+    p.add_argument(
+        "-dir", dest="mount_dir", required=True,
+        help="mounted directory to watch (shell: remote.mount -dir)",
+    )
+    p.add_argument(
+        "-remote", default="",
+        help="override type.id/prefix (default: the mount's recorded mapping)",
+    )
+    p.add_argument(
+        "-timeAgo", default="0s",
+        help="also replay changes newer than this before following",
+    )
+    p.add_argument(
+        "-timeoutSec", type=float, default=0,
+        help="stop after this many seconds (0 = follow forever)",
+    )
+
+
+async def _resolve_remote(stub, mount_dir: str, override: str):
+    """-> (storage, prefix) from the override or the mount's KV record,
+    loading the backend's remote.conf registration either way."""
+    import json
+
+    from ..pb import filer_pb2
+    from ..storage import backend as backend_mod
+
+    remote = override
+    if not remote:
+        kv = await stub.KvGet(
+            filer_pb2.KvGetRequest(key=f"remote.mount{mount_dir}".encode())
+        )
+        remote = bytes(kv.value).decode()
+        if not remote:
+            raise SystemExit(f"{mount_dir} is not a remote mount")
+    name = remote.partition("/")[0]
+    conf = await stub.KvGet(
+        filer_pb2.KvGetRequest(key=f"remote.conf/{name}".encode())
+    )
+    if conf.value:
+        backend_mod.configure(json.loads(bytes(conf.value)))
+    from ..shell.command_remote import _backend  # one remote-locator grammar
+
+    return _backend(remote)
+
+
+async def run(args) -> None:
+    import asyncio
+    import tempfile
+    import time
+    import urllib.parse
+
+    import aiohttp
+
+    from ..pb import Stub, channel, filer_pb2, server_address
+    from ..shell.command_volume import parse_duration
+
+    mount_dir = args.mount_dir.rstrip("/")
+    stub = Stub(
+        channel(server_address.grpc_address(args.filer)),
+        filer_pb2,
+        "SeaweedFiler",
+    )
+    storage, prefix = await _resolve_remote(stub, mount_dir, args.remote)
+    norm = prefix.strip("/")
+    filer_http = server_address.http_address(args.filer)
+    since_ns = time.time_ns() - int(parse_duration(args.timeAgo) * 1e9)
+
+    def key_of(path: str) -> str:
+        rel = path[len(mount_dir):].strip("/")
+        return f"{norm}/{rel}".strip("/") if norm else rel
+
+    async def upload_path(session, path: str, entry) -> None:
+        # remote stubs (mount artifacts: marker, no local data) are the
+        # REMOTE's state reflected locally — nothing to push back
+        if entry.extended.get("remote.key") and not (
+            entry.chunks or entry.content
+        ):
+            return
+        async with session.get(
+            f"http://{filer_http}{urllib.parse.quote(path)}"
+        ) as r:
+            if r.status >= 300:
+                print(f"skip {path}: HTTP {r.status}")
+                return
+            with tempfile.NamedTemporaryFile() as tmp:
+                async for piece in r.content.iter_chunked(1 << 20):
+                    tmp.write(piece)
+                tmp.flush()
+                key = key_of(path)
+                await asyncio.to_thread(storage.upload, tmp.name, key)
+        # stamp the CURRENT entry (re-fetched) so reads stream through and
+        # re-syncs know the remote is current; writing the stale event
+        # snapshot back would revert a concurrent v2 write AND make the
+        # server GC v2's chunks.  If the entry changed since the event,
+        # skip — the newer event will sync and stamp it.
+        d, _, n = path.rpartition("/")
+        try:
+            cur = await stub.LookupDirectoryEntry(
+                filer_pb2.LookupDirectoryEntryRequest(
+                    directory=d or "/", name=n
+                )
+            )
+        except Exception:  # noqa: BLE001 — deleted meanwhile
+            return
+        cur_entry = cur.entry
+        same = [c.file_id for c in cur_entry.chunks] == [
+            c.file_id for c in entry.chunks
+        ] and bytes(cur_entry.content) == bytes(entry.content)
+        if not same:
+            print(f"~ {path} changed during upload; deferring to next event")
+            return
+        cur_entry.extended["remote.backend"] = storage.name.encode()
+        cur_entry.extended["remote.key"] = key_of(path).encode()
+        await stub.UpdateEntry(
+            filer_pb2.UpdateEntryRequest(
+                directory=d or "/", entry=cur_entry,
+                signatures=[SYNC_SIGNATURE],
+            )
+        )
+        print(f"+ {path} -> {key_of(path)}")
+
+    async def follow():
+        async with aiohttp.ClientSession() as session:
+            async for ev in stub.SubscribeMetadata(
+                filer_pb2.SubscribeMetadataRequest(
+                    client_name="filer.remote.sync",
+                    path_prefix=mount_dir,
+                    since_ns=since_ns,
+                    signature=SYNC_SIGNATURE,
+                )
+            ):
+                note = ev.event_notification
+                has_old = note.HasField("old_entry")
+                has_new = note.HasField("new_entry")
+                if has_old and (not has_new or note.new_parent_path):
+                    old_path = (
+                        f"{ev.directory.rstrip('/')}/{note.old_entry.name}"
+                    )
+                    # subscription prefix matching is loose (parents and
+                    # /wbX siblings arrive too) — hard boundary here, or
+                    # key_of() mangles foreign paths into REAL remote keys
+                    if not old_path.startswith(mount_dir + "/"):
+                        continue
+                    if not note.old_entry.is_directory:
+                        try:
+                            await asyncio.to_thread(
+                                storage.delete_key, key_of(old_path)
+                            )
+                            print(f"- {old_path}")
+                        except Exception as e:  # noqa: BLE001
+                            print(f"delete {old_path}: {e}")
+                if has_new and not note.new_entry.is_directory:
+                    new_dir = note.new_parent_path or ev.directory
+                    path = f"{new_dir.rstrip('/')}/{note.new_entry.name}"
+                    if not path.startswith(mount_dir + "/"):
+                        continue  # outside the mount (or renamed out)
+                    try:
+                        await upload_path(session, path, note.new_entry)
+                    except Exception as e:  # noqa: BLE001
+                        print(f"upload {path}: {e}")
+
+    if args.timeoutSec > 0:
+        try:
+            await asyncio.wait_for(follow(), args.timeoutSec)
+        except asyncio.TimeoutError:
+            pass
+    else:
+        await follow()

@@ -169,7 +169,12 @@ class Filer:
         if len(self._dir_cache) > 10240:
             self._dir_cache.clear()
 
-    async def update_entry(self, old_entry: Entry | None, entry: Entry) -> None:
+    async def update_entry(
+        self,
+        old_entry: Entry | None,
+        entry: Entry,
+        signatures: list[int] | None = None,
+    ) -> None:
         if old_entry is not None:
             if old_entry.is_directory and not entry.is_directory:
                 raise FilerError(f"existing {entry.full_path} is a directory")
@@ -177,7 +182,9 @@ class Filer:
                 raise FilerError(f"existing {entry.full_path} is a file")
         self.store.update_entry(entry)
         self._hl_on_write(entry, new_name=False)
-        await self.meta_log.append(entry.directory, old_entry, entry)
+        await self.meta_log.append(
+            entry.directory, old_entry, entry, signatures=signatures or []
+        )
 
     async def append_chunks(self, full_path: str, chunks: list) -> Entry:
         """AppendToEntry: add chunks at the current end of the file."""

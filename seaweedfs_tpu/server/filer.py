@@ -830,7 +830,9 @@ class FilerServer:
             old = self.filer.find_entry(entry.full_path)
         except NotFoundError:
             pass
-        await self.filer.update_entry(old, entry)
+        await self.filer.update_entry(
+            old, entry, signatures=list(request.signatures)
+        )
         if old is not None:
             if old.hard_link_id and old.hard_link_id != entry.hard_link_id:
                 self.filer._release_hard_link(old)  # name left the group

@@ -279,3 +279,75 @@ def test_filer_replicate_from_spool(tmp_path):
             await dst_cluster.stop()
 
     asyncio.run(go())
+
+
+def test_filer_remote_sync_writeback(tmp_path):
+    """Local writes under a remote mount are pushed back to the backend,
+    deletes propagate, and the syncer's own entry updates don't loop."""
+
+    async def go():
+        import io
+
+        from seaweedfs_tpu.shell import CommandEnv, run_command
+
+        backing = tmp_path / "store"
+        backing.mkdir()
+        (backing / "seed.txt").write_bytes(b"from-remote")
+        cluster = await make(tmp_path / "cluster")
+        try:
+            env = CommandEnv(
+                [cluster.master.advertise_url], out=io.StringIO()
+            )
+            await env.acquire_lock()
+            await run_command(
+                env, f"remote.configure -name local.ws -dir {backing}"
+            )
+            await run_command(env, "remote.mount -dir /wb -remote local.ws")
+
+            syncer = asyncio.create_task(
+                run_cmd(
+                    "filer.remote.sync",
+                    [
+                        "-filer",
+                        f"{cluster.filer.url}.{cluster.filer.grpc_port}",
+                        "-dir", "/wb", "-timeoutSec", "25",
+                    ],
+                )
+            )
+            await asyncio.sleep(0.5)  # let the subscription attach
+            async with aiohttp.ClientSession() as s:
+                async with s.put(
+                    f"http://{cluster.filer.url}/wb/new.txt",
+                    data=b"written-locally",
+                ) as r:
+                    assert r.status in (200, 201)
+                async with s.put(
+                    f"http://{cluster.filer.url}/wb/sub/deep.txt",
+                    data=b"deep",
+                ) as r:
+                    assert r.status in (200, 201)
+            for _ in range(40):
+                if (backing / "new.txt").exists() and (
+                    backing / "sub" / "deep.txt"
+                ).exists():
+                    break
+                await asyncio.sleep(0.25)
+            assert (backing / "new.txt").read_bytes() == b"written-locally"
+            assert (backing / "sub" / "deep.txt").read_bytes() == b"deep"
+
+            async with aiohttp.ClientSession() as s:
+                await s.delete(f"http://{cluster.filer.url}/wb/new.txt")
+            for _ in range(40):
+                if not (backing / "new.txt").exists():
+                    break
+                await asyncio.sleep(0.25)
+            assert not (backing / "new.txt").exists()
+            syncer.cancel()  # -timeoutSec is only the safety bound
+            try:
+                await syncer
+            except asyncio.CancelledError:
+                pass
+        finally:
+            await cluster.stop()
+
+    asyncio.run(go())
