@@ -37,6 +37,12 @@ def add_args(p) -> None:
         help="erasure-coding kernel backend (auto = pallas on TPU)",
     )
     p.add_argument(
+        "-ec.deviceCacheMB", dest="ec_device_cache_mb", type=int, default=0,
+        help="pin mounted EC shards in device HBM up to this budget so "
+        "degraded reads/rebuilds reconstruct without per-call H2D "
+        "(0 = disabled)",
+    )
+    p.add_argument(
         "-readMode", dest="read_mode", default="proxy",
         choices=["local", "proxy", "redirect"],
     )
@@ -95,6 +101,7 @@ async def run(args) -> None:
         client_max_size_mb=args.client_max_size_mb,
         concurrent_upload_limit_mb=args.concurrent_upload_limit_mb,
         concurrent_download_limit_mb=args.concurrent_download_limit_mb,
+        ec_device_cache_mb=args.ec_device_cache_mb,
     )
     await vs.start()
     await asyncio.Event().wait()

@@ -1,0 +1,331 @@
+"""Device-resident EC shard cache + batched degraded-read reconstruction.
+
+Round-2 measurement showed why a naive device degraded read loses: every
+per-needle reconstruct shipped 10x the payload (the survivor intervals)
+host->device before the kernel could run, so the call was transfer-bound
+(3965 ms p99 vs 0.75 ms for the C++ CPU kernel on this rig's tunneled
+device).  The fix is to keep hot shards *resident in HBM*: then a degraded
+read sends only (offset, row) scalars up and the reconstructed interval
+bytes down, and any number of concurrent needle reconstructions batch into
+ONE device call that gathers survivor slices from the resident buffers.
+
+This is the TPU answer to the reference's per-needle goroutine fan-in
+(/root/reference/weed/storage/store_ec.go:339-393): instead of fetching
+interval bytes from >=10 peers per needle, the rebuilder/reader node pins
+the survivor shards once (mount time or first read) and serves every
+degraded needle from device memory.
+
+Shapes and compile hygiene:
+  * shard buffers are padded to SHARD_QUANTUM so volumes of similar size
+    share jit caches, plus MAX_TILE slack so slices never clamp;
+  * request sizes quantize to SIZE_BUCKETS, request counts to
+    COUNT_BUCKETS, offsets align down to LANE (128) with the residual
+    sliced off on host — a handful of compiles total, warmable up front.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import gf256, rs_tpu
+
+DATA_SHARDS = 10
+TOTAL_SHARDS = 14
+
+LANE = 128  # TPU lane tile: device slices start lane-aligned
+SIZE_BUCKETS = (2048, 8192, 32768, 131072, 524288, 2 * 1024 * 1024)
+COUNT_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+MAX_TILE = SIZE_BUCKETS[-1]
+# split oversized intervals into chunks that fit the largest bucket even
+# after the <=LANE-1 alignment residual
+CHUNK = MAX_TILE - LANE
+SHARD_QUANTUM = 64 * 1024 * 1024
+
+
+class CacheMiss(LookupError):
+    """Not enough resident shards to serve the request."""
+
+
+def _bucket(values: tuple[int, ...], need: int) -> int:
+    for v in values:
+        if need <= v:
+            return v
+    raise ValueError(f"{need} exceeds largest bucket {values[-1]}")
+
+
+class DeviceShardCache:
+    """LRU cache of EC shard bytes pinned in device memory.
+
+    Keyed by (vid, shard_id).  `budget_bytes` bounds device-padded bytes;
+    inserting past the budget evicts least-recently-used shards (whole
+    shards — a partially resident volume simply fails over to the host
+    path via CacheMiss).
+    """
+
+    def __init__(
+        self,
+        budget_bytes: int = 8 << 30,
+        shard_quantum: int = SHARD_QUANTUM,
+    ):
+        self.budget = budget_bytes
+        self.quantum = shard_quantum
+        self._lock = threading.Lock()
+        self._arrays: OrderedDict[tuple[int, int], object] = OrderedDict()
+        self._true_sizes: dict[tuple[int, int], int] = {}
+        self.bytes_used = 0
+
+    def _padded_len(self, n: int) -> int:
+        need = n + MAX_TILE
+        return -(-need // self.quantum) * self.quantum
+
+    def put(self, vid: int, shard_id: int, data) -> None:
+        host = np.frombuffer(bytes(data), dtype=np.uint8) if isinstance(
+            data, (bytes, bytearray, memoryview)
+        ) else np.asarray(data, dtype=np.uint8)
+        padded = np.zeros(self._padded_len(host.size), dtype=np.uint8)
+        padded[: host.size] = host
+        arr = jax.device_put(padded)
+        key = (vid, shard_id)
+        with self._lock:
+            if key in self._arrays:
+                self.bytes_used -= self._arrays.pop(key).size
+            while self._arrays and self.bytes_used + padded.size > self.budget:
+                old_key, old = self._arrays.popitem(last=False)
+                self._true_sizes.pop(old_key, None)
+                self.bytes_used -= old.size
+            self._arrays[key] = arr
+            self._true_sizes[key] = host.size
+            self.bytes_used += padded.size
+
+    def get(self, vid: int, shard_id: int):
+        with self._lock:
+            key = (vid, shard_id)
+            arr = self._arrays.get(key)
+            if arr is not None:
+                self._arrays.move_to_end(key)
+            return arr
+
+    def shard_size(self, vid: int, shard_id: int) -> int | None:
+        return self._true_sizes.get((vid, shard_id))
+
+    def shard_ids(self, vid: int) -> list[int]:
+        with self._lock:
+            return sorted(s for (v, s) in self._arrays if v == vid)
+
+    def evict(self, vid: int, shard_id: int | None = None) -> None:
+        with self._lock:
+            keys = [
+                k
+                for k in self._arrays
+                if k[0] == vid and (shard_id is None or k[1] == shard_id)
+            ]
+            for k in keys:
+                self.bytes_used -= self._arrays.pop(k).size
+                self._true_sizes.pop(k, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._arrays.clear()
+            self._true_sizes.clear()
+            self.bytes_used = 0
+
+
+@functools.lru_cache(maxsize=64)
+def _prepared_matrix(matrix_bytes: bytes, m: int, k: int):
+    return rs_tpu.prepare_matrix(
+        np.frombuffer(matrix_bytes, dtype=np.uint8).reshape(m, k)
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("tile", "fetch", "kernel", "interpret", "k_true"),
+)
+def _gather_reconstruct(
+    a_bm,
+    survivors,
+    offsets,
+    row_idx,
+    deltas,
+    *,
+    tile,
+    fetch,
+    kernel,
+    interpret,
+    k_true,
+):
+    """survivors: tuple of [L] u8 resident shards in matrix column order;
+    offsets [N] int32 lane-aligned; row_idx [N] int32 selects each
+    request's wanted matrix row; deltas [N] the sub-lane alignment
+    residual.  -> [N, fetch] u8.
+
+    `tile` is the compute width (size bucket); `fetch` <= tile is the D2H
+    width (power-of-two cover of the largest actual request): the result
+    is delta-shifted and narrowed ON DEVICE so the transfer back — the
+    scarce resource on a tunneled device — carries only useful bytes."""
+    cols = [
+        jax.vmap(
+            lambda off, arr=arr: jax.lax.dynamic_slice(arr, (off,), (tile,))
+        )(offsets)
+        for arr in survivors
+    ]  # k x [N, tile]
+    x = jnp.stack(cols, axis=0)  # [k, N, tile]
+    k, n, _ = x.shape
+    out = rs_tpu.apply_matrix_device(
+        a_bm,
+        x.reshape(k, n * tile),
+        kernel=kernel,
+        interpret=interpret,
+        k_true=k_true,
+    )  # [m_pad, n*tile]
+    out3 = out.reshape(out.shape[0], n, tile).transpose(1, 0, 2)
+    sel = jnp.take_along_axis(out3, row_idx[:, None, None], axis=1)[:, 0, :]
+    if fetch < tile:
+        sel = jax.vmap(
+            lambda row, d: jax.lax.dynamic_slice(row, (d,), (fetch,))
+        )(sel, deltas)
+    return sel
+
+
+def _plan(requests: list[tuple[int, int, int]]):
+    """Split/align requests into device sub-requests.
+
+    Each request (wanted_shard, offset, size) becomes >=1 sub-requests
+    (req_index, aligned_off, delta, take, bucket) with delta+take <= bucket.
+    """
+    subs = []
+    for idx, (_, off, size) in enumerate(requests):
+        pos = off
+        remaining = size
+        while remaining > 0:
+            take = min(remaining, CHUNK)
+            aligned = pos - (pos % LANE)
+            delta = pos - aligned
+            subs.append(
+                (idx, aligned, delta, take, _bucket(SIZE_BUCKETS, delta + take))
+            )
+            pos += take
+            remaining -= take
+    return subs
+
+
+def reconstruct_intervals(
+    cache: DeviceShardCache,
+    vid: int,
+    requests: list[tuple[int, int, int]],
+    kernel: str | None = None,
+    interpret: bool | None = None,
+    data_shards: int = DATA_SHARDS,
+    total_shards: int = TOTAL_SHARDS,
+) -> list[bytes]:
+    """Reconstruct interval bytes for a batch of degraded reads in as few
+    device calls as possible (one per size bucket actually present).
+
+    requests: [(wanted_shard_id, shard_offset, size)].  All gather inputs
+    are resident shards; per-call H2D is just the offset/row vectors and
+    D2H is exactly the reconstructed bytes.  Raises CacheMiss when fewer
+    than `data_shards` non-wanted shards of `vid` are resident.
+    """
+    if not requests:
+        return []
+    if kernel is None:
+        kernel = "pallas" if rs_tpu.on_tpu() else "xla"
+    if interpret is None:
+        interpret = not rs_tpu.on_tpu()
+
+    wanted = sorted({r[0] for r in requests})
+    resident = cache.shard_ids(vid)
+    present = [s for s in resident if s not in wanted]
+    if len(present) < data_shards:
+        raise CacheMiss(
+            f"vid {vid}: {len(present)} resident survivors, need {data_shards}"
+        )
+    rmat, use = gf256.reconstruction_matrix(
+        data_shards, total_shards, present, wanted
+    )
+    a_bm = _prepared_matrix(rmat.tobytes(), *rmat.shape)
+    survivors = tuple(cache.get(vid, s) for s in use)
+    if any(s is None for s in survivors):  # evicted between listing and get
+        raise CacheMiss(f"vid {vid}: survivor shard evicted mid-request")
+    row_of = {sid: i for i, sid in enumerate(wanted)}
+
+    subs = _plan(requests)
+    sub_out: list[bytes | None] = [None] * len(subs)
+    for bucket in SIZE_BUCKETS:
+        group = [(i, s) for i, s in enumerate(subs) if s[4] == bucket]
+        if not group:
+            continue
+        n_bucket = _bucket(COUNT_BUCKETS, min(len(group), COUNT_BUCKETS[-1]))
+        for start in range(0, len(group), n_bucket):
+            part = group[start : start + n_bucket]
+            pad = n_bucket - len(part)
+            offsets = jnp.asarray(
+                np.array([s[1] for _, s in part] + [0] * pad, dtype=np.int32)
+            )
+            rows = jnp.asarray(
+                np.array(
+                    [row_of[requests[s[0]][0]] for _, s in part] + [0] * pad,
+                    dtype=np.int32,
+                )
+            )
+            deltas = jnp.asarray(
+                np.array([s[2] for _, s in part] + [0] * pad, dtype=np.int32)
+            )
+            # D2H width: power-of-two cover of the largest actual request
+            # in this call, never wider than the compute tile
+            max_take = max(s[3] for _, s in part)
+            fetch = min(bucket, 1 << (max_take - 1).bit_length())
+            out = np.asarray(
+                _gather_reconstruct(
+                    a_bm,
+                    survivors,
+                    offsets,
+                    rows,
+                    deltas,
+                    tile=bucket,
+                    fetch=fetch,
+                    kernel=kernel,
+                    interpret=interpret,
+                    k_true=len(use),
+                )
+            )
+            for j, (sub_idx, (_, _, delta, take, _)) in enumerate(part):
+                lo = 0 if fetch < bucket else delta
+                sub_out[sub_idx] = out[j, lo : lo + take].tobytes()
+    outputs: list[list[bytes]] = [[] for _ in requests]
+    for (idx, *_), piece in zip(subs, sub_out):
+        outputs[idx].append(piece)  # subs are in offset order per request
+    return [b"".join(parts) for parts in outputs]
+
+
+def warm(
+    cache: DeviceShardCache,
+    vid: int,
+    sizes: tuple[int, ...] = (4096, 65536, 1 << 20),
+    counts: tuple[int, ...] = (1, 64),
+    total_shards: int = TOTAL_SHARDS,
+    **kw,
+) -> None:
+    """Pre-compile the bucket combinations a serving path will hit, so the
+    first real degraded read doesn't pay a 20-40s TPU compile.  The wanted
+    shard is a NON-resident one when any exists (the realistic degraded
+    case), so a volume with exactly DATA_SHARDS survivors still warms."""
+    resident = cache.shard_ids(vid)
+    non_resident = [s for s in range(total_shards) if s not in resident]
+    if non_resident:
+        missing = non_resident[0]
+        if len(resident) < DATA_SHARDS:
+            return
+    else:
+        missing = resident[-1]
+        if len(resident) - 1 < DATA_SHARDS:
+            return
+    for size in sizes:
+        for count in counts:
+            reqs = [(missing, 0, size)] * count
+            reconstruct_intervals(cache, vid, reqs, **kw)

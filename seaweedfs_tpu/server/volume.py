@@ -138,11 +138,17 @@ class VolumeServer:
         concurrent_upload_limit_mb: int = 0,  # 0 = unlimited
         concurrent_download_limit_mb: int = 0,
         disk_types: list[str] | None = None,  # per-directory (ref -disk flag)
+        ec_device_cache_mb: int = 0,  # >0: pin mounted EC shards in HBM
     ):
         if tier_backends:
             from ..storage import backend as backend_mod
 
             backend_mod.configure(tier_backends)
+        device_cache = None
+        if ec_device_cache_mb > 0:
+            from ..ops.rs_resident import DeviceShardCache
+
+            device_cache = DeviceShardCache(budget_bytes=ec_device_cache_mb << 20)
         if isinstance(max_volume_counts, int):
             max_volume_counts = [max_volume_counts] * len(directories)
         if disk_types is None:
@@ -169,6 +175,7 @@ class VolumeServer:
             port=port,
             public_url=public_url,
             ec_backend=ec_backend,
+            ec_device_cache=device_cache,
         )
         self.masters = masters
         self.ip = ip

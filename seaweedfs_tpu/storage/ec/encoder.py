@@ -14,6 +14,7 @@ here can be mounted by a Go volume server and vice versa.
 from __future__ import annotations
 
 import os
+import time
 from collections import deque
 from typing import Iterator
 
@@ -118,9 +119,18 @@ def write_ec_files(
     stride: int = DEFAULT_STRIDE,
     large_block: int = LARGE_BLOCK_SIZE,
     small_block: int = SMALL_BLOCK_SIZE,
+    fsync: bool = False,
+    stats: dict | None = None,
 ) -> int:
     """Generate <base>.ec00 .. <base>.ec13 from <base>.dat; returns bytes
-    encoded.  Equivalent of WriteEcFiles (ec_encoder.go:57)."""
+    encoded.  Equivalent of WriteEcFiles (ec_encoder.go:57).
+
+    `fsync=True` makes the shard files durable before returning (the
+    benchmark's honest-throughput mode).  `stats`, when passed, is filled
+    with the pipeline's wall-clock decomposition — read_s (host pread +
+    stripe staging), submit_s (kernel dispatch), wait_s (blocking on
+    device results), write_s (shard file writes), wall_s, batches — the
+    numbers behind any staging-overlap claim."""
     dat_path = base_name + ".dat"
     dat_size = os.path.getsize(dat_path)
     codec = _Codec(rs.RSCodec().matrix[DATA_SHARDS:], backend)
@@ -140,14 +150,22 @@ def write_ec_files(
 
     outputs = [open(base_name + to_ext(i), "wb") for i in range(TOTAL_SHARDS)]
     inflight: deque[tuple[np.ndarray, object]] = deque()
+    t = {"read_s": 0.0, "submit_s": 0.0, "wait_s": 0.0, "write_s": 0.0,
+         "batches": 0}
+    clock = time.perf_counter
+    t_start = clock()
 
     def drain_one():
         data, handle = inflight.popleft()
+        t0 = clock()
         parity = codec.resolve(handle)
+        t1 = clock()
         for i in range(DATA_SHARDS):
             outputs[i].write(data[i].tobytes())
         for i in range(codec.rows):
             outputs[DATA_SHARDS + i].write(parity[i].tobytes())
+        t["wait_s"] += t1 - t0
+        t["write_s"] += clock() - t1
 
     try:
         with open(dat_path, "rb") as f:
@@ -156,15 +174,27 @@ def write_ec_files(
                 if block_size % step:
                     step = block_size  # keep batches aligned to the block
                 for off in range(0, block_size, step):
+                    t0 = clock()
                     data = _read_stripe(f, dat_size, row_start, block_size, off, step)
+                    t1 = clock()
                     inflight.append((data, codec.submit(data)))
+                    t["read_s"] += t1 - t0
+                    t["submit_s"] += clock() - t1
+                    t["batches"] += 1
                     if len(inflight) >= _PIPELINE_DEPTH:
                         drain_one()
         while inflight:
             drain_one()
+        if fsync:
+            for o in outputs:
+                o.flush()
+                os.fsync(o.fileno())
     finally:
         for o in outputs:
             o.close()
+    if stats is not None:
+        t["wall_s"] = clock() - t_start
+        stats.update(t)
     return dat_size
 
 

@@ -152,6 +152,10 @@ class EcVolume:
         # (store_ec.go:238-279)
         self.shard_locations: dict[int, list[str]] = {}
         self.shard_locations_refresh = 0.0
+        # optional HBM shard cache (ops/rs_resident.py): when set and >=10
+        # survivors of this volume are resident, degraded reads reconstruct
+        # on-device without per-call H2D of survivor bytes
+        self.device_cache = None
 
     # -- shard management ----------------------------------------------------
 
@@ -164,7 +168,27 @@ class EcVolume:
         return True
 
     def delete_shard(self, shard_id: int) -> EcVolumeShard | None:
+        if self.device_cache is not None:
+            self.device_cache.evict(self.id, shard_id)
         return self.shards.pop(shard_id, None)
+
+    def load_shards_to_device(self, cache=None) -> int:
+        """Pin every locally mounted shard of this volume into the device
+        cache (the resident-serving setup: done at mount time or on first
+        degraded read, so reconstruction gathers from HBM instead of
+        re-shipping survivor bytes per call).  Returns shards pinned."""
+        if cache is not None:
+            self.device_cache = cache
+        if self.device_cache is None:
+            raise ValueError("no device cache configured")
+        n = 0
+        for sid, shard in self.shards.items():
+            if self.device_cache.get(self.id, sid) is None:
+                self.device_cache.put(
+                    self.id, sid, np.fromfile(shard.path, dtype=np.uint8)
+                )
+                n += 1
+        return n
 
     def shard_bits(self) -> ShardBits:
         b = ShardBits(0)
@@ -247,7 +271,18 @@ class EcVolume:
         """Degraded read: gather this interval from >=k other shards and
         recompute the missing rows (recoverOneRemoteEcShardInterval
         store_ec.go:339-393) — a single batched multiply on the selected
-        backend rather than a goroutine fan-in."""
+        backend rather than a goroutine fan-in.  When the survivors are
+        pinned in HBM (device_cache), the gather happens on-device and the
+        only per-call transfer is the reconstructed bytes themselves."""
+        if self.device_cache is not None:
+            from ...ops import rs_resident
+
+            try:
+                return rs_resident.reconstruct_intervals(
+                    self.device_cache, self.id, [(missing_shard, off, size)]
+                )[0]
+            except rs_resident.CacheMiss:
+                pass
         got: dict[int, np.ndarray] = {}
         for sid in range(TOTAL_SHARDS):
             if sid == missing_shard:
@@ -281,6 +316,84 @@ class EcVolume:
         return b"".join(
             self.read_interval(iv, remote_read, backend) for iv in intervals
         )
+
+    def read_needles_batch(
+        self,
+        needle_ids: list[int],
+        remote_read: RemoteReadFn | None = None,
+        backend: str = "cpu",
+    ) -> list[Needle | Exception]:
+        """Serve a burst of needle reads with all degraded-read
+        reconstructions coalesced into (at most one-per-size-bucket)
+        resident device calls — the batched counterpart of the reference's
+        per-needle goroutine fan-in (store_ec.go:339-393).  Intervals whose
+        shard is locally mounted are pread as usual; missing-shard
+        intervals are reconstructed together.  Falls back to the per-call
+        host path when no device cache is set or it lacks survivors.
+
+        Returns one entry per requested id, in order; a failed needle
+        (deleted, not found, corrupt) yields its exception in that slot
+        rather than aborting the rest of the burst."""
+        plans: list[tuple[int, list] | Exception] = []
+        requests: list[tuple[int, int, int]] = []
+        for nid in needle_ids:
+            try:
+                _, _, intervals = self.locate_needle(nid)
+            except (NeedleNotFound, OSError) as e:
+                plans.append(e)
+                continue
+            parts: list = []
+            for iv in intervals:
+                sid, off = iv.to_shard_and_offset()
+                shard = self.shards.get(sid)
+                if shard is not None:
+                    parts.append(("local", sid, off, iv.size))
+                else:
+                    parts.append(("recon", len(requests)))
+                    requests.append((sid, off, iv.size))
+            plans.append((nid, parts))
+
+        recon: list[bytes] | None = None
+        if requests and self.device_cache is not None:
+            from ...ops import rs_resident
+
+            try:
+                recon = rs_resident.reconstruct_intervals(
+                    self.device_cache, self.id, requests
+                )
+            except rs_resident.CacheMiss:
+                recon = None
+
+        results: list[Needle | Exception] = []
+        for plan in plans:
+            if isinstance(plan, Exception):
+                results.append(plan)
+                continue
+            nid, parts = plan
+            try:
+                raw = bytearray()
+                for p in parts:
+                    if p[0] == "local":
+                        _, sid, off, size = p
+                        raw += self.shards[sid].read_at(off, size)
+                    else:
+                        i = p[1]
+                        if recon is not None:
+                            raw += recon[i]
+                        else:
+                            sid, off, size = requests[i]
+                            raw += self._read_shard_interval(
+                                sid, off, size, remote_read, backend
+                            )
+                n = Needle.from_bytes(bytes(raw), self.version)
+                if n.id != nid:
+                    raise NeedleNotFound(
+                        f"ec batch read got needle {n.id:x}, expected {nid:x}"
+                    )
+                results.append(n)
+            except Exception as e:  # isolate per-needle failures
+                results.append(e)
+        return results
 
     def read_needle(
         self,
@@ -330,6 +443,8 @@ class EcVolume:
 
     def destroy(self) -> None:
         """Remove sidecars + local shards (ec_volume.go Destroy)."""
+        if self.device_cache is not None:
+            self.device_cache.evict(self.id)
         self.close()
         for p in [self.ecx_path, self.ecj_path, self.base_name + ".vif"]:
             if os.path.exists(p):

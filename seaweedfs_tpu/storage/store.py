@@ -13,6 +13,7 @@ layer calls it via ``asyncio.to_thread``.
 from __future__ import annotations
 
 import glob
+import logging
 import os
 import queue
 import threading
@@ -87,12 +88,14 @@ class Store:
         port: int = 8080,
         public_url: str = "",
         ec_backend: str = "auto",
+        ec_device_cache=None,  # ops.rs_resident.DeviceShardCache | None
     ):
         self.locations = locations
         self.ip = ip
         self.port = port
         self.public_url = public_url or f"{ip}:{port}"
         self.ec_backend = ec_backend
+        self.ec_device_cache = ec_device_cache
         self.volume_size_limit = 30 * 1024 * 1024 * 1024  # set by master pulse
         self._lock = threading.RLock()
         # delta queues drained by the heartbeat loop (store.go:66-70)
@@ -102,6 +105,10 @@ class Store:
         self.deleted_ec_shards: queue.SimpleQueue[EcShardMessage] = queue.SimpleQueue()
         for loc in self.locations:
             loc.load_existing_volumes()
+        if self.ec_device_cache is not None:
+            for loc in self.locations:
+                for ev in loc.ec_volumes.values():
+                    self._pin_ec_shards_async(ev)
 
     # -- lookup --------------------------------------------------------------
 
@@ -434,6 +441,31 @@ class Store:
             for sid in shard_ids:
                 ev.add_shard(sid)
             self.new_ec_shards.put(self._ec_message(ev))
+        if self.ec_device_cache is not None:
+            self._pin_ec_shards_async(ev)
+
+    def _pin_ec_shards_async(self, ev: EcVolume) -> None:
+        """Pin a volume's local shards in HBM + pre-compile the reconstruct
+        buckets, off the caller's thread: shard upload rides a slow tunnel
+        on this rig and jit warm-up is 20-40s, so neither may block the
+        store lock, the mount RPC, or server startup.  Until the thread
+        finishes, degraded reads fall back to the host path (CacheMiss)."""
+        cache = self.ec_device_cache
+
+        def pin():
+            try:
+                ev.load_shards_to_device(cache)
+                from ..ops import rs_resident
+
+                rs_resident.warm(cache, ev.id)
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "ec device-cache pinning failed for volume %d", ev.id
+                )
+
+        threading.Thread(
+            target=pin, name=f"ec-pin-{ev.id}", daemon=True
+        ).start()
 
     def _location_with_ec_files(self, vid: int, collection: str) -> DiskLocation | None:
         for loc in self.locations:
