@@ -1,21 +1,28 @@
-"""`filer.backup` — mirror filer DATA to a local directory
-(reference: weed/command/filer_backup.go, which streams metadata events
-into a local-disk sink).  First run replays the subtree from the filer;
-the metadata subscription then applies live creates/updates/deletes.
-Progress (the last applied event timestamp) persists in the target dir,
-so a restart resumes from where it stopped instead of re-copying."""
+"""`filer.backup` — mirror filer DATA to a local directory or an
+object-store backend (reference: weed/command/filer_backup.go, which
+streams metadata events into local-disk/S3/GCS/... sinks).  First run
+replays the subtree from the filer; the metadata subscription then
+applies live creates/updates/deletes.  Progress (the last applied event
+timestamp) persists in the target (dir or store), so a restart resumes
+from where it stopped instead of re-copying."""
 from __future__ import annotations
 
 import os
 
 NAME = "filer.backup"
-HELP = "continuously mirror a filer path to a local directory"
+HELP = "continuously mirror a filer path to a local dir or object store"
 
 
 def add_args(p) -> None:
     p.add_argument("-filer", required=True, help="filer host:port")
     p.add_argument("-path", default="/", help="filer subtree to mirror")
-    p.add_argument("-dir", dest="target", required=True, help="local target dir")
+    p.add_argument("-dir", dest="target", default="", help="local target dir")
+    p.add_argument(
+        "-remote", default="",
+        help="object-store target instead of a local dir: "
+        "<type.id>[/keyPrefix] from master.toml [storage.backend] "
+        "(s3.x backs up into a bucket, the reference's S3 sink)",
+    )
     p.add_argument(
         "-oneTime", action="store_true",
         help="stop after the initial replay instead of tailing forever",
@@ -25,9 +32,104 @@ def add_args(p) -> None:
 PROGRESS_FILE = ".filer_backup_progress"
 
 
-def _local_path(target: str, root: str, full: str) -> str:
-    rel = full[len(root):].strip("/")
-    return os.path.join(target, rel) if rel else target
+def _rel(root: str, full: str) -> str:
+    return full[len(root):].strip("/")
+
+
+class _LocalTarget:
+    """Filesystem sink (the original filer.backup behavior)."""
+
+    def __init__(self, target: str):
+        self.target = target
+        os.makedirs(target, exist_ok=True)
+        self._progress = os.path.join(target, PROGRESS_FILE)
+
+    def _path(self, rel: str) -> str:
+        return os.path.join(self.target, rel) if rel else self.target
+
+    def read_progress(self) -> int:
+        if os.path.exists(self._progress):
+            with open(self._progress) as f:
+                return int(f.read().strip() or 0)
+        return 0
+
+    async def write_progress(self, ts_ns: int) -> None:
+        with open(self._progress, "w") as f:
+            f.write(str(ts_ns))
+
+    async def mkdir(self, rel: str) -> None:
+        os.makedirs(self._path(rel), exist_ok=True)
+
+    async def store_file(self, rel: str, tmp_path: str) -> None:
+        p = self._path(rel)
+        os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
+        os.replace(tmp_path, p)
+
+    async def delete(self, rel: str) -> None:
+        import shutil
+
+        p = self._path(rel)
+        if os.path.isdir(p):
+            shutil.rmtree(p, ignore_errors=True)
+        elif os.path.exists(p):
+            os.remove(p)
+
+    def describe(self) -> str:
+        return self.target
+
+
+class _RemoteTarget:
+    """Object-store sink over a storage backend (s3/local) — the
+    reference's S3 backup sink role, minus the SDK."""
+
+    def __init__(self, remote: str):
+        from ..storage import backend as backend_mod
+
+        self.storage, self.prefix = backend_mod.backend_from_spec(remote)
+
+    def _key(self, rel: str) -> str:
+        return f"{self.prefix}/{rel}" if self.prefix else rel
+
+    def read_progress(self) -> int:
+        try:
+            return int(self.storage.get_bytes(self._key(PROGRESS_FILE)) or 0)
+        except (FileNotFoundError, ValueError):
+            return 0
+
+    async def write_progress(self, ts_ns: int) -> None:
+        import asyncio
+
+        await asyncio.to_thread(
+            self.storage.put_bytes, self._key(PROGRESS_FILE),
+            str(ts_ns).encode(),
+        )
+
+    async def mkdir(self, rel: str) -> None:
+        pass  # object stores have no directories
+
+    async def store_file(self, rel: str, tmp_path: str) -> None:
+        import asyncio
+
+        try:
+            # upload() streams from the file (multipart for big objects)
+            await asyncio.to_thread(
+                self.storage.upload, tmp_path, self._key(rel)
+            )
+        finally:
+            if os.path.exists(tmp_path):
+                os.remove(tmp_path)
+
+    async def delete(self, rel: str) -> None:
+        import asyncio
+
+        key = self._key(rel)
+        keys = await asyncio.to_thread(self.storage.list_keys, key)
+        for k, _ in keys or [(key, 0)]:
+            if k == key or k.startswith(key + "/"):
+                await asyncio.to_thread(self.storage.delete_key, k)
+
+    def describe(self) -> str:
+        return self.storage.name + (f"/{self.prefix}" if self.prefix else "")
 
 
 async def run(args) -> None:
@@ -37,14 +139,16 @@ async def run(args) -> None:
 
     from ..pb import Stub, channel, filer_pb2, server_address
 
+    if bool(args.target) == bool(args.remote):
+        raise SystemExit("exactly one of -dir / -remote required")
+    import asyncio
+
+    target = _RemoteTarget(args.remote) if args.remote else _LocalTarget(args.target)
+
     root = "/" + args.path.strip("/") if args.path != "/" else "/"
     filer_http = server_address.http_address(args.filer)
-    os.makedirs(args.target, exist_ok=True)
-    progress_path = os.path.join(args.target, PROGRESS_FILE)
-    since_ns = 0
-    if os.path.exists(progress_path):
-        with open(progress_path) as f:
-            since_ns = int(f.read().strip() or 0)
+    # progress read may be a network call (s3): off-loop
+    since_ns = await asyncio.to_thread(target.read_progress)
 
     stub = Stub(
         channel(server_address.grpc_address(args.filer)),
@@ -54,19 +158,32 @@ async def run(args) -> None:
 
     async with aiohttp.ClientSession() as session:
 
-        async def fetch(full_path: str, local: str) -> None:
+        async def backup_file(full: str) -> bool:
+            """Stream the file to a local temp, then hand it to the target
+            (local: rename into place; remote: streamed/multipart upload)
+            — never the whole file in memory."""
+            import tempfile
             import urllib.parse
 
-            os.makedirs(os.path.dirname(local) or ".", exist_ok=True)
-            async with session.get(
-                f"http://{filer_http}{urllib.parse.quote(full_path)}"
-            ) as r:
-                if r.status >= 300:
-                    print(f"skip {full_path}: HTTP {r.status}")
-                    return
-                with open(local, "wb") as f:
-                    async for chunk in r.content.iter_chunked(1 << 20):
-                        f.write(chunk)
+            fd, tmp = tempfile.mkstemp(prefix=".filer_backup_")
+            try:
+                async with session.get(
+                    f"http://{filer_http}{urllib.parse.quote(full)}"
+                ) as r:
+                    if r.status >= 300:
+                        print(f"skip {full}: HTTP {r.status}")
+                        os.close(fd)
+                        os.remove(tmp)
+                        return False
+                    with os.fdopen(fd, "wb") as f:
+                        async for chunk in r.content.iter_chunked(1 << 20):
+                            f.write(chunk)
+                await target.store_file(_rel(root, full), tmp)
+                return True
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.remove(tmp)
+                raise
 
         async def replay(directory: str) -> int:
             from ..filer.client import list_all_entries
@@ -74,12 +191,10 @@ async def run(args) -> None:
             n = 0
             for e in await list_all_entries(stub, directory):
                 full = f"{directory.rstrip('/')}/{e.name}"
-                local = _local_path(args.target, root, full)
                 if e.is_directory:
-                    os.makedirs(local, exist_ok=True)
+                    await target.mkdir(_rel(root, full))
                     n += await replay(full)
-                else:
-                    await fetch(full, local)
+                elif await backup_file(full):
                     n += 1
             return n
 
@@ -87,9 +202,8 @@ async def run(args) -> None:
             start_ns = time.time_ns()
             n = await replay(root)
             since_ns = start_ns
-            with open(progress_path, "w") as f:
-                f.write(str(since_ns))
-            print(f"initial replay: {n} files into {args.target}")
+            await target.write_progress(since_ns)
+            print(f"initial replay: {n} files into {target.describe()}")
         if args.oneTime:
             return
 
@@ -107,22 +221,14 @@ async def run(args) -> None:
                 not note.HasField("new_entry") or note.new_parent_path
             ):
                 old_full = f"{directory.rstrip('/')}/{note.old_entry.name}"
-                local = _local_path(args.target, root, old_full)
-                if os.path.isdir(local):
-                    import shutil
-
-                    shutil.rmtree(local, ignore_errors=True)
-                elif os.path.exists(local):
-                    os.remove(local)
+                await target.delete(_rel(root, old_full))
                 print(f"- {old_full}")
             if note.HasField("new_entry"):
                 new_dir = note.new_parent_path or directory
                 full = f"{new_dir.rstrip('/')}/{note.new_entry.name}"
-                local = _local_path(args.target, root, full)
                 if note.new_entry.is_directory:
-                    os.makedirs(local, exist_ok=True)
+                    await target.mkdir(_rel(root, full))
                 else:
-                    await fetch(full, local)
+                    await backup_file(full)
                 print(f"+ {full}")
-            with open(progress_path, "w") as f:
-                f.write(str(ev.ts_ns))
+            await target.write_progress(ev.ts_ns)

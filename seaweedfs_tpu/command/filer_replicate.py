@@ -25,8 +25,14 @@ def add_args(p) -> None:
         help="source filer host:port[.grpc] (chunk content is fetched here)",
     )
     p.add_argument(
-        "-targetFiler", dest="target_filer", required=True,
+        "-targetFiler", dest="target_filer", default="",
         help="target filer host:port[.grpc]",
+    )
+    p.add_argument(
+        "-targetRemote", dest="target_remote", default="",
+        help="object-store sink instead of a filer: <type.id>[/keyPrefix] "
+        "from the [storage.backend] config (s3.x replicates into a "
+        "bucket, the reference's s3sink)",
     )
     p.add_argument("-sourcePath", dest="source_path", default="/")
     p.add_argument("-targetPath", dest="target_path", default="/")
@@ -50,13 +56,28 @@ async def run(args) -> None:
         with open(progress_path) as f:
             offset = int(f.read().strip() or 0)
 
+    if bool(args.target_filer) == bool(args.target_remote):
+        raise SystemExit("exactly one of -targetFiler / -targetRemote required")
+
     source = FilerSource(server_address.grpc_address(args.source_filer))
-    sink = FilerSink(
-        server_address.grpc_address(args.target_filer),
-        fetch_chunk=source.fetch_chunk,
-        source_path=args.source_path,
-        target_path=args.target_path,
-    )
+    if args.target_remote:
+        from ..replication.sink import ObjectStoreSink
+        from ..storage import backend as backend_mod
+
+        storage, key_prefix = backend_mod.backend_from_spec(args.target_remote)
+        sink = ObjectStoreSink(
+            storage,
+            fetch_chunk=source.fetch_chunk,
+            source_path=args.source_path,
+            key_prefix=key_prefix,
+        )
+    else:
+        sink = FilerSink(
+            server_address.grpc_address(args.target_filer),
+            fetch_chunk=source.fetch_chunk,
+            source_path=args.source_path,
+            target_path=args.target_path,
+        )
     import aiohttp
     import grpc
 

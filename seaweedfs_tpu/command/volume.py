@@ -92,11 +92,16 @@ async def run(args) -> None:
         ec_backend=args.ec_backend,
         read_mode=args.read_mode,
         jwt_signing_key=config_util.jwt_signing_key(),
-        tier_backends=(
-            {"local.default": {"type": "local", "dir": args.tier_dir}}
-            if args.tier_dir
-            else None
-        ),
+        tier_backends={
+            # master.toml [storage.backend.*] + the -tier.dir shorthand
+            **config_util.storage_backends(),
+            **(
+                {"local.default": {"type": "local", "dir": args.tier_dir}}
+                if args.tier_dir
+                else {}
+            ),
+        }
+        or None,
         index_kind=args.index_kind,
         client_max_size_mb=args.client_max_size_mb,
         concurrent_upload_limit_mb=args.concurrent_upload_limit_mb,

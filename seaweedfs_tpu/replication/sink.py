@@ -1,10 +1,15 @@
-"""Filer sink: apply one filer's metadata events to another filer,
-re-homing chunk data into the target cluster.
+"""Replication sinks: apply one filer's metadata events to a target.
 
-Reference: weed/replication/sink/filersink/filer_sink.go
-(CreateEntry/UpdateEntry/DeleteEntry + replicateChunks which fetches
-from the source and re-uploads via the target's AssignVolume), driven by
-weed/replication/replicator.go event dispatch.
+Two targets, same ``apply(ev)`` surface (the reference's
+weed/replication/sink/ ReplicationSink interface):
+
+* FilerSink — another filer cluster, re-homing chunk data via the
+  target's AssignVolume (reference sink/filersink/filer_sink.go, driven
+  by weed/replication/replicator.go event dispatch).
+* ObjectStoreSink — a storage backend from storage/backend.py, writing
+  whole objects (reference sink/s3sink/s3_sink.go and sink/localsink;
+  with an "s3"-type backend this IS the S3 replication sink, e2e-testable
+  against the in-repo gateway).
 """
 from __future__ import annotations
 
@@ -17,6 +22,79 @@ from ..pb import Stub, filer_pb2
 from ..pb.rpc import channel
 
 log = logging.getLogger("replication.sink")
+
+
+class ObjectStoreSink:
+    """Mirror filer DATA into an object-store backend (s3/local).
+
+    Event mapping (s3_sink.go CreateEntry/DeleteEntry): a file create or
+    update fetches every chunk from the source and PUTs one object at the
+    path-derived key; deletes remove the key; directories are skipped (no
+    object-store counterpart); renames are delete+create.
+    """
+
+    def __init__(
+        self,
+        storage,  # storage/backend.py BackendStorage
+        fetch_chunk,  # async (file_id) -> bytes, from the source cluster
+        source_path: str = "/",
+        key_prefix: str = "",
+    ):
+        self.storage = storage
+        self.fetch_chunk = fetch_chunk
+        self.source_path = source_path.rstrip("/")
+        self.key_prefix = key_prefix.strip("/")
+
+    def _key(self, directory: str, name: str) -> str | None:
+        full = f"{directory.rstrip('/')}/{name}"
+        if self.source_path and not (
+            full == self.source_path or full.startswith(self.source_path + "/")
+        ):
+            return None
+        rel = full[len(self.source_path):].strip("/")
+        if not rel:
+            return None
+        return f"{self.key_prefix}/{rel}" if self.key_prefix else rel
+
+    async def apply(self, ev) -> None:
+        import asyncio
+
+        n = ev.event_notification
+        has_old = n.HasField("old_entry")
+        has_new = n.HasField("new_entry")
+        if has_old:
+            old_key = self._key(ev.directory, n.old_entry.name)
+            moved = has_new and n.new_parent_path and (
+                n.new_parent_path != ev.directory
+                or n.old_entry.name != n.new_entry.name
+            )
+            if old_key and (not has_new or moved):
+                if n.old_entry.is_directory:
+                    # directory delete/rename: sweep the whole prefix
+                    # (s3_sink.go deleteDirectory semantics)
+                    def sweep(prefix=old_key):
+                        for k, _ in self.storage.list_keys(prefix):
+                            if k == prefix or k.startswith(prefix + "/"):
+                                self.storage.delete_key(k)
+
+                    await asyncio.to_thread(sweep)
+                else:
+                    await asyncio.to_thread(self.storage.delete_key, old_key)
+        if has_new and not n.new_entry.is_directory:
+            directory = n.new_parent_path or ev.directory
+            key = self._key(directory, n.new_entry.name)
+            if key is None:
+                return
+            content = bytearray(n.new_entry.content)
+            for c in sorted(n.new_entry.chunks, key=lambda c: c.offset):
+                blob = await self.fetch_chunk(c.file_id)
+                end = c.offset + len(blob)
+                if len(content) < end:
+                    content.extend(b"\x00" * (end - len(content)))
+                content[c.offset : end] = blob
+            await asyncio.to_thread(
+                self.storage.put_bytes, key, bytes(content)
+            )
 
 
 class FilerSink:

@@ -116,6 +116,65 @@ class _ByteLease:
             lim._cond.notify_all()
 
 
+class EcReadBatcher:
+    """Natural batching of EC needle reads.
+
+    Requests that arrive while a batch is being served queue up and are
+    coalesced into the next batch, so a burst of concurrent degraded
+    reads becomes one device-resident reconstruct call per size bucket
+    (Store.read_ec_needles_batch -> EcVolume.read_needles_batch) instead
+    of one per needle — the asyncio counterpart of the reference's
+    per-needle goroutine fan-in (store_ec.go:339-393).  No timers: a lone
+    request is served immediately, so idle latency is unchanged."""
+
+    def __init__(self, store, remote_reader_factory):
+        self.store = store
+        self._remote_reader = remote_reader_factory
+        self._pending: list[tuple[int, int, int | None, asyncio.Future]] = []
+        self._draining = False
+
+    async def read(self, vid: int, nid: int, cookie: int | None):
+        fut = asyncio.get_running_loop().create_future()
+        self._pending.append((vid, nid, cookie, fut))
+        if not self._draining:
+            self._draining = True
+            asyncio.ensure_future(self._drain())
+        result = await fut
+        if isinstance(result, Exception):
+            raise result
+        return result
+
+    async def _drain(self) -> None:
+        try:
+            while self._pending:
+                batch, self._pending = self._pending, []
+                by_vid: dict[int, list] = {}
+                for vid, nid, cookie, fut in batch:
+                    by_vid.setdefault(vid, []).append((nid, cookie, fut))
+                for vid, items in by_vid.items():
+                    try:
+                        results = await asyncio.to_thread(
+                            self.store.read_ec_needles_batch,
+                            vid,
+                            [(nid, cookie) for nid, cookie, _ in items],
+                            self._remote_reader(vid),
+                        )
+                    except Exception as e:  # volume-level failure
+                        results = [e] * len(items)
+                    for (_, _, fut), r in zip(items, results):
+                        if fut.done():
+                            continue
+                        if isinstance(r, Exception):
+                            fut.set_exception(r)
+                        else:
+                            fut.set_result(r)
+        finally:
+            self._draining = False
+            if self._pending:  # raced with an enqueue after the loop check
+                self._draining = True
+                asyncio.ensure_future(self._drain())
+
+
 class VolumeServer:
     def __init__(
         self,
@@ -192,6 +251,7 @@ class VolumeServer:
         self.download_limiter = ByteLimiter(concurrent_download_limit_mb << 20)
         self._pending_compacts: dict[int, tuple[str, str, int, str | None]] = {}
         self._ec_locations: dict[int, tuple[float, dict[int, list[str]]]] = {}
+        self._ec_batcher = EcReadBatcher(self.store, self._remote_shard_reader)
         self._grpc_server: grpc.aio.Server | None = None
         self._http_runner: web.AppRunner | None = None
         self._tasks: list[asyncio.Task] = []
@@ -468,10 +528,9 @@ class VolumeServer:
                         self.store.read_needle, vid, nid, cookie
                     )
                 else:
-                    n = await asyncio.to_thread(
-                        self.store.read_ec_needle, vid, nid, cookie,
-                        self._remote_shard_reader(vid),
-                    )
+                    # coalesced: concurrent EC reads batch into one
+                    # device-resident reconstruct call
+                    n = await self._ec_batcher.read(vid, nid, cookie)
             except (NotFoundError, KeyError):
                 raise web.HTTPNotFound()
             except CookieMismatch:

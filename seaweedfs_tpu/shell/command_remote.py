@@ -10,6 +10,7 @@ remote clients.
 """
 from __future__ import annotations
 
+import asyncio
 import time
 
 from ..pb import filer_pb2
@@ -17,26 +18,54 @@ from ..storage import backend as backend_mod
 from .commands import command, parse_flags
 
 
+async def _list_remote_off_loop(storage, prefix: str) -> list:
+    """Backend listings may be network calls (s3): never on the loop."""
+    return await asyncio.to_thread(lambda: list(_list_remote(storage, prefix)))
+
+
 @command("remote.configure")
 async def cmd_remote_configure(env, args):
-    """-name <type.id> -dir <path> : register a storage backend for
-    remote mounts.  The config persists in the filer KV (the reference
-    stores remote.conf in filer_etc) so the FILER process can lazy-load
-    it for read-through — shells and filers are separate processes."""
+    """-name <type.id> [-dir <path>] [-endpoint host:port -bucket b
+    -accessKey k -secretKey s -region r -prefix p -createBucket] :
+    register a storage backend for remote mounts — "local" (directory) or
+    "s3" (any S3 endpoint, incl. this repo's own gateway).  The config
+    persists in the filer KV (the reference stores remote.conf in
+    filer_etc) so the FILER process can lazy-load it for read-through —
+    shells and filers are separate processes."""
+    import asyncio
     import json
 
     flags = parse_flags(args)
     name = flags.get("name", "local.default")
-    cfg = {name: {"type": "local", "dir": flags["dir"]}}
-    backend_mod.configure(cfg)
+    btype = name.partition(".")[0]
+    if btype == "s3":
+        section = {
+            "type": "s3",
+            "endpoint": flags["endpoint"],
+            "bucket": flags["bucket"],
+            "access_key": flags.get("accessKey", ""),
+            "secret_key": flags.get("secretKey", ""),
+            "region": flags.get("region", "us-east-1"),
+            "prefix": flags.get("prefix", ""),
+        }
+        # bucket creation happens HERE, once; the persisted config must
+        # not re-create on every lazy load in the filer
+        cfg = {name: {**section, "create_bucket": "createBucket" in flags}}
+        target = f"{flags['endpoint']}/{flags['bucket']}"
+    else:
+        section = {"type": "local", "dir": flags["dir"]}
+        cfg = {name: section}
+        target = flags["dir"]
+    # backend construction may do network IO (S3 bucket create): off-loop
+    await asyncio.to_thread(backend_mod.configure, cfg)
     filer = await env.find_filer()
     await env.filer_stub(filer).KvPut(
         filer_pb2.KvPutRequest(
             key=f"remote.conf/{name}".encode(),
-            value=json.dumps(cfg).encode(),
+            value=json.dumps({name: section}).encode(),
         )
     )
-    env.write(f"configured backend {name} -> {flags['dir']}")
+    env.write(f"configured backend {name} -> {target}")
 
 
 def _backend(remote: str):
@@ -91,7 +120,7 @@ async def cmd_remote_mount(env, args):
     stub = env.filer_stub(filer)
     await _ensure_dir(stub, mount_dir)
     n = 0
-    for rel, key, size in _list_remote(storage, prefix):
+    for rel, key, size in await _list_remote_off_loop(storage, prefix):
         d = mount_dir
         if "/" in rel:
             sub, _, name = rel.rpartition("/")
@@ -160,7 +189,7 @@ async def cmd_remote_cache(env, args):
                 continue  # already cached (small files inline as content)
             storage, _ = _backend(e.extended["remote.backend"].decode())
             key = e.extended["remote.key"].decode()
-            total = storage.size(key)
+            total = await asyncio.to_thread(storage.size, key)
 
             async def pieces(storage=storage, key=key, total=total):
                 import asyncio as _a
@@ -266,7 +295,7 @@ async def cmd_remote_meta_sync(env, args):
         raise ValueError(f"{mount_dir} is not a remote mount")
     storage, prefix = _backend(remote)
     remote_keys: dict[str, tuple[str, int]] = {}
-    for rel, key, size in _list_remote(storage, prefix):
+    for rel, key, size in await _list_remote_off_loop(storage, prefix):
         remote_keys[rel] = (key, size)
     local: dict[str, tuple[str, object]] = {}
     async for directory, e in _walk_remote_entries(env, stub, mount_dir):
@@ -345,8 +374,11 @@ async def cmd_remote_mount_buckets(env, args):
     # buckets = first path component UNDER the remote's prefix, so a
     # prefixed -remote enumerates and mounts consistently
     buckets = sorted(
-        {rel.partition("/")[0] for rel, _, _ in _list_remote(storage, prefix)
-         if "/" in rel}
+        {
+            rel.partition("/")[0]
+            for rel, _, _ in await _list_remote_off_loop(storage, prefix)
+            if "/" in rel
+        }
     )
     n = 0
     base = flags["remote"].rstrip("/")

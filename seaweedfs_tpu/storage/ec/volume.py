@@ -182,7 +182,8 @@ class EcVolume:
         if self.device_cache is None:
             raise ValueError("no device cache configured")
         n = 0
-        for sid, shard in self.shards.items():
+        # snapshot: mount RPCs may add shards while a pin thread iterates
+        for sid, shard in list(self.shards.items()):
             if self.device_cache.get(self.id, sid) is None:
                 self.device_cache.put(
                     self.id, sid, np.fromfile(shard.path, dtype=np.uint8)
@@ -411,7 +412,9 @@ class EcVolume:
                 f"ec read got needle {n.id:x}, expected {needle_id:x}"
             )
         if cookie is not None and n.cookie != cookie:
-            raise PermissionError(f"cookie mismatch for needle {needle_id:x}")
+            from ..volume import CookieMismatch
+
+            raise CookieMismatch(f"cookie mismatch for needle {needle_id:x}")
         return n
 
     # -- delete (ec_volume_delete.go) ----------------------------------------

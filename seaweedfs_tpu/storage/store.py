@@ -35,7 +35,7 @@ from .ec import (
 from .ec.volume import RemoteReadFn
 from .needle import Needle
 from .vacuum import vacuum as vacuum_volume
-from .volume import NotFoundError, Volume, VolumeInfo
+from .volume import CookieMismatch, NotFoundError, Volume, VolumeInfo
 
 
 @dataclass
@@ -538,6 +538,35 @@ class Store:
         return ev.read_needle(
             needle_id, cookie, remote_read, backend=self.ec_backend
         )
+
+    def read_ec_needles_batch(
+        self,
+        vid: int,
+        requests: list[tuple[int, int | None]],  # (needle_id, cookie)
+        remote_read: RemoteReadFn | None = None,
+    ) -> list[Needle | Exception]:
+        """Serve a burst of EC needle reads in one coalesced call: all
+        degraded-read reconstructions in the batch become (at most a few)
+        device-resident reconstruct calls instead of one per needle
+        (EcVolume.read_needles_batch).  One result slot per request; a
+        bad needle yields its exception without failing the rest."""
+        ev = self.find_ec_volume(vid)
+        if ev is None:
+            raise NotFoundError(f"ec volume {vid} not found")
+        results = ev.read_needles_batch(
+            [nid for nid, _ in requests], remote_read, backend=self.ec_backend
+        )
+        out: list[Needle | Exception] = []
+        for (nid, cookie), r in zip(requests, results):
+            if (
+                isinstance(r, Needle)
+                and cookie is not None
+                and r.cookie != cookie
+            ):
+                out.append(CookieMismatch(f"cookie mismatch for {nid:x}"))
+            else:
+                out.append(r)
+        return out
 
     def read_ec_shard_interval(self, vid: int, shard_id: int, offset: int, size: int) -> bytes:
         """Serve a raw shard range to a peer (VolumeEcShardRead

@@ -45,6 +45,22 @@ def get_path(cfg: dict, dotted: str, default=None):
     return node
 
 
+def storage_backends(dirs=SEARCH_DIRS) -> dict:
+    """[storage.backend.<type>.<id>] sections from master.toml, flattened
+    to the storage/backend.py configure() shape {"s3.default": {...}}
+    (reference backend.go LoadConfiguration reads the same master.toml
+    section)."""
+    section = get_path(load_config("master", dirs), "storage.backend", {}) or {}
+    out = {}
+    for btype, ids in section.items():
+        if not isinstance(ids, dict):
+            continue
+        for bid, conf in ids.items():
+            if isinstance(conf, dict):
+                out[f"{btype}.{bid}"] = {"type": btype, **conf}
+    return out
+
+
 def jwt_signing_key(dirs=SEARCH_DIRS) -> str:
     """The volume-write JWT signing key from security.toml
     (reference scaffold: [jwt.signing] key = ...)."""

@@ -192,6 +192,51 @@ class TestEcVolumeWiring:
             assert n.data == blobs[nid][1]
         ev.close()
 
+    def test_server_batcher_coalesces(self, tmp_path):
+        """EcReadBatcher: concurrent reads land in one
+        Store.read_ec_needles_batch call; failures stay per-needle."""
+        import asyncio
+
+        from seaweedfs_tpu.server.volume import EcReadBatcher
+
+        calls = []
+
+        class FakeStore:
+            def read_ec_needles_batch(self, vid, requests, remote_read=None):
+                calls.append(list(requests))
+                out = []
+                for nid, _cookie in requests:
+                    if nid == 99:
+                        out.append(KeyError("nope"))
+                    else:
+                        out.append(f"needle-{vid}-{nid}")
+                return out
+
+        async def go():
+            b = EcReadBatcher(FakeStore(), lambda vid: None)
+
+            async def slow_first():
+                return await b.read(1, 1, None)
+
+            # first read starts a drain; the rest arrive while it runs
+            # and must coalesce into ONE follow-up batch
+            results = await asyncio.gather(
+                b.read(1, 1, None),
+                b.read(1, 2, None),
+                b.read(1, 3, None),
+                b.read(1, 99, None),
+                return_exceptions=True,
+            )
+            assert results[0] == "needle-1-1"
+            assert results[1] == "needle-1-2"
+            assert results[2] == "needle-1-3"
+            assert isinstance(results[3], KeyError)
+            assert len(calls) <= 2  # 1 leading + 1 coalesced batch
+            total = sum(len(c) for c in calls)
+            assert total == 4
+
+        asyncio.run(go())
+
     def test_eviction_on_shard_delete(self, tmp_path):
         v, _ = make_volume(tmp_path, count=4)
         encode_volume(v)

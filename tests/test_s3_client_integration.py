@@ -1,0 +1,384 @@
+"""Generic S3-protocol client wired four ways, e2e against the in-repo
+S3 gateway: volume tier backend, remote-storage mount, replication sink,
+and filer.backup target.
+
+Reference counterparts: weed/storage/backend/s3_backend/s3_backend.go,
+weed/remote_storage/s3/s3_storage_client.go,
+weed/replication/sink/s3sink/s3_sink.go, and filer_backup.go's S3 sink —
+all AWS-SDK-based there; here they ride s3api/client.py (signed by the
+repo's own SigV4) so the whole protocol loop is testable with zero
+egress: cluster A speaks S3 to cluster B's gateway.
+"""
+import argparse
+import asyncio
+import io
+import os
+
+import aiohttp
+import pytest
+
+from seaweedfs_tpu.command import COMMANDS
+from seaweedfs_tpu.s3api import Identity, IdentityAccessManagement
+from seaweedfs_tpu.s3api.client import S3Client
+from seaweedfs_tpu.server.cluster import LocalCluster
+from seaweedfs_tpu.shell import CommandEnv, run_command
+from seaweedfs_tpu.storage import backend as backend_mod
+
+ACCESS, SECRET = "AKIDTIER", "tier-secret"
+
+
+def run_cmd(name, argv):
+    mod = COMMANDS[name]
+    p = argparse.ArgumentParser()
+    mod.add_args(p)
+    return mod.run(p.parse_args(argv))
+
+
+async def start_object_cluster(tmp_path, auth=True):
+    """Cluster B: the S3 endpoint everything else talks to."""
+    iam = None
+    if auth:
+        iam = IdentityAccessManagement(
+            [
+                Identity(
+                    name="tier",
+                    credentials=[(ACCESS, SECRET)],
+                    actions=["Admin"],
+                )
+            ]
+        )
+    cluster = LocalCluster(
+        base_dir=str(tmp_path / "objstore"),
+        n_volume_servers=1,
+        pulse_seconds=1,
+        with_s3=True,
+        s3_kwargs=dict(iam=iam) if iam else {},
+    )
+    await cluster.start()
+    return cluster
+
+
+def s3_section(cluster, bucket, prefix=""):
+    return {
+        "type": "s3",
+        "endpoint": cluster.s3.url,
+        "bucket": bucket,
+        "access_key": ACCESS,
+        "secret_key": SECRET,
+        "prefix": prefix,
+        "create_bucket": True,
+    }
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    backend_mod.clear_registry()
+    yield
+    backend_mod.clear_registry()
+
+
+def test_s3_client_roundtrip_and_multipart(tmp_path, monkeypatch):
+    """Raw client against the gateway: objects, ranges, listing
+    pagination, and the multipart path used for big tier uploads."""
+
+    async def go():
+        b = await start_object_cluster(tmp_path)
+        try:
+            client = S3Client(b.s3.url, ACCESS, SECRET)
+
+            def drive():
+                client.create_bucket("raw")
+                client.create_bucket("raw")  # idempotent
+                client.put_object("raw", "a/b.bin", b"hello world")
+                assert client.get_object("raw", "a/b.bin") == b"hello world"
+                assert client.get_object("raw", "a/b.bin", 6, 5) == b"world"
+                assert client.head_object("raw", "a/b.bin") == 11
+                with pytest.raises(FileNotFoundError):
+                    client.head_object("raw", "missing")
+                for i in range(7):
+                    client.put_object("raw", f"many/k{i}", bytes([i]))
+                keys = client.list_objects("raw", "many/", max_keys=3)
+                assert [k for k, _ in keys] == [f"many/k{i}" for i in range(7)]
+                # multipart: force the threshold down so a small file
+                # exercises initiate/part/complete
+                import seaweedfs_tpu.s3api.client as cmod
+
+                monkeypatch.setattr(cmod, "MULTIPART_THRESHOLD", 1 << 20)
+                monkeypatch.setattr(cmod, "PART_SIZE", 1 << 20)
+                big = os.urandom(3 * (1 << 20) + 12345)
+                src = tmp_path / "big.bin"
+                src.write_bytes(big)
+                assert client.put_object_from_file(
+                    "raw", "big.bin", str(src)
+                ) == len(big)
+                assert client.head_object("raw", "big.bin") == len(big)
+                assert client.get_object("raw", "big.bin", 2 << 20, 64) == big[
+                    2 << 20 : (2 << 20) + 64
+                ]
+                dst = str(tmp_path / "back.bin")
+                client.get_object_to_file("raw", "big.bin", dst)
+                with open(dst, "rb") as f:
+                    assert f.read() == big
+                client.delete_object("raw", "a/b.bin")
+                with pytest.raises(FileNotFoundError):
+                    client.head_object("raw", "a/b.bin")
+
+            await asyncio.to_thread(drive)
+        finally:
+            await b.stop()
+
+    asyncio.run(go())
+
+
+def test_tier_move_into_s3_gateway(tmp_path):
+    """Cluster A tier-moves a volume into a bucket served by cluster B's
+    S3 gateway and keeps serving reads from it (VERDICT round-2 'done'
+    condition for the tier wiring)."""
+
+    async def go():
+        b = await start_object_cluster(tmp_path)
+        a = LocalCluster(
+            base_dir=str(tmp_path / "a"),
+            n_volume_servers=1,
+            pulse_seconds=1,
+            volume_size_limit_mb=8,
+        )
+        await a.start()
+        try:
+            await asyncio.to_thread(
+                backend_mod.configure, {"s3.default": s3_section(b, "tier")}
+            )
+            from seaweedfs_tpu.operation import assign, upload_data
+
+            master = a.master.advertise_url
+            a0 = await assign(master)
+            vid = int(a0.fid.split(",")[0])
+            blobs = {}
+            for i in range(8):
+                ai = await assign(master)
+                if int(ai.fid.split(",")[0]) != vid:
+                    continue
+                data = os.urandom(4000 + i * 531)
+                await upload_data(f"http://{ai.url}/{ai.fid}", data)
+                blobs[ai.fid] = data
+            assert blobs
+
+            env = CommandEnv([master], out=io.StringIO())
+            await run_command(env, "lock")
+            await run_command(
+                env, f"volume.tier.upload -volumeId {vid} -dest s3.default"
+            )
+            assert "uploaded" in env.out.getvalue()
+
+            # the .dat now lives in the bucket...
+            client = S3Client(b.s3.url, ACCESS, SECRET)
+            keys = await asyncio.to_thread(client.list_objects, "tier")
+            assert any(k.endswith(f"{vid}.dat") for k, _ in keys)
+            # ...and reads still work, now through ranged S3 GETs
+            async with aiohttp.ClientSession() as s:
+                for fid, data in blobs.items():
+                    vs = a.volume_servers[0]
+                    async with s.get(f"http://{vs.url}/{fid}") as r:
+                        assert r.status == 200
+                        assert await r.read() == data
+
+            # and back down
+            await run_command(
+                env, f"volume.tier.download -volumeId {vid}"
+            )
+            v = a.volume_servers[0].store.find_volume(vid)
+            assert v is not None and not getattr(v, "remote_key", None)
+        finally:
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(go())
+
+
+def test_remote_mount_s3_bucket(tmp_path):
+    """remote.configure -name s3.x / remote.mount of a gateway bucket:
+    listing mirrors into the filer, reads stream through, remote.cache
+    materializes chunks."""
+
+    async def go():
+        b = await start_object_cluster(tmp_path)
+        a = LocalCluster(
+            base_dir=str(tmp_path / "a"),
+            n_volume_servers=1,
+            pulse_seconds=1,
+            with_filer=True,
+        )
+        await a.start()
+        try:
+            objects = {
+                "photos/x.jpg": os.urandom(50_000),
+                "photos/deep/y.bin": os.urandom(120_000),
+                "top.txt": b"hello via s3",
+            }
+            client = S3Client(b.s3.url, ACCESS, SECRET)
+
+            def seed():
+                client.create_bucket("shared")
+                for key, data in objects.items():
+                    client.put_object("shared", key, data)
+
+            await asyncio.to_thread(seed)
+
+            env = CommandEnv([a.master.advertise_url], out=io.StringIO())
+            await run_command(env, "lock")
+            deadline = asyncio.get_event_loop().time() + 10
+            while True:
+                try:
+                    await run_command(
+                        env,
+                        "remote.configure -name s3.ext "
+                        f"-endpoint {b.s3.url} -bucket shared "
+                        f"-accessKey {ACCESS} -secretKey {SECRET}",
+                    )
+                    break
+                except Exception:
+                    if asyncio.get_event_loop().time() > deadline:
+                        raise
+                    await asyncio.sleep(0.3)
+            await run_command(env, "remote.mount -dir /ext -remote s3.ext")
+            out = env.out.getvalue()
+            assert "mounted s3.ext at /ext (3 objects)" in out
+
+            async with aiohttp.ClientSession() as s:
+                # read-through (no cached chunks yet)
+                async with s.get(
+                    f"http://{a.filer.url}/ext/photos/deep/y.bin"
+                ) as r:
+                    assert r.status == 200
+                    assert await r.read() == objects["photos/deep/y.bin"]
+                # cache, then read again
+                await run_command(env, "remote.cache -dir /ext")
+                async with s.get(f"http://{a.filer.url}/ext/top.txt") as r:
+                    assert r.status == 200
+                    assert await r.read() == objects["top.txt"]
+        finally:
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(go())
+
+
+def test_filer_replicate_into_s3_sink(tmp_path):
+    """filer.replicate -targetRemote s3.x: the notification queue drains
+    into a bucket; creates/deletes round-trip as objects."""
+
+    async def go():
+        from seaweedfs_tpu.replication.notification import FileQueueNotifier
+
+        b = await start_object_cluster(tmp_path)
+        spool = str(tmp_path / "events.spool")
+        a = LocalCluster(
+            base_dir=str(tmp_path / "a"),
+            n_volume_servers=1,
+            pulse_seconds=1,
+            with_filer=True,
+            filer_kwargs=dict(notifier=FileQueueNotifier(spool)),
+        )
+        await a.start()
+        try:
+            await asyncio.to_thread(
+                backend_mod.configure, {"s3.sink": s3_section(b, "mirror")}
+            )
+            doc = os.urandom(64 * 1024)
+            async with aiohttp.ClientSession() as s:
+                async with s.put(
+                    f"http://{a.filer.url}/r/doc.bin", data=doc
+                ) as r:
+                    assert r.status in (200, 201)
+                async with s.put(
+                    f"http://{a.filer.url}/r/gone.bin", data=b"x"
+                ) as r:
+                    assert r.status in (200, 201)
+                async with s.delete(f"http://{a.filer.url}/r/gone.bin") as r:
+                    assert r.status < 400
+
+            await run_cmd(
+                "filer.replicate",
+                [
+                    "-spool", spool,
+                    "-sourceFiler",
+                    f"{a.filer.url}.{a.filer.grpc_port}",
+                    "-targetRemote", "s3.sink/backup",
+                    "-sourcePath", "/r",
+                ],
+            )
+            client = S3Client(b.s3.url, ACCESS, SECRET)
+            got = await asyncio.to_thread(
+                client.get_object, "mirror", "backup/doc.bin"
+            )
+            assert got == doc
+            with pytest.raises(FileNotFoundError):
+                await asyncio.to_thread(
+                    client.head_object, "mirror", "backup/gone.bin"
+                )
+        finally:
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(go())
+
+
+def test_filer_backup_into_s3(tmp_path):
+    """filer.backup -remote s3.x: one-shot replay lands the subtree as
+    objects, with resumable progress stored in the bucket."""
+
+    async def go():
+        b = await start_object_cluster(tmp_path)
+        a = LocalCluster(
+            base_dir=str(tmp_path / "a"),
+            n_volume_servers=1,
+            pulse_seconds=1,
+            with_filer=True,
+        )
+        await a.start()
+        try:
+            await asyncio.to_thread(
+                backend_mod.configure, {"s3.bak": s3_section(b, "backups")}
+            )
+            files = {
+                "/docs/a.txt": b"alpha",
+                "/docs/sub/b.bin": os.urandom(30_000),
+            }
+            async with aiohttp.ClientSession() as s:
+                for path, data in files.items():
+                    async with s.put(
+                        f"http://{a.filer.url}{path}", data=data
+                    ) as r:
+                        assert r.status in (200, 201)
+
+            await run_cmd(
+                "filer.backup",
+                [
+                    "-filer", f"{a.filer.url}.{a.filer.grpc_port}",
+                    "-path", "/docs",
+                    "-remote", "s3.bak/snap",
+                    "-oneTime",
+                ],
+            )
+            client = S3Client(b.s3.url, ACCESS, SECRET)
+
+            def check():
+                assert client.get_object("backups", "snap/a.txt") == files[
+                    "/docs/a.txt"
+                ]
+                assert client.get_object("backups", "snap/sub/b.bin") == files[
+                    "/docs/sub/b.bin"
+                ]
+                # progress marker written -> a rerun resumes, not replays
+                assert int(
+                    client.get_object(
+                        "backups", "snap/.filer_backup_progress"
+                    )
+                ) > 0
+
+            await asyncio.to_thread(check)
+        finally:
+            await a.stop()
+            await b.stop()
+
+    asyncio.run(go())
