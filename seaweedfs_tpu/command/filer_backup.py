@@ -166,21 +166,23 @@ async def run(args) -> None:
             import urllib.parse
 
             fd, tmp = tempfile.mkstemp(prefix=".filer_backup_")
+            f = os.fdopen(fd, "wb")  # takes fd ownership immediately
             try:
                 async with session.get(
                     f"http://{filer_http}{urllib.parse.quote(full)}"
                 ) as r:
                     if r.status >= 300:
                         print(f"skip {full}: HTTP {r.status}")
-                        os.close(fd)
+                        f.close()
                         os.remove(tmp)
                         return False
-                    with os.fdopen(fd, "wb") as f:
-                        async for chunk in r.content.iter_chunked(1 << 20):
-                            f.write(chunk)
+                    async for chunk in r.content.iter_chunked(1 << 20):
+                        f.write(chunk)
+                f.close()
                 await target.store_file(_rel(root, full), tmp)
                 return True
             except BaseException:
+                f.close()
                 if os.path.exists(tmp):
                     os.remove(tmp)
                 raise

@@ -18,12 +18,14 @@ class Mount:
         filer_address: str,
         filer_grpc_address: str = "",
         filer_path: str = "/",
+        **fs_kwargs,
     ):
         self.mountpoint = mountpoint
         self.fs = WeedFS(
             filer_address,
             filer_grpc_address=filer_grpc_address,
             root=filer_path,
+            **fs_kwargs,
         )
         self.conn: FuseConnection | None = None
 
@@ -31,6 +33,7 @@ class Mount:
         fd = kernel_mount(self.mountpoint)
         self.conn = FuseConnection(fd, self.fs)
         self.conn.start()
+        self.fs.start_meta_subscription()
 
     async def wait(self) -> None:
         if self.conn is not None:

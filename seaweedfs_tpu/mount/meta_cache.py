@@ -1,0 +1,79 @@
+"""Mount-side metadata cache, invalidated by the filer meta log.
+
+Reference: weed/mount/meta_cache/meta_cache.go + meta_cache_subscribe.go
+— the mount keeps entries and directory listings locally and subscribes
+to the filer's SubscribeMetadata stream; every event (from any client or
+another mount) invalidates the affected paths, so a second mount sees a
+first mount's rename within one meta-log tick while lookups in between
+cost nothing.
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+log = logging.getLogger("mount.meta")
+
+
+class MetaCache:
+    def __init__(self, ttl: float = 30.0):
+        self.ttl = ttl
+        self._entries: dict[str, tuple[float, object]] = {}
+        self._listings: dict[str, tuple[float, list]] = {}
+        self.hits = 0
+        self.misses = 0
+
+    # -- entries -------------------------------------------------------------
+
+    def get_entry(self, path: str):
+        hit = self._entries.get(path)
+        if hit and time.monotonic() < hit[0]:
+            self.hits += 1
+            return hit[1]
+        self.misses += 1
+        return None
+
+    def put_entry(self, path: str, entry) -> None:
+        self._entries[path] = (time.monotonic() + self.ttl, entry)
+
+    # -- listings ------------------------------------------------------------
+
+    def get_listing(self, directory: str):
+        hit = self._listings.get(directory)
+        if hit and time.monotonic() < hit[0]:
+            self.hits += 1
+            return hit[1]
+        self.misses += 1
+        return None
+
+    def put_listing(self, directory: str, entries: list) -> None:
+        self._listings[directory] = (time.monotonic() + self.ttl, entries)
+
+    # -- invalidation --------------------------------------------------------
+
+    def invalidate(self, path: str) -> None:
+        """Drop one path's entry, its parent's listing, and any cached
+        state under it (renames/deletes of directories)."""
+        self._entries.pop(path, None)
+        self._listings.pop(path, None)
+        d = path.rpartition("/")[0] or "/"
+        self._listings.pop(d, None)
+        prefix = path + "/"
+        for k in [k for k in self._entries if k.startswith(prefix)]:
+            del self._entries[k]
+        for k in [k for k in self._listings if k.startswith(prefix)]:
+            del self._listings[k]
+
+    def clear(self) -> None:
+        self._entries.clear()
+        self._listings.clear()
+
+    def apply_event(self, ev) -> None:
+        """One SubscribeMetadata event -> targeted invalidation."""
+        n = ev.event_notification
+        directory = ev.directory.rstrip("/") or ""
+        if n.HasField("old_entry"):
+            self.invalidate(f"{directory}/{n.old_entry.name}")
+        if n.HasField("new_entry"):
+            new_dir = (n.new_parent_path or ev.directory).rstrip("/") or ""
+            self.invalidate(f"{new_dir}/{n.new_entry.name}")

@@ -1,0 +1,231 @@
+"""Chunked dirty pages: the FUSE streaming write pipeline.
+
+Reference: weed/mount/page_writer.go:22-77 + dirty_pages_chunked.go:26-92
+— writes land in fixed-size chunk buffers; full (or evicted) chunks are
+uploaded to volume servers as the write progresses, so memory use is
+O(resident_chunks x chunk_size) regardless of file size, and FLUSH only
+has to upload the tail and publish the entry.  A random write into an
+existing file seeds ONLY the chunk(s) it straddles (no whole-file
+download); the published entry carries overlapping chunks whose
+modified_ts_ns ordering lets the filer's interval algebra resolve the
+newest bytes (filer/filechunks.py).
+"""
+from __future__ import annotations
+
+import time
+
+from ..pb import filer_pb2
+
+CHUNK_SIZE = 4 * 1024 * 1024
+MAX_RESIDENT = 4
+
+
+class _Chunk:
+    __slots__ = ("index", "buf", "hi", "touched")
+
+    def __init__(self, index: int, chunk_size: int):
+        self.index = index
+        self.buf = bytearray(chunk_size)
+        self.hi = 0  # valid bytes: [0, hi) — holes below are zeros
+        self.touched = 0.0
+
+
+class DirtyPages:
+    """Per-open-handle write state.
+
+    `base_size` is the committed file size at open; `size` tracks the
+    live logical size.  `uploaded` holds FileChunks already on volume
+    servers but not yet published in the entry — `commit()` publishes
+    them.
+    """
+
+    def __init__(
+        self,
+        fs,  # WeedFS: _read_range/_assign_upload/_commit_entry
+        path: str,
+        base_size: int,
+        chunk_size: int = CHUNK_SIZE,
+        max_resident: int = MAX_RESIDENT,
+    ):
+        self.fs = fs
+        self.path = path
+        self.base_size = base_size
+        self.size = base_size
+        self.chunk_size = chunk_size
+        self.max_resident = max_resident
+        self.resident: dict[int, _Chunk] = {}
+        self.uploaded: list[filer_pb2.FileChunk] = []
+        self.dirty = False
+        # observability/tests: high-water mark of resident buffers
+        self.max_resident_seen = 0
+
+    # -- helpers -------------------------------------------------------------
+
+    def _range_in_uploaded(self, start: int, end: int) -> bool:
+        return any(
+            c.offset < end and start < c.offset + int(c.size)
+            for c in self.uploaded
+        )
+
+    async def _seed(self, chunk: _Chunk) -> None:
+        """Fill a chunk buffer from the file's current content — called
+        only for partial writes into existing bytes, and only for the
+        straddled chunk (dirty_pages seeding, never the whole file)."""
+        start = chunk.index * self.chunk_size
+        if self._range_in_uploaded(start, start + self.chunk_size):
+            # the freshest bytes for this range sit in not-yet-published
+            # chunks (e.g. this chunk was evicted earlier): publish first
+            # — commit also raises base_size, so the read below sees them.
+            # Checking uploaded BEFORE the base_size cut is what keeps a
+            # rewrite of an evicted chunk from seeding zeros.
+            await self.commit()
+        want = min(self.chunk_size, self.base_size - start)
+        if want <= 0:
+            return
+        data = await self.fs._read_range(self.path, start, want)
+        chunk.buf[: len(data)] = data
+        chunk.hi = max(chunk.hi, len(data))
+
+    async def _upload_chunk(self, chunk: _Chunk) -> None:
+        data = bytes(chunk.buf[: chunk.hi])
+        if not data:
+            return
+        fid = await self.fs._assign_upload(data)
+        self.uploaded.append(
+            filer_pb2.FileChunk(
+                file_id=fid,
+                offset=chunk.index * self.chunk_size,
+                size=len(data),
+                modified_ts_ns=time.time_ns(),
+            )
+        )
+
+    async def _evict_if_needed(self, keep_index: int) -> None:
+        while len(self.resident) > self.max_resident:
+            victim_idx = min(
+                (i for i in self.resident if i != keep_index),
+                key=lambda i: (self.resident[i].touched, i),
+                default=None,
+            )
+            if victim_idx is None:
+                return
+            await self._upload_chunk(self.resident.pop(victim_idx))
+
+    # -- write ---------------------------------------------------------------
+
+    async def write(self, offset: int, data: bytes) -> None:
+        pos = 0
+        while pos < len(data):
+            abs_off = offset + pos
+            idx = abs_off // self.chunk_size
+            in_off = abs_off - idx * self.chunk_size
+            take = min(len(data) - pos, self.chunk_size - in_off)
+            chunk = self.resident.get(idx)
+            if chunk is None:
+                chunk = _Chunk(idx, self.chunk_size)
+                full_cover = in_off == 0 and take == self.chunk_size
+                overlaps_existing = (
+                    idx * self.chunk_size < max(self.base_size, self.size)
+                )
+                self.resident[idx] = chunk
+                if not full_cover and overlaps_existing:
+                    try:
+                        await self._seed(chunk)
+                    except BaseException:
+                        self.resident.pop(idx, None)
+                        raise
+            chunk.buf[in_off : in_off + take] = data[pos : pos + take]
+            chunk.hi = max(chunk.hi, in_off + take)
+            chunk.touched = time.monotonic()
+            self.size = max(self.size, abs_off + take)
+            self.dirty = True
+            self.max_resident_seen = max(
+                self.max_resident_seen, len(self.resident)
+            )
+            await self._evict_if_needed(idx)
+            pos += take
+
+    # -- read (read-your-writes) ---------------------------------------------
+
+    async def read(self, offset: int, size: int) -> bytes:
+        size = max(0, min(size, self.size - offset))
+        if size == 0:
+            return b""
+        end = offset + size
+        # fast path: the whole range inside one resident buffer
+        idx = offset // self.chunk_size
+        chunk = self.resident.get(idx)
+        if chunk is not None and end <= (idx + 1) * self.chunk_size:
+            in_off = offset - idx * self.chunk_size
+            return bytes(chunk.buf[in_off : in_off + size])
+        # general path: publish pending uploads, read the committed view,
+        # then overlay resident buffers
+        if self._range_in_uploaded(offset, end):
+            await self.commit()
+        if offset < self.base_size:
+            base = await self.fs._read_range(
+                self.path, offset, min(size, self.base_size - offset)
+            )
+        else:
+            base = b""
+        out = bytearray(size)
+        out[: len(base)] = base
+        for i in range(idx, (end - 1) // self.chunk_size + 1):
+            c = self.resident.get(i)
+            if c is None:
+                continue
+            c_start = i * self.chunk_size
+            lo = max(offset, c_start)
+            hi = min(end, c_start + c.hi)
+            if lo < hi:
+                out[lo - offset : hi - offset] = c.buf[
+                    lo - c_start : hi - c_start
+                ]
+        return bytes(out)
+
+    # -- publish -------------------------------------------------------------
+
+    async def commit(self) -> None:
+        """Publish uploaded-but-unreferenced chunks into the entry."""
+        if not self.uploaded and self.size == self.base_size:
+            return
+        chunks, self.uploaded = self.uploaded, []
+        await self.fs._commit_entry(self.path, chunks, self.size)
+        # the entry now declares file_size=self.size, and the filer serves
+        # zeros for holes, so the committed view covers [0, size)
+        self.base_size = max(self.base_size, self.size)
+
+    async def flush(self) -> None:
+        """Upload every resident buffer and publish (FUSE FLUSH/FSYNC)."""
+        if not self.dirty and not self.uploaded:
+            return
+        for idx in sorted(self.resident):
+            await self._upload_chunk(self.resident[idx])
+        self.resident.clear()
+        await self.commit()
+        self.dirty = False
+
+    def truncate_zero(self) -> None:
+        """O_TRUNC/truncate(0): forget everything local; caller rewrites
+        the entry."""
+        self.resident.clear()
+        self.uploaded.clear()
+        self.size = 0
+        self.base_size = 0
+        self.dirty = True
+
+    async def truncate(self, new_size: int) -> None:
+        if new_size == 0:
+            self.truncate_zero()
+            await self.fs._truncate_entry(self.path, 0)
+            return
+        if new_size >= self.size:
+            self.size = new_size  # growth: holes read back as zeros
+            self.dirty = True
+            return
+        # shrink: publish current state, then cut the entry server-side
+        await self.flush()
+        await self.fs._truncate_entry(self.path, new_size)
+        self.size = new_size
+        self.base_size = new_size
+        self.dirty = False

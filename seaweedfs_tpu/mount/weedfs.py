@@ -3,18 +3,20 @@
 Reference: weed/mount/weedfs.go:29-70 and weedfs_file_*.go /
 weedfs_dir_*.go — inode table bridging FUSE nodeids to filer paths,
 reads streamed from the filer HTTP plane (Range requests resolve chunk
-intervals server-side), writes spooled locally per open handle and
-flushed to the filer on FLUSH/RELEASE (the reference's page-cache +
-upload pipeline, simplified to whole-file flush).
+intervals server-side), writes streamed out through chunked dirty pages
+(pages.py — fixed-size buffers uploaded as they fill, O(chunk) memory
+regardless of file size, per the reference's page_writer.go), and
+metadata served through a cache invalidated by the filer's
+SubscribeMetadata stream (meta_cache.py).
 """
 from __future__ import annotations
 
+import asyncio
 import errno
 import logging
 import os
 import stat as stat_mod
 import struct
-import tempfile
 import time
 import urllib.parse
 
@@ -24,6 +26,8 @@ import grpc
 from ..pb import Stub, filer_pb2
 from ..pb.rpc import channel
 from . import fusekernel as fk
+from .meta_cache import MetaCache
+from .pages import CHUNK_SIZE, MAX_RESIDENT, DirtyPages
 
 log = logging.getLogger("mount")
 
@@ -109,14 +113,14 @@ class Inodes:
 
 
 class Handle:
-    """One open file: reads proxy the filer; writes spool locally."""
+    """One open file: reads proxy the filer; writes stream through
+    chunked dirty pages."""
 
     def __init__(self, path: str, entry: filer_pb2.Entry | None, flags: int):
         self.path = path
         self.entry = entry
         self.flags = flags
-        self.spool: tempfile.NamedTemporaryFile | None = None
-        self.dirty = False
+        self.pages: DirtyPages | None = None
 
     @property
     def writable(self) -> bool:
@@ -129,6 +133,9 @@ class WeedFS:
         filer_address: str,  # host:port HTTP
         filer_grpc_address: str = "",
         root: str = "/",
+        chunk_size: int = CHUNK_SIZE,
+        max_resident_chunks: int = MAX_RESIDENT,
+        meta_ttl: float = 30.0,
     ):
         host, _, p = filer_address.partition(":")
         self.filer_address = filer_address
@@ -139,6 +146,10 @@ class WeedFS:
         self._next_fh = 1
         self._stub_cache = None
         self._session: aiohttp.ClientSession | None = None
+        self.chunk_size = chunk_size
+        self.max_resident_chunks = max_resident_chunks
+        self.meta = MetaCache(ttl=meta_ttl)
+        self._meta_task: asyncio.Task | None = None
 
     def _stub(self):
         if self._stub_cache is None:
@@ -152,18 +163,55 @@ class WeedFS:
             self._session = aiohttp.ClientSession()
         return self._session
 
+    def start_meta_subscription(self) -> None:
+        """Tail the filer meta log and invalidate the cache on every
+        event — this is what lets one mount see another mount's changes
+        within a tick while lookups stay cached (reference
+        mount/meta_cache/meta_cache_subscribe.go)."""
+        if self._meta_task is None or self._meta_task.done():
+            self._meta_task = asyncio.ensure_future(self._meta_loop())
+
+    async def _meta_loop(self) -> None:
+        root = self.inodes.root
+        since = time.time_ns()
+        while True:
+            try:
+                async for ev in self._stub().SubscribeMetadata(
+                    filer_pb2.SubscribeMetadataRequest(
+                        client_name="mount",
+                        path_prefix=root if root != "/" else "",
+                        since_ns=since,
+                    )
+                ):
+                    since = max(since, ev.ts_ns)
+                    self.meta.apply_event(ev)
+            except asyncio.CancelledError:
+                return
+            except Exception as e:  # noqa: BLE001 — filer restart etc.
+                log.debug("meta subscription retry: %s", e)
+                await asyncio.sleep(1.0)
+
     async def close(self) -> None:
+        if self._meta_task is not None:
+            self._meta_task.cancel()
+            self._meta_task = None
         if self._session is not None:
             await self._session.close()
             self._session = None
 
     # ---------------------------------------------------------------- filer
 
-    async def _find(self, path: str) -> filer_pb2.Entry:
+    async def _find(
+        self, path: str, fresh: bool = False
+    ) -> filer_pb2.Entry:
         if path == "/":
             e = filer_pb2.Entry(name="/", is_directory=True)
             e.attributes.file_mode = 0o755
             return e
+        if not fresh:
+            cached = self.meta.get_entry(path)
+            if cached is not None:
+                return cached
         d, _, name = path.rpartition("/")
         try:
             resp = await self._stub().LookupDirectoryEntry(
@@ -177,12 +225,27 @@ class WeedFS:
             raise
         if not resp.HasField("entry"):
             raise fk.FuseError(errno.ENOENT)
+        if not resp.entry.hard_link_id:
+            # hard-linked entries change through SIBLING names (the filer
+            # republishes shared content/xattrs across the group), which
+            # path-keyed invalidation can't see — serve those fresh
+            self.meta.put_entry(path, resp.entry)
         return resp.entry
 
     async def _list(self, directory: str) -> list[filer_pb2.Entry]:
         from ..filer.client import list_all_entries
 
-        return await list_all_entries(self._stub(), directory)
+        cached = self.meta.get_listing(directory)
+        if cached is not None:
+            return cached
+        entries = await list_all_entries(self._stub(), directory)
+        self.meta.put_listing(directory, entries)
+        for e in entries:  # listing rows double as entry lookups
+            if not e.hard_link_id:
+                self.meta.put_entry(
+                    f"{directory.rstrip('/') or ''}/{e.name}", e
+                )
+        return entries
 
     async def _subtree_size(self, directory: str) -> int:
         """Total file bytes under a directory (quota accounting)."""
@@ -241,13 +304,12 @@ class WeedFS:
         # a dirty open handle knows the freshest size; mode/ownership come
         # from the entry it was opened with
         for h in self.handles.values():
-            if h.path == path and h.spool is not None:
-                size = os.fstat(h.spool.fileno()).st_size
+            if h.path == path and h.pages is not None and h.pages.dirty:
                 a = h.entry.attributes if h.entry else None
                 attr = fk.pack_attr(
                     nodeid,
                     fk.S_IFREG | ((a.file_mode & 0o7777) if a else 0o644),
-                    size,
+                    h.pages.size,
                     int(time.time()), int(time.time()),
                     uid=a.uid if a else 0, gid=a.gid if a else 0,
                 )
@@ -263,10 +325,9 @@ class WeedFS:
             h = self.handles.get(fh)
             if h is None or not h.writable:
                 # O_TRUNC truncates arrive WITHOUT FATTR_FH on this kernel;
-                # route them to any open writable handle for the path — the
-                # no-handle filer rewrite below would RACE the first WRITE's
-                # spool seeding (seed reads old content while the truncate
-                # PUT is in flight) and resurrect the old tail on flush
+                # route them to any open writable handle for the path so
+                # its dirty pages shrink with the file instead of
+                # resurrecting the old tail on flush
                 h = next(
                     (
                         x for x in self.handles.values()
@@ -275,31 +336,16 @@ class WeedFS:
                     None,
                 )
             if h is not None and h.writable:
-                await self._ensure_spool(h)
-                h.spool.truncate(size)
-                h.dirty = True
+                await self._pages(h).truncate(size)
             else:
-                # truncate without an open handle: rewrite through the
-                # filer, zero-padding growth (POSIX) and keeping the mode
-                cur = await self._find(path)
-                data = b""
-                if size:
-                    data = await self._read_range(path, 0, size)
-                    if len(data) < size:
-                        data += b"\x00" * (size - len(data))
-                await self._put(
-                    path, data,
-                    mode=(cur.attributes.file_mode & 0o7777) or 0o644,
-                )
-        entry = await self._find(path)
+                # truncate without an open handle: server-side chunk trim
+                await self._truncate_entry(path, size)
+        entry = await self._find(path, fresh=True)
         if valid & FATTR_MODE:
             entry.attributes.file_mode = mode
         if valid & FATTR_MTIME:
             entry.attributes.mtime = mtime
-        d, _, name = path.rpartition("/")
-        await self._stub().UpdateEntry(
-            filer_pb2.UpdateEntryRequest(directory=d or "/", entry=entry)
-        )
+        await self._update_entry(path, entry)
         entry2 = await self._find(path)
         return fk.pack_attr_out(self._attr_of(nodeid, entry2), attr_valid=0)
 
@@ -409,6 +455,7 @@ class WeedFS:
         )
         if resp.error:
             raise fk.FuseError(errno.EEXIST)
+        self.meta.invalidate(path)
         ino = self.inodes.lookup(path)
         entry = await self._find(path)
         return fk.pack_entry_out(ino, self._attr_of(ino, entry))
@@ -439,6 +486,7 @@ class WeedFS:
         )
         if resp.error:
             raise fk.FuseError(errno.ENOENT)
+        self.meta.invalidate((directory.rstrip("/") or "") + "/" + name)
 
     async def rename(self, nodeid: int, body: bytes, **kw) -> bytes:
         (newdir_ino,) = RENAME_IN.unpack_from(body)
@@ -466,6 +514,8 @@ class WeedFS:
         )
         old_path = (old_dir.rstrip("/") or "") + "/" + oldname.decode()
         new_path = (new_dir.rstrip("/") or "") + "/" + newname.decode()
+        self.meta.invalidate(old_path)
+        self.meta.invalidate(new_path)
         self.inodes.forget_path(new_path)
         self.inodes.rename(old_path, new_path)
         # open handles follow the rename or their flush would resurrect
@@ -500,6 +550,7 @@ class WeedFS:
             )
         )
         path = (parent.rstrip("/") or "") + "/" + name.decode()
+        self.meta.invalidate(path)
         ino = self.inodes.lookup(path)
         entry = await self._find(path)
         return fk.pack_entry_out(ino, self._attr_of(ino, entry))
@@ -509,6 +560,7 @@ class WeedFS:
         await self._stub().UpdateEntry(
             filer_pb2.UpdateEntryRequest(directory=d or "/", entry=entry)
         )
+        self.meta.invalidate(path)
 
     async def link(self, nodeid: int, body: bytes, **kw) -> bytes:
         """Hard link (weedfs_link.go): names become pointers to shared
@@ -538,6 +590,7 @@ class WeedFS:
         if resp.error:
             raise fk.FuseError(errno.EEXIST)
         new_path = (new_parent.rstrip("/") or "") + "/" + newname
+        self.meta.invalidate(new_path)
         ino = self.inodes.lookup(new_path)
         entry = await self._find(new_path)
         return fk.pack_entry_out(ino, self._attr_of(ino, entry))
@@ -601,16 +654,33 @@ class WeedFS:
 
     # files
 
+    def _pages(self, h: Handle, base_size: int = 0) -> DirtyPages:
+        if h.pages is None:
+            h.pages = DirtyPages(
+                self, h.path, base_size,
+                chunk_size=self.chunk_size,
+                max_resident=self.max_resident_chunks,
+            )
+        return h.pages
+
+    @staticmethod
+    def _entry_size(entry: filer_pb2.Entry) -> int:
+        extent = max(
+            (c.offset + int(c.size) for c in entry.chunks), default=0
+        )
+        return max(entry.attributes.file_size, extent, len(entry.content))
+
     async def open(self, nodeid: int, body: bytes, **kw) -> bytes:
         flags, _ = OPEN_IN.unpack_from(body)
         path = self.inodes.path(nodeid)
         entry = await self._find(path)
         h = Handle(path, entry, flags)
-        if h.writable and not (flags & os.O_TRUNC):
-            await self._ensure_spool(h)  # read-modify-write needs the bytes
-        elif h.writable:
-            h.spool = tempfile.NamedTemporaryFile(prefix="weedfs-spool-")
-            h.dirty = True
+        if h.writable:
+            if flags & os.O_TRUNC:
+                await self._truncate_entry(path, 0)
+                self._pages(h, base_size=0).dirty = True
+            else:
+                self._pages(h, base_size=self._entry_size(entry))
         fh = self._next_fh
         self._next_fh += 1
         self.handles[fh] = h
@@ -625,8 +695,7 @@ class WeedFS:
         entry = await self._find(path)
         ino = self.inodes.lookup(path)
         h = Handle(path, entry, flags)
-        h.spool = tempfile.NamedTemporaryFile(prefix="weedfs-spool-")
-        h.dirty = True
+        self._pages(h, base_size=0).dirty = True
         fh = self._next_fh
         self._next_fh += 1
         self.handles[fh] = h
@@ -644,6 +713,78 @@ class WeedFS:
         entry = await self._find(path)
         ino = self.inodes.lookup(path)
         return fk.pack_entry_out(ino, self._attr_of(ino, entry))
+
+    async def _assign_upload(self, data: bytes) -> str:
+        """Assign a fid via the filer and upload one chunk to the volume
+        server — the mount's direct write plane (weedfs_file_sync.go /
+        filehandle upload path)."""
+        from ..operation.upload import upload_data
+
+        a = await self._stub().AssignVolume(
+            filer_pb2.AssignVolumeRequest(count=1)
+        )
+        if a.error:
+            log.warning("assign failed: %s", a.error)
+            raise fk.FuseError(errno.EIO)
+        await upload_data(
+            f"http://{a.location.url}/{a.file_id}",
+            data,
+            compress=False,
+            jwt=a.auth,
+        )
+        return a.file_id
+
+    async def _commit_entry(
+        self, path: str, chunks: list[filer_pb2.FileChunk], size: int
+    ) -> None:
+        """Publish uploaded chunks into the entry (the dirty-pages commit
+        half of dirty_pages_chunked.go saveChunkedFileIntervalToStorage)."""
+        entry = await self._find(path, fresh=True)
+        entry.chunks.extend(chunks)
+        if entry.content and any(
+            c.offset == 0 and int(c.size) >= len(entry.content)
+            for c in chunks
+        ):
+            # the inlined head was folded into a newer chunk (seeding read
+            # it); drop it or the read path would keep serving stale bytes
+            entry.content = b""
+        entry.attributes.file_size = size
+        entry.attributes.mtime = int(time.time())
+        await self._update_entry(path, entry)
+
+    async def _truncate_entry(self, path: str, new_size: int) -> None:
+        """Server-side truncation: trim the chunk list (re-uploading the
+        boundary range when a chunk straddles it) instead of rewriting
+        the whole file."""
+        entry = await self._find(path, fresh=True)
+        if new_size == 0:
+            del entry.chunks[:]
+            entry.content = b""
+        else:
+            keep = [
+                c for c in entry.chunks
+                if c.offset + int(c.size) <= new_size
+            ]
+            straddle = [
+                c for c in entry.chunks
+                if c.offset < new_size < c.offset + int(c.size)
+            ]
+            if straddle:
+                lo = min(c.offset for c in straddle)
+                data = await self._read_range(path, lo, new_size - lo)
+                fid = await self._assign_upload(data)
+                keep.append(
+                    filer_pb2.FileChunk(
+                        file_id=fid, offset=lo, size=len(data),
+                        modified_ts_ns=time.time_ns(),
+                    )
+                )
+            del entry.chunks[:]
+            entry.chunks.extend(keep)
+            entry.content = bytes(entry.content[:new_size])
+        entry.attributes.file_size = new_size
+        entry.attributes.mtime = int(time.time())
+        await self._update_entry(path, entry)
 
     async def _read_range(self, path: str, offset: int, size: int) -> bytes:
         sess = await self._sess()
@@ -664,33 +805,15 @@ class WeedFS:
         ) as r:
             if r.status >= 300:
                 raise fk.FuseError(errno.EIO)
-
-    async def _ensure_spool(self, h: Handle) -> None:
-        if h.spool is not None:
-            return
-        spool = tempfile.NamedTemporaryFile(prefix="weedfs-spool-")
-        sess = await self._sess()
-        async with sess.get(self._http(h.path)) as r:
-            if r.status == 404:
-                pass  # brand-new file: empty spool is correct
-            elif r.status >= 300:
-                # a failed seed must NOT leave an empty spool behind — the
-                # later flush would overwrite the real file with it
-                spool.close()
-                raise fk.FuseError(errno.EIO)
-            else:
-                async for piece in r.content.iter_chunked(1 << 16):
-                    spool.write(piece)
-        spool.flush()
-        h.spool = spool
+        self.meta.invalidate(path)
 
     async def read(self, nodeid: int, body: bytes, **kw) -> bytes:
         (fh, offset, size, _, _, _, _) = READ_IN.unpack_from(body)
         h = self.handles.get(fh)
         if h is None:
             raise fk.FuseError(errno.EBADF)
-        if h.spool is not None:
-            return os.pread(h.spool.fileno(), size, offset)
+        if h.pages is not None:
+            return await h.pages.read(offset, size)
         return await self._read_range(h.path, offset, size)
 
     async def write(self, nodeid: int, body: bytes, **kw) -> bytes:
@@ -699,37 +822,12 @@ class WeedFS:
         h = self.handles.get(fh)
         if h is None or not h.writable:
             raise fk.FuseError(errno.EBADF)
-        await self._ensure_spool(h)
-        os.pwrite(h.spool.fileno(), data, offset)
-        h.dirty = True
+        await self._pages(h).write(offset, data)
         return fk.WRITE_OUT.pack(len(data), 0)
 
-    async def _current_mode(self, h: Handle) -> int:
-        """The file's live mode (a chmod may have landed since open)."""
-        try:
-            entry = await self._find(h.path)
-            mode = entry.attributes.file_mode & 0o7777
-        except fk.FuseError:
-            mode = (
-                h.entry.attributes.file_mode & 0o7777 if h.entry else 0o644
-            )
-        return mode or 0o644
-
     async def _flush_handle(self, h: Handle) -> None:
-        if not (h.dirty and h.spool is not None):
-            return
-        h.spool.flush()
-        size = os.fstat(h.spool.fileno()).st_size
-        mode = await self._current_mode(h)
-        sess = await self._sess()
-        with open(h.spool.name, "rb") as f:
-            async with sess.put(
-                self._http(h.path) + f"?mode={mode:o}", data=f
-            ) as r:
-                if r.status >= 300:
-                    raise fk.FuseError(errno.EIO)
-        h.dirty = False
-        log.debug("flushed %s (%d bytes)", h.path, size)
+        if h.pages is not None:
+            await h.pages.flush()
 
     async def flush(self, nodeid: int, body: bytes, **kw) -> bytes:
         (fh, _, _, _) = RELEASE_IN.unpack_from(body)
@@ -750,8 +848,6 @@ class WeedFS:
         h = self.handles.pop(fh, None)
         if h is not None:
             await self._flush_handle(h)
-            if h.spool is not None:
-                h.spool.close()
         return b""
 
     async def lseek(self, nodeid: int, body: bytes, **kw) -> bytes:

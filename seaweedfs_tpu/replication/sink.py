@@ -86,7 +86,17 @@ class ObjectStoreSink:
             if key is None:
                 return
             content = bytearray(n.new_entry.content)
-            for c in sorted(n.new_entry.chunks, key=lambda c: c.offset):
+            # oldest-first by modified_ts_ns (ties: list order) so newer
+            # overlapping chunks shadow older bytes, exactly like the
+            # filer's interval resolution (filer/filechunks.py)
+            ordered = [
+                c
+                for _, _, c in sorted(
+                    (c.modified_ts_ns, i, c)
+                    for i, c in enumerate(n.new_entry.chunks)
+                )
+            ]
+            for c in ordered:
                 blob = await self.fetch_chunk(c.file_id)
                 end = c.offset + len(blob)
                 if len(content) < end:

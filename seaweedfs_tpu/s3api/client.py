@@ -43,6 +43,7 @@ class S3Client:
         region: str = "us-east-1",
         timeout: float = 60.0,
     ):
+        self.https = endpoint.startswith("https://")
         if "//" in endpoint:
             endpoint = endpoint.split("//", 1)[1]
         self.endpoint = endpoint.rstrip("/")
@@ -61,7 +62,8 @@ class S3Client:
         data: bytes = b"",
         headers: dict | None = None,
     ) -> tuple[int, bytes, dict]:
-        url = f"http://{self.endpoint}{path}"
+        scheme = "https" if self.https else "http"
+        url = f"{scheme}://{self.endpoint}{path}"
         if query:
             url += f"?{query}"
         hdrs = dict(headers or {})
@@ -70,7 +72,11 @@ class S3Client:
                 method, url, hdrs, data, self.access_key, self.secret_key,
                 region=self.region,
             )
-        conn = http.client.HTTPConnection(self.endpoint, timeout=self.timeout)
+        conn_cls = (
+            http.client.HTTPSConnection if self.https
+            else http.client.HTTPConnection
+        )
+        conn = conn_cls(self.endpoint, timeout=self.timeout)
         try:
             conn.request(method, path + (f"?{query}" if query else ""),
                          body=data or None, headers=hdrs)

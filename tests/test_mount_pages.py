@@ -1,0 +1,165 @@
+"""DirtyPages unit tests against a fake filer: the chunked write
+pipeline's edge cases (eviction, rewrite-after-eviction, seeding,
+read-your-writes, truncation) without a kernel mount."""
+import asyncio
+import os
+
+from seaweedfs_tpu.mount.pages import DirtyPages
+
+
+class FakeFS:
+    """Emulates the filer surface DirtyPages drives: committed content is
+    a flat buffer; chunks apply in commit order (ts order equivalent,
+    since each commit appends newer chunks)."""
+
+    def __init__(self):
+        self.blobs: dict[str, bytes] = {}
+        self.committed = bytearray()
+        self.size = 0
+        self.next_fid = 0
+        self.reads: list[tuple[int, int]] = []
+        self.commits = 0
+
+    async def _read_range(self, path, offset, size):
+        self.reads.append((offset, size))
+        end = min(self.size, offset + size)
+        view = bytes(self.committed[offset:end])
+        return view + b"\x00" * (min(size, self.size - offset) - len(view))
+
+    async def _assign_upload(self, data):
+        fid = f"f{self.next_fid}"
+        self.next_fid += 1
+        self.blobs[fid] = bytes(data)
+        return fid
+
+    async def _commit_entry(self, path, chunks, size):
+        self.commits += 1
+        for c in chunks:
+            blob = self.blobs[c.file_id]
+            end = c.offset + len(blob)
+            if len(self.committed) < end:
+                self.committed.extend(b"\x00" * (end - len(self.committed)))
+            self.committed[c.offset : end] = blob
+        self.size = size
+        if len(self.committed) < size:
+            self.committed.extend(b"\x00" * (size - len(self.committed)))
+        del self.committed[size:]
+
+    async def _truncate_entry(self, path, new_size):
+        self.size = new_size
+        del self.committed[new_size:]
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+CS = 1024
+
+
+def make(base=b""):
+    fs = FakeFS()
+    fs.committed = bytearray(base)
+    fs.size = len(base)
+    pages = DirtyPages(fs, "/f", len(base), chunk_size=CS, max_resident=2)
+    return fs, pages
+
+
+def test_sequential_write_evicts_and_flushes():
+    async def go():
+        fs, p = make()
+        blob = os.urandom(6 * CS + 123)
+        for off in range(0, len(blob), 300):
+            await p.write(off, blob[off : off + 300])
+        assert p.max_resident_seen <= 3
+        await p.flush()
+        assert bytes(fs.committed) == blob
+        assert fs.size == len(blob)
+
+    run(go())
+
+
+def test_rewrite_of_evicted_uncommitted_chunk():
+    """Regression: a partial write into a chunk that was evicted and
+    uploaded (but not committed) must first publish the upload, then
+    seed from it — not shadow it with zeros."""
+
+    async def go():
+        fs, p = make()
+        blob = bytearray(os.urandom(4 * CS))
+        await p.write(0, bytes(blob))  # fills chunks 0-3, evicting 0-1
+        assert p.uploaded, "eviction should have uploaded chunks"
+        patch = b"PATCH!"
+        await p.write(100, patch)  # back into evicted chunk 0
+        blob[100 : 100 + len(patch)] = patch
+        await p.flush()
+        assert bytes(fs.committed) == bytes(blob)
+
+    run(go())
+
+
+def test_partial_write_seeds_only_straddled_chunk():
+    async def go():
+        base = os.urandom(8 * CS)
+        fs, p = make(base)
+        await p.write(3 * CS + 10, b"xy")
+        seeded = sum(size for _, size in fs.reads)
+        assert seeded <= CS, fs.reads
+        await p.flush()
+        expect = bytearray(base)
+        expect[3 * CS + 10 : 3 * CS + 12] = b"xy"
+        assert bytes(fs.committed) == bytes(expect)
+
+    run(go())
+
+
+def test_read_your_writes_before_flush():
+    async def go():
+        base = os.urandom(3 * CS)
+        fs, p = make(base)
+        await p.write(CS + 5, b"hello")
+        got = await p.read(CS, 16)
+        expect = bytearray(base[CS : CS + 16])
+        expect[5:10] = b"hello"
+        assert got == bytes(expect)
+        # spanning read across resident + committed
+        got = await p.read(0, 3 * CS)
+        full = bytearray(base)
+        full[CS + 5 : CS + 10] = b"hello"
+        assert got == bytes(full)
+
+    run(go())
+
+
+def test_write_beyond_eof_reads_zeros_in_hole():
+    async def go():
+        fs, p = make(b"abc")
+        await p.write(2 * CS, b"tail")
+        assert p.size == 2 * CS + 4
+        got = await p.read(0, p.size)
+        expect = b"abc" + b"\x00" * (2 * CS - 3) + b"tail"
+        assert got == expect
+        await p.flush()
+        assert bytes(fs.committed) == expect
+
+    run(go())
+
+
+def test_truncate_paths():
+    async def go():
+        base = os.urandom(2 * CS)
+        fs, p = make(base)
+        await p.write(10, b"zzz")
+        await p.truncate(CS)  # shrink: publish then cut
+        assert p.size == CS
+        await p.flush()
+        expect = bytearray(base[:CS])
+        expect[10:13] = b"zzz"
+        assert bytes(fs.committed) == bytes(expect)
+        await p.truncate(CS + 50)  # growth: zeros
+        await p.flush()
+        assert fs.size == CS + 50
+        p.truncate_zero()
+        assert p.size == 0
+
+    run(go())
