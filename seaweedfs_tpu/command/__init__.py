@@ -82,6 +82,11 @@ def main(argv: list[str] | None = None) -> int:
     from ..utils import profiling
 
     profiling.maybe_start(args)
+    # process-wide mTLS from security.toml [tls] (reference security/tls.go
+    # loads the same file for every weed command)
+    from ..security import tls as tls_mod
+
+    tls_mod.configure(tls_mod.from_security_toml())
     try:
         asyncio.run(COMMANDS[args.command].run(args))
     except KeyboardInterrupt:

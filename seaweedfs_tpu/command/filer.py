@@ -1,6 +1,8 @@
 """`filer` — run a filer server (reference: weed/command/filer.go)."""
 from __future__ import annotations
 
+from ..security import guard as guard_mod
+
 import argparse
 import asyncio
 
@@ -107,6 +109,7 @@ def build_filer_server(args):
         compress_chunks=args.compress_chunks,
         chunk_cache_dir=args.chunk_cache_dir or None,
         chunk_cache_mb=args.chunk_cache_mb,
+        white_list=guard_mod.from_security_toml(),
     )
 
 

@@ -4,6 +4,7 @@ from __future__ import annotations
 import asyncio
 
 from ..utils import config as config_util
+from ..security import guard as guard_mod
 
 NAME = "master"
 HELP = "start a master server"
@@ -40,7 +41,12 @@ def add_args(p) -> None:
     )
     p.add_argument(
         "-mdir", dest="meta_dir", default="",
-        help="directory for durable raft state (term/vote/log)",
+        help="directory for durable raft state (term/vote/log/snapshot)",
+    )
+    p.add_argument(
+        "-raft.snapshotThreshold", dest="raft_snapshot_threshold",
+        type=int, default=1000,
+        help="compact the raft log into a snapshot past this many entries",
     )
 
 
@@ -60,6 +66,8 @@ async def run(args) -> None:
         jwt_expires_sec=config_util.jwt_expires_sec(),
         peers=[p.strip() for p in args.peers.split(",") if p.strip()],
         meta_dir=args.meta_dir or None,
+        raft_snapshot_threshold=args.raft_snapshot_threshold,
+        white_list=guard_mod.from_security_toml(),
     )
     await ms.start()
     await asyncio.Event().wait()  # serve until interrupted

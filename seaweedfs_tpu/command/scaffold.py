@@ -20,6 +20,19 @@ TEMPLATES = {
 key = ""
 # Seconds an issued write token stays valid.
 expires_after_seconds = 10
+
+[tls]
+# When all three are set, EVERY gRPC surface (master/volume/filer/raft/
+# mq) serves mutual TLS and every client presents this certificate
+# (reference weed/security/tls.go).
+ca = ""
+cert = ""
+key = ""
+
+[access]
+# IPs / CIDR ranges allowed to reach the public HTTP planes; empty =
+# open (reference weed/security/guard.go white_list).
+white_list = []
 """,
 }
 

@@ -36,7 +36,10 @@ async def run(args) -> None:
     from ..server.master import MasterServer
     from ..server.volume import VolumeServer
 
+    from ..security import guard as guard_mod
+
     jwt_key = config_util.jwt_signing_key()
+    white_list = guard_mod.from_security_toml()
     ms = MasterServer(
         ip=args.ip,
         port=args.master_port,
@@ -44,6 +47,7 @@ async def run(args) -> None:
         default_replication=args.default_replication,
         jwt_signing_key=jwt_key,
         jwt_expires_sec=config_util.jwt_expires_sec(),
+        white_list=white_list,
     )
     await ms.start()
 
@@ -59,6 +63,7 @@ async def run(args) -> None:
         max_volume_counts=counts,
         ec_backend=args.ec_backend,
         jwt_signing_key=jwt_key,
+        white_list=white_list,
     )
     await vs.start()
 

@@ -4,6 +4,7 @@ from __future__ import annotations
 import asyncio
 
 from ..utils import config as config_util
+from ..security import guard as guard_mod
 
 NAME = "volume"
 HELP = "start a volume server"
@@ -45,6 +46,11 @@ def add_args(p) -> None:
     p.add_argument(
         "-readMode", dest="read_mode", default="proxy",
         choices=["local", "proxy", "redirect"],
+    )
+    p.add_argument(
+        "-images.fix.orientation", dest="fix_jpg_orientation",
+        action="store_true",
+        help="rotate JPEG pixels per EXIF orientation at upload",
     )
     p.add_argument(
         "-tier.dir", dest="tier_dir", default="",
@@ -107,6 +113,8 @@ async def run(args) -> None:
         concurrent_upload_limit_mb=args.concurrent_upload_limit_mb,
         concurrent_download_limit_mb=args.concurrent_download_limit_mb,
         ec_device_cache_mb=args.ec_device_cache_mb,
+        white_list=guard_mod.from_security_toml(),
+        fix_jpg_orientation=args.fix_jpg_orientation,
     )
     await vs.start()
     await asyncio.Event().wait()

@@ -29,6 +29,13 @@ def resized(
         fmt = img.format
         if fmt not in ("PNG", "JPEG", "GIF"):
             return data
+        if fmt == "JPEG":
+            # turn the pixels upright BEFORE resizing: the re-encode drops
+            # EXIF, so an ignored orientation tag would serve thumbnails
+            # sideways (reference FixJpgOrientation, images/orientation.go)
+            from PIL import ImageOps
+
+            img = ImageOps.exif_transpose(img)
         ow, oh = img.size
         if width and height:
             if mode == "fit":

@@ -11,15 +11,26 @@ from __future__ import annotations
 
 import logging
 import time
+from collections import OrderedDict
 
 log = logging.getLogger("mount.meta")
 
 
 class MetaCache:
-    def __init__(self, ttl: float = 30.0):
+    def __init__(
+        self,
+        ttl: float = 30.0,
+        max_entries: int = 16384,
+        max_listings: int = 2048,
+    ):
         self.ttl = ttl
-        self._entries: dict[str, tuple[float, object]] = {}
-        self._listings: dict[str, tuple[float, list]] = {}
+        self.max_entries = max_entries
+        self.max_listings = max_listings
+        # LRU: get moves to end, overflow pops the front — a tree walk
+        # over millions of paths stays bounded instead of retaining every
+        # path ever touched
+        self._entries: OrderedDict[str, tuple[float, object]] = OrderedDict()
+        self._listings: OrderedDict[str, tuple[float, list]] = OrderedDict()
         self.hits = 0
         self.misses = 0
 
@@ -28,26 +39,38 @@ class MetaCache:
     def get_entry(self, path: str):
         hit = self._entries.get(path)
         if hit and time.monotonic() < hit[0]:
+            self._entries.move_to_end(path)
             self.hits += 1
             return hit[1]
+        if hit:  # expired: reclaim the slot
+            self._entries.pop(path, None)
         self.misses += 1
         return None
 
     def put_entry(self, path: str, entry) -> None:
         self._entries[path] = (time.monotonic() + self.ttl, entry)
+        self._entries.move_to_end(path)
+        while len(self._entries) > self.max_entries:
+            self._entries.popitem(last=False)
 
     # -- listings ------------------------------------------------------------
 
     def get_listing(self, directory: str):
         hit = self._listings.get(directory)
         if hit and time.monotonic() < hit[0]:
+            self._listings.move_to_end(directory)
             self.hits += 1
             return hit[1]
+        if hit:
+            self._listings.pop(directory, None)
         self.misses += 1
         return None
 
     def put_listing(self, directory: str, entries: list) -> None:
         self._listings[directory] = (time.monotonic() + self.ttl, entries)
+        self._listings.move_to_end(directory)
+        while len(self._listings) > self.max_listings:
+            self._listings.popitem(last=False)
 
     # -- invalidation --------------------------------------------------------
 

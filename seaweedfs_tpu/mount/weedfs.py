@@ -173,7 +173,10 @@ class WeedFS:
 
     async def _meta_loop(self) -> None:
         root = self.inodes.root
-        since = time.time_ns()
+        # back-date a minute: the filer stamps events with ITS clock, so a
+        # mount host running ahead would silently skip the first events.
+        # Replayed events are idempotent invalidations — cheap insurance.
+        since = time.time_ns() - 60_000_000_000
         while True:
             try:
                 async for ev in self._stub().SubscribeMetadata(
@@ -225,10 +228,13 @@ class WeedFS:
             raise
         if not resp.HasField("entry"):
             raise fk.FuseError(errno.ENOENT)
-        if not resp.entry.hard_link_id:
+        if not resp.entry.hard_link_id and not fresh:
             # hard-linked entries change through SIBLING names (the filer
             # republishes shared content/xattrs across the group), which
-            # path-keyed invalidation can't see — serve those fresh
+            # path-keyed invalidation can't see — serve those fresh.
+            # fresh=True lookups are about to be MUTATED by the caller
+            # (setattr/commit/truncate): caching that shared object would
+            # poison the cache if the update then fails.
             self.meta.put_entry(path, resp.entry)
         return resp.entry
 
@@ -739,6 +745,8 @@ class WeedFS:
     ) -> None:
         """Publish uploaded chunks into the entry (the dirty-pages commit
         half of dirty_pages_chunked.go saveChunkedFileIntervalToStorage)."""
+        from ..filer.filechunks import compact_file_chunks
+
         entry = await self._find(path, fresh=True)
         entry.chunks.extend(chunks)
         if entry.content and any(
@@ -748,6 +756,18 @@ class WeedFS:
             # the inlined head was folded into a newer chunk (seeding read
             # it); drop it or the read path would keep serving stale bytes
             entry.content = b""
+        # prune fully-shadowed chunks so rewrite-heavy files don't grow
+        # the entry forever; the filer GCs the dropped fids on update
+        # (filechunks.go CompactFileChunks role).  NEVER when manifest
+        # chunks are present: a manifest's declared span covers bytes
+        # reachable only through its children, so flat interval algebra
+        # would mark live manifests as garbage (the reference resolves
+        # manifests through a lookup fn before compacting).
+        if not any(c.is_chunk_manifest for c in entry.chunks):
+            compacted, garbage = compact_file_chunks(list(entry.chunks))
+            if garbage:
+                del entry.chunks[:]
+                entry.chunks.extend(compacted)
         entry.attributes.file_size = size
         entry.attributes.mtime = int(time.time())
         await self._update_entry(path, entry)

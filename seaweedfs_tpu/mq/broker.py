@@ -21,6 +21,7 @@ import grpc
 
 from ..pb import Stub, filer_pb2, generic_handler, mq_pb2
 from ..pb.rpc import GRPC_OPTIONS, channel
+from ..security import tls as tls_mod
 
 log = logging.getLogger("mq")
 
@@ -54,6 +55,39 @@ def _records_decode(blob: bytes):
         value = blob[pos + 20 + klen: pos + n]
         yield offset, key, value, ts_ns
         pos += n
+
+
+class NotAssignedHere(Exception):
+    """The balancer owns this partition on another broker."""
+
+    def __init__(self, partition: int, owner: str):
+        super().__init__(
+            f"partition {partition} is assigned to broker {owner}"
+        )
+        self.partition = partition
+        self.owner = owner
+
+
+class SingleBrokerBalancer:
+    """Partition -> broker assignment seam (reference mq/broker/balancer).
+
+    The default answers "this broker" for every partition — the
+    single-broker deployment the experimental reference broker also
+    serves — but every serving path (lookup, publish, subscribe) routes
+    through it, so a multi-broker assignment is an implementation of this
+    interface, not a rewrite of the broker."""
+
+    def __init__(self, local: str):
+        self.local = local
+
+    def broker_for(self, tkey: str, partition: int, partition_count: int) -> str:
+        return self.local
+
+    def brokers_for_topic(self, tkey: str, partition_count: int) -> list[str]:
+        return [
+            self.broker_for(tkey, i, partition_count)
+            for i in range(partition_count)
+        ]
 
 
 class Partition:
@@ -142,8 +176,10 @@ class MessageQueueBroker:
         ip: str = "127.0.0.1",
         port: int = 17777,  # grpc
         masters: list[str] | None = None,  # register as a broker in cluster.ps
+        balancer=None,  # partition->broker seam; default: single-broker
     ):
         self.masters = masters or []
+        self._balancer = balancer
         self._master_client = None
         host, _, p = filer_address.partition(":")
         self.filer_address = filer_address
@@ -160,6 +196,12 @@ class MessageQueueBroker:
         if self._session is None:
             self._session = aiohttp.ClientSession()
         return self._session
+
+    @property
+    def balancer(self):
+        if self._balancer is None:  # lazily: grpc_url needs the bound port
+            self._balancer = SingleBrokerBalancer(self.grpc_url)
+        return self._balancer
 
     @property
     def grpc_url(self) -> str:
@@ -180,7 +222,7 @@ class MessageQueueBroker:
         self._grpc_server.add_generic_rpc_handlers(
             [generic_handler(mq_pb2, "SeaweedMessaging", self)]
         )
-        self.port = self._grpc_server.add_insecure_port(f"{self.ip}:{self.port}")
+        self.port = tls_mod.add_port(self._grpc_server, f"{self.ip}:{self.port}")
         await self._grpc_server.start()
         self._flusher = asyncio.create_task(self._flush_loop())
         if self.masters:
@@ -329,15 +371,23 @@ class MessageQueueBroker:
             topic=request.topic,
             partition_count=len(parts),
             broker=self.grpc_url,
+            partition_brokers=self.balancer.brokers_for_topic(
+                tkey, len(parts)
+            ),
         )
 
     def _partition_for(self, parts: list[Partition], req) -> Partition:
         if req.partition >= 0:
             if req.partition >= len(parts):
                 raise IndexError(f"partition {req.partition} out of range")
-            return parts[req.partition]
-        key = bytes(req.data.key)
-        return parts[zlib.crc32(key) % len(parts)] if key else parts[0]
+            p = parts[req.partition]
+        else:
+            key = bytes(req.data.key)
+            p = parts[zlib.crc32(key) % len(parts)] if key else parts[0]
+        owner = self.balancer.broker_for(p.tkey, p.idx, len(parts))
+        if owner != self.grpc_url:
+            raise NotAssignedHere(p.idx, owner)
+        return p
 
     async def Publish(self, request_iterator, context):
         parts = None
@@ -352,7 +402,7 @@ class MessageQueueBroker:
                 continue  # init-only message
             try:
                 p = self._partition_for(parts, req)
-            except IndexError as e:
+            except (IndexError, NotAssignedHere) as e:
                 yield mq_pb2.PublishResponse(error=str(e))
                 continue
             offset = await p.append(bytes(req.data.key), bytes(req.data.value))
@@ -367,6 +417,13 @@ class MessageQueueBroker:
             or request.partition >= len(parts)
         ):
             yield mq_pb2.SubscribeResponse(error=f"unknown topic/partition {tkey}")
+            return
+        owner = self.balancer.broker_for(tkey, request.partition, len(parts))
+        if owner != self.grpc_url:
+            yield mq_pb2.SubscribeResponse(
+                error=f"partition {request.partition} is assigned to "
+                f"broker {owner}"
+            )
             return
         p = parts[request.partition]
         offset = request.start_offset

@@ -85,14 +85,41 @@ def generic_handler(pb2_module, service_name: str, servicer) -> grpc.GenericRpcH
 _channels: dict[str, grpc.aio.Channel] = {}
 
 
+def _tls_creds():
+    from ..security import tls
+
+    cfg = tls.configured()
+    return tls.channel_credentials(cfg) if cfg is not None else None
+
+
 def channel(address: str) -> grpc.aio.Channel:
-    """Shared insecure aio channel per address (the reference caches one
-    gRPC connection per server, pb/grpc_client_server.go)."""
+    """Shared aio channel per address (the reference caches one gRPC
+    connection per server, pb/grpc_client_server.go) — mTLS when
+    security.tls is configured, plaintext otherwise."""
     ch = _channels.get(address)
     if ch is None:
-        ch = grpc.aio.insecure_channel(address, options=GRPC_OPTIONS)
+        creds = _tls_creds()
+        if creds is not None:
+            ch = grpc.aio.secure_channel(address, creds, options=GRPC_OPTIONS)
+        else:
+            ch = grpc.aio.insecure_channel(address, options=GRPC_OPTIONS)
         _channels[address] = ch
     return ch
+
+
+def sync_channel(address: str) -> grpc.Channel:
+    """Uncached SYNC channel honoring the TLS config — for hooks that run
+    on worker threads (e.g. the volume server's remote shard reader)."""
+    creds = _tls_creds()
+    if creds is not None:
+        return grpc.secure_channel(address, creds, options=GRPC_OPTIONS)
+    return grpc.insecure_channel(address, options=GRPC_OPTIONS)
+
+
+def drop_cached_channels() -> None:
+    """Forget cached channels (without closing: callers may hold stubs).
+    Used when the TLS config changes so new dials pick it up."""
+    _channels.clear()
 
 
 async def close_all_channels() -> None:

@@ -5,9 +5,13 @@ hashicorp/raft; no such library exists in this image, so this is a
 from-scratch implementation of the Raft paper's core: randomized-timeout
 leader election, AppendEntries heartbeat + log replication with the
 conflict-backoff rule, majority commit with the current-term guard
-(§5.4.2), and durable term/vote/log.  Scope matches what the masters
-need — a replicated command log for volume-id/sequence allocation — not
-snapshots or membership change.
+(§5.4.2), durable term/vote/log, one-at-a-time membership change, and
+log-compacting snapshots (§7): past `snapshot_threshold` applied
+entries the state machine's snapshot replaces the log prefix, restarts
+replay O(snapshot)+tail instead of the whole history, and lagging or
+joining peers catch up via InstallSnapshot (the hashicorp snapshot
+store + restore plumbing the reference relies on,
+raft_hashicorp.go:60-120).
 
 All state transitions run on the asyncio loop (no thread races); RPCs
 ride the same descriptor-driven grpc.aio plumbing as every other
@@ -49,12 +53,18 @@ class RaftNode:
         heartbeat_interval: float = 0.1,
         dial_fn=None,  # peer id -> grpc address (default: identity)
         voter: bool = True,  # False: joining server — replicate, never campaign
+        snapshot_fn=None,  # () -> dict: state-machine snapshot at last_applied
+        restore_fn=None,  # (dict) -> None: install a snapshot's state
+        snapshot_threshold: int = 1000,  # log entries before compaction
     ):
         self.id = node_id
         self.voter = voter
         self.peers = [p for p in peers if p != node_id]
         self.dial_fn = dial_fn or (lambda a: a)
         self.apply_fn = apply_fn
+        self.snapshot_fn = snapshot_fn
+        self.restore_fn = restore_fn
+        self.snapshot_threshold = snapshot_threshold
         self.data_dir = data_dir
         self.election_timeout = election_timeout
         self.heartbeat_interval = heartbeat_interval
@@ -62,8 +72,12 @@ class RaftNode:
         self.state = FOLLOWER
         self.term = 0
         self.voted_for: str | None = None
-        # log[0] is a sentinel (term 0, index 0)
+        # log[0] is a sentinel at the snapshot point (term 0, index 0
+        # when no snapshot); entry index i lives at log[i - snapshot_index]
         self.log: list[tuple[int, int, bytes]] = [(0, 0, b"")]
+        self.snapshot_index = 0
+        self.snapshot_term = 0
+        self._snapshot_state: dict | None = None  # last snapshot, for peers
         self.commit_index = 0
         self.last_applied = 0
         self.leader_id: str | None = None
@@ -86,7 +100,21 @@ class RaftNode:
     def _log_path(self) -> str:
         return os.path.join(self.data_dir, "raft_log.jsonl")
 
+    def _snapshot_path(self) -> str:
+        return os.path.join(self.data_dir, "raft_snapshot.json")
+
     def _load(self) -> None:
+        # snapshot FIRST: raft_state.json may hold membership/voter that
+        # changed after the snapshot was taken, so its values must win
+        try:
+            with open(self._snapshot_path()) as f:
+                snap = json.load(f)
+            self._install_local_snapshot(
+                snap["index"], snap["term"], snap.get("members"),
+                snap["state"],
+            )
+        except (OSError, ValueError, KeyError):
+            pass
         try:
             with open(self._state_path()) as f:
                 st = json.load(f)
@@ -101,11 +129,52 @@ class RaftNode:
             with open(self._log_path()) as f:
                 for line in f:
                     e = json.loads(line)
+                    if e["i"] <= self.snapshot_index:
+                        continue  # compacted away
                     self.log.append(
                         (e["t"], e["i"], base64.b64decode(e["c"]))
                     )
         except (OSError, ValueError, KeyError):
             pass
+
+    def _install_local_snapshot(
+        self, index: int, term: int, members: list[str] | None, state: dict
+    ) -> None:
+        """Adopt a snapshot as the new log base (shared by restart load
+        and leader-pushed InstallSnapshot)."""
+        self.snapshot_index = index
+        self.snapshot_term = term
+        self._snapshot_state = state
+        self.log = [(term, index, b"")]
+        self.commit_index = max(self.commit_index, index)
+        self.last_applied = max(self.last_applied, index)
+        if members is not None:
+            self.peers = [m for m in members if not self.same_node(m, self.id)]
+            if any(self.same_node(m, self.id) for m in members):
+                self.voter = True
+        if self.restore_fn is not None:
+            try:
+                self.restore_fn(state)
+            except Exception:  # noqa: BLE001
+                log.exception("snapshot restore failed at index %d", index)
+
+    def _persist_snapshot(self) -> None:
+        if not self.data_dir:
+            return
+        tmp = self._snapshot_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    "index": self.snapshot_index,
+                    "term": self.snapshot_term,
+                    "members": [self.id] + self.peers,
+                    "state": self._snapshot_state,
+                },
+                f,
+            )
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._snapshot_path())
 
     def _persist_state(self) -> None:
         if not self.data_dir:
@@ -162,6 +231,14 @@ class RaftNode:
     def last_log(self) -> tuple[int, int]:
         t_, i, _ = self.log[-1]
         return i, t_
+
+    def _at(self, index: int) -> tuple[int, int, bytes]:
+        """Log entry by ABSOLUTE index (the sentinel sits at
+        snapshot_index)."""
+        return self.log[index - self.snapshot_index]
+
+    def _has(self, index: int) -> bool:
+        return self.snapshot_index <= index <= self.last_log()[0]
 
     def _stub(self, peer: str) -> Stub:
         s = self._stub_cache.get(peer)
@@ -344,11 +421,18 @@ class RaftNode:
         self._advance_commit()
 
     async def _replicate(self, peer: str) -> None:
-        ni = self.next_index.get(peer, 1)
-        prev = self.log[ni - 1]
+        ni = self.next_index.get(peer, self.snapshot_index + 1)
+        if ni <= self.snapshot_index:
+            if self._snapshot_state is not None:
+                # the entries this peer needs are compacted away: ship
+                # the snapshot instead (raft §7 InstallSnapshot)
+                await self._send_snapshot(peer)
+                return
+            ni = self.snapshot_index + 1
+        prev = self._at(ni - 1)
         entries = [
             raft_pb2.LogEntry(term=t_, index=i, command=c)
-            for t_, i, c in self.log[ni:]
+            for t_, i, c in self.log[ni - self.snapshot_index:]
         ]
         try:
             resp = await asyncio.wait_for(
@@ -372,12 +456,15 @@ class RaftNode:
             self.match_index[peer] = resp.match_index
             self.next_index[peer] = resp.match_index + 1
         else:
-            self.next_index[peer] = max(1, ni - 1)  # conflict backoff
+            # conflict backoff; backing off TO the snapshot boundary
+            # flips the next round to InstallSnapshot
+            floor = self.snapshot_index if self._snapshot_state else 1
+            self.next_index[peer] = max(floor, ni - 1)
 
     def _advance_commit(self) -> None:
         li, _ = self.last_log()
         for n in range(self.commit_index + 1, li + 1):
-            if self.log[n][0] != self.term:
+            if self._at(n)[0] != self.term:
                 continue  # only current-term entries commit by counting (§5.4.2)
             replicated = 1 + sum(
                 1 for p in self.peers if self.match_index.get(p, 0) >= n
@@ -389,7 +476,7 @@ class RaftNode:
     def _apply_committed(self) -> None:
         while self.last_applied < self.commit_index:
             self.last_applied += 1
-            t_, i, c = self.log[self.last_applied]
+            t_, i, c = self._at(self.last_applied)
             if c:
                 # own_live: this node proposed the entry in its current
                 # leadership — state machines can skip self-adjustments
@@ -408,6 +495,66 @@ class RaftNode:
                         fut.set_result(None)
                     else:
                         fut.set_exception(NotLeader(self.leader_id))
+        self._maybe_snapshot()
+
+    # ------------------------------------------------------------- snapshots
+
+    def _maybe_snapshot(self) -> None:
+        if (
+            self.snapshot_fn is None
+            or len(self.log) - 1 <= self.snapshot_threshold
+            or self.last_applied <= self.snapshot_index
+        ):
+            return
+        self.take_snapshot()
+
+    def take_snapshot(self) -> None:
+        """Compact the log at last_applied: the state machine's snapshot
+        replaces every entry at or below it (§7; the reference's
+        hashicorp snapshot store role)."""
+        index = self.last_applied
+        term = self._at(index)[0] if index > self.snapshot_index else self.snapshot_term
+        try:
+            state = self.snapshot_fn()
+        except Exception:  # noqa: BLE001 — never kill raft for a snapshot
+            log.exception("snapshot_fn failed; keeping full log")
+            return
+        tail = self.log[index - self.snapshot_index + 1:]
+        self.log = [(term, index, b"")] + tail
+        self.snapshot_index = index
+        self.snapshot_term = term
+        self._snapshot_state = state
+        self._persist_snapshot()
+        self._persist_log_rewrite()
+        log.info(
+            "%s: snapshot at index %d (log now %d entries)",
+            self.id, index, len(self.log) - 1,
+        )
+
+    async def _send_snapshot(self, peer: str) -> None:
+        try:
+            resp = await asyncio.wait_for(
+                self._stub(peer).InstallSnapshot(
+                    raft_pb2.SnapshotRequest(
+                        term=self.term,
+                        leader_id=self.id,
+                        last_included_index=self.snapshot_index,
+                        last_included_term=self.snapshot_term,
+                        members=[self.id] + self.peers,
+                        state=json.dumps(self._snapshot_state).encode(),
+                    )
+                ),
+                timeout=self.heartbeat_interval * 10,
+            )
+        except (grpc.aio.AioRpcError, asyncio.TimeoutError):
+            return
+        if resp.term > self.term:
+            self._become_follower(resp.term)
+            return
+        if self.state != LEADER:
+            return
+        self.match_index[peer] = self.snapshot_index
+        self.next_index[peer] = self.snapshot_index + 1
 
     # ------------------------------------------------------------ rpc handlers
 
@@ -429,18 +576,23 @@ class RaftNode:
         if request.term < self.term:
             return raft_pb2.AppendResponse(term=self.term, success=False)
         self._become_follower(request.term, leader=request.leader_id)
-        # log consistency check
+        # log consistency check.  A prev BELOW our snapshot point is
+        # consistent by construction: snapshots only cover committed
+        # entries, which every legitimate leader's log matches.
         pli, plt = request.prev_log_index, request.prev_log_term
-        if pli >= len(self.log) or self.log[pli][0] != plt:
-            return raft_pb2.AppendResponse(term=self.term, success=False)
+        if pli >= self.snapshot_index:
+            if not self._has(pli) or self._at(pli)[0] != plt:
+                return raft_pb2.AppendResponse(term=self.term, success=False)
         # append, truncating conflicts; plain appends persist by appending
         # (a full rewrite per batch would be O(n^2) across the log's life)
         truncated = False
         appended: list[tuple[int, int, bytes]] = []
         for e in request.entries:
-            if e.index < len(self.log):
-                if self.log[e.index][0] != e.term:
-                    del self.log[e.index:]
+            if e.index <= self.snapshot_index:
+                continue  # already compacted into the snapshot
+            if e.index <= self.last_log()[0]:
+                if self._at(e.index)[0] != e.term:
+                    del self.log[e.index - self.snapshot_index:]
                     truncated = True
                 else:
                     continue
@@ -462,3 +614,28 @@ class RaftNode:
             success=True,
             match_index=request.prev_log_index + len(request.entries),
         )
+
+    async def InstallSnapshot(self, request, context):
+        if request.term < self.term:
+            return raft_pb2.SnapshotResponse(term=self.term)
+        self._become_follower(request.term, leader=request.leader_id)
+        if request.last_included_index <= self.snapshot_index:
+            return raft_pb2.SnapshotResponse(term=self.term)  # stale
+        self._install_local_snapshot(
+            request.last_included_index,
+            request.last_included_term,
+            list(request.members) or None,
+            json.loads(request.state),
+        )
+        # state BEFORE snapshot: _load gives raft_state.json's membership
+        # precedence, so a crash between the two writes must never leave a
+        # newer snapshot beside older state (pre-snapshot peers would be
+        # resurrected with no config entry left in the log to fix them)
+        self._persist_state()
+        self._persist_snapshot()
+        self._persist_log_rewrite()  # log restarts from the snapshot point
+        log.info(
+            "%s: installed snapshot at index %d from %s",
+            self.id, self.snapshot_index, request.leader_id,
+        )
+        return raft_pb2.SnapshotResponse(term=self.term)

@@ -1,0 +1,171 @@
+"""security.toml-driven mutual TLS for the gRPC control plane.
+
+Reference: weed/security/tls.go — every gRPC surface (master, volume,
+filer, raft, mq) loads cert/key/CA from security.toml and requires
+verified client certificates; clients present their own cert from the
+same file.  Mirrored here as process-global state (the reference's
+security.toml is process-global too): `configure()` once at startup,
+after which `add_port()` binds secure listeners and pb/rpc.py's channel
+helpers hand out mTLS channels.
+
+security.toml shape (see command/scaffold.py):
+
+    [tls]
+    ca   = "/etc/seaweedfs/ca.crt"
+    cert = "/etc/seaweedfs/server.crt"
+    key  = "/etc/seaweedfs/server.key"
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import grpc
+
+
+@dataclasses.dataclass(frozen=True)
+class TlsConfig:
+    ca: str  # CA bundle path (verifies peers both ways)
+    cert: str  # this process's certificate path
+    key: str  # this process's private key path
+
+    def read(self) -> tuple[bytes, bytes, bytes]:
+        with open(self.ca, "rb") as f:
+            ca = f.read()
+        with open(self.cert, "rb") as f:
+            cert = f.read()
+        with open(self.key, "rb") as f:
+            key = f.read()
+        return ca, cert, key
+
+
+_config: TlsConfig | None = None
+
+
+def configure(cfg: TlsConfig | None) -> None:
+    """Set (or clear) the process-wide TLS config.  Existing cached
+    channels are dropped so new dials pick up the change."""
+    global _config
+    _config = cfg
+    from ..pb import rpc
+
+    rpc.drop_cached_channels()
+
+
+def configured() -> TlsConfig | None:
+    return _config
+
+
+def from_security_toml(dirs=None) -> TlsConfig | None:
+    """[tls] section of security.toml -> TlsConfig (None when absent)."""
+    from ..utils import config as config_util
+
+    kw = {"dirs": dirs} if dirs else {}
+    cfg = config_util.load_config("security", **kw)
+    section = cfg.get("tls") or {}
+    if section.get("cert") and section.get("key") and section.get("ca"):
+        return TlsConfig(
+            ca=section["ca"], cert=section["cert"], key=section["key"]
+        )
+    return None
+
+
+def server_credentials(cfg: TlsConfig) -> grpc.ServerCredentials:
+    ca, cert, key = cfg.read()
+    return grpc.ssl_server_credentials(
+        [(key, cert)],
+        root_certificates=ca,
+        require_client_auth=True,  # mutual TLS, like the reference
+    )
+
+
+def channel_credentials(cfg: TlsConfig) -> grpc.ChannelCredentials:
+    ca, cert, key = cfg.read()
+    return grpc.ssl_channel_credentials(
+        root_certificates=ca, private_key=key, certificate_chain=cert
+    )
+
+
+def add_port(server, address: str) -> int:
+    """Bind a gRPC server port — secure when TLS is configured, insecure
+    otherwise.  The one call every server's start() makes."""
+    if _config is not None:
+        return server.add_secure_port(address, server_credentials(_config))
+    return server.add_insecure_port(address)
+
+
+def generate_test_pki(directory: str, hosts=("127.0.0.1", "localhost")) -> TlsConfig:
+    """Self-signed CA + one server/client cert for tests and scaffolding
+    (the reference points users at openssl; in-process generation keeps
+    the e2e TLS test hermetic)."""
+    import datetime
+    import ipaddress as ipa
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    os.makedirs(directory, exist_ok=True)
+    now = datetime.datetime.now(datetime.timezone.utc)
+
+    def make_key():
+        return rsa.generate_private_key(public_exponent=65537, key_size=2048)
+
+    ca_key = make_key()
+    ca_name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, "seaweedfs-test-ca")]
+    )
+    ca_cert = (
+        x509.CertificateBuilder()
+        .subject_name(ca_name)
+        .issuer_name(ca_name)
+        .public_key(ca_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(x509.BasicConstraints(ca=True, path_length=None), True)
+        .sign(ca_key, hashes.SHA256())
+    )
+
+    leaf_key = make_key()
+    san = []
+    for h in hosts:
+        try:
+            san.append(x509.IPAddress(ipa.ip_address(h)))
+        except ValueError:
+            san.append(x509.DNSName(h))
+    leaf_cert = (
+        x509.CertificateBuilder()
+        .subject_name(
+            x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, hosts[-1])])
+        )
+        .issuer_name(ca_name)
+        .public_key(leaf_key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(x509.SubjectAlternativeName(san), False)
+        .sign(ca_key, hashes.SHA256())
+    )
+
+    paths = {}
+    for name, data in (
+        ("ca.crt", ca_cert.public_bytes(serialization.Encoding.PEM)),
+        ("server.crt", leaf_cert.public_bytes(serialization.Encoding.PEM)),
+        (
+            "server.key",
+            leaf_key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.TraditionalOpenSSL,
+                serialization.NoEncryption(),
+            ),
+        ),
+    ):
+        p = os.path.join(directory, name)
+        with open(p, "wb") as f:
+            f.write(data)
+        paths[name] = p
+    return TlsConfig(
+        ca=paths["ca.crt"], cert=paths["server.crt"], key=paths["server.key"]
+    )

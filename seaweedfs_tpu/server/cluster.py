@@ -34,6 +34,7 @@ class LocalCluster:
         jwt_signing_key: str = "",
         tier_backends: dict | None = None,  # default: local backend in base_dir/tier
         disk_types: list[str] | None = None,  # per-directory, all servers
+        master_kwargs: dict | None = None,
     ):
         import os
 
@@ -41,6 +42,7 @@ class LocalCluster:
             port=0, volume_size_limit_mb=volume_size_limit_mb,
             pulse_seconds=pulse_seconds,
             jwt_signing_key=jwt_signing_key,
+            **(master_kwargs or {}),
         )
         self.jwt_signing_key = jwt_signing_key
         self.with_filer = with_filer or with_s3 or with_webdav or with_iam

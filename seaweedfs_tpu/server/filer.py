@@ -47,6 +47,8 @@ from ..operation.assign import assign as assign_rpc
 from ..operation.delete import delete_files
 from ..operation.upload import upload_data
 from ..pb import Stub, channel, filer_pb2, generic_handler, master_pb2, server_address
+from ..security import tls as tls_mod
+from ..security import guard as guard_mod
 from ..pb.rpc import GRPC_OPTIONS
 from ..wdclient import MasterClient
 
@@ -76,8 +78,10 @@ class FilerServer:
         chunk_cache_dir: str | None = None,
         notifier=None,  # replication.notification.Notifier
         upload_parallelism: int = 4,  # concurrent chunk uploads per file
+        white_list: list[str] | None = None,  # [access] white_list guard
     ):
         self.masters = masters
+        self.guard = guard_mod.Guard(white_list)
         self.ip = ip
         self.port = port
         self.grpc_port = grpc_port or (port + 10000 if port else 0)
@@ -166,12 +170,17 @@ class FilerServer:
         self._grpc_server.add_generic_rpc_handlers(
             [generic_handler(filer_pb2, "SeaweedFiler", self)]
         )
-        self.grpc_port = self._grpc_server.add_insecure_port(
-            f"{self.ip}:{self.grpc_port}"
+        self.grpc_port = tls_mod.add_port(
+            self._grpc_server, f"{self.ip}:{self.grpc_port}"
         )
         await self._grpc_server.start()
 
-        app = web.Application(client_max_size=1024 * 1024 * 1024)
+        app = web.Application(
+            client_max_size=1024 * 1024 * 1024,
+            middlewares=(
+                [guard_mod.middleware(self.guard)] if self.guard.enabled else []
+            ),
+        )
         app.router.add_route("*", "/{path:.*}", self._http_dispatch)
         self._http_runner = web.AppRunner(app)
         await self._http_runner.setup()

@@ -33,6 +33,8 @@ from .. import stats
 from ..pb import Stub, generic_handler, master_pb2, raft_pb2, server_address, volume_server_pb2
 from ..pb.rpc import GRPC_OPTIONS, channel
 from ..security import gen_volume_write_jwt
+from ..security import tls as tls_mod
+from ..security import guard as guard_mod
 from ..storage import types as t
 from ..topology import (
     MemorySequencer,
@@ -85,8 +87,12 @@ class MasterServer:
         peers: list[str] | None = None,  # other masters' advertise urls
         meta_dir: str | None = None,  # durable raft state directory
         raft_join: bool = False,  # start as non-voter until cluster.raft.add
+        raft_snapshot_threshold: int = 1000,  # log entries before compaction
+        white_list: list[str] | None = None,  # [access] white_list guard
     ):
         self.raft_join = raft_join
+        self.guard = guard_mod.Guard(white_list)
+        self.raft_snapshot_threshold = raft_snapshot_threshold
         self.ip = ip
         self.port = port
         self.grpc_port = grpc_port or (port + 10000 if port else 0)
@@ -145,12 +151,17 @@ class MasterServer:
         self._grpc_server.add_generic_rpc_handlers(
             [generic_handler(raft_pb2, "SeaweedRaft", self)]
         )
-        self.grpc_port = self._grpc_server.add_insecure_port(
-            f"{self.ip}:{self.grpc_port}"
+        self.grpc_port = tls_mod.add_port(
+            self._grpc_server, f"{self.ip}:{self.grpc_port}"
         )
         await self._grpc_server.start()
 
-        app = web.Application(client_max_size=256 * 1024 * 1024)
+        app = web.Application(
+            client_max_size=256 * 1024 * 1024,
+            middlewares=(
+                [guard_mod.middleware(self.guard)] if self.guard.enabled else []
+            ),
+        )
         app.router.add_route("*", "/dir/assign", self.h_assign)
         app.router.add_route("*", "/dir/lookup", self.h_lookup)
         app.router.add_get("/dir/status", self.h_dir_status)
@@ -186,6 +197,9 @@ class MasterServer:
             data_dir=self.meta_dir,
             dial_fn=server_address.grpc_address,
             voter=not self.raft_join,
+            snapshot_fn=self._raft_snapshot,
+            restore_fn=self._raft_restore,
+            snapshot_threshold=self.raft_snapshot_threshold,
         )
         await self.raft.start()
 
@@ -221,6 +235,25 @@ class MasterServer:
         if self.raft is None or self.raft.leader_id is None:
             return self.advertise_url
         return self.raft.leader_id
+
+    def _raft_snapshot(self) -> dict:
+        """State-machine snapshot at the raft apply point: the allocation
+        ceilings every future leader must start past (membership is
+        carried by the raft layer itself).  Reference analogue: the
+        hashicorp snapshot of MaxVolumeId state, raft_hashicorp.go."""
+        return {
+            "max_vid": self.topo.max_volume_id,
+            "seq_ceiling": self._seq_committed,
+        }
+
+    def _raft_restore(self, st: dict) -> None:
+        self.topo.max_volume_id = max(
+            self.topo.max_volume_id, int(st.get("max_vid", 0))
+        )
+        ceiling = int(st.get("seq_ceiling", 0))
+        if ceiling:
+            self.topo.sequencer.set_max(ceiling)
+            self._seq_committed = max(self._seq_committed, ceiling)
 
     def _apply_raft(self, cmd: dict, term: int = 0, own_live: bool = False) -> None:
         """Raft state machine: allocation ceilings replicated so any

@@ -15,6 +15,7 @@ import grpc
 from aiohttp import web
 
 from ..pb import Stub, channel, generic_handler, master_pb2, server_address
+from ..security import tls as tls_mod
 from ..pb.rpc import GRPC_OPTIONS
 from ..wdclient import MasterClient
 
@@ -53,8 +54,8 @@ class MasterFollowerServer:
         self._grpc_server.add_generic_rpc_handlers(
             [generic_handler(master_pb2, "Seaweed", self)]
         )
-        self.grpc_port = self._grpc_server.add_insecure_port(
-            f"{self.ip}:{self.grpc_port}"
+        self.grpc_port = tls_mod.add_port(
+            self._grpc_server, f"{self.ip}:{self.grpc_port}"
         )
         await self._grpc_server.start()
 

@@ -44,6 +44,8 @@ from ..storage.ec import (
 )
 from .. import stats
 from ..security import verify_volume_write_jwt
+from ..security import tls as tls_mod
+from ..security import guard as guard_mod
 from ..storage.needle import CrcError, Needle
 from ..storage.store import Store
 from ..storage.volume import CookieMismatch, NotFoundError, Volume, VolumeReadOnly
@@ -198,7 +200,11 @@ class VolumeServer:
         concurrent_download_limit_mb: int = 0,
         disk_types: list[str] | None = None,  # per-directory (ref -disk flag)
         ec_device_cache_mb: int = 0,  # >0: pin mounted EC shards in HBM
+        white_list: list[str] | None = None,  # [access] white_list guard
+        fix_jpg_orientation: bool = False,  # ref -images.fix.orientation
     ):
+        self.fix_jpg_orientation = fix_jpg_orientation
+        self.guard = guard_mod.Guard(white_list)
         if tier_backends:
             from ..storage import backend as backend_mod
 
@@ -272,13 +278,16 @@ class VolumeServer:
         self._grpc_server.add_generic_rpc_handlers(
             [generic_handler(volume_server_pb2, "VolumeServer", self)]
         )
-        self.grpc_port = self._grpc_server.add_insecure_port(
-            f"{self.ip}:{self.grpc_port}"
+        self.grpc_port = tls_mod.add_port(
+            self._grpc_server, f"{self.ip}:{self.grpc_port}"
         )
         await self._grpc_server.start()
 
         app = web.Application(
-            client_max_size=self.client_max_size_mb * 1024 * 1024
+            client_max_size=self.client_max_size_mb * 1024 * 1024,
+            middlewares=(
+                [guard_mod.middleware(self.guard)] if self.guard.enabled else []
+            ),
         )
         app.router.add_get("/status", self.h_status)
         app.router.add_get("/metrics", stats.metrics_handler)
@@ -661,6 +670,19 @@ class VolumeServer:
             name, mime, data, compressed = self._parse_upload(
                 request.headers.get("Content-Type", ""), body
             )
+            if (
+                self.fix_jpg_orientation
+                and not compressed
+                and (
+                    mime == b"image/jpeg"
+                    or (name or b"").lower().endswith((b".jpg", b".jpeg"))
+                )
+            ):
+                # turn pixels upright at ingest (reference needle.go:104
+                # images.FixJpgOrientation, behind -images.fix.orientation)
+                from ..images.orientation import fix_orientation
+
+                data = await asyncio.to_thread(fix_orientation, data)
             from ..storage.needle import FLAG_IS_COMPRESSED
 
             n = Needle(
@@ -832,7 +854,9 @@ class VolumeServer:
             locations = self._cached_ec_locations(vid)
             for addr in locations.get(shard_id, []):
                 try:
-                    ch = grpc.insecure_channel(addr, options=GRPC_OPTIONS)
+                    from ..pb.rpc import sync_channel
+
+                    ch = sync_channel(addr)
                     stub = Stub(ch, volume_server_pb2, "VolumeServer")
                     chunks = []
                     for resp in stub.VolumeEcShardRead(
@@ -861,8 +885,10 @@ class VolumeServer:
             from ..pb import server_address
 
             try:
-                ch = grpc.insecure_channel(
-                    server_address.grpc_address(self.current_master), options=GRPC_OPTIONS
+                from ..pb.rpc import sync_channel
+
+                ch = sync_channel(
+                    server_address.grpc_address(self.current_master)
                 )
                 stub = Stub(ch, master_pb2, "Seaweed")
                 resp = stub.LookupEcVolume(

@@ -182,3 +182,58 @@ def test_broker_registers_with_master(tmp_path):
             await cluster.stop()
 
     run(go())
+
+
+def test_balancer_seam_routes_partitions(tmp_path):
+    """Partition->broker assignment goes through the balancer interface:
+    a fake two-broker assignment makes this broker refuse the partitions
+    it doesn't own and advertise the owner in lookups (reference
+    mq/broker/balancer as a seam, not a hardcoded self-answer)."""
+
+    async def go():
+        cluster, broker = await make(tmp_path)
+
+        class TwoBrokerBalancer:
+            """Even partitions live here, odd ones on a phantom peer."""
+
+            def __init__(self, local):
+                self.local = local
+
+            def broker_for(self, tkey, partition, partition_count):
+                return self.local if partition % 2 == 0 else "other:19999"
+
+            def brokers_for_topic(self, tkey, n):
+                return [self.broker_for(tkey, i, n) for i in range(n)]
+
+        try:
+            from seaweedfs_tpu.mq.client import MqClient
+
+            client = MqClient(broker.grpc_url)
+            topic = MqClient.topic("t", "ns")
+            await client.configure_topic(topic, partition_count=2)
+            broker._balancer = TwoBrokerBalancer(broker.grpc_url)
+
+            # lookup advertises the per-partition assignment
+            from seaweedfs_tpu.pb import Stub, mq_pb2
+            from seaweedfs_tpu.pb.rpc import channel
+
+            stub = Stub(channel(broker.grpc_url), mq_pb2, "SeaweedMessaging")
+            resp = await stub.LookupTopicBrokers(
+                mq_pb2.LookupTopicBrokersRequest(topic=topic)
+            )
+            assert list(resp.partition_brokers) == [
+                broker.grpc_url, "other:19999",
+            ]
+
+            # publishing to the owned partition works; the foreign one is
+            # refused with the owner named
+            out = await client.publish(topic, [(b"k", b"v")], partition=0)
+            assert out == [(0, 0)]
+            with pytest.raises(RuntimeError) as ei:
+                await client.publish(topic, [(b"k", b"v")], partition=1)
+            assert "other:19999" in str(ei.value)
+        finally:
+            await broker.stop()
+            await cluster.stop()
+
+    run(go())

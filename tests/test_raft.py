@@ -19,13 +19,25 @@ def run(coro):
 
 
 class Harness:
-    def __init__(self, tmp_path, n=3):
+    def __init__(self, tmp_path, n=3, snapshot_threshold=None):
         self.tmp_path = tmp_path
         self.n = n
+        self.snapshot_threshold = snapshot_threshold
         self.nodes: dict[str, RaftNode] = {}
         self.servers: dict[str, grpc.aio.Server] = {}
         self.applied: dict[str, list] = {}
+        self.restored: dict[str, dict] = {}
+        self.base_counts: dict[str, int] = {}
         self.addrs: list[str] = []
+
+    def _snapshot_of(self, addr):
+        return {
+            "count": self.base_counts.get(addr, 0) + len(self.applied[addr])
+        }
+
+    def _restore(self, addr, st):
+        self.restored[addr] = st
+        self.base_counts[addr] = st["count"]
 
     async def start(self):
         # reserve ports first so peers lists are complete
@@ -38,18 +50,27 @@ class Harness:
         for i, addr in enumerate(self.addrs):
             await self.spawn(i, addr, fresh=True)
 
-    async def spawn(self, i, addr, fresh=False):
+    async def spawn(self, i, addr, fresh=False, **node_kwargs):
         if not fresh:
             server = grpc.aio.server(options=GRPC_OPTIONS)
             server.add_insecure_port(addr)
             self.servers[addr] = server
         self.applied.setdefault(addr, [])
+        if self.snapshot_threshold is not None:
+            node_kwargs.setdefault("snapshot_threshold", self.snapshot_threshold)
+            node_kwargs.setdefault(
+                "snapshot_fn", lambda a=addr: self._snapshot_of(a)
+            )
+            node_kwargs.setdefault(
+                "restore_fn", lambda st, a=addr: self._restore(a, st)
+            )
         node = RaftNode(
             addr, list(self.addrs),
             apply_fn=lambda cmd, a=addr, **kw: self.applied[a].append(cmd),
             data_dir=str(self.tmp_path / f"raft-{i}"),
             election_timeout=(0.15, 0.3),
             heartbeat_interval=0.04,
+            **node_kwargs,
         )
         self.nodes[addr] = node
         self.servers[addr].add_generic_rpc_handlers(
@@ -135,6 +156,94 @@ def test_restart_recovers_durable_state(tmp_path):
             await asyncio.sleep(0.4)
             assert [c["n"] for c in h.applied[addr]] == [0, 1, 2]
             assert node.term >= leader.term
+        finally:
+            await h.stop()
+
+    run(go())
+
+
+def test_snapshot_compacts_log_and_restart_replays_tail(tmp_path):
+    """Past the threshold the log is replaced by a snapshot; a restart
+    replays O(snapshot)+tail instead of the whole history (VERDICT
+    round-2 'done' condition for raft snapshots)."""
+
+    async def go():
+        h = Harness(tmp_path, n=1, snapshot_threshold=50)
+        await h.start()
+        try:
+            (leader,) = h.nodes.values()
+            total = 300
+            for i in range(total):
+                await leader.propose({"n": i})
+            addr = leader.id
+            # the log was compacted — far below the command count
+            assert len(leader.log) - 1 <= 60, len(leader.log)
+            assert leader.snapshot_index > 0
+            assert len(h.applied[addr]) == total
+
+            # restart from disk: restore_fn gets the snapshot, and only
+            # the tail beyond it re-applies
+            await h.kill(addr)
+            h.applied[addr] = []
+            h.base_counts.pop(addr, None)
+            node = await h.spawn(0, addr)
+            await asyncio.sleep(0.5)
+            assert addr in h.restored, "restart never restored a snapshot"
+            replayed = len(h.applied[addr])
+            assert replayed <= 60, f"replayed {replayed} entries"
+            assert h.restored[addr]["count"] + replayed == total
+            assert node.snapshot_index > 0
+        finally:
+            await h.stop()
+
+    run(go())
+
+
+def test_lagging_follower_catches_up_via_installsnapshot(tmp_path):
+    """A wiped/joining follower whose needed entries were compacted away
+    receives the leader's snapshot, then the tail."""
+
+    async def go():
+        h = Harness(tmp_path, n=3, snapshot_threshold=20)
+        await h.start()
+        try:
+            leader = await h.wait_leader()
+            victim = next(a for a in h.addrs if a != leader.id)
+            vidx = h.addrs.index(victim)
+            await h.kill(victim)
+
+            total = 120
+            for i in range(total):
+                await leader.propose({"n": i})
+            assert leader.snapshot_index > 0
+
+            # wipe the victim's disk: it returns knowing nothing
+            import shutil
+
+            shutil.rmtree(str(tmp_path / f"raft-{vidx}"))
+            h.applied[victim] = []
+            h.base_counts.pop(victim, None)
+            await h.spawn(vidx, victim)
+
+            deadline = asyncio.get_event_loop().time() + 8
+            while True:
+                have = h.base_counts.get(victim, 0) + len(h.applied[victim])
+                if have == total and victim in h.restored:
+                    break
+                if asyncio.get_event_loop().time() > deadline:
+                    raise AssertionError(
+                        f"victim has {have}/{total}, restored="
+                        f"{victim in h.restored}"
+                    )
+                await asyncio.sleep(0.1)
+            # and it keeps up with NEW entries after the snapshot.  The
+            # rejoining node's election-timeout campaign may have bumped
+            # the term and moved leadership (no pre-vote here, like raft
+            # without the §9.6 extension) — re-acquire the leader.
+            leader = await h.wait_leader()
+            await leader.propose({"n": total})
+            await asyncio.sleep(0.3)
+            assert h.applied[victim][-1] == {"n": total}
         finally:
             await h.stop()
 
