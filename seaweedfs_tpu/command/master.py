@@ -52,7 +52,13 @@ def add_args(p) -> None:
 
 async def run(args) -> None:
     from ..server.master import MasterServer
+    from ..storage import types as storage_types
 
+    if args.volume_size_limit_mb * 1024 * 1024 > storage_types.MAX_POSSIBLE_VOLUME_SIZE:
+        # volumes past the 4-byte 32GB address cap need 5-byte needle-map
+        # offsets (reference 5BytesOffset build tag, offset_5bytes.go) —
+        # a deployment-wide mode every node must share
+        storage_types.set_offset_size(5)
     ms = MasterServer(
         ip=args.ip,
         port=args.port,

@@ -37,6 +37,10 @@ async def run(args) -> None:
     from ..server.volume import VolumeServer
 
     from ..security import guard as guard_mod
+    from ..storage import types as storage_types
+
+    if args.volume_size_limit_mb * 1024 * 1024 > storage_types.MAX_POSSIBLE_VOLUME_SIZE:
+        storage_types.set_offset_size(5)  # see command/master.py
 
     jwt_key = config_util.jwt_signing_key()
     white_list = guard_mod.from_security_toml()

@@ -53,6 +53,13 @@ def add_args(p) -> None:
         help="rotate JPEG pixels per EXIF orientation at upload",
     )
     p.add_argument(
+        "-offset.bytes", dest="offset_bytes", type=int, default=4,
+        choices=[4, 5],
+        help="needle-map offset width: 5 raises the volume cap from 32GB "
+        "to 8TB (reference 5BytesOffset build tag; must match the whole "
+        "deployment — .idx/.ecx files are not readable across modes)",
+    )
+    p.add_argument(
         "-tier.dir", dest="tier_dir", default="",
         help="directory backing the 'local.default' tier storage backend",
     )
@@ -80,6 +87,10 @@ def add_args(p) -> None:
 async def run(args) -> None:
     from ..server.volume import VolumeServer
 
+    if args.offset_bytes != 4:
+        from ..storage import types as storage_types
+
+        storage_types.set_offset_size(args.offset_bytes)
     dirs = [d.strip() for d in args.dir.split(",") if d.strip()]
     counts = [int(c) for c in str(args.max_volume_counts).split(",")]
     if len(counts) == 1:

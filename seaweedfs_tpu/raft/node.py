@@ -152,6 +152,11 @@ class RaftNode:
             self.peers = [m for m in members if not self.same_node(m, self.id)]
             if any(self.same_node(m, self.id) for m in members):
                 self.voter = True
+            elif self.voter and self.state != LEADER:
+                # removed while partitioned and the config entry was
+                # compacted away: stop campaigning (mirrors apply_config,
+                # or this node would term-bump the cluster forever)
+                self.voter = False
         if self.restore_fn is not None:
             try:
                 self.restore_fn(state)

@@ -41,8 +41,7 @@ def middleware(guard: Guard):
 
     @web.middleware
     async def check(request: web.Request, handler):
-        peer = request.transport.get_extra_info("peername") if request.transport else None
-        ip = peer[0] if peer else ""
+        ip = request.remote or ""
         if not guard.allowed(ip):
             raise web.HTTPForbidden(text=f"request from {ip} not allowed")
         return await handler(request)

@@ -63,9 +63,18 @@ def from_security_toml(dirs=None) -> TlsConfig | None:
     kw = {"dirs": dirs} if dirs else {}
     cfg = config_util.load_config("security", **kw)
     section = cfg.get("tls") or {}
-    if section.get("cert") and section.get("key") and section.get("ca"):
+    present = {k for k in ("ca", "cert", "key") if section.get(k)}
+    if len(present) == 3:
         return TlsConfig(
             ca=section["ca"], cert=section["cert"], key=section["key"]
+        )
+    if present:
+        # a half-filled section must FAIL, not silently serve plaintext
+        # while the operator believes mTLS is on
+        missing = {"ca", "cert", "key"} - present
+        raise ValueError(
+            f"security.toml [tls] is missing {sorted(missing)} — set all "
+            "of ca/cert/key or none"
         )
     return None
 

@@ -330,6 +330,18 @@ class MasterServer:
         node: DataNode | None = None
         try:
             async for hb in request_iterator:
+                if hb.offset_bytes and hb.offset_bytes != t.OFFSET_SIZE:
+                    # the needle-map offset width is a deployment-wide
+                    # mode: .idx/.ecx written in one mode are garbage in
+                    # the other, so reject the mismatch LOUDLY instead of
+                    # letting the cluster mix formats
+                    await context.abort(
+                        grpc.StatusCode.FAILED_PRECONDITION,
+                        f"offset width mismatch: volume server uses "
+                        f"{hb.offset_bytes}-byte needle-map offsets, "
+                        f"master uses {t.OFFSET_SIZE} (check "
+                        f"-volumeSizeLimitMB / -offset.bytes)",
+                    )
                 if node is None:
                     node = self.topo.get_or_create_node(
                         hb.data_center,

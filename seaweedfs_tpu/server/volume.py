@@ -379,6 +379,7 @@ class VolumeServer:
             rack=self.rack,
             has_no_volumes=hs.has_no_volumes,
             has_no_ec_shards=hs.has_no_ec_shards,
+            offset_bytes=t.OFFSET_SIZE,
         )
         for k, v in hs.max_volume_counts.items():
             hb.max_volume_counts[k] = v

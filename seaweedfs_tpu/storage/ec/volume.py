@@ -45,23 +45,24 @@ class InsufficientShards(RuntimeError):
     pass
 
 
-ENTRY = t.NEEDLE_MAP_ENTRY_SIZE  # 16
-
-
 def search_sorted_index(fd: int, index_size: int, needle_id: int) -> tuple[int, int, int]:
-    """Binary-search a sorted 16B-entry index file -> (entry_offset,
+    """Binary-search a sorted-entry index file -> (entry_offset,
     needle_offset, size); raises NeedleNotFound (SearchNeedleFromSortedIndex
     ec_volume.go:230-255).  The single home of the .ecx entry layout —
-    delete, rebuild and lookup all go through here."""
-    lo, hi = 0, index_size // ENTRY
+    delete, rebuild and lookup all go through here.  Entry width follows
+    the process offset mode (16B, or 17B under t.set_offset_size(5))."""
+    entry = t.NEEDLE_MAP_ENTRY_SIZE
+    lo, hi = 0, index_size // entry
     while lo < hi:
         mid = (lo + hi) // 2
-        buf = os.pread(fd, ENTRY, mid * ENTRY)
+        buf = os.pread(fd, entry, mid * entry)
         key = int.from_bytes(buf[:8], "big")
         if key == needle_id:
-            off = int.from_bytes(buf[8:12], "big") * t.NEEDLE_PADDING_SIZE
-            size = int.from_bytes(buf[12:16], "big", signed=True)
-            return mid * ENTRY, off, size
+            off = t.offset_from_bytes(buf[8 : 8 + t.OFFSET_SIZE])
+            size = int.from_bytes(
+                buf[8 + t.OFFSET_SIZE : entry], "big", signed=True
+            )
+            return mid * entry, off, size
         if key < needle_id:
             lo = mid + 1
         else:
@@ -70,12 +71,12 @@ def search_sorted_index(fd: int, index_size: int, needle_id: int) -> tuple[int, 
 
 
 def mark_entry_deleted(fd: int, entry_offset: int) -> None:
-    """Tombstone an index entry in place: size=-1 at entry+12
-    (MarkNeedleDeleted ec_volume_delete.go:13-25)."""
+    """Tombstone an index entry in place: size=-1 written over the size
+    field (MarkNeedleDeleted ec_volume_delete.go:13-25)."""
     os.pwrite(
         fd,
         t.TOMBSTONE_FILE_SIZE.to_bytes(4, "big", signed=True),
-        entry_offset + 12,
+        entry_offset + 8 + t.OFFSET_SIZE,
     )
 
 
@@ -434,7 +435,7 @@ class EcVolume:
     # -- lifecycle -----------------------------------------------------------
 
     def file_count(self) -> int:
-        return self.ecx_size // ENTRY
+        return self.ecx_size // t.NEEDLE_MAP_ENTRY_SIZE
 
     def close(self) -> None:
         for s in self.shards.values():

@@ -18,7 +18,11 @@ import numpy as np
 
 from . import types as t
 
-ENTRY = t.NEEDLE_MAP_ENTRY_SIZE  # 16
+
+def entry_size() -> int:
+    """Current on-disk entry width: 16, or 17 in 5-byte-offset mode
+    (t.set_offset_size)."""
+    return t.NEEDLE_MAP_ENTRY_SIZE
 
 
 def pack_entry(needle_id: int, actual_offset: int, size: int) -> bytes:
@@ -31,14 +35,16 @@ def pack_entry(needle_id: int, actual_offset: int, size: int) -> bytes:
 
 def parse_buffer(buf: bytes) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Bulk-parse entries -> (ids u64, actual_offsets i64, sizes i32)."""
-    n = len(buf) // ENTRY
-    a = np.frombuffer(buf[: n * ENTRY], dtype=np.uint8).reshape(n, ENTRY)
+    entry = entry_size()
+    n = len(buf) // entry
+    a = np.frombuffer(buf[: n * entry], dtype=np.uint8).reshape(n, entry)
     ids = a[:, :8].copy().view(">u8").reshape(n).astype(np.uint64)
-    offs = (
-        a[:, 8:12].copy().view(">u4").reshape(n).astype(np.int64)
-        * t.NEEDLE_PADDING_SIZE
-    )
-    sizes = a[:, 12:16].copy().view(">i4").reshape(n).astype(np.int32)
+    offs = a[:, 8:12].copy().view(">u4").reshape(n).astype(np.int64)
+    if t.OFFSET_SIZE == 5:  # high byte appended after the low word
+        offs += a[:, 12].astype(np.int64) << 32
+    offs *= t.NEEDLE_PADDING_SIZE
+    lo = 8 + t.OFFSET_SIZE
+    sizes = a[:, lo : lo + 4].copy().view(">i4").reshape(n).astype(np.int32)
     return ids, offs, sizes
 
 
@@ -52,4 +58,4 @@ def walk(path: str) -> Iterator[tuple[int, int, int]]:
 
 
 def entry_count(path: str) -> int:
-    return os.path.getsize(path) // ENTRY
+    return os.path.getsize(path) // entry_size()

@@ -140,7 +140,7 @@ class _PersistentNeedleMap:
         self._replaying = True
         try:
             for i in range(len(ids)):
-                self._meta_watermark = watermark + (i + 1) * idx_mod.ENTRY
+                self._meta_watermark = watermark + (i + 1) * idx_mod.entry_size()
                 nid, off, size = int(ids[i]), int(offs[i]), int(sizes[i])
                 if t.size_is_valid(size):
                     self.set(nid, off, size)

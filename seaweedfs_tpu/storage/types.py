@@ -19,7 +19,7 @@ COOKIE_SIZE = 4
 SIZE_SIZE = 4
 OFFSET_SIZE = 4
 NEEDLE_HEADER_SIZE = COOKIE_SIZE + NEEDLE_ID_SIZE + SIZE_SIZE  # 16
-NEEDLE_MAP_ENTRY_SIZE = NEEDLE_ID_SIZE + OFFSET_SIZE + SIZE_SIZE  # 16
+NEEDLE_MAP_ENTRY_SIZE = NEEDLE_ID_SIZE + OFFSET_SIZE + SIZE_SIZE  # 16 (17 in 5-byte mode)
 NEEDLE_PADDING_SIZE = 8
 NEEDLE_CHECKSUM_SIZE = 4
 TIMESTAMP_SIZE = 8
@@ -31,6 +31,25 @@ _U64 = struct.Struct(">Q")
 _I32 = struct.Struct(">i")
 
 
+def set_offset_size(n: int) -> None:
+    """Runtime analogue of the reference's `5BytesOffset` build tag
+    (types/offset_5bytes.go:14-17): 5-byte needle-map offsets raise the
+    volume address cap from 32GB to 8TB.  Like the build tag this is a
+    PROCESS-WIDE deployment choice made once at startup — .idx/.ecx
+    files written in one mode are not readable in the other, so every
+    node in a cluster must agree (the master flips it when
+    -volumeSizeLimitMB exceeds the 4-byte cap; volume servers via
+    -offset.bytes).  On-disk 5-byte layout matches the reference:
+    4-byte big-endian low word, then the high byte (offset_5bytes.go
+    OffsetToBytes)."""
+    global OFFSET_SIZE, NEEDLE_MAP_ENTRY_SIZE, MAX_POSSIBLE_VOLUME_SIZE
+    if n not in (4, 5):
+        raise ValueError(f"offset size must be 4 or 5, got {n}")
+    OFFSET_SIZE = n
+    NEEDLE_MAP_ENTRY_SIZE = NEEDLE_ID_SIZE + n + SIZE_SIZE
+    MAX_POSSIBLE_VOLUME_SIZE = (1 << (8 * n)) * NEEDLE_PADDING_SIZE
+
+
 def size_is_deleted(size: int) -> bool:
     return size < 0 or size == TOMBSTONE_FILE_SIZE
 
@@ -40,14 +59,20 @@ def size_is_valid(size: int) -> bool:
 
 
 def offset_to_bytes(actual_offset: int) -> bytes:
-    """Byte offset (multiple of 8) -> 4-byte on-disk unit count."""
+    """Byte offset (multiple of 8) -> OFFSET_SIZE-byte on-disk unit count."""
     assert actual_offset % NEEDLE_PADDING_SIZE == 0, actual_offset
-    return _U32.pack(actual_offset // NEEDLE_PADDING_SIZE)
+    units = actual_offset // NEEDLE_PADDING_SIZE
+    if OFFSET_SIZE == 4:
+        return _U32.pack(units)
+    return _U32.pack(units & 0xFFFFFFFF) + bytes([units >> 32])
 
 
 def offset_from_bytes(b: bytes) -> int:
-    """4-byte unit count -> actual byte offset."""
-    return _U32.unpack(b)[0] * NEEDLE_PADDING_SIZE
+    """OFFSET_SIZE-byte unit count -> actual byte offset."""
+    units = _U32.unpack(b[:4])[0]
+    if OFFSET_SIZE == 5:
+        units += b[4] << 32
+    return units * NEEDLE_PADDING_SIZE
 
 
 # --- TTL --------------------------------------------------------------------

@@ -147,7 +147,7 @@ def test_reopen_does_not_resurrect_deleted_needles(tmp_path, map_kind):
     # were appended by tail recovery
     import seaweedfs_tpu.storage.idx as idxm
 
-    n_entries = os.path.getsize(v2.idx_path) // idxm.ENTRY
+    n_entries = os.path.getsize(v2.idx_path) // idxm.entry_size()
     assert n_entries == 3, f"recovery duplicated idx entries: {n_entries}"
     v2.close()
 

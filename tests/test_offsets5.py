@@ -1,0 +1,138 @@
+"""5-byte needle-map offsets: volumes past the 32GB 4-byte address cap.
+
+Reference: the `5BytesOffset` build tag (types/offset_5bytes.go:14-17)
+raises the cap to 8TB; here t.set_offset_size(5) is the runtime
+equivalent (process-wide, like the tag).  Covers the wire encodings,
+the idx walker, a REAL >32GB-addressed sparse volume round-trip, and
+EC encode/.ecx/degraded-read in 17-byte-entry mode.
+"""
+import os
+
+import pytest
+
+from seaweedfs_tpu.storage import ec, idx as idx_mod
+from seaweedfs_tpu.storage import types as t
+from seaweedfs_tpu.storage.volume import Volume
+
+from test_ec import encode_volume, make_volume
+
+GB = 1024 * 1024 * 1024
+
+
+@pytest.fixture
+def five_bytes():
+    t.set_offset_size(5)
+    yield
+    t.set_offset_size(4)
+
+
+def test_default_mode_unchanged():
+    assert t.OFFSET_SIZE == 4
+    assert t.NEEDLE_MAP_ENTRY_SIZE == 16
+    assert t.MAX_POSSIBLE_VOLUME_SIZE == 32 * GB
+
+
+def test_offset_encoding_roundtrip(five_bytes):
+    assert t.NEEDLE_MAP_ENTRY_SIZE == 17
+    assert t.MAX_POSSIBLE_VOLUME_SIZE == 8 * 1024 * GB
+    for off in (0, 8, 32 * GB, 33 * GB + 8, 8 * 1024 * GB - 8):
+        b = t.offset_to_bytes(off)
+        assert len(b) == 5
+        assert t.offset_from_bytes(b) == off
+    # reference byte order: low word big-endian, high byte appended
+    b = t.offset_to_bytes((1 << 32) * t.NEEDLE_PADDING_SIZE)
+    assert b == bytes([0, 0, 0, 0, 1])
+
+
+def test_idx_pack_parse_above_32gb(five_bytes, tmp_path):
+    path = str(tmp_path / "big.idx")
+    entries = [
+        (1, 0, 100),
+        (2, 33 * GB, 4096),
+        (3, 100 * GB + 8, 1 << 20),
+        (4, 0, t.TOMBSTONE_FILE_SIZE),
+    ]
+    with open(path, "wb") as f:
+        for nid, off, size in entries:
+            f.write(idx_mod.pack_entry(nid, off, size))
+    assert idx_mod.entry_count(path) == 4
+    assert list(idx_mod.walk(path)) == entries
+
+
+def test_sparse_volume_past_32gb_roundtrip(five_bytes, tmp_path):
+    """Write/read needles ABOVE the 4-byte cap on a sparse .dat — the
+    VERDICT 'done' condition for this feature."""
+    v = Volume(str(tmp_path), 1)
+    blob_a = os.urandom(5000)
+    v.write(1, 0xAAAA, blob_a, name=b"low")
+    # jump the append position past 32GB (sparse hole, no real disk use)
+    v._dat.truncate(33 * GB)
+    blob_b = os.urandom(7000)
+    v.write(2, 0xBBBB, blob_b, name=b"high")
+    off, _ = v.nm.get(2)
+    assert off >= 33 * GB
+    assert v.read(1, 0xAAAA).data == blob_a
+    assert v.read(2, 0xBBBB).data == blob_b
+    v.close()
+
+    # reload from disk: the 17-byte idx replays correctly
+    v2 = Volume(str(tmp_path), 1)
+    assert v2.read(2, 0xBBBB).data == blob_b
+    assert v2.read(1, 0xAAAA).data == blob_a
+    v2.close()
+
+
+def test_ec_roundtrip_in_5byte_mode(five_bytes, tmp_path):
+    """ec.encode -> .ecx (17-byte entries) -> degraded read, all in
+    5-byte mode."""
+    v, blobs = make_volume(tmp_path)
+    base = encode_volume(v)
+    assert os.path.getsize(base + ".ecx") % 17 == 0
+    ev = ec.EcVolume(str(tmp_path), v.id)
+    down = {0, 11}
+    for i in range(14):
+        if i not in down:
+            ev.add_shard(i)
+    for nid, (cookie, data) in blobs.items():
+        assert ev.read_needle(nid, cookie=cookie).data == data
+    # delete path writes the tombstone at the 5-byte-mode field offset
+    victim = next(iter(blobs))
+    ev.delete_needle(victim)
+    with pytest.raises(Exception):
+        ev.read_needle(victim)
+    ev.close()
+
+
+def test_master_rejects_offset_width_mismatch():
+    """A volume server heartbeating a different needle-map offset width
+    is rejected loudly — mixed modes write mutually unreadable
+    .idx/.ecx files, so the cluster must refuse to form."""
+    import asyncio
+
+    import grpc
+    import pytest as _pytest
+
+    from seaweedfs_tpu.pb import Stub, master_pb2
+    from seaweedfs_tpu.pb.rpc import channel
+    from seaweedfs_tpu.server.master import MasterServer
+
+    async def go():
+        m = MasterServer(port=0)
+        await m.start()
+        try:
+            stub = Stub(channel(m.grpc_url), master_pb2, "Seaweed")
+
+            async def feed():
+                yield master_pb2.Heartbeat(
+                    ip="127.0.0.1", port=9, offset_bytes=5
+                )
+
+            with _pytest.raises(grpc.aio.AioRpcError) as ei:
+                async for _ in stub.SendHeartbeat(feed()):
+                    pass
+            assert ei.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+            assert "offset width mismatch" in ei.value.details()
+        finally:
+            await m.stop()
+
+    asyncio.run(go())
