@@ -1,0 +1,184 @@
+"""Block-diagonal MXU packing, round 3.
+
+The plain kernel pads A to one 128x128 int8 MXU tile of which only 32
+output rows are useful: 1638 MACs per useful input byte.  Packing g
+independent stripe groups block-diagonally (A_blk [g*32, g*80], input
+[g*10, B/g]) fills the M dimension with useful rows at the cost of a
+longer contraction — g=4 gives [128, 320] ~= 1229 MACs/byte, a ~1.33x
+MXU-roof lift (120 -> 160 GB/s).
+
+Measured with the rotating-buffer harness (see kernel_roof_r3.py).
+Variants:
+  plain_32k        current kernel, tile 32768 (round-3 best: 80.3)
+  blkdiag_g{2,4}_t{16k,32k}  pre-stacked [g*10, B] input
+  blkdiag_g4_tr_32k          device-side restack from [10, B] input
+                             (what the encode path would pay if the host
+                             keeps the flat stripe layout)
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from seaweedfs_tpu.ops import rs, rs_tpu
+
+
+def measure_rot(apply_fn, bank, useful_bytes, n_small=8, n_large=72, reps=3):
+    r = bank.shape[0]
+
+    @jax.jit
+    def many(bank, n):
+        def body(i, acc):
+            xi = jax.lax.dynamic_index_in_dim(bank, i % r, keepdims=False)
+            out = apply_fn(xi)
+            return acc + jnp.sum(out[:, ::16384].astype(jnp.int32))
+
+        return jax.lax.fori_loop(0, n, body, jnp.int32(0))
+
+    int(many(bank, 1))
+    est = []
+    for _ in range(reps):
+        times = {}
+        for n in (n_small, n_large):
+            t0 = time.perf_counter()
+            int(many(bank, n))
+            times[n] = time.perf_counter() - t0
+        est.append(
+            useful_bytes
+            / ((times[n_large] - times[n_small]) / (n_large - n_small))
+        )
+    return float(np.median(est))
+
+
+def make_blockdiag(a_bm_np, groups):
+    m8, k8 = a_bm_np.shape
+    blk = np.zeros((groups * m8, groups * k8), dtype=np.int8)
+    for g in range(groups):
+        blk[g * m8 : (g + 1) * m8, g * k8 : (g + 1) * k8] = a_bm_np
+    return jnp.asarray(blk)
+
+
+def blockdiag_apply(a_blk, k_per_group, groups, tile, restack=False):
+    gm8, gk8 = a_blk.shape
+    out_rows = gm8 // 8
+
+    def kern(a_ref, x_ref, o_ref):
+        xv = x_ref[:]
+        bits = rs_tpu._unpack_bits_bitmajor(xv)
+        counts = jnp.dot(a_ref[:], bits, preferred_element_type=jnp.int32)
+        o_ref[:] = rs_tpu._pack_bits_bitmajor(counts, out_rows)
+
+    gk = groups * k_per_group
+
+    def apply(xi):
+        if restack:
+            # [k, B] -> [g*k, B/g]: segment g of each shard becomes rows
+            # g*k..g*k+k-1 (the layout the host would otherwise pre-stage)
+            k, b = xi.shape
+            seg = b // groups
+            xi = (
+                xi.reshape(k, groups, seg)
+                .transpose(1, 0, 2)
+                .reshape(groups * k, seg)
+            )
+        gkk, b = xi.shape
+        # bit-plane alignment: unpack concatenates 8 masked planes of gk
+        # rows each; gk=40/80 are NOT multiples of 32-sublane tiles, so
+        # let Mosaic handle it (this is part of what we're measuring)
+        return pl.pallas_call(
+            kern,
+            grid=(pl.cdiv(b, tile),),
+            in_specs=[
+                pl.BlockSpec((gm8, gk8), lambda i: (0, 0), memory_space=pltpu.VMEM),
+                pl.BlockSpec((gkk, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec(
+                (out_rows, tile), lambda i: (0, i), memory_space=pltpu.VMEM
+            ),
+            out_shape=jax.ShapeDtypeStruct((out_rows, b), jnp.uint8),
+            cost_estimate=pl.CostEstimate(
+                flops=2 * gm8 * gk8 * b,
+                bytes_accessed=gkk * b + out_rows * b,
+                transcendentals=0,
+            ),
+        )(a_blk, xi)
+
+    return apply
+
+
+def main():
+    assert rs_tpu.on_tpu()
+    codec = rs.RSCodec()
+    parity = np.asarray(codec.matrix[10:], np.uint8)  # [4, 10]
+    # UNPADDED bit-major matrix [32, 80] for block-diag (no k_pad)
+    a_std = np.asarray(rs_tpu.gf256.expand_to_gf2(parity))  # [32, 80]
+    a_bm_np = (
+        a_std.reshape(4, 8, 10, 8).transpose(1, 0, 3, 2).reshape(32, 80)
+    ).astype(np.int8)
+    a_pad = rs_tpu.prepare_matrix(parity)  # padded, for the plain baseline
+    rng = np.random.default_rng(0)
+
+    mb = 96
+    b = (mb << 20) // 10
+    b -= b % (32768 * 8)  # divisible by tile and by groups
+    useful = 10 * b
+
+    # ONE upload; stacked layouts are derived on-device (the tunnel is
+    # ~10MB/s — re-uploading per group blew the round-1 attempt's budget)
+    bank_flat = jax.device_put(
+        rng.integers(0, 256, size=(2, 10, b), dtype=np.uint8)
+    )
+
+    def plain(tile):
+        def f(xi):
+            return rs_tpu.apply_matrix_device(
+                a_pad, xi, kernel="pallas", interpret=False, tile=tile
+            )
+
+        return f
+
+    print("plain_32k", round(measure_rot(plain(32768), bank_flat, useful) / 1e9, 2), flush=True)
+
+    for groups in (4, 8):
+        seg = b // groups
+
+        @jax.jit
+        def restack(bank, g=groups, seg=seg):
+            r, k, _ = bank.shape
+            return (
+                bank.reshape(r, k, g, seg)
+                .transpose(0, 2, 1, 3)
+                .reshape(r, g * k, seg)
+            )
+
+        bank_stacked = restack(bank_flat)
+        bank_stacked.block_until_ready()
+        a_blk = make_blockdiag(a_bm_np, groups)
+        for tile, label in ((32768, "32k"),):
+            try:
+                r = measure_rot(
+                    blockdiag_apply(a_blk, 10, groups, tile), bank_stacked, useful
+                )
+                print(f"blkdiag_g{groups}_t{label}", round(r / 1e9, 2), flush=True)
+            except Exception as e:
+                print(f"blkdiag_g{groups}_t{label} FAILED: {str(e)[:120]}", flush=True)
+        del bank_stacked
+
+    # device-side restack cost (flat input, transpose inside)
+    a_blk4 = make_blockdiag(a_bm_np, 4)
+    try:
+        r = measure_rot(
+            blockdiag_apply(a_blk4, 10, 4, 32768, restack=True),
+            bank_flat,
+            useful,
+        )
+        print("blkdiag_g4_tr_32k", round(r / 1e9, 2), flush=True)
+    except Exception as e:
+        print("blkdiag_g4_tr_32k FAILED:", str(e)[:120], flush=True)
+
+
+if __name__ == "__main__":
+    main()

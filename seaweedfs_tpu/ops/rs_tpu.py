@@ -44,6 +44,26 @@ Two kernels:
              in HBM (8x inflation) but needs no Pallas.
   "pallas" — fused kernel: unpack -> MXU dot -> pack entirely in VMEM, so
              HBM traffic is just the k input and m output byte planes.
+
+Round-3 findings (experiments/kernel_roof_r3.py, kernel_blockdiag_r3.py,
+profiler-measured on v5e-1 — the fori-loop differencing harness used in
+earlier rounds charges its own per-iteration XOR pass and dispatch
+jitter to the kernel, reading ~77 GB/s for a kernel whose device-stream
+execution time is 0.81 ms for 96MB = ~123 GB/s, i.e. the plain kernel
+already sits AT its documented ~120 GB/s MXU roof):
+
+  * BLOCK-DIAGONAL g=4 packing lifts the roof itself: four independent
+    stripe groups fill the MXU's M dimension (A_blk [128, 320] vs a
+    mostly-padding [128, 128]), cutting MACs/useful-byte from 1638 to
+    ~1229 -> measured 0.656 ms / 96MB = ~152 GB/s.  The catch: inputs
+    must arrive segment-stacked ([g*k, B/g]); restacking ON DEVICE costs
+    more than the win (byte transposes: 58 GB/s flat-to-flat), so the
+    HOST stages the layout (free — the encode pipeline writes the same
+    bytes either way).  apply_matrix_blockdiag below.
+  * g=8 regresses (95 GB/s): longer contraction padding + VMEM pressure.
+  * Feeding the flat layout via a 3-D BlockSpec block (gather inside the
+    kernel) is rejected by Mosaic (compile-helper 500) — dead end, like
+    the int8-accumulate and u8-multiply routes before it.
 """
 from __future__ import annotations
 
@@ -240,6 +260,103 @@ def apply_matrix_device(
     if kernel == "xla":
         return _apply_xla(a_bm, x)
     raise ValueError(f"unknown TPU kernel {kernel!r}")
+
+
+# --- block-diagonal variant (the encode hot path) ---------------------------
+
+BLOCKDIAG_GROUPS = 4
+BLOCKDIAG_TILE = 32768
+
+
+def prepare_matrix_blockdiag(
+    m_gf: np.ndarray, groups: int = BLOCKDIAG_GROUPS
+) -> jax.Array:
+    """GF(256) matrix [m,k] -> the block-diagonal system's prepared bit
+    matrix.  The block structure is applied at the GF(256) level and then
+    expanded by the standard prepare_matrix, so the column order matches
+    what _unpack_bits_bitmajor produces for the STACKED input (bit-major
+    over all groups*k rows — a per-group bit-major layout would compute
+    garbage)."""
+    m_gf = np.asarray(m_gf, dtype=np.uint8)
+    m, k = m_gf.shape
+    blk = np.zeros((groups * m, groups * k), dtype=np.uint8)
+    for g in range(groups):
+        blk[g * m : (g + 1) * m, g * k : (g + 1) * k] = m_gf
+    return prepare_matrix(blk)
+
+
+def apply_matrix_device_blockdiag(
+    a_blk: jax.Array,
+    x_stacked: jax.Array,  # [groups*k, seg] u8, segment-stacked
+    groups: int = BLOCKDIAG_GROUPS,
+    tile: int = BLOCKDIAG_TILE,
+    interpret: bool = False,
+) -> jax.Array:
+    """-> [>=groups*m, seg] u8 (group g's true output rows at g*m..; any
+    row padding sits at the tail).  Same fused kernel as the plain path —
+    only the matrix and input layout differ."""
+    return apply_matrix_device(
+        a_blk,
+        x_stacked,
+        kernel="pallas",
+        interpret=interpret,
+        tile=tile,
+        k_true=x_stacked.shape[0],
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def _prepared_blockdiag(matrix_bytes: bytes, m: int, k: int, groups: int):
+    return prepare_matrix_blockdiag(
+        np.frombuffer(matrix_bytes, dtype=np.uint8).reshape(m, k), groups
+    )
+
+
+def stack_segments(shards: np.ndarray, groups: int = BLOCKDIAG_GROUPS) -> np.ndarray:
+    """[k, B] -> [groups*k, B/groups]: segment g of every shard becomes
+    rows g*k..g*k+k-1 (the host-side staging that makes block-diagonal
+    free — same bytes, different row order)."""
+    k, b = shards.shape
+    seg = b // groups
+    return (
+        shards.reshape(k, groups, seg).transpose(1, 0, 2).reshape(groups * k, seg)
+    )
+
+
+def unstack_segments(out: np.ndarray, m: int, groups: int = BLOCKDIAG_GROUPS) -> np.ndarray:
+    """[>=groups*m, seg] -> [m, groups*seg]: group g's true rows live at
+    g*m..g*m+m-1 (row padding, if any, is beyond groups*m)."""
+    seg = out.shape[1]
+    return (
+        out[: groups * m]
+        .reshape(groups, m, seg)
+        .transpose(1, 0, 2)
+        .reshape(m, groups * seg)
+    )
+
+
+def apply_matrix_blockdiag(
+    m_gf: np.ndarray,
+    shards: np.ndarray,
+    groups: int = BLOCKDIAG_GROUPS,
+    tile: int = BLOCKDIAG_TILE,
+) -> np.ndarray:
+    """Host-convenience block-diagonal apply (numpy in/out) — the fast
+    path for bulk encode/rebuild when B divides by `groups`.  Callers
+    with indivisible batches use the plain apply_matrix."""
+    m_gf = np.asarray(m_gf, dtype=np.uint8)
+    rows, k = m_gf.shape
+    b = shards.shape[1]
+    if b % groups:
+        return apply_matrix(m_gf, shards)
+    a_blk = _prepared_blockdiag(m_gf.tobytes(), rows, k, groups)
+    x = jnp.asarray(
+        np.ascontiguousarray(stack_segments(np.asarray(shards, np.uint8), groups))
+    )
+    out = apply_matrix_device_blockdiag(
+        a_blk, x, groups=groups, tile=tile, interpret=_interpret_default()
+    )
+    return unstack_segments(np.asarray(out), rows, groups)
 
 
 def on_tpu() -> bool:
