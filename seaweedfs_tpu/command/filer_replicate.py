@@ -146,3 +146,5 @@ async def run(args) -> None:
         )
     finally:
         await source.close()
+        if hasattr(sink, "close"):
+            await sink.close()

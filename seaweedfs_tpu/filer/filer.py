@@ -37,10 +37,12 @@ class Filer:
         delete_file_ids_fn=None,  # async (list[str]) -> None; wired by the server
         meta_log_path: str | None = None,
         notifier=None,  # replication.notification.Notifier
+        fetch_manifest_fn=None,  # async (FileChunk) -> decoded manifest bytes
     ):
         self.store = store
         self.meta_log = MetaLog(meta_log_path, notifier=notifier)
         self._delete_file_ids_fn = delete_file_ids_fn
+        self._fetch_manifest_fn = fetch_manifest_fn
         self._dir_cache: dict[str, float] = {}  # known-directory memo
         # hard links: shared content + name refcount live in the store KV
         # under the hard_link_id; all counter math happens under this lock
@@ -283,12 +285,30 @@ class Filer:
             self.store.kv_put(ckey, str(refs).encode())
             return False
 
-    async def _delete_chunks(self, chunks: list) -> None:
+    async def _delete_chunks(self, chunks: list, expand: bool = True) -> None:
+        """expand=True resolves manifest chunks and deletes their children
+        too (entry deletion).  delete_unused_chunks passes expand=False: its
+        diff already decided exactly which fids are unreferenced — a dropped
+        manifest whose children are still live inline must NOT cascade."""
         if self._delete_file_ids_fn is None:
             return
+        chunks = list(chunks)
+        if expand and any(
+            c.is_chunk_manifest for c in chunks
+        ) and self._fetch_manifest_fn:
+            # expand BEFORE deleting anything: the children are reachable
+            # only through the manifest blobs (entry delete would otherwise
+            # orphan every data chunk inside them)
+            from .manifest import expand_manifest_chunks
+
+            try:
+                data, meta = await expand_manifest_chunks(
+                    self._fetch_manifest_fn, chunks
+                )
+                chunks = data + meta
+            except Exception as e:  # noqa: BLE001 — delete what we can
+                log.warning("manifest resolve for delete failed: %s", e)
         fids = sorted({c.file_id for c in chunks if c.file_id})
-        # manifest chunks' inner chunks are resolved by the caller when
-        # needed; the manifest blob itself is always deleted
         if fids:
             try:
                 await self._delete_file_ids_fn(fids)
@@ -296,9 +316,37 @@ class Filer:
                 log.warning("chunk deletion failed: %s", e)
 
     async def delete_unused_chunks(self, old_chunks, new_chunks) -> None:
-        unused = find_unused_file_chunks(old_chunks, new_chunks)
+        """GC chunks dropped by an entry update — MANIFEST-AWARE, like the
+        reference's MinusChunks (filechunks.go): both sides resolve to
+        (data, manifest) chunk sets and each set diffs independently, so
+        folding data chunks into a manifest does not delete the live data
+        and dropping a manifest deletes its children too."""
+        if any(c.is_chunk_manifest for c in list(old_chunks) + list(new_chunks)):
+            # append/flush keeps every old top-level fid: nothing can be
+            # unused, skip the manifest fetches entirely
+            if not find_unused_file_chunks(old_chunks, new_chunks):
+                return
+            if self._fetch_manifest_fn is None:
+                return  # cannot resolve: leak rather than lose data
+            from .manifest import expand_manifest_chunks
+
+            try:
+                old_d, old_m = await expand_manifest_chunks(
+                    self._fetch_manifest_fn, old_chunks
+                )
+                new_d, new_m = await expand_manifest_chunks(
+                    self._fetch_manifest_fn, new_chunks
+                )
+            except Exception as e:  # noqa: BLE001 — unresolvable manifest
+                log.warning("manifest resolve for GC failed, skipping: %s", e)
+                return
+            unused = find_unused_file_chunks(
+                old_d, new_d
+            ) + find_unused_file_chunks(old_m, new_m)
+        else:
+            unused = find_unused_file_chunks(old_chunks, new_chunks)
         if unused:
-            await self._delete_chunks(unused)
+            await self._delete_chunks(unused, expand=False)
 
     # ----------------------------------------------------------------- rename
 

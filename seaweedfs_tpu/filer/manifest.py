@@ -38,6 +38,100 @@ def resolve_chunk_manifest(lookup_fn, chunks, start_offset: int, stop_offset: in
     return data_chunks, manifest_chunks
 
 
+def decoded_chunk_fetcher(fetch_raw):
+    """Adapt a raw fetcher (async file_id -> needle payload) into a decoded
+    chunk fetcher (async FileChunk -> plaintext bytes), applying the chunk's
+    cipher/compression flags — the framing volume servers store verbatim."""
+
+    async def fetch(c):
+        raw = await fetch_raw(c.file_id)
+        if c.cipher_key:
+            from ..utils.cipher import decrypt
+
+            raw = decrypt(raw, bytes(c.cipher_key))
+        if c.is_compressed:
+            from ..utils.compression import decompress
+
+            raw = decompress(raw)
+        return raw
+
+    return fetch
+
+
+async def fetch_chunk_via_lookup(stub, session, file_id: str) -> bytes:
+    """Raw needle payload for a chunk fid: filer LookupVolume then HTTP GET
+    from any replica.  The shared fetch plumbing for every client that
+    reads chunk blobs outside the filer's own read path (replication
+    source, mounts, sinks)."""
+    vid = file_id.split(",")[0]
+    resp = await stub.LookupVolume(
+        filer_pb2.LookupVolumeRequest(volume_ids=[vid])
+    )
+    locs = resp.locations_map.get(vid)
+    if locs is None or not locs.locations:
+        raise RuntimeError(f"chunk {file_id}: no locations")
+    last_err: Exception | None = None
+    for loc in locs.locations:
+        try:
+            async with session.get(f"http://{loc.url}/{file_id}") as r:
+                if r.status < 300:
+                    return await r.read()
+                last_err = RuntimeError(f"{loc.url}: HTTP {r.status}")
+        except Exception as e:  # noqa: BLE001 — try the next replica
+            last_err = e
+    raise RuntimeError(f"chunk {file_id}: unreachable ({last_err})")
+
+
+async def expand_data_chunks(fetch_raw, chunks) -> list:
+    """Flat data-chunk list with manifests resolved through a RAW fetcher
+    (async file_id -> needle payload); manifest-blob decode handled here."""
+    data, _ = await expand_manifest_chunks(
+        decoded_chunk_fetcher(fetch_raw), chunks
+    )
+    return data
+
+
+async def expand_manifest_chunks(fetch_decoded, chunks):
+    """Async manifest expansion: -> (data_chunks, manifest_chunks), with
+    manifest chunks resolved recursively through `fetch_decoded` (async
+    FileChunk -> decoded manifest blob; see decoded_chunk_fetcher).  The
+    async counterpart of resolve_chunk_manifest for callers whose chunk
+    fetch is a network call (sinks, mounts, the filer's GC)."""
+    data: list = []
+    meta: list = []
+    for c in chunks:
+        if not c.is_chunk_manifest:
+            data.append(c)
+            continue
+        meta.append(c)
+        m = filer_pb2.FileChunkManifest.FromString(await fetch_decoded(c))
+        sub_data, sub_meta = await expand_manifest_chunks(
+            fetch_decoded, m.chunks
+        )
+        data.extend(sub_data)
+        meta.extend(sub_meta)
+    return data, meta
+
+
+async def maybe_manifestize_async(save_async, chunks, batch: int = MANIFEST_BATCH):
+    """maybe_manifestize with an async blob saver: first pass collects the
+    manifest blobs to store, they upload via `save_async(bytes) ->
+    FileChunk`, and a second identical pass folds with the real chunks."""
+    pending: list[bytes] = []
+
+    def collect(blob: bytes) -> filer_pb2.FileChunk:
+        pending.append(blob)
+        return filer_pb2.FileChunk(file_id="pending")
+
+    maybe_manifestize(collect, chunks, batch)
+    if not pending:
+        return list(chunks)
+    uploaded = {}
+    for blob in pending:
+        uploaded[blob] = await save_async(blob)
+    return maybe_manifestize(lambda b: uploaded[b], chunks, batch)
+
+
 def maybe_manifestize(save_fn, chunks, batch: int = MANIFEST_BATCH):
     """If too many non-manifest chunks, fold batches of them into manifest
     chunks.  save_fn(bytes) -> FileChunk for the stored manifest blob."""

@@ -772,6 +772,25 @@ class WeedFS:
         entry.attributes.mtime = int(time.time())
         await self._update_entry(path, entry)
 
+    async def _fetch_chunk_raw(self, file_id: str) -> bytes:
+        """One chunk's raw needle payload straight from a volume server (the
+        manifest-blob fetch path; file data reads go through the filer)."""
+        from ..filer.manifest import fetch_chunk_via_lookup
+
+        try:
+            return await fetch_chunk_via_lookup(
+                self._stub(), await self._sess(), file_id
+            )
+        except RuntimeError:
+            raise fk.FuseError(errno.EIO)
+
+    async def _expand_manifest_chunks(self, chunks) -> list:
+        """Manifest chunks (chunks-of-chunks) -> the data chunks they
+        cover, so chunk-list surgery (truncate) operates on real spans."""
+        from ..filer.manifest import expand_data_chunks
+
+        return await expand_data_chunks(self._fetch_chunk_raw, chunks)
+
     async def _truncate_entry(self, path: str, new_size: int) -> None:
         """Server-side truncation: trim the chunk list (re-uploading the
         boundary range when a chunk straddles it) instead of rewriting
@@ -781,24 +800,57 @@ class WeedFS:
             del entry.chunks[:]
             entry.content = b""
         else:
+            # expand manifests ONLY when one straddles the boundary: a
+            # straddling manifest's span can start near offset 0 of a huge
+            # file, which would turn the boundary re-upload below into a
+            # whole-file rewrite.  Manifests fully below new_size stay
+            # folded; fully past it they drop whole (the filer's
+            # manifest-aware GC cascades to their children).
+            expanded = any(
+                c.is_chunk_manifest
+                and c.offset < new_size < c.offset + int(c.size)
+                for c in entry.chunks
+            )
+            chunks = (
+                await self._expand_manifest_chunks(entry.chunks)
+                if expanded
+                else list(entry.chunks)
+            )
             keep = [
-                c for c in entry.chunks
+                c for c in chunks
                 if c.offset + int(c.size) <= new_size
             ]
             straddle = [
-                c for c in entry.chunks
+                c for c in chunks
                 if c.offset < new_size < c.offset + int(c.size)
             ]
             if straddle:
                 lo = min(c.offset for c in straddle)
-                data = await self._read_range(path, lo, new_size - lo)
-                fid = await self._assign_upload(data)
-                keep.append(
-                    filer_pb2.FileChunk(
-                        file_id=fid, offset=lo, size=len(data),
-                        modified_ts_ns=time.time_ns(),
+                # chunk_size-bounded pieces: the straddle span can exceed
+                # the volume/needle size limit as a single upload
+                for off in range(lo, new_size, self.chunk_size):
+                    n = min(self.chunk_size, new_size - off)
+                    data = await self._read_range(path, off, n)
+                    if not data:
+                        break
+                    fid = await self._assign_upload(data)
+                    keep.append(
+                        filer_pb2.FileChunk(
+                            file_id=fid, offset=off, size=len(data),
+                            modified_ts_ns=time.time_ns(),
+                        )
                     )
-                )
+            if expanded:
+                # re-fold: the expansion must not leave a huge file's
+                # entry holding thousands of inline chunks
+                from ..filer.manifest import maybe_manifestize_async
+
+                async def save_blob(blob: bytes) -> filer_pb2.FileChunk:
+                    return filer_pb2.FileChunk(
+                        file_id=await self._assign_upload(blob), size=len(blob)
+                    )
+
+                keep = await maybe_manifestize_async(save_blob, keep)
             del entry.chunks[:]
             entry.chunks.extend(keep)
             entry.content = bytes(entry.content[:new_size])

@@ -24,6 +24,12 @@ from ..pb.rpc import channel
 log = logging.getLogger("replication.sink")
 
 
+# Sinks that assemble or re-home chunk data MUST resolve manifest chunks
+# (filer/manifest.expand_data_chunks) — splicing a manifest chunk's own
+# bytes into an object would store serialized FileChunkManifest protos
+# instead of file data.  Reference: sink/s3sink via filer.ResolveChunkManifest.
+
+
 class ObjectStoreSink:
     """Mirror filer DATA into an object-store backend (s3/local).
 
@@ -85,19 +91,28 @@ class ObjectStoreSink:
             key = self._key(directory, n.new_entry.name)
             if key is None:
                 return
+            from ..filer.manifest import expand_data_chunks
+
             content = bytearray(n.new_entry.content)
+            chunks = await expand_data_chunks(
+                self.fetch_chunk, n.new_entry.chunks
+            )
             # oldest-first by modified_ts_ns (ties: list order) so newer
             # overlapping chunks shadow older bytes, exactly like the
             # filer's interval resolution (filer/filechunks.py)
             ordered = [
                 c
                 for _, _, c in sorted(
-                    (c.modified_ts_ns, i, c)
-                    for i, c in enumerate(n.new_entry.chunks)
+                    (c.modified_ts_ns, i, c) for i, c in enumerate(chunks)
                 )
             ]
+            from ..filer.manifest import decoded_chunk_fetcher
+
+            fetch_decoded = decoded_chunk_fetcher(self.fetch_chunk)
             for c in ordered:
-                blob = await self.fetch_chunk(c.file_id)
+                # decode per-chunk framing: the mirror stores FILE bytes,
+                # not the zstd/AES envelopes volume servers hold
+                blob = await fetch_decoded(c)
                 end = c.offset + len(blob)
                 if len(content) < end:
                     content.extend(b"\x00" * (end - len(content)))
@@ -126,6 +141,7 @@ class FilerSink:
         self.source_path = source_path.rstrip("/")
         self.target_path = target_path.rstrip("/")
         self._stub_cache = None
+        self._session = None  # lazy aiohttp session for target-side fetches
 
     def _map_dir(self, directory: str) -> str:
         if self.source_path == self.target_path:
@@ -142,6 +158,18 @@ class FilerSink:
                 channel(self.filer_grpc_address), filer_pb2, "SeaweedFiler"
             )
         return self._stub_cache
+
+    async def _sess(self):
+        import aiohttp
+
+        if self._session is None:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
 
     async def apply(self, ev: filer_pb2.SubscribeMetadataResponse) -> None:
         """Dispatch one event (replicator.go Replicate)."""
@@ -166,10 +194,13 @@ class FilerSink:
 
     async def _existing_by_source(
         self, directory: str, name: str
-    ) -> dict[str, filer_pb2.FileChunk]:
-        """Target chunks already replicated for this entry, keyed by the
-        source fid they came from — lets updates skip unchanged chunks
-        (filer_sink.go UpdateEntry's chunk diff)."""
+    ) -> tuple[dict[str, filer_pb2.FileChunk], list[filer_pb2.FileChunk]]:
+        """(by_source_fid, target_top_level_chunks) for the entry already
+        replicated at the target — lets updates skip unchanged chunks
+        (filer_sink.go UpdateEntry's chunk diff).  The target entry's
+        manifests must expand first: the source_file_id-carrying children
+        live INSIDE the manifest blobs, and missing them would re-upload
+        every chunk of a large file on each metadata-only event."""
         try:
             resp = await self._stub().LookupDirectoryEntry(
                 filer_pb2.LookupDirectoryEntryRequest(
@@ -178,13 +209,21 @@ class FilerSink:
             )
         except grpc.aio.AioRpcError as e:
             if e.code() == grpc.StatusCode.NOT_FOUND:
-                return {}
+                return {}, []
             raise
         if not resp.HasField("entry"):
-            return {}
-        return {
-            c.source_file_id: c for c in resp.entry.chunks if c.source_file_id
-        }
+            return {}, []
+        top = list(resp.entry.chunks)
+        chunks = top
+        if any(c.is_chunk_manifest for c in chunks):
+            from ..filer.manifest import expand_data_chunks, fetch_chunk_via_lookup
+
+            sess = await self._sess()
+            chunks = await expand_data_chunks(
+                lambda fid: fetch_chunk_via_lookup(self._stub(), sess, fid),
+                chunks,
+            )
+        return {c.source_file_id: c for c in chunks if c.source_file_id}, top
 
     async def _replicate_chunks(
         self, entry: filer_pb2.Entry, existing: dict[str, filer_pb2.FileChunk]
@@ -224,13 +263,58 @@ class FilerSink:
             out.append(nc)
         return out
 
+    async def _save_blob(self, blob: bytes) -> filer_pb2.FileChunk:
+        """Store a manifest blob in the TARGET cluster -> its FileChunk."""
+        a = await self._stub().AssignVolume(
+            filer_pb2.AssignVolumeRequest(
+                count=1,
+                collection=self.collection,
+                replication=self.replication,
+            )
+        )
+        if a.error:
+            raise RuntimeError(f"target assign failed: {a.error}")
+        await upload_data(
+            f"http://{a.location.url}/{a.file_id}",
+            blob,
+            compress=False,
+            jwt=a.auth,
+        )
+        return filer_pb2.FileChunk(file_id=a.file_id, size=len(blob))
+
     async def _create(self, directory: str, entry: filer_pb2.Entry) -> None:
         directory = self._map_dir(directory)
-        existing = await self._existing_by_source(directory, entry.name)
+        existing, target_top = await self._existing_by_source(
+            directory, entry.name
+        )
         new_entry = filer_pb2.Entry()
         new_entry.CopyFrom(entry)
         del new_entry.chunks[:]
-        new_entry.chunks.extend(await self._replicate_chunks(entry, existing))
+        # expand manifests first: replicating a manifest chunk verbatim
+        # would ship a blob whose child fids point at the SOURCE cluster.
+        # After re-homing, re-fold so a 100k-chunk source entry doesn't
+        # become 100k inline chunks of target metadata.
+        from ..filer.manifest import expand_data_chunks, maybe_manifestize_async
+
+        flat = filer_pb2.Entry()
+        flat.chunks.extend(
+            await expand_data_chunks(self.fetch_chunk, entry.chunks)
+        )
+        replicated = await self._replicate_chunks(flat, existing)
+        existing_fids = {c.file_id for c in existing.values()}
+        if (
+            target_top
+            and len(replicated) == len(existing)
+            and all(c.file_id in existing_fids for c in replicated)
+        ):
+            # metadata-only event: the chunk set is unchanged — keep the
+            # target's own (possibly manifestized) list instead of
+            # re-uploading fresh manifest blobs per attr touch
+            new_entry.chunks.extend(target_top)
+        else:
+            new_entry.chunks.extend(
+                await maybe_manifestize_async(self._save_blob, replicated)
+            )
         resp = await self._stub().CreateEntry(
             filer_pb2.CreateEntryRequest(
                 directory=directory,

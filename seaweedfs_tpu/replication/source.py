@@ -34,24 +34,11 @@ class FilerSource:
 
     async def fetch_chunk(self, file_id: str) -> bytes:
         """Raw needle payload for a chunk fid (any replica)."""
-        vid = file_id.split(",")[0]
-        resp = await self._stub().LookupVolume(
-            filer_pb2.LookupVolumeRequest(volume_ids=[vid])
+        from ..filer.manifest import fetch_chunk_via_lookup
+
+        return await fetch_chunk_via_lookup(
+            self._stub(), await self._sess(), file_id
         )
-        locs = resp.locations_map.get(vid)
-        if locs is None or not locs.locations:
-            raise RuntimeError(f"chunk {file_id}: no locations at source")
-        sess = await self._sess()
-        last_err: Exception | None = None
-        for loc in locs.locations:
-            try:
-                async with sess.get(f"http://{loc.url}/{file_id}") as r:
-                    if r.status < 300:
-                        return await r.read()
-                    last_err = RuntimeError(f"{loc.url}: HTTP {r.status}")
-            except Exception as e:  # noqa: BLE001 — try the next replica
-                last_err = e
-        raise RuntimeError(f"chunk {file_id}: unreachable ({last_err})")
 
     async def close(self) -> None:
         if self._session is not None:

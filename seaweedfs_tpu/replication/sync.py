@@ -158,3 +158,4 @@ class FilerSync:
                 pass
             self._task = None
         await self.source.close()
+        await self.sink.close()

@@ -148,6 +148,8 @@ class S3Client:
     def get_object(
         self, bucket: str, key: str, offset: int = 0, size: int = -1
     ) -> bytes:
+        if size == 0:
+            return b""  # "bytes=N--1" would be a malformed Range header
         headers = {}
         if offset or size >= 0:
             end = "" if size < 0 else str(offset + size - 1)

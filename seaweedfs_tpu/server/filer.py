@@ -107,6 +107,9 @@ class FilerServer:
             delete_file_ids_fn=self._delete_file_ids,
             meta_log_path=meta_log_path,
             notifier=notifier,
+            fetch_manifest_fn=lambda c: self._fetch_chunk_decoded(
+                c.file_id, bytes(c.cipher_key), c.is_compressed
+            ),
         )
         self.master_client = MasterClient(
             masters,
@@ -729,22 +732,14 @@ class FilerServer:
 
     async def _manifestize_async(self, chunks, collection, replication):
         """Async wrapper: pre-upload manifest blobs then fold the list."""
-        uploaded: dict[bytes, filer_pb2.FileChunk] = {}
+        from ..filer.manifest import maybe_manifestize_async
 
-        def save(blob: bytes) -> filer_pb2.FileChunk:
-            return uploaded[blob]
-
-        # first pass to learn which blobs are needed
-        pending: list[bytes] = []
-
-        def collect(blob: bytes) -> filer_pb2.FileChunk:
-            pending.append(blob)
-            return filer_pb2.FileChunk(file_id="pending")
-
-        maybe_manifestize(collect, chunks)
-        for blob in pending:
-            uploaded[blob] = await self._upload_chunk(blob, 0, "manifest", collection, replication)
-        return maybe_manifestize(save, chunks)
+        return await maybe_manifestize_async(
+            lambda blob: self._upload_chunk(
+                blob, 0, "manifest", collection, replication
+            ),
+            chunks,
+        )
 
     async def h_delete(self, request: web.Request) -> web.Response:
         path, _ = self._req_path(request)

@@ -537,10 +537,20 @@ class VolumeServer:
                     n = await asyncio.to_thread(
                         self.store.read_needle, vid, nid, cookie
                     )
-                else:
+                elif self.store.ec_device_cache is not None:
                     # coalesced: concurrent EC reads batch into one
                     # device-resident reconstruct call
                     n = await self._ec_batcher.read(vid, nid, cookie)
+                else:
+                    # no device cache: the batcher's sequential drain loop
+                    # would serialize otherwise-concurrent disk reads
+                    n = await asyncio.to_thread(
+                        self.store.read_ec_needle,
+                        vid,
+                        nid,
+                        cookie,
+                        self._remote_shard_reader(vid),
+                    )
             except (NotFoundError, KeyError):
                 raise web.HTTPNotFound()
             except CookieMismatch:

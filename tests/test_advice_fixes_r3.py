@@ -1,0 +1,207 @@
+"""Regression tests for the round-3 advisor findings (ADVICE.md):
+
+1. ObjectStoreSink resolves manifest chunks before assembling objects
+   (mirrored large files must contain data, not serialized manifests)
+2. mount truncate expands manifests and splits the boundary re-upload
+   into chunk_size-bounded pieces
+3. S3 client get_object(size=0) returns b'' instead of a malformed
+   'bytes=0--1' Range header
+"""
+import asyncio
+
+import pytest
+
+from seaweedfs_tpu.filer.manifest import expand_data_chunks
+from seaweedfs_tpu.pb import filer_pb2
+from seaweedfs_tpu.replication.sink import ObjectStoreSink
+
+
+def chunk(fid, offset, size, ts=1, manifest=False):
+    return filer_pb2.FileChunk(
+        file_id=fid,
+        offset=offset,
+        size=size,
+        modified_ts_ns=ts,
+        is_chunk_manifest=manifest,
+    )
+
+
+class _MemBackend:
+    def __init__(self):
+        self.objects = {}
+
+    def put_bytes(self, key, data):
+        self.objects[key] = data
+
+    def delete_key(self, key):
+        self.objects.pop(key, None)
+
+    def list_keys(self, prefix=""):
+        return [(k, len(v)) for k, v in self.objects.items()]
+
+
+def _event(directory, entry):
+    ev = filer_pb2.SubscribeMetadataResponse(directory=directory)
+    ev.event_notification.new_entry.CopyFrom(entry)
+    return ev
+
+
+def test_sink_resolves_manifest_chunks():
+    blobs = {
+        "1,a1": b"A" * 10,
+        "1,b2": b"B" * 6,
+        "1,c3": b"C" * 4,
+    }
+    manifest = filer_pb2.FileChunkManifest(
+        chunks=[chunk("1,b2", 10, 6, ts=2), chunk("1,c3", 16, 4, ts=3)]
+    )
+    blobs["1,m9"] = manifest.SerializeToString()
+
+    async def fetch(fid):
+        return blobs[fid]
+
+    entry = filer_pb2.Entry(name="big.bin")
+    entry.chunks.append(chunk("1,a1", 0, 10, ts=1))
+    entry.chunks.append(chunk("1,m9", 10, 10, ts=4, manifest=True))
+
+    backend = _MemBackend()
+    sink = ObjectStoreSink(backend, fetch, source_path="/")
+    asyncio.run(sink.apply(_event("/data", entry)))
+    assert backend.objects["data/big.bin"] == b"A" * 10 + b"B" * 6 + b"C" * 4
+
+
+def test_expand_manifest_chunks_nested():
+    inner = filer_pb2.FileChunkManifest(chunks=[chunk("1,x", 0, 3)])
+    outer = filer_pb2.FileChunkManifest(
+        chunks=[chunk("1,inner", 0, 3, manifest=True)]
+    )
+    blobs = {
+        "1,inner": inner.SerializeToString(),
+        "1,outer": outer.SerializeToString(),
+    }
+
+    async def fetch(fid):
+        return blobs[fid]
+
+    flat = asyncio.run(
+        expand_data_chunks(fetch, [chunk("1,outer", 0, 3, manifest=True)])
+    )
+    assert [c.file_id for c in flat] == ["1,x"]
+
+
+def test_truncate_expands_manifest_and_splits_boundary(monkeypatch):
+    from seaweedfs_tpu.mount.weedfs import WeedFS
+
+    fs = WeedFS("127.0.0.1:1", chunk_size=1024)
+
+    # file: data chunk [0,1024) + manifest spanning [1024, 1024+8192)
+    # whose children are 1024-byte chunks; truncate to 1024 + 2x1024 + 500
+    children = [
+        chunk(f"1,c{i}", 1024 + i * 1024, 1024, ts=i) for i in range(8)
+    ]
+    manifest = filer_pb2.FileChunkManifest(chunks=children)
+    entry = filer_pb2.Entry(name="f")
+    entry.chunks.append(chunk("1,head", 0, 1024))
+    entry.chunks.append(chunk("1,m", 1024, 8192, manifest=True))
+    entry.attributes.file_size = 1024 + 8192
+
+    async def find(path, fresh=False):
+        return entry
+
+    async def fetch_blob(fid):
+        assert fid == "1,m"
+        return manifest.SerializeToString()
+
+    reads = []
+
+    async def read_range(path, off, size):
+        reads.append((off, size))
+        return b"x" * size
+
+    uploads = []
+
+    async def assign_upload(data):
+        uploads.append(len(data))
+        return f"1,u{len(uploads)}"
+
+    updated = {}
+
+    async def update_entry(path, e):
+        updated["entry"] = e
+
+    monkeypatch.setattr(fs, "_find", find)
+    monkeypatch.setattr(fs, "_fetch_chunk_raw", fetch_blob)
+    monkeypatch.setattr(fs, "_read_range", read_range)
+    monkeypatch.setattr(fs, "_assign_upload", assign_upload)
+    monkeypatch.setattr(fs, "_update_entry", update_entry)
+
+    new_size = 1024 + 2 * 1024 + 500
+    asyncio.run(fs._truncate_entry("/f", new_size))
+
+    e = updated["entry"]
+    assert e.attributes.file_size == new_size
+    # kept: head + the two whole children below the boundary
+    kept = sorted((c.offset, int(c.size)) for c in e.chunks)
+    assert (0, 1024) in kept
+    assert (1024, 1024) in kept and (2048, 1024) in kept
+    # the straddle re-upload covered only [3072, 3572), in <=chunk_size
+    # pieces, NOT the manifest's whole span from 1024
+    assert reads == [(3072, 500)]
+    assert all(u <= 1024 for u in uploads)
+    assert not any(c.is_chunk_manifest for c in e.chunks)
+    # no chunk extends past the new size
+    assert max(c.offset + int(c.size) for c in e.chunks) == new_size
+
+
+def test_delete_unused_chunks_is_manifest_aware():
+    """Folding data chunks into a manifest (entry update old=[d1..d4],
+    new=[manifest(d1..d4)]) must NOT GC the live data chunks; dropping a
+    manifest must GC its children too (reference MinusChunks)."""
+    from seaweedfs_tpu.filer.filer import Filer
+    from seaweedfs_tpu.filer.filerstore import MemoryStore
+
+    children = [chunk(f"1,d{i}", i * 10, 10) for i in range(4)]
+    manifest_blob = filer_pb2.FileChunkManifest(
+        chunks=children
+    ).SerializeToString()
+    mchunk = chunk("1,m", 0, 40, manifest=True)
+
+    deleted = []
+
+    async def delete_ids(fids):
+        deleted.extend(fids)
+
+    async def fetch(c):
+        assert c.file_id == "1,m"
+        return manifest_blob
+
+    f = Filer(
+        MemoryStore(), delete_file_ids_fn=delete_ids, fetch_manifest_fn=fetch
+    )
+    # fold: children survive (reachable through the manifest)
+    asyncio.run(f.delete_unused_chunks(children, [mchunk]))
+    assert deleted == []
+    # unfold: manifest blob deleted, children survive (now direct)
+    asyncio.run(f.delete_unused_chunks([mchunk], children))
+    assert deleted == ["1,m"]
+    # drop everything: manifest AND its children deleted
+    deleted.clear()
+    asyncio.run(f.delete_unused_chunks([mchunk], []))
+    assert sorted(deleted) == ["1,d0", "1,d1", "1,d2", "1,d3", "1,m"]
+    # no fetch hook: leak rather than lose data
+    f2 = Filer(MemoryStore(), delete_file_ids_fn=delete_ids)
+    deleted.clear()
+    asyncio.run(f2.delete_unused_chunks([mchunk], []))
+    assert deleted == []
+
+
+def test_s3_client_get_object_size_zero():
+    from seaweedfs_tpu.s3api.client import S3Client
+
+    c = S3Client("127.0.0.1:1", "ak", "sk")
+
+    def boom(*a, **kw):  # pragma: no cover - must not be reached
+        raise AssertionError("size=0 read must not issue a request")
+
+    c._request = boom
+    assert c.get_object("b", "k", offset=5, size=0) == b""
