@@ -1,0 +1,116 @@
+"""Round-4 TPU validation: fused resident kernel + blockdiag bench + overlap.
+
+One TPU session (single-client device) checking, in order:
+  1. the fused DMA gather+reconstruct kernel compiles on real Mosaic and
+     matches the numpy oracle;
+  2. its device-stream time per needle (the co-located projection) vs the
+     round-3 chain and the 0.97 ms CPU-kernel target;
+  3. blockdiag + plain encode devtime (expect ~152 / ~123 GB/s);
+  4. e2e encode pipeline overlap with the worker-thread design.
+
+Writes findings to stdout; conclusions get promoted into ops/rs_tpu.py /
+BENCH via bench.py.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import bench as benchmod
+
+    err = benchmod.probe_tpu()
+    if err:
+        print(json.dumps({"error": err}))
+        sys.exit(1)
+
+    import jax
+
+    from seaweedfs_tpu.ops import rs, rs_resident, rs_tpu
+    from seaweedfs_tpu.utils import devtime
+
+    assert rs_tpu.on_tpu(), jax.default_backend()
+    out = {}
+
+    # ---- 1+2: fused resident kernel ----
+    L = 8 * 1024 * 1024
+    rng = np.random.default_rng(7)
+    codec = rs.RSCodec(backend="native")
+    data = rng.integers(0, 256, size=(10, L), dtype=np.uint8)
+    shards = codec.encode_all(data)
+    cache = rs_resident.DeviceShardCache(shard_quantum=1 << 24)
+    for sid in range(14):
+        if sid not in (3, 11):
+            cache.put(1, sid, shards[sid])
+
+    t0 = time.time()
+    reqs = [(3, 5, 100), (3, 131, 4000), (11, 70000, 30000)]
+    try:
+        got = rs_resident.reconstruct_intervals(
+            cache, 1, reqs, kernel="pallas", interpret=False
+        )
+        for (sid, off, size), g in zip(reqs, got):
+            assert g == shards[sid][off : off + size].tobytes(), (off, size)
+        out["fused_correct"] = True
+        out["fused_first_compile_s"] = round(time.time() - t0, 1)
+    except Exception as e:  # noqa: BLE001 — report and keep going
+        out["fused_correct"] = False
+        out["fused_error"] = repr(e)[:500]
+        print(json.dumps(out))
+        sys.exit(0)
+
+    # device-stream time per needle, batched 64, per size (projection)
+    batch = 64
+    for size in (4096, 65536, 1048576):
+        reqs = [
+            (3, int(rng.integers(0, L - size)), size) for _ in range(batch)
+        ]
+        thunk = rs_resident.make_batched_call(cache, 1, reqs)
+        ms = devtime.device_avg_ms(thunk, n=6)
+        out[f"fused_dev_ms_per_needle_{size}"] = round(ms / batch, 4)
+    # single-needle call (count bucket 1)
+    for size in (4096, 1048576):
+        reqs = [(3, int(rng.integers(0, L - size)), size)]
+        thunk = rs_resident.make_batched_call(cache, 1, reqs)
+        ms = devtime.device_avg_ms(thunk, n=6)
+        out[f"fused_dev_ms_single_{size}"] = round(ms, 4)
+
+    # on-rig wall p99, batched (includes tunnel RTT + D2H)
+    lats = []
+    for i in range(12):
+        size = (4096, 65536, 1048576)[i % 3]
+        reqs = [
+            (3, int(rng.integers(0, L - size)), size) for _ in range(batch)
+        ]
+        t0 = time.perf_counter()
+        rs_resident.reconstruct_intervals(cache, 1, reqs)
+        lats.append((time.perf_counter() - t0) / batch)
+    out["fused_wall_p99_ms_batched"] = round(
+        float(np.percentile(np.asarray(lats) * 1e3, 99)), 3
+    )
+    cache.clear()
+
+    # ---- 3: encode kernels, devtime primary + loop cross-check ----
+    parity_m = rs.RSCodec().matrix[10:]
+    enc, kernel = benchmod.bench_device_encode(parity_m, mb=256)
+    out["encode"] = {k: round(v / 1e9, 2) for k, v in enc.items()}
+    out["kernel"] = kernel
+
+    # ---- 4: e2e overlap ----
+    e2e, stats = benchmod.bench_e2e_encode("pallas", mb=64, warm=True)
+    out["e2e_gbps"] = round(e2e / 1e9, 4)
+    out["e2e_stats"] = {
+        k: round(v, 3) if isinstance(v, float) else v for k, v in stats.items()
+    }
+    out["e2e_overlap"] = round(benchmod.overlap_fraction(stats), 3)
+
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

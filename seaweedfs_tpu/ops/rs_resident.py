@@ -31,6 +31,8 @@ from collections import OrderedDict
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from . import gf256, rs_tpu
 
@@ -38,12 +40,16 @@ DATA_SHARDS = 10
 TOTAL_SHARDS = 14
 
 LANE = 128  # TPU lane tile: device slices start lane-aligned
+# The fused kernel's DMA source is a (1024)-tiled 1-D HBM memref: Mosaic
+# must PROVE slice starts divisible by 1024, so fused offsets align down
+# to this and the <=1023-byte residual joins the host-trimmed delta.
+FUSED_ALIGN = 1024
 SIZE_BUCKETS = (2048, 8192, 32768, 131072, 524288, 2 * 1024 * 1024)
 COUNT_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
 MAX_TILE = SIZE_BUCKETS[-1]
 # split oversized intervals into chunks that fit the largest bucket even
-# after the <=LANE-1 alignment residual
-CHUNK = MAX_TILE - LANE
+# after the <=FUSED_ALIGN-1 alignment residual
+CHUNK = MAX_TILE - FUSED_ALIGN
 SHARD_QUANTUM = 64 * 1024 * 1024
 
 
@@ -142,6 +148,176 @@ def _prepared_matrix(matrix_bytes: bytes, m: int, k: int):
     )
 
 
+# --- fused gather+reconstruct kernel ----------------------------------------
+#
+# The round-3 serving path ran FOUR chained XLA ops per call (vmap
+# dynamic_slice gather -> stack/reshape -> pallas matmul -> take_along_axis
+# -> vmap slice): every stage round-trips HBM and the chain costs several
+# dispatches of fixed overhead per 4KB needle.  The fused kernel does the
+# whole thing in ONE pallas program: per grid step it DMAs each survivor's
+# slice HBM->VMEM at a scalar-prefetched offset, unpacks to GF(2) bit
+# planes, runs the MXU dot, packs, and row-selects the wanted shard — no
+# gathered intermediate ever touches HBM.  The sub-lane `delta` trim
+# happens on host after D2H (<=127 bytes per needle of extra wire).
+#
+# Mosaic layout constraints (probed on v5e, experiments/r4_fused_probe.py +
+# the memref_slice divisibility errors that followed):
+#   * output/VMEM blocks need their second-minor dim divisible by 8 (or
+#     equal to the array dim) — so each grid step serves a GROUP of 8
+#     requests, output block (8, tile);
+#   * DMA slice starts must be PROVABLY divisible by the memref tiling
+#     (1024 for 1-D u8) — offsets travel in FUSED_ALIGN units and multiply
+#     in-kernel, and every destination offset is a static multiple of tile;
+#   * single-row slices of 2-D VMEM scratch are rejected (sublane tile 8),
+#     and 1-D->2-D reshapes relayout — so the gather lands in a FLAT 1-D
+#     HBM buffer laid out so a free XLA reshape yields [chunks, G, k, W],
+#     which a second, regular-BlockSpec kernel consumes (block (1,1,k,W):
+#     leading dims are unconstrained, trailing dims equal the array's);
+#   * jax.lax.dynamic_slice has no Mosaic lowering — the per-request row
+#     select is an iota-mask reduction.
+# Both pallas calls live in ONE jit: a single host dispatch, and the only
+# intermediate (the gathered slices) never rides the host link.
+
+FUSED_GROUP = 8  # requests per grid step (output sublane tile)
+FUSED_TILE = 4096  # per-request lane chunk; x8 group = 32768-lane compute
+                   # width (bits 4MB + counts 4MB int32 in VMEM)
+
+
+def _make_gather_body(k: int, g_n: int, tile: int, n_groups: int):
+    w = g_n * tile
+
+    def body(offs_ref, *rest):
+        surv = rest[:k]
+        o_ref = rest[k]
+        sems = rest[k + 1]
+        g = pl.program_id(0)
+        j = pl.program_id(1)
+        copies = []
+        for r in range(g_n):
+            # the explicit multiply is what lets Mosaic PROVE alignment
+            src = offs_ref[g * g_n + r] * FUSED_ALIGN + j * tile
+            for i in range(k):
+                dst = ((j * n_groups + g) * k + i) * w + r * tile
+                copies.append(
+                    pltpu.make_async_copy(
+                        surv[i].at[pl.ds(src, tile)],
+                        o_ref.at[pl.ds(dst, tile)],
+                        sems.at[i, r],
+                    )
+                )
+        for c in copies:
+            c.start()
+        for c in copies:
+            c.wait()
+
+    return body
+
+
+def _make_select_body(k: int, k_pad: int, m_pad: int, g_n: int, tile: int):
+    w = g_n * tile
+
+    def body(rows_ref, a_ref, x_ref, o_ref):
+        g = pl.program_id(0)
+        xv = x_ref[0, 0]  # (k, w); leading unit dims index away for free
+        if k < k_pad:
+            xv = jnp.concatenate(
+                [xv, jnp.zeros((k_pad - k, w), jnp.uint8)], axis=0
+            )
+        bits = rs_tpu._unpack_bits_bitmajor(xv)
+        counts = jnp.dot(a_ref[:], bits, preferred_element_type=jnp.int32)
+        packed = rs_tpu._pack_bits_bitmajor(counts, m_pad)  # (m_pad, w)
+        ridx = jax.lax.broadcasted_iota(jnp.int32, (m_pad, tile), 0)
+        outs = []
+        for r in range(g_n):
+            row = rows_ref[g * g_n + r]
+            blk = packed[:, r * tile : (r + 1) * tile]
+            sel = jnp.where(ridx == row, blk, jnp.uint8(0)).astype(jnp.int32)
+            outs.append(jnp.sum(sel, axis=0, keepdims=True).astype(jnp.uint8))
+        o_ref[:] = jnp.concatenate(outs, axis=0)
+
+    return body
+
+
+@functools.partial(
+    jax.jit, static_argnames=("tile", "fetch", "k_true", "interpret")
+)
+def _fused_reconstruct(
+    a_bm, survivors, offsets, row_idx, *, tile, fetch, k_true, interpret
+):
+    """survivors: tuple of [L] u8 resident shards (HBM) in matrix column
+    order; offsets [N] int32 in FUSED_ALIGN units (byte offset /
+    FUSED_ALIGN); row_idx [N] int32.  -> [N, fetch] u8 of raw
+    reconstructed bytes starting at each aligned offset (caller trims the
+    delta head).  N pads to the 8-request group internally.  Returns the
+    [N, fetch] result FLATTENED (1-D, true-N rows only): 2-D transfers
+    pay a per-row tunnel cost; callers reshape host-side."""
+    k = len(survivors)
+    if k_true is not None and k != k_true:
+        raise ValueError(f"{k} survivors but matrix was built for {k_true}")
+    m_pad8, k_pad8 = a_bm.shape
+    m_pad, k_pad = m_pad8 // 8, k_pad8 // 8
+    n = offsets.shape[0]
+    pad = (-n) % FUSED_GROUP
+    if pad:
+        offsets = jnp.pad(offsets, (0, pad))
+        row_idx = jnp.pad(row_idx, (0, pad))
+    n_pad = n + pad
+    tile = min(tile, fetch)
+    chunks = max(1, fetch // tile)
+    n_groups = n_pad // FUSED_GROUP
+    w = FUSED_GROUP * tile
+
+    gathered = pl.pallas_call(
+        _make_gather_body(k, FUSED_GROUP, tile, n_groups),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n_groups, chunks),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * k,
+            out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((k, FUSED_GROUP))],
+        ),
+        out_shape=jax.ShapeDtypeStruct((chunks * n_groups * k * w,), jnp.uint8),
+        cost_estimate=pl.CostEstimate(
+            flops=0,
+            bytes_accessed=2 * chunks * n_groups * k * w,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(offsets, *survivors)
+    x4 = gathered.reshape(chunks, n_groups, k, w)  # contiguous: free
+
+    out = pl.pallas_call(
+        _make_select_body(k, k_pad, m_pad, FUSED_GROUP, tile),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n_groups, chunks),
+            in_specs=[
+                pl.BlockSpec(
+                    a_bm.shape, lambda *_: (0, 0), memory_space=pltpu.VMEM
+                ),
+                pl.BlockSpec(
+                    (1, 1, k, w),
+                    lambda gi, ji, *_: (ji, gi, 0, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (FUSED_GROUP, tile),
+                lambda gi, ji, *_: (gi, ji),
+                memory_space=pltpu.VMEM,
+            ),
+        ),
+        out_shape=jax.ShapeDtypeStruct((n_pad, fetch), jnp.uint8),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m_pad8 * k_pad8 * n_pad * fetch,
+            bytes_accessed=(k + 1) * n_pad * fetch,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(row_idx, a_bm, x4)
+    return (out[:n] if pad else out).reshape(-1)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("tile", "fetch", "kernel", "interpret", "k_true"),
@@ -167,7 +343,9 @@ def _gather_reconstruct(
     `tile` is the compute width (size bucket); `fetch` <= tile is the D2H
     width (power-of-two cover of the largest actual request): the result
     is delta-shifted and narrowed ON DEVICE so the transfer back — the
-    scarce resource on a tunneled device — carries only useful bytes."""
+    scarce resource on a tunneled device — carries only useful bytes.
+    Returns the [N, fetch] result FLATTENED (1-D): 2-D transfers pay a
+    per-row tunnel cost; callers reshape host-side."""
     cols = [
         jax.vmap(
             lambda off, arr=arr: jax.lax.dynamic_slice(arr, (off,), (tile,))
@@ -189,7 +367,7 @@ def _gather_reconstruct(
         sel = jax.vmap(
             lambda row, d: jax.lax.dynamic_slice(row, (d,), (fetch,))
         )(sel, deltas)
-    return sel
+    return sel.reshape(-1)
 
 
 def _plan(requests: list[tuple[int, int, int]]):
@@ -212,6 +390,72 @@ def _plan(requests: list[tuple[int, int, int]]):
             pos += take
             remaining -= take
     return subs
+
+
+def _resolve_codec(cache, vid, requests, data_shards, total_shards):
+    """Shared preamble: reconstruction matrix + resident survivor tuple."""
+    wanted = sorted({r[0] for r in requests})
+    resident = cache.shard_ids(vid)
+    present = [s for s in resident if s not in wanted]
+    if len(present) < data_shards:
+        raise CacheMiss(
+            f"vid {vid}: {len(present)} resident survivors, need {data_shards}"
+        )
+    rmat, use = gf256.reconstruction_matrix(
+        data_shards, total_shards, present, wanted
+    )
+    a_bm = _prepared_matrix(rmat.tobytes(), *rmat.shape)
+    survivors = tuple(cache.get(vid, s) for s in use)
+    if any(s is None for s in survivors):  # evicted between listing and get
+        raise CacheMiss(f"vid {vid}: survivor shard evicted mid-request")
+    row_of = {sid: i for i, sid in enumerate(wanted)}
+    return a_bm, survivors, row_of, use
+
+
+def _group_vectors(part, requests, row_of, pad):
+    offsets = jnp.asarray(
+        np.array([s[1] for _, s in part] + [0] * pad, dtype=np.int32)
+    )
+    rows = jnp.asarray(
+        np.array(
+            [row_of[requests[s[0]][0]] for _, s in part] + [0] * pad,
+            dtype=np.int32,
+        )
+    )
+    deltas = jnp.asarray(
+        np.array([s[2] for _, s in part] + [0] * pad, dtype=np.int32)
+    )
+    return offsets, rows, deltas
+
+
+def _fused_vectors(part, requests, row_of, pad):
+    """Re-align each sub-request down to FUSED_ALIGN: offsets become unit
+    counts, the residual joins the host-trimmed delta.  -> (offs_units,
+    rows, deltas, fetch) with fetch a power-of-two cover of the largest
+    delta+take (CHUNK keeps it <= MAX_TILE)."""
+    offs_units, deltas = [], []
+    for _, s in part:
+        extra = s[1] % FUSED_ALIGN
+        offs_units.append((s[1] - extra) // FUSED_ALIGN)
+        deltas.append(s[2] + extra)
+    span = max(d + s[3] for d, (_, s) in zip(deltas, part))
+    fetch = max(1 << (span - 1).bit_length(), 2048)
+    offsets = jnp.asarray(
+        np.array(offs_units + [0] * pad, dtype=np.int32)
+    )
+    rows = jnp.asarray(
+        np.array(
+            [row_of[requests[s[0]][0]] for _, s in part] + [0] * pad,
+            dtype=np.int32,
+        )
+    )
+    return offsets, rows, deltas, fetch
+
+
+def _use_fused(kernel: str, interpret: bool) -> bool:
+    """The fused DMA kernel is the serving path on real TPUs; interpret
+    mode also supports it (tests), but the XLA fallback kernel cannot."""
+    return kernel == "pallas"
 
 
 def reconstruct_intervals(
@@ -237,22 +481,10 @@ def reconstruct_intervals(
         kernel = "pallas" if rs_tpu.on_tpu() else "xla"
     if interpret is None:
         interpret = not rs_tpu.on_tpu()
-
-    wanted = sorted({r[0] for r in requests})
-    resident = cache.shard_ids(vid)
-    present = [s for s in resident if s not in wanted]
-    if len(present) < data_shards:
-        raise CacheMiss(
-            f"vid {vid}: {len(present)} resident survivors, need {data_shards}"
-        )
-    rmat, use = gf256.reconstruction_matrix(
-        data_shards, total_shards, present, wanted
+    a_bm, survivors, row_of, use = _resolve_codec(
+        cache, vid, requests, data_shards, total_shards
     )
-    a_bm = _prepared_matrix(rmat.tobytes(), *rmat.shape)
-    survivors = tuple(cache.get(vid, s) for s in use)
-    if any(s is None for s in survivors):  # evicted between listing and get
-        raise CacheMiss(f"vid {vid}: survivor shard evicted mid-request")
-    row_of = {sid: i for i, sid in enumerate(wanted)}
+    fused = _use_fused(kernel, interpret)
 
     subs = _plan(requests)
     sub_out: list[bytes | None] = [None] * len(subs)
@@ -264,43 +496,112 @@ def reconstruct_intervals(
         for start in range(0, len(group), n_bucket):
             part = group[start : start + n_bucket]
             pad = n_bucket - len(part)
-            offsets = jnp.asarray(
-                np.array([s[1] for _, s in part] + [0] * pad, dtype=np.int32)
-            )
-            rows = jnp.asarray(
-                np.array(
-                    [row_of[requests[s[0]][0]] for _, s in part] + [0] * pad,
-                    dtype=np.int32,
+            if fused:
+                # fetch covers the realigned delta+take (the host trims
+                # the delta head after D2H; no in-kernel shift needed)
+                offsets, rows, deltas, fetch = _fused_vectors(
+                    part, requests, row_of, pad
                 )
-            )
-            deltas = jnp.asarray(
-                np.array([s[2] for _, s in part] + [0] * pad, dtype=np.int32)
-            )
-            # D2H width: power-of-two cover of the largest actual request
-            # in this call, never wider than the compute tile
-            max_take = max(s[3] for _, s in part)
-            fetch = min(bucket, 1 << (max_take - 1).bit_length())
-            out = np.asarray(
-                _gather_reconstruct(
-                    a_bm,
-                    survivors,
-                    offsets,
-                    rows,
-                    deltas,
-                    tile=bucket,
-                    fetch=fetch,
-                    kernel=kernel,
-                    interpret=interpret,
-                    k_true=len(use),
+                out = np.asarray(
+                    _fused_reconstruct(
+                        a_bm,
+                        survivors,
+                        offsets,
+                        rows,
+                        tile=min(fetch, FUSED_TILE),
+                        fetch=fetch,
+                        k_true=len(use),
+                        interpret=interpret,
+                    )
+                ).reshape(-1, fetch)
+                for j, (sub_idx, (_, _, _, take, _)) in enumerate(part):
+                    d = deltas[j]
+                    sub_out[sub_idx] = out[j, d : d + take].tobytes()
+            else:
+                offsets, rows, deltas = _group_vectors(
+                    part, requests, row_of, pad
                 )
-            )
-            for j, (sub_idx, (_, _, delta, take, _)) in enumerate(part):
-                lo = 0 if fetch < bucket else delta
-                sub_out[sub_idx] = out[j, lo : lo + take].tobytes()
+                # D2H width: power-of-two cover of the largest actual
+                # request in this call, never wider than the compute tile
+                max_take = max(s[3] for _, s in part)
+                fetch = min(bucket, 1 << (max_take - 1).bit_length())
+                out = np.asarray(
+                    _gather_reconstruct(
+                        a_bm,
+                        survivors,
+                        offsets,
+                        rows,
+                        deltas,
+                        tile=bucket,
+                        fetch=fetch,
+                        kernel=kernel,
+                        interpret=interpret,
+                        k_true=len(use),
+                    )
+                ).reshape(-1, fetch)
+                for j, (sub_idx, (_, _, delta, take, _)) in enumerate(part):
+                    lo = 0 if fetch < bucket else delta
+                    sub_out[sub_idx] = out[j, lo : lo + take].tobytes()
     outputs: list[list[bytes]] = [[] for _ in requests]
     for (idx, *_), piece in zip(subs, sub_out):
         outputs[idx].append(piece)  # subs are in offset order per request
     return [b"".join(parts) for parts in outputs]
+
+
+def make_batched_call(
+    cache: DeviceShardCache,
+    vid: int,
+    requests: list[tuple[int, int, int]],
+    kernel: str | None = None,
+    interpret: bool | None = None,
+):
+    """Zero-arg thunk running the ONE device call a homogeneous batch of
+    requests (same size bucket, count <= COUNT_BUCKETS[-1]) maps to,
+    returning the un-copied device array — bench.py profiler-times the
+    serving call with this, without host copies in the measured region."""
+    if kernel is None:
+        kernel = "pallas" if rs_tpu.on_tpu() else "xla"
+    if interpret is None:
+        interpret = not rs_tpu.on_tpu()
+    a_bm, survivors, row_of, use = _resolve_codec(
+        cache, vid, requests, DATA_SHARDS, TOTAL_SHARDS
+    )
+    subs = _plan(requests)
+    buckets = {s[4] for s in subs}
+    if len(buckets) != 1 or len(subs) > COUNT_BUCKETS[-1]:
+        raise ValueError("bench batch must be one homogeneous bucket group")
+    bucket = buckets.pop()
+    part = list(enumerate(subs))
+    pad = _bucket(COUNT_BUCKETS, len(part)) - len(part)
+    if _use_fused(kernel, interpret):
+        offsets, rows, _deltas, fetch = _fused_vectors(
+            part, requests, row_of, pad
+        )
+        return lambda: _fused_reconstruct(
+            a_bm,
+            survivors,
+            offsets,
+            rows,
+            tile=min(fetch, FUSED_TILE),
+            fetch=fetch,
+            k_true=len(use),
+            interpret=interpret,
+        )
+    offsets, rows, deltas = _group_vectors(part, requests, row_of, pad)
+    max_take = max(s[3] for _, s in part)
+    fetch = min(bucket, 1 << (max_take - 1).bit_length())
+    return lambda: _gather_reconstruct(
+        a_bm,
+        survivors,
+        offsets,
+        rows,
+        deltas,
+        tile=bucket,
+        fetch=fetch,
+        kernel=kernel,
+        interpret=interpret,
+        k_true=len(use),
+    )
 
 
 def warm(
@@ -327,5 +628,9 @@ def warm(
             return
     for size in sizes:
         for count in counts:
-            reqs = [(missing, 0, size)] * count
-            reconstruct_intervals(cache, vid, reqs, **kw)
+            # both alignment classes: an aligned offset keeps fetch at
+            # cover(size); any other offset pushes the span past it into
+            # the next power of two — each is its own compiled shape
+            for off in (0, 1):
+                reqs = [(missing, off, size)] * count
+                reconstruct_intervals(cache, vid, reqs, **kw)

@@ -4,9 +4,12 @@ Reference behavior: /root/reference/weed/storage/erasure_coding/ec_encoder.go
 (WriteEcFiles :57, RebuildEcFiles :61, encodeDatFile :194, rebuildEcFiles
 :233).  The reference streams 256KB-per-shard buffers through a CPU SIMD
 encoder one batch at a time; here the unit of work is a [10, stride] uint8
-stripe batch handed to the RS codec, and on device backends batches are
-double-buffered so host file reads overlap device compute and transfers
-(jax dispatch is async — the result is only blocked on when written out).
+stripe batch handed to the RS codec, and on device backends the whole
+device leg (host staging -> H2D -> kernel -> D2H) runs on a dedicated
+worker thread while the caller keeps reading/writing files — measured
+overlap, not just async dispatch (the H2D transfer itself blocks, so
+dispatching from the reader thread would serialize the pipeline; see
+bench.py's encode_e2e_device_overlap_fraction).
 
 File formats are byte-identical to the reference, so `.ec00-.ec13` produced
 here can be mounted by a Go volume server and vice versa.
@@ -16,6 +19,7 @@ from __future__ import annotations
 import os
 import time
 from collections import deque
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Iterator
 
 import numpy as np
@@ -34,7 +38,10 @@ from .layout import (
 # 40MB input per batch: large enough to saturate the MXU kernel (tile sweep
 # in ops/rs_tpu.py), small enough to double-buffer in HBM comfortably.
 DEFAULT_STRIDE = 4 * 1024 * 1024
-_PIPELINE_DEPTH = 2
+# In-flight batches: the reader may run this far ahead of the device worker
+# before blocking.  3 keeps one batch staging, one on the wire, one landing
+# without ballooning host memory (each batch is ~stride*10 bytes).
+_PIPELINE_DEPTH = 3
 
 
 def ec_base_name(dirname: str, vid: int, collection: str = "") -> str:
@@ -44,15 +51,23 @@ def ec_base_name(dirname: str, vid: int, collection: str = "") -> str:
 
 
 class _Codec:
-    """Wraps RSCodec so device backends can run async (pipelined) while CPU
-    backends stay synchronous.  submit() returns an opaque handle; resolve()
-    turns it into a numpy [m, stride] array."""
+    """Wraps RSCodec so device backends can run pipelined while CPU backends
+    stay synchronous.  submit() returns an opaque handle immediately;
+    resolve() turns it into a numpy [m, stride] parity array.
+
+    Device path: one worker thread owns the whole device leg — stage the
+    block-diagonal layout, jax.device_put, dispatch the kernel, fetch the
+    result — because on a tunneled device both transfers BLOCK; run from
+    the caller they would serialize against file reads/writes.  The caller
+    overlaps its host work with the worker; `busy_s` accumulates the
+    worker's active time (the overlap denominator in bench.py)."""
 
     def __init__(self, matrix: np.ndarray, backend: str):
         self.backend = rs.resolve_backend(backend)
         self.matrix = np.asarray(matrix, dtype=np.uint8)
         self.rows = self.matrix.shape[0]
         self.device = self.backend in ("xla", "pallas")
+        self.busy_s = 0.0
         if self.device:
             from ...ops import rs_tpu
 
@@ -60,48 +75,66 @@ class _Codec:
             self._a_bm = rs_tpu.prepare_matrix(self.matrix)
             self._a_blk = rs_tpu.prepare_matrix_blockdiag(self.matrix)
             self._interpret = not rs_tpu.on_tpu()
+            self._pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ec-dev"
+            )
         else:
             self._codec = rs.RSCodec(backend=self.backend)
 
     def submit(self, shards: np.ndarray):
         if self.device:
-            import jax.numpy as jnp
+            return self._pool.submit(self._device_leg, shards)
+        return self._codec.apply_matrix(self.matrix, shards)
 
-            groups = self._tpu.BLOCKDIAG_GROUPS
-            if (
-                self.backend == "pallas"
-                and shards.shape[1] % (groups * 128) == 0
-            ):
-                # block-diagonal fast path: host stages segment-stacked
-                # rows (free — same bytes) and the MXU runs with a full M
-                # dimension (~152 vs ~123 GB/s, see ops/rs_tpu.py header)
-                x = jnp.asarray(
-                    np.ascontiguousarray(self._tpu.stack_segments(shards))
-                )
-                return (
-                    "blk",
-                    self._tpu.apply_matrix_device_blockdiag(
-                        self._a_blk, x, interpret=self._interpret
-                    ),
-                )
-            x = jnp.asarray(np.ascontiguousarray(shards))
-            return (
-                "plain",
-                self._tpu.apply_matrix_device(
-                    self._a_bm,
-                    x,
-                    kernel=self.backend,
-                    interpret=self._interpret,
-                    k_true=self.matrix.shape[1],
-                ),
+    def _device_leg(self, shards: np.ndarray) -> np.ndarray:
+        """Both transfers ship FLAT 1-D buffers (apply_matrix_device_flat):
+        the tunnel pays ~80ms per row on 2-D arrays, which would dominate
+        the whole pipeline."""
+        import jax
+
+        t0 = time.perf_counter()
+        groups = self._tpu.BLOCKDIAG_GROUPS
+        k, b = shards.shape
+        if self.backend == "pallas" and b % (groups * 128) == 0:
+            # block-diagonal fast path: host stages segment-stacked rows
+            # (free — same bytes) and the MXU runs with a full M dimension
+            # (~152 vs ~123 GB/s, see ops/rs_tpu.py header)
+            stacked = np.ascontiguousarray(self._tpu.stack_segments(shards))
+            x = jax.device_put(stacked.reshape(-1))
+            out = self._tpu.apply_matrix_device_flat(
+                self._a_blk,
+                x,
+                k=groups * k,
+                m=groups * self.rows,
+                tile=self._tpu.BLOCKDIAG_TILE,
+                interpret=self._interpret,
             )
-        return ("plain", self._codec.apply_matrix(self.matrix, shards))
+            seg = b // groups
+            parity = self._tpu.unstack_segments(
+                np.asarray(out).reshape(groups * self.rows, seg), self.rows
+            )
+        else:
+            x = jax.device_put(np.ascontiguousarray(shards).reshape(-1))
+            out = self._tpu.apply_matrix_device_flat(
+                self._a_bm,
+                x,
+                k=k,
+                m=self.rows,
+                kernel=self.backend,
+                interpret=self._interpret,
+            )
+            parity = np.asarray(out).reshape(self.rows, b)
+        self.busy_s += time.perf_counter() - t0
+        return parity
 
     def resolve(self, handle) -> np.ndarray:
-        kind, out = handle
-        if kind == "blk":
-            return self._tpu.unstack_segments(np.asarray(out), self.rows)
-        return np.asarray(out)[: self.rows]
+        if isinstance(handle, Future):
+            return handle.result()
+        return handle
+
+    def shutdown(self) -> None:
+        if self.device:
+            self._pool.shutdown(wait=True)
 
 
 def _iter_rows(
@@ -152,9 +185,11 @@ def write_ec_files(
     `fsync=True` makes the shard files durable before returning (the
     benchmark's honest-throughput mode).  `stats`, when passed, is filled
     with the pipeline's wall-clock decomposition — read_s (host pread +
-    stripe staging), submit_s (kernel dispatch), wait_s (blocking on
-    device results), write_s (shard file writes), wall_s, batches — the
-    numbers behind any staging-overlap claim."""
+    stripe staging), submit_s (handing the batch to the device worker),
+    wait_s (blocking on device results), write_s (shard file writes),
+    device_busy_s (the worker's active stage+transfer+kernel+fetch time),
+    wall_s, batches — the numbers behind any staging-overlap claim:
+    overlap happened iff read_s+write_s+device_busy_s > wall_s."""
     dat_path = base_name + ".dat"
     dat_size = os.path.getsize(dat_path)
     codec = _Codec(rs.RSCodec().matrix[DATA_SHARDS:], backend)
@@ -175,7 +210,7 @@ def write_ec_files(
     outputs = [open(base_name + to_ext(i), "wb") for i in range(TOTAL_SHARDS)]
     inflight: deque[tuple[np.ndarray, object]] = deque()
     t = {"read_s": 0.0, "submit_s": 0.0, "wait_s": 0.0, "write_s": 0.0,
-         "batches": 0}
+         "fsync_s": 0.0, "batches": 0}
     clock = time.perf_counter
     t_start = clock()
 
@@ -210,14 +245,21 @@ def write_ec_files(
         while inflight:
             drain_one()
         if fsync:
+            # separate clock: the final fsync follows the LAST write by
+            # definition, so it can never overlap the device leg — it is
+            # durability tail latency, not hideable host work
+            t0 = clock()
             for o in outputs:
                 o.flush()
                 os.fsync(o.fileno())
+            t["fsync_s"] += clock() - t0
     finally:
+        codec.shutdown()
         for o in outputs:
             o.close()
     if stats is not None:
         t["wall_s"] = clock() - t_start
+        t["device_busy_s"] = codec.busy_s
         stats.update(t)
     return dat_size
 
@@ -271,6 +313,7 @@ def rebuild_ec_files(
         while inflight:
             drain_one()
     finally:
+        codec.shutdown()
         for h in list(inputs.values()) + list(outputs.values()):
             h.close()
     return missing
