@@ -49,6 +49,16 @@ def add_args(p) -> None:
         "notification.toml backends)",
     )
     p.add_argument(
+        "-notifyMq", dest="notify_mq", default="",
+        help="publish every metadata change to this MQ broker "
+        "(host:port[.grpc] of `weed mq.broker`) — the network-queue "
+        "notification backend (reference notification.toml kafka)",
+    )
+    p.add_argument(
+        "-notifyMqTopic", dest="notify_mq_topic", default="filer_meta",
+        help="MQ topic for -notifyMq events",
+    )
+    p.add_argument(
         "-metricsPort", dest="metrics_port", type=int, default=0,
         help="prometheus /metrics port (0 = auto-assign)",
     )
@@ -92,6 +102,21 @@ def build_filer_server(args):
         from ..replication.notification import FileQueueNotifier
 
         notifier = FileQueueNotifier(args.notify_spool)
+    elif getattr(args, "notify_mq", ""):
+        from ..pb import server_address
+        from ..replication.notification import MqNotifier
+
+        # comma-separated bootstrap list: translate each element (the
+        # whole string through grpc_address would mangle all but the last)
+        bootstraps = ",".join(
+            server_address.grpc_address(a.strip())
+            for a in args.notify_mq.split(",")
+            if a.strip()
+        )
+        notifier = MqNotifier(
+            bootstraps,
+            topic=getattr(args, "notify_mq_topic", "filer_meta"),
+        )
     return FilerServer(
         masters=[m.strip() for m in args.masters.split(",") if m.strip()],
         store=store,

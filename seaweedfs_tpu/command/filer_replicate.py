@@ -17,8 +17,19 @@ HELP = "replicate a filer's notification-queue changes to another filer"
 
 def add_args(p) -> None:
     p.add_argument(
-        "-spool", required=True,
+        "-spool", default="",
         help="notification spool file (source filer's -notifySpool)",
+    )
+    p.add_argument(
+        "-mqBroker", dest="mq_broker", default="",
+        help="consume the source filer's meta events from this MQ broker "
+        "(host:port[.grpc]; source filer runs -notifyMq) instead of a "
+        "spool file — the network-queue mode, reference "
+        "filer_replication.go over kafka",
+    )
+    p.add_argument(
+        "-mqTopic", dest="mq_topic", default="filer_meta",
+        help="MQ topic the source filer publishes meta events to",
     )
     p.add_argument(
         "-sourceFiler", dest="source_filer", required=True,
@@ -43,6 +54,114 @@ def add_args(p) -> None:
     )
 
 
+async def _consume_mq(args, sink, is_transient) -> tuple[int, int]:
+    """Consume meta events from the MQ broker with committed group offsets
+    (partitions in parallel; per-event commit so a broker or replicator
+    restart resumes exactly after the last applied event).  Transport
+    failures — including the broker restarting mid-stream — reconnect
+    with backoff; only poison events are skipped (offset still commits)."""
+    import asyncio
+
+    from ..mq.client import MqClient
+    from ..pb import filer_pb2, mq_pb2, server_address
+
+    broker = server_address.grpc_address(args.mq_broker)
+    client = MqClient(broker)
+    topic = MqClient.topic(args.mq_topic)
+    group = "replicate"
+
+    # partition layout (and owning brokers, for multi-broker clusters)
+    while True:
+        try:
+            resp = await client._stub().LookupTopicBrokers(
+                mq_pb2.LookupTopicBrokersRequest(topic=topic)
+            )
+            break
+        except Exception as e:  # noqa: BLE001 — broker not up yet
+            if not args.follow:
+                raise SystemExit(f"mq broker unreachable: {e}")
+            await asyncio.sleep(1.0)
+    partition_brokers = list(resp.partition_brokers) or [broker] * max(
+        1, resp.partition_count
+    )
+    counts = {"applied": 0, "skipped": 0}
+
+    async def lookup_owner(idx: int, last_addr: str) -> str:
+        """Re-resolve the partition's CURRENT owner after a stream break:
+        a broker death reassigns partitions, so retrying the old address
+        forever would stall the partition.  Any reachable broker answers
+        (they all compute the same assignment); try the bootstrap broker,
+        the last known owner, and every broker from the last map."""
+        for cand in dict.fromkeys(
+            [broker, last_addr, *partition_brokers]
+        ):
+            try:
+                c = MqClient(cand)
+                r = await c._stub().LookupTopicBrokers(
+                    mq_pb2.LookupTopicBrokersRequest(topic=topic)
+                )
+                owners = list(r.partition_brokers)
+                if owners:
+                    partition_brokers[:] = owners
+                    return owners[idx]
+            except Exception:  # noqa: BLE001 — this broker is down too
+                continue
+        return last_addr
+
+    async def consume_partition(idx: int, addr: str) -> None:
+        pc = MqClient(addr)
+        while True:
+            try:
+                async for offset, key, value in pc.subscribe(
+                    topic,
+                    idx,
+                    consumer_group=group,
+                    start_offset=-1,  # committed, else earliest
+                    tail=args.follow,
+                ):
+                    note = filer_pb2.EventNotification.FromString(value)
+                    d, _, _name = key.decode().rpartition("/")
+                    ev = filer_pb2.SubscribeMetadataResponse(
+                        directory=d or "/", event_notification=note
+                    )
+                    try:
+                        await sink.apply(ev)
+                        counts["applied"] += 1
+                    except Exception as e:  # noqa: BLE001
+                        if is_transient(e):
+                            # resume from the committed offset after a pause
+                            print(f"transient failure at {key}: {e}")
+                            raise
+                        print(f"skip poison event {key}: {e}")
+                        counts["skipped"] += 1
+                    await pc.commit(topic, idx, group, offset + 1)
+                if not args.follow:
+                    return
+            except Exception as e:  # noqa: BLE001 — stream broke (broker
+                # restart, sink hiccup): reconnect and resume at commit
+                if not args.follow:
+                    raise SystemExit(
+                        f"partition {idx}: {e}; committed offset preserved "
+                        "— rerun to resume"
+                    )
+                print(f"partition {idx}: stream interrupted, resuming: {e}")
+                pc.reset()
+                await asyncio.sleep(1.0)
+                new_addr = await lookup_owner(idx, addr)
+                if new_addr != addr:
+                    print(f"partition {idx}: owner moved to {new_addr}")
+                    addr = new_addr
+                    pc = MqClient(addr)
+
+    await asyncio.gather(
+        *(
+            consume_partition(i, addr)
+            for i, addr in enumerate(partition_brokers)
+        )
+    )
+    return counts["applied"], counts["skipped"]
+
+
 async def run(args) -> None:
     import asyncio
 
@@ -50,14 +169,16 @@ async def run(args) -> None:
     from ..replication.sink import FilerSink
     from ..replication.source import FilerSource
 
-    progress_path = args.spool + ".replicate_offset"
-    offset = 0
-    if os.path.exists(progress_path):
-        with open(progress_path) as f:
-            offset = int(f.read().strip() or 0)
-
+    if bool(args.spool) == bool(args.mq_broker):
+        raise SystemExit("exactly one of -spool / -mqBroker required")
     if bool(args.target_filer) == bool(args.target_remote):
         raise SystemExit("exactly one of -targetFiler / -targetRemote required")
+
+    progress_path = (args.spool or "mq") + ".replicate_offset"
+    offset = 0
+    if args.spool and os.path.exists(progress_path):
+        with open(progress_path) as f:
+            offset = int(f.read().strip() or 0)
 
     source = FilerSource(server_address.grpc_address(args.source_filer))
     if args.target_remote:
@@ -101,6 +222,13 @@ async def run(args) -> None:
 
     applied = skipped = 0
     try:
+        if args.mq_broker:
+            applied, skipped = await _consume_mq(args, sink, is_transient)
+            print(
+                f"replicated {applied} events from mq"
+                + (f", {skipped} skipped" if skipped else "")
+            )
+            return
         while True:
             progressed = False
             if os.path.exists(args.spool):

@@ -90,6 +90,73 @@ class SingleBrokerBalancer:
         ]
 
 
+class ClusterBalancer:
+    """Partition -> broker assignment over the LIVE broker registry
+    (reference mq/broker/broker_server.go + balancer registration).
+
+    Every broker registers with the master cluster registry
+    (KeepConnected, client_type "broker"); all brokers resolve the same
+    sorted live-broker list and place partition p of topic t on
+    brokers[(crc32(t) + p) % n] — no coordinator, same answer everywhere.
+    A broker death ends its KeepConnected stream, the registry drops it,
+    and the next refresh (<= `ttl` behind) moves its partitions to the
+    survivors, who re-read the partition's filer-persisted log on first
+    owned access (Partition activation)."""
+
+    def __init__(self, masters: list[str], local: str, ttl: float = 1.0):
+        from ..pb import server_address
+
+        self.masters = [server_address.grpc_address(m) for m in masters]
+        self.local = local
+        self.ttl = ttl
+        self._brokers: list[str] = [local]
+        self._ts = 0.0
+        self._stubs: dict[str, Stub] = {}
+
+    def _master_stub(self, addr: str):
+        from ..pb import master_pb2 as mpb
+
+        if addr not in self._stubs:
+            self._stubs[addr] = Stub(channel(addr), mpb, "Seaweed")
+        return self._stubs[addr]
+
+    async def refresh(self) -> list[str]:
+        """Re-read the registry (first reachable master wins); always
+        falls back to the last snapshot, never to an empty list."""
+        from ..pb import master_pb2 as mpb
+        from ..pb import server_address
+
+        for addr in self.masters:
+            try:
+                resp = await self._master_stub(addr).ListClusterNodes(
+                    mpb.ListClusterNodesRequest(client_type="broker")
+                )
+            except Exception:  # noqa: BLE001 — try the next master
+                self._stubs.pop(addr, None)
+                continue
+            brokers = sorted(
+                server_address.grpc_address(n.address)
+                for n in resp.cluster_nodes
+            )
+            if brokers:
+                self._brokers = brokers
+            self._ts = time.monotonic()
+            return self._brokers
+        return self._brokers
+
+    def broker_for(self, tkey: str, partition: int, partition_count: int) -> str:
+        brokers = self._brokers or [self.local]
+        return brokers[
+            (zlib.crc32(tkey.encode()) + partition) % len(brokers)
+        ]
+
+    def brokers_for_topic(self, tkey: str, partition_count: int) -> list[str]:
+        return [
+            self.broker_for(tkey, i, partition_count)
+            for i in range(partition_count)
+        ]
+
+
 class Partition:
     def __init__(self, broker: "MessageQueueBroker", tkey: str, idx: int):
         self.broker = broker
@@ -102,6 +169,10 @@ class Partition:
         self.pending: list[tuple[int, bytes, bytes, int]] = []  # not yet flushed
         self.cond = asyncio.Condition()
         self._flushing = False
+        # ownership epoch: False until this broker (re)reads the durable
+        # log as the partition's CURRENT owner — another broker may have
+        # appended since our last look (balancer reassignment)
+        self.active = False
 
     @property
     def log_path(self) -> tuple[str, str]:
@@ -191,6 +262,7 @@ class MessageQueueBroker:
         self._stub_cache = None
         self._session: aiohttp.ClientSession | None = None
         self._flusher: asyncio.Task | None = None
+        self._balancer_task: asyncio.Task | None = None
 
     async def _sess(self) -> aiohttp.ClientSession:
         if self._session is None:
@@ -225,6 +297,9 @@ class MessageQueueBroker:
         self.port = tls_mod.add_port(self._grpc_server, f"{self.ip}:{self.port}")
         await self._grpc_server.start()
         self._flusher = asyncio.create_task(self._flush_loop())
+        if self._balancer is None and self.masters:
+            # multi-broker mode: registry-driven partition assignment
+            self._balancer = ClusterBalancer(self.masters, self.grpc_url)
         if self.masters:
             # membership via KeepConnected, like filers (cluster.go)
             from ..wdclient import MasterClient
@@ -238,9 +313,18 @@ class MessageQueueBroker:
                 client_address=f"{self.ip}:{self.port}.{self.port}",
             )
             await self._master_client.start()
+        if isinstance(self._balancer, ClusterBalancer):
+            await self._balancer.refresh()
+            self._balancer_task = asyncio.create_task(self._balancer_loop())
         log.info("mq broker up grpc=%s", self.grpc_url)
 
     async def stop(self) -> None:
+        if self._balancer_task is not None:
+            self._balancer_task.cancel()
+            try:
+                await self._balancer_task
+            except asyncio.CancelledError:
+                pass
         if self._master_client is not None:
             await self._master_client.stop()
         # stop accepting publishes BEFORE the final flush, or a message
@@ -268,6 +352,11 @@ class MessageQueueBroker:
             await asyncio.sleep(1.0)
             for parts in list(self.topics.values()):
                 for p in parts:
+                    if not p.active:
+                        # a deactivated partition belongs to another
+                        # broker now: appending its stale batch would
+                        # collide with the new owner's offsets
+                        continue
                     try:
                         await p.flush()
                     except Exception:  # noqa: BLE001
@@ -330,11 +419,98 @@ class MessageQueueBroker:
     def _group_key(self, tkey: str, partition: int, group: str) -> bytes:
         return f"mq.offset/{tkey}/{partition}/{group}".encode()
 
+    async def _ensure_topic(self, tkey: str) -> list[Partition] | None:
+        """Topic lookup with lazy filer discovery: a topic configured on a
+        PEER broker exists as /topics/<tkey>/<i> directories even though
+        this broker never saw the ConfigureTopic."""
+        parts = self.topics.get(tkey)
+        if parts:
+            return parts
+        from ..filer.client import list_all_entries
+
+        try:
+            pdirs = await list_all_entries(self._stub(), f"{TOPICS_DIR}/{tkey}")
+        except grpc.aio.AioRpcError:
+            return None
+        n = sum(1 for e in pdirs if e.is_directory)
+        if n == 0:
+            return None
+        parts = [Partition(self, tkey, i) for i in range(n)]
+        self.topics[tkey] = parts
+        return parts
+
+    async def _deactivate(self, p: Partition) -> None:
+        """Ownership moved away: make acked records durable BEFORE the new
+        owner resyncs from the log — an unflushed batch appended later
+        would collide with the new owner's offsets.  If the flush fails,
+        the batch is dropped with a counted warning (ack'd-but-lost, the
+        same class as losing an unreplicated kafka tail); the registry
+        TTL bounds the handoff window, and a flap inside one TTL is the
+        residual race a lease/epoch scheme would close."""
+        if not p.active:
+            return
+        p.active = False
+        try:
+            await p.flush()
+        except Exception:  # noqa: BLE001
+            lost = len(p.pending)
+            p.pending = []
+            log.error(
+                "partition %s/%d handoff: %d acked records lost "
+                "(flush failed during deactivation)", p.tkey, p.idx, lost,
+            )
+
+    async def _ensure_active(self, p: Partition) -> None:
+        """First owned access after (re)gaining a partition: resync
+        next_offset from the durable log, so offsets never collide with
+        appends a previous owner flushed."""
+        if p.active:
+            return
+        blob = await self._read_log(p)
+        last = -1
+        for offset, *_ in _records_decode(blob):
+            last = max(last, offset)
+        async with p.cond:
+            if p.active:  # a concurrent activator won the race; its state
+                return  # already covers any appends since
+            p.next_offset = max(p.next_offset, last + 1)
+            p.mem = []
+            p.mem_base = p.next_offset
+            p.flushed_upto = p.next_offset
+            p.pending = []
+            p.active = True
+
+    async def _balancer_loop(self) -> None:
+        bal = self.balancer
+        while True:
+            await asyncio.sleep(bal.ttl)
+            try:
+                before = list(bal._brokers)
+                await bal.refresh()
+                if before != bal._brokers:
+                    log.info("broker set changed: %s", bal._brokers)
+                    # deactivate (flush + release) partitions we no
+                    # longer own; re-activation re-reads the log if
+                    # ownership returns.  Snapshot: handlers add topics
+                    # concurrently while the flushes await.
+                    for tkey, parts in list(self.topics.items()):
+                        for p in parts:
+                            if (
+                                bal.broker_for(tkey, p.idx, len(parts))
+                                != self.grpc_url
+                            ):
+                                await self._deactivate(p)
+            except Exception:  # noqa: BLE001 — the loop must outlive any
+                # refresh/flush hiccup: a dead balancer task would leave a
+                # stale owner accepting publishes forever
+                log.exception("balancer refresh failed; retrying")
+
     # ------------------------------------------------------------------ rpc
 
     async def ConfigureTopic(self, request, context):
         tkey = topic_key(request.topic)
         n = max(1, request.partition_count or 1)
+        await self._ensure_topic(tkey)  # a peer may have created it
         if tkey not in self.topics:
             self.topics[tkey] = [Partition(self, tkey, i) for i in range(n)]
             # materialize partition directories so restart discovery works
@@ -364,7 +540,7 @@ class MessageQueueBroker:
 
     async def LookupTopicBrokers(self, request, context):
         tkey = topic_key(request.topic)
-        parts = self.topics.get(tkey)
+        parts = await self._ensure_topic(tkey)
         if parts is None:
             await context.abort(grpc.StatusCode.NOT_FOUND, f"topic {tkey}")
         return mq_pb2.LookupTopicBrokersResponse(
@@ -394,7 +570,7 @@ class MessageQueueBroker:
         async for req in request_iterator:
             if parts is None:
                 tkey = topic_key(req.topic)
-                parts = self.topics.get(tkey)
+                parts = await self._ensure_topic(tkey)
                 if parts is None:
                     yield mq_pb2.PublishResponse(error=f"unknown topic {tkey}")
                     return
@@ -402,15 +578,22 @@ class MessageQueueBroker:
                 continue  # init-only message
             try:
                 p = self._partition_for(parts, req)
-            except (IndexError, NotAssignedHere) as e:
+            except NotAssignedHere as e:
+                # ownership moved: flush + release before the new owner
+                # resyncs, then point the client at the owner
+                await self._deactivate(parts[e.partition])
                 yield mq_pb2.PublishResponse(error=str(e))
                 continue
+            except IndexError as e:
+                yield mq_pb2.PublishResponse(error=str(e))
+                continue
+            await self._ensure_active(p)
             offset = await p.append(bytes(req.data.key), bytes(req.data.value))
             yield mq_pb2.PublishResponse(offset=offset, partition=p.idx)
 
     async def Subscribe(self, request, context):
         tkey = topic_key(request.topic)
-        parts = self.topics.get(tkey)
+        parts = await self._ensure_topic(tkey)
         if (
             parts is None
             or request.partition < 0
@@ -420,12 +603,14 @@ class MessageQueueBroker:
             return
         owner = self.balancer.broker_for(tkey, request.partition, len(parts))
         if owner != self.grpc_url:
+            await self._deactivate(parts[request.partition])
             yield mq_pb2.SubscribeResponse(
                 error=f"partition {request.partition} is assigned to "
                 f"broker {owner}"
             )
             return
         p = parts[request.partition]
+        await self._ensure_active(p)
         offset = request.start_offset
         if offset == -1:  # committed group offset, else earliest
             offset = 0

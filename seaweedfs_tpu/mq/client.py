@@ -18,6 +18,17 @@ class MqClient:
             )
         return self._stub_cache
 
+    def reset(self) -> None:
+        """Drop the cached stub after a transport failure.  The underlying
+        channel is SHARED per address (pb/rpc.channel) and deliberately
+        left open: grpc reconnects it itself once the peer returns, and
+        closing it here breaks every other client of the same broker —
+        measured as a mutual-invalidation livelock between the notifier's
+        and the replicator's retry loops in the broker-restart test.  For
+        genuinely dead channels (e.g. rotated TLS credentials) use
+        pb.rpc.evict_channel explicitly."""
+        self._stub_cache = None
+
     @staticmethod
     def topic(name: str, namespace: str = "default") -> mq_pb2.Topic:
         return mq_pb2.Topic(namespace=namespace, name=name)
@@ -35,6 +46,42 @@ class MqClient:
     async def list_topics(self) -> list[tuple[mq_pb2.Topic, int]]:
         resp = await self._stub().ListTopics(mq_pb2.ListTopicsRequest())
         return list(zip(resp.topics, resp.partition_counts))
+
+    async def lookup(
+        self, topic: mq_pb2.Topic
+    ) -> tuple[int, list[str]]:
+        """-> (partition_count, per-partition owning broker grpc urls)."""
+        resp = await self._stub().LookupTopicBrokers(
+            mq_pb2.LookupTopicBrokersRequest(topic=topic)
+        )
+        brokers = list(resp.partition_brokers) or [
+            resp.broker
+        ] * max(1, resp.partition_count)
+        return len(brokers), brokers
+
+    async def publish_routed(
+        self,
+        topic: mq_pb2.Topic,
+        messages: list[tuple[bytes, bytes]],  # (key, value)
+    ) -> int:
+        """Multi-broker publish: look up the partition->broker map, group
+        messages by their key-hash partition (the same crc32 placement the
+        broker applies), and send each group to its OWNING broker —
+        cross-broker routing instead of bouncing off NotAssignedHere.
+        Returns the number of messages published."""
+        import zlib
+
+        count, brokers = await self.lookup(topic)
+        groups: dict[int, list[tuple[bytes, bytes]]] = {}
+        for key, value in messages:
+            pidx = zlib.crc32(key) % count if key else 0
+            groups.setdefault(pidx, []).append((key, value))
+        sent = 0
+        for pidx, msgs in groups.items():
+            addr = brokers[pidx]
+            client = self if addr == self.broker else MqClient(addr)
+            sent += len(await client.publish(topic, msgs, partition=pidx))
+        return sent
 
     async def publish(
         self,

@@ -107,6 +107,18 @@ def channel(address: str) -> grpc.aio.Channel:
     return ch
 
 
+async def evict_channel(address: str) -> None:
+    """Drop AND close the cached aio channel for an address, so the next
+    `channel()` call dials a genuinely fresh connection.  NOT for retry
+    loops — the channel is shared per address and grpc reconnects it by
+    itself (closing it under other clients' stubs livelocks them; see
+    MqClient.reset).  This is the administrative path for channels that
+    can never recover, e.g. after rotating TLS credentials."""
+    ch = _channels.pop(address, None)
+    if ch is not None:
+        await ch.close()
+
+
 def sync_channel(address: str) -> grpc.Channel:
     """Uncached SYNC channel honoring the TLS config — for hooks that run
     on worker threads (e.g. the volume server's remote shard reader)."""

@@ -2,11 +2,12 @@
 
 Reference: weed/notification/configuration.go — a MessageQueue interface
 (SendMessage(key, proto)) with kafka/SQS/pub-sub/log backends, invoked
-for every filer meta mutation when notifications are configured.  Broker
-backends need external services (zero egress here), so the shipped
-implementations are the log publisher, a local spool file (length-
-prefixed records an external forwarder can drain), and an in-process
-callback for embedding.
+for every filer meta mutation when notifications are configured.  The
+network-queue class is covered by MqNotifier publishing to the in-repo
+MQ broker (mq/broker.py) — the zero-egress equivalent of the kafka
+publisher (weed/notification/kafka/kafka_queue.go:1-60); the log
+publisher, a local spool file, and an in-process callback round out the
+local backends.
 """
 from __future__ import annotations
 
@@ -14,6 +15,7 @@ import asyncio
 import logging
 import os
 import struct
+from collections import deque
 
 from ..pb import filer_pb2
 
@@ -46,6 +48,119 @@ class CallbackNotifier(Notifier):
         r = self.fn(key, notification)
         if asyncio.iscoroutine(r):
             await r
+
+
+class MqNotifier(Notifier):
+    """notification.toml type `mq`: meta events go over the wire to the
+    in-repo MQ broker, landing in a filer-backed partition log that
+    `filer.replicate -mqBroker` consumes with committed group offsets —
+    a real network queue, not an in-process hop.
+
+    Publish semantics mirror the reference's async kafka producer
+    (kafka_queue.go buffers through the client library): publish() only
+    enqueues; a background task drains batches to the broker and retries
+    with backoff, so a broker restart never fails filer mutations.  The
+    buffer is bounded — beyond `max_buffer` the OLDEST events drop with a
+    counted warning (backpressure would stall the filer's write path)."""
+
+    def __init__(
+        self,
+        broker_grpc_address: str,  # comma-separated bootstrap list
+        topic: str = "filer_meta",
+        namespace: str = "default",
+        partition_count: int = 4,
+        max_buffer: int = 10000,
+    ):
+        from ..mq.client import MqClient
+
+        self._addrs = [
+            a.strip() for a in broker_grpc_address.split(",") if a.strip()
+        ]
+        self._addr_idx = 0
+        self.client = MqClient(self._addrs[0])
+        self.topic = MqClient.topic(topic, namespace)
+        self.partition_count = partition_count
+        self.max_buffer = max_buffer
+        self.dropped = 0
+        self._buf: deque[tuple[bytes, bytes]] = deque()
+        self._configured = False
+        self._task: asyncio.Task | None = None
+        self._closing = False
+
+    async def publish(self, key, notification) -> None:
+        self._buf.append((key.encode(), notification.SerializeToString()))
+        over = len(self._buf) - self.max_buffer
+        if over > 0:
+            for _ in range(over):
+                self._buf.popleft()
+            self.dropped += over
+            log.warning(
+                "mq notifier buffer overflow: %d events dropped total",
+                self.dropped,
+            )
+        if self._task is None or self._task.done():
+            self._task = asyncio.ensure_future(self._drain())
+
+    async def _publish_batch(self) -> None:
+        if not self._configured:
+            await self.client.configure_topic(
+                self.topic, self.partition_count
+            )
+            self._configured = True
+        # take the batch OUT of the deque before awaiting: publish() may
+        # run during the await and pop the deque's front on overflow —
+        # popping len(batch) afterwards would then discard events that
+        # were never published.  On failure the batch goes back to the
+        # FRONT (order preserved), where overflow accounting can see it.
+        batch = [
+            self._buf.popleft() for _ in range(min(256, len(self._buf)))
+        ]
+        try:
+            # routed: each key-hash partition goes to its OWNING broker,
+            # so the notifier works unchanged against a multi-broker
+            # cluster
+            await self.client.publish_routed(self.topic, batch)
+        except BaseException:  # incl. CancelledError: close() cancels the
+            # drain mid-publish and then runs the final flush — the batch
+            # must be back in the buffer for it
+            self._buf.extendleft(reversed(batch))
+            raise
+
+    async def _drain(self) -> None:
+        backoff = 0.5
+        while self._buf and not self._closing:
+            try:
+                await self._publish_batch()
+                backoff = 0.5
+            except Exception as e:  # noqa: BLE001 — broker down: retry
+                log.warning("mq notify publish failed (will retry): %s", e)
+                self.client.reset()
+                if len(self._addrs) > 1:
+                    # rotate bootstrap brokers (kafka bootstrap-list
+                    # semantics): a dead bootstrap must not stall events
+                    # while other brokers live
+                    from ..mq.client import MqClient
+
+                    self._addr_idx = (self._addr_idx + 1) % len(self._addrs)
+                    self.client = MqClient(self._addrs[self._addr_idx])
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
+
+    async def close(self) -> None:
+        """One final best-effort flush, then stop the drain task."""
+        self._closing = True
+        if self._task is not None and not self._task.done():
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        if self._buf:
+            try:
+                while self._buf:
+                    await self._publish_batch()
+            except Exception as e:  # noqa: BLE001
+                log.warning("mq notify final flush failed: %s", e)
 
 
 class FileQueueNotifier(Notifier):

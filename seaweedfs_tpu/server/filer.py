@@ -224,6 +224,16 @@ class FilerServer:
             await self._metrics_runner.cleanup()
         if self._session:
             await self._session.close()
+        # async notifiers (MqNotifier) hold buffered events + a drain
+        # task: flush and stop them before the process exits
+        notifier = getattr(self.filer.meta_log, "notifier", None)
+        close = getattr(notifier, "close", None)
+        if close is not None:
+            import inspect
+
+            r = close()
+            if inspect.isawaitable(r):
+                await r
         self.filer.shutdown()
 
     # -------------------------------------------------- chunk data movement
@@ -439,6 +449,14 @@ class FilerServer:
         )
         more = len(entries) > limit
         entries = entries[:limit]
+        from . import ui
+
+        if ui.wants_html(request):
+            # browser directory listing (reference filer_ui/filer.html)
+            return web.Response(
+                text=ui.render_filer_listing(path, entries, limit, more),
+                content_type="text/html",
+            )
         return web.json_response(
             {
                 "Path": path,

@@ -237,3 +237,104 @@ def test_balancer_seam_routes_partitions(tmp_path):
             await cluster.stop()
 
     run(go())
+
+
+async def _wait_brokers(broker, n, timeout=8.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        await broker.balancer.refresh()
+        if len(broker.balancer._brokers) == n:
+            return
+        await asyncio.sleep(0.2)
+    raise AssertionError(
+        f"registry never converged to {n} brokers: {broker.balancer._brokers}"
+    )
+
+
+def test_multi_broker_assignment_and_failover(tmp_path):
+    """TWO live brokers: partitions split across both via the registry
+    balancer, lookups agree from either broker, publish_routed reaches the
+    owners cross-broker, and killing one broker reassigns its partitions
+    to the survivor, which recovers their filer-persisted logs."""
+
+    async def go():
+        cluster = LocalCluster(
+            base_dir=str(tmp_path), n_volume_servers=1, with_filer=True
+        )
+        await cluster.start()
+        masters = [cluster.master.advertise_url]
+
+        def mk():
+            return MessageQueueBroker(
+                filer_address=cluster.filer.url,
+                filer_grpc_address=f"{cluster.filer.ip}:{cluster.filer.grpc_port}",
+                port=0,
+                masters=masters,
+            )
+
+        b1, b2 = mk(), mk()
+        await b1.start()
+        await b2.start()
+        b2_stopped = False
+        try:
+            await _wait_brokers(b1, 2)
+            await _wait_brokers(b2, 2)
+
+            c1 = MqClient(b1.grpc_url)
+            topic = MqClient.topic("ev")
+            await c1.configure_topic(topic, partition_count=4)
+            count, brokers = await c1.lookup(topic)
+            assert count == 4
+            assert set(brokers) == {b1.grpc_url, b2.grpc_url}, (
+                "partitions must spread across BOTH live brokers"
+            )
+            # both brokers answer the same assignment (lazy topic discovery
+            # on b2, which never saw the ConfigureTopic)
+            c2 = MqClient(b2.grpc_url)
+            assert (await c2.lookup(topic))[1] == brokers
+
+            msgs = [(f"k{i}".encode(), f"v{i}".encode()) for i in range(40)]
+            assert await c1.publish_routed(topic, msgs) == 40
+
+            # direct publish to a foreign partition is refused, owner named
+            foreign = next(
+                i for i, a in enumerate(brokers) if a != b1.grpc_url
+            )
+            with pytest.raises(RuntimeError) as ei:
+                await c1.publish(topic, [(b"x", b"y")], partition=foreign)
+            assert b2.grpc_url in str(ei.value)
+
+            # subscribe each partition at its owner: all 40 come back
+            got = {}
+            for i, addr in enumerate(brokers):
+                pc = MqClient(addr)
+                async for _o, k, v in pc.subscribe(topic, i, start_offset=0):
+                    got[k] = v
+            assert len(got) == 40
+
+            # ---- failover: b2 dies; its partitions move to b1 ----
+            await b2.stop()
+            b2_stopped = True
+            await _wait_brokers(b1, 1)
+            count, brokers = await c1.lookup(topic)
+            assert set(brokers) == {b1.grpc_url}
+            more = [(f"m{i}".encode(), f"w{i}".encode()) for i in range(10)]
+            assert await c1.publish_routed(topic, more) == 10
+
+            got2 = {}
+            for i in range(count):
+                async for _o, k, v in c1.subscribe(topic, i, start_offset=0):
+                    got2[k] = v
+            # survivor serves b2's flushed history AND the new messages
+            assert len(got2) == 50, sorted(got2)[:5]
+            for i in range(40):
+                assert got2[f"k{i}".encode()] == f"v{i}".encode()
+            for i in range(10):
+                assert got2[f"m{i}".encode()] == f"w{i}".encode()
+        finally:
+            if not b2_stopped:
+                await b2.stop()
+            await b1.stop()
+            await cluster.stop()
+
+    run(go())

@@ -1,0 +1,264 @@
+"""Notification over the in-repo MQ broker, e2e: filer meta events publish
+over the wire to mq/broker.py (MqNotifier), and `filer.replicate -mqBroker`
+consumes them into a second cluster — including a broker restart
+mid-stream (events buffered by the notifier, consumer resumes from its
+committed group offset).
+
+Reference shape: weed/notification/kafka/kafka_queue.go publishers +
+weed/command/filer_replication.go consumers.
+"""
+import argparse
+import asyncio
+
+import aiohttp
+import pytest
+
+from seaweedfs_tpu.command import COMMANDS
+from seaweedfs_tpu.mq import MessageQueueBroker
+from seaweedfs_tpu.replication.notification import MqNotifier
+from seaweedfs_tpu.server.cluster import LocalCluster
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def start_pair(tmp_path):
+    src = LocalCluster(
+        base_dir=str(tmp_path / "src"), n_volume_servers=1, with_filer=True
+    )
+    await src.start()
+    broker = MessageQueueBroker(
+        filer_address=src.filer.url,
+        filer_grpc_address=f"{src.filer.ip}:{src.filer.grpc_port}",
+        port=0,
+    )
+    await broker.start()
+    notifier = MqNotifier(broker.grpc_url, partition_count=2)
+    src.filer.filer.meta_log.notifier = notifier
+    dst = LocalCluster(
+        base_dir=str(tmp_path / "dst"), n_volume_servers=1, with_filer=True
+    )
+    await dst.start()
+    return src, broker, notifier, dst
+
+
+def replicate_args(broker, src, dst, follow=False):
+    mod = COMMANDS["filer.replicate"]
+    p = argparse.ArgumentParser()
+    mod.add_args(p)
+    argv = [
+        # explicit host:port.grpc form — a broker has no HTTP port for the
+        # +10000 convention to hang off
+        "-mqBroker", f"{broker.ip}:{broker.port}.{broker.port}",
+        "-sourceFiler", f"{src.filer.ip}:{src.filer.port}.{src.filer.grpc_port}",
+        "-targetFiler", f"{dst.filer.ip}:{dst.filer.port}.{dst.filer.grpc_port}",
+    ]
+    if follow:
+        argv.append("-follow")
+    return mod, p.parse_args(argv)
+
+
+async def put(cluster, path, data):
+    async with aiohttp.ClientSession() as s:
+        async with s.put(
+            f"http://{cluster.filer.url}{path}", data=data
+        ) as r:
+            assert r.status < 300, r.status
+
+
+async def get(cluster, path):
+    async with aiohttp.ClientSession() as s:
+        async with s.get(f"http://{cluster.filer.url}{path}") as r:
+            if r.status == 404:
+                return None
+            assert r.status < 300, r.status
+            return await r.read()
+
+
+async def wait_for(cluster, path, data, timeout=15.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        got = await get(cluster, path)
+        if got == data:
+            return
+        await asyncio.sleep(0.2)
+    raise AssertionError(f"{path} never reached the target")
+
+
+async def drain_notifier(notifier, timeout=10.0):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        if not notifier._buf:
+            return
+        await asyncio.sleep(0.1)
+    raise AssertionError("notifier buffer never drained to the broker")
+
+
+def test_mq_notification_replicates(tmp_path):
+    """Meta events flow filer -> broker (over gRPC) -> filer.replicate ->
+    second cluster; catch-up mode drains and exits."""
+
+    async def go():
+        src, broker, notifier, dst = await start_pair(tmp_path)
+        try:
+            bodies = {
+                f"/docs/f{i}.bin": (b"%d-" % i) * 200 for i in range(3)
+            }
+            for path, data in bodies.items():
+                await put(src, path, data)
+            await drain_notifier(notifier)
+            mod, args = replicate_args(broker, src, dst)
+            await mod.run(args)
+            for path, data in bodies.items():
+                assert await get(dst, path) == data
+            # deletes propagate too
+            async with aiohttp.ClientSession() as s:
+                async with s.delete(
+                    f"http://{src.filer.url}/docs/f0.bin"
+                ) as r:
+                    assert r.status < 300
+            await drain_notifier(notifier)
+            mod, args = replicate_args(broker, src, dst)
+            await mod.run(args)
+            assert await get(dst, "/docs/f0.bin") is None
+            assert await get(dst, "/docs/f1.bin") == bodies["/docs/f1.bin"]
+        finally:
+            await notifier.close()
+            await broker.stop()
+            await src.stop()
+            await dst.stop()
+
+    run(go())
+
+
+def test_mq_notification_broker_restart_mid_stream(tmp_path):
+    """Kill the broker between events: the notifier buffers and retries,
+    the tailing replicator reconnects and resumes from its committed
+    offset, and every event still lands exactly once."""
+
+    async def go():
+        src, broker, notifier, dst = await start_pair(tmp_path)
+        task = None
+        try:
+            await put(src, "/a.bin", b"alpha" * 100)
+            await drain_notifier(notifier)
+            mod, args = replicate_args(broker, src, dst, follow=True)
+            task = asyncio.ensure_future(mod.run(args))
+            await wait_for(dst, "/a.bin", b"alpha" * 100)
+
+            port = broker.port
+            await broker.stop()
+            # events during the outage buffer in the notifier
+            await put(src, "/b.bin", b"bravo" * 100)
+            await asyncio.sleep(0.5)
+            assert notifier._buf, "event should be buffered while broker is down"
+
+            broker2 = MessageQueueBroker(
+                filer_address=src.filer.url,
+                filer_grpc_address=f"{src.filer.ip}:{src.filer.grpc_port}",
+                port=port,
+            )
+            await broker2.start()
+            try:
+                await wait_for(dst, "/b.bin", b"bravo" * 100, timeout=25.0)
+                # a.bin must not have been re-applied destructively
+                assert await get(dst, "/a.bin") == b"alpha" * 100
+            finally:
+                await broker2.stop()
+        finally:
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
+            await notifier.close()
+            await src.stop()
+            await dst.stop()
+
+    run(go())
+
+
+def test_mq_notification_broker_failover(tmp_path):
+    """TWO brokers behind the registry balancer: kill the one owning some
+    partitions mid-stream; the notifier rotates bootstraps + publish_routed
+    follows the new assignment, and the tailing replicator re-looks-up
+    partition owners — every event still lands."""
+
+    async def go():
+        from seaweedfs_tpu.mq import MessageQueueBroker as Broker
+
+        src = LocalCluster(
+            base_dir=str(tmp_path / "src"), n_volume_servers=1,
+            with_filer=True, pulse_seconds=1,
+        )
+        await src.start()
+        masters = [src.master.advertise_url]
+
+        def mk():
+            return Broker(
+                filer_address=src.filer.url,
+                filer_grpc_address=f"{src.filer.ip}:{src.filer.grpc_port}",
+                port=0,
+                masters=masters,
+            )
+
+        b1, b2 = mk(), mk()
+        await b1.start()
+        await b2.start()
+        for b in (b1, b2):
+            deadline = asyncio.get_event_loop().time() + 8
+            while asyncio.get_event_loop().time() < deadline:
+                await b.balancer.refresh()
+                if len(b.balancer._brokers) == 2:
+                    break
+                await asyncio.sleep(0.2)
+            assert len(b.balancer._brokers) == 2
+
+        notifier = MqNotifier(
+            f"{b1.grpc_url},{b2.grpc_url}", partition_count=4
+        )
+        src.filer.filer.meta_log.notifier = notifier
+        dst = LocalCluster(
+            base_dir=str(tmp_path / "dst"), n_volume_servers=1,
+            with_filer=True,
+        )
+        await dst.start()
+        task = None
+        b2_stopped = False
+        try:
+            # enough files to hash across several partitions
+            for i in range(6):
+                await put(src, f"/m/f{i}.bin", (b"%d!" % i) * 50)
+            await drain_notifier(notifier)
+            mod, args = replicate_args(b1, src, dst, follow=True)
+            task = asyncio.ensure_future(mod.run(args))
+            for i in range(6):
+                await wait_for(dst, f"/m/f{i}.bin", (b"%d!" % i) * 50)
+
+            await b2.stop()
+            b2_stopped = True
+            # write during/after the failover window; the registry drops
+            # b2 within the balancer TTL and b1 takes its partitions
+            for i in range(6, 12):
+                await put(src, f"/m/f{i}.bin", (b"%d!" % i) * 50)
+            for i in range(6, 12):
+                await wait_for(
+                    dst, f"/m/f{i}.bin", (b"%d!" % i) * 50, timeout=30.0
+                )
+        finally:
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
+            await notifier.close()
+            if not b2_stopped:
+                await b2.stop()
+            await b1.stop()
+            await src.stop()
+            await dst.stop()
+
+    run(go())
