@@ -13,7 +13,16 @@ from .filechunks import (
     view_from_visibles,
 )
 from .filer import Filer, FilerError, NotEmptyError
-from .filerstore import FilerStore, MemoryStore, NotFoundError, SqliteStore
+from .filerstore import (
+    AbstractSqlStore,
+    FilerStore,
+    MemoryStore,
+    NotFoundError,
+    OnConflictSqliteDialect,
+    SqlDialect,
+    SqliteDialect,
+    SqliteStore,
+)
 from .manifest import maybe_manifestize, resolve_chunk_manifest
 from .meta_log import MetaLog
 
@@ -23,12 +32,16 @@ __all__ = [
     "Entry",
     "Filer",
     "FilerError",
+    "AbstractSqlStore",
     "FilerStore",
     "MODE_DIR",
     "MemoryStore",
     "MetaLog",
     "NotEmptyError",
     "NotFoundError",
+    "SqlDialect",
+    "SqliteDialect",
+    "OnConflictSqliteDialect",
     "SqliteStore",
     "VisibleInterval",
     "compact_file_chunks",

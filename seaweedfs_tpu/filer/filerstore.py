@@ -174,32 +174,121 @@ class MemoryStore(FilerStore):
         self._kv.pop(bytes(key), None)
 
 
-class SqliteStore(FilerStore):
-    """Durable embedded store on sqlite3 (reference weed/filer/sqlite via
-    abstract_sql: one `filemeta(dirhash,name,directory,meta)` table; here
-    (directory, name) is the natural primary key)."""
+class SqlDialect:
+    """One SQL engine's connection + statement text, the thin object the
+    generic tier parameterizes over (reference
+    weed/filer/abstract_sql/abstract_sql_store.go SqlGenerator + the
+    mysql/postgres2/sqlite dialect packages).  A new engine is a subclass
+    overriding `connect()` and whichever statements its SQL flavor spells
+    differently — the store logic itself is never touched."""
+
+    name = "generic-sql"
+
+    create_tables = (
+        "CREATE TABLE IF NOT EXISTS filemeta ("
+        " directory TEXT NOT NULL, name TEXT NOT NULL, meta BLOB,"
+        " PRIMARY KEY (directory, name))",
+        "CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB)",
+    )
+    upsert_entry = (
+        "INSERT OR REPLACE INTO filemeta (directory, name, meta)"
+        " VALUES (?,?,?)"
+    )
+    find_entry = "SELECT meta FROM filemeta WHERE directory=? AND name=?"
+    delete_entry = "DELETE FROM filemeta WHERE directory=? AND name=?"
+    delete_children = "DELETE FROM filemeta WHERE directory=?"
+    # {op} becomes > / >= for exclusive/inclusive pagination
+    list_entries = (
+        "SELECT name, meta FROM filemeta WHERE directory=? AND name {op} ?"
+    )
+    list_prefix_clause = " AND name GLOB ?"
+    list_tail = " ORDER BY name LIMIT ?"
+    kv_upsert = "INSERT OR REPLACE INTO kv (k, v) VALUES (?,?)"
+    kv_find = "SELECT v FROM kv WHERE k=?"
+    kv_delete_sql = "DELETE FROM kv WHERE k=?"
+    begin = "BEGIN"
+
+    def connect(self):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def prefix_argument(self, prefix: str) -> str:
+        """The bind value for list_prefix_clause."""
+        return (
+            prefix.replace("[", "[[]").replace("*", "[*]").replace("?", "[?]")
+            + "*"
+        )
+
+
+class SqliteDialect(SqlDialect):
+    """The embedded engine (reference weed/filer/sqlite)."""
 
     name = "sqlite"
 
     def __init__(self, path: str = ":memory:"):
-        self._path = path
+        self.path = path
+
+    def connect(self):
+        c = sqlite3.connect(self.path, timeout=30.0, isolation_level=None)
+        c.execute("PRAGMA journal_mode=WAL")
+        c.execute("PRAGMA synchronous=NORMAL")
+        return c
+
+
+class OnConflictSqliteDialect(SqliteDialect):
+    """The ANSI/postgres statement flavor (ON CONFLICT upserts, LIKE with
+    ESCAPE instead of GLOB — the text weed/filer/postgres2 generates),
+    run on the sqlite engine since that's what this image ships.  Exists
+    to prove the abstract tier's claim: a second dialect is a screenful
+    of statement text, not a store rewrite."""
+
+    name = "sqlite-onconflict"
+
+    upsert_entry = (
+        "INSERT INTO filemeta (directory, name, meta) VALUES (?,?,?)"
+        " ON CONFLICT (directory, name) DO UPDATE SET meta=excluded.meta"
+    )
+    kv_upsert = (
+        "INSERT INTO kv (k, v) VALUES (?,?)"
+        " ON CONFLICT (k) DO UPDATE SET v=excluded.v"
+    )
+    list_prefix_clause = r" AND name LIKE ? ESCAPE '\'"
+
+    def connect(self):
+        c = super().connect()
+        # sqlite's LIKE is ASCII case-insensitive by default; filer/S3
+        # prefix listing semantics are case-SENSITIVE
+        c.execute("PRAGMA case_sensitive_like=ON")
+        return c
+
+    def prefix_argument(self, prefix: str) -> str:
+        escaped = (
+            prefix.replace("\\", "\\\\")
+            .replace("%", r"\%")
+            .replace("_", r"\_")
+        )
+        return escaped + "%"
+
+
+class AbstractSqlStore(FilerStore):
+    """The generic SQL tier: every FilerStore operation in terms of a
+    SqlDialect's statements, with per-thread connections (stores are
+    called from asyncio.to_thread workers) and engine transactions.
+    Reference: weed/filer/abstract_sql/abstract_sql_store.go:1-90."""
+
+    def __init__(self, dialect: SqlDialect):
+        self.dialect = dialect
+        self.name = dialect.name
         self._local = threading.local()
-        self._conns: list[sqlite3.Connection] = []
+        self._conns: list = []
         self._conns_lock = threading.Lock()
         c = self._conn()
-        c.execute(
-            "CREATE TABLE IF NOT EXISTS filemeta ("
-            " directory TEXT NOT NULL, name TEXT NOT NULL, meta BLOB,"
-            " PRIMARY KEY (directory, name))"
-        )
-        c.execute("CREATE TABLE IF NOT EXISTS kv (k BLOB PRIMARY KEY, v BLOB)")
+        for stmt in dialect.create_tables:
+            c.execute(stmt)
 
-    def _conn(self) -> sqlite3.Connection:
+    def _conn(self):
         c = getattr(self._local, "conn", None)
         if c is None:
-            c = sqlite3.connect(self._path, timeout=30.0, isolation_level=None)
-            c.execute("PRAGMA journal_mode=WAL")
-            c.execute("PRAGMA synchronous=NORMAL")
+            c = self.dialect.connect()
             self._local.conn = c
             with self._conns_lock:
                 self._conns.append(c)
@@ -207,7 +296,7 @@ class SqliteStore(FilerStore):
 
     def insert_entry(self, entry: Entry) -> None:
         self._conn().execute(
-            "INSERT OR REPLACE INTO filemeta (directory, name, meta) VALUES (?,?,?)",
+            self.dialect.upsert_entry,
             (entry.directory, entry.name, entry.encode()),
         )
 
@@ -217,9 +306,7 @@ class SqliteStore(FilerStore):
         from .entry import dir_and_name
 
         d, n = dir_and_name(full_path)
-        row = self._conn().execute(
-            "SELECT meta FROM filemeta WHERE directory=? AND name=?", (d, n)
-        ).fetchone()
+        row = self._conn().execute(self.dialect.find_entry, (d, n)).fetchone()
         if row is None:
             raise NotFoundError(full_path)
         return Entry.decode(full_path, row[0])
@@ -228,26 +315,25 @@ class SqliteStore(FilerStore):
         from .entry import dir_and_name
 
         d, n = dir_and_name(full_path)
-        self._conn().execute(
-            "DELETE FROM filemeta WHERE directory=? AND name=?", (d, n)
-        )
+        self._conn().execute(self.dialect.delete_entry, (d, n))
 
     def delete_folder_children(self, full_path: str) -> None:
         self._conn().execute(
-            "DELETE FROM filemeta WHERE directory=?", (full_path.rstrip("/") or "/",)
+            self.dialect.delete_children, (full_path.rstrip("/") or "/",)
         )
 
     def list_directory_entries(
         self, dir_path, start_file_name="", include_start=False, limit=1 << 30, prefix=""
     ):
         dir_path = dir_path.rstrip("/") or "/"
-        op = ">=" if include_start else ">"
-        sql = f"SELECT name, meta FROM filemeta WHERE directory=? AND name {op} ?"
+        sql = self.dialect.list_entries.format(
+            op=">=" if include_start else ">"
+        )
         args: list = [dir_path, start_file_name]
         if prefix:
-            sql += " AND name GLOB ?"
-            args.append(_glob_escape(prefix) + "*")
-        sql += " ORDER BY name LIMIT ?"
+            sql += self.dialect.list_prefix_clause
+            args.append(self.dialect.prefix_argument(prefix))
+        sql += self.dialect.list_tail
         args.append(limit)
         return [
             Entry.decode(new_full_path(dir_path, name), meta)
@@ -256,20 +342,22 @@ class SqliteStore(FilerStore):
 
     def kv_put(self, key, value):
         self._conn().execute(
-            "INSERT OR REPLACE INTO kv (k, v) VALUES (?,?)", (bytes(key), bytes(value))
+            self.dialect.kv_upsert, (bytes(key), bytes(value))
         )
 
     def kv_get(self, key):
-        row = self._conn().execute("SELECT v FROM kv WHERE k=?", (bytes(key),)).fetchone()
+        row = self._conn().execute(
+            self.dialect.kv_find, (bytes(key),)
+        ).fetchone()
         if row is None:
             raise NotFoundError(key)
         return row[0]
 
     def kv_delete(self, key):
-        self._conn().execute("DELETE FROM kv WHERE k=?", (bytes(key),))
+        self._conn().execute(self.dialect.kv_delete_sql, (bytes(key),))
 
     def begin_transaction(self):
-        self._conn().execute("BEGIN")
+        self._conn().execute(self.dialect.begin)
 
     def commit_transaction(self):
         self._conn().execute("COMMIT")
@@ -282,13 +370,19 @@ class SqliteStore(FilerStore):
             for c in self._conns:
                 try:
                     c.close()
-                except Exception:
+                except Exception:  # noqa: BLE001
                     pass
             self._conns.clear()
 
 
-def _glob_escape(s: str) -> str:
-    return s.replace("[", "[[]").replace("*", "[*]").replace("?", "[?]")
+class SqliteStore(AbstractSqlStore):
+    """Durable embedded store on sqlite3 — AbstractSqlStore with the
+    sqlite dialect (the reference's weed/filer/sqlite is likewise a thin
+    dialect over abstract_sql)."""
+
+    def __init__(self, path: str = ":memory:"):
+        super().__init__(SqliteDialect(path))
+        self._path = path
 
 
 class NativeKvStore(FilerStore):
