@@ -162,6 +162,7 @@ class MasterServer:
                 [guard_mod.middleware(self.guard)] if self.guard.enabled else []
             ),
         )
+        app.router.add_get("/", self.h_ui)
         app.router.add_route("*", "/dir/assign", self.h_assign)
         app.router.add_route("*", "/dir/lookup", self.h_lookup)
         app.router.add_get("/dir/status", self.h_dir_status)
@@ -976,6 +977,25 @@ class MasterServer:
                     {"url": l.url, "publicUrl": l.public_url} for l in entry.locations
                 ],
             }
+        )
+
+    async def h_ui(self, request: web.Request) -> web.Response:
+        """Operator status page (reference master_server_handlers_ui.go +
+        master_ui/master.html); browsers get HTML, everyone else the
+        /dir/status JSON."""
+        from . import ui
+
+        if not ui.wants_html(request):
+            return await self.h_dir_status(request)
+        cluster = {
+            "IsLeader": self.is_leader,
+            "Leader": server_address.http_address(self.leader_advertise),
+            "Peers": self.peers,
+            "MaxVolumeId": self.topo.max_volume_id,
+        }
+        return web.Response(
+            text=ui.render_master(cluster, self.topo.to_info()),
+            content_type="text/html",
         )
 
     async def h_dir_status(self, request: web.Request) -> web.Response:

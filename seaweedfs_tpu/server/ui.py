@@ -1,0 +1,186 @@
+"""Server status pages: the operator-facing HTML the reference renders
+from weed/server/master_ui/ + volume_server_ui/ + filer_ui/ templates
+(master_server_handlers_ui.go:1-35 etc.).  Plain tables, no assets, no
+JS — `curl -H 'Accept: text/html'` or a browser both read it.
+"""
+from __future__ import annotations
+
+import html
+import time
+
+_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>{title}</title><style>
+body {{ font-family: sans-serif; margin: 2em; color: #222; }}
+h1 {{ font-size: 1.4em; }} h2 {{ font-size: 1.1em; margin-top: 1.5em; }}
+table {{ border-collapse: collapse; margin: 0.5em 0; }}
+th, td {{ border: 1px solid #bbb; padding: 0.25em 0.7em; text-align: left; }}
+th {{ background: #eee; }}
+.muted {{ color: #777; font-size: 0.9em; }}
+</style></head><body>
+<h1>{title}</h1>
+<p class="muted">seaweedfs-tpu &middot; rendered {now}</p>
+{body}
+</body></html>"""
+
+
+def _esc(v) -> str:
+    return html.escape(str(v))
+
+
+def _table(headers: list[str], rows: list[list]) -> str:
+    head = "".join(f"<th>{_esc(h)}</th>" for h in headers)
+    body = "".join(
+        "<tr>" + "".join(f"<td>{_esc(c)}</td>" for c in row) + "</tr>"
+        for row in rows
+    )
+    return f"<table><tr>{head}</tr>{body}</table>"
+
+
+def _page(title: str, body: str) -> str:
+    return _PAGE.format(
+        title=_esc(title), now=time.strftime("%Y-%m-%d %H:%M:%S"), body=body
+    )
+
+
+def wants_html(request) -> bool:
+    return "text/html" in request.headers.get("Accept", "")
+
+
+def render_master(cluster: dict, topo_info: dict) -> str:
+    """Cluster status + the topology tree with per-node volume layouts."""
+    body = ["<h2>Cluster</h2>"]
+    body.append(
+        _table(
+            ["leader", "this node is leader", "peers", "max volume id"],
+            [[
+                cluster.get("Leader", ""),
+                cluster.get("IsLeader", False),
+                ", ".join(cluster.get("Peers", []) or []) or "-",
+                cluster.get("MaxVolumeId", 0),
+            ]],
+        )
+    )
+    body.append("<h2>Topology</h2>")
+    rows = []
+    for dc in topo_info.get("data_centers", []):
+        for rack in dc.get("racks", []):
+            for node in rack.get("nodes", []):
+                vols = node.get("volumes", [])
+                ec = node.get("ec_shards", [])
+                size = sum(v.get("size", 0) for v in vols)
+                rows.append([
+                    dc.get("id", ""),
+                    rack.get("id", ""),
+                    node.get("id", ""),
+                    len(vols),
+                    sum(v.get("file_count", 0) for v in vols),
+                    f"{size / 1e6:.1f} MB",
+                    len(ec),
+                    node.get("max_volume_counts", ""),
+                ])
+    body.append(
+        _table(
+            ["data center", "rack", "node", "volumes", "files", "size",
+             "ec shards", "slots"],
+            rows,
+        )
+    )
+    vol_rows = []
+    for dc in topo_info.get("data_centers", []):
+        for rack in dc.get("racks", []):
+            for node in rack.get("nodes", []):
+                for v in node.get("volumes", []):
+                    vol_rows.append([
+                        v.get("id", ""),
+                        v.get("collection", "") or "-",
+                        node.get("id", ""),
+                        f"{v.get('size', 0) / 1e6:.1f} MB",
+                        v.get("file_count", 0),
+                        v.get("delete_count", 0),
+                        "ro" if v.get("read_only") else "rw",
+                        v.get("replica_placement", 0),
+                    ])
+    body.append("<h2>Volumes</h2>")
+    body.append(
+        _table(
+            ["id", "collection", "node", "size", "files", "deleted",
+             "mode", "replication"],
+            sorted(vol_rows, key=lambda r: (r[0], r[2])),
+        )
+    )
+    return _page("seaweedfs-tpu master", "".join(body))
+
+
+def render_volume(
+    url: str, disks: list[dict], volumes: list[dict], ec_shards: list[dict]
+) -> str:
+    body = ["<h2>Disks</h2>"]
+    body.append(
+        _table(
+            ["directory", "disk type", "max volumes", "volumes", "ec shards"],
+            [[
+                d.get("dir", ""), d.get("disk_type", ""),
+                d.get("max_volume_count", 0), d.get("volumes", 0),
+                d.get("ec_shards", 0),
+            ] for d in disks],
+        )
+    )
+    body.append("<h2>Volumes</h2>")
+    body.append(
+        _table(
+            ["id", "collection", "size", "files", "deleted",
+             "deleted bytes", "mode", "ttl", "version"],
+            [[
+                v.get("id", ""), v.get("collection", "") or "-",
+                f"{v.get('size', 0) / 1e6:.1f} MB", v.get("file_count", 0),
+                v.get("delete_count", 0), v.get("deleted_byte_count", 0),
+                "ro" if v.get("read_only") else "rw",
+                v.get("ttl", 0) or "-", v.get("version", ""),
+            ] for v in sorted(volumes, key=lambda v: v.get("id", 0))],
+        )
+    )
+    body.append("<h2>EC shards</h2>")
+    body.append(
+        _table(
+            ["volume", "collection", "shards held"],
+            [[
+                s.get("id", ""), s.get("collection", "") or "-",
+                s.get("shard_ids", ""),
+            ] for s in ec_shards],
+        )
+    )
+    return _page(f"seaweedfs-tpu volume server {url}", "".join(body))
+
+
+def render_filer_listing(
+    path: str, entries: list, limit: int, has_more: bool
+) -> str:
+    rows = []
+    for e in entries:
+        name = e.name + ("/" if e.is_directory else "")
+        href = (path.rstrip("/") or "") + "/" + e.name
+        rows.append([
+            f'<a href="{_esc(href)}">{_esc(name)}</a>',
+            "-" if e.is_directory else e.attr.file_size,
+            time.strftime(
+                "%Y-%m-%d %H:%M:%S", time.localtime(e.attr.mtime or 0)
+            ),
+            f"{e.attr.mode & 0o7777:o}",
+        ])
+    body = [f"<h2>{_esc(path.rstrip('/') or '/')}</h2>"]
+    # the name cell is pre-escaped html (an anchor): render raw
+    head = "".join(
+        f"<th>{h}</th>" for h in ("name", "size", "modified", "mode")
+    )
+    trs = "".join(
+        "<tr><td>" + r[0] + "</td>"
+        + "".join(f"<td>{_esc(c)}</td>" for c in r[1:])
+        + "</tr>"
+        for r in rows
+    )
+    body.append(f"<table><tr>{head}</tr>{trs}</table>")
+    if has_more:
+        body.append(
+            f'<p class="muted">showing first {limit}; pass ?limit= for more</p>'
+        )
+    return _page("seaweedfs-tpu filer", "".join(body))

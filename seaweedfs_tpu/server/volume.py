@@ -448,6 +448,39 @@ class VolumeServer:
 
     async def h_status(self, request: web.Request) -> web.Response:
         infos = await asyncio.to_thread(self.store.volume_infos)
+        from . import ui
+
+        if ui.wants_html(request):
+            # operator page (reference volume_server_ui/ index.html)
+            disks = [
+                {
+                    "dir": loc.directory,
+                    "disk_type": loc.disk_type,
+                    "max_volume_count": loc.max_volume_count,
+                    "volumes": len(loc.volumes),
+                    "ec_shards": sum(
+                        len(ev.shards) for ev in loc.ec_volumes.values()
+                    ),
+                }
+                for loc in self.store.locations
+            ]
+            ec = [
+                {
+                    "id": ev.id,
+                    "collection": ev.collection,
+                    "shard_ids": ",".join(
+                        str(s) for s in sorted(ev.shards)
+                    ),
+                }
+                for loc in self.store.locations
+                for ev in loc.ec_volumes.values()
+            ]
+            return web.Response(
+                text=ui.render_volume(
+                    self.url, disks, [vars(i) for i in infos], ec
+                ),
+                content_type="text/html",
+            )
         return web.json_response(
             {
                 "Version": "seaweedfs-tpu",

@@ -1,0 +1,80 @@
+"""HTML status pages (reference master_server_handlers_ui.go,
+volume_server_ui/, filer_ui/): `Accept: text/html` renders operator
+pages on master /, volume /status, and filer directory GETs, while JSON
+clients keep their existing responses.
+"""
+import asyncio
+
+import aiohttp
+
+from seaweedfs_tpu.operation import assign, upload_data
+from seaweedfs_tpu.server.cluster import LocalCluster
+
+HTML = {"Accept": "text/html"}
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def fetch(url, headers=None):
+    async with aiohttp.ClientSession() as s:
+        async with s.get(url, headers=headers or {}) as r:
+            return r.status, r.content_type, await r.text()
+
+
+def test_status_pages(tmp_path):
+    async def go():
+        cluster = LocalCluster(
+            base_dir=str(tmp_path), n_volume_servers=1, with_filer=True,
+            pulse_seconds=1,
+        )
+        await cluster.start()
+        try:
+            master = cluster.master.advertise_url
+            a = await assign(master)
+            await upload_data(f"http://{a.url}/{a.fid}", b"ui-test-needle")
+            async with aiohttp.ClientSession() as s:
+                async with s.put(
+                    f"http://{cluster.filer.url}/docs/hello.txt", data=b"hi"
+                ) as r:
+                    assert r.status < 300
+            await asyncio.sleep(1.2)  # heartbeat: volume visible on master
+
+            vs = cluster.volume_servers[0]
+            from seaweedfs_tpu.pb import server_address
+
+            master_http = server_address.http_address(master)
+
+            # master: HTML for browsers, JSON dir status untouched
+            status, ctype, text = await fetch(f"http://{master_http}/", HTML)
+            assert status == 200 and ctype == "text/html"
+            assert "Topology" in text or "Volumes" in text
+            assert vs.url in text, "volume node must appear in the topology"
+            status, ctype, _ = await fetch(f"http://{master_http}/dir/status")
+            assert status == 200 and ctype == "application/json"
+
+            # volume server: disks + volumes tables
+            status, ctype, text = await fetch(
+                f"http://{vs.url}/status", HTML
+            )
+            assert status == 200 and ctype == "text/html"
+            assert "Disks" in text and "Volumes" in text
+            assert str(a.fid.split(",")[0]) in text
+            status, ctype, _ = await fetch(f"http://{vs.url}/status")
+            assert ctype == "application/json"
+
+            # filer: directory listing page with the file linked
+            status, ctype, text = await fetch(
+                f"http://{cluster.filer.url}/docs", HTML
+            )
+            assert status == 200 and ctype == "text/html"
+            assert "hello.txt" in text
+            status, ctype, _ = await fetch(
+                f"http://{cluster.filer.url}/docs"
+            )
+            assert ctype == "application/json"
+        finally:
+            await cluster.stop()
+
+    run(go())
