@@ -9,8 +9,18 @@ psum over the shard axis + mod-2 yields the reconstructed bytes.
 """
 from .distributed import (
     distributed_apply_matrix,
+    distributed_degraded_read,
+    distributed_encode_blockdiag,
     make_mesh,
     shard_parallel_apply,
+    staged_apply_matrix,
 )
 
-__all__ = ["make_mesh", "distributed_apply_matrix", "shard_parallel_apply"]
+__all__ = [
+    "make_mesh",
+    "distributed_apply_matrix",
+    "distributed_encode_blockdiag",
+    "distributed_degraded_read",
+    "staged_apply_matrix",
+    "shard_parallel_apply",
+]

@@ -1,5 +1,7 @@
 """Mesh-sharded EC math vs the numpy oracle, on the virtual 8-device mesh
 (the in-process multi-node test shape of reference topology_test.go)."""
+import os
+
 import numpy as np
 import pytest
 
@@ -50,3 +52,74 @@ def test_distributed_full_cycle_with_delete(data):
     mesh_a = make_mesh(5, 1)
     parity = np.asarray(distributed_apply_matrix(mesh_a, parity_m, data))
     np.testing.assert_array_equal(parity, full[10:])
+
+
+def test_distributed_blockdiag_and_degraded_read(data):
+    """Block-diagonal bulk encode + batched degraded read under shard_map
+    (the pod-scale forms of the single-chip fast paths)."""
+    import jax
+
+    from seaweedfs_tpu.parallel import (
+        distributed_degraded_read,
+        distributed_encode_blockdiag,
+    )
+
+    mesh = make_mesh(2, 2, devices=jax.devices("cpu")[:4])
+    parity_m = rs.RSCodec().matrix[10:]
+    b = data.shape[1] - data.shape[1] % (4 * 2 * 128)
+    data = data[:, :b]
+    want = rs_cpu.apply_matrix_numpy(parity_m, data)
+    got = np.asarray(distributed_encode_blockdiag(mesh, parity_m, data))
+    np.testing.assert_array_equal(got, want)
+
+    codec = rs.RSCodec(backend="numpy")
+    full = codec.encode_all(data)
+    present = [i for i in range(14) if i not in (3, 11)]
+    reqs = [(5, 1000), (b - 700, 700), (1300, 2048)]
+    pieces = distributed_degraded_read(
+        mesh, full[present][:10], present[:10], 3, reqs
+    )
+    for (off, size), piece in zip(reqs, pieces):
+        assert piece == full[3][off : off + size].tobytes()
+
+
+def test_two_process_host_staging(tmp_path):
+    """BASELINE config 5's staging story: TWO separate processes, each
+    contributing only its process-local input slice via
+    jax.make_array_from_process_local_data, jointly running the sharded
+    encode over one logical 8-device mesh with the psum crossing process
+    boundaries.  Each worker asserts the full result against the oracle."""
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, "-m", "seaweedfs_tpu.parallel.distributed",
+                "--staged-worker",
+                "--coordinator", f"127.0.0.1:{port}",
+                "--nproc", "2", "--pid", str(pid),
+            ],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out.decode())
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out}"
+        assert f"staged worker {pid}: ok" in out, out
