@@ -94,7 +94,7 @@ def require_native():
         sys.exit(1)
 
 
-def bench_cpu_group(parity_m, mb=64, reps=6):
+def bench_cpu_group(parity_m, mb=64, reps=10):
     """One group of CPU-kernel reps -> list of per-rep seconds.  main()
     runs two groups (before and after the device benches) and medians the
     union, so a transient on this single shared core shows up as

@@ -428,18 +428,38 @@ def _group_vectors(part, requests, row_of, pad):
     return offsets, rows, deltas
 
 
+def _fetch_cover(span: int) -> int:
+    """Smallest of {2^n, 3*2^(n-1)} covering span (min 2048).  A pure
+    power-of-two ladder wastes ~2x D2H whenever the alignment delta pushes
+    a power-of-two-sized request just past the boundary (the common case:
+    any unaligned 1MB needle); the 1.5x steps cap the waste at ~50% while
+    adding at most one compiled shape per size class."""
+    p = max(1 << (span - 1).bit_length(), 2048)
+    three_halves = 3 * (p >> 2)
+    return three_halves if three_halves >= max(span, 2048) else p
+
+
+def _fused_tile_for(fetch: int) -> int:
+    """Largest per-chunk tile <= FUSED_TILE dividing fetch (fetch is
+    2^n or 3*2^(n-1), so halving always lands on a divisor >= 1024)."""
+    t = FUSED_TILE
+    while fetch % t:
+        t //= 2
+    return t
+
+
 def _fused_vectors(part, requests, row_of, pad):
     """Re-align each sub-request down to FUSED_ALIGN: offsets become unit
     counts, the residual joins the host-trimmed delta.  -> (offs_units,
-    rows, deltas, fetch) with fetch a power-of-two cover of the largest
-    delta+take (CHUNK keeps it <= MAX_TILE)."""
+    rows, deltas, fetch) with fetch covering the largest delta+take
+    (CHUNK keeps it <= MAX_TILE)."""
     offs_units, deltas = [], []
     for _, s in part:
         extra = s[1] % FUSED_ALIGN
         offs_units.append((s[1] - extra) // FUSED_ALIGN)
         deltas.append(s[2] + extra)
     span = max(d + s[3] for d, (_, s) in zip(deltas, part))
-    fetch = max(1 << (span - 1).bit_length(), 2048)
+    fetch = _fetch_cover(span)
     offsets = jnp.asarray(
         np.array(offs_units + [0] * pad, dtype=np.int32)
     )
@@ -508,7 +528,7 @@ def reconstruct_intervals(
                         survivors,
                         offsets,
                         rows,
-                        tile=min(fetch, FUSED_TILE),
+                        tile=_fused_tile_for(fetch),
                         fetch=fetch,
                         k_true=len(use),
                         interpret=interpret,
@@ -582,7 +602,7 @@ def make_batched_call(
             survivors,
             offsets,
             rows,
-            tile=min(fetch, FUSED_TILE),
+            tile=_fused_tile_for(fetch),
             fetch=fetch,
             k_true=len(use),
             interpret=interpret,

@@ -268,6 +268,21 @@ BLOCKDIAG_GROUPS = 4
 BLOCKDIAG_TILE = 32768
 
 
+def blockdiag_system(
+    m_gf: np.ndarray, groups: int = BLOCKDIAG_GROUPS
+) -> np.ndarray:
+    """GF(256) matrix [m,k] -> the [groups*m, groups*k] block-diagonal
+    system that encodes `groups` independent stripe segments in one
+    multiply.  Shared by the single-chip prepared-matrix path and the
+    mesh-sharded encode so the two can never drift."""
+    m_gf = np.asarray(m_gf, dtype=np.uint8)
+    m, k = m_gf.shape
+    blk = np.zeros((groups * m, groups * k), dtype=np.uint8)
+    for g in range(groups):
+        blk[g * m : (g + 1) * m, g * k : (g + 1) * k] = m_gf
+    return blk
+
+
 def prepare_matrix_blockdiag(
     m_gf: np.ndarray, groups: int = BLOCKDIAG_GROUPS
 ) -> jax.Array:
@@ -277,12 +292,7 @@ def prepare_matrix_blockdiag(
     what _unpack_bits_bitmajor produces for the STACKED input (bit-major
     over all groups*k rows — a per-group bit-major layout would compute
     garbage)."""
-    m_gf = np.asarray(m_gf, dtype=np.uint8)
-    m, k = m_gf.shape
-    blk = np.zeros((groups * m, groups * k), dtype=np.uint8)
-    for g in range(groups):
-        blk[g * m : (g + 1) * m, g * k : (g + 1) * k] = m_gf
-    return prepare_matrix(blk)
+    return prepare_matrix(blockdiag_system(m_gf, groups))
 
 
 def apply_matrix_device_blockdiag(

@@ -138,11 +138,9 @@ def distributed_encode_blockdiag(
     from ..ops import rs_tpu
 
     parity_m = np.asarray(parity_m, dtype=np.uint8)
-    rows, k = parity_m.shape
+    rows = parity_m.shape[0]
     shards = np.asarray(shards, dtype=np.uint8)
-    blk = np.zeros((groups * rows, groups * k), dtype=np.uint8)
-    for g in range(groups):
-        blk[g * rows : (g + 1) * rows, g * k : (g + 1) * k] = parity_m
+    blk = rs_tpu.blockdiag_system(parity_m, groups)
     stacked = rs_tpu.stack_segments(shards, groups)  # [g*k, B/g]
     out = np.asarray(distributed_apply_matrix(mesh, blk, stacked))
     return rs_tpu.unstack_segments(out, rows, groups)
@@ -169,20 +167,24 @@ def distributed_degraded_read(
     order = [survivor_ids.index(s) for s in use]
     n_batch = mesh.shape["batch"]
     tile = 128 * n_batch
+    # variable-width concatenation: each request contributes only its own
+    # tile-rounded span (padding every request to the burst's largest span
+    # would stage/transfer mostly zeros for mixed-size bursts)
     spans = []
+    col = 0
     for off, size in requests:
         lo = off - off % 128
         span = -(-(off + size - lo) // tile) * tile
-        spans.append((lo, span))
-    width = max(s for _, s in spans)
-    x = np.zeros((len(use), len(requests) * width), dtype=np.uint8)
-    for j, (lo, _) in enumerate(spans):
-        seg = survivors[order, lo : lo + width]
-        x[:, j * width : j * width + seg.shape[1]] = seg
+        spans.append((lo, span, col))
+        col += span
+    x = np.zeros((len(use), col), dtype=np.uint8)
+    for lo, span, c in spans:
+        seg = survivors[order, lo : lo + span]
+        x[:, c : c + seg.shape[1]] = seg
     out = np.asarray(distributed_apply_matrix(mesh, rmat, x))
     return [
-        out[0, j * width + (off - lo) : j * width + (off - lo) + size].tobytes()
-        for j, ((off, size), (lo, _)) in enumerate(zip(requests, spans))
+        out[0, c + (off - lo) : c + (off - lo) + size].tobytes()
+        for (off, size), (lo, _, c) in zip(requests, spans)
     ]
 
 

@@ -117,9 +117,17 @@ def test_two_process_host_staging(tmp_path):
         for pid in (0, 1)
     ]
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=240)
-        outs.append(out.decode())
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out.decode())
+    finally:
+        # a hung worker (e.g. peer crashed before initialize) must not
+        # leak past the test; grab whatever output it produced
+        for p in procs[len(outs):]:
+            p.kill()
+            out, _ = p.communicate()
+            outs.append("[killed after timeout]\n" + out.decode())
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out}"
         assert f"staged worker {pid}: ok" in out, out
