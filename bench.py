@@ -327,7 +327,7 @@ def overlap_fraction(stats):
     return max(0.0, min(1.0, (host + dev - wall) / min(host, dev)))
 
 
-def bench_degraded_read_resident(sizes=(4096, 65536, 1048576), n=24, batch=64):
+def bench_degraded_read_resident(sizes=(4096, 65536, 1048576), n=18, batch=64):
     """Degraded reads served from DEVICE-RESIDENT shards (ops/rs_resident):
     survivors pinned in HBM once, then each call ships only offsets up and
     reconstructed bytes down.  Reports p99 per-needle latency for single
@@ -369,7 +369,7 @@ def bench_degraded_read_resident(sizes=(4096, 65536, 1048576), n=24, batch=64):
         t0 = time.perf_counter()
         rs_resident.reconstruct_intervals(cache, 1, req)
         lats_single.append(time.perf_counter() - t0)
-    for i in range(max(9, n // 2)):
+    for i in range(9):
         size = sizes[i % len(sizes)]
         reqs = [
             (3, int(rng.integers(0, L - size)), size) for _ in range(batch)
@@ -403,13 +403,19 @@ def bench_degraded_read_resident(sizes=(4096, 65536, 1048576), n=24, batch=64):
     return out
 
 
-def bench_degraded_read(sizes=(4096, 65536, 1048576), n=40, batch=64):
+def bench_degraded_read(sizes=(4096, 65536, 1048576), n=24, batch=64):
     """Per-needle degraded read: 2 shards down, reconstruct the needle's
     interval bytes from 10 survivors (store_ec.go:339-393 shape).  Reports
     p99 per-needle latency for the CPU kernel, a single device call
     (pays full tunnel/dispatch RTT), and a 64-needle batched device call
     (the design's amortization: one call reconstructs a whole read burst).
-    """
+
+    The CPU-native baseline runs the full size mix (it is the number the
+    resident path's projection is compared against); the DEVICE comparison
+    paths run small needles only — they ship 10x the payload per call,
+    and with the tunnel's bandwidth swinging as low as ~0.1 MB/s, 1MB
+    needles would stretch the benchmark by tens of minutes to time a
+    design the resident path already supersedes."""
     from seaweedfs_tpu.ops import gf256, rs, rs_tpu, rs_cpu
 
     missing = [3, 11]
@@ -443,13 +449,14 @@ def bench_degraded_read(sizes=(4096, 65536, 1048576), n=40, batch=64):
             lats.append((time.perf_counter() - t0) / width)
         return lats
 
-    for label, fn in (
-        (
-            "native",
-            lambda stack: rs_cpu.apply_matrix_native(rmat, stack),
-        ),
-        (
-            "device_single",
+    out["native"] = p99(
+        timed_run(
+            lambda stack: rs_cpu.apply_matrix_native(rmat, stack), n, width=1
+        )
+    )
+    sizes = tuple(s for s in sizes if s <= 65536)  # device paths: small only
+    out["device_single"] = p99(
+        timed_run(
             lambda stack: np.asarray(
                 rs_tpu.apply_matrix_device(
                     a_bm,
@@ -459,16 +466,12 @@ def bench_degraded_read(sizes=(4096, 65536, 1048576), n=40, batch=64):
                     k_true=len(use),
                 )
             ),
-        ),
-    ):
-        out[label] = p99(timed_run(fn, n, width=1))
+            n,
+            width=1,
+        )
+    )
 
-    # batched: one device call reconstructs `batch` needles (concatenated).
-    # Small needles only — this comparison path ships 10x the payload per
-    # call, and at 1MB x64 that is ~640MB through the tunnel per
-    # iteration; the resident path below is the shipped design there.
-    global_sizes = sizes
-    sizes = (4096, 65536)
+    # batched: one device call reconstructs `batch` needles (concatenated)
     out["device_batched"] = p99(
         timed_run(
             lambda stack: np.asarray(
@@ -484,7 +487,6 @@ def bench_degraded_read(sizes=(4096, 65536, 1048576), n=40, batch=64):
             width=batch,
         )
     )
-    sizes = global_sizes
     return out
 
 

@@ -649,8 +649,9 @@ def warm(
     for size in sizes:
         for count in counts:
             # both alignment classes: an aligned offset keeps fetch at
-            # cover(size); any other offset pushes the span past it into
-            # the next power of two — each is its own compiled shape
+            # cover(size); any other offset pushes the span past it onto
+            # the next ladder step (usually the 3*2^(n-1) one, see
+            # _fetch_cover) — each is its own compiled shape
             for off in (0, 1):
                 reqs = [(missing, off, size)] * count
                 reconstruct_intervals(cache, vid, reqs, **kw)

@@ -121,9 +121,11 @@ def test_two_process_host_staging(tmp_path):
         for p in procs:
             out, _ = p.communicate(timeout=240)
             outs.append(out.decode())
-    finally:
+    except subprocess.TimeoutExpired:
         # a hung worker (e.g. peer crashed before initialize) must not
-        # leak past the test; grab whatever output it produced
+        # leak past the test; collect whatever output every remaining
+        # worker produced and FALL THROUGH to the assertions so the
+        # failure message shows the root cause, not a bare timeout
         for p in procs[len(outs):]:
             p.kill()
             out, _ = p.communicate()
