@@ -1,0 +1,96 @@
+"""Randomized random-access read/write harness over the REAL kernel mount
+(the role of the reference's test/random_access Java harness): interleaved
+positional writes, reads, truncates, and reopens against an in-memory
+oracle, verifying byte-exactness after every operation batch.
+"""
+import asyncio
+import os
+import random
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists("/dev/fuse") or os.geteuid() != 0,
+    reason="needs /dev/fuse and root",
+)
+
+from seaweedfs_tpu.mount import Mount  # noqa: E402
+from seaweedfs_tpu.server.cluster import LocalCluster  # noqa: E402
+
+
+def test_randomized_positional_io(tmp_path):
+    async def go():
+        mnt = str(tmp_path / "mnt")
+        os.makedirs(mnt)
+        cluster = LocalCluster(
+            base_dir=str(tmp_path / "data"), n_volume_servers=1,
+            with_filer=True,
+        )
+        await cluster.start()
+        m = Mount(
+            mnt,
+            filer_address=cluster.filer.url,
+            filer_grpc_address=f"{cluster.filer.ip}:{cluster.filer.grpc_port}",
+            chunk_size=64 * 1024,  # small chunks: more boundaries per op
+        )
+        await m.start()
+        try:
+            def harness():
+                rng = random.Random(1234)
+                path = mnt + "/ra.bin"
+                size_cap = 1 << 20
+                oracle = bytearray()
+                fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o644)
+                try:
+                    for step in range(120):
+                        op = rng.randrange(10)
+                        if op < 5:  # positional write
+                            off = rng.randrange(0, size_cap)
+                            n = rng.randrange(1, 64 * 1024)
+                            blob = rng.randbytes(n)
+                            os.pwrite(fd, blob, off)
+                            if len(oracle) < off + n:
+                                oracle.extend(
+                                    b"\x00" * (off + n - len(oracle))
+                                )
+                            oracle[off : off + n] = blob
+                        elif op < 8:  # positional read
+                            if not oracle:
+                                continue
+                            off = rng.randrange(0, len(oracle))
+                            n = rng.randrange(1, 96 * 1024)
+                            got = os.pread(fd, n, off)
+                            want = bytes(oracle[off : off + n])
+                            assert got == want, (
+                                f"step {step}: read {len(got)}B@{off} "
+                                "diverged from oracle"
+                            )
+                        elif op < 9 and oracle:  # truncate (shrink or grow)
+                            new = rng.randrange(0, len(oracle) + 4096)
+                            os.ftruncate(fd, new)
+                            if new <= len(oracle):
+                                del oracle[new:]
+                            else:
+                                oracle.extend(b"\x00" * (new - len(oracle)))
+                        else:  # flush + reopen: durability through commit
+                            os.close(fd)
+                            fd = os.open(path, os.O_RDWR)
+                            st = os.stat(path)
+                            assert st.st_size == len(oracle), (
+                                f"step {step}: size {st.st_size} != "
+                                f"oracle {len(oracle)}"
+                            )
+                    os.close(fd)
+                    fd = -1
+                    with open(path, "rb") as f:
+                        assert f.read() == bytes(oracle), "final content"
+                finally:
+                    if fd >= 0:
+                        os.close(fd)
+
+            await asyncio.to_thread(harness)
+        finally:
+            await m.stop()
+            await cluster.stop()
+
+    asyncio.run(go())

@@ -118,18 +118,28 @@ def test_two_process_host_staging(tmp_path):
     ]
     outs = []
     try:
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=240)
+                outs.append(out.decode(errors="replace"))
+        except subprocess.TimeoutExpired:
+            # a hung worker (e.g. peer crashed before initialize): collect
+            # whatever output every remaining worker produced and FALL
+            # THROUGH to the assertions so the failure message shows the
+            # root cause, not a bare timeout
+            for p in procs[len(outs):]:
+                p.kill()
+                out, _ = p.communicate()
+                outs.append(
+                    "[killed after timeout]\n" + out.decode(errors="replace")
+                )
+    finally:
+        # no exception path may leak workers (KeyboardInterrupt, pytest
+        # timeout, decode errors): kill anything still running
         for p in procs:
-            out, _ = p.communicate(timeout=240)
-            outs.append(out.decode())
-    except subprocess.TimeoutExpired:
-        # a hung worker (e.g. peer crashed before initialize) must not
-        # leak past the test; collect whatever output every remaining
-        # worker produced and FALL THROUGH to the assertions so the
-        # failure message shows the root cause, not a bare timeout
-        for p in procs[len(outs):]:
-            p.kill()
-            out, _ = p.communicate()
-            outs.append("[killed after timeout]\n" + out.decode())
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} failed:\n{out}"
         assert f"staged worker {pid}: ok" in out, out
