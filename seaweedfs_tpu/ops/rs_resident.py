@@ -57,6 +57,35 @@ class CacheMiss(LookupError):
     """Not enough resident shards to serve the request."""
 
 
+_COMPILE_CACHE_SET = False
+
+
+def enable_persistent_compile_cache(path: str) -> bool:
+    """Point XLA's persistent compilation cache at `path` so the
+    reconstruct kernel's per-(size, count)-shape compiles (tens of
+    seconds each on remote-compile rigs) survive process restarts.
+
+    The setting is PROCESS-GLOBAL, so call this once from the process
+    entry point (the volume CLI does, next to -ec.deviceCacheMB); later
+    calls no-op.  Returns True when the cache was enabled."""
+    global _COMPILE_CACHE_SET
+    if _COMPILE_CACHE_SET:
+        return False
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # noqa: BLE001 — older jax without the knobs
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "persistent compile cache unavailable (%s): every restart "
+            "will recompile the reconstruct kernel shapes", e,
+        )
+        return False
+    _COMPILE_CACHE_SET = True
+    return True
+
+
 def _bucket(values: tuple[int, ...], need: int) -> int:
     for v in values:
         if need <= v:
@@ -628,7 +657,8 @@ def warm(
     cache: DeviceShardCache,
     vid: int,
     sizes: tuple[int, ...] = (4096, 65536, 1 << 20),
-    counts: tuple[int, ...] = (1, 64),
+    counts: tuple[int, ...] = (1, 8, 64),  # single read, a batcher
+    # coalesce round, and a full burst — the serving path's count shapes
     total_shards: int = TOTAL_SHARDS,
     **kw,
 ) -> None:
