@@ -179,13 +179,11 @@ def main():
             )
             for i in range(10)
         )
-        offs = jnp.array([128, 4096], dtype=jnp.int32)
-        rows = jnp.array([0, 0], dtype=jnp.int32)
+        meta = jnp.array([[0, 4], [0, 0]], dtype=jnp.int32)  # [offs_units, rows]
         return rs_resident._fused_reconstruct(
             a_bm,
             survivors,
-            offs,
-            rows,
+            meta,
             tile=2048,
             fetch=2048,
             k_true=10,

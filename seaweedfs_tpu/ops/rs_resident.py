@@ -271,11 +271,12 @@ def _make_select_body(k: int, k_pad: int, m_pad: int, g_n: int, tile: int):
     jax.jit, static_argnames=("tile", "fetch", "k_true", "interpret")
 )
 def _fused_reconstruct(
-    a_bm, survivors, offsets, row_idx, *, tile, fetch, k_true, interpret
+    a_bm, survivors, meta, *, tile, fetch, k_true, interpret
 ):
     """survivors: tuple of [L] u8 resident shards (HBM) in matrix column
-    order; offsets [N] int32 in FUSED_ALIGN units (byte offset /
-    FUSED_ALIGN); row_idx [N] int32.  -> [N, fetch] u8 of raw
+    order; meta [2, N] int32 — row 0 the offsets in FUSED_ALIGN units
+    (byte offset / FUSED_ALIGN), row 1 the wanted matrix rows (packed so
+    the call ships ONE scalar vector).  -> [N, fetch] u8 of raw
     reconstructed bytes starting at each aligned offset (caller trims the
     delta head).  N pads to the 8-request group internally.  Returns the
     [N, fetch] result FLATTENED (1-D, true-N rows only): 2-D transfers
@@ -285,11 +286,11 @@ def _fused_reconstruct(
         raise ValueError(f"{k} survivors but matrix was built for {k_true}")
     m_pad8, k_pad8 = a_bm.shape
     m_pad, k_pad = m_pad8 // 8, k_pad8 // 8
-    n = offsets.shape[0]
+    n = meta.shape[1]
     pad = (-n) % FUSED_GROUP
     if pad:
-        offsets = jnp.pad(offsets, (0, pad))
-        row_idx = jnp.pad(row_idx, (0, pad))
+        meta = jnp.pad(meta, ((0, 0), (0, pad)))
+    offsets, row_idx = meta[0], meta[1]
     n_pad = n + pad
     tile = min(tile, fetch)
     chunks = max(1, fetch // tile)
@@ -479,9 +480,10 @@ def _fused_tile_for(fetch: int) -> int:
 
 def _fused_vectors(part, requests, row_of, pad):
     """Re-align each sub-request down to FUSED_ALIGN: offsets become unit
-    counts, the residual joins the host-trimmed delta.  -> (offs_units,
-    rows, deltas, fetch) with fetch covering the largest delta+take
-    (CHUNK keeps it <= MAX_TILE)."""
+    counts, the residual joins the host-trimmed delta.  -> (meta, deltas,
+    fetch): meta is the packed [2, N] int32 (offset units / wanted rows,
+    one H2D transfer) and fetch covers the largest delta+take (CHUNK
+    keeps it <= MAX_TILE)."""
     offs_units, deltas = [], []
     for _, s in part:
         extra = s[1] % FUSED_ALIGN
@@ -489,16 +491,19 @@ def _fused_vectors(part, requests, row_of, pad):
         deltas.append(s[2] + extra)
     span = max(d + s[3] for d, (_, s) in zip(deltas, part))
     fetch = _fetch_cover(span)
-    offsets = jnp.asarray(
-        np.array(offs_units + [0] * pad, dtype=np.int32)
-    )
-    rows = jnp.asarray(
+    # ONE packed [2, N] host->device transfer (row 0: offset units, row 1:
+    # wanted matrix rows): tiny scalar vectors each pay a full dispatch
+    # RTT on tunneled rigs, so two transfers would double that tax
+    meta = jnp.asarray(
         np.array(
-            [row_of[requests[s[0]][0]] for _, s in part] + [0] * pad,
+            [
+                offs_units + [0] * pad,
+                [row_of[requests[s[0]][0]] for _, s in part] + [0] * pad,
+            ],
             dtype=np.int32,
         )
     )
-    return offsets, rows, deltas, fetch
+    return meta, deltas, fetch
 
 
 def _use_fused(kernel: str, interpret: bool) -> bool:
@@ -548,15 +553,14 @@ def reconstruct_intervals(
             if fused:
                 # fetch covers the realigned delta+take (the host trims
                 # the delta head after D2H; no in-kernel shift needed)
-                offsets, rows, deltas, fetch = _fused_vectors(
+                meta, deltas, fetch = _fused_vectors(
                     part, requests, row_of, pad
                 )
                 out = np.asarray(
                     _fused_reconstruct(
                         a_bm,
                         survivors,
-                        offsets,
-                        rows,
+                        meta,
                         tile=_fused_tile_for(fetch),
                         fetch=fetch,
                         k_true=len(use),
@@ -623,14 +627,13 @@ def make_batched_call(
     part = list(enumerate(subs))
     pad = _bucket(COUNT_BUCKETS, len(part)) - len(part)
     if _use_fused(kernel, interpret):
-        offsets, rows, _deltas, fetch = _fused_vectors(
+        meta, _deltas, fetch = _fused_vectors(
             part, requests, row_of, pad
         )
         return lambda: _fused_reconstruct(
             a_bm,
             survivors,
-            offsets,
-            rows,
+            meta,
             tile=_fused_tile_for(fetch),
             fetch=fetch,
             k_true=len(use),
