@@ -148,6 +148,23 @@ class DeviceShardCache:
     def shard_size(self, vid: int, shard_id: int) -> int | None:
         return self._true_sizes.get((vid, shard_id))
 
+    def stats(self) -> tuple[int, int]:
+        """(resident shard count, padded device bytes held)."""
+        with self._lock:
+            return len(self._arrays), self.bytes_used
+
+    def resident_by_vid(self) -> dict[int, list[int]]:
+        """One locked snapshot of vid -> sorted resident shard ids (status
+        pages render many volumes; per-vid shard_ids() calls would scan
+        the key set once per volume under the serving path's lock)."""
+        out: dict[int, list[int]] = {}
+        with self._lock:
+            for v, s in self._arrays:
+                out.setdefault(v, []).append(s)
+        for ids in out.values():
+            ids.sort()
+        return out
+
     def shard_ids(self, vid: int) -> list[int]:
         with self._lock:
             return sorted(s for (v, s) in self._arrays if v == vid)

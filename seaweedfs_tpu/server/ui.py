@@ -142,10 +142,10 @@ def render_volume(
     body.append("<h2>EC shards</h2>")
     body.append(
         _table(
-            ["volume", "collection", "shards held"],
+            ["volume", "collection", "shards held", "resident in HBM"],
             [[
                 s.get("id", ""), s.get("collection", "") or "-",
-                s.get("shard_ids", ""),
+                s.get("shard_ids", ""), s.get("resident", "-") or "-",
             ] for s in ec_shards],
         )
     )

@@ -464,6 +464,10 @@ class VolumeServer:
                 }
                 for loc in self.store.locations
             ]
+            cache = self.store.ec_device_cache
+            resident = (
+                cache.resident_by_vid() if cache is not None else {}
+            )
             ec = [
                 {
                     "id": ev.id,
@@ -471,6 +475,11 @@ class VolumeServer:
                     "shard_ids": ",".join(
                         str(s) for s in sorted(ev.shards)
                     ),
+                    "resident": ",".join(
+                        str(s) for s in resident.get(ev.id, [])
+                    )
+                    if cache is not None
+                    else "-",
                 }
                 for loc in self.store.locations
                 for ev in loc.ec_volumes.values()
@@ -542,6 +551,11 @@ class VolumeServer:
             stats.VOLUME_SERVER_VOLUME_GAUGE.labels(
                 collection=collection, type=kind
             ).set(count)
+        cache = self.store.ec_device_cache
+        if cache is not None:
+            n_resident, n_bytes = cache.stats()
+            stats.VOLUME_SERVER_RESIDENT_SHARD_GAUGE.set(n_resident)
+            stats.VOLUME_SERVER_RESIDENT_BYTES_GAUGE.set(n_bytes)
 
     def _parse_fid(self, request: web.Request) -> tuple[int, int, int]:
         fid = request.match_info["fid"].strip("/")

@@ -78,6 +78,16 @@ VOLUME_SERVER_VOLUME_GAUGE = Gauge(
     ["collection", "type"],
     registry=REGISTRY,
 )
+VOLUME_SERVER_RESIDENT_SHARD_GAUGE = Gauge(
+    "SeaweedFS_volumeServer_ec_resident_shards",
+    "EC shards pinned in device HBM (the degraded-read fast path).",
+    registry=REGISTRY,
+)
+VOLUME_SERVER_RESIDENT_BYTES_GAUGE = Gauge(
+    "SeaweedFS_volumeServer_ec_resident_bytes",
+    "Device memory held by the EC shard cache (padded bytes).",
+    registry=REGISTRY,
+)
 
 FILER_REQUEST_COUNTER = Counter(
     "SeaweedFS_filer_request_total",
