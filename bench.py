@@ -23,7 +23,11 @@ TIMING METHODOLOGY (round-4 rework, VERDICT r3 Weak #1/#2):
     reps (one before the device benches, one after) and publishes the
     per-group medians + coefficient of variation, so a load transient on
     this single shared core is visible instead of silently shifting
-    vs_baseline.
+    vs_baseline.  Within-run cv measures ~0.07-0.09; ACROSS runs the
+    shared core itself swings (4.36 / 4.90 / 5.06 GB/s in three same-day
+    round-4 runs, ~10.5 in a quieter round-3 window), while the device
+    numbers repeat to ±0.02% — read vs_baseline together with
+    cpu_group_medians_gbps, not as a standalone constant.
 
 `extra` covers the remaining BASELINE.json configs, measured end to end:
 

@@ -477,9 +477,7 @@ class VolumeServer:
                     ),
                     "resident": ",".join(
                         str(s) for s in resident.get(ev.id, [])
-                    )
-                    if cache is not None
-                    else "-",
+                    ),
                 }
                 for loc in self.store.locations
                 for ev in loc.ec_volumes.values()
@@ -552,10 +550,12 @@ class VolumeServer:
                 collection=collection, type=kind
             ).set(count)
         cache = self.store.ec_device_cache
-        if cache is not None:
-            n_resident, n_bytes = cache.stats()
-            stats.VOLUME_SERVER_RESIDENT_SHARD_GAUGE.set(n_resident)
-            stats.VOLUME_SERVER_RESIDENT_BYTES_GAUGE.set(n_bytes)
+        # always set (zero when cache-less): on a shared registry
+        # (LocalCluster) a skipped set would leave another server's
+        # resident counts standing as if they were this server's
+        n_resident, n_bytes = cache.stats() if cache is not None else (0, 0)
+        stats.VOLUME_SERVER_RESIDENT_SHARD_GAUGE.set(n_resident)
+        stats.VOLUME_SERVER_RESIDENT_BYTES_GAUGE.set(n_bytes)
 
     def _parse_fid(self, request: web.Request) -> tuple[int, int, int]:
         fid = request.match_info["fid"].strip("/")

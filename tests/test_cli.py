@@ -59,6 +59,22 @@ def test_scaffold_templates_parse(capsys):
         tomllib.loads(scaffold.TEMPLATES[which])
 
 
+def _free_ports(n):
+    """Distinct ephemeral ports: fixed numbers collide on busy hosts (this
+    suite runs while benchmarks and sibling tests hold sockets)."""
+    import socket
+
+    socks, ports = [], []
+    for _ in range(n):
+        sk = socket.socket()
+        sk.bind(("127.0.0.1", 0))
+        socks.append(sk)
+        ports.append(sk.getsockname()[1])
+    for sk in socks:
+        sk.close()
+    return ports
+
+
 def _spawn(args, cwd):
     env = dict(os.environ, PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
     return subprocess.Popen(
@@ -89,7 +105,7 @@ def test_cli_two_process(tmp_path):
     write/read through them, and drive the admin shell over a pipe."""
     vol_dir = tmp_path / "v1"
     vol_dir.mkdir()
-    mport, vport = 29333, 28080
+    mport, vport = _free_ports(2)
     master = _spawn(["master", "-port", str(mport)], str(tmp_path))
     volume = None
     try:
@@ -142,7 +158,7 @@ def test_cli_two_process(tmp_path):
 
 def test_cli_shell_runs_commands(tmp_path):
     """cluster.ps / volume.list through the piped REPL."""
-    mport, vport = 29433, 28180
+    mport, vport = _free_ports(2)
     vol_dir = tmp_path / "v1"
     vol_dir.mkdir()
     master = _spawn(["master", "-port", str(mport)], str(tmp_path))
