@@ -24,6 +24,11 @@ def add_args(p) -> None:
         "-ec.backend", dest="ec_backend", default="auto",
         choices=["auto", "cpu", "native", "numpy", "xla", "pallas"],
     )
+    p.add_argument(
+        "-ec.deviceCacheMB", dest="ec_device_cache_mb", type=int, default=0,
+        help="pin mounted EC shards in device HBM up to this budget "
+        "(degraded reads serve from the fused reconstruct kernels)",
+    )
     p.add_argument("-filer", action="store_true", help="also run a filer")
     p.add_argument("-filer.port", dest="filer_port", type=int, default=8888)
     p.add_argument("-filer.db", dest="filer_db", default="")
@@ -59,6 +64,10 @@ async def run(args) -> None:
     counts = [int(c) for c in str(args.volume_max).split(",")]
     if len(counts) == 1:
         counts = counts * len(dirs)
+    if args.ec_device_cache_mb > 0:
+        from ..ops.rs_resident import compile_cache_for_volume_dirs
+
+        compile_cache_for_volume_dirs(args.ec_device_cache_mb, dirs)
     vs = VolumeServer(
         masters=[ms.advertise_url],
         directories=dirs,
@@ -66,6 +75,7 @@ async def run(args) -> None:
         port=args.volume_port,
         max_volume_counts=counts,
         ec_backend=args.ec_backend,
+        ec_device_cache_mb=args.ec_device_cache_mb,
         jwt_signing_key=jwt_key,
         white_list=white_list,
     )

@@ -93,16 +93,12 @@ async def run(args) -> None:
         storage_types.set_offset_size(args.offset_bytes)
     dirs = [d.strip() for d in args.dir.split(",") if d.strip()]
     counts = [int(c) for c in str(args.max_volume_counts).split(",")]
-    if args.ec_device_cache_mb > 0 and dirs:
+    if args.ec_device_cache_mb > 0:
         # process entry point: persist kernel compiles next to the data so
         # restarts don't re-pay tens of seconds per reconstruct shape
-        import os as _os
+        from ..ops.rs_resident import compile_cache_for_volume_dirs
 
-        from ..ops.rs_resident import enable_persistent_compile_cache
-
-        enable_persistent_compile_cache(
-            _os.path.join(dirs[0], "jax_compile_cache")
-        )
+        compile_cache_for_volume_dirs(args.ec_device_cache_mb, dirs)
     if len(counts) == 1:
         counts = counts * len(dirs)
     vs = VolumeServer(

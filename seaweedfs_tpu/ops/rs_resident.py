@@ -86,6 +86,18 @@ def enable_persistent_compile_cache(path: str) -> bool:
     return True
 
 
+def compile_cache_for_volume_dirs(ec_device_cache_mb: int, dirs) -> bool:
+    """CLI bootstrap shared by `volume` and `server`: when the device
+    shard cache is enabled, persist kernel compiles next to the data."""
+    import os
+
+    if ec_device_cache_mb <= 0 or not dirs:
+        return False
+    return enable_persistent_compile_cache(
+        os.path.join(dirs[0], "jax_compile_cache")
+    )
+
+
 def _bucket(values: tuple[int, ...], need: int) -> int:
     for v in values:
         if need <= v:
