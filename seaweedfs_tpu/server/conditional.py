@@ -1,0 +1,44 @@
+"""Conditional GET/HEAD evaluation (If-None-Match / If-Modified-Since ->
+304), the reference's checkPreconditions at
+weed/server/filer_server_handlers_read.go:60-80 and the needle ETag check
+at volume_server_handlers_read.go:160-175: If-None-Match wins when
+present; If-Modified-Since only consulted otherwise.
+"""
+from __future__ import annotations
+
+import calendar
+import time
+
+
+def _canonical_etag(tag: str) -> str:
+    tag = tag.strip()
+    if tag.startswith("W/"):
+        tag = tag[2:]
+    return tag.strip('"')
+
+
+def not_modified(request, etag: str, mtime: int | float | None) -> bool:
+    """True when the client's validators prove its cached copy is current.
+
+    `etag` is the response's ETag value (quoted or not — canonicalized
+    here); `mtime` is the entity's last-modified unix time (None/0 =
+    unknown)."""
+    inm = request.headers.get("If-None-Match", "")
+    if inm:
+        ours = _canonical_etag(etag)
+        return any(
+            _canonical_etag(candidate) in ("*", ours)
+            for candidate in inm.split(",")
+        )
+    ims = request.headers.get("If-Modified-Since", "")
+    if ims and mtime:
+        try:
+            # timegm, not mktime: the header is GMT by definition and the
+            # server's local timezone/DST must not skew the comparison
+            since = calendar.timegm(
+                time.strptime(ims, "%a, %d %b %Y %H:%M:%S GMT")
+            )
+        except ValueError:
+            return False
+        return int(mtime) <= since
+    return False

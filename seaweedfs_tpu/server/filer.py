@@ -481,6 +481,11 @@ class FilerServer:
         if entry.attr.md5:
             headers["Content-MD5"] = base64.b64encode(entry.attr.md5).decode()
 
+        from .conditional import not_modified
+
+        if not_modified(request, headers.get("ETag", ""), entry.attr.mtime):
+            return web.Response(status=304, headers=headers)
+
         offset, size, status = 0, total, 200
         rng = request.http_range
         if rng.start is not None or rng.stop is not None:

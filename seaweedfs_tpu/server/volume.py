@@ -630,6 +630,25 @@ class VolumeServer:
             headers["Last-Modified"] = time.strftime(
                 "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(n.last_modified)
             )
+        ct = n.mime.decode() if n.mime else "application/octet-stream"
+        resize = ct.startswith("image/") and (
+            "width" in request.query or "height" in request.query
+        )
+        if resize:
+            try:
+                rw = int(request.query.get("width") or 0)
+                rh = int(request.query.get("height") or 0)
+            except ValueError:
+                raise web.HTTPBadRequest(text="width/height must be integers")
+            rmode = request.query.get("mode", "")
+            # resize variants must not share the original's cache identity
+            headers["Etag"] = f'"{n.etag}-{rw}x{rh}{rmode}"'
+        from .conditional import not_modified
+
+        if not_modified(request, headers["Etag"], n.last_modified):
+            # BEFORE decompress/resize: a 304 exists to skip the body work;
+            # keep the validators so caches can refresh their entry
+            return web.Response(status=304, headers=headers)
         body = n.data
         if n.is_compressed:
             if "gzip" in request.headers.get("Accept-Encoding", ""):
@@ -638,21 +657,10 @@ class VolumeServer:
                 import gzip as _gz
 
                 body = _gz.decompress(body)
-        ct = n.mime.decode() if n.mime else "application/octet-stream"
-        if ct.startswith("image/") and (
-            "width" in request.query or "height" in request.query
-        ):
+        if resize:
             from ..images import resized
 
-            try:
-                rw = int(request.query.get("width") or 0)
-                rh = int(request.query.get("height") or 0)
-            except ValueError:
-                raise web.HTTPBadRequest(text="width/height must be integers")
-            rmode = request.query.get("mode", "")
             body = await asyncio.to_thread(resized, body, rw, rh, rmode)
-            # resize variants must not share the original's cache identity
-            headers["Etag"] = f'"{n.etag}-{rw}x{rh}{rmode}"'
         if request.method == "HEAD":
             return web.Response(
                 status=200, headers={**headers, "Content-Length": str(len(body))},

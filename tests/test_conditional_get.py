@@ -1,0 +1,95 @@
+"""Conditional GET/HEAD (If-None-Match / If-Modified-Since -> 304) on
+volume and filer reads — reference checkPreconditions
+(filer_server_handlers_read.go:60-80, volume_server_handlers_read.go:
+160-175).
+"""
+import asyncio
+import time
+
+import aiohttp
+
+from seaweedfs_tpu.operation import assign, upload_data
+from seaweedfs_tpu.server.cluster import LocalCluster
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def fetch(url, headers=None):
+    async with aiohttp.ClientSession() as s:
+        async with s.get(url, headers=headers or {}) as r:
+            # keep the case-insensitive multidict (ETag vs Etag)
+            return r.status, r.headers.copy(), await r.read()
+
+
+def test_conditional_reads(tmp_path):
+    async def go():
+        cluster = LocalCluster(
+            base_dir=str(tmp_path), n_volume_servers=1, with_filer=True,
+            pulse_seconds=1,
+        )
+        await cluster.start()
+        try:
+            master = cluster.master.advertise_url
+            a = await assign(master)
+            await upload_data(f"http://{a.url}/{a.fid}", b"needle-body")
+            url = f"http://{a.url}/{a.fid}"
+            status, hdrs, body = await fetch(url)
+            assert status == 200 and body == b"needle-body"
+            etag = hdrs["Etag"]
+
+            # matching validator -> 304 with no body
+            status, hdrs304, body = await fetch(
+                url, {"If-None-Match": etag}
+            )
+            assert status == 304 and body == b""
+            assert hdrs304.get("Etag") == etag, "304 must keep validators"
+            # weak-form and wildcard match too
+            status, _, _ = await fetch(url, {"If-None-Match": f"W/{etag}"})
+            assert status == 304
+            status, _, _ = await fetch(url, {"If-None-Match": "*"})
+            assert status == 304
+            # stale validator -> full response
+            status, _, body = await fetch(
+                url, {"If-None-Match": '"deadbeef"'}
+            )
+            assert status == 200 and body == b"needle-body"
+            # If-Modified-Since after the write -> 304; before it -> 200
+            future = time.strftime(
+                "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(time.time() + 60)
+            )
+            past = "Mon, 01 Jan 2001 00:00:00 GMT"
+            status, _, _ = await fetch(url, {"If-Modified-Since": future})
+            assert status == 304
+            status, _, _ = await fetch(url, {"If-Modified-Since": past})
+            assert status == 200
+            # If-None-Match takes precedence over If-Modified-Since
+            status, _, _ = await fetch(
+                url,
+                {"If-None-Match": '"deadbeef"', "If-Modified-Since": future},
+            )
+            assert status == 200
+
+            # filer path: chunked entry carries an ETag
+            async with aiohttp.ClientSession() as s:
+                async with s.put(
+                    # maxMB=1 forces chunking so the entry carries an ETag
+                    f"http://{cluster.filer.url}/c.bin?maxMB=1",
+                    data=b"x" * (2 * 1024 * 1024),
+                ) as r:
+                    assert r.status < 300
+            furl = f"http://{cluster.filer.url}/c.bin"
+            status, fh, body = await fetch(furl)
+            assert status == 200 and len(body) == 2 * 1024 * 1024
+            fetag = fh["ETag"]
+            status, _, body = await fetch(furl, {"If-None-Match": fetag})
+            assert status == 304 and body == b""
+            status, _, _ = await fetch(furl, {"If-Modified-Since": future})
+            assert status == 304
+            status, _, body = await fetch(furl, {"If-None-Match": '"nope"'})
+            assert status == 200 and len(body) == 2 * 1024 * 1024
+        finally:
+            await cluster.stop()
+
+    run(go())
