@@ -58,6 +58,11 @@ class S3Error(Exception):
 
 ERR_NO_SUCH_BUCKET = ("NoSuchBucket", "The specified bucket does not exist", 404)
 ERR_NO_SUCH_KEY = ("NoSuchKey", "The specified key does not exist", 404)
+ERR_PRECONDITION = (
+    "PreconditionFailed",
+    "At least one of the pre-conditions you specified did not hold",
+    412,
+)
 ERR_NO_SUCH_UPLOAD = ("NoSuchUpload", "The specified upload does not exist", 404)
 ERR_BUCKET_NOT_EMPTY = ("BucketNotEmpty", "The bucket you tried to delete is not empty", 409)
 ERR_BUCKET_EXISTS = ("BucketAlreadyExists", "The requested bucket name is not available", 409)
@@ -863,6 +868,9 @@ class S3ApiServer:
         entry = await self._get_entry(bucket, key)
         if entry.is_directory:
             raise S3Error(*ERR_NO_SUCH_KEY)
+        precond = self._check_preconditions(request, entry)
+        if precond is not None:
+            return precond
         headers = {}
         if "Range" in request.headers:
             headers["Range"] = request.headers["Range"]
@@ -890,6 +898,44 @@ class S3ApiServer:
                     await resp.write(piece)
             await resp.write_eof()
             return resp
+
+    def _check_preconditions(self, request, entry):
+        """AWS GetObject conditional semantics (RFC 7232 order): If-Match /
+        If-Unmodified-Since fail with 412; If-None-Match /
+        If-Modified-Since revalidate with 304.  Returns a ready response
+        or None to proceed."""
+        import time as _time
+
+        from ..server.conditional import (
+            etag_matches,
+            not_modified,
+            parse_http_date,
+        )
+
+        etag = _entry_etag(entry)
+        mtime = entry.attributes.mtime
+        if_match = request.headers.get("If-Match", "")
+        if if_match:
+            # If-Match requires the STRONG comparison (RFC 7232 3.1)
+            if not etag_matches(if_match, etag, weak=False):
+                raise S3Error(*ERR_PRECONDITION)
+        else:
+            ius = request.headers.get("If-Unmodified-Since", "")
+            if ius and mtime:
+                since = parse_http_date(ius)
+                if since is not None and int(mtime) > since:
+                    raise S3Error(*ERR_PRECONDITION)
+        if not_modified(request, etag, mtime):
+            return web.Response(
+                status=304,
+                headers={
+                    "ETag": f'"{etag}"',
+                    "Last-Modified": _time.strftime(
+                        "%a, %d %b %Y %H:%M:%S GMT", _time.gmtime(mtime)
+                    ),
+                },
+            )
+        return None
 
     async def delete_object(self, bucket: str, key: str) -> web.Response:
         """S3 delete is idempotent and only removes the named object —

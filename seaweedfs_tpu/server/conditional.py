@@ -17,6 +17,36 @@ def _canonical_etag(tag: str) -> str:
     return tag.strip('"')
 
 
+def parse_http_date(value: str) -> int | None:
+    """IMF-fixdate -> unix seconds, or None when unparseable.  timegm, not
+    mktime: the header is GMT by definition and the server's local
+    timezone/DST must not skew comparisons."""
+    try:
+        return calendar.timegm(
+            time.strptime(value, "%a, %d %b %Y %H:%M:%S GMT")
+        )
+    except ValueError:
+        return None
+
+
+def etag_matches(header_value: str, ours: str, weak: bool = True) -> bool:
+    """Does any candidate in an If-(None-)Match header match our ETag?
+
+    weak=True is RFC 7232's weak comparison (If-None-Match); weak=False is
+    the STRONG comparison If-Match requires — a W/ candidate never
+    matches."""
+    ours_c = _canonical_etag(ours)
+    for candidate in header_value.split(","):
+        candidate = candidate.strip()
+        if candidate == "*":
+            return True
+        if not weak and candidate.startswith("W/"):
+            continue
+        if _canonical_etag(candidate) == ours_c:
+            return True
+    return False
+
+
 def not_modified(request, etag: str, mtime: int | float | None) -> bool:
     """True when the client's validators prove its cached copy is current.
 
@@ -25,20 +55,11 @@ def not_modified(request, etag: str, mtime: int | float | None) -> bool:
     unknown)."""
     inm = request.headers.get("If-None-Match", "")
     if inm:
-        ours = _canonical_etag(etag)
-        return any(
-            _canonical_etag(candidate) in ("*", ours)
-            for candidate in inm.split(",")
-        )
+        return etag_matches(inm, etag, weak=True)
     ims = request.headers.get("If-Modified-Since", "")
     if ims and mtime:
-        try:
-            # timegm, not mktime: the header is GMT by definition and the
-            # server's local timezone/DST must not skew the comparison
-            since = calendar.timegm(
-                time.strptime(ims, "%a, %d %b %Y %H:%M:%S GMT")
-            )
-        except ValueError:
+        since = parse_http_date(ims)
+        if since is None:
             return False
         return int(mtime) <= since
     return False

@@ -690,11 +690,37 @@ class VolumeServer:
             )
         import aiohttp
 
+        # forward the read-semantics headers (conditionals, Range) and hand
+        # the peer's validators back, so proxied reads revalidate exactly
+        # like local ones
+        fwd = {
+            k: request.headers[k]
+            for k in (
+                "Range",
+                "If-None-Match",
+                "If-Modified-Since",
+                "Accept-Encoding",
+            )
+            if k in request.headers
+        }
         async with aiohttp.ClientSession() as s:
-            async with s.get(f"http://{target}{request.path_qs}") as r:
+            async with s.get(
+                f"http://{target}{request.path_qs}", headers=fwd
+            ) as r:
                 body = await r.read()
+                back = {
+                    k: r.headers[k]
+                    for k in (
+                        "Etag",
+                        "Last-Modified",
+                        "Accept-Ranges",
+                        "Content-Range",
+                        "Content-Encoding",
+                    )
+                    if k in r.headers
+                }
                 return web.Response(
-                    status=r.status, body=body,
+                    status=r.status, body=body, headers=back,
                     content_type=r.content_type or "application/octet-stream",
                 )
 

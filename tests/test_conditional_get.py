@@ -93,3 +93,83 @@ def test_conditional_reads(tmp_path):
             await cluster.stop()
 
     run(go())
+
+
+def test_s3_conditional_requests(tmp_path):
+    """AWS GetObject conditionals on the gateway: If-None-Match/-Modified-
+    Since -> 304, If-Match/If-Unmodified-Since mismatch -> 412."""
+
+    async def go():
+        cluster = LocalCluster(
+            base_dir=str(tmp_path), n_volume_servers=1, with_s3=True,
+            pulse_seconds=1,
+        )
+        await cluster.start()
+        try:
+            base = f"http://{cluster.s3.url}"
+            async with aiohttp.ClientSession() as s:
+                async with s.put(f"{base}/b") as r:
+                    assert r.status == 200
+                async with s.put(f"{base}/b/k.bin", data=b"object!") as r:
+                    assert r.status == 200
+                    etag = r.headers["ETag"]
+
+                async def get(hdrs):
+                    async with s.get(f"{base}/b/k.bin", headers=hdrs) as r:
+                        return r.status, await r.read()
+
+                assert (await get({}))[0] == 200
+                status, body = await get({"If-None-Match": etag})
+                assert status == 304 and body == b""
+                status, body = await get({"If-None-Match": '"zzz"'})
+                assert status == 200 and body == b"object!"
+                status, _ = await get({"If-Match": etag})
+                assert status == 200
+                status, _ = await get({"If-Match": '"zzz"'})
+                assert status == 412
+                # If-Match is a STRONG comparison: weak validators fail
+                status, _ = await get({"If-Match": f"W/{etag}"})
+                assert status == 412
+                future = time.strftime(
+                    "%a, %d %b %Y %H:%M:%S GMT",
+                    time.gmtime(time.time() + 60),
+                )
+                past = "Mon, 01 Jan 2001 00:00:00 GMT"
+                assert (await get({"If-Modified-Since": future}))[0] == 304
+                assert (await get({"If-Modified-Since": past}))[0] == 200
+                assert (await get({"If-Unmodified-Since": future}))[0] == 200
+                assert (await get({"If-Unmodified-Since": past}))[0] == 412
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_conditional_on_proxied_read(tmp_path):
+    """read_mode=proxy: the non-holding server must forward conditionals
+    to the holder and relay validators back."""
+
+    async def go():
+        cluster = LocalCluster(
+            base_dir=str(tmp_path), n_volume_servers=2, pulse_seconds=1,
+        )
+        await cluster.start()
+        try:
+            master = cluster.master.advertise_url
+            a = await assign(master)
+            vid = int(a.fid.split(",")[0])
+            await upload_data(f"http://{a.url}/{a.fid}", b"proxied")
+            other = next(
+                vs for vs in cluster.volume_servers
+                if not vs.store.has_volume(vid)
+            )
+            purl = f"http://{other.url}/{a.fid}"
+            status, hdrs, body = await fetch(purl)
+            assert status == 200 and body == b"proxied"
+            etag = hdrs["Etag"]  # validators must survive the proxy hop
+            status, hdrs, body = await fetch(purl, {"If-None-Match": etag})
+            assert status == 304 and body == b""
+        finally:
+            await cluster.stop()
+
+    run(go())
