@@ -890,8 +890,26 @@ class S3ApiServer:
             for k, v in entry.extended.items():
                 if k.startswith("x-amz-meta-"):
                     out_headers[k] = v.decode()
+            # response-* query overrides (AWS GetObject request parameters;
+            # the common use is presigned download links forcing a
+            # filename/type)
+            overrides = {
+                "response-content-disposition": "Content-Disposition",
+                "response-cache-control": "Cache-Control",
+                "response-content-encoding": "Content-Encoding",
+                "response-content-language": "Content-Language",
+                "response-expires": "Expires",
+            }
+            content_type_override = request.query.get(
+                "response-content-type", ""
+            )
+            for q, hdr in overrides.items():
+                if q in request.query:
+                    out_headers[hdr] = request.query[q]
             resp = web.StreamResponse(status=r.status, headers=out_headers)
-            resp.content_type = r.content_type or "application/octet-stream"
+            resp.content_type = content_type_override or (
+                r.content_type or "application/octet-stream"
+            )
             await resp.prepare(request)
             if request.method != "HEAD":
                 async for piece in r.content.iter_chunked(1 << 20):
@@ -904,10 +922,9 @@ class S3ApiServer:
         If-Unmodified-Since fail with 412; If-None-Match /
         If-Modified-Since revalidate with 304.  Returns a ready response
         or None to proceed."""
-        import time as _time
-
         from ..server.conditional import (
             etag_matches,
+            format_http_date,
             not_modified,
             parse_http_date,
         )
@@ -926,15 +943,10 @@ class S3ApiServer:
                 if since is not None and int(mtime) > since:
                     raise S3Error(*ERR_PRECONDITION)
         if not_modified(request, etag, mtime):
-            return web.Response(
-                status=304,
-                headers={
-                    "ETag": f'"{etag}"',
-                    "Last-Modified": _time.strftime(
-                        "%a, %d %b %Y %H:%M:%S GMT", _time.gmtime(mtime)
-                    ),
-                },
-            )
+            headers = {"ETag": f'"{etag}"'}
+            if mtime:  # unset mtime must not surface as the epoch/now
+                headers["Last-Modified"] = format_http_date(mtime)
+            return web.Response(status=304, headers=headers)
         return None
 
     async def delete_object(self, bucket: str, key: str) -> web.Response:

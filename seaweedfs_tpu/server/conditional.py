@@ -17,6 +17,12 @@ def _canonical_etag(tag: str) -> str:
     return tag.strip('"')
 
 
+def format_http_date(mtime: int | float) -> str:
+    """unix seconds -> IMF-fixdate (the one formatter every server path
+    shares)."""
+    return time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime(mtime))
+
+
 def parse_http_date(value: str) -> int | None:
     """IMF-fixdate -> unix seconds, or None when unparseable.  timegm, not
     mktime: the header is GMT by definition and the server's local
@@ -45,6 +51,22 @@ def etag_matches(header_value: str, ours: str, weak: bool = True) -> bool:
         if _canonical_etag(candidate) == ours_c:
             return True
     return False
+
+
+def content_disposition(request, filename: str) -> str | None:
+    """`inline; filename=...` for named entities, `attachment` when the
+    ?dl= query flag asks for a download (reference
+    adjustHeaderContentDisposition, server/common.go:268-282)."""
+    if not filename:
+        return None
+    import urllib.parse
+
+    kind = "inline"
+    dl = request.query.get("dl", "")
+    if dl.lower() in ("1", "true", "yes"):
+        kind = "attachment"
+    quoted = urllib.parse.quote(filename)
+    return f'{kind}; filename="{quoted}"'
 
 
 def not_modified(request, etag: str, mtime: int | float | None) -> bool:

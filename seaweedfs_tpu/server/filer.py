@@ -470,19 +470,22 @@ class FilerServer:
     async def _stream_file(self, request: web.Request, entry: Entry) -> web.StreamResponse:
         total = entry.size()
         mime = entry.attr.mime or "application/octet-stream"
+        from .conditional import format_http_date
+
         headers = {
             "Accept-Ranges": "bytes",
-            "Last-Modified": time.strftime(
-                "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(entry.attr.mtime)
-            ),
+            "Last-Modified": format_http_date(entry.attr.mtime),
         }
         if entry.chunks:
             headers["ETag"] = f'"{etag_of_chunks(entry.chunks)}"'
         if entry.attr.md5:
             headers["Content-MD5"] = base64.b64encode(entry.attr.md5).decode()
 
-        from .conditional import not_modified
+        from .conditional import content_disposition, not_modified
 
+        cd = content_disposition(request, entry.name)
+        if cd:
+            headers["Content-Disposition"] = cd
         if not_modified(request, headers.get("ETag", ""), entry.attr.mtime):
             return web.Response(status=304, headers=headers)
 

@@ -627,9 +627,9 @@ class VolumeServer:
     ) -> web.StreamResponse:
         headers = {"Etag": f'"{n.etag}"', "Accept-Ranges": "bytes"}
         if n.last_modified:
-            headers["Last-Modified"] = time.strftime(
-                "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(n.last_modified)
-            )
+            from .conditional import format_http_date
+
+            headers["Last-Modified"] = format_http_date(n.last_modified)
         ct = n.mime.decode() if n.mime else "application/octet-stream"
         resize = ct.startswith("image/") and (
             "width" in request.query or "height" in request.query
@@ -643,8 +643,13 @@ class VolumeServer:
             rmode = request.query.get("mode", "")
             # resize variants must not share the original's cache identity
             headers["Etag"] = f'"{n.etag}-{rw}x{rh}{rmode}"'
-        from .conditional import not_modified
+        from .conditional import content_disposition, not_modified
 
+        cd = content_disposition(
+            request, n.name.decode("utf-8", "replace") if n.name else ""
+        )
+        if cd:
+            headers["Content-Disposition"] = cd
         if not_modified(request, headers["Etag"], n.last_modified):
             # BEFORE decompress/resize: a 304 exists to skip the body work;
             # keep the validators so caches can refresh their entry
@@ -703,7 +708,10 @@ class VolumeServer:
             )
             if k in request.headers
         }
-        async with aiohttp.ClientSession() as s:
+        # auto_decompress=False: the relay must pass the holder's bytes
+        # VERBATIM — transparent gunzip would serve decompressed data
+        # still labeled Content-Encoding: gzip
+        async with aiohttp.ClientSession(auto_decompress=False) as s:
             async with s.get(
                 f"http://{target}{request.path_qs}", headers=fwd
             ) as r:
@@ -716,6 +724,7 @@ class VolumeServer:
                         "Accept-Ranges",
                         "Content-Range",
                         "Content-Encoding",
+                        "Content-Disposition",
                     )
                     if k in r.headers
                 }

@@ -173,3 +173,53 @@ def test_conditional_on_proxied_read(tmp_path):
             await cluster.stop()
 
     run(go())
+
+
+def test_content_disposition_and_s3_response_overrides(tmp_path):
+    """?dl=true downloads as attachment with the entry's filename
+    (reference adjustHeaderContentDisposition), and S3 response-* query
+    params override the served headers (presigned-download semantics)."""
+
+    async def go():
+        cluster = LocalCluster(
+            base_dir=str(tmp_path), n_volume_servers=1, with_s3=True,
+            pulse_seconds=1,
+        )
+        await cluster.start()
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.put(
+                    f"http://{cluster.filer.url}/d/report.pdf", data=b"pdf!"
+                ) as r:
+                    assert r.status < 300
+            furl = f"http://{cluster.filer.url}/d/report.pdf"
+            _, h, _ = await fetch(furl)
+            assert 'inline; filename="report.pdf"' in h.get(
+                "Content-Disposition", ""
+            )
+            _, h, _ = await fetch(furl + "?dl=true")
+            assert h["Content-Disposition"].startswith("attachment")
+
+            base = f"http://{cluster.s3.url}"
+            async with aiohttp.ClientSession() as s:
+                async with s.put(f"{base}/rb") as r:
+                    assert r.status == 200
+                async with s.put(f"{base}/rb/o.bin", data=b"data") as r:
+                    assert r.status == 200
+                async with s.get(
+                    f"{base}/rb/o.bin"
+                    "?response-content-disposition=attachment%3B%20filename%3Dx.bin"
+                    "&response-content-type=text/plain"
+                    "&response-cache-control=no-store"
+                ) as r:
+                    assert r.status == 200
+                    assert r.headers["Content-Disposition"].startswith(
+                        "attachment"
+                    )
+                    assert r.headers["Content-Type"].startswith("text/plain")
+                    assert r.headers["Cache-Control"] == "no-store"
+                    assert await r.read() == b"data"
+        finally:
+            await cluster.stop()
+
+    run(go())
