@@ -766,7 +766,11 @@ class S3ApiServer:
                 status=200, headers={"ETag": f'"{hashlib.md5(b"").hexdigest()}"'}
             )
         data = await self._body(request)
-        headers = {}
+        from ..server.conditional import persistable_headers
+
+        # forward caching/presentation headers so `aws s3 cp
+        # --cache-control ...` persists them like a direct filer PUT
+        headers = dict(persistable_headers(request.headers))
         if request.headers.get("Content-Type"):
             headers["Content-Type"] = request.headers["Content-Type"]
         if isinstance(data, (bytes, bytearray)):
@@ -887,9 +891,19 @@ class S3ApiServer:
             }
             if r.headers.get("Content-Range"):
                 out_headers["Content-Range"] = r.headers["Content-Range"]
+            from ..server.conditional import (
+                canonical_header,
+                is_persisted_header,
+            )
+
             for k, v in entry.extended.items():
                 if k.startswith("x-amz-meta-"):
                     out_headers[k] = v.decode()
+                elif is_persisted_header(k):
+                    # stored caching/presentation headers ride back out
+                    out_headers[canonical_header(k)] = v.decode(
+                        "utf-8", "replace"
+                    )
             # response-* query overrides (AWS GetObject request parameters;
             # the common use is presigned download links forcing a
             # filename/type)

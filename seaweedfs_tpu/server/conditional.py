@@ -53,6 +53,33 @@ def etag_matches(header_value: str, ours: str, weak: bool = True) -> bool:
     return False
 
 
+PERSISTED_HEADERS = ("Cache-Control", "Expires", "Content-Disposition")
+
+
+def canonical_header(name: str) -> str:
+    """HTTP header names are case-insensitive; canonicalize like Go's
+    textproto (Cache-Control, Seaweed-Origin) so matching and storage
+    never depend on the client's spelling."""
+    return "-".join(p.capitalize() for p in name.split("-"))
+
+
+def is_persisted_header(name: str) -> bool:
+    ck = canonical_header(name)
+    return ck in PERSISTED_HEADERS or ck.startswith("Seaweed-")
+
+
+def persistable_headers(headers) -> dict[str, str]:
+    """The upload headers an entry should carry and replay on reads
+    (reference SaveAmzMetaData shape): caching/presentation headers plus
+    Seaweed-* pairs, keys canonicalized.  ONE predicate shared by the
+    filer write path, its read replay, and the S3 gateway's forward."""
+    out: dict[str, str] = {}
+    for k, v in headers.items():
+        if is_persisted_header(k):
+            out[canonical_header(k)] = v
+    return out
+
+
 def content_disposition(request, filename: str) -> str | None:
     """`inline; filename=...` for named entities, `attachment` when the
     ?dl= query flag asks for a download (reference

@@ -483,9 +483,18 @@ class FilerServer:
 
         from .conditional import content_disposition, not_modified
 
-        cd = content_disposition(request, entry.name)
-        if cd:
-            headers["Content-Disposition"] = cd
+        # replay stored caching/presentation headers (an explicit stored
+        # Content-Disposition wins over the synthesized filename one, like
+        # the reference's early return in adjustHeaderContentDisposition)
+        from .conditional import canonical_header, is_persisted_header
+
+        for xk, xv in entry.extended.items():
+            if is_persisted_header(xk):
+                headers[canonical_header(xk)] = xv.decode("utf-8", "replace")
+        if "Content-Disposition" not in headers:
+            cd = content_disposition(request, entry.name)
+            if cd:
+                headers["Content-Disposition"] = cd
         if not_modified(request, headers.get("ETag", ""), entry.attr.mtime):
             return web.Response(status=304, headers=headers)
 
@@ -724,6 +733,15 @@ class FilerServer:
                 )
             now = int(time.time())
             mode = int(q.get("mode", "0660"), 8)
+            # persist caching/presentation headers + Seaweed-* pairs with
+            # the entry; reads replay them (reference autochunk
+            # SaveAmzMetaData shape, write_autochunk.go:245-258)
+            from .conditional import persistable_headers
+
+            extended = {
+                k: v.encode()
+                for k, v in persistable_headers(request.headers).items()
+            }
             entry = Entry(
                 full_path=path,
                 attr=Attr(
@@ -733,6 +751,7 @@ class FilerServer:
                 ),
                 chunks=chunks,
                 content=small_content,
+                extended=extended,
             )
             old_chunks = []
             try:

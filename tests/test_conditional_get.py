@@ -223,3 +223,70 @@ def test_content_disposition_and_s3_response_overrides(tmp_path):
             await cluster.stop()
 
     run(go())
+
+
+def test_upload_headers_persist_and_replay(tmp_path):
+    """Cache-Control / Expires / Content-Disposition / Seaweed-* headers
+    sent at upload persist in the entry and replay on every read; a
+    stored Content-Disposition beats the synthesized filename one."""
+
+    async def go():
+        cluster = LocalCluster(
+            base_dir=str(tmp_path), n_volume_servers=1, with_filer=True,
+            pulse_seconds=1,
+        )
+        await cluster.start()
+        try:
+            url = f"http://{cluster.filer.url}/h/asset.js"
+            async with aiohttp.ClientSession() as s:
+                async with s.put(
+                    url,
+                    data=b"console.log(1)",
+                    headers={
+                        # lowercase on purpose: header names are
+                        # case-insensitive and must canonicalize
+                        "cache-control": "public, max-age=3600",
+                        "Content-Disposition": 'attachment; filename="x.js"',
+                        "seaweed-origin": "build-42",
+                    },
+                ) as r:
+                    assert r.status < 300
+            status, h, body = await fetch(url)
+            assert status == 200 and body == b"console.log(1)"
+            assert h["Cache-Control"] == "public, max-age=3600"
+            assert h["Content-Disposition"] == 'attachment; filename="x.js"'
+            assert h["Seaweed-Origin"] == "build-42"
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_s3_put_forwards_cache_headers(tmp_path):
+    """`aws s3 cp --cache-control ...` semantics: headers sent on S3 PUT
+    persist and come back on GetObject."""
+
+    async def go():
+        cluster = LocalCluster(
+            base_dir=str(tmp_path), n_volume_servers=1, with_s3=True,
+            pulse_seconds=1,
+        )
+        await cluster.start()
+        try:
+            base = f"http://{cluster.s3.url}"
+            async with aiohttp.ClientSession() as s:
+                async with s.put(f"{base}/cb") as r:
+                    assert r.status == 200
+                async with s.put(
+                    f"{base}/cb/a.css",
+                    data=b"body{}",
+                    headers={"cache-control": "max-age=86400"},
+                ) as r:
+                    assert r.status == 200
+                async with s.get(f"{base}/cb/a.css") as r:
+                    assert r.status == 200
+                    assert r.headers.get("Cache-Control") == "max-age=86400"
+        finally:
+            await cluster.stop()
+
+    run(go())
