@@ -578,11 +578,20 @@ class VolumeServer:
         if v is not None:
             loc = v.nm.get(nid)
             size_hint = loc[1] if loc else 0
+            if loc is None and request.query.get("readDeleted") == "true":
+                # forensic reads must stay under the memory throttle too
+                size_hint = (
+                    await asyncio.to_thread(v.deleted_needle_size, nid) or 0
+                )
         async with self.download_limiter(size_hint):
             try:
                 if v is not None:
                     n = await asyncio.to_thread(
-                        self.store.read_needle, vid, nid, cookie
+                        self.store.read_needle,
+                        vid,
+                        nid,
+                        cookie,
+                        request.query.get("readDeleted") == "true",
                     )
                 elif self.store.ec_device_cache is not None:
                     # coalesced: concurrent EC reads batch into one

@@ -78,6 +78,12 @@ class CompactMap:
             return None
         return v
 
+    def get_any(self, needle_id: int) -> tuple[int, int] | None:
+        """Raw entry INCLUDING tombstoned ones: a delete only marks the
+        size, so the original record's offset survives until vacuum —
+        what ?readDeleted=true reads (reference ReadOption.ReadDeleted)."""
+        return self._m.get(needle_id)
+
     def has(self, needle_id: int) -> bool:
         return self.get(needle_id) is not None
 

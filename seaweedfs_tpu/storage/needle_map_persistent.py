@@ -208,6 +208,13 @@ class _PersistentNeedleMap:
             return None
         return row
 
+    def get_any(self, needle_id: int) -> tuple[int, int] | None:
+        """Raw row INCLUDING tombstones (delete keeps the original offset)
+        — the ?readDeleted=true surface, same contract as
+        CompactMap.get_any."""
+        with self._lock:
+            return self._get_raw(needle_id)
+
     def has(self, needle_id: int) -> bool:
         return self.get(needle_id) is not None
 

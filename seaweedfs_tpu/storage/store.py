@@ -356,10 +356,16 @@ class Store:
             self._volume_message(v, loc.disk_type if loc else "")
         )
 
-    def read_needle(self, vid: int, needle_id: int, cookie: int | None = None) -> Needle:
+    def read_needle(
+        self,
+        vid: int,
+        needle_id: int,
+        cookie: int | None = None,
+        read_deleted: bool = False,
+    ) -> Needle:
         v = self.find_volume(vid)
         if v is not None:
-            return v.read(needle_id, cookie)
+            return v.read(needle_id, cookie, read_deleted=read_deleted)
         ev = self.find_ec_volume(vid)
         if ev is not None:
             return self.read_ec_needle(vid, needle_id, cookie)

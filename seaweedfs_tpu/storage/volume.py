@@ -374,17 +374,56 @@ class Volume:
         buf = _pread(st.dat, total, offset)
         return Needle.from_bytes(buf, self.version)
 
-    def read(self, needle_id: int, cookie: int | None = None) -> Needle:
+    def read(
+        self,
+        needle_id: int,
+        cookie: int | None = None,
+        read_deleted: bool = False,
+    ) -> Needle:
         # one state capture: the offset from st.nm is only ever applied to
         # st.dat, so a concurrent vacuum swap can't mix old map / new file
         st = self._state
         loc = st.nm.get(needle_id)
-        if loc is None:
-            raise NotFoundError(f"needle {needle_id:x} not found in volume {self.id}")
-        n = self._read_at(loc[0], loc[1], st)
+        if loc is not None:
+            n = self._read_at(loc[0], loc[1], st)
+        else:
+            n = self._read_tombstoned(needle_id, st) if read_deleted else None
+            if n is None:
+                raise NotFoundError(
+                    f"needle {needle_id:x} not found in volume {self.id}"
+                )
         if cookie is not None and n.cookie != cookie:
             raise CookieMismatch(f"cookie mismatch for needle {needle_id:x}")
         return n
+
+    def _tombstoned_location(self, needle_id: int, st) -> tuple[int, int] | None:
+        """(offset, original size) of a deleted-but-not-vacuumed needle:
+        the map keeps the original record's offset under the tombstone,
+        and the record's own header carries the pre-delete size."""
+        get_any = getattr(st.nm, "get_any", None)
+        raw = get_any(needle_id) if get_any else None
+        if raw is None:
+            return None
+        hdr = _pread(st.dat, t.NEEDLE_HEADER_SIZE, raw[0])
+        if len(hdr) < t.NEEDLE_HEADER_SIZE:
+            return None
+        _, _, size = Needle.parse_header(hdr)
+        if not t.size_is_valid(size):
+            return None
+        return raw[0], size
+
+    def deleted_needle_size(self, needle_id: int) -> int | None:
+        """Size a ?readDeleted=true read would return (throttle hints)."""
+        loc = self._tombstoned_location(needle_id, self._state)
+        return loc[1] if loc else None
+
+    def _read_tombstoned(self, needle_id: int, st) -> Needle | None:
+        """Deleted-but-not-vacuumed needle (?readDeleted=true, reference
+        ReadOption.ReadDeleted)."""
+        loc = self._tombstoned_location(needle_id, st)
+        if loc is None:
+            return None
+        return self._read_at(loc[0], loc[1], st)
 
     def has(self, needle_id: int) -> bool:
         return self.nm.has(needle_id)

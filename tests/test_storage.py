@@ -397,3 +397,55 @@ def test_compact_map_live_count_edge_cases():
     assert len(m) == 1
     m.delete(99)  # absent: no change
     assert len(m) == 1
+
+
+def test_read_deleted_until_vacuum(tmp_path):
+    """?readDeleted=true semantics (reference ReadOption.ReadDeleted): a
+    deleted needle stays readable from its original record until vacuum
+    reclaims it."""
+    from seaweedfs_tpu.storage.disk_location import DiskLocation
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.store import Store
+    from seaweedfs_tpu.storage.volume import NotFoundError
+
+    store = Store([DiskLocation(str(tmp_path))])
+    store.add_volume(1)
+    store.write_needle(1, Needle(id=7, cookie=3, data=b"forensics" * 10))
+    store.write_needle(1, Needle(id=8, cookie=3, data=b"keep"))
+    assert store.delete_needle(1, 7, 3) > 0
+
+    with pytest.raises((NotFoundError, KeyError)):
+        store.read_needle(1, 7, 3)
+    n = store.read_needle(1, 7, 3, read_deleted=True)
+    assert n.data == b"forensics" * 10
+    # wrong cookie still refused even on forensic reads
+    from seaweedfs_tpu.storage.volume import CookieMismatch
+
+    with pytest.raises(CookieMismatch):
+        store.read_needle(1, 7, 999, read_deleted=True)
+
+    # throttle hint sees the original size through the tombstone
+    v = store.find_volume(1)
+    assert v.deleted_needle_size(7) >= len(b"forensics" * 10)
+
+    store.vacuum_volume(1)
+    with pytest.raises((NotFoundError, KeyError)):
+        store.read_needle(1, 7, 3, read_deleted=True)
+    assert store.read_needle(1, 8, 3).data == b"keep"
+
+
+def test_read_deleted_on_persistent_map(tmp_path):
+    """The persistent (SQLite) needle map keeps tombstone offsets too, so
+    forensic reads work on -index sqlite volumes as well."""
+    from seaweedfs_tpu.storage.disk_location import DiskLocation
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.store import Store
+
+    store = Store(
+        [DiskLocation(str(tmp_path), needle_map_kind="persistent")]
+    )
+    store.add_volume(2)
+    store.write_needle(2, Needle(id=5, cookie=1, data=b"sql-forensics"))
+    assert store.delete_needle(2, 5, 1) > 0
+    n = store.read_needle(2, 5, 1, read_deleted=True)
+    assert n.data == b"sql-forensics"
