@@ -1,3 +1,3 @@
-from .resizing import resized
+from .resizing import cropped, resized
 
-__all__ = ["resized"]
+__all__ = ["cropped", "resized"]

@@ -58,3 +58,37 @@ def resized(
     except Exception:
         # never fail a read because a thumbnail couldn't be produced
         return data
+
+
+def cropped(data: bytes, x1: int, y1: int, x2: int, y2: int) -> bytes:
+    """On-read crop (reference images/cropping.go, applied BEFORE resize):
+    the (x1,y1)-(x2,y2) box clamped to the image; invalid boxes and
+    non-image payloads pass through untouched."""
+    if not (x1 >= 0 and y1 >= 0 and x2 > x1 and y2 > y1):
+        return data
+    try:
+        from PIL import Image
+    except ImportError:  # pragma: no cover - PIL is in the image
+        return data
+    try:
+        img = Image.open(io.BytesIO(data))
+        fmt = img.format
+        if fmt not in ("PNG", "JPEG", "GIF"):
+            return data
+        if fmt == "JPEG":
+            # upright the pixels BEFORE cropping: the re-encode drops
+            # EXIF, and the crop box is expressed in display coordinates
+            # (same invariant as resized() above)
+            from PIL import ImageOps
+
+            img = ImageOps.exif_transpose(img)
+        x2 = min(x2, img.width)
+        y2 = min(y2, img.height)
+        if x2 <= x1 or y2 <= y1:
+            return data
+        buf = io.BytesIO()
+        img.crop((x1, y1, x2, y2)).save(buf, format=fmt)
+        return buf.getvalue()
+    except Exception:
+        # never fail a read because a crop couldn't be produced
+        return data

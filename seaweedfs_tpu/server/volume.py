@@ -574,12 +574,14 @@ class VolumeServer:
         # lease BEFORE the disk read so the throttle bounds memory; the
         # index knows the size up front for normal volumes (EC locates
         # during the read itself — those lease 0 and stay unthrottled)
+        read_deleted = request.query.get("readDeleted") == "true"
         size_hint = 0
         if v is not None:
             loc = v.nm.get(nid)
             size_hint = loc[1] if loc else 0
-            if loc is None and request.query.get("readDeleted") == "true":
+            if loc is None and read_deleted:
                 # forensic reads must stay under the memory throttle too
+                # (a 16-byte header pread on a rare path)
                 size_hint = (
                     await asyncio.to_thread(v.deleted_needle_size, nid) or 0
                 )
@@ -591,7 +593,7 @@ class VolumeServer:
                         vid,
                         nid,
                         cookie,
-                        request.query.get("readDeleted") == "true",
+                        read_deleted,
                     )
                 elif self.store.ec_device_cache is not None:
                     # coalesced: concurrent EC reads batch into one
@@ -640,18 +642,33 @@ class VolumeServer:
 
             headers["Last-Modified"] = format_http_date(n.last_modified)
         ct = n.mime.decode() if n.mime else "application/octet-stream"
-        resize = ct.startswith("image/") and (
+        is_image = ct.startswith("image/")
+        resize = is_image and (
             "width" in request.query or "height" in request.query
         )
-        if resize:
+        crop = is_image and any(
+            f"crop_{k}" in request.query for k in ("x1", "y1", "x2", "y2")
+        )
+        if resize or crop:
             try:
                 rw = int(request.query.get("width") or 0)
                 rh = int(request.query.get("height") or 0)
+                cx1 = int(request.query.get("crop_x1") or 0)
+                cy1 = int(request.query.get("crop_y1") or 0)
+                cx2 = int(request.query.get("crop_x2") or 0)
+                cy2 = int(request.query.get("crop_y2") or 0)
             except ValueError:
-                raise web.HTTPBadRequest(text="width/height must be integers")
+                raise web.HTTPBadRequest(
+                    text="width/height/crop_* must be integers"
+                )
             rmode = request.query.get("mode", "")
-            # resize variants must not share the original's cache identity
-            headers["Etag"] = f'"{n.etag}-{rw}x{rh}{rmode}"'
+            # processed variants must not share the original's cache
+            # identity; the crop suffix only appears when cropping so
+            # resize-only Etags stay stable across versions
+            variant = f"{n.etag}-{rw}x{rh}{rmode}"
+            if crop:
+                variant += f"-{cx1},{cy1},{cx2},{cy2}"
+            headers["Etag"] = f'"{variant}"'
         from .conditional import content_disposition, not_modified
 
         cd = content_disposition(
@@ -665,12 +682,23 @@ class VolumeServer:
             return web.Response(status=304, headers=headers)
         body = n.data
         if n.is_compressed:
-            if "gzip" in request.headers.get("Accept-Encoding", ""):
+            # transforms need pixels: never hand gzip bytes to crop/resize
+            # (they would pass through untouched yet carry the variant
+            # Etag, poisoning caches with the original under that identity)
+            if not (resize or crop) and "gzip" in request.headers.get(
+                "Accept-Encoding", ""
+            ):
                 headers["Content-Encoding"] = "gzip"
             else:
                 import gzip as _gz
 
                 body = _gz.decompress(body)
+        if crop:
+            # reference order: crop first, then resize (volume_server_
+            # handlers_read.go shouldCropImages + shouldResizeImages)
+            from ..images import cropped
+
+            body = await asyncio.to_thread(cropped, body, cx1, cy1, cx2, cy2)
         if resize:
             from ..images import resized
 

@@ -205,3 +205,39 @@ def test_exif_orientation_fix():
     plain = io.BytesIO()
     img.save(plain, format="JPEG")
     assert fix_orientation(plain.getvalue()) == plain.getvalue()
+
+
+def test_image_crop():
+    """On-read crop (reference images/cropping.go): box honored, clamped,
+    invalid boxes and non-images pass through."""
+    import io
+
+    import pytest
+
+    PIL = pytest.importorskip("PIL")
+    from PIL import Image
+
+    from seaweedfs_tpu.images import cropped
+
+    img = Image.new("RGB", (100, 80), (10, 20, 30))
+    for x in range(50):
+        for y in range(40):
+            img.putpixel((x, y), (200, 0, 0))  # red top-left quadrant
+    buf = io.BytesIO()
+    img.save(buf, format="PNG")
+    data = buf.getvalue()
+
+    out = cropped(data, 0, 0, 50, 40)
+    got = Image.open(io.BytesIO(out))
+    assert got.size == (50, 40)
+    assert got.getpixel((10, 10)) == (200, 0, 0)
+
+    # clamped to image bounds
+    out = cropped(data, 60, 50, 500, 500)
+    got = Image.open(io.BytesIO(out))
+    assert got.size == (40, 30)
+    assert got.getpixel((5, 5)) == (10, 20, 30)
+
+    # invalid box / non-image: untouched
+    assert cropped(data, 30, 30, 10, 10) == data
+    assert cropped(b"not an image", 0, 0, 10, 10) == b"not an image"
