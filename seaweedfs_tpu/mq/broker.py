@@ -4,14 +4,27 @@ Reference: weed/mq/broker/ — topics split into partitions, publishers
 stream DataMessages which land in per-partition logs persisted through
 the filer (the reference spools LogBuffers to /topics/... files the
 same way), subscribers replay from an offset then tail live; consumer
-group offsets live in the filer KV.  Single-broker scope here (the
-reference's balancer assigns partitions across brokers; the lookup RPC
-returns this broker for every partition so the client wiring matches).
+group offsets live in the filer KV.
+
+Multi-broker: every broker registers in the master cluster registry and
+the ClusterBalancer places partitions over the sorted live-broker list —
+no coordinator, same answer everywhere; ownership handoff flushes +
+releases the partition and the new owner resyncs from the durable log
+(test_mq.py two-broker failover).  Cross-owner append collisions are
+fenced by a per-partition epoch in the filer KV: activation bumps the
+epoch (counter + fresh activator nonce, so racing activators' fences
+differ even when their counters tie — the KV has no compare-and-set),
+and every log append re-reads it first, so a stale owner's in-flight
+flush parks its batch instead of colliding with the new owner's offsets
+(the parked batch replays on reactivation when no other epoch
+intervened).  The residual race is one KvGet->append round-trip wide,
+not a registry-TTL wide window.
 """
 from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import struct
 import time
 import zlib
@@ -66,6 +79,11 @@ class NotAssignedHere(Exception):
         )
         self.partition = partition
         self.owner = owner
+
+
+class StaleEpochError(Exception):
+    """A log append was fenced off: another owner bumped the partition
+    epoch after this batch was formed."""
 
 
 class SingleBrokerBalancer:
@@ -168,11 +186,28 @@ class Partition:
         self.flushed_upto = 0  # first offset NOT yet durable
         self.pending: list[tuple[int, bytes, bytes, int]] = []  # not yet flushed
         self.cond = asyncio.Condition()
-        self._flushing = False
-        # ownership epoch: False until this broker (re)reads the durable
-        # log as the partition's CURRENT owner — another broker may have
-        # appended since our last look (balancer reassignment)
+        # serializes flushes: a WAITING flush (not a skipped one) is what
+        # lets _deactivate guarantee every pending record is either
+        # durable or parked before ownership is released
+        self.flush_lock = asyncio.Lock()
+        # ownership: False until this broker (re)reads the durable log as
+        # the partition's CURRENT owner — another broker may have appended
+        # since our last look (balancer reassignment)
         self.active = False
+        # fence value this owner holds (filer KV mq.fence/<tkey>/<idx>):
+        # (counter, activator nonce).  Every append re-checks it so a
+        # stale owner can't collide; the nonce makes two racing
+        # activators' fences DIFFER even when their counters tie (the
+        # filer KV has no compare-and-set), so they fence each other out
+        # instead of both passing every check
+        self.epoch: tuple[int, bytes] = (0, b"")
+        # batch whose flush was fenced off or failed during handoff,
+        # kept as (epoch, records) for replay on reactivation
+        self.parked: tuple[tuple[int, bytes], list] | None = None
+        # serializes activation: two concurrent activators would each
+        # bump the fence and the loser's epoch would self-fence the
+        # partition, losing acked records on a healthy broker
+        self.activate_lock = asyncio.Lock()
 
     @property
     def log_path(self) -> tuple[str, str]:
@@ -197,7 +232,13 @@ class Partition:
                     self.mem_base += drop
             self.pending.append(rec)
             self.cond.notify_all()
-        if len(self.pending) >= _SEGMENT_FLUSH_EVERY:
+        # skip (don't queue behind) an in-flight flush: the ack must not
+        # stall for a filer round-trip; the next threshold crossing or
+        # the periodic flusher picks the batch up
+        if (
+            len(self.pending) >= _SEGMENT_FLUSH_EVERY
+            and not self.flush_lock.locked()
+        ):
             try:
                 await self.flush()
             except Exception:  # noqa: BLE001 — record is accepted; the
@@ -205,20 +246,48 @@ class Partition:
                 log.exception("inline flush failed for %s/%d", self.tkey, self.idx)
         return offset
 
+    def _park(self, epoch: tuple[int, bytes], batch: list) -> None:
+        """Hold a batch whose append was fenced/failed for reconciliation
+        at the next activation (or shutdown).  Same-epoch batches merge;
+        an unreconciled older-epoch batch can no longer replay (the log
+        moved on under a different fence) and is counted lost now."""
+        if self.parked is not None:
+            held_epoch, held = self.parked
+            if held_epoch == epoch:
+                batch = held + batch
+            else:
+                log.error(
+                    "partition %s/%d: %d acked records lost (parked "
+                    "batch superseded by a newer fenced batch)",
+                    self.tkey, self.idx, len(held),
+                )
+        self.parked = (epoch, batch)
+
     async def flush(self) -> None:
-        if self._flushing or not self.pending:
-            return
-        self._flushing = True
-        try:
+        async with self.flush_lock:
+            if not self.pending:
+                return
             batch, self.pending = self.pending, []
-            await self.broker._append_log(self, _records_encode(batch))
+            epoch = self.epoch
+            try:
+                await self.broker._append_log(
+                    self, _records_encode(batch), epoch=epoch
+                )
+            except StaleEpochError:
+                # another owner fenced us out mid-flight: park the batch
+                # (reconciliation decides replay vs loss).  Only stop
+                # serving if the partition still runs under the batch's
+                # epoch — a newer local activation is a healthy owner
+                # this stale flush must not tear down.
+                self._park(epoch, batch)
+                if self.epoch == epoch:
+                    self.active = False
+                raise
+            except Exception:
+                # put the batch back; a later flush retries
+                self.pending = batch + self.pending
+                raise
             self.flushed_upto = batch[-1][0] + 1
-        except Exception:
-            # put the batch back; a later flush retries
-            self.pending = batch + self.pending
-            raise
-        finally:
-            self._flushing = False
 
     async def read_from(self, offset: int):
         """Yield records >= offset: durable segment first, then memory.
@@ -343,6 +412,20 @@ class MessageQueueBroker:
                     await p.flush()
                 except Exception:  # noqa: BLE001
                     log.exception("final flush failed for %s/%d", p.tkey, p.idx)
+                if p.parked is not None:
+                    # a batch parked during a handoff would otherwise
+                    # vanish silently on shutdown: replay it if we still
+                    # hold the epoch and the log ends where it begins
+                    try:
+                        stored = await self._read_fence(p)
+                        last = await self._last_offset(p)
+                        await self._reconcile_parked(p, stored, last, stored)
+                    except Exception:  # noqa: BLE001
+                        n = len(p.parked[1]) if p.parked else 0
+                        log.error(
+                            "partition %s/%d: %d parked records lost at "
+                            "shutdown", p.tkey, p.idx, n,
+                        )
         if self._session is not None:
             await self._session.close()
             self._session = None
@@ -364,7 +447,40 @@ class MessageQueueBroker:
 
     # ------------------------------------------------------- filer plumbing
 
-    async def _append_log(self, p: Partition, blob: bytes) -> None:
+    def _fence_key(self, p: Partition) -> bytes:
+        return f"mq.fence/{p.tkey}/{p.idx}".encode()
+
+    async def _read_fence(self, p: Partition) -> tuple[int, bytes]:
+        """(counter, activator nonce); ((0, b'') when never fenced)."""
+        kv = await self._stub().KvGet(
+            filer_pb2.KvGetRequest(key=self._fence_key(p))
+        )
+        if not kv.value:
+            return (0, b"")
+        return struct.unpack("<q", kv.value[:8])[0], bytes(kv.value[8:])
+
+    async def _write_fence(self, p: Partition, epoch: tuple[int, bytes]) -> None:
+        await self._stub().KvPut(
+            filer_pb2.KvPutRequest(
+                key=self._fence_key(p),
+                value=struct.pack("<q", epoch[0]) + epoch[1],
+            )
+        )
+
+    async def _append_log(
+        self, p: Partition, blob: bytes,
+        epoch: tuple[int, bytes] | None = None,
+    ) -> None:
+        """Append to the partition's durable log; with `epoch` set, the
+        write is FENCED: the filer-held epoch is re-read first and a
+        mismatch raises StaleEpochError instead of colliding with the
+        current owner's offsets.  The residual window is this one
+        KvGet->POST round-trip (the filer append has no compare-and-set),
+        vs the registry-TTL-wide window without the fence."""
+        if epoch is not None and await self._read_fence(p) != epoch:
+            raise StaleEpochError(
+                f"{p.tkey}/{p.idx}: epoch {epoch[0]} fenced off"
+            )
         d, name = p.log_path
         sess = await self._sess()
         async with sess.post(
@@ -373,6 +489,13 @@ class MessageQueueBroker:
         ) as r:
             if r.status >= 300:
                 raise RuntimeError(f"log append HTTP {r.status}")
+
+    async def _last_offset(self, p: Partition) -> int:
+        """Highest offset in the partition's durable log (-1 if empty)."""
+        last = -1
+        for offset, *_ in _records_decode(await self._read_log(p)):
+            last = max(last, offset)
+        return last
 
     async def _read_log(self, p: Partition) -> bytes:
         d, name = p.log_path
@@ -406,10 +529,7 @@ class MessageQueueBroker:
                 n = sum(1 for e in pdirs if e.is_directory)
                 for i in range(n):
                     part = Partition(self, tkey, i)
-                    blob = await self._read_log(part)
-                    last = -1
-                    for offset, *_ in _records_decode(blob):
-                        last = max(last, offset)
+                    last = await self._last_offset(part)
                     part.next_offset = last + 1
                     part.mem_base = last + 1
                     parts.append(part)
@@ -442,43 +562,112 @@ class MessageQueueBroker:
     async def _deactivate(self, p: Partition) -> None:
         """Ownership moved away: make acked records durable BEFORE the new
         owner resyncs from the log — an unflushed batch appended later
-        would collide with the new owner's offsets.  If the flush fails,
-        the batch is dropped with a counted warning (ack'd-but-lost, the
-        same class as losing an unreplicated kafka tail); the registry
-        TTL bounds the handoff window, and a flap inside one TTL is the
-        residual race a lease/epoch scheme would close."""
+        would collide with the new owner's offsets.  The append is epoch-
+        fenced, so if the new owner already activated, the batch PARKS
+        instead of colliding; a transiently failed flush parks too, and
+        reactivation replays the parked batch when no other epoch
+        intervened (else it is counted lost — the ack'd-but-lost class of
+        an unreplicated kafka tail, now bounded to genuine double-owner
+        flaps instead of any flush hiccup)."""
         if not p.active:
             return
         p.active = False
         try:
             await p.flush()
+        except StaleEpochError:
+            # already parked by flush(); reactivation reconciles
+            log.warning(
+                "partition %s/%d handoff: flush fenced off, %d records "
+                "parked", p.tkey, p.idx, len(p.parked[1]) if p.parked else 0,
+            )
         except Exception:  # noqa: BLE001
-            lost = len(p.pending)
-            p.pending = []
-            log.error(
-                "partition %s/%d handoff: %d acked records lost "
-                "(flush failed during deactivation)", p.tkey, p.idx, lost,
+            batch, p.pending = p.pending, []
+            p._park(p.epoch, batch)
+            log.warning(
+                "partition %s/%d handoff: flush failed, %d acked records "
+                "parked for replay", p.tkey, p.idx, len(batch),
             )
 
+    async def _reconcile_parked(
+        self,
+        p: Partition,
+        stored: tuple[int, bytes],
+        last: int,
+        append_epoch: tuple[int, bytes],
+    ) -> int:
+        """Replay a parked batch when no other epoch intervened and the
+        log still ends exactly where the batch begins; else count it
+        lost.  Returns the last durable offset after reconciliation."""
+        parked, p.parked = p.parked, None
+        if parked is None:
+            return last
+        parked_epoch, batch = parked
+        if stored == parked_epoch and last + 1 == batch[0][0]:
+            try:
+                await self._append_log(
+                    p, _records_encode(batch), epoch=append_epoch
+                )
+                log.info(
+                    "partition %s/%d: replayed %d parked records",
+                    p.tkey, p.idx, len(batch),
+                )
+                return batch[-1][0]
+            except Exception:  # noqa: BLE001
+                log.error(
+                    "partition %s/%d: %d parked records lost "
+                    "(replay append failed)", p.tkey, p.idx, len(batch),
+                )
+        else:
+            log.error(
+                "partition %s/%d: %d acked records lost (another "
+                "owner appended during the handoff window)",
+                p.tkey, p.idx, len(batch),
+            )
+        return last
+
     async def _ensure_active(self, p: Partition) -> None:
-        """First owned access after (re)gaining a partition: resync
-        next_offset from the durable log, so offsets never collide with
-        appends a previous owner flushed."""
+        """First owned access after (re)gaining a partition: re-check
+        ownership against a FRESH balancer view (a stale-but-alive broker
+        must not steal the fence back during the registry-TTL window),
+        bump the fence epoch (so any previous owner's in-flight flush
+        parks instead of colliding), then resync next_offset from the
+        durable log.  A batch parked by our own earlier handoff replays
+        here when no other epoch intervened and the log still ends
+        exactly where the batch begins; otherwise it is counted lost.
+        Raises NotAssignedHere when the fresh view says another broker
+        owns the partition."""
         if p.active:
             return
-        blob = await self._read_log(p)
-        last = -1
-        for offset, *_ in _records_decode(blob):
-            last = max(last, offset)
-        async with p.cond:
-            if p.active:  # a concurrent activator won the race; its state
-                return  # already covers any appends since
-            p.next_offset = max(p.next_offset, last + 1)
-            p.mem = []
-            p.mem_base = p.next_offset
-            p.flushed_upto = p.next_offset
-            p.pending = []
-            p.active = True
+        async with p.activate_lock:
+            if p.active:  # a concurrent activator won; its state covers us
+                return
+            bal = self.balancer
+            if hasattr(bal, "refresh"):
+                await bal.refresh()
+            parts = self.topics.get(p.tkey)
+            if parts is not None:
+                owner = bal.broker_for(p.tkey, p.idx, len(parts))
+                if owner != self.grpc_url:
+                    raise NotAssignedHere(p.idx, owner)
+            # hold the flush lock too: an in-flight flush completing
+            # after the log resync would land records the resync never
+            # saw (same-process half of the handoff race)
+            async with p.flush_lock:
+                stored = await self._read_fence(p)
+                # fresh nonce per activation: two racing activators'
+                # fences differ even when their counters tie
+                new_epoch = (stored[0] + 1, os.urandom(8))
+                await self._write_fence(p, new_epoch)
+                last = await self._last_offset(p)
+                last = await self._reconcile_parked(p, stored, last, new_epoch)
+                async with p.cond:
+                    p.epoch = new_epoch
+                    p.next_offset = max(p.next_offset, last + 1)
+                    p.mem = []
+                    p.mem_base = p.next_offset
+                    p.flushed_upto = p.next_offset
+                    p.pending = []
+                    p.active = True
 
     async def _balancer_loop(self) -> None:
         bal = self.balancer
@@ -587,7 +776,13 @@ class MessageQueueBroker:
             except IndexError as e:
                 yield mq_pb2.PublishResponse(error=str(e))
                 continue
-            await self._ensure_active(p)
+            try:
+                await self._ensure_active(p)
+            except NotAssignedHere as e:
+                # the FRESH balancer view disagrees with the snapshot
+                # _partition_for used: point the client at the real owner
+                yield mq_pb2.PublishResponse(error=str(e))
+                continue
             offset = await p.append(bytes(req.data.key), bytes(req.data.value))
             yield mq_pb2.PublishResponse(offset=offset, partition=p.idx)
 
@@ -610,7 +805,11 @@ class MessageQueueBroker:
             )
             return
         p = parts[request.partition]
-        await self._ensure_active(p)
+        try:
+            await self._ensure_active(p)
+        except NotAssignedHere as e:
+            yield mq_pb2.SubscribeResponse(error=str(e))
+            return
         offset = request.start_offset
         if offset == -1:  # committed group offset, else earliest
             offset = 0
@@ -627,15 +826,24 @@ class MessageQueueBroker:
         elif offset == -2:  # latest
             offset = p.next_offset
         while True:
+            progressed = False
             async for rec in p.read_from(offset):
                 o, key, value, ts_ns = rec
                 offset = o + 1
+                progressed = True
                 yield mq_pb2.SubscribeResponse(
                     data=mq_pb2.DataMessage(key=key, value=value, ts_ns=ts_ns),
                     offset=o,
                 )
             if not request.tail:
                 return
+            if not progressed and offset < p.mem_base:
+                # offsets in [offset, mem_base) exist neither in the
+                # durable log (just consulted) nor in memory: an acked-
+                # but-lost gap.  Skip ahead instead of hot-rereading the
+                # whole log until a new message happens to arrive.
+                offset = p.mem_base
+                continue
             async with p.cond:
                 if p.next_offset <= offset:
                     await p.cond.wait()

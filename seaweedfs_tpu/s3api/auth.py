@@ -168,9 +168,16 @@ class IdentityAccessManagement:
 
     def authenticate(self, request) -> Identity | None:
         """Verify an aiohttp request; returns the Identity (None =
-        anonymous and auth disabled).  Raises S3AuthError on failure."""
+        anonymous and auth disabled).  Raises S3AuthError on failure.
+
+        Records `request["s3_signed"]` — True when the identity came
+        from verified SigV4/V2 credentials, False when it rode the
+        anonymous identity — so handlers can gate parameters AWS allows
+        only on signed requests (e.g. GetObject response-* overrides)
+        without re-deriving which scheme applied."""
         if not self.enabled:
             return None
+        request["s3_signed"] = True
         auth_header = request.headers.get("Authorization", "")
         if auth_header.startswith("AWS4-HMAC-SHA256"):
             return self._verify_header_sig(request, auth_header)
@@ -180,6 +187,7 @@ class IdentityAccessManagement:
             return self._verify_v2_header(request, auth_header)
         if "Signature" in request.query and "AWSAccessKeyId" in request.query:
             return self._verify_v2_presigned(request)
+        request["s3_signed"] = False
         anon = next((i for i in self.identities if i.name == "anonymous"), None)
         if anon is not None:
             return anon

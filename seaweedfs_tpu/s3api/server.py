@@ -67,6 +67,16 @@ ERR_NO_SUCH_UPLOAD = ("NoSuchUpload", "The specified upload does not exist", 404
 ERR_BUCKET_NOT_EMPTY = ("BucketNotEmpty", "The bucket you tried to delete is not empty", 409)
 ERR_BUCKET_EXISTS = ("BucketAlreadyExists", "The requested bucket name is not available", 409)
 
+# GetObject response-* query overrides (presigned-download semantics);
+# response-content-type is handled separately via resp.content_type
+_RESPONSE_OVERRIDES = {
+    "response-content-disposition": "Content-Disposition",
+    "response-cache-control": "Cache-Control",
+    "response-content-encoding": "Content-Encoding",
+    "response-content-language": "Content-Language",
+    "response-expires": "Expires",
+}
+
 
 class S3ApiServer:
     def __init__(
@@ -311,6 +321,23 @@ class S3ApiServer:
                     if not await self._bucket_exists(bucket):
                         raise S3Error(*ERR_NO_SUCH_BUCKET)
                     return _xml_response(_el("LocationConstraint"))
+                if m == "GET" and "requestPayment" in q:
+                    # GetBucketRequestPayment: always BucketOwner
+                    # (reference s3api_bucket_handlers.go:352-360)
+                    if not await self._bucket_exists(bucket):
+                        raise S3Error(*ERR_NO_SUCH_BUCKET)
+                    payment = _el("RequestPaymentConfiguration")
+                    ET.SubElement(payment, "Payer").text = "BucketOwner"
+                    return _xml_response(payment)
+                if m == "PUT" and "requestPayment" in q:
+                    # must not fall through to put_bucket (which would
+                    # 409 on the existing bucket); requester-pays is not
+                    # supported, like the other config-write subresources
+                    raise S3Error(
+                        "NotImplemented",
+                        "PutBucketRequestPayment is not implemented",
+                        501,
+                    )
                 if "object-lock" in q:
                     # bucket-level object-lock configuration is a
                     # documented no-op (reference skip handlers)
@@ -869,6 +896,18 @@ class S3ApiServer:
         )
 
     async def get_object(self, bucket: str, key: str, request: web.Request) -> web.StreamResponse:
+        if any(
+            p in request.query
+            for p in (*_RESPONSE_OVERRIDES, "response-content-type")
+        ) and not request.get("s3_signed", True):
+            # AWS rejects response-* on anonymous requests: otherwise any
+            # reader could rewrite presentation headers on public
+            # objects.  Checked before any backend I/O is spent.
+            raise S3Error(
+                "InvalidRequest",
+                "response-* query parameters require a signed request",
+                400,
+            )
         entry = await self._get_entry(bucket, key)
         if entry.is_directory:
             raise S3Error(*ERR_NO_SUCH_KEY)
@@ -907,17 +946,10 @@ class S3ApiServer:
             # response-* query overrides (AWS GetObject request parameters;
             # the common use is presigned download links forcing a
             # filename/type)
-            overrides = {
-                "response-content-disposition": "Content-Disposition",
-                "response-cache-control": "Cache-Control",
-                "response-content-encoding": "Content-Encoding",
-                "response-content-language": "Content-Language",
-                "response-expires": "Expires",
-            }
             content_type_override = request.query.get(
                 "response-content-type", ""
             )
-            for q, hdr in overrides.items():
+            for q, hdr in _RESPONSE_OVERRIDES.items():
                 if q in request.query:
                     out_headers[hdr] = request.query[q]
             resp = web.StreamResponse(status=r.status, headers=out_headers)

@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import html
 import time
+import urllib.parse
 
 _PAGE = """<!DOCTYPE html>
 <html><head><meta charset="utf-8"><title>{title}</title><style>
@@ -158,7 +159,13 @@ def render_filer_listing(
     rows = []
     for e in entries:
         name = e.name + ("/" if e.is_directory else "")
-        href = (path.rstrip("/") or "") + "/" + e.name
+        # percent-encode the segment: names with %, ?, # or spaces must
+        # not be parsed as URL syntax by the browser
+        href = (
+            urllib.parse.quote(path.rstrip("/"))
+            + "/"
+            + urllib.parse.quote(e.name)
+        )
         rows.append([
             f'<a href="{_esc(href)}">{_esc(name)}</a>',
             "-" if e.is_directory else e.attr.file_size,

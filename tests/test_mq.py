@@ -338,3 +338,106 @@ def test_multi_broker_assignment_and_failover(tmp_path):
             await cluster.stop()
 
     run(go())
+
+
+def test_mq_epoch_fence_parks_stale_flush(tmp_path):
+    """A flush racing a newer owner's activation is fenced off by the
+    per-partition epoch in the filer KV: the batch parks (no colliding
+    append) and the partition deactivates.  Reactivation after another
+    epoch intervened counts the parked records lost instead of replaying
+    them over the new owner's offsets."""
+
+    async def go():
+        cluster, broker = await make(tmp_path)
+        try:
+            c = MqClient(broker.grpc_url)
+            topic = c.topic("fenced")
+            await c.configure_topic(topic, partition_count=1)
+            await c.publish(topic, [(b"", b"d%d" % i) for i in range(5)])
+            p = broker.topics["default/fenced"][0]
+            await p.flush()  # 0..4 durable under epoch 1
+            assert p.epoch[0] == 1
+            await c.publish(topic, [(b"", b"x%d" % i) for i in range(3)])
+            assert len(p.pending) == 3
+            # another owner activates: epoch moves on under our feet
+            await broker._write_fence(p, (2, b"interloper"))
+            with pytest.raises(Exception):
+                await p.flush()
+            assert p.parked is not None and len(p.parked[1]) == 3
+            assert not p.active
+            # the durable log was NOT extended by the fenced batch
+            blob = await broker._read_log(p)
+            from seaweedfs_tpu.mq.broker import _records_decode
+
+            assert max(o for o, *_ in _records_decode(blob)) == 4
+            # reactivation: parked epoch 1 != stored epoch 2 -> records
+            # are counted lost; their offsets are NOT reused (a gap, not
+            # a collision — publishers already saw 5..7 acked)
+            await broker._ensure_active(p)
+            assert p.parked is None and p.active and p.epoch[0] == 3
+            assert p.next_offset == 8
+            # a tail subscriber crossing the lost-records gap skips it
+            # (no hot re-read loop) and sees the next live message
+            await c.publish(topic, [(b"", b"after-gap")])
+            got = []
+
+            async def tail_reader():
+                async for _o, _k, v in c.subscribe(
+                    topic, 0, start_offset=0, tail=True
+                ):
+                    got.append(v)
+                    if v == b"after-gap":
+                        return
+
+            await asyncio.wait_for(tail_reader(), 10)
+            assert got == [b"d%d" % i for i in range(5)] + [b"after-gap"]
+        finally:
+            await broker.stop()
+            await cluster.stop()
+
+    run(go())
+
+
+def test_mq_parked_batch_replays_on_reactivation(tmp_path):
+    """A handoff flush that fails transiently parks the acked batch; when
+    the broker reactivates the partition and no other epoch intervened,
+    the parked batch replays into the log — no acked record lost."""
+
+    async def go():
+        cluster, broker = await make(tmp_path)
+        try:
+            c = MqClient(broker.grpc_url)
+            topic = c.topic("parked")
+            await c.configure_topic(topic, partition_count=1)
+            await c.publish(topic, [(b"", b"d%d" % i) for i in range(5)])
+            p = broker.topics["default/parked"][0]
+            await p.flush()
+            await c.publish(topic, [(b"", b"x%d" % i) for i in range(3)])
+
+            real_append = broker._append_log
+
+            async def failing_append(part, blob, epoch=None):
+                raise RuntimeError("filer briefly unreachable")
+
+            broker._append_log = failing_append
+            await broker._deactivate(p)
+            broker._append_log = real_append
+            assert p.parked is not None and len(p.parked[1]) == 3
+            assert not p.active
+
+            # reactivate: same epoch still stored, log ends where the
+            # parked batch begins -> replay
+            await broker._ensure_active(p)
+            assert p.parked is None and p.active
+            assert p.next_offset == 8
+
+            got = []
+            async for _o, _k, v in c.subscribe(topic, 0, start_offset=0):
+                got.append(v)
+            assert got == [b"d0", b"d1", b"d2", b"d3", b"d4",
+                           b"x0", b"x1", b"x2"]
+        finally:
+            await broker.stop()
+            await cluster.stop()
+
+    run(go())

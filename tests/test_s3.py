@@ -472,3 +472,66 @@ def test_s3_tagging_and_metadata(tmp_path):
             await cluster.stop()
 
     run(go())
+
+
+def test_s3_request_payment_and_signed_response_overrides(tmp_path):
+    """GetBucketRequestPayment returns the BucketOwner payer document
+    (reference s3api_bucket_handlers.go:352-360); response-* GetObject
+    overrides are honored only on SIGNED requests when auth is enabled —
+    AWS rejects them on anonymous reads with 400 InvalidRequest."""
+
+    async def go():
+        iam = IdentityAccessManagement(
+            [
+                Identity(
+                    name="admin",
+                    credentials=[(ACCESS, SECRET)],
+                    actions=["Admin"],
+                ),
+                Identity(name="anonymous", actions=["Read"]),
+            ]
+        )
+        cluster = LocalCluster(
+            base_dir=str(tmp_path), n_volume_servers=1, with_s3=True,
+            s3_kwargs=dict(iam=iam),
+        )
+        await cluster.start()
+        signed = S3Client(cluster.s3.url, ACCESS, SECRET)
+        anon = S3Client(cluster.s3.url)
+        try:
+            status, _, _ = await signed.request("PUT", "/payb")
+            assert status == 200
+            status, body, _ = await signed.request(
+                "GET", "/payb", query="requestPayment"
+            )
+            assert status == 200
+            assert _strip(_xml(body).tag) == "RequestPaymentConfiguration"
+            payer = [c for c in _xml(body) if _strip(c.tag) == "Payer"]
+            assert payer and payer[0].text == "BucketOwner"
+            status, body, _ = await signed.request(
+                "GET", "/no-such-bucket", query="requestPayment"
+            )
+            assert status == 404
+
+            status, _, _ = await signed.request("PUT", "/payb/o.txt", b"pub")
+            assert status == 200
+            # the anonymous identity can read the object...
+            status, body, _ = await anon.request("GET", "/payb/o.txt")
+            assert status == 200 and body == b"pub"
+            # ...but cannot rewrite its presentation headers
+            status, body, _ = await anon.request(
+                "GET", "/payb/o.txt",
+                query="response-content-type=text/evil",
+            )
+            assert status == 400 and b"InvalidRequest" in body
+            # a signed reader can
+            status, _, hdrs = await signed.request(
+                "GET", "/payb/o.txt",
+                query="response-content-type=text/plain",
+            )
+            assert status == 200
+            assert hdrs["Content-Type"].startswith("text/plain")
+        finally:
+            await cluster.stop()
+
+    run(go())
