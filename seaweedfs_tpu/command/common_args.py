@@ -1,0 +1,24 @@
+"""Flags shared by several server commands (reference: the global
+-metrics.address / -metrics.intervalSeconds pair every `weed` server
+command forwards to stats.LoopPushingMetric, weed/stats/metrics.go:263).
+"""
+from __future__ import annotations
+
+
+def add_metrics_args(p) -> None:
+    p.add_argument(
+        "-metrics.address", dest="metrics_address", default="",
+        help="Prometheus pushgateway host:port to push metrics to "
+        "(empty = serve /metrics only)",
+    )
+    p.add_argument(
+        "-metrics.intervalSeconds", dest="metrics_interval_seconds",
+        type=int, default=15, help="how often to push metrics",
+    )
+
+
+def metrics_kwargs(args) -> dict:
+    return dict(
+        metrics_address=args.metrics_address,
+        metrics_interval_seconds=args.metrics_interval_seconds,
+    )

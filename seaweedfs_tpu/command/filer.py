@@ -1,6 +1,7 @@
 """`filer` — run a filer server (reference: weed/command/filer.go)."""
 from __future__ import annotations
 
+from . import common_args
 from ..security import guard as guard_mod
 
 import argparse
@@ -80,6 +81,7 @@ def add_args(p) -> None:
         "-cacheSizeMB", dest="chunk_cache_mb", type=int, default=64,
         help="memory chunk cache budget",
     )
+    common_args.add_metrics_args(p)
 
 
 def build_filer_server(args):
@@ -135,6 +137,7 @@ def build_filer_server(args):
         chunk_cache_dir=args.chunk_cache_dir or None,
         chunk_cache_mb=args.chunk_cache_mb,
         white_list=guard_mod.from_security_toml(),
+        **common_args.metrics_kwargs(args),
     )
 
 

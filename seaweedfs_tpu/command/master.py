@@ -3,6 +3,7 @@ from __future__ import annotations
 
 import asyncio
 
+from . import common_args
 from ..utils import config as config_util
 from ..security import guard as guard_mod
 
@@ -48,6 +49,7 @@ def add_args(p) -> None:
         type=int, default=1000,
         help="compact the raft log into a snapshot past this many entries",
     )
+    common_args.add_metrics_args(p)
 
 
 async def run(args) -> None:
@@ -74,6 +76,7 @@ async def run(args) -> None:
         meta_dir=args.meta_dir or None,
         raft_snapshot_threshold=args.raft_snapshot_threshold,
         white_list=guard_mod.from_security_toml(),
+        **common_args.metrics_kwargs(args),
     )
     await ms.start()
     await asyncio.Event().wait()  # serve until interrupted

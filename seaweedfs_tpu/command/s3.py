@@ -4,6 +4,8 @@ from __future__ import annotations
 import asyncio
 import json
 
+from . import common_args
+
 NAME = "s3"
 HELP = "start an S3-compatible gateway over a filer"
 
@@ -24,6 +26,7 @@ def add_args(p) -> None:
         help="s3 identities json (reference s3.json: "
         '{"identities":[{"name",...,"credentials":[...],"actions":[...]}]})',
     )
+    common_args.add_metrics_args(p)
 
 
 def build_s3_server(args):
@@ -40,6 +43,7 @@ def build_s3_server(args):
         ip=args.ip,
         port=args.port,
         iam=iam,
+        **common_args.metrics_kwargs(args),
     )
 
 

@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import asyncio
 
+from . import common_args
 from ..utils import config as config_util
 
 NAME = "server"
@@ -35,6 +36,7 @@ def add_args(p) -> None:
     p.add_argument("-s3", action="store_true", help="also run the S3 gateway")
     p.add_argument("-s3.port", dest="s3_port", type=int, default=8333)
     p.add_argument("-s3.config", dest="s3_config", default="")
+    common_args.add_metrics_args(p)
 
 
 async def run(args) -> None:
@@ -49,6 +51,7 @@ async def run(args) -> None:
 
     jwt_key = config_util.jwt_signing_key()
     white_list = guard_mod.from_security_toml()
+    metrics_kw = common_args.metrics_kwargs(args)
     ms = MasterServer(
         ip=args.ip,
         port=args.master_port,
@@ -57,6 +60,7 @@ async def run(args) -> None:
         jwt_signing_key=jwt_key,
         jwt_expires_sec=config_util.jwt_expires_sec(),
         white_list=white_list,
+        **metrics_kw,
     )
     await ms.start()
 
@@ -78,6 +82,7 @@ async def run(args) -> None:
         ec_device_cache_mb=args.ec_device_cache_mb,
         jwt_signing_key=jwt_key,
         white_list=white_list,
+        **metrics_kw,
     )
     await vs.start()
 
@@ -95,6 +100,8 @@ async def run(args) -> None:
         fargs.db_path = args.filer_db
         fargs.ip = args.ip
         fargs.port = args.filer_port
+        fargs.metrics_address = args.metrics_address
+        fargs.metrics_interval_seconds = args.metrics_interval_seconds
         fs = filer_cmd.build_filer_server(fargs)
         await fs.start()
         if args.s3:
@@ -109,6 +116,8 @@ async def run(args) -> None:
             sargs.ip = args.ip
             sargs.port = args.s3_port
             sargs.s3_config = args.s3_config
+            sargs.metrics_address = args.metrics_address
+            sargs.metrics_interval_seconds = args.metrics_interval_seconds
             s3 = s3_cmd.build_s3_server(sargs)
             await s3.start()
 

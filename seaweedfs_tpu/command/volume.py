@@ -3,6 +3,7 @@ from __future__ import annotations
 
 import asyncio
 
+from . import common_args
 from ..utils import config as config_util
 from ..security import guard as guard_mod
 
@@ -82,6 +83,7 @@ def add_args(p) -> None:
         "-concurrentDownloadLimitMB", dest="concurrent_download_limit_mb",
         type=int, default=0, help="total in-flight download bytes (0 = off)",
     )
+    common_args.add_metrics_args(p)
 
 
 async def run(args) -> None:
@@ -132,6 +134,7 @@ async def run(args) -> None:
         ec_device_cache_mb=args.ec_device_cache_mb,
         white_list=guard_mod.from_security_toml(),
         fix_jpg_orientation=args.fix_jpg_orientation,
+        **common_args.metrics_kwargs(args),
     )
     await vs.start()
     await asyncio.Event().wait()

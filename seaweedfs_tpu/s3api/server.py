@@ -87,7 +87,12 @@ class S3ApiServer:
         port: int = 8333,
         buckets_path: str = "/buckets",
         iam: IdentityAccessManagement | None = None,
+        metrics_address: str = "",  # pushgateway host:port (ref -metrics.address)
+        metrics_interval_seconds: int = 15,  # ref -metrics.intervalSeconds
     ):
+        self.metrics_address = metrics_address
+        self.metrics_interval_seconds = metrics_interval_seconds
+        self._metrics_push_task = None
         self.filer_address = filer_address
         host, _, p = filer_address.partition(":")
         self.filer_grpc_address = filer_grpc_address or f"{host}:{int(p) + 10000}"
@@ -191,9 +196,21 @@ class S3ApiServer:
         site = web.TCPSite(self._http_runner, self.ip, self.port)
         await site.start()
         self.port = site._server.sockets[0].getsockname()[1]
+        from .. import stats
+
+        self._metrics_push_task = stats.start_push_loop(
+            "s3", self.url, self.metrics_address,
+            self.metrics_interval_seconds,
+        )
         log.info("s3 gateway listening on %s", self.port)
 
     async def stop(self) -> None:
+        if self._metrics_push_task is not None:
+            self._metrics_push_task.cancel()
+            try:
+                await self._metrics_push_task
+            except asyncio.CancelledError:
+                pass
         if self._iam_refresh is not None:
             self._iam_refresh.cancel()
             try:

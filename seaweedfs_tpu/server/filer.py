@@ -79,7 +79,12 @@ class FilerServer:
         notifier=None,  # replication.notification.Notifier
         upload_parallelism: int = 4,  # concurrent chunk uploads per file
         white_list: list[str] | None = None,  # [access] white_list guard
+        metrics_address: str = "",  # pushgateway host:port (ref -metrics.address)
+        metrics_interval_seconds: int = 15,  # ref -metrics.intervalSeconds
     ):
+        self.metrics_address = metrics_address
+        self.metrics_interval_seconds = metrics_interval_seconds
+        self._metrics_push_task = None
         self.masters = masters
         self.guard = guard_mod.Guard(white_list)
         self.ip = ip
@@ -212,9 +217,19 @@ class FilerServer:
                 f"{self.ip}:{self.port}.{self.grpc_port}"
             )
         await self.master_client.start()
+        self._metrics_push_task = stats.start_push_loop(
+            "filer", self.url, self.metrics_address,
+            self.metrics_interval_seconds,
+        )
         log.info("filer listening http=%s grpc=%s", self.port, self.grpc_port)
 
     async def stop(self) -> None:
+        if self._metrics_push_task is not None:
+            self._metrics_push_task.cancel()
+            try:
+                await self._metrics_push_task
+            except asyncio.CancelledError:
+                pass
         await self.master_client.stop()
         if self._grpc_server:
             await self._grpc_server.stop(0.5)

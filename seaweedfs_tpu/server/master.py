@@ -89,7 +89,11 @@ class MasterServer:
         raft_join: bool = False,  # start as non-voter until cluster.raft.add
         raft_snapshot_threshold: int = 1000,  # log entries before compaction
         white_list: list[str] | None = None,  # [access] white_list guard
+        metrics_address: str = "",  # pushgateway host:port (ref -metrics.address)
+        metrics_interval_seconds: int = 15,  # ref -metrics.intervalSeconds
     ):
+        self.metrics_address = metrics_address
+        self.metrics_interval_seconds = metrics_interval_seconds
         self.raft_join = raft_join
         self.guard = guard_mod.Guard(white_list)
         self.raft_snapshot_threshold = raft_snapshot_threshold
@@ -207,6 +211,12 @@ class MasterServer:
         self._tasks.append(asyncio.create_task(self._grower_loop()))
         if self.auto_vacuum:
             self._tasks.append(asyncio.create_task(self._vacuum_loop()))
+        push = stats.start_push_loop(
+            "master", self.url, self.metrics_address,
+            self.metrics_interval_seconds,
+        )
+        if push is not None:
+            self._tasks.append(push)
         log.info(
             "master up http=%s grpc=%s peers=%s", self.url, self.grpc_url,
             others,

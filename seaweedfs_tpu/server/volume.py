@@ -202,7 +202,11 @@ class VolumeServer:
         ec_device_cache_mb: int = 0,  # >0: pin mounted EC shards in HBM
         white_list: list[str] | None = None,  # [access] white_list guard
         fix_jpg_orientation: bool = False,  # ref -images.fix.orientation
+        metrics_address: str = "",  # pushgateway host:port (ref -metrics.address)
+        metrics_interval_seconds: int = 15,  # ref -metrics.intervalSeconds
     ):
+        self.metrics_address = metrics_address
+        self.metrics_interval_seconds = metrics_interval_seconds
         self.fix_jpg_orientation = fix_jpg_orientation
         self.guard = guard_mod.Guard(white_list)
         if tier_backends:
@@ -311,6 +315,12 @@ class VolumeServer:
         if heartbeat and self.masters:
             self._tasks.append(asyncio.create_task(self._heartbeat_forever()))
         self._tasks.append(asyncio.create_task(self._ttl_sweep_forever()))
+        push = stats.start_push_loop(
+            "volumeServer", self.url, self.metrics_address,
+            self.metrics_interval_seconds, collect=self._collect_metrics,
+        )
+        if push is not None:
+            self._tasks.append(push)
         log.info("volume server up http=%s grpc=%s", self.url, self.grpc_url)
 
     async def _ttl_sweep_forever(self, interval: float = 60.0) -> None:

@@ -122,6 +122,68 @@ def time_request(counter: Counter, histogram: Histogram, kind: str):
         histogram.labels(type=kind).observe(time.perf_counter() - t0)
 
 
+def start_push_loop(
+    job: str,
+    instance: str,
+    address: str,
+    interval_seconds: int,
+    collect=None,
+):
+    """Background task PUSHING the registry to a Prometheus pushgateway
+    (reference metrics.go:263-283 LoopPushingMetric): PUT the text
+    exposition to /metrics/job/<job>/instance/<instance> every
+    `interval_seconds`.  Returns the asyncio.Task (cancel on server
+    stop), or None when no address/interval is configured — serving
+    /metrics locally is unaffected either way."""
+    import asyncio
+
+    if not address or interval_seconds == 0:
+        return None
+    if interval_seconds < 0:
+        # misconfigured negative interval would busy-loop; the reference
+        # clamps to its 15s default the same way (metrics.go:277-279)
+        interval_seconds = 15
+    return asyncio.create_task(
+        _push_loop(job, instance, address, interval_seconds, collect)
+    )
+
+
+async def _push_loop(job, instance, address, interval_seconds, collect):
+    import asyncio
+    import logging
+    import urllib.parse
+
+    import aiohttp
+
+    log = logging.getLogger("stats")
+    base = address if "://" in address else f"http://{address}"
+    url = (
+        f"{base}/metrics/job/{urllib.parse.quote(job, safe='')}"
+        f"/instance/{urllib.parse.quote(instance, safe='')}"
+    )
+    log.info("pushing metrics to %s every %ds", url, interval_seconds)
+    async with aiohttp.ClientSession() as sess:
+        while True:
+            try:
+                if collect is not None:
+                    collect()
+                async with sess.put(
+                    url,
+                    data=generate_latest(REGISTRY),
+                    headers={"Content-Type": CONTENT_TYPE_LATEST},
+                ) as r:
+                    if r.status >= 300:
+                        log.warning(
+                            "pushgateway %s returned HTTP %d", url, r.status
+                        )
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — the gateway being
+                # down must not kill the server's push loop
+                log.warning("could not push metrics to %s: %s", url, e)
+            await asyncio.sleep(interval_seconds)
+
+
 async def metrics_handler(request):
     """aiohttp GET /metrics handler (the reference's per-server metrics
     listener, metrics.go StartMetricsServer)."""
