@@ -12,7 +12,8 @@ measured on the same host — BASELINE.md's "measure the denominator" rule.
 The native library is REQUIRED: the benchmark builds it and exits non-zero
 if that fails, so the baseline can never silently degrade to numpy.
 
-TIMING METHODOLOGY (round-4 rework, VERDICT r3 Weak #1/#2):
+TIMING METHODOLOGY (round-4 rework, VERDICT r3 Weak #1/#2; round-5
+consistency rework, VERDICT r4 Weak #2/#3):
   * Device numbers use the profiler's device-stream execution time
     (utils/devtime) as PRIMARY: experiments/kernel_roof_r3.py proved the
     fori-loop differencing harness under-reads by ~1.8x (it charges its
@@ -21,13 +22,24 @@ TIMING METHODOLOGY (round-4 rework, VERDICT r3 Weak #1/#2):
     and published next to the primary.
   * The CPU denominator takes the median of two interleaved groups of
     reps (one before the device benches, one after) and publishes the
-    per-group medians + coefficient of variation, so a load transient on
-    this single shared core is visible instead of silently shifting
-    vs_baseline.  Within-run cv measures ~0.07-0.09; ACROSS runs the
-    shared core itself swings (4.36 / 4.90 / 5.06 GB/s in three same-day
-    round-4 runs, ~10.5 in a quieter round-3 window), while the device
-    numbers repeat to ±0.02% — read vs_baseline together with
-    cpu_group_medians_gbps, not as a standalone constant.
+    per-group medians + coefficient of variation.  The single shared
+    core swings under outside load BOTH across runs (4.4-10.5 GB/s
+    observed over rounds 3-4) and sometimes WITHIN one (BENCH_r04
+    shipped group medians 1.7x apart), while the device numbers repeat
+    to ±0.02%.  So the headline carries TWO baselines:
+    `vs_baseline` divides by the blended median (both groups pooled) and
+    `vs_baseline_conservative` divides by the FASTEST group median — the
+    speedup claim the CPU's best observed window still supports.  The
+    >=8x target is asserted against the conservative number
+    (extra.consistency.vs_baseline_ok).
+  * `extra.consistency` cross-checks the run against itself: the durable
+    e2e encode figure implies a shard-write rate (x1.4 of input bytes)
+    that must not exceed the disk ceiling measured in the SAME run; the
+    ceiling probe runs twice (before and after the e2e encodes, same
+    interleave protocol as the CPU groups) and the check compares
+    against the faster probe with 25% tolerance for disk-window drift.
+    A failed check sets consistency.ok=false rather than shipping
+    silently-contradictory numbers.
 
 `extra` covers the remaining BASELINE.json configs, measured end to end:
 
@@ -57,11 +69,19 @@ TIMING METHODOLOGY (round-4 rework, VERDICT r3 Weak #1/#2):
                              co-located projection from profiler-measured
                              device time (no tunnel RTT/D2H)
   multi_volume_device_gbps   8 volumes' stripes batched into one call
+  serving                    HTTP degraded-read concurrency sweep through
+                             the REAL volume server (bench_serving_sweep):
+                             aggregate reads/s + p50 at c=1..256 for the
+                             native per-read path vs the device-resident
+                             batched path, and the levels where the
+                             device path wins end-to-end on this rig
   disk_write_mbps            write bandwidth measured with the SHARD
                              WRITER's own pattern (14 striped files,
                              fsync-all before the clock stops) so the
                              durable e2e figure can be cross-checked
-                             against it (VERDICT r3 Weak #7)
+                             against it (VERDICT r3 Weak #7); probed
+                             before AND after the e2e encodes (see
+                             consistency)
   h2d_mbps / d2h_mbps        measured host<->device bandwidth
 
 Rig physics (recorded so the e2e numbers can be read honestly): this box
@@ -120,14 +140,20 @@ def bench_cpu_group(parity_m, mb=64, reps=10):
 
 
 def cpu_stats(nbytes, times_a, times_b):
-    """-> (median_bps, diagnostics dict) over both interleaved groups."""
-    all_t = np.asarray(times_a + times_b)
+    """-> (blended_bps, fastest_group_bps, diagnostics dict).
+
+    `times_b` may be empty (the device-unavailable error path measures
+    only one group); the diagnostics then honestly report one group
+    instead of double-counting the same reps."""
+    groups = [g for g in (times_a, times_b) if g]
+    all_t = np.asarray([t for g in groups for t in g])
     med = float(np.median(all_t))
-    return nbytes / med, {
+    group_meds = [float(np.median(np.asarray(g))) for g in groups]
+    return nbytes / med, nbytes / min(group_meds), {
         "cpu_reps": len(all_t),
+        "cpu_groups": len(groups),
         "cpu_group_medians_gbps": [
-            round(nbytes / float(np.median(np.asarray(g))) / 1e9, 3)
-            for g in (times_a, times_b)
+            round(nbytes / m / 1e9, 3) for m in group_meds
         ],
         "cpu_cv": round(float(np.std(all_t) / np.mean(all_t)), 3),
     }
@@ -498,14 +524,13 @@ def bench_degraded_read(sizes=(4096, 65536, 1048576), n=24, batch=64):
     return out
 
 
-def bench_rig_bandwidths(mb=64):
-    """Measured rig limits that cap every e2e path: disk write bandwidth in
-    the SHARD WRITER's own pattern (14 striped files written round-robin,
-    all fsynced before the clock stops — so the durable e2e number has an
-    apples-to-apples ceiling, VERDICT r3 Weak #7), host->device, and
-    device->host transfer."""
-    import jax
-
+def bench_disk_ceiling(mb=64):
+    """Disk write bandwidth (MB/s) in the SHARD WRITER's own pattern (14
+    striped files written round-robin, all fsynced before the clock stops
+    — so the durable e2e number has an apples-to-apples ceiling, VERDICT
+    r3 Weak #7).  Called twice per run, before and after the e2e encodes,
+    so a drifting disk window shows up as inter-probe spread instead of a
+    silently contradictory ceiling (VERDICT r4 Weak #2)."""
     buf = np.random.default_rng(6).integers(0, 256, mb << 20, dtype=np.uint8)
     with tempfile.TemporaryDirectory(dir=".") as d:
         files = [open(os.path.join(d, f"s{i:02d}"), "wb") for i in range(14)]
@@ -523,6 +548,14 @@ def bench_rig_bandwidths(mb=64):
         disk = (per * 14) / (time.perf_counter() - t0)
         for f in files:
             f.close()
+    return disk / 1e6
+
+
+def bench_transfer_bandwidths(mb=64):
+    """Measured host<->device tunnel bandwidth (MB/s)."""
+    import jax
+
+    buf = np.random.default_rng(6).integers(0, 256, mb << 20, dtype=np.uint8)
     jax.device_put(buf[: 1 << 20]).block_until_ready()  # warm
     t0 = time.perf_counter()
     dev = jax.device_put(buf)
@@ -532,7 +565,192 @@ def bench_rig_bandwidths(mb=64):
     t0 = time.perf_counter()
     np.asarray(dev)
     d2h = buf.nbytes / (time.perf_counter() - t0)
-    return disk / 1e6, h2d / 1e6, d2h / 1e6
+    return h2d / 1e6, d2h / 1e6
+
+
+async def _serving_sweep_async(
+    device: bool,
+    levels=(1, 4, 16, 64, 256),
+    reads_per_level=512,
+    n_needles=64,
+):
+    """Aggregate degraded-read throughput through the REAL volume-server
+    HTTP path (VERDICT r4 next-round #1): one volume of 4KB needles,
+    EC-encoded, two shards destroyed, read back over plain HTTP by c
+    closed-loop clients.  `device=True` serves via the EcReadBatcher ->
+    device-resident batched reconstruct; False via the per-read native
+    CPU reconstruct.  Returns {"reads_per_s": {c: v}, "p50_ms": {c: v}}.
+    Reference path being challenged: weed/storage/store_ec.go:339-393."""
+    import asyncio
+
+    import aiohttp
+
+    from seaweedfs_tpu.operation import assign, upload_data
+    from seaweedfs_tpu.pb import Stub, channel, volume_server_pb2
+    from seaweedfs_tpu.server.cluster import LocalCluster
+    from seaweedfs_tpu.storage.ec.layout import TOTAL_SHARDS
+
+    tmp = tempfile.mkdtemp(prefix="bench_serving_", dir=".")
+    cluster = LocalCluster(
+        base_dir=tmp, n_volume_servers=1, pulse_seconds=1,
+        ec_backend="native",
+    )
+    await cluster.start()
+    out = {"reads_per_s": {}, "p50_ms": {}}
+    try:
+        vs = cluster.volume_servers[0]
+        if device:
+            from seaweedfs_tpu.ops.rs_resident import DeviceShardCache
+
+            cache = DeviceShardCache(budget_bytes=2 << 30)
+            # the sweep serves only 4KB needles: narrow the mount-time
+            # warm plan to its shapes (incl. the widest count bucket) so
+            # the pin thread pre-compiles exactly what the timed bursts
+            # hit — and nothing competes with them for the compiler
+            cache.warm_sizes = (4096,)
+            cache.warm_counts = (1, 8, 64, 256)
+            vs.store.ec_device_cache = cache
+        master = cluster.master.advertise_url
+        rng = np.random.default_rng(17)
+        blobs, vid = {}, None
+        for _ in range(n_needles * 12):
+            if len(blobs) >= n_needles:
+                break
+            a = await assign(master)
+            v = int(a.fid.split(",")[0])
+            if vid is None:
+                vid = v
+            if v != vid:  # assigns round-robin over several volumes
+                continue
+            data = rng.integers(0, 256, 4096, dtype=np.uint8).tobytes()
+            await upload_data(f"http://{a.url}/{a.fid}", data)
+            blobs[a.fid] = data
+        assert len(blobs) >= n_needles // 2, "could not fill one volume"
+
+        stub = Stub(channel(vs.grpc_url), volume_server_pb2, "VolumeServer")
+        await stub.VolumeMarkReadonly(
+            volume_server_pb2.VolumeMarkReadonlyRequest(volume_id=vid)
+        )
+        await stub.VolumeEcShardsGenerate(
+            volume_server_pb2.VolumeEcShardsGenerateRequest(volume_id=vid)
+        )
+        await stub.VolumeEcShardsMount(
+            volume_server_pb2.VolumeEcShardsMountRequest(
+                volume_id=vid, shard_ids=list(range(TOTAL_SHARDS))
+            )
+        )
+        await stub.VolumeUnmount(
+            volume_server_pb2.VolumeUnmountRequest(volume_id=vid)
+        )
+        if device:
+            deadline = time.time() + 600
+            cache = vs.store.ec_device_cache
+            while time.time() < deadline:
+                if len(cache.shard_ids(vid)) == TOTAL_SHARDS:
+                    break
+                await asyncio.sleep(0.5)
+            assert len(cache.shard_ids(vid)) == TOTAL_SHARDS, "pin timeout"
+            # wait out the pin thread's warm compiles too: a compile
+            # racing a timed burst would serialize against its dispatches
+            await asyncio.to_thread(
+                lambda: [t.join(timeout=900) for t in vs.store._pin_threads]
+            )
+        # shard 0 holds every needle of a small volume; dropping it (and
+        # 11) forces every read to reconstruct from exactly 10 survivors
+        for sid in (0, 11):
+            await stub.VolumeEcShardsUnmount(
+                volume_server_pb2.VolumeEcShardsUnmountRequest(
+                    volume_id=vid, shard_ids=[sid]
+                )
+            )
+            if device:
+                vs.store.ec_device_cache.evict(vid, sid)
+            p = vs.store._ec_base(vid, "") + f".ec{sid:02d}"
+            if os.path.exists(p):
+                os.remove(p)
+
+        fids = list(blobs)
+        async with aiohttp.ClientSession() as sess:
+
+            async def read(fid):
+                async with sess.get(f"http://{vs.url}/{fid}") as r:
+                    assert r.status == 200, (fid, r.status)
+                    return await r.read()
+
+            # untimed warm pass per level: pays the jit compiles for
+            # every (count bucket, alignment) shape the timed runs hit,
+            # and asserts byte-exactness once per level
+            for c in levels:
+                seq = [fids[i % len(fids)] for i in range(max(c, 32))]
+                sem = asyncio.Semaphore(c)
+
+                async def warm_read(fid):
+                    async with sem:
+                        got = await read(fid)
+                        assert got == blobs[fid], "degraded read corrupt"
+
+                await asyncio.gather(*(warm_read(f) for f in seq))
+
+            for c in levels:
+                sem = asyncio.Semaphore(c)
+                lats = []
+
+                async def timed_read(fid):
+                    async with sem:
+                        t0 = time.perf_counter()
+                        await read(fid)
+                        lats.append(time.perf_counter() - t0)
+
+                seq = [fids[i % len(fids)] for i in range(reads_per_level)]
+                t0 = time.perf_counter()
+                await asyncio.gather(*(timed_read(f) for f in seq))
+                wall = time.perf_counter() - t0
+                out["reads_per_s"][str(c)] = round(reads_per_level / wall, 1)
+                out["p50_ms"][str(c)] = round(
+                    sorted(lats)[len(lats) // 2] * 1e3, 2
+                )
+        out["needles"] = len(blobs)
+    finally:
+        await cluster.stop()
+        from seaweedfs_tpu.pb.rpc import close_all_channels
+
+        await close_all_channels()
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+def bench_serving_sweep(levels=(1, 4, 16, 64, 256), reads_per_level=512):
+    """Run the HTTP degraded-read concurrency sweep for both serving
+    modes and derive the win report: the concurrency levels (if any)
+    where the device-resident batched path beats the native per-read
+    path in aggregate needles/s, measured end-to-end on this rig."""
+    import asyncio
+
+    native = asyncio.run(
+        _serving_sweep_async(False, levels, reads_per_level)
+    )
+    resident = asyncio.run(
+        _serving_sweep_async(True, levels, reads_per_level)
+    )
+    wins = [
+        c
+        for c in native["reads_per_s"]
+        if resident["reads_per_s"][c] > native["reads_per_s"][c]
+    ]
+    return {
+        "needles": resident.get("needles"),
+        "reads_per_level": reads_per_level,
+        "native_reads_per_s": native["reads_per_s"],
+        "resident_reads_per_s": resident["reads_per_s"],
+        "native_p50_ms": native["p50_ms"],
+        "resident_p50_ms": resident["p50_ms"],
+        "device_wins_at_c": wins,
+        "device_wins": bool(wins),
+        "best_native_reads_per_s": max(native["reads_per_s"].values()),
+        "best_resident_reads_per_s": max(resident["reads_per_s"].values()),
+    }
 
 
 def probe_tpu(timeout_sec: int = 900) -> str | None:
@@ -588,7 +806,7 @@ def main():
         # record the honest state: the CPU baseline was measured, the
         # device could not be — and exit non-zero so the failure is
         # visible rather than masked by a strawman number
-        cpu_bps, cpu_diag = cpu_stats(nbytes, cpu_times_a, cpu_times_a)
+        cpu_bps, _, cpu_diag = cpu_stats(nbytes, cpu_times_a, [])
         print(
             json.dumps(
                 {
@@ -604,22 +822,55 @@ def main():
             )
         )
         sys.exit(1)
+    # persistent kernel-compile cache: the serving sweep hits many
+    # (count, fetch) shapes at 20-40s/compile on this tunneled rig;
+    # compiles are never inside a timed region, the cache just keeps the
+    # run length sane and mirrors the deployed -ec.deviceCacheMB path
+    from seaweedfs_tpu.ops.rs_resident import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_bench_compile_cache")
+    )
     enc, kernel = bench_device_encode(parity_m)
     rebuild_bps = bench_device_rebuild()
     multi_bps = bench_multi_volume()
     degraded = bench_degraded_read()
     resident = bench_degraded_read_resident()
+    serving = bench_serving_sweep()
+    disk_pre_mbps = bench_disk_ceiling()
     e2e_native, _ = bench_e2e_encode("native")
     # tunnel-bound: keep short; warm the batch-shape compile untimed
     e2e_device, dev_stats = bench_e2e_encode(kernel, mb=64, warm=True)
-    disk_mbps, h2d_mbps, d2h_mbps = bench_rig_bandwidths()
+    disk_post_mbps = bench_disk_ceiling()
+    h2d_mbps, d2h_mbps = bench_transfer_bandwidths()
 
     # second interleaved CPU group: the denominator measured again after
     # ~the whole run, so load drift is visible in cpu_group_medians_gbps
     _, cpu_times_b = bench_cpu_group(parity_m)
-    cpu_bps, cpu_diag = cpu_stats(nbytes, cpu_times_a, cpu_times_b)
+    cpu_bps, cpu_fast_bps, cpu_diag = cpu_stats(
+        nbytes, cpu_times_a, cpu_times_b
+    )
 
     dev_bps = enc["blockdiag_devtime"]
+    vs_baseline_conservative = round(dev_bps / cpu_fast_bps, 2)
+    # internal consistency: the durable e2e figure implies a shard-write
+    # rate (14 shards of input/10 each = 1.4x input bytes) that the disk
+    # ceiling measured THIS run must support (25% tolerance for window
+    # drift between probes)
+    implied_mbps = e2e_native * 1.4 / 1e6
+    ceiling = max(disk_pre_mbps, disk_post_mbps)
+    consistency = {
+        "durable_implied_shard_write_mbps": round(implied_mbps, 1),
+        "disk_ceiling_mbps_pre": round(disk_pre_mbps, 1),
+        "disk_ceiling_mbps_post": round(disk_post_mbps, 1),
+        "durable_within_ceiling": bool(implied_mbps <= ceiling * 1.25),
+        "vs_baseline_ok": bool(vs_baseline_conservative >= 8),
+    }
+    consistency["ok"] = bool(
+        consistency["durable_within_ceiling"]
+        and consistency["vs_baseline_ok"]
+    )
     print(
         json.dumps(
             {
@@ -628,6 +879,9 @@ def main():
                 "unit": "GB/s",
                 "vs_baseline": round(dev_bps / cpu_bps, 2),
                 "extra": {
+                    "vs_baseline_conservative": vs_baseline_conservative,
+                    "consistency": consistency,
+                    "serving": serving,
                     "cpu_native_gbps": round(cpu_bps / 1e9, 3),
                     **cpu_diag,
                     "encode_plain_device_gbps": round(
@@ -667,7 +921,7 @@ def main():
                     "degraded_p99_ms_device_resident_colocated_projection": round(
                         resident["projected_colocated"], 4
                     ),
-                    "disk_write_mbps": round(disk_mbps, 1),
+                    "disk_write_mbps": round(max(disk_pre_mbps, disk_post_mbps), 1),
                     "h2d_mbps": round(h2d_mbps, 1),
                     "d2h_mbps": round(d2h_mbps, 1),
                 },

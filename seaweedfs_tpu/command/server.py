@@ -51,6 +51,9 @@ async def run(args) -> None:
 
     jwt_key = config_util.jwt_signing_key()
     white_list = guard_mod.from_security_toml()
+    # every co-hosted role pushes the shared process registry under its
+    # own job name, as the reference's combined `weed server` does with
+    # its shared Gather — consumers aggregate with a job filter
     metrics_kw = common_args.metrics_kwargs(args)
     ms = MasterServer(
         ip=args.ip,

@@ -45,7 +45,13 @@ LANE = 128  # TPU lane tile: device slices start lane-aligned
 # to this and the <=1023-byte residual joins the host-trimmed delta.
 FUSED_ALIGN = 1024
 SIZE_BUCKETS = (2048, 8192, 32768, 131072, 524288, 2 * 1024 * 1024)
-COUNT_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+# a 256-wide bucket amortizes the per-call dispatch RTT over whole read
+# bursts on tunneled rigs (padding past the true count costs only device
+# compute: the in-jit [:n] trim keeps padded rows off the wire).  The
+# ladder jumps 64 -> 256 on purpose: every bucket is a compiled shape
+# warm() must pay 20-40s for, and a 65-request batch padded to 256 wastes
+# only microseconds of MXU time
+COUNT_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 256)
 MAX_TILE = SIZE_BUCKETS[-1]
 # split oversized intervals into chunks that fit the largest bucket even
 # after the <=FUSED_ALIGN-1 alignment residual
@@ -105,6 +111,19 @@ def _bucket(values: tuple[int, ...], need: int) -> int:
     raise ValueError(f"{need} exceeds largest bucket {values[-1]}")
 
 
+# bound per-call output (count * size bucket) so a wide batch of large
+# intervals can't balloon device/host buffers; small-needle batches (the
+# dominant serving shape) still ride the widest counts
+_MAX_CALL_OUT = 32 * 1024 * 1024
+# bound AGGREGATE un-fetched output across pipelined calls: each pending
+# call parks its [n, fetch] result in HBM until the fetch loop reaches it
+_MAX_PENDING_OUT = 128 * 1024 * 1024
+
+
+def _max_count(size_bucket: int) -> int:
+    return max(1, min(COUNT_BUCKETS[-1], _MAX_CALL_OUT // size_bucket))
+
+
 class DeviceShardCache:
     """LRU cache of EC shard bytes pinned in device memory.
 
@@ -121,6 +140,14 @@ class DeviceShardCache:
     ):
         self.budget = budget_bytes
         self.quantum = shard_quantum
+        # the (size, count) bucket shapes the store's pin thread
+        # pre-compiles after pinning a volume (warm()); deployments with
+        # a known workload shape can narrow these to cut mount-time
+        # compile cost (each shape is 20-40s on remote-compile rigs).
+        # 256 covers the widest burst bucket so a >64-read coalesce
+        # never hits a compile cliff on the serving path
+        self.warm_sizes: tuple[int, ...] = (4096, 65536, 1 << 20)
+        self.warm_counts: tuple[int, ...] = (1, 8, 64, 256)
         self._lock = threading.Lock()
         self._arrays: OrderedDict[tuple[int, int], object] = OrderedDict()
         self._true_sizes: dict[tuple[int, int], int] = {}
@@ -571,11 +598,38 @@ def reconstruct_intervals(
 
     subs = _plan(requests)
     sub_out: list[bytes | None] = [None] * len(subs)
+
+    # PIPELINE: dispatch device calls ahead of fetching results (jax
+    # dispatch is async — each call's H2D and compute start immediately).
+    # On tunneled rigs this overlaps the per-call dispatch RTT and D2H of
+    # call N with the compute of call N+1 instead of paying them serially
+    # per size bucket.  Aggregate un-fetched output is bounded: every
+    # pending call holds its [n, fetch] result in HBM, so a huge batch
+    # must drain the oldest call before dispatching more.
+    pending: list[tuple[list, object, int, list[int] | None]] = []
+    pending_bytes = 0
+
+    def _finish(entry) -> int:
+        part, arr, fetch, deltas = entry
+        out = np.asarray(arr).reshape(-1, fetch)
+        if deltas is not None:  # fused: host trims the alignment delta
+            for j, (sub_idx, (_, _, _, take, _)) in enumerate(part):
+                d = deltas[j]
+                sub_out[sub_idx] = out[j, d : d + take].tobytes()
+        else:  # XLA fallback: delta was shifted on device iff narrowed
+            bucket = part[0][1][4]
+            for j, (sub_idx, (_, _, delta, take, _)) in enumerate(part):
+                lo = 0 if fetch < bucket else delta
+                sub_out[sub_idx] = out[j, lo : lo + take].tobytes()
+        return len(part) * fetch
+
     for bucket in SIZE_BUCKETS:
         group = [(i, s) for i, s in enumerate(subs) if s[4] == bucket]
         if not group:
             continue
-        n_bucket = _bucket(COUNT_BUCKETS, min(len(group), COUNT_BUCKETS[-1]))
+        n_bucket = _bucket(
+            COUNT_BUCKETS, min(len(group), _max_count(bucket))
+        )
         for start in range(0, len(group), n_bucket):
             part = group[start : start + n_bucket]
             pad = n_bucket - len(part)
@@ -585,20 +639,17 @@ def reconstruct_intervals(
                 meta, deltas, fetch = _fused_vectors(
                     part, requests, row_of, pad
                 )
-                out = np.asarray(
-                    _fused_reconstruct(
-                        a_bm,
-                        survivors,
-                        meta,
-                        tile=_fused_tile_for(fetch),
-                        fetch=fetch,
-                        k_true=len(use),
-                        interpret=interpret,
-                    )
-                ).reshape(-1, fetch)
-                for j, (sub_idx, (_, _, _, take, _)) in enumerate(part):
-                    d = deltas[j]
-                    sub_out[sub_idx] = out[j, d : d + take].tobytes()
+                arr = _fused_reconstruct(
+                    a_bm,
+                    survivors,
+                    meta,
+                    tile=_fused_tile_for(fetch),
+                    fetch=fetch,
+                    k_true=len(use),
+                    interpret=interpret,
+                )
+                pending.append((part, arr, fetch, deltas))
+                pending_bytes += len(part) * fetch
             else:
                 offsets, rows, deltas = _group_vectors(
                     part, requests, row_of, pad
@@ -607,23 +658,24 @@ def reconstruct_intervals(
                 # request in this call, never wider than the compute tile
                 max_take = max(s[3] for _, s in part)
                 fetch = min(bucket, 1 << (max_take - 1).bit_length())
-                out = np.asarray(
-                    _gather_reconstruct(
-                        a_bm,
-                        survivors,
-                        offsets,
-                        rows,
-                        deltas,
-                        tile=bucket,
-                        fetch=fetch,
-                        kernel=kernel,
-                        interpret=interpret,
-                        k_true=len(use),
-                    )
-                ).reshape(-1, fetch)
-                for j, (sub_idx, (_, _, delta, take, _)) in enumerate(part):
-                    lo = 0 if fetch < bucket else delta
-                    sub_out[sub_idx] = out[j, lo : lo + take].tobytes()
+                arr = _gather_reconstruct(
+                    a_bm,
+                    survivors,
+                    offsets,
+                    rows,
+                    deltas,
+                    tile=bucket,
+                    fetch=fetch,
+                    kernel=kernel,
+                    interpret=interpret,
+                    k_true=len(use),
+                )
+                pending.append((part, arr, fetch, None))
+                pending_bytes += len(part) * fetch
+            while pending_bytes > _MAX_PENDING_OUT and len(pending) > 1:
+                pending_bytes -= _finish(pending.pop(0))
+    for entry in pending:
+        _finish(entry)
     outputs: list[list[bytes]] = [[] for _ in requests]
     for (idx, *_), piece in zip(subs, sub_out):
         outputs[idx].append(piece)  # subs are in offset order per request
@@ -692,6 +744,7 @@ def warm(
     counts: tuple[int, ...] = (1, 8, 64),  # single read, a batcher
     # coalesce round, and a full burst — the serving path's count shapes
     total_shards: int = TOTAL_SHARDS,
+    should_stop=None,  # callable -> bool: abort between compiles
     **kw,
 ) -> None:
     """Pre-compile the bucket combinations a serving path will hit, so the
@@ -715,5 +768,7 @@ def warm(
             # the next ladder step (usually the 3*2^(n-1) one, see
             # _fetch_cover) — each is its own compiled shape
             for off in (0, 1):
+                if should_stop is not None and should_stop():
+                    return
                 reqs = [(missing, off, size)] * count
                 reconstruct_intervals(cache, vid, reqs, **kw)

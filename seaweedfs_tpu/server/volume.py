@@ -123,32 +123,43 @@ class EcReadBatcher:
 
     Requests that arrive while a batch is being served queue up and are
     coalesced into the next batch, so a burst of concurrent degraded
-    reads becomes one device-resident reconstruct call per size bucket
+    reads becomes a few wide device-resident reconstruct calls
     (Store.read_ec_needles_batch -> EcVolume.read_needles_batch) instead
     of one per needle — the asyncio counterpart of the reference's
     per-needle goroutine fan-in (store_ec.go:339-393).  No timers: a lone
-    request is served immediately, so idle latency is unchanged."""
+    request is served immediately, so idle latency is unchanged.
 
-    def __init__(self, store, remote_reader_factory):
+    Up to `max_inflight` batches run concurrently: on tunneled devices a
+    batch's wall time is dominated by GIL-free dispatch RTT and D2H, so
+    overlapping batch N+1's device compute with batch N's transfers
+    raises aggregate throughput without changing per-batch behavior."""
+
+    def __init__(self, store, remote_reader_factory, max_inflight: int = 2):
         self.store = store
         self._remote_reader = remote_reader_factory
+        self.max_inflight = max(1, max_inflight)
         self._pending: list[tuple[int, int, int | None, asyncio.Future]] = []
-        self._draining = False
+        self._inflight = 0
 
     async def read(self, vid: int, nid: int, cookie: int | None):
         fut = asyncio.get_running_loop().create_future()
         self._pending.append((vid, nid, cookie, fut))
-        if not self._draining:
-            self._draining = True
-            asyncio.ensure_future(self._drain())
+        self._maybe_spawn()
         result = await fut
         if isinstance(result, Exception):
             raise result
         return result
 
+    def _maybe_spawn(self) -> None:
+        if self._pending and self._inflight < self.max_inflight:
+            self._inflight += 1
+            asyncio.ensure_future(self._drain())
+
     async def _drain(self) -> None:
         try:
             while self._pending:
+                # atomic swap (no await in between): concurrent drains
+                # never see the same request twice
                 batch, self._pending = self._pending, []
                 by_vid: dict[int, list] = {}
                 for vid, nid, cookie, fut in batch:
@@ -171,10 +182,8 @@ class EcReadBatcher:
                         else:
                             fut.set_result(r)
         finally:
-            self._draining = False
-            if self._pending:  # raced with an enqueue after the loop check
-                self._draining = True
-                asyncio.ensure_future(self._drain())
+            self._inflight -= 1
+            self._maybe_spawn()  # raced with an enqueue after the loop check
 
 
 class VolumeServer:
@@ -374,7 +383,10 @@ class VolumeServer:
             await self._grpc_server.stop(0.1)
         if self._http_runner:
             await self._http_runner.cleanup()
-        self.store.close()
+        # off the loop: close() joins pin/warm threads that may sit in a
+        # 20-40s jit compile — blocking here would freeze every other
+        # coroutine in the process (co-hosted servers, in-flight HTTP)
+        await asyncio.to_thread(self.store.close)
 
     # ------------------------------------------------------------------ heartbeat
 

@@ -137,6 +137,8 @@ def start_push_loop(
     /metrics locally is unaffected either way."""
     import asyncio
 
+    # interval 0 = pushing disabled even with an address, matching the
+    # reference's early return (metrics.go:264-266)
     if not address or interval_seconds == 0:
         return None
     if interval_seconds < 0:

@@ -173,11 +173,13 @@ class EcVolume:
             self.device_cache.evict(self.id, shard_id)
         return self.shards.pop(shard_id, None)
 
-    def load_shards_to_device(self, cache=None) -> int:
+    def load_shards_to_device(self, cache=None, should_stop=None) -> int:
         """Pin every locally mounted shard of this volume into the device
         cache (the resident-serving setup: done at mount time or on first
         degraded read, so reconstruction gathers from HBM instead of
-        re-shipping survivor bytes per call).  Returns shards pinned."""
+        re-shipping survivor bytes per call).  Returns shards pinned.
+        `should_stop` (callable -> bool) aborts between shards so a
+        closing server can join its pin thread promptly."""
         if cache is not None:
             self.device_cache = cache
         if self.device_cache is None:
@@ -185,6 +187,8 @@ class EcVolume:
         n = 0
         # snapshot: mount RPCs may add shards while a pin thread iterates
         for sid, shard in list(self.shards.items()):
+            if should_stop is not None and should_stop():
+                break
             if self.device_cache.get(self.id, sid) is None:
                 self.device_cache.put(
                     self.id, sid, np.fromfile(shard.path, dtype=np.uint8)

@@ -98,6 +98,10 @@ class Store:
         self.ec_device_cache = ec_device_cache
         self.volume_size_limit = 30 * 1024 * 1024 * 1024  # set by master pulse
         self._lock = threading.RLock()
+        # device-cache pin/warm threads: cancellable + joined on close so
+        # an exiting process never aborts inside a background jit compile
+        self._closing = threading.Event()
+        self._pin_threads: list[threading.Thread] = []
         # delta queues drained by the heartbeat loop (store.go:66-70)
         self.new_volumes: queue.SimpleQueue[VolumeMessage] = queue.SimpleQueue()
         self.deleted_volumes: queue.SimpleQueue[VolumeMessage] = queue.SimpleQueue()
@@ -457,21 +461,33 @@ class Store:
         store lock, the mount RPC, or server startup.  Until the thread
         finishes, degraded reads fall back to the host path (CacheMiss)."""
         cache = self.ec_device_cache
+        if self._closing.is_set():
+            return
 
         def pin():
             try:
-                ev.load_shards_to_device(cache)
+                ev.load_shards_to_device(
+                    cache, should_stop=self._closing.is_set
+                )
                 from ..ops import rs_resident
 
-                rs_resident.warm(cache, ev.id)
+                rs_resident.warm(
+                    cache, ev.id,
+                    sizes=cache.warm_sizes,
+                    counts=cache.warm_counts,
+                    should_stop=self._closing.is_set,
+                )
             except Exception:
                 logging.getLogger(__name__).exception(
                     "ec device-cache pinning failed for volume %d", ev.id
                 )
 
-        threading.Thread(
-            target=pin, name=f"ec-pin-{ev.id}", daemon=True
-        ).start()
+        # prune finished threads so mount/unmount churn over a long
+        # server lifetime doesn't accumulate dead Thread objects
+        self._pin_threads = [t for t in self._pin_threads if t.is_alive()]
+        t = threading.Thread(target=pin, name=f"ec-pin-{ev.id}", daemon=True)
+        self._pin_threads.append(t)
+        t.start()
 
     def _location_with_ec_files(self, vid: int, collection: str) -> DiskLocation | None:
         for loc in self.locations:
@@ -661,5 +677,12 @@ class Store:
         )
 
     def close(self) -> None:
+        # stop + join pin/warm threads FIRST: a daemon thread aborted by
+        # interpreter teardown mid-jit-compile takes the process down
+        # with SIGABRT ("terminate called ...")
+        self._closing.set()
+        for t in self._pin_threads:
+            t.join(timeout=60)
+        self._pin_threads.clear()
         for loc in self.locations:
             loc.close()
