@@ -69,6 +69,14 @@ consistency rework, VERDICT r4 Weak #2/#3):
                              co-located projection from profiler-measured
                              device time (no tunnel RTT/D2H)
   multi_volume_device_gbps   8 volumes' stripes batched into one call
+  scrub                      EC parity scrub of a mounted volume through
+                             the live VolumeEcShardsVerify RPC, CPU-file
+                             backend vs device-resident backend, timed
+                             client-side end-to-end.  Scrub computes
+                             ~1.4 bytes of GF(256) work per byte held
+                             and ships ~nothing, so it is the serving-
+                             family op the tunneled TPU wins outright
+                             on this rig (scrub.device_wins)
   serving                    HTTP degraded-read concurrency sweep through
                              the REAL volume server (bench_serving_sweep):
                              aggregate reads/s + p50 at c=1..256 for the
@@ -568,10 +576,114 @@ def bench_transfer_bandwidths(mb=64):
     return h2d / 1e6, d2h / 1e6
 
 
+async def build_degraded_cluster(
+    base_dir: str,
+    n_blobs: int = 64,
+    blob_size=None,  # callable i -> bytes length; default varies sizes
+    device_cache: bool = False,
+    cache_budget: int = 2 << 30,
+    warm_sizes: tuple | None = None,
+    warm_counts: tuple | None = None,
+    drop_shards: tuple = (0, 11),
+) -> tuple:
+    """THE canonical degrade choreography, shared by the benchmark and
+    tests/test_serving_e2e.py so the two can never drift: boot a
+    LocalCluster, fill ONE volume with blobs, EC-encode + mount it,
+    optionally pin the shards in the device cache (waiting out the pin
+    thread's warm compiles), then destroy `drop_shards` so every read
+    must reconstruct.  Returns (cluster, volume_server, blobs, vid)."""
+    import asyncio
+
+    from seaweedfs_tpu.operation import assign, upload_data
+    from seaweedfs_tpu.pb import Stub, channel, volume_server_pb2
+    from seaweedfs_tpu.server.cluster import LocalCluster
+    from seaweedfs_tpu.storage.ec.layout import TOTAL_SHARDS
+
+    cluster = LocalCluster(
+        base_dir=base_dir, n_volume_servers=1, pulse_seconds=1,
+        ec_backend="native",
+    )
+    await cluster.start()
+    vs = cluster.volume_servers[0]
+    if device_cache:
+        from seaweedfs_tpu.ops.rs_resident import DeviceShardCache
+
+        cache = DeviceShardCache(budget_bytes=cache_budget)
+        if warm_sizes is not None:
+            cache.warm_sizes = warm_sizes
+        if warm_counts is not None:
+            cache.warm_counts = warm_counts
+        vs.store.ec_device_cache = cache
+    master = cluster.master.advertise_url
+    rng = np.random.default_rng(17)
+    if blob_size is None:
+        blob_size = lambda i: 1500 + i * 613  # noqa: E731
+    blobs, vid = {}, None
+    for i in range(max(120, n_blobs * 12)):
+        if len(blobs) >= n_blobs:
+            break
+        a = await assign(master)
+        v = int(a.fid.split(",")[0])
+        if vid is None:
+            vid = v
+        if v != vid:  # assigns round-robin over several volumes
+            continue
+        data = rng.integers(
+            0, 256, blob_size(i), dtype=np.uint8
+        ).tobytes()
+        await upload_data(f"http://{a.url}/{a.fid}", data)
+        blobs[a.fid] = data
+    assert len(blobs) >= max(6, n_blobs // 2), "could not fill one volume"
+
+    stub = Stub(channel(vs.grpc_url), volume_server_pb2, "VolumeServer")
+    await stub.VolumeMarkReadonly(
+        volume_server_pb2.VolumeMarkReadonlyRequest(volume_id=vid)
+    )
+    await stub.VolumeEcShardsGenerate(
+        volume_server_pb2.VolumeEcShardsGenerateRequest(volume_id=vid)
+    )
+    await stub.VolumeEcShardsMount(
+        volume_server_pb2.VolumeEcShardsMountRequest(
+            volume_id=vid, shard_ids=list(range(TOTAL_SHARDS))
+        )
+    )
+    await stub.VolumeUnmount(
+        volume_server_pb2.VolumeUnmountRequest(volume_id=vid)
+    )
+    if device_cache:
+        deadline = time.time() + 600
+        cache = vs.store.ec_device_cache
+        while time.time() < deadline:
+            if len(cache.shard_ids(vid)) == TOTAL_SHARDS:
+                break
+            await asyncio.sleep(0.5)
+        assert len(cache.shard_ids(vid)) == TOTAL_SHARDS, "pin timeout"
+        # wait out the pin thread's warm compiles too: a compile racing
+        # a timed burst would serialize against its dispatches
+        await asyncio.to_thread(
+            lambda: [t.join(timeout=900) for t in vs.store._pin_threads]
+        )
+    # shard 0 holds every needle of a small volume (intervals start at
+    # offset 0), so dropping it forces every read to reconstruct;
+    # dropping a second shard leaves exactly 10 survivors
+    for sid in drop_shards:
+        await stub.VolumeEcShardsUnmount(
+            volume_server_pb2.VolumeEcShardsUnmountRequest(
+                volume_id=vid, shard_ids=[sid]
+            )
+        )
+        if device_cache:
+            vs.store.ec_device_cache.evict(vid, sid)
+        p = vs.store._ec_base(vid, "") + f".ec{sid:02d}"
+        if os.path.exists(p):
+            os.remove(p)
+    return cluster, vs, blobs, vid
+
+
 async def _serving_sweep_async(
     device: bool,
-    levels=(1, 4, 16, 64, 256),
-    reads_per_level=512,
+    levels=(1, 16, 64, 256),
+    reads_per_level=384,
     n_needles=64,
 ):
     """Aggregate degraded-read throughput through the REAL volume-server
@@ -585,90 +697,22 @@ async def _serving_sweep_async(
 
     import aiohttp
 
-    from seaweedfs_tpu.operation import assign, upload_data
-    from seaweedfs_tpu.pb import Stub, channel, volume_server_pb2
-    from seaweedfs_tpu.server.cluster import LocalCluster
-    from seaweedfs_tpu.storage.ec.layout import TOTAL_SHARDS
+    from seaweedfs_tpu.ops.rs_resident import COUNT_BUCKETS
 
     tmp = tempfile.mkdtemp(prefix="bench_serving_", dir=".")
-    cluster = LocalCluster(
-        base_dir=tmp, n_volume_servers=1, pulse_seconds=1,
-        ec_backend="native",
-    )
-    await cluster.start()
     out = {"reads_per_s": {}, "p50_ms": {}}
+    # 4KB needles only; warm EVERY count bucket — the batcher's widths
+    # are timing-dependent, so any bucket can appear mid-measurement and
+    # an unwarmed one would put a 20-40s compile inside a timed burst
+    cluster, vs, blobs, _vid = await build_degraded_cluster(
+        tmp,
+        n_blobs=n_needles,
+        blob_size=lambda i: 4096,
+        device_cache=device,
+        warm_sizes=(4096,),
+        warm_counts=COUNT_BUCKETS,
+    )
     try:
-        vs = cluster.volume_servers[0]
-        if device:
-            from seaweedfs_tpu.ops.rs_resident import DeviceShardCache
-
-            cache = DeviceShardCache(budget_bytes=2 << 30)
-            # the sweep serves only 4KB needles: narrow the mount-time
-            # warm plan to its shapes (incl. the widest count bucket) so
-            # the pin thread pre-compiles exactly what the timed bursts
-            # hit — and nothing competes with them for the compiler
-            cache.warm_sizes = (4096,)
-            cache.warm_counts = (1, 8, 64, 256)
-            vs.store.ec_device_cache = cache
-        master = cluster.master.advertise_url
-        rng = np.random.default_rng(17)
-        blobs, vid = {}, None
-        for _ in range(n_needles * 12):
-            if len(blobs) >= n_needles:
-                break
-            a = await assign(master)
-            v = int(a.fid.split(",")[0])
-            if vid is None:
-                vid = v
-            if v != vid:  # assigns round-robin over several volumes
-                continue
-            data = rng.integers(0, 256, 4096, dtype=np.uint8).tobytes()
-            await upload_data(f"http://{a.url}/{a.fid}", data)
-            blobs[a.fid] = data
-        assert len(blobs) >= n_needles // 2, "could not fill one volume"
-
-        stub = Stub(channel(vs.grpc_url), volume_server_pb2, "VolumeServer")
-        await stub.VolumeMarkReadonly(
-            volume_server_pb2.VolumeMarkReadonlyRequest(volume_id=vid)
-        )
-        await stub.VolumeEcShardsGenerate(
-            volume_server_pb2.VolumeEcShardsGenerateRequest(volume_id=vid)
-        )
-        await stub.VolumeEcShardsMount(
-            volume_server_pb2.VolumeEcShardsMountRequest(
-                volume_id=vid, shard_ids=list(range(TOTAL_SHARDS))
-            )
-        )
-        await stub.VolumeUnmount(
-            volume_server_pb2.VolumeUnmountRequest(volume_id=vid)
-        )
-        if device:
-            deadline = time.time() + 600
-            cache = vs.store.ec_device_cache
-            while time.time() < deadline:
-                if len(cache.shard_ids(vid)) == TOTAL_SHARDS:
-                    break
-                await asyncio.sleep(0.5)
-            assert len(cache.shard_ids(vid)) == TOTAL_SHARDS, "pin timeout"
-            # wait out the pin thread's warm compiles too: a compile
-            # racing a timed burst would serialize against its dispatches
-            await asyncio.to_thread(
-                lambda: [t.join(timeout=900) for t in vs.store._pin_threads]
-            )
-        # shard 0 holds every needle of a small volume; dropping it (and
-        # 11) forces every read to reconstruct from exactly 10 survivors
-        for sid in (0, 11):
-            await stub.VolumeEcShardsUnmount(
-                volume_server_pb2.VolumeEcShardsUnmountRequest(
-                    volume_id=vid, shard_ids=[sid]
-                )
-            )
-            if device:
-                vs.store.ec_device_cache.evict(vid, sid)
-            p = vs.store._ec_base(vid, "") + f".ec{sid:02d}"
-            if os.path.exists(p):
-                os.remove(p)
-
         fids = list(blobs)
         async with aiohttp.ClientSession() as sess:
 
@@ -721,7 +765,116 @@ async def _serving_sweep_async(
     return out
 
 
-def bench_serving_sweep(levels=(1, 4, 16, 64, 256), reads_per_level=512):
+async def _scrub_bench_async(mb=768, reps=3):
+    """EC parity scrub through the live volume-server RPC
+    (VolumeEcShardsVerify), CPU-file backend vs device-resident backend,
+    timed CLIENT-side — a measured end-to-end serving-family number on
+    this rig.  Scrub moves ~zero payload (offsets up, a [4] mismatch
+    vector down) while computing ~1.4 bytes of GF(256) work per byte
+    held, so it is the op where the tunneled TPU beats the local CPU
+    outright rather than by projection."""
+    import asyncio
+
+    from seaweedfs_tpu.pb import Stub, channel, volume_server_pb2
+    from seaweedfs_tpu.server.volume import VolumeServer
+    from seaweedfs_tpu.storage.ec import encoder as ec_encoder
+    from seaweedfs_tpu.storage.volume_info import save_volume_info
+
+    tmp = tempfile.mkdtemp(prefix="bench_scrub_", dir=".")
+    base = os.path.join(tmp, "1")
+    rng = np.random.default_rng(23)
+    with open(base + ".dat", "wb") as f:
+        remaining = mb << 20
+        while remaining > 0:
+            n = min(64 << 20, remaining)
+            f.write(rng.integers(0, 256, n, dtype=np.uint8).tobytes())
+            remaining -= n
+    ec_encoder.write_ec_files(base, backend="native")
+    save_volume_info(base + ".vif", {"version": 3})
+    open(base + ".ecx", "wb").close()
+    open(base + ".ecj", "wb").close()
+    os.remove(base + ".dat")
+
+    out = {"volume_mb": mb}
+
+    async def timed_scrub(vs, reps, warm=False):
+        stub = Stub(channel(vs.grpc_url), volume_server_pb2, "VolumeServer")
+        if warm:  # untimed: the device path's one-off jit compile
+            await stub.VolumeEcShardsVerify(
+                volume_server_pb2.VolumeEcShardsVerifyRequest(volume_id=1)
+            )
+        times, backend = [], ""
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            r = await stub.VolumeEcShardsVerify(
+                volume_server_pb2.VolumeEcShardsVerifyRequest(volume_id=1)
+            )
+            times.append(time.perf_counter() - t0)
+            backend = r.backend
+            assert list(r.parity_mismatch_bytes) == [0, 0, 0, 0]
+        return float(np.median(times)), backend, r.bytes_verified
+
+    try:
+        # CPU-file pass
+        vs = VolumeServer(masters=[], directories=[tmp], port=0, grpc_port=0,
+                          ec_backend="native")
+        await vs.start(heartbeat=False)
+        try:
+            s, backend, span = await timed_scrub(vs, reps)
+            out["native_s"] = round(s, 3)
+            out["native_backend"] = backend
+            out["input_bytes"] = int(span) * 10
+        finally:
+            await vs.stop()
+
+        # device-resident pass: pin manually so the warm plan can be
+        # narrowed to nothing (scrub needs no reconstruct-shape compiles)
+        from seaweedfs_tpu.ops.rs_resident import DeviceShardCache
+
+        vs = VolumeServer(masters=[], directories=[tmp], port=0, grpc_port=0,
+                          ec_backend="native")
+        cache = DeviceShardCache(budget_bytes=4 << 30)
+        cache.warm_sizes = ()
+        vs.store.ec_device_cache = cache
+        ev = vs.store.find_ec_volume(1)
+        vs.store._pin_ec_shards_async(ev)
+        await vs.start(heartbeat=False)
+        try:
+            deadline = time.time() + 900
+            while time.time() < deadline:
+                if len(cache.shard_ids(1)) == 14:
+                    break
+                await asyncio.sleep(0.5)
+            assert len(cache.shard_ids(1)) == 14, "scrub pin timeout"
+            await asyncio.to_thread(
+                lambda: [t.join(timeout=900) for t in vs.store._pin_threads]
+            )
+            s, backend, _ = await timed_scrub(vs, reps, warm=True)
+            out["device_s"] = round(s, 3)
+            out["device_backend"] = backend
+        finally:
+            await vs.stop()
+    finally:
+        from seaweedfs_tpu.pb.rpc import close_all_channels
+
+        await close_all_channels()
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    out["native_gbps"] = round(out["input_bytes"] / out["native_s"] / 1e9, 3)
+    out["device_gbps"] = round(out["input_bytes"] / out["device_s"] / 1e9, 3)
+    out["device_speedup"] = round(out["native_s"] / out["device_s"], 2)
+    out["device_wins"] = bool(out["device_s"] < out["native_s"])
+    return out
+
+
+def bench_scrub(mb=768, reps=3):
+    import asyncio
+
+    return asyncio.run(_scrub_bench_async(mb=mb, reps=reps))
+
+
+def bench_serving_sweep(levels=(1, 16, 64, 256), reads_per_level=384):
     """Run the HTTP degraded-read concurrency sweep for both serving
     modes and derive the win report: the concurrency levels (if any)
     where the device-resident batched path beats the native per-read
@@ -838,10 +991,16 @@ def main():
     degraded = bench_degraded_read()
     resident = bench_degraded_read_resident()
     serving = bench_serving_sweep()
+    scrub = bench_scrub()
     disk_pre_mbps = bench_disk_ceiling()
     e2e_native, _ = bench_e2e_encode("native")
     # tunnel-bound: keep short; warm the batch-shape compile untimed
     e2e_device, dev_stats = bench_e2e_encode(kernel, mb=64, warm=True)
+    # volume-scale leg (VERDICT r4 #3): a full-GB device-backend encode,
+    # so the overlap/staging claims carry a number measured at the size
+    # class real volumes live in (tests/test_volume_scale_encode.py
+    # proves the 11GB layout; this measures the device pipeline at 1GB)
+    e2e_device_1g, dev1g_stats = bench_e2e_encode(kernel, mb=1024, warm=True)
     disk_post_mbps = bench_disk_ceiling()
     h2d_mbps, d2h_mbps = bench_transfer_bandwidths()
 
@@ -882,6 +1041,7 @@ def main():
                     "vs_baseline_conservative": vs_baseline_conservative,
                     "consistency": consistency,
                     "serving": serving,
+                    "scrub": scrub,
                     "cpu_native_gbps": round(cpu_bps / 1e9, 3),
                     **cpu_diag,
                     "encode_plain_device_gbps": round(
@@ -901,6 +1061,16 @@ def main():
                     "encode_e2e_device_stage_s": {
                         k: round(v, 3) if isinstance(v, float) else v
                         for k, v in dev_stats.items()
+                    },
+                    "encode_e2e_device_1g_gbps_durable": round(
+                        e2e_device_1g / 1e9, 3
+                    ),
+                    "encode_e2e_device_1g_overlap_fraction": round(
+                        overlap_fraction(dev1g_stats), 3
+                    ),
+                    "encode_e2e_device_1g_stage_s": {
+                        k: round(v, 3) if isinstance(v, float) else v
+                        for k, v in dev1g_stats.items()
                     },
                     "degraded_p99_ms_native": round(degraded["native"], 3),
                     "degraded_p99_ms_device_single": round(

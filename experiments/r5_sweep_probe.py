@@ -1,0 +1,48 @@
+"""Round-5 probe: the HTTP degraded-read concurrency sweep on the REAL
+TPU — the measurement VERDICT r4 item #1 asks bench.py to publish.
+Runs bench._serving_sweep_async for both modes at reduced read counts
+and prints the comparison, so serving-path tuning can iterate without
+paying a full bench run each time.
+
+Usage: PYTHONPATH=/root/.axon_site:/root/repo python experiments/r5_sweep_probe.py
+"""
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+async def main():
+    import bench
+    from seaweedfs_tpu.ops import rs_tpu
+    from seaweedfs_tpu.ops.rs_resident import enable_persistent_compile_cache
+
+    assert rs_tpu.on_tpu(), "probe needs the real TPU"
+    enable_persistent_compile_cache(
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     ".jax_bench_compile_cache")
+    )
+    levels = (1, 16, 64, 256)
+    reads = 384
+    t0 = time.time()
+    native = await bench._serving_sweep_async(False, levels, reads)
+    t1 = time.time()
+    resident = await bench._serving_sweep_async(True, levels, reads)
+    t2 = time.time()
+    out = {
+        "native": native,
+        "resident": resident,
+        "native_wall_s": round(t1 - t0, 1),
+        "resident_wall_s": round(t2 - t1, 1),
+        "wins": [
+            c for c in native["reads_per_s"]
+            if resident["reads_per_s"][c] > native["reads_per_s"][c]
+        ],
+    }
+    print(json.dumps(out, indent=1))
+
+
+asyncio.run(main())

@@ -1522,6 +1522,30 @@ class VolumeServer:
             pass
         return volume_server_pb2.VolumeEcBlobDeleteResponse()
 
+    async def VolumeEcShardsVerify(self, request, context):
+        """Parity scrub of a mounted EC volume (device-resident when the
+        shard cache holds the whole volume, else the CPU kernel over the
+        shard files) — the repair-loop verify pass as a first-class RPC."""
+        try:
+            result = await asyncio.to_thread(
+                self.store.scrub_ec_volume, request.volume_id
+            )
+        except NotFoundError as e:
+            await context.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        except FileNotFoundError as e:
+            # degraded volume (missing shard files) and not fully
+            # resident: scrub needs all 14 inputs — tell the caller
+            # cleanly instead of an UNKNOWN traceback
+            await context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION, str(e)
+            )
+        return volume_server_pb2.VolumeEcShardsVerifyResponse(
+            parity_mismatch_bytes=result["parity_mismatch_bytes"],
+            backend=result["backend"],
+            seconds=result["seconds"],
+            bytes_verified=result["bytes_verified"],
+        )
+
     async def VolumeEcShardsToVolume(self, request, context):
         """Decode EC shards back into a normal .dat/.idx volume
         (volume_grpc_erasure_coding.go:407-446)."""

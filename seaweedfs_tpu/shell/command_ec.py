@@ -225,6 +225,47 @@ async def collect_ec_volume_shards(env) -> dict[int, dict[int, TopoNode]]:
     return out
 
 
+@command("ec.scrub")
+async def cmd_ec_scrub(env, args):
+    """[-volumeId <id>] : verify parity consistency of mounted EC volumes
+    (VolumeEcShardsVerify).  Runs on nodes holding all 14 shards of a
+    volume — device-resident (HBM) when the volume is pinned, else the
+    CPU kernel over the shard files; spread volumes are reported skipped."""
+    flags = parse_flags(args)
+    target = int(flags.get("volumeId", 0) or 0)
+    shard_map = await collect_ec_volume_shards(env)
+    for vid, shards in sorted(shard_map.items()):
+        if target and vid != target:
+            continue
+        holders: dict[str, set[int]] = {}
+        for sid, node in shards.items():
+            holders.setdefault(node.grpc_address, set()).add(sid)
+        full = [a for a, sids in holders.items() if len(sids) == TOTAL_SHARDS]
+        if not full:
+            env.write(
+                f"ec volume {vid}: shards spread over {len(holders)} "
+                f"node(s), none holds all {TOTAL_SHARDS} — skipped"
+            )
+            continue
+        stub = env.volume_stub(full[0])
+        r = await stub.VolumeEcShardsVerify(
+            volume_server_pb2.VolumeEcShardsVerifyRequest(volume_id=vid)
+        )
+        bad = sum(r.parity_mismatch_bytes)
+        mb = r.bytes_verified * TOTAL_SHARDS / 1e6
+        rate = (
+            r.bytes_verified * 10 / r.seconds / 1e9 if r.seconds else 0.0
+        )
+        status = (
+            "OK" if bad == 0
+            else f"CORRUPT: {list(r.parity_mismatch_bytes)} mismatch bytes"
+        )
+        env.write(
+            f"ec volume {vid}: {status} backend={r.backend} "
+            f"{mb:.0f}MB in {r.seconds:.2f}s ({rate:.2f} GB/s)"
+        )
+
+
 @command("ec.rebuild")
 async def cmd_ec_rebuild(env, args):
     """[-force] : rebuild missing EC shards onto a rebuilder node

@@ -214,15 +214,25 @@ def write_ec_files(
     clock = time.perf_counter
     t_start = clock()
 
+    def write_or_seek(fobj, row: np.ndarray) -> None:
+        # sparse-aware: an all-zero chunk becomes a hole (seek) instead
+        # of written zeros — byte-identical on read (holes read as
+        # zeros), but a mostly-empty volume encodes without materializing
+        # terabytes of zero blocks.  Final sizes are fixed by ftruncate.
+        if row.any():
+            fobj.write(row.tobytes())
+        else:
+            fobj.seek(len(row), os.SEEK_CUR)
+
     def drain_one():
         data, handle = inflight.popleft()
         t0 = clock()
         parity = codec.resolve(handle)
         t1 = clock()
         for i in range(DATA_SHARDS):
-            outputs[i].write(data[i].tobytes())
+            write_or_seek(outputs[i], data[i])
         for i in range(codec.rows):
-            outputs[DATA_SHARDS + i].write(parity[i].tobytes())
+            write_or_seek(outputs[DATA_SHARDS + i], parity[i])
         t["wait_s"] += t1 - t0
         t["write_s"] += clock() - t1
 
@@ -244,6 +254,11 @@ def write_ec_files(
                         drain_one()
         while inflight:
             drain_one()
+        for o in outputs:
+            # materialize trailing holes left by write_or_seek: the
+            # shard file's SIZE must match the layout math even when its
+            # tail is all zeros
+            o.truncate(o.tell())
         if fsync:
             # separate clock: the final fsync follows the LAST write by
             # definition, so it can never overlap the device leg — it is
@@ -317,6 +332,60 @@ def rebuild_ec_files(
         for h in list(inputs.values()) + list(outputs.values()):
             h.close()
     return missing
+
+
+def verify_ec_files(
+    base_name: str,
+    backend: str = "cpu",
+    stride: int = DEFAULT_STRIDE,
+) -> tuple[list[int], int]:
+    """Parity scrub over the shard FILES: recompute parity from the data
+    shards chunk by chunk and count mismatching bytes per parity shard.
+    -> ([mismatches per parity shard], bytes verified per shard).  The
+    CPU counterpart of the device-resident scrub
+    (ops/rs_resident.scrub_volume); repair loops run whichever the
+    store's cache state supports (reference analogue: the read-verify
+    passes of volume.fsck / ec.rebuild)."""
+    paths = [base_name + to_ext(i) for i in range(TOTAL_SHARDS)]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        raise FileNotFoundError(f"scrub needs all shards: missing {missing}")
+    shard_size = os.path.getsize(paths[0])
+    codec = _Codec(rs.RSCodec().matrix[DATA_SHARDS:], backend)
+    mism = np.zeros(TOTAL_SHARDS - DATA_SHARDS, dtype=np.int64)
+    handles = [open(p, "rb") for p in paths]
+    inflight: deque[tuple[object, np.ndarray]] = deque()
+
+    def drain_one():
+        handle, parity_disk = inflight.popleft()
+        parity = codec.resolve(handle)
+        np.add(
+            mism,
+            (parity != parity_disk).sum(axis=1),
+            out=mism,
+        )
+
+    try:
+        for off in range(0, shard_size, stride):
+            n = min(stride, shard_size - off)
+            data = np.zeros((DATA_SHARDS, n), dtype=np.uint8)
+            parity_disk = np.zeros((TOTAL_SHARDS - DATA_SHARDS, n), np.uint8)
+            for i in range(DATA_SHARDS):
+                buf = os.pread(handles[i].fileno(), n, off)
+                data[i, : len(buf)] = np.frombuffer(buf, dtype=np.uint8)
+            for j in range(TOTAL_SHARDS - DATA_SHARDS):
+                buf = os.pread(handles[DATA_SHARDS + j].fileno(), n, off)
+                parity_disk[j, : len(buf)] = np.frombuffer(buf, np.uint8)
+            inflight.append((codec.submit(data), parity_disk))
+            if len(inflight) >= _PIPELINE_DEPTH:
+                drain_one()
+        while inflight:
+            drain_one()
+    finally:
+        codec.shutdown()
+        for h in handles:
+            h.close()
+    return [int(v) for v in mism], shard_size
 
 
 def write_sorted_file_from_idx(base_name: str, ext: str = ".ecx") -> None:

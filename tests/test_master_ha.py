@@ -29,12 +29,18 @@ def free_ports(n):
 
 
 async def make_masters(tmp_path, n=3):
-    ports = free_ports(n)
-    urls = [f"127.0.0.1:{p}" for p in ports]
+    # explicit dynamically-allocated grpc ports (host:port.grpc peer
+    # form): the p+10000 convention collides with unrelated listeners on
+    # busy hosts and was a recorded flake source
+    ports = free_ports(2 * n)
+    http_ports, grpc_ports = ports[:n], ports[n:]
+    urls = [
+        f"127.0.0.1:{p}.{g}" for p, g in zip(http_ports, grpc_ports)
+    ]
     masters = []
-    for i, p in enumerate(ports):
+    for i, (p, g) in enumerate(zip(http_ports, grpc_ports)):
         m = MasterServer(
-            port=p, grpc_port=p + 10000, peers=list(urls),
+            port=p, grpc_port=g, peers=list(urls),
             meta_dir=str(tmp_path / f"m{i}"), pulse_seconds=1,
             volume_size_limit_mb=64,
         )
@@ -188,12 +194,12 @@ def test_master_snapshot_restart(tmp_path):
     full log replay."""
 
     async def go():
-        (port,) = free_ports(1)
-        url = f"127.0.0.1:{port}"
+        port, gport = free_ports(2)
+        url = f"127.0.0.1:{port}.{gport}"
 
         def make():
             return MasterServer(
-                port=port, grpc_port=port + 10000, peers=[url],
+                port=port, grpc_port=gport, peers=[url],
                 meta_dir=str(tmp_path / "m"), pulse_seconds=1,
                 volume_size_limit_mb=64, raft_snapshot_threshold=25,
             )

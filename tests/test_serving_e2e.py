@@ -14,96 +14,36 @@ same path on the real TPU and publishes the measured numbers.
 Reference path being matched: weed/storage/store_ec.go:136-393.
 """
 import asyncio
-import os
-import tempfile
-import time
 
 import aiohttp
-import numpy as np
 import pytest
-
-from seaweedfs_tpu.operation import assign, upload_data
-from seaweedfs_tpu.pb import Stub, channel, volume_server_pb2
-from seaweedfs_tpu.server.cluster import LocalCluster
-from seaweedfs_tpu.storage.ec.layout import TOTAL_SHARDS
 
 
 def run(coro):
     return asyncio.run(coro)
 
 
-async def _build_degraded_cluster(tmp_path, n_blobs=10, device_cache=True):
-    """Cluster with one volume EC-encoded, mounted, and two shards
-    destroyed; returns (cluster, vs, blobs dict fid->bytes)."""
-    cluster = LocalCluster(
-        base_dir=str(tmp_path), n_volume_servers=1, pulse_seconds=1,
-    )
-    await cluster.start()
-    vs = cluster.volume_servers[0]
-    if device_cache:
-        from seaweedfs_tpu.ops.rs_resident import DeviceShardCache
+async def _build_degraded_cluster(
+    tmp_path, n_blobs=10, device_cache=True, drop_shards=(0, 11)
+):
+    """Cluster with one volume EC-encoded, mounted, and `drop_shards`
+    destroyed; returns (cluster, vs, blobs dict fid->bytes).  Thin CI
+    wrapper over bench.build_degraded_cluster — ONE implementation of
+    the degrade choreography shared with the benchmark, so the measured
+    path and the tested path cannot drift."""
+    from bench import build_degraded_cluster
 
-        vs.store.ec_device_cache = DeviceShardCache(budget_bytes=1 << 30)
-
-    master = cluster.master.advertise_url
-    rng = np.random.default_rng(11)
-    blobs = {}
-    vid = None
-    for i in range(120):
-        if len(blobs) >= n_blobs:
-            break
-        a = await assign(master)
-        v = int(a.fid.split(",")[0])
-        if vid is None:
-            vid = v
-        if v != vid:  # assigns round-robin over several volumes
-            continue
-        data = rng.integers(0, 256, 1500 + i * 613, dtype=np.uint8).tobytes()
-        await upload_data(f"http://{a.url}/{a.fid}", data)
-        blobs[a.fid] = data
-    assert len(blobs) >= max(6, n_blobs // 2)
-
-    stub = Stub(channel(vs.grpc_url), volume_server_pb2, "VolumeServer")
-    await stub.VolumeMarkReadonly(
-        volume_server_pb2.VolumeMarkReadonlyRequest(volume_id=vid)
+    cluster, vs, blobs, _vid = await build_degraded_cluster(
+        str(tmp_path),
+        n_blobs=n_blobs,
+        device_cache=device_cache,
+        cache_budget=1 << 30,
+        # no pre-warm in CI: the XLA-fallback kernels compile in
+        # milliseconds at first use, and the full warm plan (every count
+        # bucket x size) would dominate the test's runtime
+        warm_sizes=(),
+        drop_shards=drop_shards,
     )
-    await stub.VolumeEcShardsGenerate(
-        volume_server_pb2.VolumeEcShardsGenerateRequest(volume_id=vid)
-    )
-    await stub.VolumeEcShardsMount(
-        volume_server_pb2.VolumeEcShardsMountRequest(
-            volume_id=vid, shard_ids=list(range(TOTAL_SHARDS))
-        )
-    )
-    await stub.VolumeUnmount(
-        volume_server_pb2.VolumeUnmountRequest(volume_id=vid)
-    )
-    if device_cache:
-        # wait for the async HBM pin + warm thread
-        deadline = time.time() + 120
-        while time.time() < deadline:
-            if len(vs.store.ec_device_cache.shard_ids(vid)) == TOTAL_SHARDS:
-                break
-            await asyncio.sleep(0.1)
-        assert (
-            len(vs.store.ec_device_cache.shard_ids(vid)) == TOTAL_SHARDS
-        ), "shards never became resident"
-
-    # force DEGRADED reads: shard 0 holds every needle of a small volume
-    # (intervals start at offset 0), so removing it makes every read
-    # reconstruct; removing shard 11 too drops redundancy to exactly 10.
-    for sid in (0, 11):
-        await stub.VolumeEcShardsUnmount(
-            volume_server_pb2.VolumeEcShardsUnmountRequest(
-                volume_id=vid, shard_ids=[sid]
-            )
-        )
-        if device_cache:
-            vs.store.ec_device_cache.evict(vid, sid)
-        base = vs.store._ec_base(vid, "")
-        p = base + f".ec{sid:02d}"
-        if os.path.exists(p):
-            os.remove(p)
     return cluster, vs, blobs
 
 
