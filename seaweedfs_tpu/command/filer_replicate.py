@@ -70,11 +70,16 @@ async def _consume_mq(args, sink, is_transient) -> tuple[int, int]:
     topic = MqClient.topic(args.mq_topic)
     group = "replicate"
 
-    # partition layout (and owning brokers, for multi-broker clusters)
+    # partition layout (and owning brokers, for multi-broker clusters);
+    # bounded like lookup_owner below — a half-dead broker must surface
+    # as a retry, not an output-less hang before any consumer spawns
     while True:
         try:
-            resp = await client._stub().LookupTopicBrokers(
-                mq_pb2.LookupTopicBrokersRequest(topic=topic)
+            resp = await asyncio.wait_for(
+                client._stub().LookupTopicBrokers(
+                    mq_pb2.LookupTopicBrokersRequest(topic=topic)
+                ),
+                timeout=3.0,
             )
             break
         except Exception as e:  # noqa: BLE001 — broker not up yet
@@ -97,8 +102,13 @@ async def _consume_mq(args, sink, is_transient) -> tuple[int, int]:
         ):
             try:
                 c = MqClient(cand)
-                r = await c._stub().LookupTopicBrokers(
-                    mq_pb2.LookupTopicBrokersRequest(topic=topic)
+                # bounded: a half-dead candidate must cost seconds, not
+                # stall the partition's resume loop indefinitely
+                r = await asyncio.wait_for(
+                    c._stub().LookupTopicBrokers(
+                        mq_pb2.LookupTopicBrokersRequest(topic=topic)
+                    ),
+                    timeout=3.0,
                 )
                 owners = list(r.partition_brokers)
                 if owners:
@@ -110,13 +120,14 @@ async def _consume_mq(args, sink, is_transient) -> tuple[int, int]:
 
     async def consume_partition(idx: int, addr: str) -> None:
         pc = MqClient(addr)
+        start = -1  # committed, else earliest
         while True:
             try:
                 async for offset, key, value in pc.subscribe(
                     topic,
                     idx,
                     consumer_group=group,
-                    start_offset=-1,  # committed, else earliest
+                    start_offset=start,
                     tail=args.follow,
                 ):
                     note = filer_pb2.EventNotification.FromString(value)
@@ -135,6 +146,10 @@ async def _consume_mq(args, sink, is_transient) -> tuple[int, int]:
                         print(f"skip poison event {key}: {e}")
                         counts["skipped"] += 1
                     await pc.commit(topic, idx, group, offset + 1)
+                    # only a COMMIT against THIS owner's numbering makes
+                    # resuming at the committed offset safe again; a
+                    # reconnect before any commit must replay from 0
+                    start = -1
                 if not args.follow:
                     return
             except Exception as e:  # noqa: BLE001 — stream broke (broker
@@ -152,6 +167,14 @@ async def _consume_mq(args, sink, is_transient) -> tuple[int, int]:
                     print(f"partition {idx}: owner moved to {new_addr}")
                     addr = new_addr
                     pc = MqClient(addr)
+                    # a NEW owner's log is a different numbering space:
+                    # an offset committed against the old owner can point
+                    # PAST events the new owner holds, silently skipping
+                    # them.  Replay from the earliest record instead —
+                    # the sink applies meta events idempotently, so
+                    # duplicates are absorbed and nothing is skipped
+                    # (at-least-once across failover).
+                    start = 0
 
     await asyncio.gather(
         *(

@@ -140,14 +140,20 @@ class ClusterBalancer:
 
     async def refresh(self) -> list[str]:
         """Re-read the registry (first reachable master wins); always
-        falls back to the last snapshot, never to an empty list."""
+        falls back to the last snapshot, never to an empty list.  Each
+        master gets a bounded wait: refresh() sits on serving paths
+        (partition activation), where a silently-hung RPC would stall
+        every publish/subscribe on the partition."""
         from ..pb import master_pb2 as mpb
         from ..pb import server_address
 
         for addr in self.masters:
             try:
-                resp = await self._master_stub(addr).ListClusterNodes(
-                    mpb.ListClusterNodesRequest(client_type="broker")
+                resp = await asyncio.wait_for(
+                    self._master_stub(addr).ListClusterNodes(
+                        mpb.ListClusterNodesRequest(client_type="broker")
+                    ),
+                    timeout=5.0,
                 )
             except Exception:  # noqa: BLE001 — try the next master
                 self._stubs.pop(addr, None)

@@ -85,9 +85,15 @@ class MqNotifier(Notifier):
         self._buf: deque[tuple[bytes, bytes]] = deque()
         self._configured = False
         self._task: asyncio.Task | None = None
+        self._draining = False
         self._closing = False
 
     async def publish(self, key, notification) -> None:
+        if key.startswith("/topics/"):
+            # the MQ spools its partition logs through the SAME filer:
+            # publishing those mutations back into the MQ would be a
+            # feedback loop (every flush begets an event begets a flush)
+            return
         self._buf.append((key.encode(), notification.SerializeToString()))
         over = len(self._buf) - self.max_buffer
         if over > 0:
@@ -98,7 +104,17 @@ class MqNotifier(Notifier):
                 "mq notifier buffer overflow: %d events dropped total",
                 self.dropped,
             )
-        if self._task is None or self._task.done():
+        self._maybe_spawn()
+
+    def _maybe_spawn(self) -> None:
+        """Race-free drain spawn: a publish landing while the previous
+        drain is EXITING (it saw an empty buffer, but is not yet done())
+        must still get a drainer, or the event sits silently until the
+        next publish.  The flag flips in _drain's finally with no await
+        in between, so on this single loop exactly one drainer runs and
+        no buffered event is ever left without one."""
+        if self._buf and not self._closing and not self._draining:
+            self._draining = True
             self._task = asyncio.ensure_future(self._drain())
 
     async def _publish_batch(self) -> None:
@@ -126,25 +142,40 @@ class MqNotifier(Notifier):
             self._buf.extendleft(reversed(batch))
             raise
 
+    # bound any silently-hung RPC (half-dead channel, stalled handler):
+    # a timeout surfaces as a retry with rotation instead of an unbounded
+    # stall that drains nothing and logs nothing
+    _PUBLISH_TIMEOUT = 10.0
+
     async def _drain(self) -> None:
         backoff = 0.5
-        while self._buf and not self._closing:
-            try:
-                await self._publish_batch()
-                backoff = 0.5
-            except Exception as e:  # noqa: BLE001 — broker down: retry
-                log.warning("mq notify publish failed (will retry): %s", e)
-                self.client.reset()
-                if len(self._addrs) > 1:
-                    # rotate bootstrap brokers (kafka bootstrap-list
-                    # semantics): a dead bootstrap must not stall events
-                    # while other brokers live
-                    from ..mq.client import MqClient
+        try:
+            while self._buf and not self._closing:
+                try:
+                    await asyncio.wait_for(
+                        self._publish_batch(), self._PUBLISH_TIMEOUT
+                    )
+                    backoff = 0.5
+                except Exception as e:  # noqa: BLE001 — broker down: retry
+                    log.warning(
+                        "mq notify publish failed (will retry): %s", e
+                    )
+                    self.client.reset()
+                    if len(self._addrs) > 1:
+                        # rotate bootstrap brokers (kafka bootstrap-list
+                        # semantics): a dead bootstrap must not stall
+                        # events while other brokers live
+                        from ..mq.client import MqClient
 
-                    self._addr_idx = (self._addr_idx + 1) % len(self._addrs)
-                    self.client = MqClient(self._addrs[self._addr_idx])
-                await asyncio.sleep(backoff)
-                backoff = min(backoff * 2, 5.0)
+                        self._addr_idx = (
+                            self._addr_idx + 1
+                        ) % len(self._addrs)
+                        self.client = MqClient(self._addrs[self._addr_idx])
+                    await asyncio.sleep(backoff)
+                    backoff = min(backoff * 2, 5.0)
+        finally:
+            self._draining = False
+            self._maybe_spawn()  # raced with a publish after the check
 
     async def close(self) -> None:
         """One final best-effort flush, then stop the drain task."""
@@ -158,7 +189,9 @@ class MqNotifier(Notifier):
         if self._buf:
             try:
                 while self._buf:
-                    await self._publish_batch()
+                    await asyncio.wait_for(
+                        self._publish_batch(), self._PUBLISH_TIMEOUT
+                    )
             except Exception as e:  # noqa: BLE001
                 log.warning("mq notify final flush failed: %s", e)
 

@@ -83,6 +83,15 @@ async def wait_for(cluster, path, data, timeout=15.0):
         if got == data:
             return
         await asyncio.sleep(0.2)
+    # dump every live task's stack before failing: a silent stall in the
+    # notifier/replicator pipeline is invisible in the assertion alone
+    import traceback
+
+    for t in asyncio.all_tasks():
+        frames = t.get_stack(limit=6)
+        print(f"--- task {t.get_name()} ({t._coro}):")
+        for f in frames:
+            traceback.print_stack(f, limit=1)
     raise AssertionError(f"{path} never reached the target")
 
 
@@ -149,9 +158,17 @@ def test_mq_notification_broker_restart_mid_stream(tmp_path):
 
             port = broker.port
             await broker.stop()
-            # events during the outage buffer in the notifier
+            # events during the outage buffer in the notifier.  Poll:
+            # the event is transiently OUT of the deque while an
+            # in-flight publish attempt runs; the failure handler puts
+            # it back within the publish timeout.
             await put(src, "/b.bin", b"bravo" * 100)
-            await asyncio.sleep(0.5)
+            deadline = asyncio.get_event_loop().time() + 15
+            while (
+                not notifier._buf
+                and asyncio.get_event_loop().time() < deadline
+            ):
+                await asyncio.sleep(0.1)
             assert notifier._buf, "event should be buffered while broker is down"
 
             broker2 = MessageQueueBroker(
@@ -161,7 +178,10 @@ def test_mq_notification_broker_restart_mid_stream(tmp_path):
             )
             await broker2.start()
             try:
-                await wait_for(dst, "/b.bin", b"bravo" * 100, timeout=25.0)
+                # budget >= 8 notifier retry cycles (5s max backoff each):
+                # under the README's load protocol a restart can eat
+                # several cycles of reconnect + re-publish before landing
+                await wait_for(dst, "/b.bin", b"bravo" * 100, timeout=45.0)
                 # a.bin must not have been re-applied destructively
                 assert await get(dst, "/a.bin") == b"alpha" * 100
             finally:
@@ -243,10 +263,53 @@ def test_mq_notification_broker_failover(tmp_path):
             # b2 within the balancer TTL and b1 takes its partitions
             for i in range(6, 12):
                 await put(src, f"/m/f{i}.bin", (b"%d!" % i) * 50)
-            for i in range(6, 12):
-                await wait_for(
-                    dst, f"/m/f{i}.bin", (b"%d!" % i) * 50, timeout=30.0
+            try:
+                for i in range(6, 12):
+                    await wait_for(
+                        dst, f"/m/f{i}.bin", (b"%d!" % i) * 50, timeout=45.0
+                    )
+            except AssertionError:
+                import zlib
+
+                print(
+                    f"notifier: buf={len(notifier._buf)} "
+                    f"draining={notifier._draining} "
+                    f"dropped={notifier.dropped} "
+                    f"addr={notifier._addrs[notifier._addr_idx]}"
                 )
+                for i in range(6, 12):
+                    k = f"/m/f{i}.bin".encode()
+                    print(f"f{i} -> partition {zlib.crc32(k) % 4}")
+                for tkey, parts in b1.topics.items():
+                    for p in parts:
+                        blob = await b1._read_log(p)
+                        fence = await b1._read_fence(p)
+                        from seaweedfs_tpu.mq.broker import _records_decode
+
+                        durable = [o for o, *_ in _records_decode(blob)]
+                        keys = sorted(
+                            {
+                                k.decode(errors="replace")
+                                for _, k, _, _ in p.mem
+                                if k.startswith(b"/m/")
+                            }
+                            | {
+                                k.decode(errors="replace")
+                                for _, k, _, _ in _records_decode(blob)
+                                if k.startswith(b"/m/")
+                            }
+                        )
+                        print(
+                            f"b1 {tkey}/{p.idx}: active={p.active} "
+                            f"epoch={p.epoch[0]} next={p.next_offset} "
+                            f"flushed={p.flushed_upto} "
+                            f"mem_base={p.mem_base} mem={len(p.mem)} "
+                            f"pending={len(p.pending)} "
+                            f"parked={p.parked is not None} "
+                            f"durable={len(durable)} fence={fence[0]} "
+                            f"mem_keys={keys[-8:]}"
+                        )
+                raise
         finally:
             if task is not None:
                 task.cancel()
