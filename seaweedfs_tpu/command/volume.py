@@ -45,6 +45,12 @@ def add_args(p) -> None:
         "(0 = disabled)",
     )
     p.add_argument(
+        "-ec.scrub.intervalSeconds", dest="ec_scrub_interval_seconds",
+        type=int, default=0,
+        help="periodically verify EC parity of locally-complete volumes "
+        "(device-resident when pinned; 0 = disabled)",
+    )
+    p.add_argument(
         "-readMode", dest="read_mode", default="proxy",
         choices=["local", "proxy", "redirect"],
     )
@@ -134,6 +140,7 @@ async def run(args) -> None:
         ec_device_cache_mb=args.ec_device_cache_mb,
         white_list=guard_mod.from_security_toml(),
         fix_jpg_orientation=args.fix_jpg_orientation,
+        ec_scrub_interval_seconds=args.ec_scrub_interval_seconds,
         **common_args.metrics_kwargs(args),
     )
     await vs.start()

@@ -88,6 +88,11 @@ VOLUME_SERVER_RESIDENT_BYTES_GAUGE = Gauge(
     "Device memory held by the EC shard cache (padded bytes).",
     registry=REGISTRY,
 )
+VOLUME_SERVER_SCRUB_CORRUPT_GAUGE = Gauge(
+    "SeaweedFS_volumeServer_ec_scrub_corrupt_volumes",
+    "EC volumes whose last parity scrub found mismatching bytes.",
+    registry=REGISTRY,
+)
 
 FILER_REQUEST_COUNTER = Counter(
     "SeaweedFS_filer_request_total",

@@ -555,13 +555,19 @@ class Store:
         ev = self.find_ec_volume(vid)
         if ev is None:
             raise NotFoundError(f"ec volume {vid} not found")
+        return self.scrub_ec(ev)
+
+    def scrub_ec(self, ev) -> dict:
+        """Scrub one specific EcVolume object (a vid can be mounted in
+        several disk locations; resolving by vid would always scrub the
+        first location's copy)."""
         t0 = time.time()
         if self.ec_device_cache is not None:
             from ..ops import rs_resident
 
             try:
                 mism, span = rs_resident.scrub_volume(
-                    self.ec_device_cache, vid
+                    self.ec_device_cache, ev.id
                 )
                 return {
                     "parity_mismatch_bytes": mism,

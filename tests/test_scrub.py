@@ -149,3 +149,61 @@ def test_scrub_shell_command(tmp_path):
             await cluster.stop()
 
     run(go())
+
+
+def test_auto_scrub_loop_detects_corruption(tmp_path):
+    """-ec.scrub.intervalSeconds: the volume server's background scrub
+    finds a corrupted parity shard and raises the corrupt-volume gauge;
+    a clean pass later clears it."""
+    import time as time_mod
+
+    from seaweedfs_tpu import stats
+    from seaweedfs_tpu.server.volume import VolumeServer
+
+    base = _make_shards(tmp_path, vid=1)
+
+    async def go():
+        # minimal sidecars BEFORE construction: discovery scans at init
+        from seaweedfs_tpu.storage.volume_info import save_volume_info
+
+        save_volume_info(base + ".vif", {"version": 3})
+        open(base + ".ecx", "ab").close()
+        vs = VolumeServer(
+            masters=[], directories=[str(tmp_path)], port=0, grpc_port=0,
+            ec_backend="cpu", ec_scrub_interval_seconds=1,
+        )
+        await vs.start(heartbeat=False)
+        try:
+            deadline = time_mod.time() + 15
+            while time_mod.time() < deadline:
+                if stats.VOLUME_SERVER_SCRUB_CORRUPT_GAUGE._value.get() == 0:
+                    break
+                await asyncio.sleep(0.2)
+
+            # corrupt a parity shard on disk -> next cycle flags it
+            with open(base + layout.to_ext(10), "r+b") as f:
+                f.seek(64)
+                b = f.read(1)
+                f.seek(64)
+                f.write(bytes([b[0] ^ 0x80]))
+            deadline = time_mod.time() + 20
+            while time_mod.time() < deadline:
+                if stats.VOLUME_SERVER_SCRUB_CORRUPT_GAUGE._value.get() == 1:
+                    break
+                await asyncio.sleep(0.2)
+            assert stats.VOLUME_SERVER_SCRUB_CORRUPT_GAUGE._value.get() == 1
+
+            # repair (restore the byte) -> gauge clears
+            with open(base + layout.to_ext(10), "r+b") as f:
+                f.seek(64)
+                f.write(bytes([b[0]]))
+            deadline = time_mod.time() + 20
+            while time_mod.time() < deadline:
+                if stats.VOLUME_SERVER_SCRUB_CORRUPT_GAUGE._value.get() == 0:
+                    break
+                await asyncio.sleep(0.2)
+            assert stats.VOLUME_SERVER_SCRUB_CORRUPT_GAUGE._value.get() == 0
+        finally:
+            await vs.stop()
+
+    run(go())
