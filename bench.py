@@ -93,12 +93,21 @@ consistency rework, VERDICT r4 Weak #2/#3):
   h2d_mbps / d2h_mbps        measured host<->device bandwidth
 
 Rig physics (recorded so the e2e numbers can be read honestly): this box
-reaches the TPU through a network tunnel (h2d_mbps ~ 10-20 MB/s) and has a
+reaches the TPU through a network tunnel (h2d_mbps ~ 5-20 MB/s) and has a
 single CPU core, so every end-to-end file path is transfer/disk-bound far
-below both kernels.  The device-resident number is the deployable one on
-co-located TPU hosts; pod-scale rebuild over ICI (BASELINE config 5) is
-validated functionally by __graft_entry__.py's dryrun_multichip, not timed
-here (single chip).
+below both kernels.  Round 5 settled the serving question with measured
+end-to-end numbers instead of projections, in both directions:
+  * payload-out serving (degraded reads: ~6KB down the tunnel per 4KB
+    needle) LOSES to the local CPU kernel at every concurrency level —
+    the published `serving` sweep curves show it, and no batching depth
+    changes the byte ratio.  The resident path's case on co-located
+    TPU hosts remains the colocated projection, clearly labeled.
+  * compute-heavy/byte-light serving (the EC parity `scrub`: ~1.4 bytes
+    of GF(256) work per byte held, a 16-byte mismatch vector down) WINS
+    outright through the same tunnel — measured client-side through the
+    live VolumeEcShardsVerify RPC (scrub.device_speedup, ~7-9x on-rig).
+Pod-scale rebuild over ICI (BASELINE config 5) is validated functionally
+by __graft_entry__.py's dryrun_multichip, not timed here (single chip).
 """
 import json
 import os
