@@ -1020,6 +1020,27 @@ def main():
         nbytes, cpu_times_a, cpu_times_b
     )
 
+    # quantify the sweep's conclusion with the SAME-RUN d2h probe: every
+    # reconstructed 4KB needle ships one fetch row back (derived from the
+    # resident path's own ladder so the two can't drift), so even with
+    # the dispatch RTT fully amortized and zero host cost the tunnel caps
+    # the device path at d2h/fetch reads/s — comparable to or below the
+    # measured native rates, which is why no batching depth wins
+    from seaweedfs_tpu.ops import rs_resident
+    from seaweedfs_tpu.storage import needle as needle_mod
+
+    needle_fetch = rs_resident._fetch_cover(
+        needle_mod.actual_size(4096, needle_mod.CURRENT_VERSION)
+        + rs_resident.FUSED_ALIGN - 1  # worst-case alignment delta
+    )
+    serving["tunnel_ceiling_reads_per_s"] = round(
+        d2h_mbps * 1e6 / needle_fetch, 1
+    )
+    serving["tunnel_ceiling_note"] = (
+        f"same-run d2h bandwidth / {needle_fetch}B fetch per 4KB needle: "
+        "the hard upper bound on resident reads/s through this tunnel"
+    )
+
     dev_bps = enc["blockdiag_devtime"]
     vs_baseline_conservative = round(dev_bps / cpu_fast_bps, 2)
     # internal consistency: the durable e2e figure implies a shard-write
