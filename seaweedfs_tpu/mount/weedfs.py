@@ -160,7 +160,13 @@ class WeedFS:
 
     async def _sess(self) -> aiohttp.ClientSession:
         if self._session is None:
-            self._session = aiohttp.ClientSession()
+            # bounded per-request time: the retry loop in _retry_http
+            # multiplies this, and a kernel VFS syscall sits blocked for
+            # the whole budget — 3 x 60s is the worst case, not 3 x the
+            # aiohttp default of 300s
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=60, connect=10)
+            )
         return self._session
 
     def start_meta_subscription(self) -> None:
@@ -858,25 +864,63 @@ class WeedFS:
         entry.attributes.mtime = int(time.time())
         await self._update_entry(path, entry)
 
+    # transient filer hiccups (a 5xx from an overloaded upstream, a
+    # dropped connection) must not surface as EIO to the kernel VFS on
+    # the first try: both ops below are idempotent (range GET; whole-file
+    # PUT of the same bytes), so a short bounded retry makes the mount
+    # behave like a real network filesystem client instead of failing
+    # userspace syscalls on the first blip.
+    _RETRIES = 3
+
+    async def _retry_http(self, what: str, path: str, attempt):
+        """Run `attempt()` up to _RETRIES times.  attempt() raises
+        aiohttp.ClientError / asyncio.TimeoutError for retryable
+        failures (incl. 5xx, converted by the caller) and FuseError for
+        terminal ones; exhaustion logs and raises EIO — persistent
+        overload must leave a trace, not just an errno."""
+        for i in range(self._RETRIES):
+            try:
+                return await attempt()
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                if i == self._RETRIES - 1:
+                    log.warning(
+                        "mount %s %s failed after %d attempts: %s",
+                        what, path, self._RETRIES, e,
+                    )
+                    raise fk.FuseError(errno.EIO)
+                await asyncio.sleep(0.2 * (i + 1))
+
     async def _read_range(self, path: str, offset: int, size: int) -> bytes:
         sess = await self._sess()
         hdr = {"Range": f"bytes={offset}-{offset + size - 1}"} if size else {}
-        async with sess.get(self._http(path), headers=hdr) as r:
-            if r.status == 404:
-                raise fk.FuseError(errno.ENOENT)
-            if r.status >= 300 and r.status != 416:
-                raise fk.FuseError(errno.EIO)
-            if r.status == 416:  # past EOF
-                return b""
-            return await r.read()
+
+        async def attempt() -> bytes:
+            async with sess.get(self._http(path), headers=hdr) as r:
+                if r.status == 404:
+                    raise fk.FuseError(errno.ENOENT)
+                if r.status >= 500:
+                    raise aiohttp.ClientError(f"HTTP {r.status}")
+                if r.status >= 300 and r.status != 416:
+                    raise fk.FuseError(errno.EIO)
+                if r.status == 416:  # past EOF
+                    return b""
+                return await r.read()
+
+        return await self._retry_http("read", path, attempt)
 
     async def _put(self, path: str, data: bytes, mode: int = 0o644) -> None:
         sess = await self._sess()
-        async with sess.put(
-            self._http(path) + f"?mode={mode:o}", data=data
-        ) as r:
-            if r.status >= 300:
-                raise fk.FuseError(errno.EIO)
+
+        async def attempt() -> None:
+            async with sess.put(
+                self._http(path) + f"?mode={mode:o}", data=data
+            ) as r:
+                if r.status >= 500:
+                    raise aiohttp.ClientError(f"HTTP {r.status}")
+                if r.status >= 300:
+                    raise fk.FuseError(errno.EIO)
+
+        await self._retry_http("write", path, attempt)
         self.meta.invalidate(path)
 
     async def read(self, nodeid: int, body: bytes, **kw) -> bytes:

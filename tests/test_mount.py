@@ -496,3 +496,60 @@ def test_two_mounts_rename_visibility(tmp_path):
             await cluster.stop()
 
     run(go())
+
+
+def test_mount_http_ops_retry_transient_5xx():
+    """A transient filer 500 must not surface as EIO to the kernel on the
+    first attempt: the mount's idempotent HTTP ops retry briefly (network
+    filesystem semantics), failing only when the error persists."""
+    import aiohttp.web as web
+
+    from seaweedfs_tpu.mount import fusekernel as fk
+    from seaweedfs_tpu.mount.weedfs import WeedFS
+
+    async def go():
+        fails = {"get": 1, "put": 2}  # transient: recover within retries
+        body = b"retry-me"
+
+        async def h_get(request):
+            if fails["get"] > 0:
+                fails["get"] -= 1
+                return web.Response(status=500)
+            return web.Response(body=body)
+
+        async def h_put(request):
+            if fails["put"] > 0:
+                fails["put"] -= 1
+                return web.Response(status=503)
+            return web.Response()
+
+        app = web.Application()
+        app.router.add_get("/f.bin", h_get)
+        app.router.add_put("/f.bin", h_put)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        fs = WeedFS(f"127.0.0.1:{port}")
+        try:
+            got = await fs._read_range("/f.bin", 0, 0)
+            assert got == body  # recovered after one 500
+            await fs._put("/f.bin", body)  # recovered after two 503s
+            assert fails == {"get": 0, "put": 0}
+
+            # a PERSISTENT failure still raises EIO after the retries
+            fails["put"] = 99
+            try:
+                await fs._put("/f.bin", body)
+                raise AssertionError("persistent 503 did not raise")
+            except fk.FuseError as e:
+                import errno as errno_mod
+
+                assert e.errno_value == errno_mod.EIO
+        finally:
+            if fs._session is not None:
+                await fs._session.close()
+            await runner.cleanup()
+
+    run(go())
