@@ -4,6 +4,7 @@ from __future__ import annotations
 import asyncio
 
 from . import common_args
+from ..serving.config import ServingConfig
 from ..utils import config as config_util
 from ..security import guard as guard_mod
 
@@ -49,6 +50,39 @@ def add_args(p) -> None:
         type=int, default=0,
         help="periodically verify EC parity of locally-complete volumes "
         "(device-resident when pinned; 0 = disabled)",
+    )
+    # continuous-batching EC serving dispatcher (serving/dispatcher.py):
+    # ServingConfig is the single source of the defaults; the flags exist
+    # so an operator can tune the batching curve without a rebuild
+    serving_defaults = ServingConfig()
+    p.add_argument(
+        "-ec.serving.disable", dest="ec_serving_disable",
+        action="store_true",
+        help="serve every EC read on the native per-read path instead of "
+        "the resident continuous-batching dispatcher",
+    )
+    p.add_argument(
+        "-ec.serving.maxBatch", dest="ec_serving_max_batch", type=int,
+        default=serving_defaults.max_batch,
+        help="widest coalesced EC read batch (device needles per call)",
+    )
+    p.add_argument(
+        "-ec.serving.maxWaitUs", dest="ec_serving_max_wait_us", type=int,
+        default=serving_defaults.max_wait_us,
+        help="admission window (µs) a hot dispatch lane holds open for a "
+        "partial batch to fill; 0 disables",
+    )
+    p.add_argument(
+        "-ec.serving.maxInflight", dest="ec_serving_max_inflight", type=int,
+        default=serving_defaults.max_inflight,
+        help="pipelined EC read batches in flight (batch N+1 dispatches "
+        "while batch N's bytes return)",
+    )
+    p.add_argument(
+        "-ec.serving.maxQueue", dest="ec_serving_max_queue", type=int,
+        default=serving_defaults.max_queue,
+        help="queued EC reads beyond this fall back to the native path "
+        "(backpressure)",
     )
     p.add_argument(
         "-readMode", dest="read_mode", default="proxy",
@@ -141,6 +175,13 @@ async def run(args) -> None:
         white_list=guard_mod.from_security_toml(),
         fix_jpg_orientation=args.fix_jpg_orientation,
         ec_scrub_interval_seconds=args.ec_scrub_interval_seconds,
+        ec_serving=ServingConfig(
+            enabled=not args.ec_serving_disable,
+            max_batch=args.ec_serving_max_batch,
+            max_wait_us=args.ec_serving_max_wait_us,
+            max_inflight=args.ec_serving_max_inflight,
+            max_queue=args.ec_serving_max_queue,
+        ),
         **common_args.metrics_kwargs(args),
     )
     await vs.start()

@@ -160,12 +160,17 @@ class WeedFS:
 
     async def _sess(self) -> aiohttp.ClientSession:
         if self._session is None:
-            # bounded per-request time: the retry loop in _retry_http
-            # multiplies this, and a kernel VFS syscall sits blocked for
-            # the whole budget — 3 x 60s is the worst case, not 3 x the
-            # aiohttp default of 300s
+            # bound the STALL time, not the transfer time: a total cap
+            # would kill any legitimate large whole-file _put (or slow
+            # bulk read) that needs >60s of wire time and surface EIO
+            # after the retries.  connect + per-read socket timeouts make
+            # a hung filer fail fast (worst case per attempt: 10s connect
+            # + 60s between bytes, x3 retries) while a healthy-but-slow
+            # transfer of any size runs to completion.
             self._session = aiohttp.ClientSession(
-                timeout=aiohttp.ClientTimeout(total=60, connect=10)
+                timeout=aiohttp.ClientTimeout(
+                    total=None, connect=10, sock_read=60
+                )
             )
         return self._session
 
@@ -784,9 +789,16 @@ class WeedFS:
         from ..filer.manifest import fetch_chunk_via_lookup
 
         try:
-            return await fetch_chunk_via_lookup(
-                self._stub(), await self._sess(), file_id
+            # same dribble guard as _read_range; a manifest blob is at
+            # most one chunk, so chunk_size bounds its budget
+            return await asyncio.wait_for(
+                fetch_chunk_via_lookup(
+                    self._stub(), await self._sess(), file_id
+                ),
+                self._stall_budget(self.chunk_size),
             )
+        except asyncio.TimeoutError:
+            raise fk.FuseError(errno.EIO)
         except RuntimeError:
             raise fk.FuseError(errno.EIO)
 
@@ -871,6 +883,15 @@ class WeedFS:
     # behave like a real network filesystem client instead of failing
     # userspace syscalls on the first blip.
     _RETRIES = 3
+    # per-attempt deadline floor and minimum expected transfer progress:
+    # sock_read only bounds gaps BETWEEN bytes, so every session user
+    # caps its attempt at _stall_budget(payload) to bound a dribbling
+    # peer without killing legitimately slow large transfers
+    _BUDGET_FLOOR_S = 60
+    _MIN_PROGRESS_BPS = 256 * 1024
+
+    def _stall_budget(self, nbytes: int) -> float:
+        return self._BUDGET_FLOOR_S + nbytes / self._MIN_PROGRESS_BPS
 
     async def _retry_http(self, what: str, path: str, attempt):
         """Run `attempt()` up to _RETRIES times.  attempt() raises
@@ -893,32 +914,45 @@ class WeedFS:
     async def _read_range(self, path: str, offset: int, size: int) -> bytes:
         sess = await self._sess()
         hdr = {"Range": f"bytes={offset}-{offset + size - 1}"} if size else {}
+        # a dribbling response (one byte per 50s) would block the kernel
+        # VFS read indefinitely under sock_read alone
+        budget = self._stall_budget(size)
 
         async def attempt() -> bytes:
-            async with sess.get(self._http(path), headers=hdr) as r:
-                if r.status == 404:
-                    raise fk.FuseError(errno.ENOENT)
-                if r.status >= 500:
-                    raise aiohttp.ClientError(f"HTTP {r.status}")
-                if r.status >= 300 and r.status != 416:
-                    raise fk.FuseError(errno.EIO)
-                if r.status == 416:  # past EOF
-                    return b""
-                return await r.read()
+            async def get():
+                async with sess.get(self._http(path), headers=hdr) as r:
+                    if r.status == 404:
+                        raise fk.FuseError(errno.ENOENT)
+                    if r.status >= 500:
+                        raise aiohttp.ClientError(f"HTTP {r.status}")
+                    if r.status >= 300 and r.status != 416:
+                        raise fk.FuseError(errno.EIO)
+                    if r.status == 416:  # past EOF
+                        return b""
+                    return await r.read()
+
+            return await asyncio.wait_for(get(), budget)
 
         return await self._retry_http("read", path, attempt)
 
     async def _put(self, path: str, data: bytes, mode: int = 0o644) -> None:
         sess = await self._sess()
+        # nothing else bounds a stalled request-body UPLOAD (a wedged
+        # filer that accepts the connection then stops reading blocks
+        # the client in flow control with no read to time out)
+        budget = self._stall_budget(len(data))
 
         async def attempt() -> None:
-            async with sess.put(
-                self._http(path) + f"?mode={mode:o}", data=data
-            ) as r:
-                if r.status >= 500:
-                    raise aiohttp.ClientError(f"HTTP {r.status}")
-                if r.status >= 300:
-                    raise fk.FuseError(errno.EIO)
+            async def put():
+                async with sess.put(
+                    self._http(path) + f"?mode={mode:o}", data=data
+                ) as r:
+                    if r.status >= 500:
+                        raise aiohttp.ClientError(f"HTTP {r.status}")
+                    if r.status >= 300:
+                        raise fk.FuseError(errno.EIO)
+
+            await asyncio.wait_for(put(), budget)
 
         await self._retry_http("write", path, attempt)
         self.meta.invalidate(path)

@@ -659,6 +659,18 @@ class MessageQueueBroker:
             # after the log resync would land records the resync never
             # saw (same-process half of the handoff race)
             async with p.flush_lock:
+                if p.pending:
+                    # records acked between the handoff flush and this
+                    # reactivation (append() doesn't gate on `active`, so
+                    # a handler that passed the check before deactivation
+                    # can still land records): wiping them below would be
+                    # silent acked loss.  Park them under the epoch they
+                    # were acked under — the same-epoch merge in _park
+                    # keeps the batch contiguous with an already-parked
+                    # handoff batch, so reconciliation replays them
+                    # together (or counts them lost, loudly).
+                    batch, p.pending = p.pending, []
+                    p._park(p.epoch, batch)
                 stored = await self._read_fence(p)
                 # fresh nonce per activation: two racing activators'
                 # fences differ even when their counters tie
@@ -669,10 +681,42 @@ class MessageQueueBroker:
                 async with p.cond:
                     p.epoch = new_epoch
                     p.next_offset = max(p.next_offset, last + 1)
-                    p.mem = []
-                    p.mem_base = p.next_offset
-                    p.flushed_upto = p.next_offset
-                    p.pending = []
+                    # stragglers appended during the fence/reconcile
+                    # awaits above (the pre-activation park only covers
+                    # records that landed before it): keep every record
+                    # whose offset lies beyond the durable log end —
+                    # flushing those cannot collide.  Only records whose
+                    # offsets another owner already wrote over are lost
+                    # (counted, loudly); keeping the non-colliding
+                    # SUFFIX preserves the rest rather than dropping the
+                    # batch wholesale.
+                    kept = [r for r in p.pending if r[0] > last]
+                    if len(kept) != len(p.pending):
+                        log.error(
+                            "partition %s/%d: %d acked records lost "
+                            "(another owner advanced the log over their "
+                            "offsets during activation)",
+                            p.tkey, p.idx, len(p.pending) - len(kept),
+                        )
+                    p.pending = kept
+                    if kept:
+                        # rebase the memory window on the first kept
+                        # straggler; the next flush makes them durable
+                        # under the new epoch.  (If earlier records were
+                        # counted lost there is an offset gap, which
+                        # readers already skip.)
+                        p.mem = list(kept)
+                        p.mem_base = kept[0][0]
+                        p.flushed_upto = kept[0][0]
+                        log.info(
+                            "partition %s/%d: kept %d records acked "
+                            "during activation",
+                            p.tkey, p.idx, len(kept),
+                        )
+                    else:
+                        p.mem = []
+                        p.mem_base = p.next_offset
+                        p.flushed_upto = p.next_offset
                     p.active = True
 
     async def _balancer_loop(self) -> None:

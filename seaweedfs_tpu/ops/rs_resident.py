@@ -151,6 +151,16 @@ class DeviceShardCache:
         self._lock = threading.Lock()
         self._arrays: OrderedDict[tuple[int, int], object] = OrderedDict()
         self._true_sizes: dict[tuple[int, int], int] = {}
+        # vid -> the disk-location directory whose shard files were
+        # pinned.  The cache is keyed by (vid, shard) only, so a vid
+        # mounted in several locations is ambiguous without this: scrub
+        # and read verdicts must be attributed to the location whose
+        # bytes are actually resident (ADVICE r5).
+        self._pin_source: dict[int, str] = {}
+        # vid -> resident shard count, maintained on put/evict so the
+        # serving path's per-read routing predicate is O(1) instead of
+        # a scan-and-sort of the whole key set under the lock
+        self._vid_counts: dict[int, int] = {}
         self.bytes_used = 0
 
     def _padded_len(self, n: int) -> int:
@@ -168,13 +178,66 @@ class DeviceShardCache:
         with self._lock:
             if key in self._arrays:
                 self.bytes_used -= self._arrays.pop(key).size
+                self._vid_counts[vid] -= 1
             while self._arrays and self.bytes_used + padded.size > self.budget:
                 old_key, old = self._arrays.popitem(last=False)
                 self._true_sizes.pop(old_key, None)
                 self.bytes_used -= old.size
+                self._vid_counts[old_key[0]] -= 1
+                if not self._vid_counts[old_key[0]]:
+                    del self._vid_counts[old_key[0]]
+                # deliberately KEEP the evicted vid's pin-source claim:
+                # budget pressure can evict a volume's own oldest shards
+                # while its pin thread is still uploading, and dropping
+                # the claim here would leave the remaining pins
+                # unclaimed (never routed resident) or let a second
+                # location interleave its shard set.  A stale claim is
+                # conservative: scrub/serving just see too few resident
+                # shards and stay on the file path; explicit evict()/
+                # clear() (unmount, destroy) release the claim.
             self._arrays[key] = arr
             self._true_sizes[key] = host.size
+            self._vid_counts[vid] = self._vid_counts.get(vid, 0) + 1
             self.bytes_used += padded.size
+
+    def resident_count(self, vid: int) -> int:
+        """O(1) resident shard count for `vid` (the serving dispatcher's
+        per-read routing predicate — shard_ids() would scan the whole
+        key set under the lock on every read)."""
+        with self._lock:
+            return self._vid_counts.get(vid, 0)
+
+    def _forget_if_gone(self, vid: int) -> None:
+        """Drop per-vid bookkeeping once no shard of `vid` remains
+        (caller holds the lock; _vid_counts already knows, no key scan)."""
+        if not self._vid_counts.get(vid):
+            self._vid_counts.pop(vid, None)
+            self._pin_source.pop(vid, None)
+
+    def claim_pin_source(self, vid: int, source: str) -> str:
+        """Atomically claim which disk location's shard files back this
+        vid's resident bytes; returns the winning source (first claimant
+        keeps it — two locations' pin threads racing must not interleave
+        their shard sets under one key space)."""
+        with self._lock:
+            return self._pin_source.setdefault(vid, source)
+
+    def release_pin_source(self, vid: int, source: str) -> None:
+        """Release `source`'s claim if nothing of `vid` is resident: a
+        pin attempt that failed before uploading anything (unreadable
+        shard file, aborted thread) must not block another location's
+        healthy copy until process restart.  A partially pinned claim is
+        kept — those bytes are still the vid's resident identity."""
+        with self._lock:
+            if (
+                self._pin_source.get(vid) == source
+                and not self._vid_counts.get(vid)
+            ):
+                del self._pin_source[vid]
+
+    def pin_source(self, vid: int) -> str | None:
+        with self._lock:
+            return self._pin_source.get(vid)
 
     def get(self, vid: int, shard_id: int):
         with self._lock:
@@ -218,11 +281,22 @@ class DeviceShardCache:
             for k in keys:
                 self.bytes_used -= self._arrays.pop(k).size
                 self._true_sizes.pop(k, None)
+                self._vid_counts[vid] -= 1
+            if shard_id is None or keys:
+                # a whole-vid evict (unmount/destroy) always releases
+                # the claim — even when budget pressure already removed
+                # the shards, the claim must not outlive the volume.  A
+                # PARTIAL evict that matched nothing must not drop a
+                # mid-pin claim (the pin thread claimed before its first
+                # put) and open the two-location interleave window.
+                self._forget_if_gone(vid)
 
     def clear(self) -> None:
         with self._lock:
             self._arrays.clear()
             self._true_sizes.clear()
+            self._pin_source.clear()
+            self._vid_counts.clear()
             self.bytes_used = 0
 
 

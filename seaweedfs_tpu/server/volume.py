@@ -43,6 +43,7 @@ from ..storage.ec import (
     write_idx_file_from_ec_index,
 )
 from .. import stats
+from ..serving import EcReadDispatcher
 from ..security import verify_volume_write_jwt
 from ..security import tls as tls_mod
 from ..security import guard as guard_mod
@@ -118,74 +119,6 @@ class _ByteLease:
             lim._cond.notify_all()
 
 
-class EcReadBatcher:
-    """Natural batching of EC needle reads.
-
-    Requests that arrive while a batch is being served queue up and are
-    coalesced into the next batch, so a burst of concurrent degraded
-    reads becomes a few wide device-resident reconstruct calls
-    (Store.read_ec_needles_batch -> EcVolume.read_needles_batch) instead
-    of one per needle — the asyncio counterpart of the reference's
-    per-needle goroutine fan-in (store_ec.go:339-393).  No timers: a lone
-    request is served immediately, so idle latency is unchanged.
-
-    Up to `max_inflight` batches run concurrently: on tunneled devices a
-    batch's wall time is dominated by GIL-free dispatch RTT and D2H, so
-    overlapping batch N+1's device compute with batch N's transfers
-    raises aggregate throughput without changing per-batch behavior."""
-
-    def __init__(self, store, remote_reader_factory, max_inflight: int = 2):
-        self.store = store
-        self._remote_reader = remote_reader_factory
-        self.max_inflight = max(1, max_inflight)
-        self._pending: list[tuple[int, int, int | None, asyncio.Future]] = []
-        self._inflight = 0
-
-    async def read(self, vid: int, nid: int, cookie: int | None):
-        fut = asyncio.get_running_loop().create_future()
-        self._pending.append((vid, nid, cookie, fut))
-        self._maybe_spawn()
-        result = await fut
-        if isinstance(result, Exception):
-            raise result
-        return result
-
-    def _maybe_spawn(self) -> None:
-        if self._pending and self._inflight < self.max_inflight:
-            self._inflight += 1
-            asyncio.ensure_future(self._drain())
-
-    async def _drain(self) -> None:
-        try:
-            while self._pending:
-                # atomic swap (no await in between): concurrent drains
-                # never see the same request twice
-                batch, self._pending = self._pending, []
-                by_vid: dict[int, list] = {}
-                for vid, nid, cookie, fut in batch:
-                    by_vid.setdefault(vid, []).append((nid, cookie, fut))
-                for vid, items in by_vid.items():
-                    try:
-                        results = await asyncio.to_thread(
-                            self.store.read_ec_needles_batch,
-                            vid,
-                            [(nid, cookie) for nid, cookie, _ in items],
-                            self._remote_reader(vid),
-                        )
-                    except Exception as e:  # volume-level failure
-                        results = [e] * len(items)
-                    for (_, _, fut), r in zip(items, results):
-                        if fut.done():
-                            continue
-                        if isinstance(r, Exception):
-                            fut.set_exception(r)
-                        else:
-                            fut.set_result(r)
-        finally:
-            self._inflight -= 1
-            self._maybe_spawn()  # raced with an enqueue after the loop check
-
-
 class VolumeServer:
     def __init__(
         self,
@@ -214,6 +147,7 @@ class VolumeServer:
         metrics_address: str = "",  # pushgateway host:port (ref -metrics.address)
         metrics_interval_seconds: int = 15,  # ref -metrics.intervalSeconds
         ec_scrub_interval_seconds: int = 0,  # >0: periodic parity scrub
+        ec_serving=None,  # serving.ServingConfig | None (-ec.serving.* flags)
     ):
         self.metrics_address = metrics_address
         self.metrics_interval_seconds = metrics_interval_seconds
@@ -272,7 +206,9 @@ class VolumeServer:
         self.download_limiter = ByteLimiter(concurrent_download_limit_mb << 20)
         self._pending_compacts: dict[int, tuple[str, str, int, str | None]] = {}
         self._ec_locations: dict[int, tuple[float, dict[int, list[str]]]] = {}
-        self._ec_batcher = EcReadBatcher(self.store, self._remote_shard_reader)
+        self.ec_dispatcher = EcReadDispatcher(
+            self.store, self._remote_shard_reader, ec_serving
+        )
         self._grpc_server: grpc.aio.Server | None = None
         self._http_runner: web.AppRunner | None = None
         self._tasks: list[asyncio.Task] = []
@@ -675,20 +611,13 @@ class VolumeServer:
                         cookie,
                         read_deleted,
                     )
-                elif self.store.ec_device_cache is not None:
-                    # coalesced: concurrent EC reads batch into one
-                    # device-resident reconstruct call
-                    n = await self._ec_batcher.read(vid, nid, cookie)
                 else:
-                    # no device cache: the batcher's sequential drain loop
-                    # would serialize otherwise-concurrent disk reads
-                    n = await asyncio.to_thread(
-                        self.store.read_ec_needle,
-                        vid,
-                        nid,
-                        cookie,
-                        self._remote_shard_reader(vid),
-                    )
+                    # the serving dispatcher routes per volume: resident
+                    # volumes coalesce into pipelined device-resident
+                    # reconstruct batches; unpinned/cache-less volumes
+                    # (whose concurrent disk reads must not serialize
+                    # behind a batch queue) take the native path inside
+                    n = await self.ec_dispatcher.read(vid, nid, cookie)
             except (NotFoundError, KeyError):
                 raise web.HTTPNotFound()
             except CookieMismatch:

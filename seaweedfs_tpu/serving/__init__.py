@@ -1,0 +1,29 @@
+"""Continuous-batching dispatch for the device-resident EC read path.
+
+BENCH_r05 measured the resident serving path at 417 reads/s against a
+same-run tunnel ceiling of 3259 — 13% utilization — while the native CPU
+path peaked at 2091.  In that window the binding constraint was dispatch
+software, not bytes: each coalesced batch ran to completion (device call
++ D2H + per-needle HTTP responses) before the next batch dispatched, so
+the device idled through every tunnel round-trip.  This package grafts
+the inference-serving fix — continuous batching — onto the storage read
+path:
+
+  * `Coalescer` packs concurrent needle reads for the same resident
+    EcVolume into wide `read_needles_batch` calls (tunable max batch
+    width and a µs-scale max-wait admission window);
+  * `EcReadDispatcher` keeps several batches in flight (bounded depth):
+    batch N+1 dispatches while batch N's reconstructed bytes are still
+    riding the tunnel back, and saturation falls back to the native
+    per-read path instead of queuing unboundedly;
+  * per-batch Prometheus series (stats/metrics.py) make batch width,
+    queue wait, device occupancy, and fallbacks dashboard-visible.
+
+Reference path being outperformed: the per-needle goroutine fan-in of
+weed/storage/store_ec.go:339-393.
+"""
+from .config import ServingConfig
+from .coalescer import Coalescer, ReadRequest
+from .dispatcher import EcReadDispatcher
+
+__all__ = ["Coalescer", "EcReadDispatcher", "ReadRequest", "ServingConfig"]

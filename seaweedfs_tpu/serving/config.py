@@ -1,0 +1,53 @@
+"""Knobs for the continuous-batching EC serving dispatcher.
+
+Defaults are sized from this rig's measured artifacts: COUNT_BUCKETS in
+ops/rs_resident.py tops out at 256 (a wider coalesce would hit an
+uncompiled shape), the round-5 sweep showed `max_inflight=2` leaving the
+device idle through tunnel round-trips, and an admission window needs to
+be far below the ~ms batch service time to be free.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class ServingConfig:
+    """Tunables for `EcReadDispatcher` (CLI: the -ec.serving.* flags)."""
+
+    # route EC reads of resident volumes through the batching dispatcher;
+    # False serves every read on the native per-read path
+    enabled: bool = True
+    # widest coalesced batch; matches COUNT_BUCKETS[-1] so a full batch
+    # is one already-warm device shape
+    max_batch: int = 256
+    # admission window: when a dispatch slot frees and the queue holds a
+    # partial batch, wait this long for the batch to fill before
+    # dispatching.  Only applied once a drain loop is already hot (the
+    # first batch after idle dispatches immediately), so a lone request
+    # never waits.  0 disables the window.
+    max_wait_us: int = 200
+    # pipelined batches in flight: batch N+1's device dispatch overlaps
+    # batch N's D2H + response fan-out.  Round 5 measured depth 2 leaving
+    # the resident path at 13% of the tunnel ceiling; bench.py sweeps
+    # 2/4/8 and publishes the curve
+    max_inflight: int = 4
+    # backpressure: queued requests beyond this fall back to the native
+    # per-read path (counted in the fallback metric) instead of growing
+    # the queue without bound
+    max_queue: int = 2048
+
+    @property
+    def max_wait_s(self) -> float:
+        return self.max_wait_us / 1e6
+
+    def validated(self) -> "ServingConfig":
+        if self.max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        if self.max_inflight < 1:
+            raise ValueError("max_inflight must be >= 1")
+        if self.max_queue < self.max_batch:
+            raise ValueError("max_queue must be >= max_batch")
+        if self.max_wait_us < 0:
+            raise ValueError("max_wait_us must be >= 0")
+        return self

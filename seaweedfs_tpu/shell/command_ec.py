@@ -13,7 +13,7 @@ from __future__ import annotations
 import math
 
 from ..pb import master_pb2, volume_server_pb2
-from ..storage.ec import TOTAL_SHARDS
+from ..storage.ec import DATA_SHARDS, TOTAL_SHARDS
 from .command_env import CommandEnv, TopoNode
 from .commands import command, parse_flags
 
@@ -252,17 +252,19 @@ async def cmd_ec_scrub(env, args):
             volume_server_pb2.VolumeEcShardsVerifyRequest(volume_id=vid)
         )
         bad = sum(r.parity_mismatch_bytes)
-        mb = r.bytes_verified * TOTAL_SHARDS / 1e6
-        rate = (
-            r.bytes_verified * 10 / r.seconds / 1e9 if r.seconds else 0.0
-        )
+        # ONE byte basis for both figures: data bytes covered (shard span
+        # x DATA_SHARDS, the same basis bench.py's scrub GB/s uses), so
+        # the printed rate actually equals size/seconds
+        data_bytes = r.bytes_verified * DATA_SHARDS
+        mb = data_bytes / 1e6
+        rate = data_bytes / r.seconds / 1e9 if r.seconds else 0.0
         status = (
             "OK" if bad == 0
             else f"CORRUPT: {list(r.parity_mismatch_bytes)} mismatch bytes"
         )
         env.write(
             f"ec volume {vid}: {status} backend={r.backend} "
-            f"{mb:.0f}MB in {r.seconds:.2f}s ({rate:.2f} GB/s)"
+            f"{mb:.0f}MB data in {r.seconds:.2f}s ({rate:.2f} GB/s)"
         )
 
 

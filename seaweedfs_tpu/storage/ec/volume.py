@@ -169,7 +169,13 @@ class EcVolume:
         return True
 
     def delete_shard(self, shard_id: int) -> EcVolumeShard | None:
-        if self.device_cache is not None:
+        # only the pinning location's unmount evicts resident bytes: the
+        # cache is keyed by (vid, shard), so a second location dropping
+        # ITS copy must not wipe the owner's pinned shards
+        if (
+            self.device_cache is not None
+            and self.device_cache.pin_source(self.id) == self.dir
+        ):
             self.device_cache.evict(self.id, shard_id)
         return self.shards.pop(shard_id, None)
 
@@ -184,6 +190,13 @@ class EcVolume:
             self.device_cache = cache
         if self.device_cache is None:
             raise ValueError("no device cache configured")
+        # the cache is keyed by (vid, shard) only, so a vid mounted in
+        # two disk locations would interleave both locations' shard sets
+        # under one key space: first pinner claims the vid; a different
+        # location's copy stays file-backed (its scrub/read verdicts must
+        # not be attributed to this location's bytes)
+        if self.device_cache.claim_pin_source(self.id, self.dir) != self.dir:
+            return 0
         n = 0
         # snapshot: mount RPCs may add shards while a pin thread iterates
         for sid, shard in list(self.shards.items()):
@@ -195,6 +208,21 @@ class EcVolume:
                 )
                 n += 1
         return n
+
+    def is_device_resident(self) -> bool:
+        """True when enough of THIS location's shards are pinned in HBM
+        to reconstruct any missing interval on-device.  Checks the pin
+        source — another location's resident copy of the same vid does
+        not make this shard set resident, which is what keeps scrub
+        verdicts attributed to the bytes actually verified.  (Read
+        routing uses Store.ec_volume_is_resident instead, which accepts
+        any resident copy: the encoded bytes are identical.)"""
+        c = self.device_cache
+        return (
+            c is not None
+            and c.pin_source(self.id) == self.dir
+            and c.resident_count(self.id) >= DATA_SHARDS
+        )
 
     def shard_bits(self) -> ShardBits:
         b = ShardBits(0)
@@ -242,10 +270,11 @@ class EcVolume:
         interval: Interval,
         remote_read: RemoteReadFn | None = None,
         backend: str = "cpu",
+        use_device: bool = True,
     ) -> bytes:
         shard_id, off = interval.to_shard_and_offset()
         data = self._read_shard_interval(
-            shard_id, off, interval.size, remote_read, backend
+            shard_id, off, interval.size, remote_read, backend, use_device
         )
         return data
 
@@ -256,6 +285,7 @@ class EcVolume:
         size: int,
         remote_read: RemoteReadFn | None,
         backend: str,
+        use_device: bool = True,
     ) -> bytes:
         shard = self.shards.get(shard_id)
         if shard is not None:
@@ -264,7 +294,9 @@ class EcVolume:
             data = remote_read(shard_id, off, size)
             if data is not None:
                 return data
-        return self._reconstruct_interval(shard_id, off, size, remote_read, backend)
+        return self._reconstruct_interval(
+            shard_id, off, size, remote_read, backend, use_device
+        )
 
     def _reconstruct_interval(
         self,
@@ -273,14 +305,18 @@ class EcVolume:
         size: int,
         remote_read: RemoteReadFn | None,
         backend: str,
+        use_device: bool = True,
     ) -> bytes:
         """Degraded read: gather this interval from >=k other shards and
         recompute the missing rows (recoverOneRemoteEcShardInterval
         store_ec.go:339-393) — a single batched multiply on the selected
         backend rather than a goroutine fan-in.  When the survivors are
         pinned in HBM (device_cache), the gather happens on-device and the
-        only per-call transfer is the reconstructed bytes themselves."""
-        if self.device_cache is not None:
+        only per-call transfer is the reconstructed bytes themselves.
+        `use_device=False` forces the host reconstruct — the serving
+        dispatcher's shed path must not add width-1 device dispatches to
+        a device that is already the bottleneck."""
+        if use_device and self.device_cache is not None:
             from ...ops import rs_resident
 
             try:
@@ -317,10 +353,12 @@ class EcVolume:
         needle_id: int,
         remote_read: RemoteReadFn | None = None,
         backend: str = "cpu",
+        use_device: bool = True,
     ) -> bytes:
         _, _, intervals = self.locate_needle(needle_id)
         return b"".join(
-            self.read_interval(iv, remote_read, backend) for iv in intervals
+            self.read_interval(iv, remote_read, backend, use_device)
+            for iv in intervals
         )
 
     def read_needles_batch(
@@ -407,10 +445,11 @@ class EcVolume:
         cookie: int | None = None,
         remote_read: RemoteReadFn | None = None,
         backend: str = "cpu",
+        use_device: bool = True,
     ) -> Needle:
         """Full needle with CRC verification (ReadEcShardNeedle
         store_ec.go:136-174)."""
-        raw = self.read_needle_bytes(needle_id, remote_read, backend)
+        raw = self.read_needle_bytes(needle_id, remote_read, backend, use_device)
         n = Needle.from_bytes(raw, self.version)
         if n.id != needle_id:
             raise NeedleNotFound(
@@ -451,7 +490,10 @@ class EcVolume:
 
     def destroy(self) -> None:
         """Remove sidecars + local shards (ec_volume.go Destroy)."""
-        if self.device_cache is not None:
+        if (
+            self.device_cache is not None
+            and self.device_cache.pin_source(self.id) == self.dir
+        ):
             self.device_cache.evict(self.id)
         self.close()
         for p in [self.ecx_path, self.ecj_path, self.base_name + ".vif"]:

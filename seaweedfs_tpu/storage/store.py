@@ -24,6 +24,7 @@ from . import needle as needle_mod
 from . import types as t
 from .disk_location import DiskLocation
 from .ec import (
+    DATA_SHARDS,
     EcVolume,
     NeedleNotFound,
     ShardBits,
@@ -130,6 +131,23 @@ class Store:
             if ev is not None:
                 return ev
         return None
+
+    def ec_volume_is_resident(self, vid: int) -> bool:
+        """Routing predicate for the serving dispatcher: True when the
+        vid's shard set is pinned deep enough that a coalesced batch
+        becomes one device-resident reconstruct call.  False while the
+        pin thread is still uploading (reads fall to the host path
+        instead of queuing behind a batch that can't use the device).
+        Deliberately ignores WHICH location's files were pinned: every
+        mounted copy of a vid carries the same encoded bytes, so reads
+        may serve from any resident copy — pin-source attribution only
+        matters for scrub verdicts (EcVolume.is_device_resident)."""
+        if self.ec_device_cache is None:
+            return False
+        return (
+            self.find_ec_volume(vid) is not None
+            and self.ec_device_cache.resident_count(vid) >= DATA_SHARDS
+        )
 
     def location_of_volume(self, vid: int) -> DiskLocation | None:
         for loc in self.locations:
@@ -482,6 +500,11 @@ class Store:
                 logging.getLogger(__name__).exception(
                     "ec device-cache pinning failed for volume %d", ev.id
                 )
+                # a claim taken but never backed by a single resident
+                # shard would block another location's healthy copy
+                # until restart; release it (no-op when partially
+                # pinned or claimed by someone else)
+                cache.release_pin_source(ev.id, ev.dir)
 
         # prune finished threads so mount/unmount churn over a long
         # server lifetime doesn't accumulate dead Thread objects
@@ -515,6 +538,13 @@ class Store:
                     if loc.ec_volumes.get(vid) is ev:
                         del loc.ec_volumes[vid]
                 ev.close()
+                # whole-vid release: per-shard evicts match nothing when
+                # budget pressure already removed the resident bytes, so
+                # the claim would outlive the unmounted volume and block
+                # a later pinner
+                cache = self.ec_device_cache
+                if cache is not None and cache.pin_source(vid) == ev.dir:
+                    cache.evict(vid)
 
     def delete_ec_shards(self, vid: int, shard_ids: list[int], collection: str = "") -> None:
         """Unmount + remove the shard files; drop sidecars when the last
@@ -562,7 +592,12 @@ class Store:
         several disk locations; resolving by vid would always scrub the
         first location's copy)."""
         t0 = time.time()
-        if self.ec_device_cache is not None:
+        # the resident path only speaks for the location whose shard
+        # files were actually pinned: another location's copy of the same
+        # vid must scrub its own files, not borrow the resident verdict
+        # (EcVolume.is_device_resident owns the attribution rule;
+        # ADVICE r5)
+        if self.ec_device_cache is not None and ev.is_device_resident():
             from ..ops import rs_resident
 
             try:
@@ -596,14 +631,18 @@ class Store:
         needle_id: int,
         cookie: int | None = None,
         remote_read: RemoteReadFn | None = None,
+        use_device: bool = True,
     ) -> Needle:
         """(ReadEcShardNeedle store_ec.go:136-174); falls back to remote
-        shards then degraded reconstruction via the EcVolume."""
+        shards then degraded reconstruction via the EcVolume.
+        `use_device=False` forces the host reconstruct even when the
+        volume is resident (the dispatcher's shed path)."""
         ev = self.find_ec_volume(vid)
         if ev is None:
             raise NotFoundError(f"ec volume {vid} not found")
         return ev.read_needle(
-            needle_id, cookie, remote_read, backend=self.ec_backend
+            needle_id, cookie, remote_read, backend=self.ec_backend,
+            use_device=use_device,
         )
 
     def read_ec_needles_batch(

@@ -1,0 +1,294 @@
+"""Regression tests for the round-5 advisor findings (ADVICE.md):
+
+1. mq broker: records acked between a handoff flush and the next
+   reactivation are merged into the parked batch and replayed — never
+   silently wiped by _ensure_active's state reset
+2. store scrub attribution: the device-resident scrub verdict is only
+   used for the EcVolume whose shard files were actually pinned; another
+   disk location's copy of the same vid scrubs its own files
+3. mount: the HTTP session bounds connect/read-stall time instead of
+   total request time, so a large multi-minute _put can complete
+4. ec.scrub report: printed MB and GB/s share one byte basis
+   (DATA_SHARDS), so rate == size/seconds
+"""
+import asyncio
+import shutil
+from types import SimpleNamespace
+
+import numpy as np
+
+from seaweedfs_tpu.mq import MessageQueueBroker, MqClient
+from seaweedfs_tpu.server.cluster import LocalCluster
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ------------------------------------------------- 1. mq pending merge
+
+
+def test_mq_pending_survives_reactivation(tmp_path):
+    """Records appended between a failed handoff flush and the next
+    activation (append() doesn't gate on `active`, so a handler that
+    passed the check before the handoff can still land records) must be
+    replayed with the parked batch, not wiped by the activation reset."""
+
+    async def go():
+        cluster = LocalCluster(
+            base_dir=str(tmp_path), n_volume_servers=1, with_filer=True
+        )
+        await cluster.start()
+        broker = MessageQueueBroker(
+            filer_address=cluster.filer.url,
+            filer_grpc_address=(
+                f"{cluster.filer.ip}:{cluster.filer.grpc_port}"
+            ),
+            port=0,
+        )
+        await broker.start()
+        try:
+            c = MqClient(broker.grpc_url)
+            topic = c.topic("pending-merge")
+            await c.configure_topic(topic, partition_count=1)
+            await c.publish(topic, [(b"", b"d%d" % i) for i in range(5)])
+            p = broker.topics["default/pending-merge"][0]
+            await p.flush()  # 0..4 durable
+            await c.publish(topic, [(b"", b"x%d" % i) for i in range(3)])
+
+            real_append = broker._append_log
+
+            async def failing_append(part, blob, epoch=None):
+                raise RuntimeError("filer briefly unreachable")
+
+            broker._append_log = failing_append
+            await broker._deactivate(p)  # parks x0..x2
+            broker._append_log = real_append
+            assert p.parked is not None and not p.active
+
+            # the race window: two more acked records land while inactive
+            await p.append(b"", b"y0")
+            await p.append(b"", b"y1")
+            assert len(p.pending) == 2
+
+            await broker._ensure_active(p)
+            assert p.parked is None and p.active
+            assert p.next_offset == 10
+
+            got = []
+            async for _o, _k, v in c.subscribe(topic, 0, start_offset=0):
+                got.append(v)
+            assert got == (
+                [b"d%d" % i for i in range(5)]
+                + [b"x%d" % i for i in range(3)]
+                + [b"y0", b"y1"]
+            ), got
+        finally:
+            await broker.stop()
+            await cluster.stop()
+
+    run(go())
+
+
+def test_mq_straggler_during_activation_survives(tmp_path):
+    """A record acked DURING _ensure_active's fence/reconcile awaits
+    (after the pre-activation park, before the state reset) must be kept
+    and flushed under the new epoch — not wiped by the reset."""
+
+    async def go():
+        cluster = LocalCluster(
+            base_dir=str(tmp_path), n_volume_servers=1, with_filer=True
+        )
+        await cluster.start()
+        broker = MessageQueueBroker(
+            filer_address=cluster.filer.url,
+            filer_grpc_address=(
+                f"{cluster.filer.ip}:{cluster.filer.grpc_port}"
+            ),
+            port=0,
+        )
+        await broker.start()
+        try:
+            c = MqClient(broker.grpc_url)
+            topic = c.topic("straggler")
+            await c.configure_topic(topic, partition_count=1)
+            await c.publish(topic, [(b"", b"d%d" % i) for i in range(3)])
+            p = broker.topics["default/straggler"][0]
+            await p.flush()
+            p.active = False  # simulate a handoff
+
+            # land an append inside the activation's await window: right
+            # after the fence write, before the reset
+            real_write = broker._write_fence
+            raced = []
+
+            async def racy_write(part, epoch):
+                await real_write(part, epoch)
+                if part is p and not raced:
+                    raced.append(1)
+                    await part.append(b"", b"straggler")
+
+            broker._write_fence = racy_write
+            await broker._ensure_active(p)
+            broker._write_fence = real_write
+            assert raced and p.active
+            assert len(p.pending) == 1  # kept, awaiting flush
+            await p.flush()
+
+            got = []
+            async for _o, _k, v in c.subscribe(topic, 0, start_offset=0):
+                got.append(v)
+            assert got == [b"d0", b"d1", b"d2", b"straggler"], got
+        finally:
+            await broker.stop()
+            await cluster.stop()
+
+    run(go())
+
+
+# --------------------------------------------- 2. scrub attribution
+
+
+def test_scrub_device_path_only_for_pinning_location(tmp_path):
+    """A vid mounted in two disk locations: only the location whose
+    shard files were pinned gets the device-resident scrub verdict; the
+    other location scrubs its own files through the CPU kernel."""
+    from seaweedfs_tpu.ops.rs_resident import DeviceShardCache
+    from seaweedfs_tpu.storage.disk_location import DiskLocation
+    from seaweedfs_tpu.storage.ec import encoder
+    from seaweedfs_tpu.storage.store import Store
+    from seaweedfs_tpu.storage.volume_info import save_volume_info
+
+    vid = 7
+    dirs = []
+    rng = np.random.default_rng(11)
+    dat = rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()
+    for name in ("locA", "locB"):
+        d = tmp_path / name
+        d.mkdir()
+        dirs.append(str(d))
+    base = f"{dirs[0]}/{vid}"
+    with open(base + ".dat", "wb") as f:
+        f.write(dat)
+    encoder.write_ec_files(base, backend="cpu")
+    save_volume_info(base + ".vif", {"version": 3})
+    open(base + ".ecx", "ab").close()
+    import os
+
+    os.remove(base + ".dat")
+    for fn in os.listdir(dirs[0]):
+        shutil.copy(f"{dirs[0]}/{fn}", f"{dirs[1]}/{fn}")
+
+    cache = DeviceShardCache(budget_bytes=1 << 30, shard_quantum=1 << 20)
+    cache.warm_sizes = ()  # no reconstruct-shape compiles: scrub only
+    store = Store(
+        [DiskLocation(d, max_volume_count=8) for d in dirs],
+        ec_backend="cpu",
+        ec_device_cache=cache,
+    )
+    try:
+        for t in store._pin_threads:
+            t.join(timeout=120)
+        src = cache.pin_source(vid)
+        assert src in dirs  # exactly one location claimed the vid
+        assert len(cache.shard_ids(vid)) == 14  # and only one shard set
+        evs = {
+            loc.directory: loc.ec_volumes[vid] for loc in store.locations
+        }
+        owner = store.scrub_ec(evs[src])
+        assert owner["backend"] == "device_resident"
+        other_dir = next(d for d in dirs if d != src)
+        other = store.scrub_ec(evs[other_dir])
+        assert other["backend"] != "device_resident"
+        assert other["parity_mismatch_bytes"] == [0, 0, 0, 0]
+        # the unpinned location is also not "resident" for scrub
+        # attribution, while read routing accepts the resident copy
+        assert evs[src].is_device_resident()
+        assert not evs[other_dir].is_device_resident()
+        assert store.ec_volume_is_resident(vid)
+        # a NON-pinning location unmounting its copy must not wipe the
+        # owner's resident bytes or claim
+        evs[other_dir].delete_shard(0)
+        assert cache.resident_count(vid) == 14
+        assert cache.pin_source(vid) == src
+        # the owner unmounting its shard does evict it
+        evs[src].delete_shard(1)
+        assert cache.resident_count(vid) == 13
+    finally:
+        store.close()
+
+
+# ------------------------------------------------- 3. mount timeout
+
+
+def test_mount_session_bounds_stall_not_transfer():
+    """The FUSE HTTP session must not cap total request time (a 60s
+    total would EIO any large whole-file _put); it bounds connect and
+    per-read stall instead."""
+    from seaweedfs_tpu.mount.weedfs import WeedFS
+
+    async def go():
+        fs = WeedFS("127.0.0.1:1")
+        sess = await fs._sess()
+        try:
+            assert sess.timeout.total is None
+            assert sess.timeout.connect == 10
+            assert sess.timeout.sock_read == 60
+        finally:
+            await fs.close()
+
+    run(go())
+
+
+# ----------------------------------------------- 4. ec.scrub report
+
+
+def test_ec_scrub_report_single_byte_basis():
+    """The printed MB and GB/s describe the same bytes (DATA_SHARDS
+    basis): rate == MB / 1000 / seconds."""
+    from seaweedfs_tpu.shell.command_env import TopoNode
+    from seaweedfs_tpu.shell.commands import COMMANDS
+
+    bytes_verified = 10_000_000  # per-shard span
+    seconds = 2.0
+
+    class FakeStub:
+        async def VolumeEcShardsVerify(self, req):
+            return SimpleNamespace(
+                parity_mismatch_bytes=[0, 0, 0, 0],
+                bytes_verified=bytes_verified,
+                seconds=seconds,
+                backend="native",
+            )
+
+    lines = []
+    env = SimpleNamespace(
+        write=lines.append,
+        volume_stub=lambda addr: FakeStub(),
+        collect_topology=None,
+    )
+
+    async def topo():
+        return (
+            [
+                TopoNode(
+                    url="h:8080",
+                    grpc_port=18080,
+                    data_center="",
+                    rack="",
+                    ec_shards=[
+                        {"id": 7, "ec_index_bits": (1 << 14) - 1,
+                         "collection": ""}
+                    ],
+                )
+            ],
+            None,
+        )
+
+    env.collect_topology = topo
+    run(COMMANDS["ec.scrub"](env, []))
+    (line,) = lines
+    assert "OK" in line
+    # DATA_SHARDS basis on both figures
+    assert "100MB data in 2.00s" in line, line
+    assert "(0.05 GB/s)" in line, line

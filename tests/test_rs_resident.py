@@ -229,16 +229,22 @@ class TestEcVolumeWiring:
             assert n.data == blobs[nid][1]
         ev.close()
 
-    def test_server_batcher_coalesces(self, tmp_path):
-        """EcReadBatcher: concurrent reads land in one
-        Store.read_ec_needles_batch call; failures stay per-needle."""
+    def test_server_dispatcher_coalesces(self, tmp_path):
+        """EcReadDispatcher: concurrent reads of a resident volume land
+        in one Store.read_ec_needles_batch call; failures stay
+        per-needle.  (The dispatcher's own unit suite is
+        tests/test_serving_dispatcher.py — this keeps the resident-path
+        contract pinned next to the cache tests.)"""
         import asyncio
 
-        from seaweedfs_tpu.server.volume import EcReadBatcher
+        from seaweedfs_tpu.serving import EcReadDispatcher, ServingConfig
 
         calls = []
 
         class FakeStore:
+            def ec_volume_is_resident(self, vid):
+                return True
+
             def read_ec_needles_batch(self, vid, requests, remote_read=None):
                 calls.append(list(requests))
                 out = []
@@ -250,10 +256,10 @@ class TestEcVolumeWiring:
                 return out
 
         async def go():
-            b = EcReadBatcher(FakeStore(), lambda vid: None)
-
-            async def slow_first():
-                return await b.read(1, 1, None)
+            b = EcReadDispatcher(
+                FakeStore(), lambda vid: None,
+                ServingConfig(max_inflight=1, max_wait_us=0),
+            )
 
             # first read starts a drain; the rest arrive while it runs
             # and must coalesce into ONE follow-up batch

@@ -1,7 +1,8 @@
 """Integrated degraded-read serving e2e: HTTP reads through the volume
-server's EcReadBatcher -> Store.read_ec_needles_batch -> EcVolume
-resident cache -> batched reconstruct calls, with two shards destroyed
-so every read MUST reconstruct.
+server's continuous-batching EcReadDispatcher (seaweedfs_tpu/serving/)
+-> Store.read_ec_needles_batch -> EcVolume resident cache -> batched
+reconstruct calls, with two shards destroyed so every read MUST
+reconstruct.
 
 This is the CI-scaled promotion of the round-4 hardware drive
 (experiments/r4_serving_e2e.py): same cluster wiring, same
@@ -90,8 +91,9 @@ def test_degraded_http_serving_byte_exact(tmp_path, device_cache):
 
 def test_degraded_serving_batcher_coalesces(tmp_path):
     """The concurrent burst actually rides the batch path: after the
-    burst, the batcher has seen multi-needle batches (not 1-by-1), and
-    repeated bursts return stable results (compile caches warm)."""
+    burst, the dispatcher has seen multi-needle batches (not 1-by-1),
+    repeated bursts return stable results (compile caches warm), and the
+    new serving series are scrapeable from the live /metrics endpoint."""
 
     async def go():
         cluster, vs, blobs = await _build_degraded_cluster(
@@ -119,9 +121,70 @@ def test_degraded_serving_batcher_coalesces(tmp_path):
                     results = await asyncio.gather(*(read(f) for f in fids))
                     for f, got in zip(fids, results):
                         assert got == blobs[f]
+
+                # the batching decisions must be dashboard-visible: scrape
+                # the real /metrics endpoint for the new serving series
+                async with sess.get(f"http://{vs.url}/metrics") as r:
+                    assert r.status == 200
+                    text = await r.text()
             assert max(seen_widths) > 1, (
                 f"burst never coalesced: widths={seen_widths}"
             )
+            for series in (
+                "SeaweedFS_volumeServer_ec_batch_size_bucket",
+                "SeaweedFS_volumeServer_ec_batch_queue_wait_seconds_bucket",
+                "SeaweedFS_volumeServer_ec_batch_inflight",
+                "SeaweedFS_volumeServer_ec_batch_fallback_total",
+                'SeaweedFS_volumeServer_ec_read_route_total{route="batched"}',
+            ):
+                assert series in text, f"missing metrics series: {series}"
+            # the burst rode the batched route, and it was counted
+            batched_line = next(
+                l for l in text.splitlines()
+                if l.startswith(
+                    'SeaweedFS_volumeServer_ec_read_route_total{route="batched"}'
+                )
+            )
+            assert float(batched_line.split()[-1]) > 0
+        finally:
+            await cluster.stop()
+
+    run(go())
+
+
+def test_degraded_serving_batched_equals_unbatched(tmp_path):
+    """Concurrency consistency self-check on the REAL path: a concurrent
+    burst served through the coalescer/pipeline returns bytes identical
+    to the same needles read one-by-one through the unbatched native
+    reconstruct.  The baseline passes use_device=False (the dispatcher's
+    shed path), so it exercises the independent CPU reconstruct — a
+    kernel bug that corrupts both resident paths identically cannot
+    pass."""
+
+    async def go():
+        cluster, vs, blobs = await _build_degraded_cluster(
+            tmp_path, n_blobs=8, device_cache=True
+        )
+        try:
+            from seaweedfs_tpu.storage import types as t
+
+            async with aiohttp.ClientSession() as sess:
+
+                async def read(fid):
+                    async with sess.get(f"http://{vs.url}/{fid}") as r:
+                        assert r.status == 200
+                        return await r.read()
+
+                fids = list(blobs) * 3
+                batched = await asyncio.gather(*(read(f) for f in fids))
+            for fid, got in zip(fids, batched):
+                vid, nid, cookie = t.parse_fid(fid)
+                direct = vs.store.read_ec_needle(
+                    vid, nid, cookie, use_device=False
+                )
+                assert got == direct.data, (
+                    f"{fid}: batched read differs from unbatched"
+                )
         finally:
             await cluster.stop()
 
