@@ -601,6 +601,7 @@ async def build_degraded_cluster(
     warm_sizes: tuple | None = None,
     warm_counts: tuple | None = None,
     drop_shards: tuple = (0, 11),
+    with_filer: bool = False,
 ) -> tuple:
     """THE canonical degrade choreography, shared by the benchmark and
     tests/test_serving_e2e.py so the two can never drift: boot a
@@ -617,7 +618,7 @@ async def build_degraded_cluster(
 
     cluster = LocalCluster(
         base_dir=base_dir, n_volume_servers=1, pulse_seconds=1,
-        ec_backend="native",
+        ec_backend="native", with_filer=with_filer,
     )
     await cluster.start()
     vs = cluster.volume_servers[0]
@@ -696,6 +697,24 @@ async def build_degraded_cluster(
     return cluster, vs, blobs, vid
 
 
+def _stage_delta(before: dict, after: dict) -> dict:
+    """Per-stage (count, total_s, mean_us) accrued between two
+    stats.stage_breakdown() snapshots — the registry is process-global,
+    so a sweep must diff around its own reads to claim its own stages."""
+    out = {}
+    for stage, b1 in after.items():
+        b0 = before.get(stage, {"count": 0, "total_s": 0.0})
+        count = b1["count"] - b0["count"]
+        total = b1["total_s"] - b0["total_s"]
+        if count > 0:
+            out[stage] = {
+                "count": count,
+                "total_s": round(total, 6),
+                "mean_us": round(total / count * 1e6, 1),
+            }
+    return out
+
+
 async def _serving_sweep_async(
     device: bool,
     levels=(1, 16, 64, 256),
@@ -719,10 +738,12 @@ async def _serving_sweep_async(
 
     import aiohttp
 
+    from seaweedfs_tpu import stats as swfs_stats
     from seaweedfs_tpu.ops.rs_resident import COUNT_BUCKETS
 
     tmp = tempfile.mkdtemp(prefix="bench_serving_", dir=".")
     out = {"reads_per_s": {}, "p50_ms": {}}
+    stage_before = swfs_stats.stage_breakdown()
     # 4KB needles only; warm EVERY count bucket — the batcher's widths
     # are timing-dependent, so any bucket can appear mid-measurement and
     # an unwarmed one would put a 20-40s compile inside a timed burst
@@ -805,6 +826,13 @@ async def _serving_sweep_async(
                     out["max_inflight_default"]
                 )
                 out["inflight_reads_per_s"] = sweep
+        # per-stage breakdown of everything this sweep served (warm +
+        # timed reads), from the tracing layer's stage histograms: the
+        # next perf PR can name its bottleneck stage instead of
+        # re-deriving it from logs
+        out["stage_breakdown"] = _stage_delta(
+            stage_before, swfs_stats.stage_breakdown()
+        )
         out["needles"] = len(blobs)
     finally:
         await cluster.stop()
@@ -965,6 +993,10 @@ def bench_serving_sweep(levels=(1, 16, 64, 256), reads_per_level=384):
         "resident_max_inflight_default": resident.get(
             "max_inflight_default"
         ),
+        # per-stage timing over both passes (native pass stages come
+        # from the same histograms, diffed within each sweep)
+        "stage_breakdown_resident": resident.get("stage_breakdown", {}),
+        "stage_breakdown_native": native.get("stage_breakdown", {}),
         # both passes asserted every warm read byte-identical to the
         # stored blob (the batched-results consistency self-check)
         "consistency_ok": bool(

@@ -22,3 +22,39 @@ def metrics_kwargs(args) -> dict:
         metrics_address=args.metrics_address,
         metrics_interval_seconds=args.metrics_interval_seconds,
     )
+
+
+def add_obs_args(p) -> None:
+    """The -obs.* request-tracing flags every server role shares
+    (obs/config.py ObsConfig is the single source of the defaults)."""
+    from ..obs import ObsConfig
+
+    d = ObsConfig()
+    p.add_argument(
+        "-obs.disable", dest="obs_disable", action="store_true",
+        help="disable request tracing (/debug/traces stays empty; the "
+        "per-stage Prometheus histograms keep recording)",
+    )
+    p.add_argument(
+        "-obs.slowMs", dest="obs_slow_ms", type=float, default=d.slow_ms,
+        help="log any request whose end-to-end trace exceeds this many "
+        "milliseconds, with its per-stage breakdown (0 = off)",
+    )
+    p.add_argument(
+        "-obs.traceRing", dest="obs_trace_ring", type=int,
+        default=d.trace_ring,
+        help="completed traces kept in memory for /debug/traces",
+    )
+
+
+def apply_obs_args(args) -> None:
+    """Process-global, like the stats registry: call once at entry."""
+    from ..obs import ObsConfig, configure
+
+    configure(
+        ObsConfig(
+            enabled=not args.obs_disable,
+            slow_ms=args.obs_slow_ms,
+            trace_ring=args.obs_trace_ring,
+        )
+    )

@@ -82,6 +82,7 @@ def add_args(p) -> None:
         help="memory chunk cache budget",
     )
     common_args.add_metrics_args(p)
+    common_args.add_obs_args(p)
 
 
 def build_filer_server(args):
@@ -142,6 +143,7 @@ def build_filer_server(args):
 
 
 async def run(args) -> None:
+    common_args.apply_obs_args(args)
     fs = build_filer_server(args)
     await fs.start()
     await asyncio.Event().wait()

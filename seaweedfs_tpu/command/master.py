@@ -50,9 +50,11 @@ def add_args(p) -> None:
         help="compact the raft log into a snapshot past this many entries",
     )
     common_args.add_metrics_args(p)
+    common_args.add_obs_args(p)
 
 
 async def run(args) -> None:
+    common_args.apply_obs_args(args)
     from ..server.master import MasterServer
     from ..storage import types as storage_types
 

@@ -27,6 +27,7 @@ def add_args(p) -> None:
         '{"identities":[{"name",...,"credentials":[...],"actions":[...]}]})',
     )
     common_args.add_metrics_args(p)
+    common_args.add_obs_args(p)
 
 
 def build_s3_server(args):
@@ -48,6 +49,7 @@ def build_s3_server(args):
 
 
 async def run(args) -> None:
+    common_args.apply_obs_args(args)
     s3 = build_s3_server(args)
     await s3.start()
     await asyncio.Event().wait()

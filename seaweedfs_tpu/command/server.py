@@ -37,9 +37,11 @@ def add_args(p) -> None:
     p.add_argument("-s3.port", dest="s3_port", type=int, default=8333)
     p.add_argument("-s3.config", dest="s3_config", default="")
     common_args.add_metrics_args(p)
+    common_args.add_obs_args(p)
 
 
 async def run(args) -> None:
+    common_args.apply_obs_args(args)
     from ..server.master import MasterServer
     from ..server.volume import VolumeServer
 

@@ -124,9 +124,11 @@ def add_args(p) -> None:
         type=int, default=0, help="total in-flight download bytes (0 = off)",
     )
     common_args.add_metrics_args(p)
+    common_args.add_obs_args(p)
 
 
 async def run(args) -> None:
+    common_args.apply_obs_args(args)
     from ..server.volume import VolumeServer
 
     if args.offset_bytes != 4:

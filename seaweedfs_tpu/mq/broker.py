@@ -32,6 +32,7 @@ import zlib
 import aiohttp
 import grpc
 
+from .. import stats
 from ..pb import Stub, filer_pb2, generic_handler, mq_pb2
 from ..pb.rpc import GRPC_OPTIONS, channel
 from ..security import tls as tls_mod
@@ -678,6 +679,23 @@ class MessageQueueBroker:
                 await self._write_fence(p, new_epoch)
                 last = await self._last_offset(p)
                 last = await self._reconcile_parked(p, stored, last, new_epoch)
+                # residual epoch-fence window (one KvGet->append round
+                # trip wide): a stale owner whose fence check read the
+                # OLD epoch can land its append after the _last_offset
+                # read above.  Re-read the log tail so the window is
+                # OBSERVED, not just commented: an unexpected offset
+                # bumps the conflict counter and resyncs next_offset
+                # over the interloper's records instead of colliding.
+                tail = await self._last_offset(p)
+                if tail != last:
+                    stats.MQ_FENCE_CONFLICT.inc()
+                    log.error(
+                        "partition %s/%d: durable log tail moved %d -> %d "
+                        "during activation (a fenced-out append landed in "
+                        "the KvGet->append window); offsets resynced",
+                        p.tkey, p.idx, last, tail,
+                    )
+                    last = max(last, tail)
                 async with p.cond:
                     p.epoch = new_epoch
                     p.next_offset = max(p.next_offset, last + 1)

@@ -1,0 +1,31 @@
+"""Knobs for the request-tracing layer (the -obs.* flags).
+
+Mirrors serving/config.py's shape: one dataclass is the single source of
+the defaults, the CLI flags exist so an operator can tune without a
+rebuild, and `validated()` is the one validation layer.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class ObsConfig:
+    """Tunables for `seaweedfs_tpu.obs` (CLI: the -obs.* flags)."""
+
+    # record per-request traces into the /debug/traces ring and forward
+    # the trace header on fan-out; False keeps only the per-stage
+    # Prometheus histograms (spans become pure timers)
+    enabled: bool = True
+    # any request whose end-to-end trace exceeds this many milliseconds
+    # is logged with its per-span breakdown; 0 disables the slow log
+    slow_ms: float = 0.0
+    # completed traces kept in memory for /debug/traces (newest win)
+    trace_ring: int = 256
+
+    def validated(self) -> "ObsConfig":
+        if self.slow_ms < 0:
+            raise ValueError("slow_ms must be >= 0")
+        if self.trace_ring < 1:
+            raise ValueError("trace_ring must be >= 1")
+        return self

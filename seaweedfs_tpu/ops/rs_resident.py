@@ -35,6 +35,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from . import gf256, rs_tpu
+from ..obs import trace as obs_trace
+from ..stats import metrics as stats_metrics
 
 DATA_SHARDS = 10
 TOTAL_SHARDS = 14
@@ -642,6 +644,31 @@ def _use_fused(kernel: str, interpret: bool) -> bool:
     return kernel == "pallas"
 
 
+# shapes this process has already dispatched: first use of a shape is a
+# jit compile (tens of seconds on remote-compile rigs) — the trace
+# annotation + compile counter are what let a tail spike be attributed
+# to "hit an unwarmed shape" instead of guessed at
+_dispatched_shapes: set = set()
+_shapes_lock = threading.Lock()
+
+
+def _note_shape(key: tuple) -> bool:
+    """Record one device call's shape; True when it was a compile miss
+    (first use).  Locked: concurrent drain lanes dispatching the same
+    first-ever shape must count ONE miss, or the hit/miss ratio skews
+    exactly under the load it exists to diagnose."""
+    with _shapes_lock:
+        if key in _dispatched_shapes:
+            miss = False
+        else:
+            _dispatched_shapes.add(key)
+            miss = True
+    stats_metrics.VOLUME_SERVER_EC_DEVICE_COMPILE.labels(
+        result="miss" if miss else "hit"
+    ).inc()
+    return miss
+
+
 def reconstruct_intervals(
     cache: DeviceShardCache,
     vid: int,
@@ -669,6 +696,16 @@ def reconstruct_intervals(
         cache, vid, requests, data_shards, total_shards
     )
     fused = _use_fused(kernel, interpret)
+    # the device-execute stage of the request trace: every dispatched
+    # call's H2D/D2H bytes and compile-cache outcome annotate the span
+    # (and the SeaweedFS_volumeServer_ec_device_* counters), so a slow
+    # read can say "compile cliff" or "tunnel-bound fetch" by itself
+    dev_span = obs_trace.span(
+        "device_execute", requests=len(requests),
+        kernel=("fused" if fused else kernel),
+    )
+    dev_calls = dev_misses = dev_h2d = dev_d2h = 0
+    surv_len = int(survivors[0].size)
 
     subs = _plan(requests)
     sub_out: list[bytes | None] = [None] * len(subs)
@@ -697,59 +734,79 @@ def reconstruct_intervals(
                 sub_out[sub_idx] = out[j, lo : lo + take].tobytes()
         return len(part) * fetch
 
-    for bucket in SIZE_BUCKETS:
-        group = [(i, s) for i, s in enumerate(subs) if s[4] == bucket]
-        if not group:
-            continue
-        n_bucket = _bucket(
-            COUNT_BUCKETS, min(len(group), _max_count(bucket))
+    with dev_span:
+        for bucket in SIZE_BUCKETS:
+            group = [(i, s) for i, s in enumerate(subs) if s[4] == bucket]
+            if not group:
+                continue
+            n_bucket = _bucket(
+                COUNT_BUCKETS, min(len(group), _max_count(bucket))
+            )
+            for start in range(0, len(group), n_bucket):
+                part = group[start : start + n_bucket]
+                pad = n_bucket - len(part)
+                if fused:
+                    # fetch covers the realigned delta+take (the host trims
+                    # the delta head after D2H; no in-kernel shift needed)
+                    meta, deltas, fetch = _fused_vectors(
+                        part, requests, row_of, pad
+                    )
+                    tile = _fused_tile_for(fetch)
+                    dev_misses += _note_shape(
+                        ("fused", tile, fetch, n_bucket, len(use), surv_len)
+                    )
+                    dev_h2d += int(meta.nbytes)
+                    arr = _fused_reconstruct(
+                        a_bm,
+                        survivors,
+                        meta,
+                        tile=tile,
+                        fetch=fetch,
+                        k_true=len(use),
+                        interpret=interpret,
+                    )
+                    pending.append((part, arr, fetch, deltas))
+                    pending_bytes += len(part) * fetch
+                else:
+                    offsets, rows, deltas = _group_vectors(
+                        part, requests, row_of, pad
+                    )
+                    # D2H width: power-of-two cover of the largest actual
+                    # request in this call, never wider than the compute tile
+                    max_take = max(s[3] for _, s in part)
+                    fetch = min(bucket, 1 << (max_take - 1).bit_length())
+                    dev_misses += _note_shape(
+                        (kernel, bucket, fetch, n_bucket, len(use), surv_len)
+                    )
+                    dev_h2d += 3 * 4 * n_bucket  # offsets/rows/deltas int32
+                    arr = _gather_reconstruct(
+                        a_bm,
+                        survivors,
+                        offsets,
+                        rows,
+                        deltas,
+                        tile=bucket,
+                        fetch=fetch,
+                        kernel=kernel,
+                        interpret=interpret,
+                        k_true=len(use),
+                    )
+                    pending.append((part, arr, fetch, None))
+                    pending_bytes += len(part) * fetch
+                dev_calls += 1
+                # the padded rows ride the wire too: count what the
+                # fetch actually moves, not just the useful subset
+                dev_d2h += n_bucket * fetch
+                while pending_bytes > _MAX_PENDING_OUT and len(pending) > 1:
+                    pending_bytes -= _finish(pending.pop(0))
+        for entry in pending:
+            _finish(entry)
+        dev_span.annotate(
+            device_calls=dev_calls, compile_misses=dev_misses,
+            h2d_bytes=dev_h2d, d2h_bytes=dev_d2h,
         )
-        for start in range(0, len(group), n_bucket):
-            part = group[start : start + n_bucket]
-            pad = n_bucket - len(part)
-            if fused:
-                # fetch covers the realigned delta+take (the host trims
-                # the delta head after D2H; no in-kernel shift needed)
-                meta, deltas, fetch = _fused_vectors(
-                    part, requests, row_of, pad
-                )
-                arr = _fused_reconstruct(
-                    a_bm,
-                    survivors,
-                    meta,
-                    tile=_fused_tile_for(fetch),
-                    fetch=fetch,
-                    k_true=len(use),
-                    interpret=interpret,
-                )
-                pending.append((part, arr, fetch, deltas))
-                pending_bytes += len(part) * fetch
-            else:
-                offsets, rows, deltas = _group_vectors(
-                    part, requests, row_of, pad
-                )
-                # D2H width: power-of-two cover of the largest actual
-                # request in this call, never wider than the compute tile
-                max_take = max(s[3] for _, s in part)
-                fetch = min(bucket, 1 << (max_take - 1).bit_length())
-                arr = _gather_reconstruct(
-                    a_bm,
-                    survivors,
-                    offsets,
-                    rows,
-                    deltas,
-                    tile=bucket,
-                    fetch=fetch,
-                    kernel=kernel,
-                    interpret=interpret,
-                    k_true=len(use),
-                )
-                pending.append((part, arr, fetch, None))
-                pending_bytes += len(part) * fetch
-            while pending_bytes > _MAX_PENDING_OUT and len(pending) > 1:
-                pending_bytes -= _finish(pending.pop(0))
-    for entry in pending:
-        _finish(entry)
+        stats_metrics.VOLUME_SERVER_EC_DEVICE_H2D_BYTES.inc(dev_h2d)
+        stats_metrics.VOLUME_SERVER_EC_DEVICE_D2H_BYTES.inc(dev_d2h)
     outputs: list[list[bytes]] = [[] for _ in requests]
     for (idx, *_), piece in zip(subs, sub_out):
         outputs[idx].append(piece)  # subs are in offset order per request

@@ -9,6 +9,9 @@ so adding an RPC to a .proto requires no further plumbing.
 """
 from __future__ import annotations
 
+import functools
+import inspect
+
 import grpc
 from google.protobuf import message_factory
 
@@ -34,6 +37,92 @@ def _methods(pb2_module, service_name: str):
         )
 
 
+# service name -> the role its servicer plays, for trace attribution
+_SERVICE_ROLES = {
+    "Seaweed": "master",
+    "SeaweedFiler": "filer",
+    "VolumeServer": "volume",
+    "SeaweedRaft": "master",
+    "SeaweedMessaging": "mq",
+}
+
+
+def _trace_wrap_call(call):
+    """Attach the active trace id as gRPC metadata on every outbound RPC
+    (obs/trace.py contextvar) — fan-out propagation without touching any
+    call site.  Explicit caller metadata wins; untraced contexts add
+    nothing."""
+
+    def invoke(request, **kw):
+        if "metadata" not in kw:
+            from ..obs import trace as obs_trace
+
+            md = obs_trace.grpc_metadata()
+            if md is not None:
+                kw["metadata"] = md
+        return call(request, **kw)
+
+    return invoke
+
+
+def _adopt_inbound_trace(context, role: str, method: str):
+    """Adopt a trace id arriving on inbound gRPC metadata: start this
+    server's own trace entry for the request (the Dapper per-process
+    record, correlated by the shared id).  Returns (trace, token) —
+    (None, None) when the caller sent no trace id."""
+    from ..obs import trace as obs_trace
+
+    try:
+        md = dict(context.invocation_metadata() or ())
+    except Exception:  # noqa: BLE001 — context impl without metadata
+        return None, None
+    tid, psid = obs_trace.parse_trace_header(
+        md.get(obs_trace.GRPC_TRACE_KEY, "")
+    )
+    if tid is None:
+        return None, None
+    return obs_trace.start_trace(
+        f"grpc {method}", role, trace_id=tid, parent_span_id=psid
+    )
+
+
+def _trace_wrap_handler(fn, role: str, method: str):
+    """Server side of the propagation: requests carrying a trace id get
+    their own trace entry around the handler (unary and streaming)."""
+    from ..obs import trace as obs_trace
+
+    if inspect.isasyncgenfunction(fn):
+
+        @functools.wraps(fn)
+        async def stream_handler(request, context):
+            t, token = _adopt_inbound_trace(context, role, method)
+            status = "OK"
+            try:
+                async for item in fn(request, context):
+                    yield item
+            except BaseException:
+                status = "error"
+                raise
+            finally:
+                obs_trace.finish_trace(t, token, status)
+
+        return stream_handler
+
+    @functools.wraps(fn)
+    async def unary_handler(request, context):
+        t, token = _adopt_inbound_trace(context, role, method)
+        status = "OK"
+        try:
+            return await fn(request, context)
+        except BaseException:
+            status = "error"
+            raise
+        finally:
+            obs_trace.finish_trace(t, token, status)
+
+    return unary_handler
+
+
 class Stub:
     """Client stub: one attribute per RPC, built from the descriptor."""
 
@@ -50,10 +139,12 @@ class Stub:
             setattr(
                 self,
                 name,
-                factory(
-                    path,
-                    request_serializer=req.SerializeToString,
-                    response_deserializer=resp.FromString,
+                _trace_wrap_call(
+                    factory(
+                        path,
+                        request_serializer=req.SerializeToString,
+                        response_deserializer=resp.FromString,
+                    )
                 ),
             )
 
@@ -62,11 +153,13 @@ def generic_handler(pb2_module, service_name: str, servicer) -> grpc.GenericRpcH
     """Wrap `servicer` (methods named like the proto RPCs) for a
     grpc.aio.Server.  Unimplemented methods raise UNIMPLEMENTED."""
     sd = pb2_module.DESCRIPTOR.services_by_name[service_name]
+    role = _SERVICE_ROLES.get(service_name, service_name.lower())
     handlers = {}
     for name, _, req, resp, cstream, sstream in _methods(pb2_module, service_name):
         fn = getattr(servicer, name, None)
         if fn is None:
             continue
+        fn = _trace_wrap_handler(fn, role, name)
         kw = dict(
             request_deserializer=req.FromString,
             response_serializer=resp.SerializeToString,

@@ -190,6 +190,11 @@ class S3ApiServer:
             pass
         self._iam_refresh = asyncio.create_task(self._iam_refresh_loop())
         app = web.Application(client_max_size=1024 * 1024 * 1024)
+        from .. import obs
+
+        # streamed object bodies prepare inside the handler; the trace
+        # id must be stamped at prepare time (same rule as the filer)
+        app.on_response_prepare.append(obs.response_prepare_signal)
         app.router.add_route("*", "/{tail:.*}", self._dispatch)
         self._http_runner = web.AppRunner(app)
         await self._http_runner.setup()
@@ -225,9 +230,28 @@ class S3ApiServer:
     # -------------------------------------------------------------- routing
 
     async def _dispatch(self, request: web.Request) -> web.StreamResponse:
-        from .. import stats
+        from .. import obs, stats
         from .circuit_breaker import CircuitBreakerError
 
+        if request.match_info["tail"] == "debug/traces":
+            # reserved observability path (this catch-all owns the
+            # namespace; a bucket literally named "debug" loses the
+            # "traces" key to it).  The s3 port is the PUBLIC customer
+            # endpoint and traces reveal internals (object keys, server
+            # addresses), so unlike the admin-facing servers this one is
+            # opt-in only — the same SWFS_DEBUG gate as /debug/stacks.
+            import os
+
+            if os.environ.get("SWFS_DEBUG") != "1":
+                raise web.HTTPNotFound()
+            return await obs.traces_handler(request)
+        tid, psid = obs.parse_trace_header(
+            request.headers.get(obs.TRACE_HEADER, "")
+        )
+        trace, token = obs.start_trace(
+            f"{request.method} /{request.match_info['tail']}", "s3",
+            self.url, trace_id=tid, parent_span_id=psid,
+        )
         bucket = request.match_info["tail"].partition("/")[0]
         code = 500  # unhandled exceptions surface as aiohttp 500s
         try:
@@ -246,17 +270,24 @@ class S3ApiServer:
                 release = self.circuit_breaker.acquire(bucket, action, length)
             except CircuitBreakerError as e:
                 code = 503
-                return _error_response("SlowDown", str(e), 503)
+                resp = _error_response("SlowDown", str(e), 503)
+                # throttled responses are exactly the ones an operator
+                # wants to correlate — echo the header here too
+                obs.stamp_trace_header(resp, trace)
+                return resp
             try:
                 resp = await self._dispatch_authed(request)
             finally:
                 release()
             code = resp.status
+            obs.stamp_trace_header(resp, trace)
             return resp
         except web.HTTPException as e:
             code = e.status
+            obs.stamp_trace_header(e, trace)
             raise
         finally:
+            obs.finish_trace(trace, token, code)
             stats.S3_REQUEST_COUNTER.labels(
                 type=request.method,
                 code=str(code),

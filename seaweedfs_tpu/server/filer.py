@@ -42,7 +42,7 @@ from ..filer import (
     new_full_path,
     view_from_chunks,
 )
-from .. import stats
+from .. import obs, stats
 from ..operation.assign import assign as assign_rpc
 from ..operation.delete import delete_files
 from ..operation.upload import upload_data
@@ -189,6 +189,9 @@ class FilerServer:
                 [guard_mod.middleware(self.guard)] if self.guard.enabled else []
             ),
         )
+        # streamed file bodies prepare inside the handler, so the trace
+        # id must be stamped at prepare time (obs/trace.py)
+        app.on_response_prepare.append(obs.response_prepare_signal)
         app.router.add_route("*", "/{path:.*}", self._http_dispatch)
         self._http_runner = web.AppRunner(app)
         await self._http_runner.setup()
@@ -202,6 +205,10 @@ class FilerServer:
         if self.metrics_port is not None:
             mapp = web.Application()
             mapp.router.add_get("/metrics", stats.metrics_handler)
+            # traces ride the metrics port for the same reason metrics
+            # do: the data app's catch-all owns the whole namespace, so
+            # a filer path "/debug/traces" must stay a file path
+            mapp.router.add_get("/debug/traces", obs.traces_handler)
             self._metrics_runner = web.AppRunner(mapp)
             await self._metrics_runner.setup()
             msite = web.TCPSite(self._metrics_runner, self.ip, self.metrics_port)
@@ -364,20 +371,24 @@ class FilerServer:
             )
         last_err = None
         for url in urls:
-            hdr = {}
+            hdr = obs.outbound_headers()
             if not (view.offset_in_chunk == 0 and view.view_size == view.chunk_size):
                 hdr["Range"] = (
                     f"bytes={view.offset_in_chunk}-"
                     f"{view.offset_in_chunk + view.view_size - 1}"
                 )
             try:
-                async with self._session.get(url, headers=hdr) as r:
-                    if r.status >= 300:
-                        raise RuntimeError(f"{url}: HTTP {r.status}")
-                    data = await r.read()
-                    if view.is_full_chunk:
-                        await self._cache_put(view.file_id, data)
-                    return data
+                with obs.span(
+                    "chunk_fetch", file_id=view.file_id,
+                    bytes=view.view_size,
+                ):
+                    async with self._session.get(url, headers=hdr) as r:
+                        if r.status >= 300:
+                            raise RuntimeError(f"{url}: HTTP {r.status}")
+                        data = await r.read()
+                if view.is_full_chunk:
+                    await self._cache_put(view.file_id, data)
+                return data
             except Exception as e:  # noqa: BLE001 — try the next replica
                 last_err = e
         raise web.HTTPInternalServerError(text=f"chunk {view.file_id}: {last_err}")
@@ -387,10 +398,13 @@ class FilerServer:
         last_err: Exception | None = None
         for url in urls:
             try:
-                async with self._session.get(url) as r:
-                    if r.status < 300:
-                        return await r.read()
-                    last_err = RuntimeError(f"{url}: HTTP {r.status}")
+                with obs.span("chunk_fetch", file_id=file_id):
+                    async with self._session.get(
+                        url, headers=obs.outbound_headers()
+                    ) as r:
+                        if r.status < 300:
+                            return await r.read()
+                        last_err = RuntimeError(f"{url}: HTTP {r.status}")
             except Exception as e:  # noqa: BLE001 — try the next replica
                 last_err = e
         raise RuntimeError(f"{file_id}: unreachable ({last_err})")
@@ -419,6 +433,33 @@ class FilerServer:
     # ------------------------------------------------------- HTTP handlers
 
     async def _http_dispatch(self, request: web.Request) -> web.StreamResponse:
+        # manual trace scope (the catch-all route owns the namespace, so
+        # the obs middleware's path exclusions don't apply here): adopt
+        # an inbound trace id or start one, echo it on the response, and
+        # record the filer-side spans for the fan-out this request does
+        tid, psid = obs.parse_trace_header(
+            request.headers.get(obs.TRACE_HEADER, "")
+        )
+        trace, token = obs.start_trace(
+            f"{request.method} /{request.match_info['path']}", "filer",
+            self.url, trace_id=tid, parent_span_id=psid,
+        )
+        status = 500
+        try:
+            resp = await self._http_dispatch_inner(request)
+            status = resp.status
+            obs.stamp_trace_header(resp, trace)
+            return resp
+        except web.HTTPException as e:
+            status = e.status
+            obs.stamp_trace_header(e, trace)
+            raise
+        finally:
+            obs.finish_trace(trace, token, status)
+
+    async def _http_dispatch_inner(
+        self, request: web.Request
+    ) -> web.StreamResponse:
         try:
             if request.method in ("GET", "HEAD"):
                 with stats.time_request(

@@ -160,11 +160,13 @@ class MasterServer:
         )
         await self._grpc_server.start()
 
+        from .. import obs
+
         app = web.Application(
             client_max_size=256 * 1024 * 1024,
             middlewares=(
                 [guard_mod.middleware(self.guard)] if self.guard.enabled else []
-            ),
+            ) + [obs.middleware("master")],
         )
         app.router.add_get("/", self.h_ui)
         app.router.add_route("*", "/dir/assign", self.h_assign)
@@ -176,6 +178,7 @@ class MasterServer:
         app.router.add_post("/submit", self.h_submit)
         app.router.add_get("/cluster/status", self.h_cluster_status)
         app.router.add_get("/metrics", stats.metrics_handler)
+        app.router.add_get("/debug/traces", obs.traces_handler)
         if os.environ.get("SWFS_DEBUG") == "1":
             # stack dumps reveal internals; opt-in only (the reference
             # gates pprof handlers the same way)

@@ -42,7 +42,7 @@ from ..storage.ec import (
     write_dat_file,
     write_idx_file_from_ec_index,
 )
-from .. import stats
+from .. import obs, stats
 from ..serving import EcReadDispatcher
 from ..security import verify_volume_write_jwt
 from ..security import tls as tls_mod
@@ -238,10 +238,11 @@ class VolumeServer:
             client_max_size=self.client_max_size_mb * 1024 * 1024,
             middlewares=(
                 [guard_mod.middleware(self.guard)] if self.guard.enabled else []
-            ),
+            ) + [obs.middleware("volume")],
         )
         app.router.add_get("/status", self.h_status)
         app.router.add_get("/metrics", stats.metrics_handler)
+        app.router.add_get("/debug/traces", obs.traces_handler)
         if os.environ.get("SWFS_DEBUG") == "1":
             # stack dumps reveal internals; opt-in only (the reference
             # gates pprof handlers the same way)
@@ -754,6 +755,8 @@ class VolumeServer:
             )
             if k in request.headers
         }
+        # the peer records its own spans under the same trace id
+        fwd.update(obs.outbound_headers())
         # auto_decompress=False: the relay must pass the holder's bytes
         # VERBATIM — transparent gunzip would serve decompressed data
         # still labeled Content-Encoding: gzip

@@ -20,6 +20,10 @@ class ReadRequest:
     cookie: int | None
     future: asyncio.Future
     enqueued: float  # loop.time() at admission, for the queue-wait series
+    # (trace, parent_span_id) captured at admission: the drain task that
+    # serves this request runs outside the request's context, so the
+    # trace must ride the queue with the request (obs/trace.py)
+    obs_ctx: object | None = None
 
 
 class Coalescer:

@@ -29,8 +29,9 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 
-from .. import stats
+from .. import obs, stats
 from .coalescer import Coalescer, ReadRequest
 from .config import ServingConfig
 
@@ -73,7 +74,10 @@ class EcReadDispatcher:
             stats.VOLUME_SERVER_EC_READ_ROUTE.labels(route="native").inc()
             return await self._read_native(vid, nid, cookie)
         loop = asyncio.get_running_loop()
-        req = ReadRequest(vid, nid, cookie, loop.create_future(), loop.time())
+        req = ReadRequest(
+            vid, nid, cookie, loop.create_future(), loop.time(),
+            obs_ctx=obs.current(),
+        )
         if not self.coalescer.offer(req):
             # saturated: shed to the native path rather than queue without
             # bound — the fallback count is the dashboard's overload signal
@@ -108,7 +112,13 @@ class EcReadDispatcher:
         if len(self.coalescer) and self._inflight < self.cfg.max_inflight:
             self._inflight += 1
             stats.VOLUME_SERVER_EC_BATCH_INFLIGHT.set(self._inflight)
-            asyncio.ensure_future(self._drain())
+            # detached: the new task copies this context, and a drain
+            # lane spawned from a traced request would otherwise append
+            # every LATER request's batch spans to the spawner's
+            # (finished) trace — member traces ride ReadRequest.obs_ctx
+            # instead
+            with obs.detached():
+                asyncio.ensure_future(self._drain())
 
     async def _drain(self) -> None:
         """One pipeline lane: serve batches until the queue empties.
@@ -136,11 +146,16 @@ class EcReadDispatcher:
                     await asyncio.sleep(cfg.max_wait_s)
                 first = False
                 now = asyncio.get_running_loop().time()
+                now_pc = time.perf_counter()
                 for vid, items in self.coalescer.take().items():
                     stats.VOLUME_SERVER_EC_BATCH_SIZE.observe(len(items))
                     for r in items:
-                        stats.VOLUME_SERVER_EC_BATCH_QUEUE_WAIT.observe(
-                            now - r.enqueued
+                        wait = now - r.enqueued
+                        stats.VOLUME_SERVER_EC_BATCH_QUEUE_WAIT.observe(wait)
+                        # the trace's view of the same wait: admission ->
+                        # batch take, per request
+                        obs.record_span(
+                            r.obs_ctx, "queue_wait", now_pc - wait, wait
                         )
                     await self._serve_batch(vid, items)
         finally:
@@ -149,15 +164,30 @@ class EcReadDispatcher:
             self._maybe_spawn()  # raced with an offer after the loop check
 
     async def _serve_batch(self, vid: int, items: list[ReadRequest]) -> None:
-        try:
-            results = await asyncio.to_thread(
-                self.store.read_ec_needles_batch,
-                vid,
-                [(r.nid, r.cookie) for r in items],
-                self._remote_reader(vid),
-            )
-        except Exception as e:  # noqa: BLE001 — volume-level failure
-            results = [e] * len(items)
+        # one batch serves many traces: the worker's stage spans
+        # (device_execute / host_reconstruct / shard_read) land in a
+        # sink and are replayed onto every member trace afterwards —
+        # observe=False so the stage histograms count each stage once
+        t0 = time.perf_counter()
+        with obs.stage_sink() as sink:
+            try:
+                with obs.span("batch_dispatch", needles=len(items), vid=vid):
+                    results = await asyncio.to_thread(
+                        self.store.read_ec_needles_batch,
+                        vid,
+                        [(r.nid, r.cookie) for r in items],
+                        self._remote_reader(vid),
+                    )
+            except Exception as e:  # noqa: BLE001 — volume-level failure
+                results = [e] * len(items)
+        for r in items:
+            if r.obs_ctx is None:
+                continue
+            for stage, (dur, calls, ann) in sink.items():
+                obs.record_span(
+                    r.obs_ctx, stage, t0, dur, observe=False,
+                    annotations={"calls": calls, **ann},
+                )
         for r, res in zip(items, results):
             if r.future.done():  # client went away mid-batch
                 continue

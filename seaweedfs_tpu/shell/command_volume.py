@@ -814,3 +814,43 @@ async def cmd_volume_configure_replication(env, args):
             f"replication change failed on {', '.join(failures)}; "
             f"replicas may now disagree"
         )
+
+
+@command("volume.trace")
+async def cmd_volume_trace(env, args):
+    """-node <host:port> [-limit N] : fetch /debug/traces from a running
+    volume server and pretty-print the recent request traces (trace id,
+    per-span stage durations, annotations) newest-first"""
+    import aiohttp
+
+    flags = parse_flags(args)
+    node = flags.get("node") or flags.get("")
+    if not node:
+        raise ValueError("volume.trace -node <host:port(http)> [-limit N]")
+    limit = int(flags.get("limit", 10))
+    async with aiohttp.ClientSession() as sess:
+        async with sess.get(
+            f"http://{node}/debug/traces", params={"limit": str(limit)}
+        ) as r:
+            if r.status != 200:
+                raise ValueError(
+                    f"{node}/debug/traces returned HTTP {r.status}"
+                )
+            payload = await r.json()
+    traces = payload.get("traces", [])
+    if not traces:
+        env.write(f"{node}: no traces recorded")
+        return
+    for t in traces:
+        env.write(
+            f"trace {t['trace_id']} [{t['role']}] {t['name']} "
+            f"{t['duration_us'] / 1000:.2f}ms status={t.get('status', '')}"
+        )
+        for sp in t.get("spans", []):
+            ann = " ".join(
+                f"{k}={v}" for k, v in (sp.get("annotations") or {}).items()
+            )
+            env.write(
+                f"  +{sp['offset_us']:>8}us {sp['duration_us']:>8}us "
+                f"{sp['name']}{'  ' + ann if ann else ''}"
+            )

@@ -70,7 +70,10 @@ VOLUME_SERVER_REQUEST_HISTOGRAM = Histogram(
     "Bucketed histogram of volume server request processing time.",
     ["type"],
     registry=REGISTRY,
-    buckets=(0.0001, 0.001, 0.01, 0.1, 1.0, 10.0),
+    # sub-100µs floor: the 0.0001 floor lumped every device-resident EC
+    # read (µs-scale once batched) into one bucket
+    buckets=(0.000005, 0.00001, 0.000025, 0.00005, 0.0001, 0.001, 0.01,
+             0.1, 1.0, 10.0),
 )
 VOLUME_SERVER_VOLUME_GAUGE = Gauge(
     "SeaweedFS_volumeServer_volumes",
@@ -131,6 +134,93 @@ VOLUME_SERVER_EC_READ_ROUTE = Counter(
     ["route"],
     registry=REGISTRY,
 )
+
+# request tracing stages (obs/trace.py spans): one histogram family,
+# labeled by stage, µs-resolution buckets — the per-stage view that lets
+# a tail regression name its stage instead of hiding in the aggregate
+# request histogram.  Stages are pre-registered so /metrics always
+# exposes every stage series (and the README drift check sees them)
+# even before the first request exercises a path.
+TRACE_STAGES = (
+    "queue_wait",        # coalescer admission -> batch take (dispatcher)
+    "batch_dispatch",    # one coalesced batch through the store call
+    "device_execute",    # rs_resident reconstruct (device dispatch+fetch)
+    "host_reconstruct",  # CPU-kernel GF(256) reconstruct fallback
+    "shard_read",        # .ecx index lookups + local shard preads
+    "remote_shard_read", # peer shard interval fetch (VolumeEcShardRead)
+    "chunk_fetch",       # filer -> volume server chunk read
+)
+REQUEST_STAGE_SECONDS = Histogram(
+    "SeaweedFS_request_stage_seconds",
+    "Per-stage serving time from the request-tracing spans "
+    "(obs/trace.py); stage names cover the EC read path end to end.",
+    ["stage"],
+    registry=REGISTRY,
+    buckets=(0.000005, 0.00001, 0.000025, 0.00005, 0.0001, 0.00025,
+             0.0005, 0.001, 0.0025, 0.005, 0.01, 0.05, 0.25, 1.0),
+)
+for _stage in TRACE_STAGES:
+    REQUEST_STAGE_SECONDS.labels(stage=_stage)
+
+# device-call accounting for the resident EC reconstruct path
+# (ops/rs_resident.py): the tunnel bytes and the compile-cache behavior
+# per shape are what decide whether a batch was cheap or a 20-40s cliff
+VOLUME_SERVER_EC_DEVICE_H2D_BYTES = Counter(
+    "SeaweedFS_volumeServer_ec_device_h2d_bytes",
+    "Host->device bytes shipped by resident EC reconstruct calls "
+    "(offset/row vectors only — survivor bytes stay pinned).",
+    registry=REGISTRY,
+)
+VOLUME_SERVER_EC_DEVICE_D2H_BYTES = Counter(
+    "SeaweedFS_volumeServer_ec_device_d2h_bytes",
+    "Device->host bytes fetched by resident EC reconstruct calls "
+    "(the reconstructed intervals).",
+    registry=REGISTRY,
+)
+VOLUME_SERVER_EC_DEVICE_COMPILE = Counter(
+    "SeaweedFS_volumeServer_ec_device_compile",
+    "Resident EC reconstruct device calls by compile-cache outcome: "
+    "miss = first use of a (kernel, tile, fetch, count, k) shape in "
+    "this process (a jit compile, tens of seconds on remote-compile "
+    "rigs), hit = an already-compiled shape.",
+    ["result"],
+    registry=REGISTRY,
+)
+for _r in ("hit", "miss"):
+    VOLUME_SERVER_EC_DEVICE_COMPILE.labels(result=_r)
+
+MQ_FENCE_CONFLICT = Counter(
+    "SeaweedFS_mq_fence_conflict",
+    "Partition activations that found the durable log tail moved after "
+    "the fence was written (a fenced-out owner's append landed in the "
+    "KvGet->append window; offsets were resynced).",
+    registry=REGISTRY,
+)
+
+
+def stage_breakdown() -> dict:
+    """{stage: {count, total_s, mean_us}} from the stage histogram —
+    bench.py's per-stage section and ops tooling read this instead of
+    re-parsing the text exposition."""
+    out: dict = {}
+    for family in REQUEST_STAGE_SECONDS.collect():
+        sums: dict = {}
+        counts: dict = {}
+        for s in family.samples:
+            stage = s.labels.get("stage")
+            if s.name.endswith("_sum"):
+                sums[stage] = s.value
+            elif s.name.endswith("_count"):
+                counts[stage] = s.value
+        for stage, c in counts.items():
+            if c:
+                out[stage] = {
+                    "count": int(c),
+                    "total_s": round(sums.get(stage, 0.0), 6),
+                    "mean_us": round(sums.get(stage, 0.0) / c * 1e6, 1),
+                }
+    return out
+
 
 FILER_REQUEST_COUNTER = Counter(
     "SeaweedFS_filer_request_total",
@@ -207,26 +297,40 @@ async def _push_loop(job, instance, address, interval_seconds, collect):
         f"/instance/{urllib.parse.quote(instance, safe='')}"
     )
     log.info("pushing metrics to %s every %ds", url, interval_seconds)
+
+    async def push_once(sess):
+        if collect is not None:
+            collect()
+        async with sess.put(
+            url,
+            data=generate_latest(REGISTRY),
+            headers={"Content-Type": CONTENT_TYPE_LATEST},
+        ) as r:
+            if r.status >= 300:
+                log.warning(
+                    "pushgateway %s returned HTTP %d", url, r.status
+                )
+
     async with aiohttp.ClientSession() as sess:
-        while True:
+        try:
+            while True:
+                try:
+                    await push_once(sess)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — the gateway being
+                    # down must not kill the server's push loop
+                    log.warning("could not push metrics to %s: %s", url, e)
+                await asyncio.sleep(interval_seconds)
+        except asyncio.CancelledError:
+            # final best-effort push so a short-lived run (benchmark, CI
+            # job) doesn't silently drop the last interval's samples —
+            # bounded, so a dead gateway can't stall server shutdown
             try:
-                if collect is not None:
-                    collect()
-                async with sess.put(
-                    url,
-                    data=generate_latest(REGISTRY),
-                    headers={"Content-Type": CONTENT_TYPE_LATEST},
-                ) as r:
-                    if r.status >= 300:
-                        log.warning(
-                            "pushgateway %s returned HTTP %d", url, r.status
-                        )
-            except asyncio.CancelledError:
-                raise
-            except Exception as e:  # noqa: BLE001 — the gateway being
-                # down must not kill the server's push loop
-                log.warning("could not push metrics to %s: %s", url, e)
-            await asyncio.sleep(interval_seconds)
+                await asyncio.wait_for(push_once(sess), timeout=2.0)
+            except Exception:  # noqa: BLE001
+                log.debug("final metrics push to %s failed", url)
+            raise
 
 
 async def metrics_handler(request):

@@ -18,6 +18,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from ...obs import trace as obs_trace
 from ...ops import rs
 from .. import idx as idx_mod
 from .. import needle as needle_mod
@@ -289,9 +290,13 @@ class EcVolume:
     ) -> bytes:
         shard = self.shards.get(shard_id)
         if shard is not None:
-            return shard.read_at(off, size)
+            with obs_trace.span("shard_read", shard=shard_id, bytes=size):
+                return shard.read_at(off, size)
         if remote_read is not None:
-            data = remote_read(shard_id, off, size)
+            with obs_trace.span(
+                "remote_shard_read", shard=shard_id, bytes=size
+            ):
+                data = remote_read(shard_id, off, size)
             if data is not None:
                 return data
         return self._reconstruct_interval(
@@ -326,27 +331,40 @@ class EcVolume:
             except rs_resident.CacheMiss:
                 pass
         got: dict[int, np.ndarray] = {}
-        for sid in range(TOTAL_SHARDS):
-            if sid == missing_shard:
-                continue
-            shard = self.shards.get(sid)
-            buf = None
-            if shard is not None:
-                buf = shard.read_at(off, size)
-            elif remote_read is not None:
-                buf = remote_read(sid, off, size)
-            if buf is not None and len(buf) == size:
-                got[sid] = np.frombuffer(buf, dtype=np.uint8)
-            if len(got) >= DATA_SHARDS:
-                break
+        n_remote = 0
+        with obs_trace.span("shard_read", op="gather_survivors") as gather:
+            for sid in range(TOTAL_SHARDS):
+                if sid == missing_shard:
+                    continue
+                shard = self.shards.get(sid)
+                buf = None
+                if shard is not None:
+                    buf = shard.read_at(off, size)
+                elif remote_read is not None:
+                    with obs_trace.span(
+                        "remote_shard_read", shard=sid, bytes=size
+                    ):
+                        buf = remote_read(sid, off, size)
+                    n_remote += 1
+                if buf is not None and len(buf) == size:
+                    got[sid] = np.frombuffer(buf, dtype=np.uint8)
+                if len(got) >= DATA_SHARDS:
+                    break
+            gather.annotate(
+                survivors=len(got), remote=n_remote,
+                bytes=size * len(got),
+            )
         if len(got) < DATA_SHARDS:
             raise InsufficientShards(
                 f"ec volume {self.id}: {len(got)} shards reachable, "
                 f"{DATA_SHARDS} needed to recover shard {missing_shard}"
             )
-        codec = rs.RSCodec(backend=backend)
-        out = codec.reconstruct(got, wanted=[missing_shard])
-        return out[missing_shard].tobytes()
+        with obs_trace.span(
+            "host_reconstruct", backend=backend, bytes=size,
+        ):
+            codec = rs.RSCodec(backend=backend)
+            out = codec.reconstruct(got, wanted=[missing_shard])
+            return out[missing_shard].tobytes()
 
     def read_needle_bytes(
         self,
@@ -355,7 +373,9 @@ class EcVolume:
         backend: str = "cpu",
         use_device: bool = True,
     ) -> bytes:
-        _, _, intervals = self.locate_needle(needle_id)
+        # the .ecx binary search is a real disk read serving the request
+        with obs_trace.span("shard_read", op="locate"):
+            _, _, intervals = self.locate_needle(needle_id)
         return b"".join(
             self.read_interval(iv, remote_read, backend, use_device)
             for iv in intervals
@@ -380,22 +400,27 @@ class EcVolume:
         rather than aborting the rest of the burst."""
         plans: list[tuple[int, list] | Exception] = []
         requests: list[tuple[int, int, int]] = []
-        for nid in needle_ids:
-            try:
-                _, _, intervals = self.locate_needle(nid)
-            except (NeedleNotFound, OSError) as e:
-                plans.append(e)
-                continue
-            parts: list = []
-            for iv in intervals:
-                sid, off = iv.to_shard_and_offset()
-                shard = self.shards.get(sid)
-                if shard is not None:
-                    parts.append(("local", sid, off, iv.size))
-                else:
-                    parts.append(("recon", len(requests)))
-                    requests.append((sid, off, iv.size))
-            plans.append((nid, parts))
+        # locate = one .ecx binary search (disk preads) per needle: the
+        # batch's index-lookup cost, visible as its own trace stage
+        with obs_trace.span(
+            "shard_read", op="locate", needles=len(needle_ids)
+        ):
+            for nid in needle_ids:
+                try:
+                    _, _, intervals = self.locate_needle(nid)
+                except (NeedleNotFound, OSError) as e:
+                    plans.append(e)
+                    continue
+                parts: list = []
+                for iv in intervals:
+                    sid, off = iv.to_shard_and_offset()
+                    shard = self.shards.get(sid)
+                    if shard is not None:
+                        parts.append(("local", sid, off, iv.size))
+                    else:
+                        parts.append(("recon", len(requests)))
+                        requests.append((sid, off, iv.size))
+                plans.append((nid, parts))
 
         recon: list[bytes] | None = None
         if requests and self.device_cache is not None:
@@ -419,7 +444,10 @@ class EcVolume:
                 for p in parts:
                     if p[0] == "local":
                         _, sid, off, size = p
-                        raw += self.shards[sid].read_at(off, size)
+                        with obs_trace.span(
+                            "shard_read", shard=sid, bytes=size
+                        ):
+                            raw += self.shards[sid].read_at(off, size)
                     else:
                         i = p[1]
                         if recon is not None:

@@ -130,6 +130,39 @@ def test_all_server_roles_push_metrics(tmp_path):
     run(go())
 
 
+def test_final_push_on_cancellation():
+    """Stopping a server flushes one final best-effort push, so a
+    short-lived run (benchmark, CI job) doesn't silently drop the last
+    interval's samples.  The interval is set far beyond the test's
+    lifetime: any push beyond the startup one must be the final flush."""
+
+    async def go():
+        gw = PushReceiver()
+        await gw.start()
+        master = MasterServer(
+            port=0,
+            metrics_address=f"127.0.0.1:{gw.port}",
+            metrics_interval_seconds=3600,
+        )
+        await master.start()
+        # the loop pushes once at startup, then sleeps the full hour
+        deadline = asyncio.get_event_loop().time() + 10
+        while asyncio.get_event_loop().time() < deadline:
+            if gw.pushes:
+                break
+            await asyncio.sleep(0.05)
+        assert gw.pushes, "startup push never arrived"
+        n_before = len(gw.pushes)
+        await master.stop()  # cancels the push task mid-sleep
+        assert len(gw.pushes) > n_before, (
+            "cancellation dropped the final interval's samples"
+        )
+        assert gw.pushes[-1][0] == "master"
+        await gw.stop()
+
+    run(go())
+
+
 def test_push_survives_gateway_outage(tmp_path):
     """A down gateway must not kill the push loop: pushes resume when
     the receiver comes back (the reference logs and keeps looping)."""
