@@ -834,6 +834,34 @@ async def _serving_sweep_async(
             stage_before, swfs_stats.stage_breakdown()
         )
         out["needles"] = len(blobs)
+        # the master's aggregated view of the same run (heartbeat
+        # telemetry plane): device headroom, dispatcher shed counts, and
+        # merged stage digests ride the artifact next to the throughput
+        # numbers, so a regression can be read against its HBM state
+        try:
+            async with aiohttp.ClientSession() as sess:
+                async with sess.get(
+                    f"http://{cluster.master.url}/cluster/health.json"
+                ) as r:
+                    health = await r.json()
+            out["cluster_snapshot"] = {
+                "nodes": health["nodes"],
+                "cluster": {
+                    k: v
+                    for k, v in health["cluster"].items()
+                    if k != "stages"
+                },
+                "stage_p99_us": {
+                    stage: (
+                        round(s["p99_seconds"] * 1e6, 1)
+                        if s["p99_seconds"] is not None else None
+                    )
+                    for stage, s in health["cluster"]["stages"].items()
+                },
+            }
+        except Exception as e:  # noqa: BLE001 — telemetry must not sink
+            # the benchmark; a missing snapshot is itself recorded
+            out["cluster_snapshot"] = {"error": str(e)}
     finally:
         await cluster.stop()
         from seaweedfs_tpu.pb.rpc import close_all_channels
@@ -982,6 +1010,11 @@ def bench_serving_sweep(levels=(1, 16, 64, 256), reads_per_level=384):
     )
     return {
         "needles": resident.get("needles"),
+        # the master's health-plane view at the end of the device pass
+        # (device headroom + dispatcher state + merged stage p99s) —
+        # BENCH artifacts record what the HBM looked like, not just
+        # the throughput it produced
+        "cluster_snapshot": resident.get("cluster_snapshot"),
         "reads_per_level": reads_per_level,
         "native_reads_per_s": native["reads_per_s"],
         "resident_reads_per_s": resident["reads_per_s"],

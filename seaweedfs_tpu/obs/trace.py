@@ -156,11 +156,18 @@ class TraceRing:
         with self._lock:
             self._dq.append(trace)
 
-    def snapshot(self, limit: int | None = None) -> list[dict]:
-        """Newest-first JSON-ready dicts."""
+    def snapshot(
+        self, limit: int | None = None, trace_id: str | None = None
+    ) -> list[dict]:
+        """Newest-first JSON-ready dicts; `trace_id` narrows to one
+        trace's entries (a request can leave several per-role entries in
+        a co-hosted ring) BEFORE the limit applies, so `volume.trace -id`
+        fetches one trace instead of paging the whole ring."""
         with self._lock:
             items = list(self._dq)
         items.reverse()
+        if trace_id is not None:
+            items = [t for t in items if t.trace_id == trace_id]
         if limit is not None:
             items = items[:limit]
         return [t.to_dict() for t in items]
@@ -392,7 +399,8 @@ async def response_prepare_signal(request, response):
 
 async def traces_handler(request):
     """aiohttp GET /debug/traces: recent complete traces, newest-first,
-    with per-span durations.  ?limit=N bounds the payload."""
+    with per-span durations.  ?limit=N bounds the payload; ?id=<trace_id>
+    fetches one trace's entries instead of the whole ring."""
     from aiohttp import web
 
     try:
@@ -401,7 +409,10 @@ async def traces_handler(request):
         raise web.HTTPBadRequest(text="limit must be an integer")
     if limit < 0:
         raise web.HTTPBadRequest(text="limit must be >= 0")
-    return web.json_response({"traces": RING.snapshot(limit or None)})
+    trace_id = request.query.get("id") or None
+    return web.json_response(
+        {"traces": RING.snapshot(limit or None, trace_id)}
+    )
 
 
 # paths whose traffic is telemetry, not service: tracing them would wash

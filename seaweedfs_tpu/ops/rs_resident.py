@@ -164,6 +164,12 @@ class DeviceShardCache:
         # a scan-and-sort of the whole key set under the lock
         self._vid_counts: dict[int, int] = {}
         self.bytes_used = 0
+        # cumulative telemetry counters, reported up the heartbeat
+        # (pb VolumeServerTelemetry): budget-pressure evictions are the
+        # "HBM is too small for the working set" signal, pin claims the
+        # "how many volumes ever went resident here" one
+        self.evictions = 0
+        self.pin_claims = 0
 
     def _padded_len(self, n: int) -> int:
         need = n + MAX_TILE
@@ -185,6 +191,7 @@ class DeviceShardCache:
                 old_key, old = self._arrays.popitem(last=False)
                 self._true_sizes.pop(old_key, None)
                 self.bytes_used -= old.size
+                self.evictions += 1
                 self._vid_counts[old_key[0]] -= 1
                 if not self._vid_counts[old_key[0]]:
                     del self._vid_counts[old_key[0]]
@@ -222,6 +229,8 @@ class DeviceShardCache:
         keeps it — two locations' pin threads racing must not interleave
         their shard sets under one key space)."""
         with self._lock:
+            if vid not in self._pin_source:
+                self.pin_claims += 1
             return self._pin_source.setdefault(vid, source)
 
     def release_pin_source(self, vid: int, source: str) -> None:

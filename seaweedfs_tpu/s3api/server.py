@@ -233,17 +233,22 @@ class S3ApiServer:
         from .. import obs, stats
         from .circuit_breaker import CircuitBreakerError
 
-        if request.match_info["tail"] == "debug/traces":
-            # reserved observability path (this catch-all owns the
-            # namespace; a bucket literally named "debug" loses the
-            # "traces" key to it).  The s3 port is the PUBLIC customer
-            # endpoint and traces reveal internals (object keys, server
-            # addresses), so unlike the admin-facing servers this one is
-            # opt-in only — the same SWFS_DEBUG gate as /debug/stacks.
+        if request.match_info["tail"] in ("debug/traces", "debug/stacks"):
+            # reserved observability paths (this catch-all owns the
+            # namespace; a bucket literally named "debug" loses these
+            # keys to it).  The s3 port is the PUBLIC customer endpoint
+            # and traces/stacks reveal internals (object keys, server
+            # addresses, code paths), so unlike the admin-facing servers
+            # both are opt-in only behind the SWFS_DEBUG gate — but a
+            # wedged s3 gateway can still always be diagnosed with it on.
             import os
 
             if os.environ.get("SWFS_DEBUG") != "1":
                 raise web.HTTPNotFound()
+            if request.match_info["tail"] == "debug/stacks":
+                from ..utils.profiling import debug_stacks_handler
+
+                return await debug_stacks_handler(request)
             return await obs.traces_handler(request)
         tid, psid = obs.parse_trace_header(
             request.headers.get(obs.TRACE_HEADER, "")

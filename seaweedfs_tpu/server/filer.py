@@ -21,6 +21,7 @@ import base64
 import hashlib
 import json
 import logging
+import os
 import time
 
 import aiohttp
@@ -209,6 +210,12 @@ class FilerServer:
             # do: the data app's catch-all owns the whole namespace, so
             # a filer path "/debug/traces" must stay a file path
             mapp.router.add_get("/debug/traces", obs.traces_handler)
+            if os.environ.get("SWFS_DEBUG") == "1":
+                # thread-stack dumps for a wedged filer (same opt-in
+                # gate as the other roles' /debug/stacks)
+                from ..utils.profiling import debug_stacks_handler
+
+                mapp.router.add_get("/debug/stacks", debug_stacks_handler)
             self._metrics_runner = web.AppRunner(mapp)
             await self._metrics_runner.setup()
             msite = web.TCPSite(self._metrics_runner, self.ip, self.metrics_port)

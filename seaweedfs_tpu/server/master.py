@@ -112,6 +112,10 @@ class MasterServer:
             sequencer=sequencer,
             pulse_seconds=pulse_seconds,
         )
+        # heartbeat-carried telemetry aggregated into /cluster/health.json
+        # and the SeaweedFS_cluster_* series; a node missing 2 pulse
+        # intervals is flagged stale (stats/cluster.py)
+        self.telemetry = stats.ClusterTelemetry(pulse_seconds)
         self._subscribers: dict[object, asyncio.Queue] = {}
         self._grow_queue: asyncio.Queue = asyncio.Queue()
         self._growing: set[tuple] = set()
@@ -177,7 +181,12 @@ class MasterServer:
         app.router.add_route("*", "/col/delete", self.h_col_delete)
         app.router.add_post("/submit", self.h_submit)
         app.router.add_get("/cluster/status", self.h_cluster_status)
+        app.router.add_get("/cluster/health.json", self.h_cluster_health)
         app.router.add_get("/metrics", stats.metrics_handler)
+        # refresh the SeaweedFS_cluster_* gauges from the telemetry plane
+        # at scrape time (the volume server refreshes its store gauges
+        # through the same hook)
+        app[stats.metrics.metrics_collect_key()] = self.telemetry.refresh_gauges
         app.router.add_get("/debug/traces", obs.traces_handler)
         if os.environ.get("SWFS_DEBUG") == "1":
             # stack dumps reveal internals; opt-in only (the reference
@@ -367,6 +376,12 @@ class MasterServer:
                     )
                     log.info("volume server joined: %s", node.url)
                 stats.MASTER_RECEIVED_HEARTBEATS.labels(type="total").inc()
+                # every pulse refreshes freshness; the payload (absent on
+                # pre-telemetry servers) feeds the cluster health plane
+                self.telemetry.observe(
+                    node.url,
+                    hb.telemetry if hb.HasField("telemetry") else None,
+                )
                 if hb.volumes or hb.has_no_volumes or hb.ec_shards or hb.has_no_ec_shards:
                     new_v, del_v, new_ec, del_ec = self.topo.sync_node(
                         node, heartbeat_state_from_pb(hb)
@@ -399,6 +414,10 @@ class MasterServer:
                 dead_ec = list(node.ec_shards)
                 self.topo.unregister_node(node)
                 self._broadcast_location(node, [], dead_vids, [], dead_ec)
+                # keep the node's last telemetry snapshot (flagged
+                # disconnected; age takes it stale) — health.json should
+                # show what a dead node last looked like, not erase it
+                self.telemetry.disconnect(node.url)
                 log.info("volume server left: %s", node.url)
 
     async def KeepConnected(self, request_iterator, context):
@@ -1026,6 +1045,15 @@ class MasterServer:
                 "MaxVolumeId": self.topo.max_volume_id,
             }
         )
+
+    async def h_cluster_health(self, request: web.Request) -> web.Response:
+        """Aggregated cluster health from heartbeat telemetry: per-node
+        freshness/staleness, HBM budget/used/headroom, dispatcher state,
+        the EC residency map, and merged per-stage p50/p99 estimates.
+        Telemetry lands on the leader (volume servers heartbeat to it
+        alone), so followers redirect like every control-plane handler."""
+        self._redirect_if_follower(request)
+        return web.json_response(self.telemetry.health())
 
     async def h_grow(self, request: web.Request) -> web.Response:
         self._redirect_if_follower(request)

@@ -209,6 +209,17 @@ class VolumeServer:
         self.ec_dispatcher = EcReadDispatcher(
             self.store, self._remote_shard_reader, ec_serving
         )
+        # stage-digest shipping state: deltas against _stage_snapshot
+        # accrue in _digest_backlog until the heartbeat that carried
+        # them is ACKED (the master answers every heartbeat in order),
+        # so a stream break re-ships instead of silently dropping the
+        # lost pulse's observations from the cluster's merged digests
+        self._stage_snapshot: dict = {}
+        self._digest_backlog: dict = {}  # stage -> [buckets, count, sum_s]
+        self._digest_shipped: dict = {}  # the outstanding shipment's content
+        self._digest_inflight_at: int | None = None  # its heartbeat seq
+        self._hb_sent = 0  # per-stream counters (reset on reconnect)
+        self._hb_acked = 0
         self._grpc_server: grpc.aio.Server | None = None
         self._http_runner: web.AppRunner | None = None
         self._tasks: list[asyncio.Task] = []
@@ -378,6 +389,11 @@ class VolumeServer:
             await self._grpc_server.stop(0.1)
         if self._http_runner:
             await self._http_runner.cleanup()
+        # zero the occupancy/queue gauges: the registry outlives this
+        # server (co-hosted roles, in-process restarts), and a restarted
+        # server must not report the dead instance's last occupancy
+        # until its first batch
+        self.ec_dispatcher.shutdown()
         # off the loop: close() joins pin/warm threads that may sit in a
         # 20-40s jit compile — blocking here would freeze every other
         # coroutine in the process (co-hosted servers, in-flight HTTP)
@@ -385,19 +401,111 @@ class VolumeServer:
 
     # ------------------------------------------------------------------ heartbeat
 
+    @staticmethod
+    def _fold_digest(dst: dict, stage, buckets, count, dsum, sign=1) -> None:
+        rec = dst.setdefault(stage, [[0] * len(buckets), 0, 0.0])
+        rec[0] = [a + sign * b for a, b in zip(rec[0], buckets)]
+        rec[1] += sign * count
+        rec[2] += sign * dsum
+
+    def _build_telemetry(self) -> master_pb2.VolumeServerTelemetry:
+        """One pulse's telemetry payload: device-cache occupancy, the
+        serving dispatcher's live state, and the stage-histogram delta
+        since the previous pulse (pb StageDigest — fixed buckets, so the
+        master merges without raw samples).
+
+        Digest delivery is ack-gated: each pulse's delta joins the
+        backlog, the backlog ships only while no earlier shipment is
+        unconfirmed, and a shipment is confirmed (removed from the
+        backlog) once its heartbeat's response arrives — responses are
+        1:1 and ordered.  A broken stream re-ships the unconfirmed
+        backlog on reconnect, so observations survive blips; the rare
+        cost is one pulse's digest double-counted when the master
+        applied a heartbeat whose response the break ate (and a
+        follower's hint response during leader churn can false-ack one
+        shipment) — a bounded skew, versus guaranteed loss."""
+        tel = master_pb2.VolumeServerTelemetry()
+        cache = self.store.ec_device_cache
+        if cache is not None:
+            n_resident, n_bytes = cache.stats()
+            tel.device_budget_bytes = cache.budget
+            tel.device_used_bytes = n_bytes
+            tel.device_resident_shards = n_resident
+            tel.device_evictions = cache.evictions
+            tel.device_pin_claims = cache.pin_claims
+            for vid, sids in cache.resident_by_vid().items():
+                tel.resident_shards_by_volume[vid] = len(sids)
+        g = stats.REGISTRY.get_sample_value
+        tel.compile_hits = int(
+            g("SeaweedFS_volumeServer_ec_device_compile_total",
+              {"result": "hit"}) or 0
+        )
+        tel.compile_misses = int(
+            g("SeaweedFS_volumeServer_ec_device_compile_total",
+              {"result": "miss"}) or 0
+        )
+        tel.dispatcher_queue_depth = self.ec_dispatcher.queue_depth
+        tel.dispatcher_inflight = self.ec_dispatcher.inflight
+        tel.dispatcher_shed = int(
+            g("SeaweedFS_volumeServer_ec_batch_fallback_total") or 0
+        )
+        snap = stats.metrics.stage_histogram_snapshot()
+        for stage, buckets, count, dsum in stats.metrics.stage_digest_deltas(
+            self._stage_snapshot, snap
+        ):
+            self._fold_digest(self._digest_backlog, stage, buckets, count, dsum)
+        self._stage_snapshot = snap
+        if (
+            self._digest_inflight_at is not None
+            and self._hb_acked >= self._digest_inflight_at
+        ):
+            # the shipment's heartbeat was answered: the master applied
+            # it — retire exactly what was shipped from the backlog
+            for stage, (buckets, count, dsum) in self._digest_shipped.items():
+                self._fold_digest(
+                    self._digest_backlog, stage, buckets, count, dsum, sign=-1
+                )
+            self._digest_backlog = {
+                s: rec for s, rec in self._digest_backlog.items() if rec[1] > 0
+            }
+            self._digest_shipped = {}
+            self._digest_inflight_at = None
+        if self._digest_inflight_at is None and self._digest_backlog:
+            for stage, (buckets, count, dsum) in sorted(
+                self._digest_backlog.items()
+            ):
+                d = tel.stage_digests.add()
+                d.stage = stage
+                d.bucket_counts.extend(buckets)
+                d.count = count
+                d.sum_seconds = dsum
+            self._digest_shipped = {
+                s: (list(b), c, ds)
+                for s, (b, c, ds) in self._digest_backlog.items()
+            }
+            # pulses() bumps _hb_sent right after this build, so the
+            # heartbeat carrying this shipment is number _hb_sent + 1
+            self._digest_inflight_at = self._hb_sent + 1
+        return tel
+
+    def _identity_heartbeat(self) -> master_pb2.Heartbeat:
+        """Who-am-i header + this pulse's telemetry, no volume state:
+        what keeps the master's health plane fresh when nothing about
+        the volumes changed between pulses."""
+        hb = master_pb2.Heartbeat(
+            ip=self.ip, port=self.port,
+            public_url=self.store.public_url, grpc_port=self.grpc_port,
+            data_center=self.data_center, rack=self.rack,
+        )
+        hb.telemetry.CopyFrom(self._build_telemetry())
+        return hb
+
     def _full_heartbeat(self) -> master_pb2.Heartbeat:
         hs = self.store.collect_heartbeat()
-        hb = master_pb2.Heartbeat(
-            ip=self.ip,
-            port=self.port,
-            public_url=self.store.public_url,
-            grpc_port=self.grpc_port,
-            data_center=self.data_center,
-            rack=self.rack,
-            has_no_volumes=hs.has_no_volumes,
-            has_no_ec_shards=hs.has_no_ec_shards,
-            offset_bytes=t.OFFSET_SIZE,
-        )
+        hb = self._identity_heartbeat()
+        hb.has_no_volumes = hs.has_no_volumes
+        hb.has_no_ec_shards = hs.has_no_ec_shards
+        hb.offset_bytes = t.OFFSET_SIZE
         for k, v in hs.max_volume_counts.items():
             hb.max_volume_counts[k] = v
         hb.volumes.extend(volume_msg_to_pb(v) for v in hs.volumes)
@@ -408,11 +516,7 @@ class VolumeServer:
         new_v, del_v, new_ec, del_ec = self.store.drain_deltas()
         if not (new_v or del_v or new_ec or del_ec):
             return None
-        hb = master_pb2.Heartbeat(
-            ip=self.ip, port=self.port,
-            public_url=self.store.public_url, grpc_port=self.grpc_port,
-            data_center=self.data_center, rack=self.rack,
-        )
+        hb = self._identity_heartbeat()
         hb.new_volumes.extend(volume_msg_to_pb(v) for v in new_v)
         hb.deleted_volumes.extend(volume_msg_to_pb(v) for v in del_v)
         hb.new_ec_shards.extend(ec_msg_to_pb(e) for e in new_ec)
@@ -440,7 +544,9 @@ class VolumeServer:
         stub = Stub(channel(server_address.grpc_address(master)), master_pb2, "Seaweed")
 
         async def pulses():
-            yield self._full_heartbeat()
+            hb = self._full_heartbeat()
+            self._hb_sent += 1
+            yield hb
             n = 0
             while not self._stopping:
                 await asyncio.sleep(
@@ -450,16 +556,33 @@ class VolumeServer:
                 )
                 hb = self._delta_heartbeat()
                 n += 1
-                if hb is None and n % 4 == 0:
-                    hb = self._full_heartbeat()  # periodic full re-sync
-                if hb is not None:
-                    yield hb
+                if hb is None:
+                    # no state deltas: periodic full re-sync, otherwise a
+                    # telemetry-only pulse — the master's health plane
+                    # (staleness marking, HBM headroom, stage digests)
+                    # needs EVERY pulse, not just state changes
+                    hb = (
+                        self._full_heartbeat() if n % 4 == 0
+                        else self._identity_heartbeat()
+                    )
+                self._hb_sent += 1
+                yield hb
 
-        async for resp in stub.SendHeartbeat(pulses()):
-            if resp.volume_size_limit:
-                self.store.volume_size_limit = resp.volume_size_limit
-            if resp.leader:
-                self.current_master = resp.leader
+        try:
+            async for resp in stub.SendHeartbeat(pulses()):
+                self._hb_acked += 1
+                if resp.volume_size_limit:
+                    self.store.volume_size_limit = resp.volume_size_limit
+                if resp.leader:
+                    self.current_master = resp.leader
+        finally:
+            # per-stream bookkeeping dies with the stream; an
+            # unconfirmed digest shipment stays in the backlog and
+            # re-ships on the next connection
+            self._hb_sent = 0
+            self._hb_acked = 0
+            self._digest_shipped = {}
+            self._digest_inflight_at = None
 
     # ------------------------------------------------------------------ HTTP data plane
 

@@ -58,6 +58,27 @@ class EcReadDispatcher:
         self.coalescer = Coalescer(self.cfg.max_batch, self.cfg.max_queue)
         self._inflight = 0
 
+    # ----------------------------------------------------------- telemetry
+
+    @property
+    def queue_depth(self) -> int:
+        """Reads waiting in the coalescer right now."""
+        return len(self.coalescer)
+
+    @property
+    def inflight(self) -> int:
+        """Batches currently in flight on the device (occupancy)."""
+        return self._inflight
+
+    def shutdown(self) -> None:
+        """Clean-shutdown zeroing of the occupancy/queue gauges: the
+        registry is process-global (co-hosted roles, in-process restarts
+        share it), so a dispatcher that dies mid-batch would otherwise
+        leave its last occupancy standing until the replacement's first
+        batch overwrites it — a restarted server must report idle."""
+        stats.VOLUME_SERVER_EC_BATCH_INFLIGHT.set(0)
+        stats.VOLUME_SERVER_EC_QUEUE_DEPTH.set(0)
+
     # ------------------------------------------------------------- admission
 
     async def read(self, vid: int, nid: int, cookie: int | None):
@@ -85,6 +106,7 @@ class EcReadDispatcher:
             stats.VOLUME_SERVER_EC_READ_ROUTE.labels(route="native").inc()
             return await self._read_native(vid, nid, cookie)
         stats.VOLUME_SERVER_EC_READ_ROUTE.labels(route="batched").inc()
+        stats.VOLUME_SERVER_EC_QUEUE_DEPTH.set(len(self.coalescer))
         self._maybe_spawn()
         return await req.future
 
@@ -147,7 +169,9 @@ class EcReadDispatcher:
                 first = False
                 now = asyncio.get_running_loop().time()
                 now_pc = time.perf_counter()
-                for vid, items in self.coalescer.take().items():
+                taken = self.coalescer.take()
+                stats.VOLUME_SERVER_EC_QUEUE_DEPTH.set(len(self.coalescer))
+                for vid, items in taken.items():
                     stats.VOLUME_SERVER_EC_BATCH_SIZE.observe(len(items))
                     for r in items:
                         wait = now - r.enqueued

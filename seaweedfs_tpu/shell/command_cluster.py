@@ -1,8 +1,30 @@
 """cluster.* commands (reference: weed/shell/command_cluster_ps.go etc.)."""
+import json
+
 import grpc
 
-from ..pb import master_pb2
+from ..pb import master_pb2, server_address
 from .commands import command, parse_flags
+
+
+async def fetch_cluster_health(env) -> dict:
+    """GET /cluster/health.json from the master's HTTP port (shared by
+    cluster.health and volume.device.status)."""
+    import aiohttp
+
+    url = f"http://{server_address.http_address(env.masters[0])}/cluster/health.json"
+    async with aiohttp.ClientSession() as sess:
+        async with sess.get(url, allow_redirects=True) as r:
+            if r.status != 200:
+                raise ValueError(f"{url} returned HTTP {r.status}")
+            return await r.json()
+
+
+def fmt_bytes(n: int) -> str:
+    # one shell-wide byte formatter (fs.ls uses the same one)
+    from .command_fs import _fmt_size
+
+    return _fmt_size(n)
 
 
 @command("cluster.ps")
@@ -67,6 +89,66 @@ async def cmd_cluster_raft_remove(env, args):
         master_pb2.RaftRemoveServerRequest(id=flags["id"])
     )
     env.write(f"removed raft server {flags['id']}")
+
+
+@command("cluster.health")
+async def cmd_cluster_health(env, args):
+    """[-json] : aggregated cluster health from heartbeat telemetry —
+    per-node freshness (stale after 2 missed pulses), device HBM
+    used/budget/headroom, dispatcher queue/occupancy/shed, EC residency
+    map, and merged per-stage p50/p99 latency estimates"""
+    flags = parse_flags(args)
+    health = await fetch_cluster_health(env)
+    if "json" in flags:
+        env.write(json.dumps(health, indent=2, sort_keys=True))
+        return
+    cluster = health["cluster"]
+    env.write(
+        f"nodes: {cluster['nodes_total']} "
+        f"({cluster['nodes_stale']} stale; stale after "
+        f"{health['stale_after_seconds']:.1f}s without a heartbeat)"
+    )
+    env.write(
+        "  {:<22} {:>7} {:>6} {:>20} {:>6} {:>9} {:>7}".format(
+            "node", "age_s", "stale", "hbm used/budget", "queue",
+            "inflight", "shed"
+        )
+    )
+    for url, n in health["nodes"].items():
+        dev = n.get("device", {})
+        disp = n.get("dispatcher", {})
+        hbm = (
+            f"{fmt_bytes(dev['used_bytes'])}/{fmt_bytes(dev['budget_bytes'])}"
+            if dev else "-"
+        )
+        env.write(
+            "  {:<22} {:>7.1f} {:>6} {:>20} {:>6} {:>9} {:>7}".format(
+                url, n["age_seconds"], "YES" if n["stale"] else "no",
+                hbm, disp.get("queue_depth", "-"),
+                disp.get("inflight", "-"), disp.get("shed_total", "-"),
+            )
+        )
+    residency = cluster.get("ec_volume_residency", {})
+    if residency:
+        env.write("ec residency (vid: node=shards):")
+        for vid, by_node in residency.items():
+            env.write(
+                f"  {vid}: "
+                + " ".join(f"{u}={c}" for u, c in by_node.items())
+            )
+    stages = cluster.get("stages", {})
+    if stages:
+        env.write("stage latency estimates (merged digests):")
+
+        def us(v):  # the schema allows null quantiles (empty buckets)
+            return "-" if v is None else f"{v * 1e6:.1f}us"
+
+        for stage, s in stages.items():
+            env.write(
+                f"  {stage:<18} n={s['count']:<8} "
+                f"p50={us(s['p50_seconds'])} p99={us(s['p99_seconds'])}"
+                + (f" (+{s['overflow']} overflow)" if s["overflow"] else "")
+            )
 
 
 @command("cluster.check")

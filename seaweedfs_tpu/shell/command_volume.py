@@ -816,21 +816,66 @@ async def cmd_volume_configure_replication(env, args):
         )
 
 
+@command("volume.device.status")
+async def cmd_volume_device_status(env, args):
+    """[-node <host:port>] : per-node device shard-cache status from the
+    master's telemetry plane — HBM used/budget/headroom, resident shard
+    counts per EC volume, compile-cache hit/miss, evictions, pin claims"""
+    from .command_cluster import fetch_cluster_health, fmt_bytes
+
+    flags = parse_flags(args)
+    want = flags.get("node") or flags.get("")
+    health = await fetch_cluster_health(env)
+    nodes = health["nodes"]
+    if want:
+        if want not in nodes:
+            raise ValueError(
+                f"node {want!r} not in telemetry plane (known: "
+                f"{', '.join(sorted(nodes)) or 'none'})"
+            )
+        nodes = {want: nodes[want]}
+    for url, n in nodes.items():
+        state = "STALE" if n["stale"] else "fresh"
+        dev = n.get("device")
+        if not dev:
+            env.write(
+                f"{url} [{state}] no device telemetry "
+                "(cache disabled or pre-telemetry server)"
+            )
+            continue
+        env.write(
+            f"{url} [{state}] hbm {fmt_bytes(dev['used_bytes'])}"
+            f"/{fmt_bytes(dev['budget_bytes'])} "
+            f"(headroom {fmt_bytes(dev['headroom_bytes'])}) "
+            f"shards={dev['resident_shards']} "
+            f"evictions={dev['evictions']} pin_claims={dev['pin_claims']} "
+            f"compile hit/miss={dev['compile_hits']}/{dev['compile_misses']}"
+        )
+        for vid, count in dev["resident_shards_by_volume"].items():
+            env.write(f"  ec volume {vid}: {count} resident shards")
+
+
 @command("volume.trace")
 async def cmd_volume_trace(env, args):
-    """-node <host:port> [-limit N] : fetch /debug/traces from a running
-    volume server and pretty-print the recent request traces (trace id,
-    per-span stage durations, annotations) newest-first"""
+    """-node <host:port> [-limit N] [-id <trace_id>] : fetch
+    /debug/traces from a running volume server and pretty-print the
+    recent request traces (trace id, per-span stage durations,
+    annotations) newest-first; -id fetches one trace instead of the ring"""
     import aiohttp
 
     flags = parse_flags(args)
     node = flags.get("node") or flags.get("")
     if not node:
-        raise ValueError("volume.trace -node <host:port(http)> [-limit N]")
+        raise ValueError(
+            "volume.trace -node <host:port(http)> [-limit N] [-id <trace_id>]"
+        )
     limit = int(flags.get("limit", 10))
+    params = {"limit": str(limit)}
+    if flags.get("id"):
+        params["id"] = flags["id"]
     async with aiohttp.ClientSession() as sess:
         async with sess.get(
-            f"http://{node}/debug/traces", params={"limit": str(limit)}
+            f"http://{node}/debug/traces", params=params
         ) as r:
             if r.status != 200:
                 raise ValueError(

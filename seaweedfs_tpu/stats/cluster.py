@@ -1,0 +1,410 @@
+"""Master-side cluster telemetry plane (the Monarch/Borgmon-style view).
+
+Every volume server ships a compact `VolumeServerTelemetry` payload on
+each heartbeat pulse (server/volume.py _build_telemetry): device shard
+cache occupancy, serving-dispatcher state, and a fixed-bucket DELTA
+digest of its `SeaweedFS_request_stage_seconds` histogram.  This module
+is the receiving half:
+
+  * `ClusterTelemetry.observe()` keeps the latest per-node snapshot and
+    folds each node's stage digests into cluster-wide merged histograms
+    (same bucket edges on both sides — stats.STAGE_SECONDS_BUCKETS — so
+    merging is vector addition, no raw samples ever cross the wire);
+  * nodes that miss heartbeats are flagged STALE after
+    `stale_after_pulses` intervals; their last snapshot is kept (an
+    operator wants to see what the dead node last looked like), their
+    scalars drop out of the fresh-cluster aggregates;
+  * `refresh_gauges()` re-exports the aggregate view as master-side
+    `SeaweedFS_cluster_*` series at scrape time;
+  * `health()` builds the `/cluster/health.json` document: per-node
+    freshness + HBM headroom + dispatcher state, the cluster residency
+    map, and per-stage p50/p99 estimates interpolated from the merged
+    buckets ("The Tail at Scale"'s prerequisite for hedged routing).
+"""
+from __future__ import annotations
+
+import math
+import threading
+import time
+from dataclasses import dataclass, field
+
+from prometheus_client import Gauge
+
+from .metrics import REGISTRY, STAGE_SECONDS_BUCKETS
+
+CLUSTER_NODES = Gauge(
+    "SeaweedFS_cluster_volume_nodes",
+    "Volume servers known to the master's telemetry plane, by heartbeat "
+    "freshness (stale = missed >= 2 pulse intervals).",
+    ["state"],
+    registry=REGISTRY,
+)
+for _s in ("fresh", "stale"):
+    CLUSTER_NODES.labels(state=_s)
+CLUSTER_DEVICE_BUDGET = Gauge(
+    "SeaweedFS_cluster_device_budget_bytes",
+    "Per-node device shard-cache budget (HBM bytes reserved for EC "
+    "shards), re-exported from heartbeat telemetry.",
+    ["node"],
+    registry=REGISTRY,
+)
+CLUSTER_DEVICE_USED = Gauge(
+    "SeaweedFS_cluster_device_used_bytes",
+    "Per-node device shard-cache bytes in use (padded device bytes).",
+    ["node"],
+    registry=REGISTRY,
+)
+CLUSTER_DEVICE_RESIDENT = Gauge(
+    "SeaweedFS_cluster_device_resident_shards",
+    "Per-node EC shards resident in device HBM.",
+    ["node"],
+    registry=REGISTRY,
+)
+CLUSTER_DEVICE_EVICTIONS = Gauge(
+    "SeaweedFS_cluster_device_evictions",
+    "Per-node cumulative budget-pressure shard evictions (the 'HBM too "
+    "small for the working set' signal), re-exported from heartbeats.",
+    ["node"],
+    registry=REGISTRY,
+)
+CLUSTER_DISPATCHER_QUEUE = Gauge(
+    "SeaweedFS_cluster_dispatcher_queue_depth",
+    "Per-node EC serving dispatcher queue depth at last heartbeat.",
+    ["node"],
+    registry=REGISTRY,
+)
+CLUSTER_DISPATCHER_INFLIGHT = Gauge(
+    "SeaweedFS_cluster_dispatcher_inflight",
+    "Per-node EC serving dispatcher batches in flight at last heartbeat.",
+    ["node"],
+    registry=REGISTRY,
+)
+CLUSTER_DISPATCHER_SHED = Gauge(
+    "SeaweedFS_cluster_dispatcher_shed",
+    "Per-node cumulative EC reads shed to the native path (dispatcher "
+    "backpressure), re-exported from heartbeats.",
+    ["node"],
+    registry=REGISTRY,
+)
+CLUSTER_STAGE_P50 = Gauge(
+    "SeaweedFS_cluster_stage_p50_seconds",
+    "Cluster-wide p50 estimate per serving stage, interpolated from the "
+    "merged heartbeat stage digests.",
+    ["stage"],
+    registry=REGISTRY,
+)
+CLUSTER_STAGE_P99 = Gauge(
+    "SeaweedFS_cluster_stage_p99_seconds",
+    "Cluster-wide p99 estimate per serving stage, interpolated from the "
+    "merged heartbeat stage digests.",
+    ["stage"],
+    registry=REGISTRY,
+)
+
+
+def quantile_from_buckets(
+    counts, q: float, edges=STAGE_SECONDS_BUCKETS
+) -> float | None:
+    """Linear-interpolation quantile estimate from per-bucket counts
+    (len(edges) + 1, last bucket = +Inf overflow).  The overflow bucket
+    has no upper edge, so a quantile landing there reports the last
+    finite edge — a deliberate UNDER-estimate, flagged by the caller via
+    the overflow count rather than invented here.  None when empty."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    target = q * total
+    acc = 0.0
+    lo = 0.0
+    for i, c in enumerate(counts):
+        hi = edges[i] if i < len(edges) else math.inf
+        if acc + c >= target and c > 0:
+            if math.isinf(hi):
+                return float(edges[-1])
+            return lo + (hi - lo) * (target - acc) / c
+        acc += c
+        lo = hi
+    return float(edges[-1])
+
+
+@dataclass
+class NodeTelemetry:
+    """Latest heartbeat-carried snapshot for one volume server."""
+
+    last_seen: float = 0.0
+    connected: bool = True
+    has_payload: bool = False  # False: pre-telemetry server, identity only
+    device_budget_bytes: int = 0
+    device_used_bytes: int = 0
+    device_resident_shards: int = 0
+    device_evictions: int = 0
+    device_pin_claims: int = 0
+    compile_hits: int = 0
+    compile_misses: int = 0
+    dispatcher_queue_depth: int = 0
+    dispatcher_inflight: int = 0
+    dispatcher_shed: int = 0
+    resident_by_volume: dict = field(default_factory=dict)
+
+    def to_dict(self, now: float, stale_after: float) -> dict:
+        age = now - self.last_seen
+        d = {
+            "age_seconds": round(age, 3),
+            "stale": bool(age > stale_after),
+            "connected": self.connected,
+            "telemetry": self.has_payload,
+        }
+        if self.has_payload:
+            d["device"] = {
+                "budget_bytes": self.device_budget_bytes,
+                "used_bytes": self.device_used_bytes,
+                "headroom_bytes": max(
+                    0, self.device_budget_bytes - self.device_used_bytes
+                ),
+                "resident_shards": self.device_resident_shards,
+                "evictions": self.device_evictions,
+                "pin_claims": self.device_pin_claims,
+                "compile_hits": self.compile_hits,
+                "compile_misses": self.compile_misses,
+                "resident_shards_by_volume": {
+                    str(v): n for v, n in sorted(self.resident_by_volume.items())
+                },
+            }
+            d["dispatcher"] = {
+                "queue_depth": self.dispatcher_queue_depth,
+                "inflight": self.dispatcher_inflight,
+                "shed_total": self.dispatcher_shed,
+            }
+        return d
+
+
+class ClusterTelemetry:
+    """Aggregates heartbeat telemetry into the master's health plane.
+
+    Thread-safe (gRPC heartbeat streams and HTTP scrapes interleave);
+    per-stage merged buckets are cluster-cumulative since master start,
+    exactly like a Prometheus histogram would be."""
+
+    def __init__(
+        self,
+        pulse_seconds: float,
+        stale_after_pulses: float = 2.0,
+        retention_seconds: float = 3600.0,
+    ):
+        self.pulse_seconds = pulse_seconds
+        self.stale_after = stale_after_pulses * pulse_seconds
+        # a DISCONNECTED node's last snapshot is kept this long past its
+        # final heartbeat (the operator's post-mortem view), then
+        # dropped — otherwise rolling restarts on dynamic ports would
+        # grow the node set and its gauge label space without bound
+        self.retention_seconds = max(retention_seconds, self.stale_after)
+        self._lock = threading.Lock()
+        self._nodes: dict[str, NodeTelemetry] = {}
+        # stage -> ([per-bucket counts incl +Inf], count, sum_seconds)
+        self._stages: dict[str, list] = {}
+
+    # -------------------------------------------------------------- intake
+
+    def observe(self, node_url: str, tel=None, now: float | None = None) -> None:
+        """Record one heartbeat from `node_url`; `tel` is the pb
+        VolumeServerTelemetry (None for pre-telemetry servers — the
+        pulse still refreshes freshness)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            nt = self._nodes.setdefault(node_url, NodeTelemetry())
+            nt.last_seen = now
+            nt.connected = True
+            if tel is None:
+                return
+            nt.has_payload = True
+            nt.device_budget_bytes = tel.device_budget_bytes
+            nt.device_used_bytes = tel.device_used_bytes
+            nt.device_resident_shards = tel.device_resident_shards
+            nt.device_evictions = tel.device_evictions
+            nt.device_pin_claims = tel.device_pin_claims
+            nt.compile_hits = tel.compile_hits
+            nt.compile_misses = tel.compile_misses
+            nt.dispatcher_queue_depth = tel.dispatcher_queue_depth
+            nt.dispatcher_inflight = tel.dispatcher_inflight
+            nt.dispatcher_shed = tel.dispatcher_shed
+            nt.resident_by_volume = dict(tel.resident_shards_by_volume)
+            n_buckets = len(STAGE_SECONDS_BUCKETS) + 1
+            for d in tel.stage_digests:
+                merged = self._stages.setdefault(
+                    d.stage, [[0] * n_buckets, 0, 0.0]
+                )
+                # tolerate a ladder drift between versions, preserving
+                # the +Inf overflow semantics in BOTH directions: the
+                # sender's LAST bucket is always its overflow, so a
+                # shorter ladder's tail lands in our +Inf (never in a
+                # finite mid-ladder bucket, which would fake fast
+                # observations), and a longer ladder's extras fold into
+                # +Inf too — counts never silently vanish or speed up
+                counts = list(d.bucket_counts)
+                if counts:
+                    if len(counts) >= n_buckets:
+                        counts = counts[: n_buckets - 1] + [
+                            sum(counts[n_buckets - 1:])
+                        ]
+                    else:
+                        counts = (
+                            counts[:-1]
+                            + [0] * (n_buckets - len(counts))
+                            + [counts[-1]]
+                        )
+                for i, c in enumerate(counts):
+                    merged[0][i] += c
+                merged[1] += d.count
+                merged[2] += d.sum_seconds
+
+    def disconnect(self, node_url: str) -> None:
+        """Heartbeat stream broke: keep the last snapshot (the operator
+        wants the dead node's final state) but mark it disconnected —
+        age will take it stale within the staleness window."""
+        with self._lock:
+            nt = self._nodes.get(node_url)
+            if nt is not None:
+                nt.connected = False
+
+    def _prune(self, now: float) -> None:
+        """Drop disconnected nodes past the retention window (caller
+        holds the lock).  Connected nodes are never pruned — a live
+        stream that stopped pulsing is exactly what staleness flags."""
+        for url in [
+            u for u, nt in self._nodes.items()
+            if not nt.connected
+            and (now - nt.last_seen) > self.retention_seconds
+        ]:
+            del self._nodes[url]
+
+    # ------------------------------------------------------------- exports
+
+    def _stale(self, nt: NodeTelemetry, now: float) -> bool:
+        return (now - nt.last_seen) > self.stale_after
+
+    def refresh_gauges(self, now: float | None = None) -> None:
+        """Re-export the aggregate view as SeaweedFS_cluster_* series
+        (called at master /metrics scrape time).  Per-node gauges are
+        cleared first so departed nodes drop to absent, not stale-stuck
+        — the same pattern as the volume gauge refresh."""
+        now = time.time() if now is None else now
+        with self._lock:
+            self._prune(now)
+            nodes = dict(self._nodes)
+            stages = {
+                s: (list(v[0]), v[1], v[2]) for s, v in self._stages.items()
+            }
+        for g in (
+            CLUSTER_DEVICE_BUDGET, CLUSTER_DEVICE_USED,
+            CLUSTER_DEVICE_RESIDENT, CLUSTER_DEVICE_EVICTIONS,
+            CLUSTER_DISPATCHER_QUEUE, CLUSTER_DISPATCHER_INFLIGHT,
+            CLUSTER_DISPATCHER_SHED,
+        ):
+            g.clear()
+        fresh = stale = 0
+        for url, nt in nodes.items():
+            if self._stale(nt, now):
+                stale += 1
+            else:
+                fresh += 1
+            if not nt.has_payload:
+                continue
+            CLUSTER_DEVICE_BUDGET.labels(node=url).set(nt.device_budget_bytes)
+            CLUSTER_DEVICE_USED.labels(node=url).set(nt.device_used_bytes)
+            CLUSTER_DEVICE_RESIDENT.labels(node=url).set(
+                nt.device_resident_shards
+            )
+            CLUSTER_DEVICE_EVICTIONS.labels(node=url).set(nt.device_evictions)
+            CLUSTER_DISPATCHER_QUEUE.labels(node=url).set(
+                nt.dispatcher_queue_depth
+            )
+            CLUSTER_DISPATCHER_INFLIGHT.labels(node=url).set(
+                nt.dispatcher_inflight
+            )
+            CLUSTER_DISPATCHER_SHED.labels(node=url).set(nt.dispatcher_shed)
+        CLUSTER_NODES.labels(state="fresh").set(fresh)
+        CLUSTER_NODES.labels(state="stale").set(stale)
+        for stage, (buckets, _count, _sum) in stages.items():
+            p50 = quantile_from_buckets(buckets, 0.50)
+            p99 = quantile_from_buckets(buckets, 0.99)
+            if p50 is not None:
+                CLUSTER_STAGE_P50.labels(stage=stage).set(p50)
+            if p99 is not None:
+                CLUSTER_STAGE_P99.labels(stage=stage).set(p99)
+
+    def stage_quantile(self, stage: str, q: float) -> float | None:
+        """Interpolated quantile estimate for one stage's merged digest
+        (tests cross-check this against the per-server histograms)."""
+        with self._lock:
+            rec = self._stages.get(stage)
+            buckets = list(rec[0]) if rec else None
+        return quantile_from_buckets(buckets, q) if buckets else None
+
+    def health(self, now: float | None = None) -> dict:
+        """The /cluster/health.json document."""
+        now = time.time() if now is None else now
+        with self._lock:
+            self._prune(now)
+            nodes = {url: nt for url, nt in self._nodes.items()}
+            stages = {
+                s: (list(v[0]), v[1], v[2]) for s, v in self._stages.items()
+            }
+        node_docs = {
+            url: nt.to_dict(now, self.stale_after)
+            for url, nt in sorted(nodes.items())
+        }
+        fresh = [
+            nt for nt in nodes.values()
+            if nt.has_payload and not self._stale(nt, now)
+        ]
+        residency: dict[str, dict[str, int]] = {}
+        for url, nt in sorted(nodes.items()):
+            for vid, n in nt.resident_by_volume.items():
+                residency.setdefault(str(vid), {})[url] = n
+        stage_docs = {}
+        for stage, (buckets, count, sum_s) in sorted(stages.items()):
+            p50 = quantile_from_buckets(buckets, 0.50)
+            p99 = quantile_from_buckets(buckets, 0.99)
+            stage_docs[stage] = {
+                "count": count,
+                "sum_seconds": round(sum_s, 6),
+                "p50_seconds": round(p50, 9) if p50 is not None else None,
+                "p99_seconds": round(p99, 9) if p99 is not None else None,
+                # observations past the last finite edge: when nonzero
+                # the p99 estimate is a floor, not an interpolation
+                "overflow": buckets[-1],
+            }
+        return {
+            "generated_unix_ms": int(now * 1e3),
+            "pulse_seconds": self.pulse_seconds,
+            "stale_after_seconds": self.stale_after,
+            "bucket_edges_seconds": list(STAGE_SECONDS_BUCKETS),
+            "nodes": node_docs,
+            "cluster": {
+                "nodes_total": len(nodes),
+                "nodes_stale": sum(
+                    1 for nt in nodes.values() if self._stale(nt, now)
+                ),
+                "device_budget_bytes": sum(
+                    nt.device_budget_bytes for nt in fresh
+                ),
+                "device_used_bytes": sum(
+                    nt.device_used_bytes for nt in fresh
+                ),
+                "device_headroom_bytes": sum(
+                    max(0, nt.device_budget_bytes - nt.device_used_bytes)
+                    for nt in fresh
+                ),
+                "dispatcher_queue_depth": sum(
+                    nt.dispatcher_queue_depth for nt in fresh
+                ),
+                "dispatcher_inflight": sum(
+                    nt.dispatcher_inflight for nt in fresh
+                ),
+                "dispatcher_shed_total": sum(
+                    nt.dispatcher_shed for nt in fresh
+                ),
+                "ec_volume_residency": residency,
+                "stages": stage_docs,
+            },
+        }

@@ -121,6 +121,12 @@ VOLUME_SERVER_EC_BATCH_INFLIGHT = Gauge(
     "bounded by -ec.serving.maxInflight).",
     registry=REGISTRY,
 )
+VOLUME_SERVER_EC_QUEUE_DEPTH = Gauge(
+    "SeaweedFS_volumeServer_ec_queue_depth",
+    "EC reads waiting in the serving coalescer right now (bounded by "
+    "-ec.serving.maxQueue; zeroed on clean dispatcher shutdown).",
+    registry=REGISTRY,
+)
 VOLUME_SERVER_EC_BATCH_FALLBACK = Counter(
     "SeaweedFS_volumeServer_ec_batch_fallback_total",
     "EC reads shed to the native per-read path because the dispatch "
@@ -150,14 +156,20 @@ TRACE_STAGES = (
     "remote_shard_read", # peer shard interval fetch (VolumeEcShardRead)
     "chunk_fetch",       # filer -> volume server chunk read
 )
+# the FIXED bucket ladder the heartbeat stage digests ride on: volume
+# servers ship per-bucket count deltas over exactly these edges (+Inf
+# appended), so the master can merge per-server histograms into one
+# cluster digest without raw samples (pb StageDigest, stats/cluster.py)
+STAGE_SECONDS_BUCKETS = (0.000005, 0.00001, 0.000025, 0.00005, 0.0001,
+                         0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                         0.05, 0.25, 1.0)
 REQUEST_STAGE_SECONDS = Histogram(
     "SeaweedFS_request_stage_seconds",
     "Per-stage serving time from the request-tracing spans "
     "(obs/trace.py); stage names cover the EC read path end to end.",
     ["stage"],
     registry=REGISTRY,
-    buckets=(0.000005, 0.00001, 0.000025, 0.00005, 0.0001, 0.00025,
-             0.0005, 0.001, 0.0025, 0.005, 0.01, 0.05, 0.25, 1.0),
+    buckets=STAGE_SECONDS_BUCKETS,
 )
 for _stage in TRACE_STAGES:
     REQUEST_STAGE_SECONDS.labels(stage=_stage)
@@ -219,6 +231,49 @@ def stage_breakdown() -> dict:
                     "total_s": round(sums.get(stage, 0.0), 6),
                     "mean_us": round(sums.get(stage, 0.0) / c * 1e6, 1),
                 }
+    return out
+
+
+def stage_histogram_snapshot() -> dict:
+    """{stage: (cumulative per-le counts incl +Inf, sum_seconds)} from the
+    stage histogram — the raw material of the heartbeat stage digests.
+    Counts are cumulative in `le` order (the Prometheus exposition shape);
+    stage_digest_deltas() turns two snapshots into per-bucket increments."""
+    out: dict = {}
+    for family in REQUEST_STAGE_SECONDS.collect():
+        cums: dict = {}
+        sums: dict = {}
+        for s in family.samples:
+            stage = s.labels.get("stage")
+            if s.name.endswith("_bucket"):
+                cums.setdefault(stage, []).append(
+                    (float(s.labels["le"]), s.value)
+                )
+            elif s.name.endswith("_sum"):
+                sums[stage] = s.value
+        for stage, pairs in cums.items():
+            pairs.sort(key=lambda p: p[0])
+            out[stage] = (
+                [int(v) for _, v in pairs], float(sums.get(stage, 0.0))
+            )
+    return out
+
+
+def stage_digest_deltas(before: dict, after: dict) -> list:
+    """[(stage, per-bucket increments, count, sum_seconds_delta)] accrued
+    between two stage_histogram_snapshot() calls; stages with no new
+    observations are dropped so an idle pulse ships an empty digest."""
+    out = []
+    for stage, (cum_b, sum_b) in after.items():
+        cum_a, sum_a = before.get(stage, ([0] * len(cum_b), 0.0))
+        dcum = [b - a for a, b in zip(cum_a, cum_b)]
+        count = dcum[-1] if dcum else 0
+        if count <= 0:
+            continue
+        buckets = [dcum[0]] + [
+            dcum[i] - dcum[i - 1] for i in range(1, len(dcum))
+        ]
+        out.append((stage, buckets, count, max(0.0, sum_b - sum_a)))
     return out
 
 

@@ -31,3 +31,24 @@ def test_readme_documents_every_trace_stage():
         readme = f.read()
     missing = [s for s in stats.TRACE_STAGES if s not in readme]
     assert not missing, f"undocumented trace stages: {missing}"
+
+
+def test_registry_series_naming_and_help():
+    """Registry hygiene, enforced like the doc table: every series
+    carries the SeaweedFS_ namespace (dashboards select on the prefix;
+    an unprefixed series silently vanishes from them) and a non-empty
+    help string (the exposition's only self-documentation)."""
+    bad_prefix = sorted(
+        family.name
+        for family in stats.REGISTRY.collect()
+        if not family.name.startswith("SeaweedFS_")
+    )
+    assert not bad_prefix, (
+        f"series missing the SeaweedFS_ prefix: {bad_prefix}"
+    )
+    no_help = sorted(
+        family.name
+        for family in stats.REGISTRY.collect()
+        if not (family.documentation or "").strip()
+    )
+    assert not no_help, f"series lacking a help string: {no_help}"

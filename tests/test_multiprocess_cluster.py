@@ -64,6 +64,10 @@ def test_multiprocess_cluster(tmp_path):
                     "master", "-port", str(mp), "-port.grpc", str(mg),
                     "-mdir", str(tmp_path / "meta"),
                     "-volumeSizeLimitMB", "64",
+                    # the telemetry plane's staleness window is derived
+                    # from the master's OWN pulse flag — match the
+                    # volume server's 1s pulse or stale_after is 10s
+                    "-pulseSeconds", "1",
                 )
             )
             await wait_http(f"http://127.0.0.1:{mp}/cluster/status")
@@ -126,6 +130,42 @@ def test_multiprocess_cluster(tmp_path):
             out, err = await asyncio.wait_for(proc.communicate(), 60)
             assert proc.returncode == 0, err.decode()
             assert b'"fid"' in out
+
+            # telemetry round-trip: the volume process's heartbeat
+            # payload surfaces in the master process's health plane
+            vs_url = f"127.0.0.1:{vp}"
+            async with aiohttp.ClientSession() as s:
+
+                async def health():
+                    async with s.get(
+                        f"http://127.0.0.1:{mp}/cluster/health.json"
+                    ) as r:
+                        assert r.status == 200
+                        return await r.json()
+
+                deadline = asyncio.get_event_loop().time() + 15
+                doc = await health()
+                while asyncio.get_event_loop().time() < deadline:
+                    node = doc["nodes"].get(vs_url)
+                    if node and node["telemetry"] and not node["stale"]:
+                        break
+                    await asyncio.sleep(0.25)
+                    doc = await health()
+                node = doc["nodes"][vs_url]
+                assert node["telemetry"] and not node["stale"], node
+                assert "dispatcher" in node and "device" in node
+
+                # node goes silent (SIGKILL: no goodbye): flagged stale
+                # within 2 pulse intervals (pulse=1s -> 2s)
+                procs[1].kill()
+                assert doc["stale_after_seconds"] == 2.0
+                deadline = asyncio.get_event_loop().time() + 15
+                while asyncio.get_event_loop().time() < deadline:
+                    doc = await health()
+                    if doc["nodes"][vs_url]["stale"]:
+                        break
+                    await asyncio.sleep(0.5)
+                assert doc["nodes"][vs_url]["stale"], doc["nodes"]
         finally:
             for p in procs:
                 if p.returncode is None:
