@@ -124,6 +124,26 @@ import time
 
 import numpy as np
 
+# Key order of the printed JSON line is load-bearing: the driver archives
+# only the LAST 2000 chars (VERDICT r5 Weak #4 found BENCH_r05's headline
+# unverifiable from the committed artifact), so the bulky diagnostics must
+# come first and these headline keys must be the TRAILING keys, in this
+# order.  tests/test_bench_contract.py pins the contract.
+HEADLINE_KEYS = (
+    "value",
+    "vs_baseline",
+    "vs_baseline_conservative",
+    "consistency",
+    "serving_headline",
+)
+
+
+def order_result(result: dict) -> dict:
+    """Reorder the output dict so HEADLINE_KEYS are the last keys (in
+    HEADLINE_KEYS order) of the JSON line main() prints."""
+    head = {k: v for k, v in result.items() if k not in HEADLINE_KEYS}
+    return {**head, **{k: result[k] for k in HEADLINE_KEYS if k in result}}
+
 
 def require_native():
     """Build the C++ kernel if needed; hard-fail when unavailable so the
@@ -602,6 +622,8 @@ async def build_degraded_cluster(
     warm_counts: tuple | None = None,
     drop_shards: tuple = (0, 11),
     with_filer: bool = False,
+    layout: str | None = None,  # resident serving layout; None = the
+    # ServingConfig default (blockdiag)
 ) -> tuple:
     """THE canonical degrade choreography, shared by the benchmark and
     tests/test_serving_e2e.py so the two can never drift: boot a
@@ -624,8 +646,15 @@ async def build_degraded_cluster(
     vs = cluster.volume_servers[0]
     if device_cache:
         from seaweedfs_tpu.ops.rs_resident import DeviceShardCache
+        from seaweedfs_tpu.serving import ServingConfig
 
         cache = DeviceShardCache(budget_bytes=cache_budget)
+        # injected after VolumeServer construction, so apply the serving
+        # config here the way the constructor path does — BOTH knobs, or
+        # the bench/e2e pipeline shape drifts from a real server's
+        cfg = ServingConfig()
+        cache.layout = layout or cfg.layout
+        cache.pipeline.set_slots(cfg.pipeline_slots)
         if warm_sizes is not None:
             cache.warm_sizes = warm_sizes
         if warm_counts is not None:
@@ -769,7 +798,7 @@ async def _serving_sweep_async(
             # and asserts byte-exactness once per level — the batched
             # results' consistency self-check (a coalesced/pipelined
             # batch must be byte-identical to the stored blob)
-            for c in levels:
+            async def warm_burst(c):
                 seq = [fids[i % len(fids)] for i in range(max(c, 32))]
                 sem = asyncio.Semaphore(c)
 
@@ -779,6 +808,9 @@ async def _serving_sweep_async(
                         assert got == blobs[fid], "degraded read corrupt"
 
                 await asyncio.gather(*(warm_read(f) for f in seq))
+
+            for c in levels:
+                await warm_burst(c)
             out["consistency_ok"] = True  # every warm read asserted above
 
             async def timed_level(c):
@@ -813,19 +845,47 @@ async def _serving_sweep_async(
                 out["p50_ms"][str(c)] = p50
 
             if device:
-                # pipeline-depth curve at the top concurrency: how much
-                # of the round-5 gap was the in-flight cap.  The config
-                # is read at lane-spawn time, so mutating it between
-                # bursts is safe.
-                out["max_inflight_default"] = vs.ec_dispatcher.cfg.max_inflight
-                sweep = {}
-                for depth in inflight_depths:
-                    vs.ec_dispatcher.cfg.max_inflight = depth
-                    sweep[str(depth)], _ = await timed_level(max(levels))
-                vs.ec_dispatcher.cfg.max_inflight = (
-                    out["max_inflight_default"]
+                # layout x overlap x pipeline-depth matrix at the top
+                # concurrency: the round-9 attribution surface.  The
+                # config/layout/slots are read per call, so mutating
+                # them between bursts is safe; every timed read stays
+                # byte-verified (timed_read asserts).
+                from seaweedfs_tpu.ops import rs_resident
+
+                cfg = vs.ec_dispatcher.cfg
+                cache = vs.store.ec_device_cache
+                out["max_inflight_default"] = cfg.max_inflight
+                out["layout_default"] = cache.layout
+                top = max(levels)
+                matrix = {}
+                for layout in ("flat", "blockdiag"):
+                    cache.layout = layout
+                    # untimed: compile THIS layout's count-bucket ladder
+                    # (the pin-thread warm only covered the default
+                    # layout), then a warm burst for any residual shape
+                    await asyncio.to_thread(
+                        rs_resident.warm, cache, _vid,
+                        (4096,), COUNT_BUCKETS,
+                    )
+                    await warm_burst(top)
+                    for overlap in (False, True):
+                        cache.pipeline.set_slots(2 if overlap else 1)
+                        sub = {}
+                        for depth in inflight_depths:
+                            cfg.max_inflight = depth
+                            sub[str(depth)], _ = await timed_level(top)
+                        matrix[
+                            f"{layout}/"
+                            f"{'overlap' if overlap else 'serial'}"
+                        ] = sub
+                cfg.max_inflight = out["max_inflight_default"]
+                cache.layout = out["layout_default"]
+                cache.pipeline.set_slots(cfg.pipeline_slots)
+                out["layout_overlap_reads_per_s"] = matrix
+                # legacy depth curve = the default operating point's row
+                out["inflight_reads_per_s"] = matrix.get(
+                    f"{out['layout_default']}/overlap", {}
                 )
-                out["inflight_reads_per_s"] = sweep
         # per-stage breakdown of everything this sweep served (warm +
         # timed reads), from the tracing layer's stage histograms: the
         # next perf PR can name its bottleneck stage instead of
@@ -942,6 +1002,9 @@ async def _scrub_bench_async(mb=768, reps=3):
         vs = VolumeServer(masters=[], directories=[tmp], port=0, grpc_port=0,
                           ec_backend="native")
         cache = DeviceShardCache(budget_bytes=4 << 30)
+        # serve the scrub through the blockdiag system (the serving
+        # default) — one apply on the ~157 GB/s kernel instead of ~121
+        cache.layout = "blockdiag"
         cache.warm_sizes = ()
         vs.store.ec_device_cache = cache
         ev = vs.store.find_ec_volume(1)
@@ -1001,13 +1064,18 @@ def bench_serving_sweep(levels=(1, 16, 64, 256), reads_per_level=384):
         if resident["reads_per_s"][c] > native["reads_per_s"][c]
     ]
     best_native = max(native["reads_per_s"].values())
-    # the pipeline-depth sweep counts toward the best: a depth-8 win at
-    # the top concurrency is a real operating point (the default depth
-    # is recorded alongside)
+    # the layout/overlap/depth matrix counts toward the best: a
+    # blockdiag+overlap depth-8 win at the top concurrency is a real
+    # operating point (the defaults are recorded alongside)
+    matrix = resident.get("layout_overlap_reads_per_s", {})
     best_resident = max(
         list(resident["reads_per_s"].values())
-        + list(resident.get("inflight_reads_per_s", {}).values())
+        + [v for sub in matrix.values() for v in sub.values()]
     )
+    bd_overlap = matrix.get("blockdiag/overlap", {})
+    flat_serial = matrix.get("flat/serial", {})
+    bd_best = max(bd_overlap.values(), default=None)
+    flat_serial_best = max(flat_serial.values(), default=None)
     return {
         "needles": resident.get("needles"),
         # the master's health-plane view at the end of the device pass
@@ -1025,6 +1093,18 @@ def bench_serving_sweep(levels=(1, 16, 64, 256), reads_per_level=384):
         ),
         "resident_max_inflight_default": resident.get(
             "max_inflight_default"
+        ),
+        # the round-9 attribution matrix: same run, same needles, every
+        # cell byte-verified — blockdiag+double-buffer must beat the
+        # flat single-buffer path here for the tentpole to count
+        "resident_layout_default": resident.get("layout_default"),
+        "layout_overlap_reads_per_s": matrix,
+        "blockdiag_overlap_best_reads_per_s": bd_best,
+        "flat_serial_best_reads_per_s": flat_serial_best,
+        "blockdiag_overlap_beats_flat_serial": bool(
+            bd_best is not None
+            and flat_serial_best is not None
+            and bd_best > flat_serial_best
         ),
         # per-stage timing over both passes (native pass stages come
         # from the same histograms, diffed within each sweep)
@@ -1156,13 +1236,21 @@ def main():
     # the dispatch RTT fully amortized and zero host cost the tunnel caps
     # the device path at d2h/fetch reads/s — comparable to or below the
     # measured native rates, which is why no batching depth wins
-    from seaweedfs_tpu.ops import rs_resident
+    from seaweedfs_tpu.ops import rs_resident, rs_tpu
+    from seaweedfs_tpu.serving import ServingConfig
     from seaweedfs_tpu.storage import needle as needle_mod
 
     needle_fetch = rs_resident._fetch_cover(
         needle_mod.actual_size(4096, needle_mod.CURRENT_VERSION)
         + rs_resident.FUSED_ALIGN - 1  # worst-case alignment delta
     )
+    if ServingConfig().layout == "blockdiag":
+        # the default serving layout rides the coarser blockdiag fetch
+        # ladder (multiples of groups*FUSED_ALIGN) — the ceiling must be
+        # derived from the ladder the path actually ships on
+        needle_fetch, _ = rs_resident._blockdiag_fetch_tile(
+            needle_fetch, rs_tpu.BLOCKDIAG_GROUPS
+        )
     serving["tunnel_ceiling_reads_per_s"] = round(
         d2h_mbps * 1e6 / needle_fetch, 1
     )
@@ -1207,15 +1295,13 @@ def main():
         consistency["durable_within_ceiling"]
         and consistency["vs_baseline_ok"]
     )
-    # key order is load-bearing: the driver archives only the LAST 2000
-    # chars of this line (VERDICT r5 Weak #4 found BENCH_r05's headline
-    # unverifiable from the committed artifact), so the bulky diagnostic
-    # "extra" comes FIRST and the headline value / vs_baseline /
-    # consistency / serving summary are the trailing keys the tail is
-    # guaranteed to contain.
+    # key order is load-bearing (HEADLINE_KEYS / order_result above): the
+    # bulky diagnostic "extra" comes FIRST and the headline value /
+    # vs_baseline / consistency / serving summary are the trailing keys
+    # the archived tail is guaranteed to contain.
     print(
         json.dumps(
-            {
+            order_result({
                 "metric": f"rs_10_4_encode_blockdiag_{kernel}",
                 "unit": "GB/s",
                 "extra": {
@@ -1294,10 +1380,19 @@ def main():
                     "best_ceiling_utilization": serving[
                         "best_ceiling_utilization"
                     ],
+                    "blockdiag_overlap_best_reads_per_s": serving[
+                        "blockdiag_overlap_best_reads_per_s"
+                    ],
+                    "flat_serial_best_reads_per_s": serving[
+                        "flat_serial_best_reads_per_s"
+                    ],
+                    "blockdiag_overlap_beats_flat_serial": serving[
+                        "blockdiag_overlap_beats_flat_serial"
+                    ],
                     "device_wins": serving["device_wins"],
                     "consistency_ok": serving["consistency_ok"],
                 },
-            }
+            })
         )
     )
 

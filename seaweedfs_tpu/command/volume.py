@@ -85,6 +85,21 @@ def add_args(p) -> None:
         "(backpressure)",
     )
     p.add_argument(
+        "-ec.serving.layout", dest="ec_serving_layout",
+        default=serving_defaults.layout, choices=["flat", "blockdiag"],
+        help="resident shard serving layout: blockdiag runs degraded "
+        "reads and scrubs on the block-diagonal g=4 kernel (~157 vs "
+        "~121 GB/s flat on v5e; the host stages the segment layout for "
+        "free at pin time), flat keeps the plain kernel",
+    )
+    p.add_argument(
+        "-ec.serving.overlap.disable", dest="ec_serving_overlap_disable",
+        action="store_true",
+        help="serialize the device batch pipeline (one staging slot) "
+        "instead of double-buffering pack/H2D of batch N+1 under batch "
+        "N's execute",
+    )
+    p.add_argument(
         "-readMode", dest="read_mode", default="proxy",
         choices=["local", "proxy", "redirect"],
     )
@@ -183,6 +198,8 @@ async def run(args) -> None:
             max_wait_us=args.ec_serving_max_wait_us,
             max_inflight=args.ec_serving_max_inflight,
             max_queue=args.ec_serving_max_queue,
+            layout=args.ec_serving_layout,
+            overlap=not args.ec_serving_overlap_disable,
         ),
         **common_args.metrics_kwargs(args),
     )

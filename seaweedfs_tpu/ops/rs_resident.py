@@ -24,8 +24,10 @@ Shapes and compile hygiene:
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import threading
+import time
 from collections import OrderedDict
 
 import jax
@@ -126,6 +128,79 @@ def _max_count(size_bucket: int) -> int:
     return max(1, min(COUNT_BUCKETS[-1], _MAX_CALL_OUT // size_bucket))
 
 
+# resident shard layouts.  "flat": the round-5/6 layout — one 1-D padded
+# buffer per shard, reconstructed with the plain [8m,8k] bit matrix.
+# "blockdiag": the same resident bytes SERVED through the block-diagonal
+# g-group system (rs_tpu round-3: A_blk [128, 320] fills the MXU's M
+# dimension, ~157 vs ~121 GB/s flat).  The host stages the layout for
+# free: a request's tile (or scrub's shard span) splits into g
+# CONTIGUOUS segments — segment-stacked [g*k, B/g] input rows are just
+# g slices per survivor, so the gather reads them straight out of the
+# flat resident buffers and no device restack (58 GB/s byte transposes,
+# the round-3 dealbreaker) ever happens.
+LAYOUTS = ("flat", "blockdiag")
+
+
+class DevicePipeline:
+    """Double-buffered staging gate for the device leg of batched
+    reconstruct calls: `slots=2` lets batch N+1 pack (outside the slot)
+    and ship+execute (inside it) while batch N drains its D2H — only
+    N's fetch blocks N's completion.  `slots=1` is the serial baseline
+    (bench.py's overlap-off axis).  The overlap-fraction gauge is
+    device-busy seconds / wall seconds over the current batch window (a
+    window opens when the pipeline leaves idle; the ratio refreshes at
+    EVERY batch completion — a drain-only update would go stale under
+    exactly the sustained load it exists to measure), so 1.0 means the
+    device section ran the whole window and >1 means the staging slots
+    genuinely overlapped."""
+
+    def __init__(self, slots: int = 2):
+        self._cond = threading.Condition()
+        self._slots = max(1, slots)
+        self._active = 0
+        self._busy_s = 0.0
+        self._window_t0 = 0.0
+        self.last_overlap = 0.0
+
+    @property
+    def slots(self) -> int:
+        return self._slots
+
+    def set_slots(self, n: int) -> None:
+        with self._cond:
+            self._slots = max(1, int(n))
+            self._cond.notify_all()
+
+    @contextlib.contextmanager
+    def slot(self):
+        """Hold one staging slot for a device section; yields the time
+        spent waiting for the slot (annotated on the device span so a
+        saturated pipeline is attributable)."""
+        t_req = time.perf_counter()
+        with self._cond:
+            while self._active >= self._slots:
+                self._cond.wait()
+            self._active += 1
+            if self._active == 1:
+                self._window_t0 = time.perf_counter()
+                self._busy_s = 0.0
+        t0 = time.perf_counter()
+        try:
+            yield t0 - t_req
+        finally:
+            dur = time.perf_counter() - t0
+            with self._cond:
+                self._active -= 1
+                self._busy_s += dur
+                wall = time.perf_counter() - self._window_t0
+                if wall > 0:
+                    self.last_overlap = self._busy_s / wall
+                    stats_metrics.VOLUME_SERVER_EC_OVERLAP_FRACTION.set(
+                        self.last_overlap
+                    )
+                self._cond.notify()
+
+
 class DeviceShardCache:
     """LRU cache of EC shard bytes pinned in device memory.
 
@@ -139,9 +214,31 @@ class DeviceShardCache:
         self,
         budget_bytes: int = 8 << 30,
         shard_quantum: int = SHARD_QUANTUM,
+        layout: str = "flat",
+        groups: int = rs_tpu.BLOCKDIAG_GROUPS,
     ):
+        if layout not in LAYOUTS:
+            raise ValueError(f"unknown resident layout {layout!r}")
+        if groups < 1 or SIZE_BUCKETS[0] % (groups * LANE):
+            # every size bucket is a multiple of the smallest, so this
+            # one check guarantees lane-aligned tile/groups segments on
+            # the XLA path (the fused path re-derives its own
+            # groups*FUSED_ALIGN-aligned ladder)
+            raise ValueError(
+                f"groups={groups} must split the {SIZE_BUCKETS[0]}-byte "
+                "size bucket into lane-aligned segments"
+            )
         self.budget = budget_bytes
         self.quantum = shard_quantum
+        # which reconstruct/scrub kernel family serves this cache's bytes
+        # (-ec.serving.layout); mutable at runtime — the bytes are
+        # layout-agnostic (blockdiag segments are contiguous slices of
+        # the same flat buffers), only the compiled shapes differ
+        self.layout = layout
+        self.groups = groups
+        # the double-buffered device staging gate shared by every
+        # reconstruct call against this cache (-ec.serving.overlap)
+        self.pipeline = DevicePipeline()
         # the (size, count) bucket shapes the store's pin thread
         # pre-compiles after pinning a volume (warm()); deployments with
         # a known workload shape can narrow these to cut mount-time
@@ -179,8 +276,20 @@ class DeviceShardCache:
         host = np.frombuffer(bytes(data), dtype=np.uint8) if isinstance(
             data, (bytes, bytearray, memoryview)
         ) else np.asarray(data, dtype=np.uint8)
-        padded = np.zeros(self._padded_len(host.size), dtype=np.uint8)
+        # stage via np.empty + tail-only zeroing: np.zeros memsets the
+        # WHOLE padded buffer and then overwrites all but the tail — a
+        # redundant full-size host pass per shard when pinning a large
+        # volume.  A reused per-cache staging buffer would cut the
+        # allocation too, but the CPU PJRT client zero-copies aligned
+        # numpy arrays into jax Arrays, so reuse would alias (and
+        # corrupt) previously pinned shards; a fresh buffer per put is
+        # the safe form of the optimization (alloc is cheap, memset of
+        # gigabytes is not).  The padded buffer doubles as the blockdiag
+        # segment-stacked layout: its g segments are contiguous slices,
+        # staged by the host for free.
+        padded = np.empty(self._padded_len(host.size), dtype=np.uint8)
         padded[: host.size] = host
+        padded[host.size :] = 0
         arr = jax.device_put(padded)
         key = (vid, shard_id)
         with self._lock:
@@ -316,6 +425,11 @@ def _prepared_matrix(matrix_bytes: bytes, m: int, k: int):
     return rs_tpu.prepare_matrix(
         np.frombuffer(matrix_bytes, dtype=np.uint8).reshape(m, k)
     )
+
+
+# block-diagonal prepared matrices share rs_tpu's cache (the bulk
+# encoder prepares the same parity system — one cached device copy)
+_prepared_blockdiag_matrix = rs_tpu._prepared_blockdiag
 
 
 # --- fused gather+reconstruct kernel ----------------------------------------
@@ -489,6 +603,183 @@ def _fused_reconstruct(
     return (out[:n] if pad else out).reshape(-1)
 
 
+# --- block-diagonal variants -------------------------------------------------
+#
+# Same fused two-kernel structure, but the reconstruction system is the
+# block-diagonal [g*w, g*k] expansion (rs_tpu.blockdiag_system): each
+# request's tile splits into g contiguous segments, group jg's input
+# rows are the survivors' slices of segment jg, and group jg's output
+# row is the wanted shard's bytes of that segment — concatenating the
+# groups along lanes reassembles the contiguous tile.  The fatter
+# contraction (8*pad16(g*k) = 384 vs 128 bits for k=10, g=4) is what
+# lifts the MXU roof from ~121 to ~157 GB/s (rs_tpu.py round 3/4).
+# Mosaic constraints inherited from the flat kernel: every DMA slice
+# start must stay provably FUSED_ALIGN-divisible, so per-chunk segments
+# are tile/groups wide and the blockdiag fetch ladder rounds up to a
+# multiple of groups*FUSED_ALIGN (a coarser ladder — the caller pays at
+# most one extra 4KB step of D2H per request, against a ~30% MXU win).
+
+
+def _make_gather_body_blockdiag(k, groups, g_n, tile, n_groups):
+    seg = tile // groups
+    w = g_n * seg
+    gk = groups * k
+
+    def body(offs_ref, *rest):
+        surv = rest[:k]
+        o_ref = rest[k]
+        sems = rest[k + 1]
+        g = pl.program_id(0)
+        j = pl.program_id(1)
+        copies = []
+        for r in range(g_n):
+            base = offs_ref[g * g_n + r] * FUSED_ALIGN + j * tile
+            for jg in range(groups):
+                # seg is a multiple of FUSED_ALIGN (caller-enforced), so
+                # base + jg*seg keeps the alignment proof intact
+                src = base + jg * seg
+                for i in range(k):
+                    dst = (
+                        ((j * n_groups + g) * gk + jg * k + i) * w + r * seg
+                    )
+                    copies.append(
+                        pltpu.make_async_copy(
+                            surv[i].at[pl.ds(src, seg)],
+                            o_ref.at[pl.ds(dst, seg)],
+                            sems.at[i, jg * g_n + r],
+                        )
+                    )
+        for c in copies:
+            c.start()
+        for c in copies:
+            c.wait()
+
+    return body
+
+
+def _make_select_body_blockdiag(k, groups, w_true, k_pad, m_pad, g_n, tile):
+    seg = tile // groups
+    w = g_n * seg
+    gk = groups * k
+
+    def body(rows_ref, a_ref, x_ref, o_ref):
+        g = pl.program_id(0)
+        xv = x_ref[0, 0]  # (g*k, w)
+        if gk < k_pad:
+            xv = jnp.concatenate(
+                [xv, jnp.zeros((k_pad - gk, w), jnp.uint8)], axis=0
+            )
+        bits = rs_tpu._unpack_bits_bitmajor(xv)
+        counts = jnp.dot(a_ref[:], bits, preferred_element_type=jnp.int32)
+        packed = rs_tpu._pack_bits_bitmajor(counts, m_pad)  # (m_pad, w)
+        ridx = jax.lax.broadcasted_iota(jnp.int32, (m_pad, seg), 0)
+        outs = []
+        for r in range(g_n):
+            row = rows_ref[g * g_n + r]
+            blk = packed[:, r * seg : (r + 1) * seg]  # (m_pad, seg)
+            segs = []
+            for jg in range(groups):
+                # group jg's wanted row sits at jg*w_true + row in the
+                # block-diagonal system; its seg lanes are the request's
+                # bytes [jg*seg, (jg+1)*seg) of this chunk's tile
+                sel = jnp.where(
+                    ridx == jg * w_true + row, blk, jnp.uint8(0)
+                ).astype(jnp.int32)
+                segs.append(
+                    jnp.sum(sel, axis=0, keepdims=True).astype(jnp.uint8)
+                )
+            outs.append(jnp.concatenate(segs, axis=1))  # (1, tile)
+        o_ref[:] = jnp.concatenate(outs, axis=0)
+
+    return body
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("tile", "fetch", "k_true", "w_true", "groups", "interpret"),
+)
+def _fused_reconstruct_blockdiag(
+    a_blk, survivors, meta, *, tile, fetch, k_true, w_true, groups, interpret
+):
+    """Block-diagonal twin of _fused_reconstruct: same meta packing and
+    flat 1-D output contract; `w_true` is the reconstruction system's
+    pre-expansion row count (len(wanted)) so the per-group row select
+    can address jg*w_true + row.  Caller guarantees tile % (groups *
+    FUSED_ALIGN) == 0 and fetch % tile == 0."""
+    k = len(survivors)
+    if k_true is not None and k != k_true:
+        raise ValueError(f"{k} survivors but matrix was built for {k_true}")
+    m_pad8, k_pad8 = a_blk.shape
+    m_pad, k_pad = m_pad8 // 8, k_pad8 // 8
+    n = meta.shape[1]
+    pad = (-n) % FUSED_GROUP
+    if pad:
+        meta = jnp.pad(meta, ((0, 0), (0, pad)))
+    offsets, row_idx = meta[0], meta[1]
+    n_pad = n + pad
+    chunks = fetch // tile
+    n_groups = n_pad // FUSED_GROUP
+    seg = tile // groups
+    w = FUSED_GROUP * seg
+    gk = groups * k
+
+    gathered = pl.pallas_call(
+        _make_gather_body_blockdiag(k, groups, FUSED_GROUP, tile, n_groups),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n_groups, chunks),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * k,
+            out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+            scratch_shapes=[
+                pltpu.SemaphoreType.DMA((k, groups * FUSED_GROUP))
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (chunks * n_groups * gk * w,), jnp.uint8
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=0,
+            bytes_accessed=2 * chunks * n_groups * gk * w,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(offsets, *survivors)
+    x4 = gathered.reshape(chunks, n_groups, gk, w)  # contiguous: free
+
+    out = pl.pallas_call(
+        _make_select_body_blockdiag(
+            k, groups, w_true, k_pad, m_pad, FUSED_GROUP, tile
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n_groups, chunks),
+            in_specs=[
+                pl.BlockSpec(
+                    a_blk.shape, lambda *_: (0, 0), memory_space=pltpu.VMEM
+                ),
+                pl.BlockSpec(
+                    (1, 1, gk, w),
+                    lambda gi, ji, *_: (ji, gi, 0, 0),
+                    memory_space=pltpu.VMEM,
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (FUSED_GROUP, tile),
+                lambda gi, ji, *_: (gi, ji),
+                memory_space=pltpu.VMEM,
+            ),
+        ),
+        out_shape=jax.ShapeDtypeStruct((n_pad, fetch), jnp.uint8),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m_pad8 * k_pad8 * n_pad * (fetch // groups),
+            bytes_accessed=(k + 1) * n_pad * fetch,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(row_idx, a_blk, x4)
+    return (out[:n] if pad else out).reshape(-1)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("tile", "fetch", "kernel", "interpret", "k_true"),
@@ -541,6 +832,68 @@ def _gather_reconstruct(
     return sel.reshape(-1)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "tile", "fetch", "groups", "w_true", "kernel", "interpret", "k_true",
+    ),
+)
+def _gather_reconstruct_blockdiag(
+    a_blk,
+    survivors,
+    offsets,
+    row_idx,
+    deltas,
+    *,
+    tile,
+    fetch,
+    groups,
+    w_true,
+    kernel,
+    interpret,
+    k_true,
+):
+    """Block-diagonal twin of _gather_reconstruct (the XLA fallback and
+    bench path): each request's tile splits into `groups` contiguous
+    segments gathered into segment-stacked [g*k, N*seg] rows, one
+    apply of the block-diagonal matrix reconstructs every segment, and
+    the per-group wanted rows (jg*w_true + row) concatenate back into
+    the contiguous [N, tile] before the same on-device delta/narrow."""
+    seg = tile // groups
+    cols = []
+    for jg in range(groups):
+        for arr in survivors:
+            cols.append(
+                jax.vmap(
+                    lambda off, arr=arr, jg=jg: jax.lax.dynamic_slice(
+                        arr, (off + jg * seg,), (seg,)
+                    )
+                )(offsets)
+            )
+    x = jnp.stack(cols, axis=0)  # [g*k, N, seg]
+    gk, n, _ = x.shape
+    out = rs_tpu.apply_matrix_device(
+        a_blk,
+        x.reshape(gk, n * seg),
+        kernel=kernel,
+        interpret=interpret,
+        k_true=None if k_true is None else groups * k_true,
+    )  # [m_pad >= groups*w_true, n*seg]
+    out3 = out.reshape(out.shape[0], n, seg).transpose(1, 0, 2)
+    segs = []
+    for jg in range(groups):
+        rows = row_idx + jg * w_true
+        segs.append(
+            jnp.take_along_axis(out3, rows[:, None, None], axis=1)[:, 0, :]
+        )
+    sel = jnp.concatenate(segs, axis=-1)  # [N, tile], contiguous bytes
+    if fetch < tile:
+        sel = jax.vmap(
+            lambda row, d: jax.lax.dynamic_slice(row, (d,), (fetch,))
+        )(sel, deltas)
+    return sel.reshape(-1)
+
+
 def _plan(requests: list[tuple[int, int, int]]):
     """Split/align requests into device sub-requests.
 
@@ -563,8 +916,10 @@ def _plan(requests: list[tuple[int, int, int]]):
     return subs
 
 
-def _resolve_codec(cache, vid, requests, data_shards, total_shards):
-    """Shared preamble: reconstruction matrix + resident survivor tuple."""
+def _resolve_codec(cache, vid, requests, data_shards, total_shards, layout):
+    """Shared preamble: reconstruction matrix (flat or block-diagonal,
+    per the active layout) + resident survivor tuple + the system's
+    pre-expansion row count."""
     wanted = sorted({r[0] for r in requests})
     resident = cache.shard_ids(vid)
     present = [s for s in resident if s not in wanted]
@@ -575,27 +930,28 @@ def _resolve_codec(cache, vid, requests, data_shards, total_shards):
     rmat, use = gf256.reconstruction_matrix(
         data_shards, total_shards, present, wanted
     )
-    a_bm = _prepared_matrix(rmat.tobytes(), *rmat.shape)
+    if layout == "blockdiag":
+        a_prep = _prepared_blockdiag_matrix(
+            rmat.tobytes(), *rmat.shape, cache.groups
+        )
+    else:
+        a_prep = _prepared_matrix(rmat.tobytes(), *rmat.shape)
     survivors = tuple(cache.get(vid, s) for s in use)
     if any(s is None for s in survivors):  # evicted between listing and get
         raise CacheMiss(f"vid {vid}: survivor shard evicted mid-request")
     row_of = {sid: i for i, sid in enumerate(wanted)}
-    return a_bm, survivors, row_of, use
+    return a_prep, survivors, row_of, use, rmat.shape[0]
 
 
 def _group_vectors(part, requests, row_of, pad):
-    offsets = jnp.asarray(
-        np.array([s[1] for _, s in part] + [0] * pad, dtype=np.int32)
+    """HOST-side offset/row/delta vectors (np): the H2D transfer happens
+    at dispatch time under the pipeline's h2d_copy stage, not here."""
+    offsets = np.array([s[1] for _, s in part] + [0] * pad, dtype=np.int32)
+    rows = np.array(
+        [row_of[requests[s[0]][0]] for _, s in part] + [0] * pad,
+        dtype=np.int32,
     )
-    rows = jnp.asarray(
-        np.array(
-            [row_of[requests[s[0]][0]] for _, s in part] + [0] * pad,
-            dtype=np.int32,
-        )
-    )
-    deltas = jnp.asarray(
-        np.array([s[2] for _, s in part] + [0] * pad, dtype=np.int32)
-    )
+    deltas = np.array([s[2] for _, s in part] + [0] * pad, dtype=np.int32)
     return offsets, rows, deltas
 
 
@@ -634,15 +990,14 @@ def _fused_vectors(part, requests, row_of, pad):
     fetch = _fetch_cover(span)
     # ONE packed [2, N] host->device transfer (row 0: offset units, row 1:
     # wanted matrix rows): tiny scalar vectors each pay a full dispatch
-    # RTT on tunneled rigs, so two transfers would double that tax
-    meta = jnp.asarray(
-        np.array(
-            [
-                offs_units + [0] * pad,
-                [row_of[requests[s[0]][0]] for _, s in part] + [0] * pad,
-            ],
-            dtype=np.int32,
-        )
+    # RTT on tunneled rigs, so two transfers would double that tax.
+    # Stays a HOST array here — the ship happens under h2d_copy.
+    meta = np.array(
+        [
+            offs_units + [0] * pad,
+            [row_of[requests[s[0]][0]] for _, s in part] + [0] * pad,
+        ],
+        dtype=np.int32,
     )
     return meta, deltas, fetch
 
@@ -659,6 +1014,39 @@ def _use_fused(kernel: str, interpret: bool) -> bool:
 # to "hit an unwarmed shape" instead of guessed at
 _dispatched_shapes: set = set()
 _shapes_lock = threading.Lock()
+
+
+# (size_bucket, count_bucket) -> dispatch count, recorded per device
+# call: warm() compiles the observed buckets FIRST, so a re-pin (budget
+# churn, volume move) reaches serving-readiness for the live workload's
+# shapes before burning 20-40s/compile on ladder corners nobody hits
+_observed_buckets: dict[tuple[int, int], int] = {}
+
+
+def _note_observed(size_bucket: int, count_bucket: int) -> None:
+    with _shapes_lock:
+        key = (size_bucket, count_bucket)
+        _observed_buckets[key] = _observed_buckets.get(key, 0) + 1
+
+
+def observed_buckets() -> list[tuple[int, int]]:
+    """(size_bucket, count_bucket) pairs this process has dispatched,
+    most-frequent first — warm()'s compile-priority order."""
+    with _shapes_lock:
+        items = sorted(_observed_buckets.items(), key=lambda kv: -kv[1])
+    return [k for k, _ in items]
+
+
+def _blockdiag_fetch_tile(fetch: int, groups: int) -> tuple[int, int]:
+    """(fetch, tile) for the fused blockdiag kernel: per-chunk segments
+    must stay FUSED_ALIGN-provable, so fetch rounds UP to a multiple of
+    groups*FUSED_ALIGN and tile is the fixed groups*FUSED_ALIGN-aligned
+    chunk (= FUSED_TILE for g=4).  Coarser D2H ladder than flat — at
+    most one extra step per request, traded for the blockdiag MXU win."""
+    q = groups * FUSED_ALIGN
+    fetch = -(-fetch // q) * q
+    tile = FUSED_TILE if FUSED_TILE % q == 0 and fetch % FUSED_TILE == 0 else q
+    return fetch, tile
 
 
 def _note_shape(key: tuple) -> bool:
@@ -678,6 +1066,93 @@ def _note_shape(key: tuple) -> bool:
     return miss
 
 
+def _pack_calls(
+    cache, vid, requests, kernel, interpret, layout, data_shards,
+    total_shards, record_observed=True,
+):
+    """PACK stage: resolve the codec, split/align the requests, group
+    them into device calls, and build every call's HOST-side vectors.
+    Returns (calls, subs, survivors, a_prep, use, w_true) — nothing has
+    touched the device yet, so a double-buffered caller can pack batch
+    N+1 while batch N still owns a staging slot.  `record_observed=False`
+    keeps synthetic probes (warm's ladder walk) out of the
+    observed-shape ranking, which must reflect live traffic only."""
+    a_prep, survivors, row_of, use, w_true = _resolve_codec(
+        cache, vid, requests, data_shards, total_shards, layout
+    )
+    fused = _use_fused(kernel, interpret)
+    groups = cache.groups if layout == "blockdiag" else 1
+    subs = _plan(requests)
+    calls = []  # (fused?, part, host vectors, fetch, tile/bucket, deltas)
+    for bucket in SIZE_BUCKETS:
+        group = [(i, s) for i, s in enumerate(subs) if s[4] == bucket]
+        if not group:
+            continue
+        n_bucket = _bucket(COUNT_BUCKETS, min(len(group), _max_count(bucket)))
+        for start in range(0, len(group), n_bucket):
+            part = group[start : start + n_bucket]
+            pad = n_bucket - len(part)
+            if record_observed:
+                _note_observed(bucket, n_bucket)
+            if fused:
+                # fetch covers the realigned delta+take (the host trims
+                # the delta head after D2H; no in-kernel shift needed)
+                meta, deltas, fetch = _fused_vectors(
+                    part, requests, row_of, pad
+                )
+                if layout == "blockdiag":
+                    fetch, tile = _blockdiag_fetch_tile(fetch, groups)
+                else:
+                    tile = _fused_tile_for(fetch)
+                calls.append(
+                    ("fused", part, (meta,), fetch, tile, n_bucket, deltas)
+                )
+            else:
+                vectors = _group_vectors(part, requests, row_of, pad)
+                # D2H width: power-of-two cover of the largest actual
+                # request in this call, never wider than the compute tile
+                max_take = max(s[3] for _, s in part)
+                fetch = min(bucket, 1 << (max_take - 1).bit_length())
+                calls.append(
+                    ("xla", part, vectors, fetch, bucket, n_bucket, None)
+                )
+    return calls, subs, survivors, a_prep, use, w_true
+
+
+def _dispatch_call(
+    kind, dev_vectors, a_prep, survivors, n_use, w_true, groups, tile,
+    fetch, kernel, interpret,
+):
+    """Route one packed call's ON-DEVICE vectors to its kernel — the
+    single home of the fused/xla x flat/blockdiag dispatch, shared by
+    reconstruct_intervals' drain loop and make_batched_call's bench
+    thunk so the benchmark can never measure a different compiled shape
+    than the serving path dispatches."""
+    if kind == "fused":
+        (meta,) = dev_vectors
+        if groups > 1:
+            return _fused_reconstruct_blockdiag(
+                a_prep, survivors, meta, tile=tile, fetch=fetch,
+                k_true=n_use, w_true=w_true, groups=groups,
+                interpret=interpret,
+            )
+        return _fused_reconstruct(
+            a_prep, survivors, meta, tile=tile, fetch=fetch,
+            k_true=n_use, interpret=interpret,
+        )
+    offsets, rows, deltas = dev_vectors
+    if groups > 1:
+        return _gather_reconstruct_blockdiag(
+            a_prep, survivors, offsets, rows, deltas, tile=tile,
+            fetch=fetch, groups=groups, w_true=w_true, kernel=kernel,
+            interpret=interpret, k_true=n_use,
+        )
+    return _gather_reconstruct(
+        a_prep, survivors, offsets, rows, deltas, tile=tile, fetch=fetch,
+        kernel=kernel, interpret=interpret, k_true=n_use,
+    )
+
+
 def reconstruct_intervals(
     cache: DeviceShardCache,
     vid: int,
@@ -686,6 +1161,8 @@ def reconstruct_intervals(
     interpret: bool | None = None,
     data_shards: int = DATA_SHARDS,
     total_shards: int = TOTAL_SHARDS,
+    layout: str | None = None,
+    record_observed: bool = True,
 ) -> list[bytes]:
     """Reconstruct interval bytes for a batch of degraded reads in as few
     device calls as possible (one per size bucket actually present).
@@ -694,29 +1171,45 @@ def reconstruct_intervals(
     are resident shards; per-call H2D is just the offset/row vectors and
     D2H is exactly the reconstructed bytes.  Raises CacheMiss when fewer
     than `data_shards` non-wanted shards of `vid` are resident.
-    """
+
+    `layout` (None = the cache's active layout) picks the kernel family:
+    "blockdiag" serves through the block-diagonal g-group system (the
+    ~157 GB/s round-3 kernel), "flat" the plain one.  The call is staged
+    pack -> H2D -> execute -> D2H: packing runs before a staging slot is
+    taken (cache.pipeline, 2 slots = double buffering), so a concurrent
+    batch packs and ships while the previous one executes and only each
+    batch's own D2H blocks it.  Every stage is a trace span feeding
+    SeaweedFS_request_stage_seconds."""
     if not requests:
         return []
     if kernel is None:
         kernel = "pallas" if rs_tpu.on_tpu() else "xla"
     if interpret is None:
         interpret = not rs_tpu.on_tpu()
-    a_bm, survivors, row_of, use = _resolve_codec(
-        cache, vid, requests, data_shards, total_shards
-    )
+    if layout is None:
+        layout = cache.layout
+    if layout not in LAYOUTS:
+        raise ValueError(f"unknown resident layout {layout!r}")
+    groups = cache.groups if layout == "blockdiag" else 1
     fused = _use_fused(kernel, interpret)
+    with obs_trace.span(
+        "batch_pack", requests=len(requests), layout=layout
+    ):
+        calls, subs, survivors, a_prep, use, w_true = _pack_calls(
+            cache, vid, requests, kernel, interpret, layout,
+            data_shards, total_shards, record_observed,
+        )
     # the device-execute stage of the request trace: every dispatched
     # call's H2D/D2H bytes and compile-cache outcome annotate the span
     # (and the SeaweedFS_volumeServer_ec_device_* counters), so a slow
     # read can say "compile cliff" or "tunnel-bound fetch" by itself
     dev_span = obs_trace.span(
-        "device_execute", requests=len(requests),
-        kernel=("fused" if fused else kernel),
+        "device_execute", requests=len(requests), layout=layout,
+        kernel=(("fused_" if fused else "") + ("blockdiag" if groups > 1
+                                               else kernel)),
     )
     dev_calls = dev_misses = dev_h2d = dev_d2h = 0
     surv_len = int(survivors[0].size)
-
-    subs = _plan(requests)
     sub_out: list[bytes | None] = [None] * len(subs)
 
     # PIPELINE: dispatch device calls ahead of fetching results (jax
@@ -731,7 +1224,16 @@ def reconstruct_intervals(
 
     def _finish(entry) -> int:
         part, arr, fetch, deltas = entry
-        out = np.asarray(arr).reshape(-1, fetch)
+        nbytes = int(arr.size)  # padded rows ride the fetch too
+        # completion boundary BEFORE the d2h span: jax dispatch is
+        # async, so without it the fetch would absorb the kernel's
+        # remaining execute time and an MXU/compile regression would
+        # read as "tunnel-bound fetch" in the stage histogram — the
+        # blocking wait lands in device_execute, where it belongs
+        arr.block_until_ready()
+        with obs_trace.span("d2h_copy", bytes=nbytes):
+            out = np.asarray(arr).reshape(-1, fetch)
+        stats_metrics.VOLUME_SERVER_EC_D2H_BYTES.inc(nbytes)
         if deltas is not None:  # fused: host trims the alignment delta
             for j, (sub_idx, (_, _, _, take, _)) in enumerate(part):
                 d = deltas[j]
@@ -743,76 +1245,52 @@ def reconstruct_intervals(
                 sub_out[sub_idx] = out[j, lo : lo + take].tobytes()
         return len(part) * fetch
 
-    with dev_span:
-        for bucket in SIZE_BUCKETS:
-            group = [(i, s) for i, s in enumerate(subs) if s[4] == bucket]
-            if not group:
-                continue
-            n_bucket = _bucket(
-                COUNT_BUCKETS, min(len(group), _max_count(bucket))
+    with cache.pipeline.slot() as slot_wait_s, dev_span:
+        for kind, part, vectors, fetch, tile, n_bucket, deltas in calls:
+            # H2D: ship this call's packed host vectors.  Tiny, but on a
+            # tunneled rig each transfer pays a dispatch RTT — making it
+            # a named stage is what lets the stage histogram show
+            # whether h2d or execute owns a regression.
+            h2d_bytes = sum(int(v.nbytes) for v in vectors)
+            with obs_trace.span("h2d_copy", bytes=h2d_bytes):
+                dev_vectors = tuple(jnp.asarray(v) for v in vectors)
+                for v in dev_vectors:
+                    # the put is async too: wait it out INSIDE the span
+                    # so the stage measures the transfer, not the
+                    # enqueue (tiny vectors — the kernel needs them
+                    # landed before it runs anyway)
+                    v.block_until_ready()
+            stats_metrics.VOLUME_SERVER_EC_H2D_BYTES.inc(h2d_bytes)
+            dev_h2d += h2d_bytes
+            # the prepared matrix's row dim tracks the wanted-shard
+            # count EXACTLY as retracing does: blockdiag kernels take
+            # w_true static (and a_blk rows = 8*pad4(g*w_true) moves
+            # with it), while the flat kernels only retrace when
+            # pad4(w_true) changes a_bm's shape — keying on the shape
+            # neither misses a real compile nor counts phantom ones
+            dev_misses += _note_shape(
+                ("fused" if kind == "fused" else kernel, layout, tile,
+                 fetch, n_bucket, len(use), int(a_prep.shape[0]),
+                 surv_len)
             )
-            for start in range(0, len(group), n_bucket):
-                part = group[start : start + n_bucket]
-                pad = n_bucket - len(part)
-                if fused:
-                    # fetch covers the realigned delta+take (the host trims
-                    # the delta head after D2H; no in-kernel shift needed)
-                    meta, deltas, fetch = _fused_vectors(
-                        part, requests, row_of, pad
-                    )
-                    tile = _fused_tile_for(fetch)
-                    dev_misses += _note_shape(
-                        ("fused", tile, fetch, n_bucket, len(use), surv_len)
-                    )
-                    dev_h2d += int(meta.nbytes)
-                    arr = _fused_reconstruct(
-                        a_bm,
-                        survivors,
-                        meta,
-                        tile=tile,
-                        fetch=fetch,
-                        k_true=len(use),
-                        interpret=interpret,
-                    )
-                    pending.append((part, arr, fetch, deltas))
-                    pending_bytes += len(part) * fetch
-                else:
-                    offsets, rows, deltas = _group_vectors(
-                        part, requests, row_of, pad
-                    )
-                    # D2H width: power-of-two cover of the largest actual
-                    # request in this call, never wider than the compute tile
-                    max_take = max(s[3] for _, s in part)
-                    fetch = min(bucket, 1 << (max_take - 1).bit_length())
-                    dev_misses += _note_shape(
-                        (kernel, bucket, fetch, n_bucket, len(use), surv_len)
-                    )
-                    dev_h2d += 3 * 4 * n_bucket  # offsets/rows/deltas int32
-                    arr = _gather_reconstruct(
-                        a_bm,
-                        survivors,
-                        offsets,
-                        rows,
-                        deltas,
-                        tile=bucket,
-                        fetch=fetch,
-                        kernel=kernel,
-                        interpret=interpret,
-                        k_true=len(use),
-                    )
-                    pending.append((part, arr, fetch, None))
-                    pending_bytes += len(part) * fetch
-                dev_calls += 1
-                # the padded rows ride the wire too: count what the
-                # fetch actually moves, not just the useful subset
-                dev_d2h += n_bucket * fetch
-                while pending_bytes > _MAX_PENDING_OUT and len(pending) > 1:
-                    pending_bytes -= _finish(pending.pop(0))
+            arr = _dispatch_call(
+                kind, dev_vectors, a_prep, survivors, len(use), w_true,
+                groups, tile, fetch, kernel, interpret,
+            )
+            pending.append((part, arr, fetch, deltas))
+            pending_bytes += len(part) * fetch
+            dev_calls += 1
+            # the padded rows ride the wire too: count what the
+            # fetch actually moves, not just the useful subset
+            dev_d2h += n_bucket * fetch
+            while pending_bytes > _MAX_PENDING_OUT and len(pending) > 1:
+                pending_bytes -= _finish(pending.pop(0))
         for entry in pending:
             _finish(entry)
         dev_span.annotate(
             device_calls=dev_calls, compile_misses=dev_misses,
             h2d_bytes=dev_h2d, d2h_bytes=dev_d2h,
+            slot_wait_us=int(slot_wait_s * 1e6),
         )
         stats_metrics.VOLUME_SERVER_EC_DEVICE_H2D_BYTES.inc(dev_h2d)
         stats_metrics.VOLUME_SERVER_EC_DEVICE_D2H_BYTES.inc(dev_d2h)
@@ -828,17 +1306,22 @@ def make_batched_call(
     requests: list[tuple[int, int, int]],
     kernel: str | None = None,
     interpret: bool | None = None,
+    layout: str | None = None,
 ):
     """Zero-arg thunk running the ONE device call a homogeneous batch of
     requests (same size bucket, count <= COUNT_BUCKETS[-1]) maps to,
     returning the un-copied device array — bench.py profiler-times the
-    serving call with this, without host copies in the measured region."""
+    serving call with this, without host copies in the measured region.
+    `layout` follows the cache's active layout by default."""
     if kernel is None:
         kernel = "pallas" if rs_tpu.on_tpu() else "xla"
     if interpret is None:
         interpret = not rs_tpu.on_tpu()
-    a_bm, survivors, row_of, use = _resolve_codec(
-        cache, vid, requests, DATA_SHARDS, TOTAL_SHARDS
+    if layout is None:
+        layout = cache.layout
+    groups = cache.groups if layout == "blockdiag" else 1
+    a_prep, survivors, row_of, use, w_true = _resolve_codec(
+        cache, vid, requests, DATA_SHARDS, TOTAL_SHARDS, layout
     )
     subs = _plan(requests)
     buckets = {s[4] for s in subs}
@@ -846,34 +1329,32 @@ def make_batched_call(
         raise ValueError("bench batch must be one homogeneous bucket group")
     bucket = buckets.pop()
     part = list(enumerate(subs))
+    # NOTE: deliberately NOT _pack_calls — the bench thunk keeps the
+    # whole homogeneous batch in ONE device call (its contract), while
+    # _pack_calls would split wide large-size batches at _max_count.
     pad = _bucket(COUNT_BUCKETS, len(part)) - len(part)
     if _use_fused(kernel, interpret):
-        meta, _deltas, fetch = _fused_vectors(
+        kind = "fused"
+        meta_np, _deltas, fetch = _fused_vectors(
             part, requests, row_of, pad
         )
-        return lambda: _fused_reconstruct(
-            a_bm,
-            survivors,
-            meta,
-            tile=_fused_tile_for(fetch),
-            fetch=fetch,
-            k_true=len(use),
-            interpret=interpret,
+        if groups > 1:
+            fetch, tile = _blockdiag_fetch_tile(fetch, groups)
+        else:
+            tile = _fused_tile_for(fetch)
+        dev_vectors = (jnp.asarray(meta_np),)
+    else:
+        kind = "xla"
+        dev_vectors = tuple(
+            jnp.asarray(v)
+            for v in _group_vectors(part, requests, row_of, pad)
         )
-    offsets, rows, deltas = _group_vectors(part, requests, row_of, pad)
-    max_take = max(s[3] for _, s in part)
-    fetch = min(bucket, 1 << (max_take - 1).bit_length())
-    return lambda: _gather_reconstruct(
-        a_bm,
-        survivors,
-        offsets,
-        rows,
-        deltas,
-        tile=bucket,
-        fetch=fetch,
-        kernel=kernel,
-        interpret=interpret,
-        k_true=len(use),
+        max_take = max(s[3] for _, s in part)
+        fetch = min(bucket, 1 << (max_take - 1).bit_length())
+        tile = bucket
+    return lambda: _dispatch_call(
+        kind, dev_vectors, a_prep, survivors, len(use), w_true, groups,
+        tile, fetch, kernel, interpret,
     )
 
 
@@ -911,6 +1392,52 @@ def _scrub_call(a_bm, data, parity, *, n_lanes, kernel, interpret):
     return jnp.stack(rows)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("n_lanes", "groups", "kernel", "interpret")
+)
+def _scrub_call_blockdiag(
+    a_blk, data, parity, *, n_lanes, groups, kernel, interpret
+):
+    """Block-diagonal scrub: the verified span splits into `groups`
+    contiguous segments per shard (the host-staged segment stacking —
+    slices of the same resident buffers), one apply of the blockdiag
+    parity system recomputes every segment's parity, and group jg's
+    output rows compare against parity segment jg.  Same contract as
+    _scrub_call: only the [p, n_seg] int32 mismatch partials leave the
+    device."""
+    k = len(data)
+    p = len(parity)
+    seg = n_lanes // groups
+    x = jnp.concatenate(
+        [
+            data[i][jg * seg : (jg + 1) * seg][None, :]
+            for jg in range(groups)
+            for i in range(k)
+        ],
+        axis=0,
+    )  # [g*k, seg], segment-stacked
+    out = rs_tpu.apply_matrix_device(
+        a_blk, x, kernel=kernel, interpret=interpret, k_true=groups * k
+    )
+    rows = []
+    for j in range(p):
+        diff = jnp.concatenate(
+            [
+                out[jg * p + j] != parity[j][jg * seg : (jg + 1) * seg]
+                for jg in range(groups)
+            ]
+        )
+        rows.append(
+            jnp.stack(
+                [
+                    jnp.sum(diff[s : s + _SCRUB_SEG].astype(jnp.int32))
+                    for s in range(0, n_lanes, _SCRUB_SEG)
+                ]
+            )
+        )
+    return jnp.stack(rows)
+
+
 def scrub_volume(
     cache: DeviceShardCache,
     vid: int,
@@ -918,18 +1445,24 @@ def scrub_volume(
     interpret: bool | None = None,
     data_shards: int = DATA_SHARDS,
     total_shards: int = TOTAL_SHARDS,
+    layout: str | None = None,
 ) -> tuple[list[int], int]:
     """Parity scrub of a fully resident volume: -> (per-parity-shard
     mismatch byte counts, bytes verified per shard).  Raises CacheMiss
     unless ALL shards are resident.  The verified span rounds the true
-    shard size UP to the lane tile — cache buffers are zero-padded and
-    parity-of-zeros is zero, so the extra lanes verify trivially instead
-    of costing a per-shard tail fetch (each tiny D2H pays a full tunnel
-    round-trip)."""
+    shard size UP to the lane tile (blockdiag: to groups lane tiles, so
+    every segment slice stays lane-aligned) — cache buffers are
+    zero-padded and parity-of-zeros is zero, so the extra lanes verify
+    trivially instead of costing a per-shard tail fetch (each tiny D2H
+    pays a full tunnel round-trip).  `layout` (None = cache's active
+    layout) picks the kernel: blockdiag runs the scrub matmul on the
+    ~157 GB/s round-3 system."""
     if kernel is None:
         kernel = "pallas" if rs_tpu.on_tpu() else "xla"
     if interpret is None:
         interpret = not rs_tpu.on_tpu()
+    if layout is None:
+        layout = cache.layout
     resident = cache.shard_ids(vid)
     if len(resident) < total_shards:
         raise CacheMiss(
@@ -939,22 +1472,45 @@ def scrub_volume(
     if len(sizes) != 1:
         raise CacheMiss(f"vid {vid}: resident shard sizes differ: {sizes}")
     true_size = sizes.pop()
-    n_lanes = -(-true_size // LANE) * LANE
     parity_m = gf256.build_matrix(data_shards, total_shards)[data_shards:]
-    a_bm = _prepared_matrix(parity_m.tobytes(), *parity_m.shape)
     data = tuple(cache.get(vid, s) for s in range(data_shards))
     parity = tuple(
         cache.get(vid, s) for s in range(data_shards, total_shards)
     )
     if any(s is None for s in data + parity):
         raise CacheMiss(f"vid {vid}: shard evicted mid-scrub")
-    partials = np.asarray(
-        _scrub_call(
-            a_bm, data, parity,
-            n_lanes=n_lanes, kernel=kernel, interpret=interpret,
+    if layout == "blockdiag":
+        quant = cache.groups * LANE
+        n_lanes = -(-true_size // quant) * quant
+        a_blk = _prepared_blockdiag_matrix(
+            parity_m.tobytes(), *parity_m.shape, cache.groups
         )
-    )
+        partials = np.asarray(
+            _scrub_call_blockdiag(
+                a_blk, data, parity,
+                n_lanes=n_lanes, groups=cache.groups,
+                kernel=kernel, interpret=interpret,
+            )
+        )
+    else:
+        n_lanes = -(-true_size // LANE) * LANE
+        a_bm = _prepared_matrix(parity_m.tobytes(), *parity_m.shape)
+        partials = np.asarray(
+            _scrub_call(
+                a_bm, data, parity,
+                n_lanes=n_lanes, kernel=kernel, interpret=interpret,
+            )
+        )
     return [int(row.sum(dtype=np.int64)) for row in partials], n_lanes
+
+
+def _warm_key(size: int, count: int) -> tuple[int, int]:
+    """Map a warm-plan (size, count) to the (size_bucket, count_bucket)
+    shape its ALIGNED-offset request compiles — the key space
+    observed_buckets() records.  Ranking by the off=0 class (not
+    size+delta) keeps boundary sizes like 2048 in their own bucket."""
+    b = _bucket(SIZE_BUCKETS, min(size, MAX_TILE))
+    return b, _bucket(COUNT_BUCKETS, min(count, _max_count(b)))
 
 
 def warm(
@@ -965,12 +1521,24 @@ def warm(
     # coalesce round, and a full burst — the serving path's count shapes
     total_shards: int = TOTAL_SHARDS,
     should_stop=None,  # callable -> bool: abort between compiles
+    layout: str | None = None,
+    observed: list[tuple[int, int]] | None = None,
     **kw,
 ) -> None:
     """Pre-compile the bucket combinations a serving path will hit, so the
     first real degraded read doesn't pay a 20-40s TPU compile.  The wanted
     shard is a NON-resident one when any exists (the realistic degraded
-    case), so a volume with exactly DATA_SHARDS survivors still warms."""
+    case), so a volume with exactly DATA_SHARDS survivors still warms.
+
+    Compiles the ACTIVE layout's ladder only (`layout`, None = the
+    cache's — the other family's shapes would double the 20-40s/shape
+    mount-time bill for a path the knob has switched off), and walks the
+    grid OBSERVED-SHAPES-FIRST (`observed`, default this process's
+    dispatch history): a re-pin under live traffic reaches
+    serving-readiness for the workload's real (size, count) buckets
+    before burning compiles on ladder corners nobody hits."""
+    if layout is None:
+        layout = cache.layout
     resident = cache.shard_ids(vid)
     non_resident = [s for s in range(total_shards) if s not in resident]
     if non_resident:
@@ -981,14 +1549,24 @@ def warm(
         missing = resident[-1]
         if len(resident) - 1 < DATA_SHARDS:
             return
-    for size in sizes:
-        for count in counts:
-            # both alignment classes: an aligned offset keeps fetch at
-            # cover(size); any other offset pushes the span past it onto
-            # the next ladder step (usually the 3*2^(n-1) one, see
-            # _fetch_cover) — each is its own compiled shape
-            for off in (0, 1):
-                if should_stop is not None and should_stop():
-                    return
-                reqs = [(missing, off, size)] * count
-                reconstruct_intervals(cache, vid, reqs, **kw)
+    grid = [(size, count) for size in sizes for count in counts]
+    if observed is None:
+        observed = observed_buckets()
+    if observed:
+        rank = {b: i for i, b in enumerate(observed)}
+        grid.sort(key=lambda sc: rank.get(_warm_key(*sc), len(rank)))
+    for size, count in grid:
+        # both alignment classes: an aligned offset keeps fetch at
+        # cover(size); any other offset pushes the span past it onto
+        # the next ladder step (usually the 3*2^(n-1) one, see
+        # _fetch_cover) — each is its own compiled shape
+        for off in (0, 1):
+            if should_stop is not None and should_stop():
+                return
+            reqs = [(missing, off, size)] * count
+            # record_observed=False: warm's own ladder walk must not
+            # feed the observed-shape ranking it consults
+            reconstruct_intervals(
+                cache, vid, reqs, layout=layout,
+                record_observed=False, **kw,
+            )

@@ -43,7 +43,7 @@ from ..storage.ec import (
     write_idx_file_from_ec_index,
 )
 from .. import obs, stats
-from ..serving import EcReadDispatcher
+from ..serving import EcReadDispatcher, ServingConfig
 from ..security import verify_volume_write_jwt
 from ..security import tls as tls_mod
 from ..security import guard as guard_mod
@@ -158,11 +158,21 @@ class VolumeServer:
             from ..storage import backend as backend_mod
 
             backend_mod.configure(tier_backends)
+        # validate the serving config BEFORE the Store exists: the cache
+        # must carry the configured layout/pipeline shape from birth —
+        # Store.__init__ spawns pin/warm threads for on-disk EC volumes
+        # immediately, and a warm racing a late layout assignment would
+        # burn its 20-40s/shape budget compiling the wrong ladder
+        ec_serving = (ec_serving or ServingConfig()).validated()
         device_cache = None
         if ec_device_cache_mb > 0:
             from ..ops.rs_resident import DeviceShardCache
 
-            device_cache = DeviceShardCache(budget_bytes=ec_device_cache_mb << 20)
+            device_cache = DeviceShardCache(
+                budget_bytes=ec_device_cache_mb << 20,
+                layout=ec_serving.layout,
+            )
+            device_cache.pipeline.set_slots(ec_serving.pipeline_slots)
         if isinstance(max_volume_counts, int):
             max_volume_counts = [max_volume_counts] * len(directories)
         if disk_types is None:
@@ -448,6 +458,18 @@ class VolumeServer:
         tel.dispatcher_inflight = self.ec_dispatcher.inflight
         tel.dispatcher_shed = int(
             g("SeaweedFS_volumeServer_ec_batch_fallback_total") or 0
+        )
+        # double-buffered batch pipeline: last window's device-busy /
+        # wall ratio + cumulative staged bytes, so cluster.health can
+        # show per-node overlap next to queue/occupancy
+        tel.overlap_fraction = float(
+            g("SeaweedFS_volumeServer_ec_overlap_fraction") or 0.0
+        )
+        tel.ec_h2d_bytes = int(
+            g("SeaweedFS_volumeServer_ec_h2d_bytes_total") or 0
+        )
+        tel.ec_d2h_bytes = int(
+            g("SeaweedFS_volumeServer_ec_d2h_bytes_total") or 0
         )
         snap = stats.metrics.stage_histogram_snapshot()
         for stage, buckets, count, dsum in stats.metrics.stage_digest_deltas(

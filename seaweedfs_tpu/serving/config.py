@@ -36,10 +36,23 @@ class ServingConfig:
     # per-read path (counted in the fallback metric) instead of growing
     # the queue without bound
     max_queue: int = 2048
+    # resident shard layout the reconstruct kernels serve through:
+    # "blockdiag" is the ~157 GB/s round-3 g=4 system (default — the
+    # host stages the segment layout for free at pin time), "flat" the
+    # plain kernel kept as fallback (-ec.serving.layout)
+    layout: str = "blockdiag"
+    # double-buffered device staging: 2 slots let batch N+1 pack and
+    # ship while batch N executes (only N's D2H blocks N); False = one
+    # slot, the serial baseline bench.py's overlap-off axis measures
+    overlap: bool = True
 
     @property
     def max_wait_s(self) -> float:
         return self.max_wait_us / 1e6
+
+    @property
+    def pipeline_slots(self) -> int:
+        return 2 if self.overlap else 1
 
     def validated(self) -> "ServingConfig":
         if self.max_batch < 1:
@@ -50,4 +63,6 @@ class ServingConfig:
             raise ValueError("max_queue must be >= max_batch")
         if self.max_wait_us < 0:
             raise ValueError("max_wait_us must be >= 0")
+        if self.layout not in ("flat", "blockdiag"):
+            raise ValueError("layout must be 'flat' or 'blockdiag'")
         return self

@@ -21,6 +21,14 @@ read_needles_batch -> ops/rs_resident.py).  Three rules:
      the fallback series) — saturation degrades to round-5 behavior, it
      never grows an unbounded queue.
 
+Each in-flight lane's device call is itself staged pack -> H2D ->
+execute -> D2H through the cache's two-slot DevicePipeline
+(ops/rs_resident.py, configured from ServingConfig.overlap): a lane
+packs batch N+1's host vectors outside the slot while another lane's
+batch N executes, so lanes overlap at the stage level rather than just
+racing whole calls — the overlap-fraction gauge and the batch_pack /
+h2d_copy / d2h_copy trace stages make the overlap visible per batch.
+
 Every decision is visible on /metrics: batch-width histogram, per-request
 queue wait, in-flight batch occupancy, fallback and native-route
 counters (stats/metrics.py).

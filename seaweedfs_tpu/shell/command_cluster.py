@@ -109,9 +109,9 @@ async def cmd_cluster_health(env, args):
         f"{health['stale_after_seconds']:.1f}s without a heartbeat)"
     )
     env.write(
-        "  {:<22} {:>7} {:>6} {:>20} {:>6} {:>9} {:>7}".format(
+        "  {:<22} {:>7} {:>6} {:>20} {:>6} {:>9} {:>7} {:>8}".format(
             "node", "age_s", "stale", "hbm used/budget", "queue",
-            "inflight", "shed"
+            "inflight", "shed", "overlap"
         )
     )
     for url, n in health["nodes"].items():
@@ -121,11 +121,14 @@ async def cmd_cluster_health(env, args):
             f"{fmt_bytes(dev['used_bytes'])}/{fmt_bytes(dev['budget_bytes'])}"
             if dev else "-"
         )
+        ov = disp.get("overlap_fraction")
         env.write(
-            "  {:<22} {:>7.1f} {:>6} {:>20} {:>6} {:>9} {:>7}".format(
+            "  {:<22} {:>7.1f} {:>6} {:>20} {:>6} {:>9} {:>7} {:>8}".format(
                 url, n["age_seconds"], "YES" if n["stale"] else "no",
                 hbm, disp.get("queue_depth", "-"),
                 disp.get("inflight", "-"), disp.get("shed_total", "-"),
+                # >1 means the double-buffer's staging slots overlapped
+                f"{ov:.2f}" if isinstance(ov, (int, float)) else "-",
             )
         )
     residency = cluster.get("ec_volume_residency", {})

@@ -86,6 +86,14 @@ CLUSTER_DISPATCHER_SHED = Gauge(
     ["node"],
     registry=REGISTRY,
 )
+CLUSTER_OVERLAP_FRACTION = Gauge(
+    "SeaweedFS_cluster_ec_overlap_fraction",
+    "Per-node device-busy/wall ratio of the last double-buffered EC "
+    "batch window (>1 = staging slots overlapped), re-exported from "
+    "heartbeat telemetry.",
+    ["node"],
+    registry=REGISTRY,
+)
 CLUSTER_STAGE_P50 = Gauge(
     "SeaweedFS_cluster_stage_p50_seconds",
     "Cluster-wide p50 estimate per serving stage, interpolated from the "
@@ -144,6 +152,9 @@ class NodeTelemetry:
     dispatcher_queue_depth: int = 0
     dispatcher_inflight: int = 0
     dispatcher_shed: int = 0
+    overlap_fraction: float = 0.0
+    ec_h2d_bytes: int = 0
+    ec_d2h_bytes: int = 0
     resident_by_volume: dict = field(default_factory=dict)
 
     def to_dict(self, now: float, stale_after: float) -> dict:
@@ -174,6 +185,9 @@ class NodeTelemetry:
                 "queue_depth": self.dispatcher_queue_depth,
                 "inflight": self.dispatcher_inflight,
                 "shed_total": self.dispatcher_shed,
+                "overlap_fraction": round(self.overlap_fraction, 3),
+                "h2d_bytes_total": self.ec_h2d_bytes,
+                "d2h_bytes_total": self.ec_d2h_bytes,
             }
         return d
 
@@ -227,6 +241,13 @@ class ClusterTelemetry:
             nt.dispatcher_queue_depth = tel.dispatcher_queue_depth
             nt.dispatcher_inflight = tel.dispatcher_inflight
             nt.dispatcher_shed = tel.dispatcher_shed
+            # getattr-guarded: a pre-r09 volume server's telemetry pb
+            # simply lacks the pipeline fields
+            nt.overlap_fraction = float(
+                getattr(tel, "overlap_fraction", 0.0)
+            )
+            nt.ec_h2d_bytes = int(getattr(tel, "ec_h2d_bytes", 0))
+            nt.ec_d2h_bytes = int(getattr(tel, "ec_d2h_bytes", 0))
             nt.resident_by_volume = dict(tel.resident_shards_by_volume)
             n_buckets = len(STAGE_SECONDS_BUCKETS) + 1
             for d in tel.stage_digests:
@@ -298,7 +319,7 @@ class ClusterTelemetry:
             CLUSTER_DEVICE_BUDGET, CLUSTER_DEVICE_USED,
             CLUSTER_DEVICE_RESIDENT, CLUSTER_DEVICE_EVICTIONS,
             CLUSTER_DISPATCHER_QUEUE, CLUSTER_DISPATCHER_INFLIGHT,
-            CLUSTER_DISPATCHER_SHED,
+            CLUSTER_DISPATCHER_SHED, CLUSTER_OVERLAP_FRACTION,
         ):
             g.clear()
         fresh = stale = 0
@@ -322,6 +343,9 @@ class ClusterTelemetry:
                 nt.dispatcher_inflight
             )
             CLUSTER_DISPATCHER_SHED.labels(node=url).set(nt.dispatcher_shed)
+            CLUSTER_OVERLAP_FRACTION.labels(node=url).set(
+                nt.overlap_fraction
+            )
         CLUSTER_NODES.labels(state="fresh").set(fresh)
         CLUSTER_NODES.labels(state="stale").set(stale)
         for stage, (buckets, _count, _sum) in stages.items():

@@ -150,7 +150,10 @@ VOLUME_SERVER_EC_READ_ROUTE = Counter(
 TRACE_STAGES = (
     "queue_wait",        # coalescer admission -> batch take (dispatcher)
     "batch_dispatch",    # one coalesced batch through the store call
+    "batch_pack",        # host-side planning + vector staging of a batch
+    "h2d_copy",          # shipping the packed vectors host -> device
     "device_execute",    # rs_resident reconstruct (device dispatch+fetch)
+    "d2h_copy",          # fetching reconstructed bytes device -> host
     "host_reconstruct",  # CPU-kernel GF(256) reconstruct fallback
     "shard_read",        # .ecx index lookups + local shard preads
     "remote_shard_read", # peer shard interval fetch (VolumeEcShardRead)
@@ -200,6 +203,34 @@ VOLUME_SERVER_EC_DEVICE_COMPILE = Counter(
 )
 for _r in ("hit", "miss"):
     VOLUME_SERVER_EC_DEVICE_COMPILE.labels(result=_r)
+
+# double-buffered batch pipeline (ops/rs_resident.DevicePipeline): the
+# explicit pack->H2D->execute->D2H staging of the serving path.  The
+# byte counters are the stage-level view of the same transfers the
+# ec_device_* counters account per device call (measured at the copy
+# sites, so a pipeline-stage regression can be read off directly); the
+# overlap gauge is what proves the double buffer actually overlaps.
+VOLUME_SERVER_EC_H2D_BYTES = Counter(
+    "SeaweedFS_volumeServer_ec_h2d_bytes",
+    "Host->device bytes staged by the double-buffered EC batch "
+    "pipeline's h2d_copy stage (packed offset/row vectors; survivor "
+    "bytes stay pinned).",
+    registry=REGISTRY,
+)
+VOLUME_SERVER_EC_D2H_BYTES = Counter(
+    "SeaweedFS_volumeServer_ec_d2h_bytes",
+    "Device->host bytes fetched by the pipeline's d2h_copy stage "
+    "(reconstructed interval rows, fetch-width padding included).",
+    registry=REGISTRY,
+)
+VOLUME_SERVER_EC_OVERLAP_FRACTION = Gauge(
+    "SeaweedFS_volumeServer_ec_overlap_fraction",
+    "Device-busy time / wall time over the double-buffered EC "
+    "pipeline's current batch window, refreshed at every batch "
+    "completion (1.0 = the device section was busy the whole window; "
+    ">1 = staging slots overlapped, up to the slot count).",
+    registry=REGISTRY,
+)
 
 MQ_FENCE_CONFLICT = Counter(
     "SeaweedFS_mq_fence_conflict",

@@ -1,0 +1,72 @@
+"""Bench-tail contract: the driver archives only the LAST 2000 chars of
+bench.py's single JSON output line, so the headline keys (value,
+vs_baseline*, consistency, serving_headline) must be the TRAILING keys
+of the printed dict.  VERDICT r5 Weak #4 is what happens when this
+slips; bench.order_result is the single enforcement point and this
+suite pins it."""
+import json
+
+from bench import HEADLINE_KEYS, order_result
+
+
+def test_headline_keys_are_the_contract():
+    # the driver's archive rule names exactly these, in this order
+    assert HEADLINE_KEYS == (
+        "value",
+        "vs_baseline",
+        "vs_baseline_conservative",
+        "consistency",
+        "serving_headline",
+    )
+
+
+def test_order_result_puts_headline_keys_last():
+    shuffled = {
+        "serving_headline": {"device_wins": True},
+        "metric": "rs_10_4_encode_blockdiag_pallas",
+        "value": 12.3,
+        "extra": {"bulk": list(range(10))},
+        "consistency": {"ok": True},
+        "unit": "GB/s",
+        "vs_baseline_conservative": 8.1,
+        "vs_baseline": 9.9,
+    }
+    ordered = list(order_result(shuffled))
+    assert tuple(ordered[-len(HEADLINE_KEYS):]) == HEADLINE_KEYS
+    # non-headline keys keep their relative order up front
+    assert ordered[:3] == ["metric", "extra", "unit"]
+    # nothing dropped, nothing invented
+    assert set(ordered) == set(shuffled)
+
+
+def test_order_result_tolerates_missing_headline_keys():
+    # the device-unavailable error path prints a reduced dict; ordering
+    # must not invent keys for it
+    partial = {"metric": "x", "value": 0, "error": "device unavailable"}
+    ordered = list(order_result(partial))
+    assert ordered == ["metric", "error", "value"]
+
+
+def test_archived_tail_carries_headline():
+    """The real guarantee: with a bulky `extra` (far beyond the archive
+    window), the last 2000 chars of the JSON line still contain every
+    headline key."""
+    result = order_result(
+        {
+            "metric": "rs_10_4_encode_blockdiag_pallas",
+            "unit": "GB/s",
+            "extra": {f"diag_{i}": "x" * 40 for i in range(200)},
+            "value": 12.34,
+            "vs_baseline": 9.9,
+            "vs_baseline_conservative": 8.1,
+            "consistency": {"ok": True},
+            "serving_headline": {
+                "best_resident_reads_per_s": 1000.0,
+                "blockdiag_overlap_beats_flat_serial": True,
+                "consistency_ok": True,
+            },
+        }
+    )
+    tail = json.dumps(result)[-2000:]
+    for key in HEADLINE_KEYS:
+        assert f'"{key}"' in tail, f"{key} fell outside the archived tail"
