@@ -99,6 +99,25 @@ def add_args(p) -> None:
         "instead of double-buffering pack/H2D of batch N+1 under batch "
         "N's execute",
     )
+    # staged bulk EC pipelines (storage/ec/bulk.py): encode/rebuild/verify
+    # overlap host read, device matmul, and shard write by default
+    p.add_argument(
+        "-ec.bulk.overlap.disable", dest="ec_bulk_overlap_disable",
+        action="store_true",
+        help="run the bulk EC pipelines (encode/rebuild/verify) serially "
+        "on one thread instead of overlapping the read/device/write legs",
+    )
+    p.add_argument(
+        "-ec.bulk.prefetch", dest="ec_bulk_prefetch", type=int, default=3,
+        help="stripe batches the bulk pipelines' reader leg may run "
+        "ahead of the codec (bounded queue depth)",
+    )
+    p.add_argument(
+        "-ec.bulk.strideMB", dest="ec_bulk_stride_mb", type=int, default=0,
+        help="per-shard bytes per bulk codec call (0 = built-in 4MB "
+        "default; smaller strides trade kernel efficiency for pipeline "
+        "granularity)",
+    )
     p.add_argument(
         "-readMode", dest="read_mode", default="proxy",
         choices=["local", "proxy", "redirect"],
@@ -145,6 +164,17 @@ def add_args(p) -> None:
 async def run(args) -> None:
     common_args.apply_obs_args(args)
     from ..server.volume import VolumeServer
+    from ..storage.ec import bulk as ec_bulk
+
+    # bulk pipelines are store-level maintenance verbs; the config is
+    # process-global like the obs flags
+    ec_bulk.configure(
+        ec_bulk.BulkConfig(
+            overlap=not args.ec_bulk_overlap_disable,
+            prefetch=args.ec_bulk_prefetch,
+            stride=args.ec_bulk_stride_mb << 20,
+        )
+    )
 
     if args.offset_bytes != 4:
         from ..storage import types as storage_types

@@ -1547,7 +1547,8 @@ class VolumeServer:
     async def VolumeEcShardsRebuild(self, request, context):
         try:
             rebuilt = await asyncio.to_thread(
-                self.store.ec_rebuild, request.volume_id, request.collection
+                self.store.ec_rebuild, request.volume_id, request.collection,
+                request.fsync,
             )
         except (NotFoundError, ValueError) as e:
             await context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))

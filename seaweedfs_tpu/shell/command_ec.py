@@ -10,6 +10,7 @@ the volume server's configured backend (TPU MXU kernels by default).
 """
 from __future__ import annotations
 
+import asyncio
 import math
 
 from ..pb import master_pb2, volume_server_pb2
@@ -104,50 +105,135 @@ def balanced_ec_distribution(nodes: list[TopoNode], n_shards: int = TOTAL_SHARDS
     return [(n, alloc[n.url]) for n in ranked if alloc[n.url]]
 
 
+# shell fan-out knobs: shard-set copies ship tens of MB each, so the
+# concurrency bound keeps a wide cluster from saturating the source's
+# uplink, and the per-RPC timeout/retry keeps one wedged peer from
+# hanging the whole verb (the reference's parallelCopyEcShardsFromSource
+# runs one goroutine per target with an ErrorWaitGroup)
+FANOUT_CONCURRENCY = 4
+RPC_ATTEMPTS = 3
+RPC_TIMEOUT_S = 300.0
+
+
+async def _retry_rpc(
+    call_factory,
+    what: str,
+    *,
+    timeout_s: float = RPC_TIMEOUT_S,
+    attempts: int = RPC_ATTEMPTS,
+):
+    """Await `call_factory()` (a fresh RPC per attempt) under a deadline,
+    retrying TRANSIENT transport failures with exponential backoff.  The
+    shard-move RPCs are all idempotent (copy overwrites, mount/unmount/
+    delete converge), so a retry after an ambiguous failure is safe —
+    but deterministic server verdicts (NOT_FOUND, FAILED_PRECONDITION,
+    ...) surface immediately instead of burning attempts*timeout on an
+    answer that will not change."""
+    import grpc
+
+    transient = (
+        grpc.StatusCode.UNAVAILABLE,
+        grpc.StatusCode.DEADLINE_EXCEEDED,
+        grpc.StatusCode.UNKNOWN,  # ambiguous transport/middlebox failures
+    )
+    delay = 0.2
+    for attempt in range(1, attempts + 1):
+        try:
+            return await asyncio.wait_for(call_factory(), timeout_s)
+        except (grpc.RpcError, asyncio.TimeoutError, ConnectionError) as e:
+            code = e.code() if isinstance(e, grpc.RpcError) else None
+            if code is not None and code not in transient:
+                raise  # a real answer, not a delivery problem
+            if attempt == attempts:
+                raise RuntimeError(
+                    f"{what} failed after {attempts} attempts: {e!r}"
+                ) from e
+            await asyncio.sleep(delay)
+            delay *= 2
+
+
 async def spread_ec_shards(
     env: CommandEnv,
     vid: int,
     collection: str,
     source: TopoNode,
     targets: list[tuple[TopoNode, list[int]]],
+    concurrency: int = FANOUT_CONCURRENCY,
 ) -> None:
-    """Copy+mount each target's shard set from source, then unmount the
-    moved shards at the source (parallelCopyEcShardsFromSource →
-    unmountEcShards, command_ec_encode.go:145-188)."""
-    first = True
-    for node, shard_ids in targets:
-        if node.url == source.url:
-            first = False
-            continue
-        stub = env.volume_stub(node.grpc_address)
-        await stub.VolumeEcShardsCopy(
-            volume_server_pb2.VolumeEcShardsCopyRequest(
-                volume_id=vid,
-                collection=collection,
-                shard_ids=shard_ids,
-                copy_ecx_file=True,
-                copy_ecj_file=True,
-                copy_vif_file=first,
-                source_data_node=source.grpc_address,
+    """Copy+mount each target's shard set from source CONCURRENTLY
+    (bounded), then unmount the moved shards at the source
+    (parallelCopyEcShardsFromSource → unmountEcShards,
+    command_ec_encode.go:145-188).  The `.vif` sidecar ships with exactly
+    ONE copy target — decided before the fan-out starts, so concurrent
+    copies can't race it — and each target's copy→mount→source-unmount→
+    source-delete sequence stays ordered within its own task."""
+    real = [
+        (node, shard_ids)
+        for node, shard_ids in targets
+        if node.url != source.url and shard_ids
+    ]
+    vif_url = real[0][0].url if real else None
+    sem = asyncio.Semaphore(max(1, concurrency))
+
+    async def ship(node: TopoNode, shard_ids: list[int]) -> None:
+        async with sem:
+            stub = env.volume_stub(node.grpc_address)
+            await _retry_rpc(
+                lambda: stub.VolumeEcShardsCopy(
+                    volume_server_pb2.VolumeEcShardsCopyRequest(
+                        volume_id=vid,
+                        collection=collection,
+                        shard_ids=shard_ids,
+                        copy_ecx_file=True,
+                        copy_ecj_file=True,
+                        copy_vif_file=node.url == vif_url,
+                        source_data_node=source.grpc_address,
+                    )
+                ),
+                f"copy shards {shard_ids} of {vid} to {node.url}",
             )
-        )
-        first = False
-        await stub.VolumeEcShardsMount(
-            volume_server_pb2.VolumeEcShardsMountRequest(
-                volume_id=vid, collection=collection, shard_ids=shard_ids
+            await _retry_rpc(
+                lambda: stub.VolumeEcShardsMount(
+                    volume_server_pb2.VolumeEcShardsMountRequest(
+                        volume_id=vid, collection=collection,
+                        shard_ids=shard_ids,
+                    )
+                ),
+                f"mount shards {shard_ids} of {vid} on {node.url}",
             )
-        )
-        src_stub = env.volume_stub(source.grpc_address)
-        await src_stub.VolumeEcShardsUnmount(
-            volume_server_pb2.VolumeEcShardsUnmountRequest(
-                volume_id=vid, shard_ids=shard_ids
+            src_stub = env.volume_stub(source.grpc_address)
+            await _retry_rpc(
+                lambda: src_stub.VolumeEcShardsUnmount(
+                    volume_server_pb2.VolumeEcShardsUnmountRequest(
+                        volume_id=vid, shard_ids=shard_ids
+                    )
+                ),
+                f"unmount shards {shard_ids} of {vid} at source",
             )
-        )
-        await src_stub.VolumeEcShardsDelete(
-            volume_server_pb2.VolumeEcShardsDeleteRequest(
-                volume_id=vid, collection=collection, shard_ids=shard_ids
+            await _retry_rpc(
+                lambda: src_stub.VolumeEcShardsDelete(
+                    volume_server_pb2.VolumeEcShardsDeleteRequest(
+                        volume_id=vid, collection=collection,
+                        shard_ids=shard_ids,
+                    )
+                ),
+                f"delete shards {shard_ids} of {vid} at source",
             )
-        )
+
+    await _gather_strict(ship(node, sids) for node, sids in real)
+
+
+async def _gather_strict(coros) -> None:
+    """gather that lets every sibling RUN TO COMPLETION, then raises the
+    first failure.  Plain gather() re-raises early while the surviving
+    tasks keep mutating cluster state (unmounting/deleting source shards)
+    after the verb has already 'failed' — and their own exceptions die as
+    never-retrieved warnings.  Cancelling siblings instead would strand a
+    peer mid copy→mount→unmount move, which is worse than finishing it."""
+    results = await asyncio.gather(*coros, return_exceptions=True)
+    for r in results:
+        if isinstance(r, BaseException):
+            raise r
 
 
 @command("ec.encode")
@@ -268,13 +354,49 @@ async def cmd_ec_scrub(env, args):
         )
 
 
+async def gather_ec_shards(
+    stub,
+    vid: int,
+    collection: str,
+    to_copy: dict[str, list[int]],
+    concurrency: int = FANOUT_CONCURRENCY,
+) -> None:
+    """Pull every borrowed shard set onto the rebuilder CONCURRENTLY
+    (bounded, per-RPC retry/timeout).  All copies land on the SAME node,
+    so the sidecars (.ecx/.ecj/.vif) ship with exactly one of them —
+    concurrent pulls writing the same sidecar path would race."""
+    sidecar_src = next(iter(to_copy), None)
+    sem = asyncio.Semaphore(max(1, concurrency))
+
+    async def pull(src_addr: str, sids: list[int]) -> None:
+        async with sem:
+            await _retry_rpc(
+                lambda: stub.VolumeEcShardsCopy(
+                    volume_server_pb2.VolumeEcShardsCopyRequest(
+                        volume_id=vid,
+                        collection=collection,
+                        shard_ids=sids,
+                        copy_ecx_file=src_addr == sidecar_src,
+                        copy_ecj_file=src_addr == sidecar_src,
+                        copy_vif_file=src_addr == sidecar_src,
+                        source_data_node=src_addr,
+                    )
+                ),
+                f"gather shards {sids} of {vid} from {src_addr}",
+            )
+
+    await _gather_strict(pull(src, sids) for src, sids in to_copy.items())
+
+
 @command("ec.rebuild")
 async def cmd_ec_rebuild(env, args):
-    """[-force] : rebuild missing EC shards onto a rebuilder node
-    (command_ec_rebuild.go:99-176)"""
+    """[-force] [-fsync] : rebuild missing EC shards onto a rebuilder node
+    (command_ec_rebuild.go:99-176); -fsync makes the rebuilt shards
+    durable before the RPC returns"""
     env.confirm_is_locked()
     flags = parse_flags(args)
     apply = "force" in flags
+    fsync = "fsync" in flags
     shard_map = await collect_ec_volume_shards(env)
     nodes, _ = await env.collect_topology()
     for vid, shards in sorted(shard_map.items()):
@@ -304,21 +426,10 @@ async def cmd_ec_rebuild(env, args):
         for sid, holder in shards.items():
             if sid not in local and holder.url != rebuilder.url:
                 to_copy.setdefault(holder.grpc_address, []).append(sid)
-        for src_addr, sids in to_copy.items():
-            await stub.VolumeEcShardsCopy(
-                volume_server_pb2.VolumeEcShardsCopyRequest(
-                    volume_id=vid,
-                    collection=collection,
-                    shard_ids=sids,
-                    copy_ecx_file=True,
-                    copy_ecj_file=True,
-                    copy_vif_file=True,
-                    source_data_node=src_addr,
-                )
-            )
+        await gather_ec_shards(stub, vid, collection, to_copy)
         resp = await stub.VolumeEcShardsRebuild(
             volume_server_pb2.VolumeEcShardsRebuildRequest(
-                volume_id=vid, collection=collection
+                volume_id=vid, collection=collection, fsync=fsync
             )
         )
         await stub.VolumeEcShardsMount(

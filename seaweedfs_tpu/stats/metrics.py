@@ -158,6 +158,9 @@ TRACE_STAGES = (
     "shard_read",        # .ecx index lookups + local shard preads
     "remote_shard_read", # peer shard interval fetch (VolumeEcShardRead)
     "chunk_fetch",       # filer -> volume server chunk read
+    "bulk_read",         # bulk EC pipeline reader leg (stripe preads)
+    "bulk_device",       # bulk EC pipeline codec leg (stage+H2D+kernel+D2H)
+    "bulk_write",        # bulk EC pipeline writer leg (shard writes/compare)
 )
 # the FIXED bucket ladder the heartbeat stage digests ride on: volume
 # servers ship per-bucket count deltas over exactly these edges (+Inf
@@ -231,6 +234,48 @@ VOLUME_SERVER_EC_OVERLAP_FRACTION = Gauge(
     ">1 = staging slots overlapped, up to the slot count).",
     registry=REGISTRY,
 )
+
+# staged bulk EC pipelines (storage/ec/bulk.py): the per-leg decomposition
+# behind every encode/rebuild/verify overlap claim — read leg, codec leg,
+# and writer leg active seconds accumulate per pipeline so a dashboard can
+# read off which leg bounds bulk wall-clock, and the overlap gauge proves
+# the legs actually ran concurrently (the stats-contract inequality
+# read_s + write_s + device_busy_s > wall_s, as a ratio)
+VOLUME_SERVER_EC_BULK_SECONDS = Counter(
+    "SeaweedFS_volumeServer_ec_bulk_seconds",
+    "Cumulative active seconds of the staged bulk EC pipelines by leg "
+    "(read = stripe/shard preads, device = codec stage+H2D+kernel+D2H "
+    "or CPU kernel, write = shard writes / parity compare).",
+    ["pipeline", "leg"],
+    registry=REGISTRY,
+)
+VOLUME_SERVER_EC_BULK_BYTES = Counter(
+    "SeaweedFS_volumeServer_ec_bulk_bytes",
+    "Useful input bytes processed by the bulk EC pipelines (encode: .dat "
+    "bytes; rebuild/verify: survivor/data shard bytes read).",
+    ["pipeline"],
+    registry=REGISTRY,
+)
+VOLUME_SERVER_EC_BULK_BATCHES = Counter(
+    "SeaweedFS_volumeServer_ec_bulk_batches",
+    "Stripe batches pushed through the bulk EC pipelines' codec leg.",
+    ["pipeline"],
+    registry=REGISTRY,
+)
+VOLUME_SERVER_EC_BULK_OVERLAP_FRACTION = Gauge(
+    "SeaweedFS_volumeServer_ec_bulk_overlap_fraction",
+    "Leg-active seconds / wall seconds of the last bulk EC pipeline run "
+    "per pipeline (fsync tail excluded; 1.0 = one leg busy the whole "
+    "wall, >1 = legs genuinely overlapped, up to 3.0).",
+    ["pipeline"],
+    registry=REGISTRY,
+)
+for _p in ("encode", "rebuild", "verify"):
+    for _leg in ("read", "device", "write"):
+        VOLUME_SERVER_EC_BULK_SECONDS.labels(pipeline=_p, leg=_leg)
+    VOLUME_SERVER_EC_BULK_BYTES.labels(pipeline=_p)
+    VOLUME_SERVER_EC_BULK_BATCHES.labels(pipeline=_p)
+    VOLUME_SERVER_EC_BULK_OVERLAP_FRACTION.labels(pipeline=_p)
 
 MQ_FENCE_CONFLICT = Counter(
     "SeaweedFS_mq_fence_conflict",

@@ -16,9 +16,11 @@ from .layout import (
     locate_data,
     to_ext,
 )
+from .bulk import BulkConfig
 from .encoder import (
     ec_base_name,
     rebuild_ec_files,
+    verify_ec_files,
     write_ec_files,
     write_sorted_file_from_idx,
 )
@@ -36,8 +38,10 @@ __all__ = [
     "locate_data",
     "to_ext",
     "ec_base_name",
+    "BulkConfig",
     "write_ec_files",
     "rebuild_ec_files",
+    "verify_ec_files",
     "write_sorted_file_from_idx",
     "write_dat_file",
     "write_idx_file_from_ec_index",

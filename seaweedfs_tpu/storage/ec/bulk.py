@@ -1,0 +1,520 @@
+"""Staged executor shared by the bulk EC pipelines (encode / rebuild /
+verify in encoder.py).
+
+The three pipelines move the same shape of work: read stripe batches
+from disk, push them through a GF(256) matrix multiply (device or CPU),
+and write/compare the results.  Before this module each pipeline staged
+every pread and every shard write on the caller thread between device
+submits, so wall-clock was read + device + write even though the legs
+touch disjoint resources.  Here the legs run on dedicated threads around
+bounded queues, so wall-clock trends toward max(read, device, write):
+
+  reader leg   -> bounded stripe queue ->  caller (submit/resolve)
+                                             |  bounded result queue
+                                             v
+                                          writer leg
+
+Reads use one vectored ``os.preadv`` per stripe where the platform has
+it and the stripe's rows are contiguous on disk (full-block batches),
+instead of DATA_SHARDS serial preads.  All staging buffers are
+``np.empty`` with tail-only zeroing — a full memset per stripe was ~10%
+of the read leg at device speeds (same fix DeviceShardCache.put got).
+
+Stats contract (the dict ``run()`` fills, same keys for all three
+pipelines):
+
+  read_s / submit_s / wait_s / write_s   per-leg active seconds
+  device_busy_s                          codec worker active time
+  wall_s, fsync_s, batches               caller-filled wall + tail
+  overlap                                the mode the run used
+
+With ``overlap=False`` every leg runs on the caller thread, so
+``read_s + submit_s + wait_s + write_s (+ fsync_s) ~= wall_s``.  With
+``overlap=True`` the legs overlap and
+``read_s + write_s + device_busy_s > wall_s - fsync_s`` is the measured
+proof (the fsync tail follows the last write by definition, so it is
+excluded from the window on both sides of the claim) —
+the per-pipeline ``SeaweedFS_volumeServer_ec_bulk_*`` series and the
+``bulk_read`` / ``bulk_device`` / ``bulk_write`` trace stages publish
+the same decomposition.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass
+
+import numpy as np
+
+from ...ops import rs
+from .layout import DATA_SHARDS, LARGE_BLOCK_SIZE
+
+# Per-shard stride fed to the codec in one device call.  4MB x 10 shards =
+# 40MB input per batch: large enough to saturate the MXU kernel (tile sweep
+# in ops/rs_tpu.py), small enough to double-buffer in HBM comfortably.
+DEFAULT_STRIDE = 4 * 1024 * 1024
+# In-flight codec batches: the caller may run this far ahead of the codec
+# worker before blocking on a resolve.  3 keeps one batch staging, one on
+# the wire, one landing.  NOTE the overlapped pipeline's true peak host
+# footprint is ~(2*prefetch + depth + 2) batches — the stripe queue, the
+# pending deque, the result queue (payloads ride along for the writer),
+# and one in each leg's hands — ~10 batches (~400MB at the default 4MB
+# stride) vs the serial mode's 1; size stride/prefetch down together on
+# memory-tight volume servers.
+PIPELINE_DEPTH = 3
+
+# test seams / portability: the slow-IO fixtures in tests/test_ec_bulk.py
+# wrap these, and platforms without preadv (none we target) fall back to
+# per-row pread
+_pread = os.pread
+_preadv = getattr(os, "preadv", None)
+
+
+@dataclass
+class BulkConfig:
+    """Knobs for the staged bulk pipelines (CLI: the -ec.bulk.* flags).
+
+    Process-global like obs.CONFIG — bulk encode/rebuild/verify are
+    store-level maintenance verbs, not per-request serving state."""
+
+    # run the reader/writer legs on dedicated threads; False = the
+    # serial baseline (every leg on the caller thread) the bench sweep's
+    # overlap-off axis measures
+    overlap: bool = True
+    # bounded stripe-queue depth: how many read batches the reader leg
+    # may run ahead of the codec (and results ahead of the writer)
+    prefetch: int = 3
+    # per-shard bytes per codec call; 0 = DEFAULT_STRIDE
+    stride: int = 0
+
+    def validated(self) -> "BulkConfig":
+        if self.prefetch < 1:
+            raise ValueError("prefetch must be >= 1")
+        if self.stride < 0:
+            raise ValueError("stride must be >= 0")
+        if (
+            self.stride
+            and self.stride < LARGE_BLOCK_SIZE
+            and LARGE_BLOCK_SIZE % self.stride
+        ):
+            # a non-dividing stride silently falls back to whole-block
+            # batches in the encode plan — a [10, 1GB] (~10GB) staging
+            # array per batch on volumes with large-block rows.  Fail at
+            # flag-parse time instead of OOM mid-encode.
+            raise ValueError(
+                "stride must divide the 1GB EC large block "
+                "(use a power-of-two -ec.bulk.strideMB)"
+            )
+        return self
+
+
+DEFAULT = BulkConfig()
+
+
+def configure(cfg: BulkConfig) -> None:
+    """Apply the -ec.bulk.* flags; process-global like stats.REGISTRY."""
+    global DEFAULT
+    DEFAULT = cfg.validated()
+
+
+class Codec:
+    """Wraps RSCodec so the matrix-multiply leg can run pipelined.
+    submit() returns an opaque handle; resolve() turns it into a numpy
+    [m, stride] array.  `busy_s` accumulates the leg's active time — the
+    device_busy_s term of the stats contract.
+
+    Device path: one worker thread owns the whole device leg — stage the
+    block-diagonal layout, jax.device_put, dispatch the kernel, fetch the
+    result — because on a tunneled device both transfers BLOCK; run from
+    the caller they would serialize against file reads/writes.  CPU
+    backends get the same worker thread when `threaded` (the overlap
+    mode): pread/pwrite and the native kernel all release the GIL, so the
+    three legs genuinely overlap."""
+
+    def __init__(self, matrix: np.ndarray, backend: str, threaded: bool = False):
+        self.backend = rs.resolve_backend(backend)
+        self.matrix = np.asarray(matrix, dtype=np.uint8)
+        self.rows = self.matrix.shape[0]
+        self.device = self.backend in ("xla", "pallas")
+        self.busy_s = 0.0
+        self._pool = None
+        if self.device:
+            from ...ops import rs_tpu
+
+            self._tpu = rs_tpu
+            self._a_bm = rs_tpu.prepare_matrix(self.matrix)
+            self._a_blk = rs_tpu.prepare_matrix_blockdiag(self.matrix)
+            self._interpret = not rs_tpu.on_tpu()
+            self._pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ec-dev"
+            )
+        else:
+            self._codec = rs.RSCodec(backend=self.backend)
+            if threaded:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="ec-host"
+                )
+
+    def submit(self, shards: np.ndarray):
+        if self.device:
+            return self._pool.submit(self._device_leg, shards)
+        if self._pool is not None:
+            return self._pool.submit(self._host_leg, shards)
+        return self._host_leg(shards)
+
+    def _host_leg(self, shards: np.ndarray) -> np.ndarray:
+        t0 = time.perf_counter()
+        out = self._codec.apply_matrix(self.matrix, shards)
+        self.busy_s += time.perf_counter() - t0
+        return out
+
+    def _device_leg(self, shards: np.ndarray) -> np.ndarray:
+        """Both transfers ship FLAT 1-D buffers (apply_matrix_device_flat):
+        the tunnel pays ~80ms per row on 2-D arrays, which would dominate
+        the whole pipeline."""
+        import jax
+
+        t0 = time.perf_counter()
+        groups = self._tpu.BLOCKDIAG_GROUPS
+        k, b = shards.shape
+        if self.backend == "pallas" and b % (groups * 128) == 0:
+            # block-diagonal fast path: host stages segment-stacked rows
+            # (free — same bytes) and the MXU runs with a full M dimension
+            # (~152 vs ~123 GB/s, see ops/rs_tpu.py header)
+            stacked = np.ascontiguousarray(self._tpu.stack_segments(shards))
+            x = jax.device_put(stacked.reshape(-1))
+            out = self._tpu.apply_matrix_device_flat(
+                self._a_blk,
+                x,
+                k=groups * k,
+                m=groups * self.rows,
+                tile=self._tpu.BLOCKDIAG_TILE,
+                interpret=self._interpret,
+            )
+            seg = b // groups
+            parity = self._tpu.unstack_segments(
+                np.asarray(out).reshape(groups * self.rows, seg), self.rows
+            )
+        else:
+            x = jax.device_put(np.ascontiguousarray(shards).reshape(-1))
+            out = self._tpu.apply_matrix_device_flat(
+                self._a_bm,
+                x,
+                k=k,
+                m=self.rows,
+                kernel=self.backend,
+                interpret=self._interpret,
+            )
+            parity = np.asarray(out).reshape(self.rows, b)
+        self.busy_s += time.perf_counter() - t0
+        return parity
+
+    def resolve(self, handle) -> np.ndarray:
+        if isinstance(handle, Future):
+            return handle.result()
+        return handle
+
+    def shutdown(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+
+
+# ----------------------------------------------------------------- reads
+
+
+def _zero_tail(out: np.ndarray, filled: int) -> None:
+    """Zero every byte of a [rows, width] batch past the first `filled`
+    (row-major) — the tail-only half of the np.empty staging rule."""
+    rows, width = out.shape
+    row, rem = divmod(filled, width)
+    if rem:
+        out[row, rem:] = 0
+        row += 1
+    if row < rows:
+        out[row:] = 0
+
+
+def read_stripe(
+    f, dat_size: int, row_start: int, block_size: int, stride_off: int, stride: int
+) -> np.ndarray:
+    """[DATA_SHARDS, stride] batch: shard i's bytes are the original volume
+    at row_start + i*block_size + stride_off, zero-padded past EOF
+    (encodeDataOneBatch's zero-fill, ec_encoder.go:165-177).
+
+    Full-block batches (stride == block_size) cover one CONTIGUOUS byte
+    range of the .dat — the rows are just a reshape — so a single
+    vectored preadv scatters the whole stripe into the row buffers in one
+    syscall.  Sub-block batches (stride < block_size) have strided row
+    offsets and fall back to one pread per row."""
+    out = np.empty((DATA_SHARDS, stride), dtype=np.uint8)
+    fd = f.fileno()
+    if _preadv is not None and stride == block_size and stride_off == 0:
+        want = min(DATA_SHARDS * stride, max(0, dat_size - row_start))
+        got = _preadv(fd, list(out), row_start) if want > 0 else 0
+        if got >= want:
+            _zero_tail(out, got)
+            return out
+        # short read before the known EOF (signal/odd fs): retake the
+        # whole stripe on the per-row path rather than resuming mid-iov
+    for i in range(DATA_SHARDS):
+        start = row_start + i * block_size + stride_off
+        n = min(stride, max(0, dat_size - start))
+        if n > 0:
+            buf = _pread(fd, n, start)
+            out[i, : len(buf)] = np.frombuffer(buf, dtype=np.uint8)
+            if len(buf) < stride:
+                out[i, len(buf) :] = 0
+        else:
+            out[i, :] = 0
+    return out
+
+
+def read_shard_rows(handles: dict, ids, n: int, off: int) -> np.ndarray:
+    """[len(ids), n] batch from per-shard FILES (rebuild/verify inputs):
+    row j is shard ids[j]'s bytes at [off, off+n), zero-padded on a short
+    read.  Separate files can't share a preadv, but each row is one
+    contiguous pread."""
+    out = np.empty((len(ids), n), dtype=np.uint8)
+    for j, sid in enumerate(ids):
+        buf = _pread(handles[sid].fileno(), n, off)
+        out[j, : len(buf)] = np.frombuffer(buf, dtype=np.uint8)
+        if len(buf) < n:
+            out[j, len(buf) :] = 0
+    return out
+
+
+def write_or_seek(fobj, row: np.ndarray) -> None:
+    """Sparse-aware shard write: an all-zero chunk becomes a hole (seek)
+    instead of written zeros — byte-identical on read (holes read as
+    zeros), but a mostly-empty volume encodes/rebuilds without
+    materializing terabytes of zero blocks.  Final sizes are fixed by the
+    caller's ftruncate."""
+    if row.any():
+        fobj.write(row.tobytes())
+    else:
+        fobj.seek(len(row), os.SEEK_CUR)
+
+
+# -------------------------------------------------------------- executor
+
+_DONE = object()
+
+
+class _Leg(threading.Thread):
+    """One pipeline leg: runs fn to completion, parks any exception for
+    the orchestrator to re-raise."""
+
+    def __init__(self, name: str, fn):
+        super().__init__(name=name, daemon=True)
+        self._fn = fn
+        self.error: BaseException | None = None
+
+    def run(self) -> None:  # pragma: no cover - trivial dispatch
+        try:
+            self._fn()
+        except BaseException as e:  # noqa: BLE001 — parked for the caller
+            self.error = e
+
+
+def _put_checked(q: queue.Queue, item, leg: _Leg) -> None:
+    """put() that cannot deadlock on a dead consumer: if the consuming
+    leg died, raise its error instead of blocking on a full queue."""
+    while True:
+        if leg.error is not None:
+            raise leg.error
+        try:
+            q.put(item, timeout=0.1)
+            return
+        except queue.Full:
+            continue
+
+
+def run(
+    name: str,
+    plan: list,
+    read_batch,
+    codec: Codec,
+    write_batch,
+    *,
+    overlap: bool | None = None,
+    prefetch: int | None = None,
+    depth: int = PIPELINE_DEPTH,
+    to_codec=None,
+) -> dict:
+    """Drive one bulk pipeline over `plan` and return its stats dict.
+
+    `read_batch(desc) -> payload` runs on the reader leg,
+    `codec.submit(to_codec(payload))` / `resolve` on the caller thread
+    (device/CPU work lands on the codec's own worker), and
+    `write_batch(desc, payload, result)` on the writer leg, in plan
+    order.  With overlap disabled everything runs inline on the caller
+    thread — the serial baseline of the stats contract."""
+    cfg = DEFAULT
+    overlap = cfg.overlap if overlap is None else bool(overlap)
+    prefetch = cfg.prefetch if prefetch is None else prefetch
+    pick = to_codec if to_codec is not None else lambda payload: payload
+    t = {
+        "read_s": 0.0, "submit_s": 0.0, "wait_s": 0.0, "write_s": 0.0,
+        "fsync_s": 0.0, "batches": 0, "overlap": overlap,
+    }
+    clock = time.perf_counter
+
+    if not overlap:
+        for desc in plan:
+            t0 = clock()
+            payload = read_batch(desc)
+            t1 = clock()
+            handle = codec.submit(pick(payload))
+            t2 = clock()
+            result = codec.resolve(handle)
+            t3 = clock()
+            write_batch(desc, payload, result)
+            t["read_s"] += t1 - t0
+            t["submit_s"] += t2 - t1
+            t["wait_s"] += t3 - t2
+            t["write_s"] += clock() - t3
+            t["batches"] += 1
+        t["device_busy_s"] = codec.busy_s
+        return t
+
+    read_q: queue.Queue = queue.Queue(maxsize=max(1, prefetch))
+    write_q: queue.Queue = queue.Queue(maxsize=max(1, prefetch))
+    abort = threading.Event()
+
+    def reader() -> None:
+        try:
+            for desc in plan:
+                if abort.is_set():
+                    return
+                r0 = clock()
+                payload = read_batch(desc)
+                t["read_s"] += clock() - r0
+                read_q.put((desc, payload))
+        finally:
+            read_q.put(_DONE)
+
+    def writer() -> None:
+        while True:
+            item = write_q.get()
+            if item is _DONE:
+                return
+            desc, payload, result = item
+            w0 = clock()
+            write_batch(desc, payload, result)
+            t["write_s"] += clock() - w0
+
+    r_leg = _Leg(f"ec-bulk-{name}-read", reader)
+    w_leg = _Leg(f"ec-bulk-{name}-write", writer)
+    r_leg.start()
+    w_leg.start()
+    pending: deque = deque()
+
+    def flush_one() -> None:
+        desc, payload, handle = pending.popleft()
+        q0 = clock()
+        result = codec.resolve(handle)
+        t["wait_s"] += clock() - q0
+        _put_checked(write_q, (desc, payload, result), w_leg)
+
+    try:
+        while True:
+            item = read_q.get()
+            if item is _DONE:
+                # the reader's finally puts _DONE while its exception is
+                # still unwinding toward _Leg.run's handler — join before
+                # reading .error or a reader failure could look like a
+                # clean (truncated!) end of plan
+                r_leg.join()
+                if r_leg.error is not None:
+                    raise r_leg.error
+                break
+            desc, payload = item
+            s0 = clock()
+            handle = codec.submit(pick(payload))
+            t["submit_s"] += clock() - s0
+            t["batches"] += 1
+            pending.append((desc, payload, handle))
+            if len(pending) >= depth:
+                flush_one()
+        while pending:
+            flush_one()
+        _put_checked(write_q, _DONE, w_leg)
+        w_leg.join()
+        if w_leg.error is not None:
+            raise w_leg.error
+    except BaseException:
+        # unblock both legs before propagating: the reader may be parked
+        # on a full stripe queue, the writer on an empty result queue
+        abort.set()
+        while True:
+            try:
+                if read_q.get(timeout=0.05) is _DONE:
+                    break
+            except queue.Empty:
+                if not r_leg.is_alive():
+                    break
+        while w_leg.is_alive():
+            try:
+                write_q.put(_DONE, timeout=0.05)
+                break
+            except queue.Full:
+                # aborting anyway: drop a queued result to make room for
+                # the sentinel rather than stranding the writer on get()
+                try:
+                    write_q.get_nowait()
+                except queue.Empty:
+                    pass
+        r_leg.join(timeout=5)
+        w_leg.join(timeout=5)
+        raise
+    t["device_busy_s"] = codec.busy_s
+    return t
+
+
+def publish(name: str, t: dict, input_bytes: int) -> None:
+    """Feed one finished run into the SeaweedFS_volumeServer_ec_bulk_*
+    series and the bulk_read/bulk_device/bulk_write trace stages (the
+    caller's active trace when the pipeline ran under a traced RPC, e.g.
+    VolumeEcShardsGenerate).  Call after wall_s/fsync_s are filled."""
+    from ...obs import trace as obs_trace
+    from ...stats import metrics as _metrics
+
+    wall = float(t.get("wall_s", 0.0))
+    ctx = obs_trace.current()
+    t0 = time.perf_counter() - wall
+    for leg, key in (
+        ("read", "read_s"), ("device", "device_busy_s"), ("write", "write_s")
+    ):
+        secs = float(t.get(key, 0.0))
+        _metrics.VOLUME_SERVER_EC_BULK_SECONDS.labels(
+            pipeline=name, leg=leg
+        ).inc(secs)
+        obs_trace.record_span(
+            ctx, f"bulk_{leg}", t0, secs,
+            annotations={"pipeline": name, "batches": t.get("batches", 0)},
+        )
+    _metrics.VOLUME_SERVER_EC_BULK_BYTES.labels(pipeline=name).inc(
+        max(0, int(input_bytes))
+    )
+    _metrics.VOLUME_SERVER_EC_BULK_BATCHES.labels(pipeline=name).inc(
+        int(t.get("batches", 0))
+    )
+    # overlap proof as a gauge: leg-active seconds over the wall they ran
+    # in (fsync excluded — it follows the last write by definition).
+    # >1 = the legs genuinely overlapped, up to 3.0 (three legs)
+    window = wall - float(t.get("fsync_s", 0.0))
+    if window > 0:
+        _metrics.VOLUME_SERVER_EC_BULK_OVERLAP_FRACTION.labels(
+            pipeline=name
+        ).set(
+            (
+                float(t.get("read_s", 0.0))
+                + float(t.get("write_s", 0.0))
+                + float(t.get("device_busy_s", 0.0))
+            )
+            / window
+        )

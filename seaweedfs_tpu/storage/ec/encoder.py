@@ -1,15 +1,16 @@
-"""EC encode / rebuild: volume `.dat` -> 14 shard files, missing-shard repair.
+"""EC encode / rebuild / verify: volume `.dat` -> 14 shard files, missing-
+shard repair, parity scrub over the shard files.
 
 Reference behavior: /root/reference/weed/storage/erasure_coding/ec_encoder.go
 (WriteEcFiles :57, RebuildEcFiles :61, encodeDatFile :194, rebuildEcFiles
 :233).  The reference streams 256KB-per-shard buffers through a CPU SIMD
 encoder one batch at a time; here the unit of work is a [10, stride] uint8
-stripe batch handed to the RS codec, and on device backends the whole
-device leg (host staging -> H2D -> kernel -> D2H) runs on a dedicated
-worker thread while the caller keeps reading/writing files — measured
-overlap, not just async dispatch (the H2D transfer itself blocks, so
-dispatching from the reader thread would serialize the pipeline; see
-bench.py's encode_e2e_device_overlap_fraction).
+stripe batch handed to the RS codec, and the three pipelines share the
+staged executor in bulk.py: a prefetching reader leg (vectored preadv), the
+codec worker (device H2D/kernel/D2H or the CPU kernel), and a dedicated
+writer leg, so host read, matrix math, and shard write all overlap —
+measured overlap, not just async dispatch (see the stats contract in
+bulk.py; bench.py's bulk sweep publishes the proof).
 
 File formats are byte-identical to the reference, so `.ec00-.ec13` produced
 here can be mounted by a Go volume server and vice versa.
@@ -18,14 +19,13 @@ from __future__ import annotations
 
 import os
 import time
-from collections import deque
-from concurrent.futures import Future, ThreadPoolExecutor
-from typing import Iterator
 
 import numpy as np
 
 from ...ops import rs
 from .. import needle_map
+from . import bulk
+from .bulk import DEFAULT_STRIDE, read_stripe, write_or_seek  # re-exported
 from .layout import (
     DATA_SHARDS,
     LARGE_BLOCK_SIZE,
@@ -34,15 +34,6 @@ from .layout import (
     to_ext,
 )
 
-# Per-shard stride fed to the codec in one device call.  4MB x 10 shards =
-# 40MB input per batch: large enough to saturate the MXU kernel (tile sweep
-# in ops/rs_tpu.py), small enough to double-buffer in HBM comfortably.
-DEFAULT_STRIDE = 4 * 1024 * 1024
-# In-flight batches: the reader may run this far ahead of the device worker
-# before blocking.  3 keeps one batch staging, one on the wire, one landing
-# without ballooning host memory (each batch is ~stride*10 bytes).
-_PIPELINE_DEPTH = 3
-
 
 def ec_base_name(dirname: str, vid: int, collection: str = "") -> str:
     """<dir>/<collection>_<vid> or <dir>/<vid> (ec_shard.go:63-70)."""
@@ -50,96 +41,7 @@ def ec_base_name(dirname: str, vid: int, collection: str = "") -> str:
     return os.path.join(dirname, stem)
 
 
-class _Codec:
-    """Wraps RSCodec so device backends can run pipelined while CPU backends
-    stay synchronous.  submit() returns an opaque handle immediately;
-    resolve() turns it into a numpy [m, stride] parity array.
-
-    Device path: one worker thread owns the whole device leg — stage the
-    block-diagonal layout, jax.device_put, dispatch the kernel, fetch the
-    result — because on a tunneled device both transfers BLOCK; run from
-    the caller they would serialize against file reads/writes.  The caller
-    overlaps its host work with the worker; `busy_s` accumulates the
-    worker's active time (the overlap denominator in bench.py)."""
-
-    def __init__(self, matrix: np.ndarray, backend: str):
-        self.backend = rs.resolve_backend(backend)
-        self.matrix = np.asarray(matrix, dtype=np.uint8)
-        self.rows = self.matrix.shape[0]
-        self.device = self.backend in ("xla", "pallas")
-        self.busy_s = 0.0
-        if self.device:
-            from ...ops import rs_tpu
-
-            self._tpu = rs_tpu
-            self._a_bm = rs_tpu.prepare_matrix(self.matrix)
-            self._a_blk = rs_tpu.prepare_matrix_blockdiag(self.matrix)
-            self._interpret = not rs_tpu.on_tpu()
-            self._pool = ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="ec-dev"
-            )
-        else:
-            self._codec = rs.RSCodec(backend=self.backend)
-
-    def submit(self, shards: np.ndarray):
-        if self.device:
-            return self._pool.submit(self._device_leg, shards)
-        return self._codec.apply_matrix(self.matrix, shards)
-
-    def _device_leg(self, shards: np.ndarray) -> np.ndarray:
-        """Both transfers ship FLAT 1-D buffers (apply_matrix_device_flat):
-        the tunnel pays ~80ms per row on 2-D arrays, which would dominate
-        the whole pipeline."""
-        import jax
-
-        t0 = time.perf_counter()
-        groups = self._tpu.BLOCKDIAG_GROUPS
-        k, b = shards.shape
-        if self.backend == "pallas" and b % (groups * 128) == 0:
-            # block-diagonal fast path: host stages segment-stacked rows
-            # (free — same bytes) and the MXU runs with a full M dimension
-            # (~152 vs ~123 GB/s, see ops/rs_tpu.py header)
-            stacked = np.ascontiguousarray(self._tpu.stack_segments(shards))
-            x = jax.device_put(stacked.reshape(-1))
-            out = self._tpu.apply_matrix_device_flat(
-                self._a_blk,
-                x,
-                k=groups * k,
-                m=groups * self.rows,
-                tile=self._tpu.BLOCKDIAG_TILE,
-                interpret=self._interpret,
-            )
-            seg = b // groups
-            parity = self._tpu.unstack_segments(
-                np.asarray(out).reshape(groups * self.rows, seg), self.rows
-            )
-        else:
-            x = jax.device_put(np.ascontiguousarray(shards).reshape(-1))
-            out = self._tpu.apply_matrix_device_flat(
-                self._a_bm,
-                x,
-                k=k,
-                m=self.rows,
-                kernel=self.backend,
-                interpret=self._interpret,
-            )
-            parity = np.asarray(out).reshape(self.rows, b)
-        self.busy_s += time.perf_counter() - t0
-        return parity
-
-    def resolve(self, handle) -> np.ndarray:
-        if isinstance(handle, Future):
-            return handle.result()
-        return handle
-
-    def shutdown(self) -> None:
-        if self.device:
-            self._pool.shutdown(wait=True)
-
-
-def _iter_rows(
-    dat_size: int, large_block: int, small_block: int
-) -> Iterator[tuple[int, int]]:
+def _iter_rows(dat_size: int, large_block: int, small_block: int):
     """Yield (row_start_offset, block_size) per stripe row — the two-phase
     loop of encodeDatFile (ec_encoder.go:214-230)."""
     remaining = dat_size
@@ -154,127 +56,111 @@ def _iter_rows(
         remaining -= small_block * DATA_SHARDS
 
 
-def _read_stripe(
-    f, dat_size: int, row_start: int, block_size: int, stride_off: int, stride: int
-) -> np.ndarray:
-    """[DATA_SHARDS, stride] batch: shard i's bytes are the original volume
-    at row_start + i*block_size + stride_off, zero-padded past EOF
-    (encodeDataOneBatch's zero-fill, ec_encoder.go:165-177)."""
-    out = np.zeros((DATA_SHARDS, stride), dtype=np.uint8)
-    for i in range(DATA_SHARDS):
-        start = row_start + i * block_size + stride_off
-        n = min(stride, max(0, dat_size - start))
-        if n > 0:
-            buf = os.pread(f.fileno(), n, start)
-            out[i, : len(buf)] = np.frombuffer(buf, dtype=np.uint8)
-    return out
+def _save_vif_from_superblock(src_path: str, base_name: str) -> None:
+    """Persist the volume version alongside the shards when no .vif exists
+    yet, reading the superblock from `src_path` (the .dat on encode, the
+    .ec00 — whose first bytes are the .dat's first bytes — on rebuild), as
+    the reference's VolumeEcShardsGenerate does
+    (volume_grpc_erasure_coding.go:74)."""
+    from ..super_block import SUPER_BLOCK_SIZE, SuperBlock
+    from ..volume_info import load_volume_info, save_volume_info
+
+    if load_volume_info(base_name + ".vif"):
+        return
+    try:
+        with open(src_path, "rb") as f:
+            sb = SuperBlock.from_bytes(f.read(SUPER_BLOCK_SIZE))
+        save_volume_info(base_name + ".vif", {"version": sb.version})
+    except (ValueError, OSError):
+        pass  # raw/synthetic volume without a superblock: no .vif
+
+
+def _resolve_stride(stride: int | None) -> int:
+    if stride:
+        return stride
+    return bulk.DEFAULT.stride or DEFAULT_STRIDE
+
+
+def _finish_outputs(outputs, fsync: bool, t: dict) -> None:
+    """Materialize trailing holes left by write_or_seek (the shard file's
+    SIZE must match the layout math even when its tail is all zeros) and
+    optionally fsync.  The final fsync follows the LAST write by
+    definition, so it can never overlap the device leg — it is durability
+    tail latency, not hideable host work, hence its separate clock."""
+    for o in outputs:
+        o.truncate(o.tell())
+    if fsync:
+        t0 = time.perf_counter()
+        for o in outputs:
+            o.flush()
+            os.fsync(o.fileno())
+        t["fsync_s"] += time.perf_counter() - t0
 
 
 def write_ec_files(
     base_name: str,
     backend: str = "auto",
-    stride: int = DEFAULT_STRIDE,
+    stride: int | None = None,
     large_block: int = LARGE_BLOCK_SIZE,
     small_block: int = SMALL_BLOCK_SIZE,
     fsync: bool = False,
     stats: dict | None = None,
+    overlap: bool | None = None,
+    prefetch: int | None = None,
 ) -> int:
     """Generate <base>.ec00 .. <base>.ec13 from <base>.dat; returns bytes
     encoded.  Equivalent of WriteEcFiles (ec_encoder.go:57).
 
     `fsync=True` makes the shard files durable before returning (the
     benchmark's honest-throughput mode).  `stats`, when passed, is filled
-    with the pipeline's wall-clock decomposition — read_s (host pread +
-    stripe staging), submit_s (handing the batch to the device worker),
-    wait_s (blocking on device results), write_s (shard file writes),
-    device_busy_s (the worker's active stage+transfer+kernel+fetch time),
-    wall_s, batches — the numbers behind any staging-overlap claim:
-    overlap happened iff read_s+write_s+device_busy_s > wall_s."""
+    with the pipeline's wall-clock decomposition (bulk.py stats contract):
+    overlap happened iff read_s + write_s + device_busy_s > wall_s.
+    `overlap`/`prefetch`/`stride` default to the -ec.bulk.* config."""
     dat_path = base_name + ".dat"
     dat_size = os.path.getsize(dat_path)
-    codec = _Codec(rs.RSCodec().matrix[DATA_SHARDS:], backend)
+    stride = _resolve_stride(stride)
+    cfg = bulk.DEFAULT
+    use_overlap = cfg.overlap if overlap is None else bool(overlap)
+    codec = bulk.Codec(
+        rs.RSCodec().matrix[DATA_SHARDS:], backend, threaded=use_overlap
+    )
+    _save_vif_from_superblock(dat_path, base_name)
 
-    # persist the volume version alongside the shards, as the reference's
-    # VolumeEcShardsGenerate does (volume_grpc_erasure_coding.go:74)
-    from ..super_block import SUPER_BLOCK_SIZE, SuperBlock
-    from ..volume_info import load_volume_info, save_volume_info
-
-    if not load_volume_info(base_name + ".vif"):
-        try:
-            with open(dat_path, "rb") as f:
-                sb = SuperBlock.from_bytes(f.read(SUPER_BLOCK_SIZE))
-            save_volume_info(base_name + ".vif", {"version": sb.version})
-        except ValueError:
-            pass  # raw/synthetic .dat without a superblock: no .vif
+    plan = []
+    for row_start, block_size in _iter_rows(dat_size, large_block, small_block):
+        step = min(stride, block_size)
+        if block_size % step:
+            step = block_size  # keep batches aligned to the block
+        for off in range(0, block_size, step):
+            plan.append((row_start, block_size, off, step))
 
     outputs = [open(base_name + to_ext(i), "wb") for i in range(TOTAL_SHARDS)]
-    inflight: deque[tuple[np.ndarray, object]] = deque()
-    t = {"read_s": 0.0, "submit_s": 0.0, "wait_s": 0.0, "write_s": 0.0,
-         "fsync_s": 0.0, "batches": 0}
-    clock = time.perf_counter
-    t_start = clock()
-
-    def write_or_seek(fobj, row: np.ndarray) -> None:
-        # sparse-aware: an all-zero chunk becomes a hole (seek) instead
-        # of written zeros — byte-identical on read (holes read as
-        # zeros), but a mostly-empty volume encodes without materializing
-        # terabytes of zero blocks.  Final sizes are fixed by ftruncate.
-        if row.any():
-            fobj.write(row.tobytes())
-        else:
-            fobj.seek(len(row), os.SEEK_CUR)
-
-    def drain_one():
-        data, handle = inflight.popleft()
-        t0 = clock()
-        parity = codec.resolve(handle)
-        t1 = clock()
-        for i in range(DATA_SHARDS):
-            write_or_seek(outputs[i], data[i])
-        for i in range(codec.rows):
-            write_or_seek(outputs[DATA_SHARDS + i], parity[i])
-        t["wait_s"] += t1 - t0
-        t["write_s"] += clock() - t1
-
+    t_start = time.perf_counter()
     try:
         with open(dat_path, "rb") as f:
-            for row_start, block_size in _iter_rows(dat_size, large_block, small_block):
-                step = min(stride, block_size)
-                if block_size % step:
-                    step = block_size  # keep batches aligned to the block
-                for off in range(0, block_size, step):
-                    t0 = clock()
-                    data = _read_stripe(f, dat_size, row_start, block_size, off, step)
-                    t1 = clock()
-                    inflight.append((data, codec.submit(data)))
-                    t["read_s"] += t1 - t0
-                    t["submit_s"] += clock() - t1
-                    t["batches"] += 1
-                    if len(inflight) >= _PIPELINE_DEPTH:
-                        drain_one()
-        while inflight:
-            drain_one()
-        for o in outputs:
-            # materialize trailing holes left by write_or_seek: the
-            # shard file's SIZE must match the layout math even when its
-            # tail is all zeros
-            o.truncate(o.tell())
-        if fsync:
-            # separate clock: the final fsync follows the LAST write by
-            # definition, so it can never overlap the device leg — it is
-            # durability tail latency, not hideable host work
-            t0 = clock()
-            for o in outputs:
-                o.flush()
-                os.fsync(o.fileno())
-            t["fsync_s"] += clock() - t0
+
+            def read_batch(desc):
+                row_start, block_size, off, step = desc
+                return read_stripe(f, dat_size, row_start, block_size, off, step)
+
+            def write_batch(desc, data, parity):
+                for i in range(DATA_SHARDS):
+                    write_or_seek(outputs[i], data[i])
+                for i in range(codec.rows):
+                    write_or_seek(outputs[DATA_SHARDS + i], parity[i])
+
+            t = bulk.run(
+                "encode", plan, read_batch, codec, write_batch,
+                overlap=use_overlap, prefetch=prefetch,
+            )
+        _finish_outputs(outputs, fsync, t)
     finally:
         codec.shutdown()
         for o in outputs:
             o.close()
+    t["wall_s"] = time.perf_counter() - t_start
+    bulk.publish("encode", t, dat_size)
     if stats is not None:
-        t["wall_s"] = clock() - t_start
-        t["device_busy_s"] = codec.busy_s
         stats.update(t)
     return dat_size
 
@@ -282,13 +168,23 @@ def write_ec_files(
 def rebuild_ec_files(
     base_name: str,
     backend: str = "auto",
-    stride: int = DEFAULT_STRIDE,
+    stride: int | None = None,
+    fsync: bool = False,
+    stats: dict | None = None,
+    overlap: bool | None = None,
+    prefetch: int | None = None,
 ) -> list[int]:
     """Regenerate missing .ecNN files from the >=10 present ones; returns the
     list of generated shard ids.  Equivalent of RebuildEcFiles
     (ec_encoder.go:61, rebuildEcFiles :233-287) except the per-stride
     Reconstruct is one precomputed reconstruction matrix applied as a single
-    batched multiply."""
+    batched multiply, staged through the same overlapped executor as encode.
+
+    Output goes through write_or_seek + a final truncate, so a rebuilt
+    shard of a sparse volume is sparse too (byte-identical on read); the
+    .vif sidecar is preserved/recreated from the .ec00 superblock like the
+    encode path; `fsync=True` makes the rebuilt shards durable before
+    returning (the ec.rebuild -fsync flag)."""
     present = [i for i in range(TOTAL_SHARDS) if os.path.exists(base_name + to_ext(i))]
     missing = [i for i in range(TOTAL_SHARDS) if i not in present]
     if not missing:
@@ -303,41 +199,55 @@ def rebuild_ec_files(
     rmat, use = gf256.reconstruction_matrix(
         DATA_SHARDS, TOTAL_SHARDS, present, missing
     )
-    codec = _Codec(rmat, backend)
+    stride = _resolve_stride(stride)
+    cfg = bulk.DEFAULT
+    use_overlap = cfg.overlap if overlap is None else bool(overlap)
+    codec = bulk.Codec(rmat, backend, threaded=use_overlap)
 
     shard_size = os.path.getsize(base_name + to_ext(present[0]))
     inputs = {i: open(base_name + to_ext(i), "rb") for i in use}
     outputs = {i: open(base_name + to_ext(i), "wb") for i in missing}
-    inflight: deque[object] = deque()
-
-    def drain_one():
-        out = codec.resolve(inflight.popleft())
-        for j, shard_id in enumerate(missing):
-            outputs[shard_id].write(out[j].tobytes())
-
+    plan = [
+        (off, min(stride, shard_size - off))
+        for off in range(0, shard_size, stride)
+    ]
+    t_start = time.perf_counter()
     try:
-        for off in range(0, shard_size, stride):
-            n = min(stride, shard_size - off)
-            batch = np.zeros((len(use), n), dtype=np.uint8)
-            for j, shard_id in enumerate(use):
-                buf = os.pread(inputs[shard_id].fileno(), n, off)
-                batch[j, : len(buf)] = np.frombuffer(buf, dtype=np.uint8)
-            inflight.append(codec.submit(batch))
-            if len(inflight) >= _PIPELINE_DEPTH:
-                drain_one()
-        while inflight:
-            drain_one()
+
+        def read_batch(desc):
+            off, n = desc
+            return bulk.read_shard_rows(inputs, use, n, off)
+
+        def write_batch(desc, payload, out):
+            for j, shard_id in enumerate(missing):
+                write_or_seek(outputs[shard_id], out[j])
+
+        t = bulk.run(
+            "rebuild", plan, read_batch, codec, write_batch,
+            overlap=use_overlap, prefetch=prefetch,
+        )
+        _finish_outputs(list(outputs.values()), fsync, t)
     finally:
         codec.shutdown()
         for h in list(inputs.values()) + list(outputs.values()):
             h.close()
+    # shard 0 exists now (present or just rebuilt): its head is the .dat's
+    # head, so a missing .vif can be restored exactly like encode does
+    _save_vif_from_superblock(base_name + to_ext(0), base_name)
+    t["wall_s"] = time.perf_counter() - t_start
+    bulk.publish("rebuild", t, shard_size * len(use))
+    if stats is not None:
+        stats.update(t)
     return missing
 
 
 def verify_ec_files(
     base_name: str,
     backend: str = "cpu",
-    stride: int = DEFAULT_STRIDE,
+    stride: int | None = None,
+    stats: dict | None = None,
+    overlap: bool | None = None,
+    prefetch: int | None = None,
 ) -> tuple[list[int], int]:
     """Parity scrub over the shard FILES: recompute parity from the data
     shards chunk by chunk and count mismatching bytes per parity shard.
@@ -345,46 +255,52 @@ def verify_ec_files(
     CPU counterpart of the device-resident scrub
     (ops/rs_resident.scrub_volume); repair loops run whichever the
     store's cache state supports (reference analogue: the read-verify
-    passes of volume.fsck / ec.rebuild)."""
+    passes of volume.fsck / ec.rebuild).  Staged like encode/rebuild:
+    the "write" leg here is the parity comparison."""
     paths = [base_name + to_ext(i) for i in range(TOTAL_SHARDS)]
     missing = [p for p in paths if not os.path.exists(p)]
     if missing:
         raise FileNotFoundError(f"scrub needs all shards: missing {missing}")
     shard_size = os.path.getsize(paths[0])
-    codec = _Codec(rs.RSCodec().matrix[DATA_SHARDS:], backend)
+    stride = _resolve_stride(stride)
+    cfg = bulk.DEFAULT
+    use_overlap = cfg.overlap if overlap is None else bool(overlap)
+    codec = bulk.Codec(
+        rs.RSCodec().matrix[DATA_SHARDS:], backend, threaded=use_overlap
+    )
     mism = np.zeros(TOTAL_SHARDS - DATA_SHARDS, dtype=np.int64)
     handles = [open(p, "rb") for p in paths]
-    inflight: deque[tuple[object, np.ndarray]] = deque()
-
-    def drain_one():
-        handle, parity_disk = inflight.popleft()
-        parity = codec.resolve(handle)
-        np.add(
-            mism,
-            (parity != parity_disk).sum(axis=1),
-            out=mism,
-        )
-
+    plan = [
+        (off, min(stride, shard_size - off))
+        for off in range(0, shard_size, stride)
+    ]
+    t_start = time.perf_counter()
     try:
-        for off in range(0, shard_size, stride):
-            n = min(stride, shard_size - off)
-            data = np.zeros((DATA_SHARDS, n), dtype=np.uint8)
-            parity_disk = np.zeros((TOTAL_SHARDS - DATA_SHARDS, n), np.uint8)
-            for i in range(DATA_SHARDS):
-                buf = os.pread(handles[i].fileno(), n, off)
-                data[i, : len(buf)] = np.frombuffer(buf, dtype=np.uint8)
-            for j in range(TOTAL_SHARDS - DATA_SHARDS):
-                buf = os.pread(handles[DATA_SHARDS + j].fileno(), n, off)
-                parity_disk[j, : len(buf)] = np.frombuffer(buf, np.uint8)
-            inflight.append((codec.submit(data), parity_disk))
-            if len(inflight) >= _PIPELINE_DEPTH:
-                drain_one()
-        while inflight:
-            drain_one()
+
+        def read_batch(desc):
+            off, n = desc
+            return bulk.read_shard_rows(handles, range(TOTAL_SHARDS), n, off)
+
+        def write_batch(desc, payload, parity):
+            np.add(
+                mism,
+                (parity != payload[DATA_SHARDS:]).sum(axis=1),
+                out=mism,
+            )
+
+        t = bulk.run(
+            "verify", plan, read_batch, codec, write_batch,
+            overlap=use_overlap, prefetch=prefetch,
+            to_codec=lambda payload: payload[:DATA_SHARDS],
+        )
     finally:
         codec.shutdown()
         for h in handles:
             h.close()
+    t["wall_s"] = time.perf_counter() - t_start
+    bulk.publish("verify", t, shard_size * DATA_SHARDS)
+    if stats is not None:
+        stats.update(t)
     return [int(v) for v in mism], shard_size
 
 

@@ -432,16 +432,19 @@ class Store:
         write_ec_files(base, backend=self.ec_backend)
         write_sorted_file_from_idx(base)
 
-    def ec_rebuild(self, vid: int, collection: str = "") -> list[int]:
+    def ec_rebuild(
+        self, vid: int, collection: str = "", fsync: bool = False
+    ) -> list[int]:
         """Rebuild whatever shards are missing from the local >=10
         (VolumeEcShardsRebuild volume_grpc_erasure_coding.go:84-123).
-        Returns rebuilt shard ids."""
+        Returns rebuilt shard ids.  `fsync=True` makes the rebuilt shards
+        durable before returning (the ec.rebuild -fsync flag)."""
         from .ec import rebuild_ec_files
 
         base = self._ec_base(vid, collection)
         if base is None:
             raise NotFoundError(f"ec volume {vid} not found")
-        rebuilt = rebuild_ec_files(base, backend=self.ec_backend)
+        rebuilt = rebuild_ec_files(base, backend=self.ec_backend, fsync=fsync)
         rebuild_ecx_file(base)
         return rebuilt
 
