@@ -99,6 +99,21 @@ def add_args(p) -> None:
         "instead of double-buffering pack/H2D of batch N+1 under batch "
         "N's execute",
     )
+    p.add_argument(
+        "-ec.serving.aot.disable", dest="ec_serving_aot_disable",
+        action="store_true",
+        help="compile reconstruct shapes inline on first use instead of "
+        "ahead-of-time on the warm executor; also disarms the "
+        "cold-shape shed (a cold shape then stalls the read 20-40s "
+        "instead of routing to host reconstruct)",
+    )
+    p.add_argument(
+        "-ec.scrub.megakernel.disable", dest="ec_scrub_megakernel_disable",
+        action="store_true",
+        help="scrub resident EC volumes one device call per volume "
+        "instead of fusing the whole HBM cache into one block-diagonal "
+        "megakernel pass per cycle",
+    )
     # staged bulk EC pipelines (storage/ec/bulk.py): encode/rebuild/verify
     # overlap host read, device matmul, and shard write by default
     p.add_argument(
@@ -222,6 +237,7 @@ async def run(args) -> None:
         white_list=guard_mod.from_security_toml(),
         fix_jpg_orientation=args.fix_jpg_orientation,
         ec_scrub_interval_seconds=args.ec_scrub_interval_seconds,
+        ec_scrub_megakernel=not args.ec_scrub_megakernel_disable,
         ec_serving=ServingConfig(
             enabled=not args.ec_serving_disable,
             max_batch=args.ec_serving_max_batch,
@@ -230,6 +246,7 @@ async def run(args) -> None:
             max_queue=args.ec_serving_max_queue,
             layout=args.ec_serving_layout,
             overlap=not args.ec_serving_overlap_disable,
+            aot=not args.ec_serving_aot_disable,
         ),
         **common_args.metrics_kwargs(args),
     )

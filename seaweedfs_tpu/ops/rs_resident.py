@@ -24,10 +24,14 @@ Shapes and compile hygiene:
 """
 from __future__ import annotations
 
+import concurrent.futures
 import contextlib
 import functools
+import json
+import os
 import threading
 import time
+import warnings
 from collections import OrderedDict
 
 import jax
@@ -62,38 +66,198 @@ MAX_TILE = SIZE_BUCKETS[-1]
 CHUNK = MAX_TILE - FUSED_ALIGN
 SHARD_QUANTUM = 64 * 1024 * 1024
 
+# The fused kernels' per-call staging is ONE packed [N] int32 vector:
+# (offset in FUSED_ALIGN units) << META_ROW_BITS | wanted matrix row.
+# Rows index the wanted-shard list (<= TOTAL_SHARDS = 14, 5 bits with
+# margin), leaving 26 bits of offset units = 64GB of addressable shard —
+# far past SHARD_QUANTUM padding.  Halving the r09 meta ([2, N] -> [N])
+# halves serving H2D bytes per fused batch; the XLA fallback's three
+# vectors collapse into one [3, N] array for the same reason (one
+# device_put, one dispatch RTT, instead of three).
+META_ROW_BITS = 5
+_META_ROW_MASK = (1 << META_ROW_BITS) - 1
+# the staging vectors are DONATED to their kernels (donate_argnums): a
+# consumed batch's meta buffer frees as soon as the kernel reads it
+# instead of surviving until the pipelined call's D2H.  XLA warns when a
+# donated buffer cannot ALSO alias an output — always true here (int32
+# staging in, uint8 bytes out), so the advisory is noise by construction.
+# Applied per compile site via _quiet_donation too: pytest re-arms the
+# global filter around every test, so the module-level form alone leaks
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable"
+)
+
+
+@contextlib.contextmanager
+def _quiet_donation():
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable"
+        )
+        yield
+
 
 class CacheMiss(LookupError):
     """Not enough resident shards to serve the request."""
 
 
+class ColdShape(CacheMiss):
+    """A serving reconstruct would dispatch a device shape that is not
+    compiled yet (the volume's AOT warm plan hasn't reached it): the
+    caller must serve the read on the host path instead of stalling the
+    dispatcher behind a 20-40s inline compile.  Raised BEFORE any device
+    work, and only for caches with an AOT warm plan + shed_cold set —
+    direct callers and never-warmed volumes keep inline compiles."""
+
+
 _COMPILE_CACHE_SET = False
+# observable cache state: a bad path used to log once and silently leave
+# every restart recompiling — now the outcome is a gauge, a telemetry
+# field, and a volume.device.status column (compile_cache_status())
+_COMPILE_CACHE_STATE = {"enabled": False, "path": "", "error": ""}
+
+# name of the observed-(size, count)-frequency sidecar persisted next to
+# the compile cache, so warm()'s observed-buckets-first priority order
+# survives process restarts instead of resetting to ladder order
+OBSERVED_SHAPES_FILE = "observed_shapes.json"
 
 
 def enable_persistent_compile_cache(path: str) -> bool:
     """Point XLA's persistent compilation cache at `path` so the
     reconstruct kernel's per-(size, count)-shape compiles (tens of
-    seconds each on remote-compile rigs) survive process restarts.
+    seconds each on remote-compile rigs) survive process restarts, and
+    load the observed-shape frequency state persisted next to it.
 
     The setting is PROCESS-GLOBAL, so call this once from the process
     entry point (the volume CLI does, next to -ec.deviceCacheMB); later
-    calls no-op.  Returns True when the cache was enabled."""
+    calls no-op.  Returns True when the cache was enabled; the outcome
+    either way is visible via compile_cache_status() and the
+    SeaweedFS_volumeServer_ec_compile_cache_enabled gauge."""
     global _COMPILE_CACHE_SET
     if _COMPILE_CACHE_SET:
         return False
     try:
+        # probe writability up front: jax.config.update accepts any
+        # string and the failure would otherwise surface as a per-shape
+        # cache-write warning long after the operator stopped looking
+        os.makedirs(path, exist_ok=True)
+        # pid-suffixed probe: two servers sharing a cache dir must not
+        # race on one filename (the loser's os.remove would read as
+        # "bad path" and silently disable ITS persistent cache)
+        probe = os.path.join(path, f".write_probe.{os.getpid()}")
+        with open(probe, "w"):
+            pass
+        os.remove(probe)
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception as e:  # noqa: BLE001 — older jax without the knobs
+    except Exception as e:  # noqa: BLE001 — bad path / older jax
         import logging
 
         logging.getLogger(__name__).warning(
-            "persistent compile cache unavailable (%s): every restart "
-            "will recompile the reconstruct kernel shapes", e,
+            "persistent compile cache unavailable at %s (%s): every "
+            "restart will recompile the reconstruct kernel shapes", path, e,
         )
+        _COMPILE_CACHE_STATE.update(enabled=False, path=path, error=str(e))
+        stats_metrics.VOLUME_SERVER_EC_COMPILE_CACHE_ENABLED.set(0)
         return False
     _COMPILE_CACHE_SET = True
+    _COMPILE_CACHE_STATE.update(enabled=True, path=path, error="")
+    stats_metrics.VOLUME_SERVER_EC_COMPILE_CACHE_ENABLED.set(1)
+    load_observed_shapes(os.path.join(path, OBSERVED_SHAPES_FILE))
     return True
+
+
+def compile_cache_status() -> dict:
+    """{"enabled", "path", "error"} — the persistent-compile-cache
+    outcome, shipped in heartbeat telemetry and volume.device.status."""
+    return dict(_COMPILE_CACHE_STATE)
+
+
+# --- observed-shape persistence ---------------------------------------------
+# warm() walks the (size, count) grid observed-buckets-first; persisting
+# the frequency map next to the compile cache means a RESTARTED process
+# warms the live workload's shapes first too, not just a re-pin.
+
+_OBSERVED_SAVE_INTERVAL_S = 5.0
+_observed_path: str | None = None
+_observed_dirty = False
+_observed_last_save = 0.0
+
+
+def load_observed_shapes(path: str) -> int:
+    """Merge a persisted observed-shape frequency file into this
+    process's ranking and adopt `path` for future saves.  Returns the
+    number of (size, count) buckets loaded (0 when absent/corrupt —
+    either way the path is adopted so the state starts persisting)."""
+    global _observed_path
+    _observed_path = path
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        # parse fully BEFORE touching shared state: a syntactically
+        # valid JSON file with the wrong shape (bad row arity, non-list
+        # buckets) is just as corrupt as unparseable JSON
+        rows = [
+            (int(size), int(count), int(hits))
+            for size, count, hits in data["buckets"]
+        ]
+    except FileNotFoundError:
+        return 0
+    except Exception as e:  # noqa: BLE001 — corrupt file must not stop boot
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "ignoring corrupt observed-shapes file %s: %s", path, e
+        )
+        return 0
+    with _shapes_lock:
+        for size, count, hits in rows:
+            key = (size, count)
+            _observed_buckets[key] = _observed_buckets.get(key, 0) + hits
+    return len(rows)
+
+
+def persist_observed_shapes(path: str | None = None) -> bool:
+    """Atomically write the observed-shape frequency map (tmp + rename)
+    to `path` (default: the path adopted by load_observed_shapes).
+    Returns True when written."""
+    global _observed_dirty, _observed_last_save
+    path = path or _observed_path
+    if path is None:
+        return False
+    with _shapes_lock:
+        buckets = [
+            [s, c, n] for (s, c), n in sorted(_observed_buckets.items())
+        ]
+        _observed_dirty = False
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"buckets": buckets}, f)
+        os.replace(tmp, path)
+    except OSError:
+        # the observations are still unsaved: re-arm the dirty flag so
+        # the hook retries once the dir is writable again — but stamp
+        # the attempt so a persistently broken dir costs one failed
+        # open per save interval, not one per batch
+        with _shapes_lock:
+            _observed_dirty = True
+        _observed_last_save = time.monotonic()
+        return False
+    _observed_last_save = time.monotonic()
+    return True
+
+
+def _maybe_persist_observed() -> None:
+    """Throttled save hook on the dispatch path: cheap no-op unless a
+    new observation landed and the last save is older than the
+    interval (the file is tiny — a handful of bucket rows)."""
+    if (
+        _observed_path is not None
+        and _observed_dirty
+        and time.monotonic() - _observed_last_save > _OBSERVED_SAVE_INTERVAL_S
+    ):
+        persist_observed_shapes()
 
 
 def compile_cache_for_volume_dirs(ec_device_cache_mb: int, dirs) -> bool:
@@ -141,18 +305,73 @@ def _max_count(size_bucket: int) -> int:
 LAYOUTS = ("flat", "blockdiag")
 
 
+class StagingArena:
+    """Per-slot preallocated host staging buffer for a batch's packed
+    offset/row vectors: one [3, COUNT_BUCKETS[-1]] int32 block covers
+    the widest device call of either kernel family (fused uses one
+    packed row, the XLA fallback all three), so a slot's calls stage
+    into reused memory instead of allocating fresh np arrays per batch.
+    Two slots -> two arenas: a slot's arena is never touched by the
+    other slot's in-flight batch.  Only safe where device_put COPIES
+    (TPU/GPU): the CPU PJRT client zero-copies aligned numpy, so an
+    arena there would alias (and corrupt) an asynchronously executing
+    call's input — reconstruct_intervals gates arena use on on_tpu()."""
+
+    # rows of the arena block, by kernel family
+    ROWS_FUSED = 1   # packed (offset_units << META_ROW_BITS | row)
+    ROWS_XLA = 3     # offsets / rows / deltas
+
+    def __init__(self, width: int | None = None):
+        self.width = width or COUNT_BUCKETS[-1]
+        self._buf = np.empty((self.ROWS_XLA, self.width), dtype=np.int32)
+
+    def stage_fused(self, packed: list[int], pad: int) -> np.ndarray:
+        """-> [n] int32 view of the arena holding the packed meta."""
+        n = len(packed) + pad
+        view = self._buf[0, :n]
+        view[: len(packed)] = packed
+        view[len(packed):] = 0
+        return view
+
+    def stage_xla(
+        self, offsets: list[int], rows: list[int], deltas: list[int],
+        pad: int,
+    ) -> np.ndarray:
+        """-> [3, n] int32 view of the arena (offsets/rows/deltas)."""
+        n = len(offsets) + pad
+        view = self._buf[:, :n]
+        for i, col in enumerate((offsets, rows, deltas)):
+            view[i, : len(col)] = col
+            view[i, len(col):] = 0
+        return view
+
+
+class PipelineSlot:
+    """What DevicePipeline.slot() yields: the slot-acquisition wait (for
+    the device span's saturation attribution) plus this slot's private
+    staging arena."""
+
+    __slots__ = ("wait_s", "arena")
+
+    def __init__(self, wait_s: float, arena: StagingArena):
+        self.wait_s = wait_s
+        self.arena = arena
+
+
 class DevicePipeline:
     """Double-buffered staging gate for the device leg of batched
     reconstruct calls: `slots=2` lets batch N+1 pack (outside the slot)
     and ship+execute (inside it) while batch N drains its D2H — only
     N's fetch blocks N's completion.  `slots=1` is the serial baseline
-    (bench.py's overlap-off axis).  The overlap-fraction gauge is
-    device-busy seconds / wall seconds over the current batch window (a
-    window opens when the pipeline leaves idle; the ratio refreshes at
-    EVERY batch completion — a drain-only update would go stale under
-    exactly the sustained load it exists to measure), so 1.0 means the
-    device section ran the whole window and >1 means the staging slots
-    genuinely overlapped."""
+    (bench.py's overlap-off axis).  Each slot owns a preallocated
+    StagingArena so a held slot's host vectors stage into reused pinned
+    memory (no per-batch np alloc churn; the r11 donation work).  The
+    overlap-fraction gauge is device-busy seconds / wall seconds over
+    the current batch window (a window opens when the pipeline leaves
+    idle; the ratio refreshes at EVERY batch completion — a drain-only
+    update would go stale under exactly the sustained load it exists to
+    measure), so 1.0 means the device section ran the whole window and
+    >1 means the staging slots genuinely overlapped."""
 
     def __init__(self, slots: int = 2):
         self._cond = threading.Condition()
@@ -161,6 +380,10 @@ class DevicePipeline:
         self._busy_s = 0.0
         self._window_t0 = 0.0
         self.last_overlap = 0.0
+        # arena pool: one per concurrently held slot, grown on demand so
+        # set_slots() widening never reallocates under the lock-holder
+        self._arenas: list[StagingArena] = []
+        self._free_arenas: list[int] = []
 
     @property
     def slots(self) -> int:
@@ -173,9 +396,10 @@ class DevicePipeline:
 
     @contextlib.contextmanager
     def slot(self):
-        """Hold one staging slot for a device section; yields the time
-        spent waiting for the slot (annotated on the device span so a
-        saturated pipeline is attributable)."""
+        """Hold one staging slot for a device section; yields a
+        PipelineSlot carrying the time spent waiting for the slot
+        (annotated on the device span so a saturated pipeline is
+        attributable) and the slot's staging arena."""
         t_req = time.perf_counter()
         with self._cond:
             while self._active >= self._slots:
@@ -184,13 +408,19 @@ class DevicePipeline:
             if self._active == 1:
                 self._window_t0 = time.perf_counter()
                 self._busy_s = 0.0
+            if self._free_arenas:
+                arena_idx = self._free_arenas.pop()
+            else:
+                self._arenas.append(StagingArena())
+                arena_idx = len(self._arenas) - 1
         t0 = time.perf_counter()
         try:
-            yield t0 - t_req
+            yield PipelineSlot(t0 - t_req, self._arenas[arena_idx])
         finally:
             dur = time.perf_counter() - t0
             with self._cond:
                 self._active -= 1
+                self._free_arenas.append(arena_idx)
                 self._busy_s += dur
                 wall = time.perf_counter() - self._window_t0
                 if wall > 0:
@@ -247,7 +477,18 @@ class DeviceShardCache:
         # never hits a compile cliff on the serving path
         self.warm_sizes: tuple[int, ...] = (4096, 65536, 1 << 20)
         self.warm_counts: tuple[int, ...] = (1, 8, 64, 256)
+        # AOT shed policy (-ec.serving.aot.disable): when True AND a
+        # volume has an AOT warm plan (aot_state != "none"), a serving
+        # reconstruct that would hit a still-cold device shape raises
+        # ColdShape (host fallback + background compile) instead of
+        # paying a 20-40s inline compile.  Volumes never warmed (empty
+        # warm plan — the CI convention warm_sizes=()) keep the legacy
+        # inline-compile behavior so direct callers are unaffected.
+        self.shed_cold = True
         self._lock = threading.Lock()
+        # vid -> "none" | "warming" | "done": whether an AOT warm plan
+        # was started/finished for this volume (warm() maintains it)
+        self._aot_states: dict[int, str] = {}
         self._arrays: OrderedDict[tuple[int, int], object] = OrderedDict()
         self._true_sizes: dict[tuple[int, int], int] = {}
         # vid -> the disk-location directory whose shard files were
@@ -325,12 +566,38 @@ class DeviceShardCache:
         with self._lock:
             return self._vid_counts.get(vid, 0)
 
+    def aot_state(self, vid: int) -> str:
+        """"none" | "warming" | "done" — whether warm() started/finished
+        an AOT compile plan for this volume.  Anything but "none" arms
+        the cold-shape shed (shed_cold): the plan's shapes are coming,
+        so a read must not compile inline ahead of it."""
+        with self._lock:
+            return self._aot_states.get(vid, "none")
+
+    def _set_aot_state(self, vid: int, state: str) -> None:
+        with self._lock:
+            if state == "none":
+                # "none" == absent: pop instead of storing, so an
+                # aborted plan leaves no entry behind for a never
+                # re-pinned vid
+                self._aot_states.pop(vid, None)
+            elif state == "done" and vid not in self._aot_states:
+                # the volume was evicted mid-warm (_forget_if_gone
+                # dropped the entry): a straggling compile future's
+                # done-callback must not resurrect it, or a later
+                # re-pin starts shed-armed against a plan that never
+                # covered its (possibly different) shapes
+                return
+            else:
+                self._aot_states[vid] = state
+
     def _forget_if_gone(self, vid: int) -> None:
         """Drop per-vid bookkeeping once no shard of `vid` remains
         (caller holds the lock; _vid_counts already knows, no key scan)."""
         if not self._vid_counts.get(vid):
             self._vid_counts.pop(vid, None)
             self._pin_source.pop(vid, None)
+            self._aot_states.pop(vid, None)  # a re-pin re-plans
 
     def claim_pin_source(self, vid: int, source: str) -> str:
         """Atomically claim which disk location's shard files back this
@@ -417,6 +684,7 @@ class DeviceShardCache:
             self._true_sizes.clear()
             self._pin_source.clear()
             self._vid_counts.clear()
+            self._aot_states.clear()
             self.bytes_used = 0
 
 
@@ -478,8 +746,12 @@ def _make_gather_body(k: int, g_n: int, tile: int, n_groups: int):
         j = pl.program_id(1)
         copies = []
         for r in range(g_n):
-            # the explicit multiply is what lets Mosaic PROVE alignment
-            src = offs_ref[g * g_n + r] * FUSED_ALIGN + j * tile
+            # unpack the offset units from the packed meta word; the
+            # explicit multiply is what lets Mosaic PROVE alignment
+            src = (
+                (offs_ref[g * g_n + r] >> META_ROW_BITS) * FUSED_ALIGN
+                + j * tile
+            )
             for i in range(k):
                 dst = ((j * n_groups + g) * k + i) * w + r * tile
                 copies.append(
@@ -513,7 +785,7 @@ def _make_select_body(k: int, k_pad: int, m_pad: int, g_n: int, tile: int):
         ridx = jax.lax.broadcasted_iota(jnp.int32, (m_pad, tile), 0)
         outs = []
         for r in range(g_n):
-            row = rows_ref[g * g_n + r]
+            row = rows_ref[g * g_n + r] & _META_ROW_MASK
             blk = packed[:, r * tile : (r + 1) * tile]
             sel = jnp.where(ridx == row, blk, jnp.uint8(0)).astype(jnp.int32)
             outs.append(jnp.sum(sel, axis=0, keepdims=True).astype(jnp.uint8))
@@ -523,29 +795,34 @@ def _make_select_body(k: int, k_pad: int, m_pad: int, g_n: int, tile: int):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("tile", "fetch", "k_true", "interpret")
+    jax.jit,
+    static_argnames=("tile", "fetch", "k_true", "interpret"),
+    donate_argnums=(2,),
 )
 def _fused_reconstruct(
     a_bm, survivors, meta, *, tile, fetch, k_true, interpret
 ):
     """survivors: tuple of [L] u8 resident shards (HBM) in matrix column
-    order; meta [2, N] int32 — row 0 the offsets in FUSED_ALIGN units
-    (byte offset / FUSED_ALIGN), row 1 the wanted matrix rows (packed so
-    the call ships ONE scalar vector).  -> [N, fetch] u8 of raw
-    reconstructed bytes starting at each aligned offset (caller trims the
-    delta head).  N pads to the 8-request group internally.  Returns the
-    [N, fetch] result FLATTENED (1-D, true-N rows only): 2-D transfers
-    pay a per-row tunnel cost; callers reshape host-side."""
+    order; meta [N] int32 — each word packs (offset in FUSED_ALIGN
+    units) << META_ROW_BITS | wanted matrix row, so the call ships ONE
+    scalar vector of half the r09 width.  The meta buffer is DONATED
+    (staging dies with the call).  -> [N, fetch] u8 of raw reconstructed
+    bytes starting at each aligned offset (caller trims the delta head).
+    N pads to the 8-request group internally.  Returns the [N, fetch]
+    result FLATTENED (1-D, true-N rows only): 2-D transfers pay a
+    per-row tunnel cost; callers reshape host-side."""
     k = len(survivors)
     if k_true is not None and k != k_true:
         raise ValueError(f"{k} survivors but matrix was built for {k_true}")
     m_pad8, k_pad8 = a_bm.shape
     m_pad, k_pad = m_pad8 // 8, k_pad8 // 8
-    n = meta.shape[1]
+    n = meta.shape[0]
     pad = (-n) % FUSED_GROUP
     if pad:
-        meta = jnp.pad(meta, ((0, 0), (0, pad)))
-    offsets, row_idx = meta[0], meta[1]
+        meta = jnp.pad(meta, (0, pad))
+    # both pallas bodies consume the same packed word: the gather
+    # shifts the offset units out, the select masks the row bits
+    offsets = row_idx = meta
     n_pad = n + pad
     tile = min(tile, fetch)
     chunks = max(1, fetch // tile)
@@ -633,7 +910,10 @@ def _make_gather_body_blockdiag(k, groups, g_n, tile, n_groups):
         j = pl.program_id(1)
         copies = []
         for r in range(g_n):
-            base = offs_ref[g * g_n + r] * FUSED_ALIGN + j * tile
+            base = (
+                (offs_ref[g * g_n + r] >> META_ROW_BITS) * FUSED_ALIGN
+                + j * tile
+            )
             for jg in range(groups):
                 # seg is a multiple of FUSED_ALIGN (caller-enforced), so
                 # base + jg*seg keeps the alignment proof intact
@@ -675,7 +955,7 @@ def _make_select_body_blockdiag(k, groups, w_true, k_pad, m_pad, g_n, tile):
         ridx = jax.lax.broadcasted_iota(jnp.int32, (m_pad, seg), 0)
         outs = []
         for r in range(g_n):
-            row = rows_ref[g * g_n + r]
+            row = rows_ref[g * g_n + r] & _META_ROW_MASK
             blk = packed[:, r * seg : (r + 1) * seg]  # (m_pad, seg)
             segs = []
             for jg in range(groups):
@@ -697,25 +977,26 @@ def _make_select_body_blockdiag(k, groups, w_true, k_pad, m_pad, g_n, tile):
 @functools.partial(
     jax.jit,
     static_argnames=("tile", "fetch", "k_true", "w_true", "groups", "interpret"),
+    donate_argnums=(2,),
 )
 def _fused_reconstruct_blockdiag(
     a_blk, survivors, meta, *, tile, fetch, k_true, w_true, groups, interpret
 ):
-    """Block-diagonal twin of _fused_reconstruct: same meta packing and
-    flat 1-D output contract; `w_true` is the reconstruction system's
-    pre-expansion row count (len(wanted)) so the per-group row select
-    can address jg*w_true + row.  Caller guarantees tile % (groups *
-    FUSED_ALIGN) == 0 and fetch % tile == 0."""
+    """Block-diagonal twin of _fused_reconstruct: same packed-[N]-meta
+    (donated) and flat 1-D output contract; `w_true` is the
+    reconstruction system's pre-expansion row count (len(wanted)) so the
+    per-group row select can address jg*w_true + row.  Caller guarantees
+    tile % (groups * FUSED_ALIGN) == 0 and fetch % tile == 0."""
     k = len(survivors)
     if k_true is not None and k != k_true:
         raise ValueError(f"{k} survivors but matrix was built for {k_true}")
     m_pad8, k_pad8 = a_blk.shape
     m_pad, k_pad = m_pad8 // 8, k_pad8 // 8
-    n = meta.shape[1]
+    n = meta.shape[0]
     pad = (-n) % FUSED_GROUP
     if pad:
-        meta = jnp.pad(meta, ((0, 0), (0, pad)))
-    offsets, row_idx = meta[0], meta[1]
+        meta = jnp.pad(meta, (0, pad))
+    offsets = row_idx = meta
     n_pad = n + pad
     chunks = fetch // tile
     n_groups = n_pad // FUSED_GROUP
@@ -783,13 +1064,12 @@ def _fused_reconstruct_blockdiag(
 @functools.partial(
     jax.jit,
     static_argnames=("tile", "fetch", "kernel", "interpret", "k_true"),
+    donate_argnums=(2,),
 )
 def _gather_reconstruct(
     a_bm,
     survivors,
-    offsets,
-    row_idx,
-    deltas,
+    vecs,
     *,
     tile,
     fetch,
@@ -798,9 +1078,10 @@ def _gather_reconstruct(
     k_true,
 ):
     """survivors: tuple of [L] u8 resident shards in matrix column order;
-    offsets [N] int32 lane-aligned; row_idx [N] int32 selects each
-    request's wanted matrix row; deltas [N] the sub-lane alignment
-    residual.  -> [N, fetch] u8.
+    vecs [3, N] int32 (donated) — row 0 the lane-aligned offsets, row 1
+    each request's wanted matrix row, row 2 the sub-lane alignment
+    residual.  One array = ONE device_put and one dispatch RTT where the
+    r09 path paid three.  -> [N, fetch] u8.
 
     `tile` is the compute width (size bucket); `fetch` <= tile is the D2H
     width (power-of-two cover of the largest actual request): the result
@@ -808,6 +1089,7 @@ def _gather_reconstruct(
     scarce resource on a tunneled device — carries only useful bytes.
     Returns the [N, fetch] result FLATTENED (1-D): 2-D transfers pay a
     per-row tunnel cost; callers reshape host-side."""
+    offsets, row_idx, deltas = vecs[0], vecs[1], vecs[2]
     cols = [
         jax.vmap(
             lambda off, arr=arr: jax.lax.dynamic_slice(arr, (off,), (tile,))
@@ -837,13 +1119,12 @@ def _gather_reconstruct(
     static_argnames=(
         "tile", "fetch", "groups", "w_true", "kernel", "interpret", "k_true",
     ),
+    donate_argnums=(2,),
 )
 def _gather_reconstruct_blockdiag(
     a_blk,
     survivors,
-    offsets,
-    row_idx,
-    deltas,
+    vecs,
     *,
     tile,
     fetch,
@@ -854,11 +1135,13 @@ def _gather_reconstruct_blockdiag(
     k_true,
 ):
     """Block-diagonal twin of _gather_reconstruct (the XLA fallback and
-    bench path): each request's tile splits into `groups` contiguous
-    segments gathered into segment-stacked [g*k, N*seg] rows, one
-    apply of the block-diagonal matrix reconstructs every segment, and
-    the per-group wanted rows (jg*w_true + row) concatenate back into
-    the contiguous [N, tile] before the same on-device delta/narrow."""
+    bench path), same single donated [3, N] vecs contract: each
+    request's tile splits into `groups` contiguous segments gathered
+    into segment-stacked [g*k, N*seg] rows, one apply of the
+    block-diagonal matrix reconstructs every segment, and the per-group
+    wanted rows (jg*w_true + row) concatenate back into the contiguous
+    [N, tile] before the same on-device delta/narrow."""
+    offsets, row_idx, deltas = vecs[0], vecs[1], vecs[2]
     seg = tile // groups
     cols = []
     for jg in range(groups):
@@ -943,15 +1226,14 @@ def _resolve_codec(cache, vid, requests, data_shards, total_shards, layout):
     return a_prep, survivors, row_of, use, rmat.shape[0]
 
 
-def _group_vectors(part, requests, row_of, pad):
-    """HOST-side offset/row/delta vectors (np): the H2D transfer happens
-    at dispatch time under the pipeline's h2d_copy stage, not here."""
-    offsets = np.array([s[1] for _, s in part] + [0] * pad, dtype=np.int32)
-    rows = np.array(
-        [row_of[requests[s[0]][0]] for _, s in part] + [0] * pad,
-        dtype=np.int32,
-    )
-    deltas = np.array([s[2] for _, s in part] + [0] * pad, dtype=np.int32)
+def _group_vectors(part, requests, row_of):
+    """HOST-side offset/row/delta COLUMNS (plain lists): numpy staging
+    happens at dispatch time — into the held slot's arena on TPU, a
+    fresh array elsewhere — so packing allocates nothing per batch and
+    the H2D transfer lands under the pipeline's h2d_copy stage."""
+    offsets = [s[1] for _, s in part]
+    rows = [row_of[requests[s[0]][0]] for _, s in part]
+    deltas = [s[2] for _, s in part]
     return offsets, rows, deltas
 
 
@@ -975,31 +1257,27 @@ def _fused_tile_for(fetch: int) -> int:
     return t
 
 
-def _fused_vectors(part, requests, row_of, pad):
+def _fused_vectors(part, requests, row_of):
     """Re-align each sub-request down to FUSED_ALIGN: offsets become unit
-    counts, the residual joins the host-trimmed delta.  -> (meta, deltas,
-    fetch): meta is the packed [2, N] int32 (offset units / wanted rows,
-    one H2D transfer) and fetch covers the largest delta+take (CHUNK
-    keeps it <= MAX_TILE)."""
-    offs_units, deltas = [], []
+    counts, the residual joins the host-trimmed delta.  -> (packed,
+    deltas, fetch): `packed` is the [N] list of single int32 meta words
+    ((units << META_ROW_BITS) | row — HALF the r09 [2, N] wire width,
+    still one H2D transfer) and fetch covers the largest delta+take
+    (CHUNK keeps it <= MAX_TILE).  Stays host-side lists here — numpy
+    staging (arena or fresh) and the ship happen under h2d_copy."""
+    packed, deltas = [], []
     for _, s in part:
         extra = s[1] % FUSED_ALIGN
-        offs_units.append((s[1] - extra) // FUSED_ALIGN)
+        units = (s[1] - extra) // FUSED_ALIGN
+        if units >= 1 << (31 - META_ROW_BITS):  # 64GB shard: unreachable
+            raise ValueError(f"offset {s[1]} exceeds packed meta range")
+        packed.append(
+            (units << META_ROW_BITS) | row_of[requests[s[0]][0]]
+        )
         deltas.append(s[2] + extra)
     span = max(d + s[3] for d, (_, s) in zip(deltas, part))
     fetch = _fetch_cover(span)
-    # ONE packed [2, N] host->device transfer (row 0: offset units, row 1:
-    # wanted matrix rows): tiny scalar vectors each pay a full dispatch
-    # RTT on tunneled rigs, so two transfers would double that tax.
-    # Stays a HOST array here — the ship happens under h2d_copy.
-    meta = np.array(
-        [
-            offs_units + [0] * pad,
-            [row_of[requests[s[0]][0]] for _, s in part] + [0] * pad,
-        ],
-        dtype=np.int32,
-    )
-    return meta, deltas, fetch
+    return packed, deltas, fetch
 
 
 def _use_fused(kernel: str, interpret: bool) -> bool:
@@ -1024,9 +1302,11 @@ _observed_buckets: dict[tuple[int, int], int] = {}
 
 
 def _note_observed(size_bucket: int, count_bucket: int) -> None:
+    global _observed_dirty
     with _shapes_lock:
         key = (size_bucket, count_bucket)
         _observed_buckets[key] = _observed_buckets.get(key, 0) + 1
+        _observed_dirty = True
 
 
 def observed_buckets() -> list[tuple[int, int]]:
@@ -1066,24 +1346,190 @@ def _note_shape(key: tuple) -> bool:
     return miss
 
 
+# --- AOT serving grid --------------------------------------------------------
+#
+# warm() used to TRACE-AND-EXECUTE every ladder shape through
+# reconstruct_intervals; now it lowers each device-call shape with
+# jax.jit(...).lower(...).compile() on a background executor and parks
+# the Compiled executable here.  _dispatch_call routes a matching call
+# straight through the executable (the jit wrapper's own cache never
+# sees it, so there is no second compile), and a serving read that would
+# dispatch a shape neither AOT-compiled nor inline-compiled raises
+# ColdShape instead of stalling 20-40s — the dispatcher serves it on the
+# host path while the executor compiles the shape for the next read.
+
+_aot_executables: dict[tuple, object] = {}  # call key -> jax Compiled
+_aot_pending: set = set()  # keys queued/being compiled on the executor
+# keys whose AOT compile RAISED: never re-queued (a deterministic
+# compile failure would otherwise burn the single-worker executor
+# 20-40s per matching read, forever) — the shape keeps shedding to the
+# host path, which serves it fine
+_aot_failed: set = set()
+_AOT_EXECUTOR: concurrent.futures.Executor | None = None
+
+
+def _call_key(
+    kind, kernel, groups, w_true, tile, fetch, n_bucket, k, a_shape,
+    surv_len, interpret,
+) -> tuple:
+    """Canonical identity of ONE device call's compiled shape — every
+    static arg plus every aval dim of the four reconstruct kernels.
+    Shared by the miss counter, the AOT registry, and the shed check so
+    the three can never disagree about what 'warm' means.  w_true only
+    shapes the blockdiag kernels (the flat kernels' row select is purely
+    data); normalizing it to 0 for flat keeps a warm plan's w_true=1
+    probes valid for any wanted-set width with the same matrix shape."""
+    return (
+        "fused" if kind == "fused" else kernel,
+        groups,
+        w_true if groups > 1 else 0,
+        tile,
+        fetch,
+        n_bucket,
+        k,
+        tuple(a_shape),
+        surv_len,
+        bool(interpret),
+    )
+
+
+def _aot_executor() -> concurrent.futures.Executor:
+    """Single-worker compile executor: AOT jobs run one at a time in
+    submission order, so warm()'s observed-buckets-first priority IS the
+    compile order even when several volumes pin at once."""
+    global _AOT_EXECUTOR
+    with _shapes_lock:
+        if _AOT_EXECUTOR is None:
+            _AOT_EXECUTOR = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ec-aot-compile"
+            )
+        return _AOT_EXECUTOR
+
+
+def _compile_shape(key: tuple) -> None:
+    """Build the Compiled executable for one call key (runs on the AOT
+    executor).  Lowers against abstract avals only — no resident buffer
+    is held while a 20-40s compile runs."""
+    (
+        family, groups, w_true, tile, fetch, n_bucket, k, a_shape,
+        surv_len, interpret,
+    ) = key
+    a_aval = jax.ShapeDtypeStruct(a_shape, jnp.int8)
+    survivors = tuple(
+        jax.ShapeDtypeStruct((surv_len,), jnp.uint8) for _ in range(k)
+    )
+    with _quiet_donation():
+        if family == "fused":
+            vec = jax.ShapeDtypeStruct((n_bucket,), jnp.int32)
+            if groups > 1:
+                lowered = _fused_reconstruct_blockdiag.lower(
+                    a_aval, survivors, vec, tile=tile, fetch=fetch,
+                    k_true=k, w_true=w_true, groups=groups,
+                    interpret=interpret,
+                )
+            else:
+                lowered = _fused_reconstruct.lower(
+                    a_aval, survivors, vec, tile=tile, fetch=fetch,
+                    k_true=k, interpret=interpret,
+                )
+        else:
+            vec = jax.ShapeDtypeStruct((3, n_bucket), jnp.int32)
+            if groups > 1:
+                lowered = _gather_reconstruct_blockdiag.lower(
+                    a_aval, survivors, vec, tile=tile, fetch=fetch,
+                    groups=groups, w_true=w_true, kernel=family,
+                    interpret=interpret, k_true=k,
+                )
+            else:
+                lowered = _gather_reconstruct.lower(
+                    a_aval, survivors, vec, tile=tile, fetch=fetch,
+                    kernel=family, interpret=interpret, k_true=k,
+                )
+        exe = lowered.compile()
+    with _shapes_lock:
+        _aot_executables[key] = exe
+        # the shape is warm: a dispatch through the executable never
+        # compiles, so the miss counter and shed check must see it
+        _dispatched_shapes.add(key)
+        _aot_pending.discard(key)
+    stats_metrics.VOLUME_SERVER_EC_AOT_COMPILED.inc()
+
+
+def _compile_shape_logged(key: tuple) -> None:
+    try:
+        _compile_shape(key)
+    except Exception:  # noqa: BLE001 — a failed AOT compile must not
+        # kill the executor; the shape stays cold and falls back to the
+        # inline-compile path on a later non-shedding caller
+        import logging
+
+        logging.getLogger(__name__).exception(
+            "AOT compile failed for shape %s", key
+        )
+        with _shapes_lock:
+            _aot_pending.discard(key)
+            _aot_failed.add(key)
+
+
+def _schedule_aot_compiles(keys) -> list:
+    """Queue cold call keys on the compile executor (dedup against the
+    registry, the pending set, and inline-compiled shapes); returns the
+    futures for callers that want to wait (warm)."""
+    jobs = []
+    with _shapes_lock:
+        for key in keys:
+            if (
+                key in _aot_executables
+                or key in _aot_pending
+                or key in _dispatched_shapes
+                or key in _aot_failed
+            ):
+                continue
+            _aot_pending.add(key)
+            jobs.append(key)
+    if not jobs:
+        return []
+    ex = _aot_executor()
+    return [ex.submit(_compile_shape_logged, key) for key in jobs]
+
+
+def _shape_is_warm(key: tuple) -> bool:
+    with _shapes_lock:
+        return key in _dispatched_shapes or key in _aot_executables
+
+
+def aot_stats() -> dict:
+    """{"compiled", "pending", "failed"} — registry occupancy for
+    status pages and tests."""
+    with _shapes_lock:
+        return {
+            "compiled": len(_aot_executables),
+            "pending": len(_aot_pending),
+            "failed": len(_aot_failed),
+        }
+
+
 def _pack_calls(
     cache, vid, requests, kernel, interpret, layout, data_shards,
     total_shards, record_observed=True,
 ):
     """PACK stage: resolve the codec, split/align the requests, group
-    them into device calls, and build every call's HOST-side vectors.
-    Returns (calls, subs, survivors, a_prep, use, w_true) — nothing has
-    touched the device yet, so a double-buffered caller can pack batch
-    N+1 while batch N still owns a staging slot.  `record_observed=False`
-    keeps synthetic probes (warm's ladder walk) out of the
-    observed-shape ranking, which must reflect live traffic only."""
+    them into device calls, and build every call's HOST-side columns
+    (plain lists — numpy staging waits for the slot's arena).  Returns
+    (calls, subs, survivors, a_prep, use, w_true) with each call a
+    (kind, part, cols, pad, fetch, tile, n_bucket, deltas) tuple —
+    nothing has touched the device yet, so a double-buffered caller can
+    pack batch N+1 while batch N still owns a staging slot.
+    `record_observed=False` keeps synthetic probes (warm's ladder walk)
+    out of the observed-shape ranking, which must reflect live traffic
+    only."""
     a_prep, survivors, row_of, use, w_true = _resolve_codec(
         cache, vid, requests, data_shards, total_shards, layout
     )
     fused = _use_fused(kernel, interpret)
     groups = cache.groups if layout == "blockdiag" else 1
     subs = _plan(requests)
-    calls = []  # (fused?, part, host vectors, fetch, tile/bucket, deltas)
+    calls = []
     for bucket in SIZE_BUCKETS:
         group = [(i, s) for i, s in enumerate(subs) if s[4] == bucket]
         if not group:
@@ -1097,60 +1543,97 @@ def _pack_calls(
             if fused:
                 # fetch covers the realigned delta+take (the host trims
                 # the delta head after D2H; no in-kernel shift needed)
-                meta, deltas, fetch = _fused_vectors(
-                    part, requests, row_of, pad
+                packed, deltas, fetch = _fused_vectors(
+                    part, requests, row_of
                 )
                 if layout == "blockdiag":
                     fetch, tile = _blockdiag_fetch_tile(fetch, groups)
                 else:
                     tile = _fused_tile_for(fetch)
                 calls.append(
-                    ("fused", part, (meta,), fetch, tile, n_bucket, deltas)
+                    ("fused", part, packed, pad, fetch, tile, n_bucket,
+                     deltas)
                 )
             else:
-                vectors = _group_vectors(part, requests, row_of, pad)
+                cols = _group_vectors(part, requests, row_of)
                 # D2H width: power-of-two cover of the largest actual
                 # request in this call, never wider than the compute tile
                 max_take = max(s[3] for _, s in part)
                 fetch = min(bucket, 1 << (max_take - 1).bit_length())
                 calls.append(
-                    ("xla", part, vectors, fetch, bucket, n_bucket, None)
+                    ("xla", part, cols, pad, fetch, bucket, n_bucket,
+                     None)
                 )
     return calls, subs, survivors, a_prep, use, w_true
 
 
+def _stage_call_vec(kind, cols, pad, arena=None) -> np.ndarray:
+    """Materialize one call's host staging vector — [n] packed int32
+    (fused) or [3, n] int32 (xla fallback) — into the held slot's arena
+    when one is supplied (TPU: device_put copies, so the pinned arena
+    block is reused batch after batch with zero host allocs) or a fresh
+    array otherwise (CPU PJRT zero-copies aligned numpy into the jax
+    Array, so a reused buffer would alias an asynchronously executing
+    call's input)."""
+    if kind == "fused":
+        if arena is not None:
+            return arena.stage_fused(cols, pad)
+        return np.array(cols + [0] * pad, dtype=np.int32)
+    offsets, rows, deltas = cols
+    if arena is not None:
+        return arena.stage_xla(offsets, rows, deltas, pad)
+    return np.array(
+        [col + [0] * pad for col in (offsets, rows, deltas)],
+        dtype=np.int32,
+    )
+
+
 def _dispatch_call(
-    kind, dev_vectors, a_prep, survivors, n_use, w_true, groups, tile,
-    fetch, kernel, interpret,
+    kind, vec, a_prep, survivors, n_use, w_true, groups, tile,
+    fetch, kernel, interpret, key=None,
 ):
-    """Route one packed call's ON-DEVICE vectors to its kernel — the
-    single home of the fused/xla x flat/blockdiag dispatch, shared by
+    """Route one packed call's staged vector to its kernel — the single
+    home of the fused/xla x flat/blockdiag dispatch, shared by
     reconstruct_intervals' drain loop and make_batched_call's bench
     thunk so the benchmark can never measure a different compiled shape
-    than the serving path dispatches."""
-    if kind == "fused":
-        (meta,) = dev_vectors
-        if groups > 1:
-            return _fused_reconstruct_blockdiag(
-                a_prep, survivors, meta, tile=tile, fetch=fetch,
-                k_true=n_use, w_true=w_true, groups=groups,
-                interpret=interpret,
+    than the serving path dispatches.  An AOT-compiled executable for
+    the call's shape takes precedence: the jit wrappers' caches never
+    see AOT-warmed shapes, so routing through the registry is what makes
+    the background compile actually serve.  `key` is the call's
+    _call_key when the caller already computed it (the serving drain
+    loop shares one key list between the shed gate, the miss counter,
+    and this lookup — recomputing here from the staged vec could drift
+    from the gate's notion of "warm")."""
+    if key is None:
+        key = _call_key(
+            kind, kernel, groups, w_true, tile, fetch, vec.shape[-1],
+            n_use, a_prep.shape, int(survivors[0].size), interpret,
+        )
+    exe = _aot_executables.get(key)
+    if exe is not None:
+        return exe(a_prep, survivors, vec)
+    with _quiet_donation():
+        if kind == "fused":
+            if groups > 1:
+                return _fused_reconstruct_blockdiag(
+                    a_prep, survivors, vec, tile=tile, fetch=fetch,
+                    k_true=n_use, w_true=w_true, groups=groups,
+                    interpret=interpret,
+                )
+            return _fused_reconstruct(
+                a_prep, survivors, vec, tile=tile, fetch=fetch,
+                k_true=n_use, interpret=interpret,
             )
-        return _fused_reconstruct(
-            a_prep, survivors, meta, tile=tile, fetch=fetch,
-            k_true=n_use, interpret=interpret,
+        if groups > 1:
+            return _gather_reconstruct_blockdiag(
+                a_prep, survivors, vec, tile=tile, fetch=fetch,
+                groups=groups, w_true=w_true, kernel=kernel,
+                interpret=interpret, k_true=n_use,
+            )
+        return _gather_reconstruct(
+            a_prep, survivors, vec, tile=tile, fetch=fetch,
+            kernel=kernel, interpret=interpret, k_true=n_use,
         )
-    offsets, rows, deltas = dev_vectors
-    if groups > 1:
-        return _gather_reconstruct_blockdiag(
-            a_prep, survivors, offsets, rows, deltas, tile=tile,
-            fetch=fetch, groups=groups, w_true=w_true, kernel=kernel,
-            interpret=interpret, k_true=n_use,
-        )
-    return _gather_reconstruct(
-        a_prep, survivors, offsets, rows, deltas, tile=tile, fetch=fetch,
-        kernel=kernel, interpret=interpret, k_true=n_use,
-    )
 
 
 def reconstruct_intervals(
@@ -1199,6 +1682,31 @@ def reconstruct_intervals(
             cache, vid, requests, kernel, interpret, layout,
             data_shards, total_shards, record_observed,
         )
+    surv_len = int(survivors[0].size)
+    call_keys = [
+        _call_key(
+            kind, kernel, groups, w_true, tile, fetch, n_bucket,
+            len(use), a_prep.shape, surv_len, interpret,
+        )
+        for kind, _part, _cols, _pad, fetch, tile, n_bucket, _d in calls
+    ]
+    # AOT shed gate: a volume with a warm plan must never pay an inline
+    # compile on the serving path — a still-cold shape goes BACK to the
+    # caller (host reconstruct) before any device work, and the compile
+    # runs on the background executor so the next read finds it warm
+    if cache.shed_cold and cache.aot_state(vid) != "none":
+        cold = [key for key in call_keys if not _shape_is_warm(key)]
+        if cold:
+            _schedule_aot_compiles(cold)
+            stats_metrics.VOLUME_SERVER_EC_SHED_COLD_SHAPE.inc(
+                len(requests)
+            )
+            stats_metrics.VOLUME_SERVER_EC_READ_ROUTE.labels(
+                route="shed_cold_shape"
+            ).inc(len(requests))
+            raise ColdShape(
+                f"vid {vid}: {len(cold)} device shape(s) still AOT-cold"
+            )
     # the device-execute stage of the request trace: every dispatched
     # call's H2D/D2H bytes and compile-cache outcome annotate the span
     # (and the SeaweedFS_volumeServer_ec_device_* counters), so a slow
@@ -1209,7 +1717,6 @@ def reconstruct_intervals(
                                                else kernel)),
     )
     dev_calls = dev_misses = dev_h2d = dev_d2h = 0
-    surv_len = int(survivors[0].size)
     sub_out: list[bytes | None] = [None] * len(subs)
 
     # PIPELINE: dispatch device calls ahead of fetching results (jax
@@ -1245,37 +1752,42 @@ def reconstruct_intervals(
                 sub_out[sub_idx] = out[j, lo : lo + take].tobytes()
         return len(part) * fetch
 
-    with cache.pipeline.slot() as slot_wait_s, dev_span:
-        for kind, part, vectors, fetch, tile, n_bucket, deltas in calls:
-            # H2D: ship this call's packed host vectors.  Tiny, but on a
-            # tunneled rig each transfer pays a dispatch RTT — making it
-            # a named stage is what lets the stage histogram show
+    with cache.pipeline.slot() as pslot, dev_span:
+        slot_wait_s = pslot.wait_s
+        # the slot's preallocated arena only where device_put COPIES
+        # (TPU/GPU); the CPU PJRT client zero-copies aligned numpy, so a
+        # reused block would alias an asynchronously executing call's
+        # input (see StagingArena)
+        arena = pslot.arena if rs_tpu.on_tpu() else None
+        for call, key in zip(calls, call_keys):
+            kind, part, cols, pad, fetch, tile, n_bucket, deltas = call
+            # H2D: stage + ship this call's packed host vector (ONE
+            # int32 array per call — fused meta is a single packed row,
+            # the r09 [2, N]/three-vector forms are gone).  Tiny, but on
+            # a tunneled rig each transfer pays a dispatch RTT — making
+            # it a named stage is what lets the stage histogram show
             # whether h2d or execute owns a regression.
-            h2d_bytes = sum(int(v.nbytes) for v in vectors)
+            vec_np = _stage_call_vec(kind, cols, pad, arena)
+            h2d_bytes = int(vec_np.nbytes)
             with obs_trace.span("h2d_copy", bytes=h2d_bytes):
-                dev_vectors = tuple(jnp.asarray(v) for v in vectors)
-                for v in dev_vectors:
-                    # the put is async too: wait it out INSIDE the span
-                    # so the stage measures the transfer, not the
-                    # enqueue (tiny vectors — the kernel needs them
-                    # landed before it runs anyway)
-                    v.block_until_ready()
+                dev_vec = jnp.asarray(vec_np)
+                # the put is async too: wait it out INSIDE the span so
+                # the stage measures the transfer, not the enqueue —
+                # and so the arena rows are safe to reuse for the next
+                # call once the copy has landed
+                dev_vec.block_until_ready()
             stats_metrics.VOLUME_SERVER_EC_H2D_BYTES.inc(h2d_bytes)
             dev_h2d += h2d_bytes
-            # the prepared matrix's row dim tracks the wanted-shard
-            # count EXACTLY as retracing does: blockdiag kernels take
-            # w_true static (and a_blk rows = 8*pad4(g*w_true) moves
-            # with it), while the flat kernels only retrace when
-            # pad4(w_true) changes a_bm's shape — keying on the shape
-            # neither misses a real compile nor counts phantom ones
-            dev_misses += _note_shape(
-                ("fused" if kind == "fused" else kernel, layout, tile,
-                 fetch, n_bucket, len(use), int(a_prep.shape[0]),
-                 surv_len)
-            )
+            # the call key tracks the prepared matrix's shape EXACTLY
+            # as retracing does: blockdiag kernels take w_true static
+            # (and a_blk rows = 8*pad4(g*w_true) moves with it), while
+            # the flat kernels only retrace when pad4(w_true) changes
+            # a_bm's shape — keying on the shape neither misses a real
+            # compile nor counts phantom ones
+            dev_misses += _note_shape(key)
             arr = _dispatch_call(
-                kind, dev_vectors, a_prep, survivors, len(use), w_true,
-                groups, tile, fetch, kernel, interpret,
+                kind, dev_vec, a_prep, survivors, len(use), w_true,
+                groups, tile, fetch, kernel, interpret, key=key,
             )
             pending.append((part, arr, fetch, deltas))
             pending_bytes += len(part) * fetch
@@ -1297,6 +1809,9 @@ def reconstruct_intervals(
     outputs: list[list[bytes]] = [[] for _ in requests]
     for (idx, *_), piece in zip(subs, sub_out):
         outputs[idx].append(piece)  # subs are in offset order per request
+    # throttled observed-shape save (satellite: the warm/AOT priority
+    # order survives restarts) — off the device path, after the batch
+    _maybe_persist_observed()
     return [b"".join(parts) for parts in outputs]
 
 
@@ -1335,27 +1850,30 @@ def make_batched_call(
     pad = _bucket(COUNT_BUCKETS, len(part)) - len(part)
     if _use_fused(kernel, interpret):
         kind = "fused"
-        meta_np, _deltas, fetch = _fused_vectors(
-            part, requests, row_of, pad
-        )
+        cols, _deltas, fetch = _fused_vectors(part, requests, row_of)
         if groups > 1:
             fetch, tile = _blockdiag_fetch_tile(fetch, groups)
         else:
             tile = _fused_tile_for(fetch)
-        dev_vectors = (jnp.asarray(meta_np),)
     else:
         kind = "xla"
-        dev_vectors = tuple(
-            jnp.asarray(v)
-            for v in _group_vectors(part, requests, row_of, pad)
-        )
+        cols = _group_vectors(part, requests, row_of)
         max_take = max(s[3] for _, s in part)
         fetch = min(bucket, 1 << (max_take - 1).bit_length())
         tile = bucket
-    return lambda: _dispatch_call(
-        kind, dev_vectors, a_prep, survivors, len(use), w_true, groups,
-        tile, fetch, kernel, interpret,
-    )
+
+    # the staging vector is built FRESH inside the thunk: the kernels
+    # DONATE it, so a captured device array would be invalid on the
+    # second timed invocation — and shipping per call is exactly what
+    # the serving path pays per batch, so the bench measures that too
+    def thunk():
+        vec = jnp.asarray(_stage_call_vec(kind, cols, pad))
+        return _dispatch_call(
+            kind, vec, a_prep, survivors, len(use), w_true, groups,
+            tile, fetch, kernel, interpret,
+        )
+
+    return thunk
 
 
 # per-segment mismatch sums stay < 2^28 < int31, so a wholesale-corrupt
@@ -1501,7 +2019,183 @@ def scrub_volume(
                 n_lanes=n_lanes, kernel=kernel, interpret=interpret,
             )
         )
+    stats_metrics.VOLUME_SERVER_EC_SCRUB_DISPATCH.labels(
+        mode="per_volume"
+    ).inc()
     return [int(row.sum(dtype=np.int64)) for row in partials], n_lanes
+
+
+# --- fused multi-volume scrub megakernel -------------------------------------
+#
+# Per-volume scrub re-pays one device dispatch (plus a tunnel RTT on
+# remote rigs) per pinned volume even though every input already sits in
+# HBM.  The megakernel walks the WHOLE resident cache in one pass: every
+# volume shares the same block-diagonal parity system (the per-volume
+# matrices stacked block-diagonally are just the SAME cached a_blk the
+# per-volume scrub uses), so V volumes stack along the LANE axis — x is
+# [g*k, V*seg] with volume v's segment-stacked rows occupying its seg
+# lanes — and one matmul recomputes every volume's parity at the same
+# per-byte MXU cost as the per-volume loop.  (Expanding the matrix to
+# V*g blocks instead would multiply the dense contraction V-fold; the
+# lane stack keeps compute linear and amortizes only what is actually
+# per-call: dispatch, trace, RTT.)  The per-chunk verdict reduction
+# happens on device exactly as in _scrub_call: only the [V, p, n_seg]
+# int32 mismatch partials come back, and the host reduces them to a
+# per-volume verdict bitmap.
+#
+# Stacks are padded to a power-of-two volume count (repeating the first
+# volume) so the compile ladder stays a handful of shapes per n_lanes
+# class, not one per cache occupancy; _SCRUB_STACK_CAP bounds a single
+# call's runtime and the pow2 padding waste.
+
+_SCRUB_STACK_CAP = 32  # max volumes fused into one device call
+# max stacked input bytes per fused call: the lane stack materializes
+# the chunk's full (k+p)*n_lanes shard bytes AGAIN next to the resident
+# copies (plus the recomputed-parity output), so a count-only cap could
+# OOM a near-capacity cache during the scrub pre-pass — chunks are
+# bounded by transient bytes too, not just volume count
+_SCRUB_STACK_BYTES = 256 << 20
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_lanes", "groups", "vols", "k", "p", "kernel", "interpret",
+    ),
+)
+def _scrub_all_call(
+    a_blk, shards, *, n_lanes, groups, vols, k, p, kernel, interpret
+):
+    """shards: flat tuple of vols*(k+p) resident buffers, volume-major
+    (k data then p parity per volume); a_blk the SAME per-volume
+    blockdiag parity system scrub_volume applies.  One matmul over the
+    lane-stacked [g*k, vols*seg] input recomputes every volume's parity
+    over its first n_lanes bytes; -> [vols, p, n_seg] int32 mismatch
+    partials (the only D2H)."""
+    seg = n_lanes // groups
+    x = jnp.stack(
+        [
+            # row jg*k + i: shard i's segment jg, all volumes
+            # concatenated along lanes
+            jnp.concatenate(
+                [
+                    shards[v * (k + p) + i][jg * seg : (jg + 1) * seg]
+                    for v in range(vols)
+                ]
+            )
+            for jg in range(groups)
+            for i in range(k)
+        ]
+    )  # [groups*k, vols*seg]
+    out = rs_tpu.apply_matrix_device(
+        a_blk, x, kernel=kernel, interpret=interpret,
+        k_true=groups * k,
+    )
+    rows = []
+    for v in range(vols):
+        vrows = []
+        for j in range(p):
+            diff = jnp.concatenate(
+                [
+                    out[jg * p + j][v * seg : (v + 1) * seg]
+                    != shards[v * (k + p) + k + j][jg * seg : (jg + 1) * seg]
+                    for jg in range(groups)
+                ]
+            )
+            vrows.append(
+                jnp.stack(
+                    [
+                        jnp.sum(diff[s : s + _SCRUB_SEG].astype(jnp.int32))
+                        for s in range(0, n_lanes, _SCRUB_SEG)
+                    ]
+                )
+            )
+        rows.append(jnp.stack(vrows))
+    return jnp.stack(rows)
+
+
+def scrub_all_resident(
+    cache: DeviceShardCache,
+    kernel: str | None = None,
+    interpret: bool | None = None,
+    data_shards: int = DATA_SHARDS,
+    total_shards: int = TOTAL_SHARDS,
+    layout: str | None = None,
+    vids: list[int] | None = None,
+) -> tuple[dict[int, tuple[list[int], int]], dict]:
+    """Parity-scrub EVERY fully resident volume (or the `vids` subset)
+    in as few device passes as possible: volumes with equal verified
+    spans stack into one block-diagonal megakernel call, amortizing
+    dispatch + H2D over the whole cache.  -> ({vid: (per-parity-shard
+    mismatch byte counts, bytes verified per shard)}, {"device_calls",
+    "volumes"}).  Volumes that stop qualifying mid-pass (eviction, size
+    mismatch) are silently absent from the result — the caller's
+    per-volume path still owns them."""
+    if kernel is None:
+        kernel = "pallas" if rs_tpu.on_tpu() else "xla"
+    if interpret is None:
+        interpret = not rs_tpu.on_tpu()
+    if layout is None:
+        layout = cache.layout
+    groups = cache.groups if layout == "blockdiag" else 1
+    k = data_shards
+    p = total_shards - data_shards
+    quant = groups * LANE
+    if vids is None:
+        vids = sorted(cache.resident_by_vid())
+    # (n_lanes, [(vid, shard tuple)]) stacks: only fully resident,
+    # uniform-size volumes qualify (same rule as scrub_volume)
+    stacks: dict[int, list[tuple[int, tuple]]] = {}
+    for vid in vids:
+        if cache.resident_count(vid) < total_shards:
+            continue
+        sizes = {cache.shard_size(vid, s) for s in range(total_shards)}
+        if len(sizes) != 1 or None in sizes:
+            continue
+        shards = tuple(cache.get(vid, s) for s in range(total_shards))
+        if any(s is None for s in shards):
+            continue
+        n_lanes = -(-sizes.pop() // quant) * quant
+        stacks.setdefault(n_lanes, []).append((vid, shards))
+    parity_m = gf256.build_matrix(data_shards, total_shards)[data_shards:]
+    # the SAME prepared system scrub_volume uses (one cached device
+    # copy): volumes stack along lanes, never into a bigger matrix
+    a_blk = _prepared_blockdiag_matrix(
+        parity_m.tobytes(), *parity_m.shape, groups
+    )
+    results: dict[int, tuple[list[int], int]] = {}
+    device_calls = 0
+    for n_lanes, members in sorted(stacks.items()):
+        # bound the call's transient HBM (see _SCRUB_STACK_BYTES); the
+        # step stays a power of two so the pow2 volume padding below
+        # never re-crosses the byte cap
+        fit = max(1, _SCRUB_STACK_BYTES // (n_lanes * (k + p)))
+        step = min(_SCRUB_STACK_CAP, 1 << (fit.bit_length() - 1))
+        for start in range(0, len(members), step):
+            chunk = members[start : start + step]
+            # pad to the power-of-two volume bucket by repeating the
+            # first volume: compile shapes quantize to the bucket
+            # ladder, and the duplicate lanes' partials are dropped
+            vols = 1 << (len(chunk) - 1).bit_length()
+            padded = chunk + [chunk[0]] * (vols - len(chunk))
+            flat = tuple(s for _vid, shards in padded for s in shards)
+            partials = np.asarray(
+                _scrub_all_call(
+                    a_blk, flat, n_lanes=n_lanes, groups=groups,
+                    vols=vols, k=k, p=p, kernel=kernel,
+                    interpret=interpret,
+                )
+            )
+            device_calls += 1
+            stats_metrics.VOLUME_SERVER_EC_SCRUB_DISPATCH.labels(
+                mode="megakernel"
+            ).inc()
+            for (vid, _shards), vol_partials in zip(chunk, partials):
+                results[vid] = (
+                    [int(r.sum(dtype=np.int64)) for r in vol_partials],
+                    n_lanes,
+                )
+    return results, {"device_calls": device_calls, "volumes": len(results)}
 
 
 def _warm_key(size: int, count: int) -> tuple[int, int]:
@@ -1511,6 +2205,28 @@ def _warm_key(size: int, count: int) -> tuple[int, int]:
     size+delta) keeps boundary sizes like 2048 in their own bucket."""
     b = _bucket(SIZE_BUCKETS, min(size, MAX_TILE))
     return b, _bucket(COUNT_BUCKETS, min(count, _max_count(b)))
+
+
+def _warm_grid(cache, vid, sizes, counts, total_shards, observed):
+    """(missing shard, observed-first ordered [(size, count)] grid), or
+    (None, []) when the volume cannot serve a degraded read at all."""
+    resident = cache.shard_ids(vid)
+    non_resident = [s for s in range(total_shards) if s not in resident]
+    if non_resident:
+        missing = non_resident[0]
+        if len(resident) < DATA_SHARDS:
+            return None, []
+    else:
+        missing = resident[-1]
+        if len(resident) - 1 < DATA_SHARDS:
+            return None, []
+    grid = [(size, count) for size in sizes for count in counts]
+    if observed is None:
+        observed = observed_buckets()
+    if observed:
+        rank = {b: i for i, b in enumerate(observed)}
+        grid.sort(key=lambda sc: rank.get(_warm_key(*sc), len(rank)))
+    return missing, grid
 
 
 def warm(
@@ -1523,12 +2239,30 @@ def warm(
     should_stop=None,  # callable -> bool: abort between compiles
     layout: str | None = None,
     observed: list[tuple[int, int]] | None = None,
+    aot: bool = True,
+    wait: bool = True,
+    kernel: str | None = None,
+    interpret: bool | None = None,
     **kw,
 ) -> None:
-    """Pre-compile the bucket combinations a serving path will hit, so the
-    first real degraded read doesn't pay a 20-40s TPU compile.  The wanted
-    shard is a NON-resident one when any exists (the realistic degraded
-    case), so a volume with exactly DATA_SHARDS survivors still warms.
+    """Make the bucket combinations a serving path will hit compiled
+    BEFORE the first real degraded read, so none pays a 20-40s TPU
+    compile inline.  The wanted shard is a NON-resident one when any
+    exists (the realistic degraded case), so a volume with exactly
+    DATA_SHARDS survivors still warms.
+
+    Default mode (`aot=True`) is ahead-of-time: every device-call shape
+    of the grid is lowered + compiled (jax.jit(...).lower(...).compile())
+    on the single-worker background executor, in observed-buckets-first
+    priority order, and parked in the AOT registry _dispatch_call serves
+    from — no synthetic read is ever executed.  Setting the plan also
+    arms the cold-shape shed for this volume (cache.aot_state != "none"):
+    a serving read racing the executor sheds to host instead of
+    compiling inline.  `wait=False` returns as soon as the plan is
+    queued; `wait=True` blocks until the grid is compiled and marks the
+    volume "done".  `aot=False` is the legacy trace-and-execute walk
+    (kept for the -ec.serving.aot.disable knob and as the
+    compiled-shapes oracle in tests); it never arms the shed.
 
     Compiles the ACTIVE layout's ladder only (`layout`, None = the
     cache's — the other family's shapes would double the 20-40s/shape
@@ -1539,34 +2273,71 @@ def warm(
     before burning compiles on ladder corners nobody hits."""
     if layout is None:
         layout = cache.layout
-    resident = cache.shard_ids(vid)
-    non_resident = [s for s in range(total_shards) if s not in resident]
-    if non_resident:
-        missing = non_resident[0]
-        if len(resident) < DATA_SHARDS:
-            return
-    else:
-        missing = resident[-1]
-        if len(resident) - 1 < DATA_SHARDS:
-            return
-    grid = [(size, count) for size in sizes for count in counts]
-    if observed is None:
-        observed = observed_buckets()
-    if observed:
-        rank = {b: i for i, b in enumerate(observed)}
-        grid.sort(key=lambda sc: rank.get(_warm_key(*sc), len(rank)))
+    if kernel is None:
+        kernel = "pallas" if rs_tpu.on_tpu() else "xla"
+    if interpret is None:
+        interpret = not rs_tpu.on_tpu()
+    missing, grid = _warm_grid(
+        cache, vid, sizes, counts, total_shards, observed
+    )
+    if missing is None or not grid:
+        # no plan (unservable volume, or the CI convention warm_sizes=())
+        # — aot_state stays "none", so reads keep inline compiles
+        return
+    if not aot:
+        for size, count in grid:
+            # both alignment classes: an aligned offset keeps fetch at
+            # cover(size); any other offset pushes the span past it onto
+            # the next ladder step (usually the 3*2^(n-1) one, see
+            # _fetch_cover) — each is its own compiled shape
+            for off in (0, 1):
+                if should_stop is not None and should_stop():
+                    return
+                reqs = [(missing, off, size)] * count
+                # record_observed=False: warm's own ladder walk must not
+                # feed the observed-shape ranking it consults
+                reconstruct_intervals(
+                    cache, vid, reqs, layout=layout, kernel=kernel,
+                    interpret=interpret, record_observed=False, **kw,
+                )
+        return
+    cache._set_aot_state(vid, "warming")
+    groups = cache.groups if layout == "blockdiag" else 1
+    futures = []
     for size, count in grid:
-        # both alignment classes: an aligned offset keeps fetch at
-        # cover(size); any other offset pushes the span past it onto
-        # the next ladder step (usually the 3*2^(n-1) one, see
-        # _fetch_cover) — each is its own compiled shape
         for off in (0, 1):
             if should_stop is not None and should_stop():
+                # aborted (pin teardown): no plan is coming, so the
+                # volume must not stay shed-armed in "warming"
+                cache._set_aot_state(vid, "none")
                 return
             reqs = [(missing, off, size)] * count
-            # record_observed=False: warm's own ladder walk must not
-            # feed the observed-shape ranking it consults
-            reconstruct_intervals(
-                cache, vid, reqs, layout=layout,
-                record_observed=False, **kw,
-            )
+            try:
+                calls, _subs, survivors, a_prep, use, w_true = _pack_calls(
+                    cache, vid, reqs, kernel, interpret, layout,
+                    DATA_SHARDS, total_shards, record_observed=False,
+                )
+            except CacheMiss:
+                # evicted under the planner: nothing to warm — reset the
+                # state so a later direct re-pin doesn't shed forever
+                # against a plan that never ran
+                cache._set_aot_state(vid, "none")
+                return
+            surv_len = int(survivors[0].size)
+            futures.extend(_schedule_aot_compiles([
+                _call_key(
+                    kind, kernel, groups, w_true, tile, fetch, n_bucket,
+                    len(use), a_prep.shape, surv_len, interpret,
+                )
+                for kind, _p, _c, _pad, fetch, tile, n_bucket, _d in calls
+            ]))
+    if wait:
+        for f in futures:
+            f.result()
+        cache._set_aot_state(vid, "done")
+    elif futures:
+        futures[-1].add_done_callback(
+            lambda _f: cache._set_aot_state(vid, "done")
+        )
+    else:  # every shape already warm
+        cache._set_aot_state(vid, "done")

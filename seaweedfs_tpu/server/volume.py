@@ -148,10 +148,13 @@ class VolumeServer:
         metrics_interval_seconds: int = 15,  # ref -metrics.intervalSeconds
         ec_scrub_interval_seconds: int = 0,  # >0: periodic parity scrub
         ec_serving=None,  # serving.ServingConfig | None (-ec.serving.* flags)
+        ec_scrub_megakernel: bool = True,  # fuse resident scrubs into one
+        # device pass per cycle (-ec.scrub.megakernel.disable)
     ):
         self.metrics_address = metrics_address
         self.metrics_interval_seconds = metrics_interval_seconds
         self.ec_scrub_interval_seconds = ec_scrub_interval_seconds
+        self.ec_scrub_megakernel = ec_scrub_megakernel
         self.fix_jpg_orientation = fix_jpg_orientation
         self.guard = guard_mod.Guard(white_list)
         if tier_backends:
@@ -173,6 +176,9 @@ class VolumeServer:
                 layout=ec_serving.layout,
             )
             device_cache.pipeline.set_slots(ec_serving.pipeline_slots)
+            # -ec.serving.aot.disable: inline compiles instead of the
+            # cold-shape shed (warm() also keys its mode off this)
+            device_cache.shed_cold = ec_serving.aot
         if isinstance(max_volume_counts, int):
             max_volume_counts = [max_volume_counts] * len(directories)
         if disk_types is None:
@@ -309,9 +315,36 @@ class VolumeServer:
         # keeps the previous verdict: a transiently unreadable volume
         # that was corrupt last cycle must not auto-resolve the alert.
         verdicts: dict[tuple[str, int], bool] = {}
+
+        def _record(key: tuple[str, int], r: dict) -> None:
+            # ONE home for the verdict+alert bookkeeping so the
+            # megakernel and per-volume branches can never report
+            # corruption differently
+            bad = sum(r["parity_mismatch_bytes"])
+            verdicts[key] = bool(bad)
+            if bad:
+                log.error(
+                    "ec volume %d FAILED parity scrub: %s mismatch "
+                    "bytes (backend=%s) — run ec.rebuild",
+                    key[1], r["parity_mismatch_bytes"], r["backend"],
+                )
+
         while not self._stopping:
             await asyncio.sleep(self.ec_scrub_interval_seconds)
             seen: set[tuple[str, int]] = set()
+            # megakernel pre-pass: every fully resident volume scrubs in
+            # ONE fused device pass (block-diagonally stacked parity
+            # systems) instead of one dispatch per volume; the loop
+            # below consumes its verdicts and only scrubs the rest
+            # (file-backed or unpinned copies) individually
+            mega: dict = {}
+            if self.ec_scrub_megakernel:
+                try:
+                    mega = await asyncio.to_thread(
+                        self.store.scrub_all_resident
+                    )
+                except Exception:  # noqa: BLE001 — fall back per-volume
+                    log.exception("ec scrub megakernel pass failed")
             for loc in self.store.locations:
                 # per-location EcVolume objects: a vid mounted in two
                 # locations is two independent shard sets, each scrubbed
@@ -324,6 +357,12 @@ class VolumeServer:
                         # hop per cycle finding that out
                         verdicts.pop(key, None)
                         continue
+                    r = mega.get(vid)
+                    if r is not None and r["dir"] == loc.directory:
+                        # the fused pass already verified THIS location's
+                        # pinned bytes
+                        _record(key, r)
+                        continue
                     try:
                         r = await asyncio.to_thread(self.store.scrub_ec, ev)
                     except FileNotFoundError:
@@ -333,14 +372,7 @@ class VolumeServer:
                         # unmount mid-scrub: keep the last verdict
                         log.exception("ec scrub failed for volume %d", vid)
                         continue
-                    bad = sum(r["parity_mismatch_bytes"])
-                    verdicts[key] = bool(bad)
-                    if bad:
-                        log.error(
-                            "ec volume %d FAILED parity scrub: %s mismatch "
-                            "bytes (backend=%s) — run ec.rebuild",
-                            vid, r["parity_mismatch_bytes"], r["backend"],
-                        )
+                    _record(key, r)
             for key in list(verdicts):
                 if key not in seen:  # unmounted since last cycle
                     del verdicts[key]
@@ -453,6 +485,13 @@ class VolumeServer:
         tel.compile_misses = int(
             g("SeaweedFS_volumeServer_ec_device_compile_total",
               {"result": "miss"}) or 0
+        )
+        # persistent-compile-cache outcome: a node silently recompiling
+        # every restart is an operator-visible column, not a lost log
+        from ..ops import rs_resident
+
+        tel.compile_cache_enabled = bool(
+            rs_resident.compile_cache_status()["enabled"]
         )
         tel.dispatcher_queue_depth = self.ec_dispatcher.queue_depth
         tel.dispatcher_inflight = self.ec_dispatcher.inflight
@@ -1661,7 +1700,32 @@ class VolumeServer:
     async def VolumeEcShardsVerify(self, request, context):
         """Parity scrub of a mounted EC volume (device-resident when the
         shard cache holds the whole volume, else the CPU kernel over the
-        shard files) — the repair-loop verify pass as a first-class RPC."""
+        shard files) — the repair-loop verify pass as a first-class RPC.
+
+        `all_resident=True` ignores volume_id and scrubs EVERY fully
+        device-resident volume on this node in one fused megakernel pass
+        (per-volume parity systems stacked block-diagonally — a handful
+        of device dispatches for the whole cache); the per-volume
+        verdicts come back in `volumes`."""
+        if getattr(request, "all_resident", False):
+            results = await asyncio.to_thread(self.store.scrub_all_resident)
+            # per-volume seconds are span-apportioned slices of the one
+            # shared pass, so their sum IS the pass wall
+            wall = sum(r["seconds"] for r in results.values())
+            return volume_server_pb2.VolumeEcShardsVerifyResponse(
+                backend="device_megakernel",
+                seconds=wall,
+                volumes=[
+                    volume_server_pb2.EcVolumeScrubResult(
+                        volume_id=vid,
+                        parity_mismatch_bytes=r["parity_mismatch_bytes"],
+                        backend=r["backend"],
+                        bytes_verified=r["bytes_verified"],
+                        seconds=r["seconds"],
+                    )
+                    for vid, r in sorted(results.items())
+                ],
+            )
         try:
             result = await asyncio.to_thread(
                 self.store.scrub_ec_volume, request.volume_id

@@ -45,6 +45,13 @@ class ServingConfig:
     # ship while batch N executes (only N's D2H blocks N); False = one
     # slot, the serial baseline bench.py's overlap-off axis measures
     overlap: bool = True
+    # AOT serving grid + cold-shape shed (-ec.serving.aot.disable):
+    # warm plans compile ahead-of-time on a background executor, and a
+    # read that would hit a still-cold device shape is served on the
+    # host path (shed_cold_shape route) instead of stalling the
+    # dispatcher 20-40s behind an inline compile.  False restores the
+    # legacy trace-and-execute warm and inline compiles.
+    aot: bool = True
 
     @property
     def max_wait_s(self) -> float:

@@ -849,7 +849,12 @@ async def cmd_volume_device_status(env, args):
             f"(headroom {fmt_bytes(dev['headroom_bytes'])}) "
             f"shards={dev['resident_shards']} "
             f"evictions={dev['evictions']} pin_claims={dev['pin_claims']} "
-            f"compile hit/miss={dev['compile_hits']}/{dev['compile_misses']}"
+            f"compile hit/miss={dev['compile_hits']}/{dev['compile_misses']} "
+            # OFF = this node recompiles every shape on every restart
+            # (bad cache dir or old jax) — the silently-expensive state
+            # the persistent-cache satellite makes visible
+            f"compile_cache="
+            f"{'on' if dev.get('compile_cache_enabled') else 'OFF'}"
         )
         for vid, count in dev["resident_shards_by_volume"].items():
             env.write(f"  ec volume {vid}: {count} resident shards")

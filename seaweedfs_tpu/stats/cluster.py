@@ -149,6 +149,7 @@ class NodeTelemetry:
     device_pin_claims: int = 0
     compile_hits: int = 0
     compile_misses: int = 0
+    compile_cache_enabled: bool = False
     dispatcher_queue_depth: int = 0
     dispatcher_inflight: int = 0
     dispatcher_shed: int = 0
@@ -177,6 +178,7 @@ class NodeTelemetry:
                 "pin_claims": self.device_pin_claims,
                 "compile_hits": self.compile_hits,
                 "compile_misses": self.compile_misses,
+                "compile_cache_enabled": self.compile_cache_enabled,
                 "resident_shards_by_volume": {
                     str(v): n for v, n in sorted(self.resident_by_volume.items())
                 },
@@ -238,6 +240,10 @@ class ClusterTelemetry:
             nt.device_pin_claims = tel.device_pin_claims
             nt.compile_hits = tel.compile_hits
             nt.compile_misses = tel.compile_misses
+            # getattr-guarded: pre-r11 servers lack the field
+            nt.compile_cache_enabled = bool(
+                getattr(tel, "compile_cache_enabled", False)
+            )
             nt.dispatcher_queue_depth = tel.dispatcher_queue_depth
             nt.dispatcher_inflight = tel.dispatcher_inflight
             nt.dispatcher_shed = tel.dispatcher_shed

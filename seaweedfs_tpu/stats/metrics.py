@@ -136,10 +136,51 @@ VOLUME_SERVER_EC_BATCH_FALLBACK = Counter(
 VOLUME_SERVER_EC_READ_ROUTE = Counter(
     "SeaweedFS_volumeServer_ec_read_route_total",
     "EC reads by serving route (batched = resident continuous-batching "
-    "path, native = per-read host path).",
+    "path, native = per-read host path, shed_cold_shape = interval "
+    "requests re-routed to host reconstruct because their device shape "
+    "was still AOT-cold — counted per reconstruct interval, not per "
+    "needle, and IN ADDITION to the admitting batched/native count: "
+    "batched+native partitions admissions, shed_cold_shape marks which "
+    "of those were re-routed after admission).",
     ["route"],
     registry=REGISTRY,
 )
+for _route in ("batched", "native", "shed_cold_shape"):
+    VOLUME_SERVER_EC_READ_ROUTE.labels(route=_route)
+VOLUME_SERVER_EC_SHED_COLD_SHAPE = Counter(
+    "SeaweedFS_volumeServer_ec_shed_cold_shape_total",
+    "Resident reconstruct interval requests shed to the host path "
+    "because a device call shape was not AOT-compiled yet (the shed "
+    "schedules the background compile; the read never blocks on a "
+    "20-40s compile cliff).",
+    registry=REGISTRY,
+)
+VOLUME_SERVER_EC_COMPILE_CACHE_ENABLED = Gauge(
+    "SeaweedFS_volumeServer_ec_compile_cache_enabled",
+    "1 when the persistent XLA compile cache is active (reconstruct "
+    "kernel compiles survive restarts), 0 when configuration failed — "
+    "a 0 here means every restart re-pays tens of seconds per shape.",
+    registry=REGISTRY,
+)
+VOLUME_SERVER_EC_AOT_COMPILED = Counter(
+    "SeaweedFS_volumeServer_ec_aot_compiled_total",
+    "Reconstruct-kernel shapes compiled ahead-of-time on the background "
+    "executor (warm plans + cold-shape sheds) — compiles the serving "
+    "path never paid inline.",
+    registry=REGISTRY,
+)
+VOLUME_SERVER_EC_SCRUB_DISPATCH = Counter(
+    "SeaweedFS_volumeServer_ec_scrub_device_dispatch_total",
+    "Device dispatches spent scrubbing resident EC volumes, by mode: "
+    "per_volume = one call per volume (scrub_volume), megakernel = one "
+    "block-diagonal pass covering a whole stack of pinned volumes "
+    "(scrub_all_resident) — the megakernel winning means the same "
+    "parity coverage for a fraction of the dispatch/RTT bill.",
+    ["mode"],
+    registry=REGISTRY,
+)
+for _mode in ("per_volume", "megakernel"):
+    VOLUME_SERVER_EC_SCRUB_DISPATCH.labels(mode=_mode)
 
 # request tracing stages (obs/trace.py spans): one histogram family,
 # labeled by stage, µs-resolution buckets — the per-stage view that lets

@@ -329,6 +329,11 @@ class EcVolume:
                     self.device_cache, self.id, [(missing_shard, off, size)]
                 )[0]
             except rs_resident.CacheMiss:
+                # includes ColdShape (a CacheMiss subclass): an AOT-cold
+                # device shape sheds here to the host reconstruct below
+                # — counted in ..._ec_shed_cold_shape_total and the
+                # shed_cold_shape read route — while the background
+                # executor compiles it for the next read
                 pass
         got: dict[int, np.ndarray] = {}
         n_remote = 0
@@ -431,6 +436,9 @@ class EcVolume:
                     self.device_cache, self.id, requests
                 )
             except rs_resident.CacheMiss:
+                # includes ColdShape: the whole batch's intervals shed
+                # to the per-interval host path (recon=None) instead of
+                # stalling the dispatcher behind a 20-40s inline compile
                 recon = None
 
         results: list[Needle | Exception] = []

@@ -493,11 +493,17 @@ class Store:
                 )
                 from ..ops import rs_resident
 
+                # aot follows the shed knob: with the shed armed the
+                # plan MUST be ahead-of-time (state != "none" routes
+                # cold shapes to host while the executor compiles);
+                # with it disabled the legacy trace-and-execute walk
+                # keeps inline-compile behavior end to end
                 rs_resident.warm(
                     cache, ev.id,
                     sizes=cache.warm_sizes,
                     counts=cache.warm_counts,
                     should_stop=self._closing.is_set,
+                    aot=cache.shed_cold,
                 )
             except Exception:
                 logging.getLogger(__name__).exception(
@@ -589,6 +595,56 @@ class Store:
         if ev is None:
             raise NotFoundError(f"ec volume {vid} not found")
         return self.scrub_ec(ev)
+
+    def scrub_all_resident(self) -> dict[int, dict]:
+        """Parity-scrub every fully device-resident EC volume in ONE
+        megakernel pass over the HBM cache (rs_resident.
+        scrub_all_resident): per-volume parity systems stack
+        block-diagonally so the whole cache costs a handful of device
+        dispatches instead of one per volume.  -> {vid: result dict in
+        the scrub_ec shape, plus "dir" (the pinned location — the only
+        location whose files the resident verdict speaks for) and
+        "device_calls"/"volumes_in_pass" of the shared pass}.  Volumes
+        not covered (not fully resident, size mismatch, unpinned
+        location) are simply absent — the caller's per-volume path still
+        owns them."""
+        cache = self.ec_device_cache
+        if cache is None:
+            return {}
+        from ..ops import rs_resident
+
+        eligible: dict[int, object] = {}
+        with self._lock:
+            for loc in self.locations:
+                for vid, ev in loc.ec_volumes.items():
+                    # same attribution rule as scrub_ec: the resident
+                    # verdict only speaks for the pinned location's files
+                    if ev.is_device_resident():
+                        eligible[vid] = ev
+        if not eligible:
+            return {}
+        t0 = time.time()
+        results, pass_stats = rs_resident.scrub_all_resident(
+            cache, vids=sorted(eligible)
+        )
+        wall = time.time() - t0
+        # apportion the shared pass's wall by span share: per-volume
+        # seconds sum back to the pass wall, so the shell's per-volume
+        # GB/s stays comparable to the old per-volume RPC's rates
+        # instead of reading V-times slow
+        total_span = sum(span for _m, span in results.values()) or 1
+        return {
+            vid: {
+                "parity_mismatch_bytes": mism,
+                "backend": "device_megakernel",
+                "seconds": wall * span / total_span,
+                "bytes_verified": span,
+                "dir": eligible[vid].dir,
+                "device_calls": pass_stats["device_calls"],
+                "volumes_in_pass": pass_stats["volumes"],
+            }
+            for vid, (mism, span) in results.items()
+        }
 
     def scrub_ec(self, ev) -> dict:
         """Scrub one specific EcVolume object (a vid can be mounted in

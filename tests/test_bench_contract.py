@@ -18,6 +18,7 @@ def test_headline_keys_are_the_contract():
         "consistency",
         "serving_headline",
         "encode_headline",
+        "scrub_headline",
     )
 
 
@@ -25,6 +26,7 @@ def test_order_result_puts_headline_keys_last():
     shuffled = {
         "serving_headline": {"device_wins": True},
         "metric": "rs_10_4_encode_blockdiag_pallas",
+        "scrub_headline": {"megakernel_beats_per_volume": True},
         "value": 12.3,
         "encode_headline": {"overlap_beats_serial": True},
         "extra": {"bulk": list(range(10))},
@@ -63,6 +65,12 @@ def _bulky_result():
                 "best_resident_reads_per_s": 1000.0,
                 "blockdiag_overlap_beats_flat_serial": True,
                 "consistency_ok": True,
+                "timed_compile_misses": 0,
+                "timed_shed_reads": 0,
+                "aot_covers_grid": True,
+                "h2d_bytes_per_batch": 256,
+                "h2d_bytes_per_batch_r09": 512,
+                "donation_reduces_h2d": True,
             },
             "encode_headline": {
                 "overlap_beats_serial": True,
@@ -73,6 +81,15 @@ def _bulky_result():
                 "stats_contract_ok": True,
                 "byte_identical": True,
                 "rebuild_overlap_beats_serial": True,
+            },
+            "scrub_headline": {
+                "device_wins": True,
+                "device_speedup": 5.97,
+                "megakernel_beats_per_volume": True,
+                "megakernel_s_blockdiag": 0.2,
+                "per_volume_s_blockdiag": 0.9,
+                "megakernel_dispatches": 1.0,
+                "per_volume_dispatches": 4.0,
             },
         }
     )
@@ -103,3 +120,63 @@ def test_archived_tail_carries_encode_sweep_verdict():
         "rebuild_overlap_beats_serial",
     ):
         assert f'"{key}"' in tail, f"{key} fell outside the archived tail"
+
+
+def test_archived_tail_carries_r11_verdicts():
+    """The r11 verdict keys — zero timed compile misses (the AOT grid
+    covered the sweep), the packed-meta/donation H2D reduction, and the
+    scrub megakernel win — must survive the 2000-char archive window."""
+    tail = json.dumps(_bulky_result())[-2000:]
+    for key in (
+        "timed_compile_misses",
+        "timed_shed_reads",
+        "aot_covers_grid",
+        "h2d_bytes_per_batch",
+        "donation_reduces_h2d",
+        "megakernel_beats_per_volume",
+        "megakernel_dispatches",
+        "per_volume_dispatches",
+    ):
+        assert f'"{key}"' in tail, f"{key} fell outside the archived tail"
+
+
+def test_serving_warm_grid_covers_timed_needle_shapes():
+    """The compile-misses==0 guard's STRUCTURAL half: every fetch-ladder
+    shape a timed 4KB serving read can produce (any sub-lane/sub-
+    FUSED_ALIGN alignment, any count bucket) must be covered by the
+    sweep's warm grid (warm_sizes=(4096,), counts=COUNT_BUCKETS, both
+    warm alignment classes) for the single-wanted case — so a future
+    edit to SIZE_BUCKETS/_fetch_cover/_blockdiag_fetch_tile that pushes
+    a mid-benchmark needle onto an unwarmed shape fails tier-1 instead
+    of polluting the timed trajectory with a 20-40s compile."""
+    from seaweedfs_tpu.ops import rs_resident, rs_tpu
+    from seaweedfs_tpu.storage import needle as needle_mod
+
+    needle_size = needle_mod.actual_size(4096, needle_mod.CURRENT_VERSION)
+
+    def fused_shape(size, extra_delta):
+        # mirror _plan + _fused_vectors: LANE-align, then FUSED_ALIGN
+        # re-align; span = delta + take
+        span = extra_delta + size
+        fetch = rs_resident._fetch_cover(span)
+        blk_fetch, blk_tile = rs_resident._blockdiag_fetch_tile(
+            fetch, rs_tpu.BLOCKDIAG_GROUPS
+        )
+        return (
+            rs_resident._bucket(rs_resident.SIZE_BUCKETS, span),
+            blk_fetch,
+            blk_tile,
+        )
+
+    warm_shapes = {
+        fused_shape(4096, off) for off in (0, 1)
+    }
+    timed_shapes = {
+        fused_shape(needle_size, delta)
+        for delta in range(rs_resident.FUSED_ALIGN)
+    }
+    missing = timed_shapes - warm_shapes
+    assert not missing, (
+        f"timed 4KB needle reads can hit fetch shapes the serving "
+        f"sweep's warm grid never compiles: {sorted(missing)}"
+    )

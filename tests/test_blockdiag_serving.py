@@ -293,6 +293,8 @@ class TestEvictionRaces:
 
 class TestWarmPriority:
     def test_observed_buckets_order_warm_grid(self, coded, monkeypatch):
+        """Legacy (aot=False) trace-and-execute warm keeps the observed-
+        first walk."""
         cache = fill_cache(coded, missing=(3, 11))
         seen = []
 
@@ -305,9 +307,32 @@ class TestWarmPriority:
         # first even though it is not the grid's natural first entry
         rs_resident.warm(
             cache, 7, sizes=(65536, 4096), counts=(1, 16),
+            observed=[(8192, 16)], aot=False,
+        )
+        assert seen[0] == (4096, 16), seen[:4]
+
+    def test_aot_warm_walks_observed_first(self, coded, monkeypatch):
+        """AOT warm (the default) plans compile jobs in the same
+        observed-buckets-first order — the single-worker executor makes
+        submission order the compile order."""
+        cache = fill_cache(coded, missing=(3, 11))
+        seen = []
+        real = rs_resident._pack_calls
+
+        def spying(cache_, vid, reqs, *a, **kw):
+            seen.append((reqs[0][2], len(reqs)))
+            return real(cache_, vid, reqs, *a, **kw)
+
+        monkeypatch.setattr(rs_resident, "_pack_calls", spying)
+        monkeypatch.setattr(
+            rs_resident, "_schedule_aot_compiles", lambda keys: []
+        )
+        rs_resident.warm(
+            cache, 7, sizes=(65536, 4096), counts=(1, 16),
             observed=[(8192, 16)],
         )
         assert seen[0] == (4096, 16), seen[:4]
+        assert cache.aot_state(7) == "done"
 
     def test_observed_buckets_recorded(self, coded):
         cache = fill_cache(coded, missing=(3, 11))
