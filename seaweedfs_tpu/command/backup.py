@@ -49,8 +49,9 @@ async def run(args) -> None:
     since_ns = 0
     prev_revision = -1
     if os.path.exists(cursor_path):
-        with open(cursor_path) as f:
-            cur = json.loads(f.read() or "{}")
+        from ..utils.aiofile import read_file_text
+
+        cur = json.loads(await read_file_text(cursor_path) or "{}")
         since_ns = int(cur.get("since_ns", 0))
         prev_revision = int(cur.get("compact_revision", -1))
 
@@ -90,11 +91,11 @@ async def run(args) -> None:
         )
     finally:
         v.close()
-    with open(cursor_path, "w") as f:
-        json.dump(
-            {"since_ns": last_ns, "compact_revision": status.compact_revision},
-            f,
-        )
+    from ..utils.aiofile import write_file_text
+
+    await write_file_text(cursor_path, json.dumps(
+        {"since_ns": last_ns, "compact_revision": status.compact_revision}
+    ))
     print(
         f"volume {args.volume_id}: applied {applied} records "
         f"(cursor {since_ns} -> {last_ns}) into {args.dir}"

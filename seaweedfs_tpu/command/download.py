@@ -41,6 +41,7 @@ async def run(args) -> None:
             if data is None:
                 raise SystemExit(f"{fid}: all replicas failed ({last})")
             out = os.path.join(args.dir, fid.replace(",", "_"))
-            with open(out, "wb") as f:
-                f.write(data)
+            from ..utils.aiofile import write_file_bytes
+
+            await write_file_bytes(out, data)
             print(f"{fid} -> {out} ({len(data)} bytes)")

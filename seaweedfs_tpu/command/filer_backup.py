@@ -54,8 +54,9 @@ class _LocalTarget:
         return 0
 
     async def write_progress(self, ts_ns: int) -> None:
-        with open(self._progress, "w") as f:
-            f.write(str(ts_ns))
+        from ..utils.aiofile import write_file_text
+
+        await write_file_text(self._progress, str(ts_ns))
 
     async def mkdir(self, rel: str) -> None:
         os.makedirs(self._path(rel), exist_ok=True)

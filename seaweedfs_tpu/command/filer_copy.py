@@ -58,7 +58,12 @@ async def run(args) -> None:
                 f"http://{filer}{urllib.parse.quote(remote)}"
                 + (f"?{qs}" if qs else "")
             )
-            with open(local, "rb") as f:
+            # the open goes through to_thread; aiohttp itself reads a
+            # handed-over file object in an executor, so only the open
+            # (and close) would otherwise block sibling uploads
+            from ..utils.aiofile import open_in_thread
+
+            async with open_in_thread(local, "rb") as f:
                 async with session.put(url, data=f) as r:
                     if r.status >= 300:
                         raise RuntimeError(

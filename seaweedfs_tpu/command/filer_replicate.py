@@ -200,8 +200,9 @@ async def run(args) -> None:
     progress_path = (args.spool or "mq") + ".replicate_offset"
     offset = 0
     if args.spool and os.path.exists(progress_path):
-        with open(progress_path) as f:
-            offset = int(f.read().strip() or 0)
+        from ..utils.aiofile import read_file_text
+
+        offset = int((await read_file_text(progress_path)).strip() or 0)
 
     source = FilerSource(server_address.grpc_address(args.source_filer))
     if args.target_remote:

@@ -39,14 +39,23 @@ async def run(args) -> None:
     if not targets:
         raise SystemExit(f"no matching volumes under {args.dir}")
     bad = 0
+    import asyncio
+
     for collection, vid in targets:
         base = Volume.base_name(args.dir, vid, collection)
-        with open(base + ".dat", "rb") as f:
-            sb = SuperBlock.from_bytes(f.read(SUPER_BLOCK_SIZE))
-        try:
-            n = verify_index_integrity(
-                base + ".dat", base + ".idx", sb.version
+
+        # the whole per-volume check runs in one to_thread: the index
+        # sweep is a per-needle seek/read pass over the .dat file, far
+        # more loop-blocking than the 8-byte superblock read before it
+        def _check(path=base):
+            with open(path + ".dat", "rb") as f:
+                sb = SuperBlock.from_bytes(f.read(SUPER_BLOCK_SIZE))
+            return verify_index_integrity(
+                path + ".dat", path + ".idx", sb.version
             )
+
+        try:
+            n = await asyncio.to_thread(_check)
             print(f"volume {vid} ({collection or 'default'}): OK, {n} needles")
         except ValueError as e:
             bad += 1

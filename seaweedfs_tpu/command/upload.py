@@ -24,10 +24,11 @@ async def run(args) -> None:
 
     from ..operation import assign, upload_data
 
+    from ..utils.aiofile import read_file_bytes
+
     results = []
     for path in args.files:
-        with open(path, "rb") as f:
-            data = f.read()
+        data = await read_file_bytes(path)
         a = await assign(
             args.master,
             collection=args.collection,

@@ -366,12 +366,16 @@ class AbstractSqlStore(FilerStore):
         self._conn().execute("ROLLBACK")
 
     def shutdown(self):
+        import logging
+
         with self._conns_lock:
             for c in self._conns:
                 try:
                     c.close()
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as e:  # noqa: BLE001
+                    logging.getLogger("filer").debug(
+                        "sqlite connection close failed at shutdown: %s", e
+                    )
             self._conns.clear()
 
 

@@ -179,8 +179,8 @@ class FuseConnection:
     def close(self) -> None:
         try:
             asyncio.get_event_loop().remove_reader(self.fd)
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as e:  # noqa: BLE001 — loop already closed
+            log.debug("fuse fd reader removal failed at close: %s", e)
         try:
             os.close(self.fd)
         except OSError:

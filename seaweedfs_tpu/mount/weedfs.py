@@ -402,8 +402,9 @@ class WeedFS:
             if cached[0] > 0:
                 total = cached[0] * 1024 * 1024
                 used = cached[1]
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception as e:  # noqa: BLE001 — filer unreachable:
+            # statfs falls back to the unbounded defaults
+            log.debug("statfs quota probe failed: %s", e)
         bsize = 4096
         blocks = max(total // bsize, 1)
         bfree = max((total - used) // bsize, 0)

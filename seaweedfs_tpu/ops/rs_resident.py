@@ -2003,6 +2003,9 @@ def scrub_volume(
         a_blk = _prepared_blockdiag_matrix(
             parity_m.tobytes(), *parity_m.shape, cache.groups
         )
+        # graftlint: allow(device-sync): deliberate D2H of the tiny
+        # [p, n_seg] int32 mismatch partials — the whole point of scrub
+        # is that only this verdict leaves the device
         partials = np.asarray(
             _scrub_call_blockdiag(
                 a_blk, data, parity,
@@ -2013,6 +2016,8 @@ def scrub_volume(
     else:
         n_lanes = -(-true_size // LANE) * LANE
         a_bm = _prepared_matrix(parity_m.tobytes(), *parity_m.shape)
+        # graftlint: allow(device-sync): deliberate D2H of the tiny
+        # [p, n_seg] int32 mismatch partials (see blockdiag branch)
         partials = np.asarray(
             _scrub_call(
                 a_bm, data, parity,
@@ -2179,6 +2184,9 @@ def scrub_all_resident(
             vols = 1 << (len(chunk) - 1).bit_length()
             padded = chunk + [chunk[0]] * (vols - len(chunk))
             flat = tuple(s for _vid, shards in padded for s in shards)
+            # graftlint: allow(device-sync): deliberate D2H — the
+            # [V, p, n_seg] mismatch partials are the megakernel's only
+            # output, host-reduced to per-volume verdict bitmaps
             partials = np.asarray(
                 _scrub_all_call(
                     a_blk, flat, n_lanes=n_lanes, groups=groups,

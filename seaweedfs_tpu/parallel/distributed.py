@@ -273,8 +273,12 @@ def _staged_worker_main(argv) -> None:
     jax.config.update("jax_platforms", "cpu")
     try:  # cross-process CPU collectives
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
-    except Exception:  # noqa: BLE001 — older jax: default impl
-        pass
+    except Exception as e:  # noqa: BLE001 — older jax: default impl
+        import logging
+
+        logging.getLogger("parallel").debug(
+            "gloo CPU collectives unavailable (older jax?): %s", e
+        )
     jax.distributed.initialize(
         coordinator_address=args.coordinator,
         num_processes=args.nproc,

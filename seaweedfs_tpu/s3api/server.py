@@ -186,8 +186,8 @@ class S3ApiServer:
             await self._load_iam_from_filer()
         try:
             await self._load_cb_from_filer()
-        except Exception:  # noqa: BLE001 — filer may not be up yet
-            pass
+        except Exception as e:  # noqa: BLE001 — filer may not be up yet
+            log.debug("initial circuit-breaker config load failed: %s", e)
         self._iam_refresh = asyncio.create_task(self._iam_refresh_loop())
         app = web.Application(client_max_size=1024 * 1024 * 1024)
         from .. import obs

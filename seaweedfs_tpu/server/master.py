@@ -457,8 +457,17 @@ class MasterServer:
                         registered = (req.client_type, req.client_address)
                         registered_ts = time.time_ns()
                         self.cluster_nodes[registered] = registered_ts
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001
+                # a broken keep-connected stream is routine (client
+                # restart, network blip) but must not vanish silently:
+                # the telemetry plane reads liveness off these streams
+                from .. import obs
+
+                cur = obs.current()
+                log.debug(
+                    "keep-connected drain from %s ended (trace=%s): %s",
+                    registered, cur[0].trace_id if cur else "-", e,
+                )
             finally:
                 q.put_nowait(None)
 

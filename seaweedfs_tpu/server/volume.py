@@ -1488,10 +1488,15 @@ class VolumeServer:
             await context.abort(grpc.StatusCode.NOT_FOUND, f"{path} not found")
         stop = request.stop_offset or os.path.getsize(path)
         chunk = 1024 * 1024
-        with open(path, "rb") as f:
+        # open + the 1MB reads go through to_thread: a multi-GB shard
+        # copy must not stall the event loop (heartbeats, EC reads)
+        # between its disk reads
+        from ..utils.aiofile import open_in_thread
+
+        async with open_in_thread(path, "rb") as f:
             sent = 0
             while sent < stop:
-                buf = f.read(min(chunk, stop - sent))
+                buf = await asyncio.to_thread(f.read, min(chunk, stop - sent))
                 if not buf:
                     break
                 sent += len(buf)
@@ -1502,8 +1507,10 @@ class VolumeServer:
         stub = Stub(channel(source_grpc), volume_server_pb2, "VolumeServer")
         tmp = dest_path + ".tmp"
         got_any = False
+        from ..utils.aiofile import open_in_thread
+
         try:
-            with open(tmp, "wb") as f:
+            async with open_in_thread(tmp, "wb") as f:
                 async for resp in stub.CopyFile(
                     volume_server_pb2.CopyFileRequest(
                         volume_id=vid,
@@ -1513,7 +1520,7 @@ class VolumeServer:
                     )
                 ):
                     got_any = True
-                    f.write(resp.file_content)
+                    await asyncio.to_thread(f.write, resp.file_content)
         except grpc.aio.AioRpcError:
             if os.path.exists(tmp):
                 os.remove(tmp)

@@ -264,14 +264,28 @@ async def cmd_fs_meta_save(env, args):
     out_path = flags.get("o", "filer-meta.bin")
     stub = await _stub(env)
     n = 0
-    with open(out_path, "wb") as f:
+    import asyncio
+
+    from ..utils.aiofile import open_in_thread
+
+    # file IO via to_thread: the shell shares its loop with the
+    # in-flight ListEntries stream feeding _walk_entries.  Records are
+    # buffered and flushed in ~1MB slabs — one executor hop per slab,
+    # not two per entry
+    buf = bytearray()
+    async with open_in_thread(out_path, "wb") as f:
         async for d, e in _walk_entries(stub, root or "/"):
             fe = filer_pb2.FullEntry(dir=d, entry=e)
             blob = fe.SerializeToString()
             # big-endian length prefix: byte-compatible with the
             # reference's fs.meta.save files (util.Uint32toBytes)
-            f.write(struct.pack(">I", len(blob)) + blob)
+            buf += struct.pack(">I", len(blob)) + blob
             n += 1
+            if len(buf) >= 1 << 20:
+                await asyncio.to_thread(f.write, bytes(buf))
+                buf.clear()
+        if buf:
+            await asyncio.to_thread(f.write, bytes(buf))
     env.write(f"saved {n} entries from {root or '/'} to {out_path}")
 
 
@@ -291,13 +305,31 @@ async def cmd_fs_meta_load(env, args):
         return
     stub = await _stub(env)
     n = 0
-    with open(in_path, "rb") as f:
+    import asyncio
+
+    from ..utils.aiofile import open_in_thread
+
+    # stream in ~1MB slabs through to_thread and parse records from the
+    # rolling buffer: one executor hop per slab (not two per entry) and
+    # constant memory even for multi-GB backups
+    async with open_in_thread(in_path, "rb") as f:
+        buf = b""
+        eof = False
         while True:
-            hdr = f.read(4)
-            if len(hdr) < 4:
+            while not eof and (
+                len(buf) < 4 or len(buf) < 4 + struct.unpack(
+                    ">I", buf[:4]
+                )[0]
+            ):
+                chunk = await asyncio.to_thread(f.read, 1 << 20)
+                if not chunk:
+                    eof = True
+                    break
+                buf += chunk
+            if len(buf) < 4:
                 break
-            (size,) = struct.unpack(">I", hdr)
-            blob = f.read(size)
+            (size,) = struct.unpack(">I", buf[:4])
+            blob, buf = buf[4 : 4 + size], buf[4 + size :]
             if len(blob) < size:
                 env.write(
                     f"warning: truncated backup — last record dropped"

@@ -26,7 +26,9 @@ from __future__ import annotations
 import math
 import threading
 import time
+from collections.abc import Sequence
 from dataclasses import dataclass, field
+from typing import Any
 
 from prometheus_client import Gauge
 
@@ -111,7 +113,9 @@ CLUSTER_STAGE_P99 = Gauge(
 
 
 def quantile_from_buckets(
-    counts, q: float, edges=STAGE_SECONDS_BUCKETS
+    counts: Sequence[float],
+    q: float,
+    edges: Sequence[float] = STAGE_SECONDS_BUCKETS,
 ) -> float | None:
     """Linear-interpolation quantile estimate from per-bucket counts
     (len(edges) + 1, last bucket = +Inf overflow).  The overflow bucket
@@ -156,11 +160,11 @@ class NodeTelemetry:
     overlap_fraction: float = 0.0
     ec_h2d_bytes: int = 0
     ec_d2h_bytes: int = 0
-    resident_by_volume: dict = field(default_factory=dict)
+    resident_by_volume: dict[int, int] = field(default_factory=dict)
 
-    def to_dict(self, now: float, stale_after: float) -> dict:
+    def to_dict(self, now: float, stale_after: float) -> dict[str, Any]:
         age = now - self.last_seen
-        d = {
+        d: dict[str, Any] = {
             "age_seconds": round(age, 3),
             "stale": bool(age > stale_after),
             "connected": self.connected,
@@ -194,6 +198,16 @@ class NodeTelemetry:
         return d
 
 
+@dataclass
+class _StageAgg:
+    """Cluster-merged digest for one stage: per-bucket counts (fixed
+    ladder + trailing +Inf overflow), total count, total seconds."""
+
+    buckets: list[int]
+    count: int
+    sum_seconds: float
+
+
 class ClusterTelemetry:
     """Aggregates heartbeat telemetry into the master's health plane.
 
@@ -206,7 +220,7 @@ class ClusterTelemetry:
         pulse_seconds: float,
         stale_after_pulses: float = 2.0,
         retention_seconds: float = 3600.0,
-    ):
+    ) -> None:
         self.pulse_seconds = pulse_seconds
         self.stale_after = stale_after_pulses * pulse_seconds
         # a DISCONNECTED node's last snapshot is kept this long past its
@@ -216,12 +230,16 @@ class ClusterTelemetry:
         self.retention_seconds = max(retention_seconds, self.stale_after)
         self._lock = threading.Lock()
         self._nodes: dict[str, NodeTelemetry] = {}
-        # stage -> ([per-bucket counts incl +Inf], count, sum_seconds)
-        self._stages: dict[str, list] = {}
+        self._stages: dict[str, _StageAgg] = {}
 
     # -------------------------------------------------------------- intake
 
-    def observe(self, node_url: str, tel=None, now: float | None = None) -> None:
+    def observe(
+        self,
+        node_url: str,
+        tel: Any | None = None,
+        now: float | None = None,
+    ) -> None:
         """Record one heartbeat from `node_url`; `tel` is the pb
         VolumeServerTelemetry (None for pre-telemetry servers — the
         pulse still refreshes freshness)."""
@@ -258,7 +276,7 @@ class ClusterTelemetry:
             n_buckets = len(STAGE_SECONDS_BUCKETS) + 1
             for d in tel.stage_digests:
                 merged = self._stages.setdefault(
-                    d.stage, [[0] * n_buckets, 0, 0.0]
+                    d.stage, _StageAgg([0] * n_buckets, 0, 0.0)
                 )
                 # tolerate a ladder drift between versions, preserving
                 # the +Inf overflow semantics in BOTH directions: the
@@ -280,9 +298,9 @@ class ClusterTelemetry:
                             + [counts[-1]]
                         )
                 for i, c in enumerate(counts):
-                    merged[0][i] += c
-                merged[1] += d.count
-                merged[2] += d.sum_seconds
+                    merged.buckets[i] += c
+                merged.count += d.count
+                merged.sum_seconds += d.sum_seconds
 
     def disconnect(self, node_url: str) -> None:
         """Heartbeat stream broke: keep the last snapshot (the operator
@@ -319,7 +337,8 @@ class ClusterTelemetry:
             self._prune(now)
             nodes = dict(self._nodes)
             stages = {
-                s: (list(v[0]), v[1], v[2]) for s, v in self._stages.items()
+                s: (list(v.buckets), v.count, v.sum_seconds)
+                for s, v in self._stages.items()
             }
         for g in (
             CLUSTER_DEVICE_BUDGET, CLUSTER_DEVICE_USED,
@@ -367,17 +386,18 @@ class ClusterTelemetry:
         (tests cross-check this against the per-server histograms)."""
         with self._lock:
             rec = self._stages.get(stage)
-            buckets = list(rec[0]) if rec else None
+            buckets = list(rec.buckets) if rec is not None else None
         return quantile_from_buckets(buckets, q) if buckets else None
 
-    def health(self, now: float | None = None) -> dict:
+    def health(self, now: float | None = None) -> dict[str, Any]:
         """The /cluster/health.json document."""
         now = time.time() if now is None else now
         with self._lock:
             self._prune(now)
             nodes = {url: nt for url, nt in self._nodes.items()}
             stages = {
-                s: (list(v[0]), v[1], v[2]) for s, v in self._stages.items()
+                s: (list(v.buckets), v.count, v.sum_seconds)
+                for s, v in self._stages.items()
             }
         node_docs = {
             url: nt.to_dict(now, self.stale_after)
@@ -391,7 +411,7 @@ class ClusterTelemetry:
         for url, nt in sorted(nodes.items()):
             for vid, n in nt.resident_by_volume.items():
                 residency.setdefault(str(vid), {})[url] = n
-        stage_docs = {}
+        stage_docs: dict[str, dict[str, Any]] = {}
         for stage, (buckets, count, sum_s) in sorted(stages.items()):
             p50 = quantile_from_buckets(buckets, 0.50)
             p99 = quantile_from_buckets(buckets, 0.99)

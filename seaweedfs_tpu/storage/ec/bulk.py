@@ -197,6 +197,8 @@ class Codec:
             )
             seg = b // groups
             parity = self._tpu.unstack_segments(
+                # graftlint: allow(device-sync): the codec worker's own
+                # D2H — fetched on the dedicated device leg, timed busy_s
                 np.asarray(out).reshape(groups * self.rows, seg), self.rows
             )
         else:
@@ -209,6 +211,7 @@ class Codec:
                 kernel=self.backend,
                 interpret=self._interpret,
             )
+            # graftlint: allow(device-sync): codec-leg D2H (see above)
             parity = np.asarray(out).reshape(self.rows, b)
         self.busy_s += time.perf_counter() - t0
         return parity

@@ -1,0 +1,61 @@
+"""Tiny async wrappers for whole-file reads/writes from event-loop code.
+
+graftlint's async-blocking rule (GL101) bans bare `open()` inside
+`async def`: even a small metadata read stalls every coroutine sharing
+the loop (concurrent CLI uploads, a server's heartbeats).  These helpers
+are the one-liner fix for the whole-file cases; streaming call sites
+wrap their own open/read/write calls in asyncio.to_thread directly.
+"""
+from __future__ import annotations
+
+import asyncio
+import contextlib
+
+
+def _read_bytes(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def _read_text(path: str) -> str:
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+def _write_bytes(path: str, data: bytes) -> None:
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+def _write_text(path: str, text: str) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(text)
+
+
+async def read_file_bytes(path: str) -> bytes:
+    return await asyncio.to_thread(_read_bytes, path)
+
+
+async def read_file_text(path: str) -> str:
+    return await asyncio.to_thread(_read_text, path)
+
+
+async def write_file_bytes(path: str, data: bytes) -> None:
+    await asyncio.to_thread(_write_bytes, path, data)
+
+
+async def write_file_text(path: str, text: str) -> None:
+    await asyncio.to_thread(_write_text, path, text)
+
+
+@contextlib.asynccontextmanager
+async def open_in_thread(path: str, mode: str = "r", **kw):
+    """`async with open_in_thread(p, "rb") as f:` — open and close run
+    in to_thread; the caller dispatches each read/write the same way
+    (`await asyncio.to_thread(f.read, n)`).  The shared form of the
+    streaming pattern the whole-file helpers above don't cover."""
+    f = await asyncio.to_thread(open, path, mode, **kw)
+    try:
+        yield f
+    finally:
+        await asyncio.to_thread(f.close)

@@ -44,8 +44,10 @@ class MasterClient:
             self._task.cancel()
             try:
                 await self._task
-            except (asyncio.CancelledError, Exception):
+            except asyncio.CancelledError:
                 pass
+            except Exception as e:  # noqa: BLE001
+                log.debug("keep-connected task ended with: %s", e)
 
     async def _keep_connected(self) -> None:
         i = 0

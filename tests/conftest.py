@@ -24,3 +24,23 @@ os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests spawn
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _lockwatch_sweep():
+    """Opt-in suite-wide lock-order sweep: SWFS_LOCKWATCH=1 instruments
+    every lock the suite creates (tests/lockwatch.py) and fails the run
+    at teardown on any observed acquisition-order cycle — the dynamic
+    complement of graftlint's static GL104 that reaches through
+    callbacks and executor hops.  Off by default: instrumenting every
+    stdlib lock adds measurable overhead to the full tier-1 run."""
+    if os.environ.get("SWFS_LOCKWATCH") != "1":
+        yield
+        return
+    import lockwatch
+
+    with lockwatch.watch() as w:
+        yield
+    w.assert_no_cycles()

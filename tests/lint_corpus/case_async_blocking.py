@@ -1,0 +1,33 @@
+"""Seeded GL101 violations: blocking calls inside `async def`."""
+import asyncio
+import time
+
+
+async def seeded_sleep_in_handler() -> None:
+    time.sleep(0.5)  # GL101: blocks the event loop
+
+
+async def seeded_sync_file_io(path: str) -> bytes:
+    with open(path, "rb") as f:  # GL101: sync IO on the loop thread
+        return f.read()
+
+
+async def seeded_future_wait(fut) -> object:
+    return fut.result()  # GL101: sync wait on a concurrent.futures future
+
+
+async def seeded_handle_read(path: str) -> bytes:
+    f = await asyncio.to_thread(open, path, "rb")  # handle bound safely
+    data = f.read()  # GL101: sync read on the held handle
+    await asyncio.to_thread(f.close)  # NOT a violation: reference only
+    return data
+
+
+async def seeded_timed_future_wait(fut) -> object:
+    return fut.result(timeout=5)  # GL101: bounded, still blocks the loop
+
+
+async def fine_to_thread(path: str) -> str:
+    # NOT a violation: dispatched off the loop; the lambda body is a
+    # nested scope the rule deliberately does not descend into
+    return await asyncio.to_thread(lambda: open(path).read())

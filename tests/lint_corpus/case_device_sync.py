@@ -1,0 +1,24 @@
+"""Seeded GL102 violations: implicit device->host syncs on the hot path
+(this directory is in HOT_PATH_PARTS precisely so these fire)."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def seeded_asarray_fetch(device_arr):
+    return np.asarray(device_arr)  # GL102: implicit D2H outside a span
+
+
+def seeded_scalar_item(device_arr):
+    return device_arr.item()  # GL102: synchronous scalar fetch
+
+
+def seeded_truthiness_branch(a, b):
+    if jnp.any(a != b):  # GL102: branching forces a blocking sync
+        return 1
+    return 0
+
+
+def fine_spanned_fetch(obs, device_arr):
+    # NOT a violation: the d2h is explicit and traced
+    with obs.span("d2h_copy"):
+        return np.asarray(device_arr)

@@ -1,0 +1,24 @@
+"""Seeded GL103 violations: jit static/donate args vs the signature."""
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("not_a_param",))
+def seeded_unknown_static_name(x, y):
+    return x + y
+
+
+@functools.partial(jax.jit, static_argnums=(5,))
+def seeded_out_of_range_static(x, y):
+    return x * y
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(0,))
+def seeded_static_and_donated(x, y):
+    return x - y
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def fine_static_name(x, n):
+    return x.reshape(n, -1)

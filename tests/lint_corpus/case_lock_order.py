@@ -1,0 +1,33 @@
+"""Seeded GL104 violations: an AB/BA lock-order cycle and a
+non-reentrant self-reacquire (this directory is in LOCK_SCOPE_PARTS
+precisely so these fire)."""
+import threading
+
+
+class SeededInvertedPair:
+    def __init__(self) -> None:
+        self._cache_lock = threading.Lock()
+        self._pipeline_lock = threading.Lock()
+
+    def evict(self) -> None:
+        with self._cache_lock:  # A then B
+            with self._pipeline_lock:
+                pass
+
+    def submit(self) -> None:
+        with self._pipeline_lock:  # B then A — GL104 cycle
+            with self._cache_lock:
+                pass
+
+
+class SeededSelfDeadlock:
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+
+    def outer(self) -> None:
+        with self._mu:
+            self.inner()  # GL104: re-acquires the held non-reentrant Lock
+
+    def inner(self) -> None:
+        with self._mu:
+            pass

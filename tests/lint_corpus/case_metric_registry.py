@@ -1,0 +1,13 @@
+"""Seeded GL105 violations: unregistered / out-of-place series."""
+from prometheus_client import Gauge
+
+
+def seeded_unregistered_literal(registry):
+    # GL105: no such series pre-registered in stats/
+    return registry.get("SeaweedFS_totally_bogus_series_total")
+
+
+# GL105: SeaweedFS_* series declared outside stats/metrics.py|cluster.py
+SEEDED_STRAY_DECL = Gauge(
+    "SeaweedFS_stray_decl_outside_stats", "declared in the wrong module"
+)

@@ -1,0 +1,41 @@
+"""Stub 'generated' module for the seeded GL107 fixture.
+
+The proto-drift rule only reads DESCRIPTOR metadata (message names,
+field name->number maps, nested types), so a tiny duck-typed stand-in
+is enough — no protobuf runtime or protoc needed, which also keeps the
+corpus honest in containers without grpc_tools.  The maps here
+deliberately disagree with drift.proto.
+"""
+
+
+class _Options:
+    map_entry = False
+
+
+class _Field:
+    def __init__(self, name: str, number: int) -> None:
+        self.name = name
+        self.number = number
+
+
+class _Message:
+    def __init__(self, name: str, fields, nested=()):
+        self.name = name
+        self.fields = [_Field(n, num) for n, num in fields]
+        self.nested_types = list(nested)
+
+    def GetOptions(self) -> _Options:
+        return _Options()
+
+
+class _Descriptor:
+    message_types_by_name = {
+        "DriftMsg": _Message(
+            "DriftMsg",
+            [("good", 1), ("drifted", 9), ("only_in_pb2", 4)],
+        ),
+        "OnlyInPb2Msg": _Message("OnlyInPb2Msg", [("x", 1)]),
+    }
+
+
+DESCRIPTOR = _Descriptor()

@@ -1,0 +1,32 @@
+"""Seeded GL108 violations: broad excepts that swallow silently."""
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def seeded_bare_swallow(fn):
+    try:
+        fn()
+    except Exception:  # GL108: error vanishes without a log line
+        pass
+
+
+def seeded_base_exception_swallow(fn):
+    try:
+        fn()
+    except (ValueError, BaseException):  # GL108
+        pass
+
+
+def fine_logged_broad(fn):
+    try:
+        fn()
+    except Exception:  # logged: no finding
+        log.debug("fn failed", exc_info=True)
+
+
+def fine_narrow(fn):
+    try:
+        fn()
+    except ValueError:  # narrow: no finding
+        pass

@@ -1,0 +1,15 @@
+"""Seeded GL106 violations: trace stages missing from TRACE_STAGES."""
+
+
+def seeded_unknown_span_stage(obs):
+    with obs.span("bogus_stage"):  # GL106: not in TRACE_STAGES
+        pass
+
+
+def seeded_unknown_record_span(trace):
+    trace.record_span(trace, "another_bogus_stage", 0.0)  # GL106
+
+
+def fine_known_stage(obs):
+    with obs.span("device_execute"):  # registered stage: no finding
+        pass

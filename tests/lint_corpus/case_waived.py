@@ -1,0 +1,8 @@
+"""A violation WITH a waiver: must produce ZERO findings — proves the
+waiver channel suppresses exactly what it names."""
+import time
+
+
+async def waived_sleep() -> None:
+    # graftlint: allow(async-blocking): seeded waiver-channel fixture
+    time.sleep(0.01)

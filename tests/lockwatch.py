@@ -1,0 +1,255 @@
+"""Runtime lock-order harness — the dynamic complement of graftlint's
+static GL104 rule.
+
+Static analysis follows names; it cannot finish the job across
+callbacks, executor hops, and locks handed around as objects.  This
+harness closes that gap at test time: `watch()` monkeypatches
+`threading.Lock/RLock/Condition` so every lock CREATED inside the
+context is instrumented, records the actual acquisition-order graph
+(per-thread held-stack -> edges), and `assert_no_cycles()` fails the
+test on any observed AB/BA inversion.  A blocking re-acquire of a held
+non-reentrant Lock raises immediately instead of hanging the suite.
+
+Identities aggregate by ALLOCATION SITE (file:line of the constructor
+call), the same granularity the static pass uses for `self._lock = ...`
+— so two DeviceShardCache instances share one node and an inversion
+between *instances* of the same pair still shows up.  Locks allocated
+outside the repo tree (stdlib queues, executors) are delegated to but
+not recorded: they only add noise the static rule scopes out too.
+
+Usage:
+
+    with lockwatch.watch() as w:
+        ... exercise the code under test (threads welcome) ...
+    w.assert_no_cycles()
+
+Suite-wide sweep (opt-in, see tests/conftest.py):
+    SWFS_LOCKWATCH=1 pytest tests/
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import traceback
+from collections import defaultdict
+from typing import Iterator
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_THIS_FILE = os.path.abspath(__file__)
+
+# the real constructors, captured at import time so the harness's own
+# bookkeeping never recurses through the instrumentation
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_CONDITION = threading.Condition
+
+
+class LockOrderViolation(AssertionError):
+    """An observed lock-order cycle or a self-deadlocking re-acquire."""
+
+
+def _allocation_site() -> tuple[str, int]:
+    """file:line of the nearest caller frame outside this module.
+
+    Deliberately does NOT skip stdlib frames: a lock constructed inside
+    threading.Event or queue.Queue resolves to the stdlib file, fails
+    `_interesting`, and is delegated-but-not-recorded — exactly the
+    documented contract.  Only direct `threading.Lock()` calls in repo
+    code resolve to a repo site and join the order graph."""
+    for frame in reversed(traceback.extract_stack()):
+        fn = os.path.abspath(frame.filename)
+        if fn == _THIS_FILE:
+            continue
+        return frame.filename, frame.lineno or 0
+    return "<unknown>", 0
+
+
+def _interesting(path: str) -> bool:
+    p = os.path.abspath(path)
+    return p.startswith(_REPO_ROOT) and "site-packages" not in p
+
+
+class _WatchedLock:
+    """Instrumented stand-in for Lock/RLock: delegates everything,
+    reports acquire/release to the watch."""
+
+    def __init__(self, watch: "LockWatch", kind: str, key: str,
+                 record: bool) -> None:
+        self._watch = watch
+        self._kind = kind          # "Lock" | "RLock"
+        self.key = key             # "file:line" allocation site
+        self._record = record
+        self._real = _REAL_LOCK() if kind == "Lock" else _REAL_RLOCK()
+
+    # -- lock protocol -------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if self._record:
+            self._watch.note_attempt(self, blocking)
+        ok = self._real.acquire(blocking, timeout)
+        if ok and self._record:
+            self._watch.note_acquired(self)
+        return ok
+
+    def release(self) -> None:
+        self._real.release()
+        if self._record:
+            self._watch.note_released(self)
+
+    def locked(self) -> bool:
+        return self._real.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    # RLock-only introspection threading.Condition prefers when present;
+    # delegating keeps Condition's owned-check correct for RLocks.
+    # (_release_save/_acquire_restore are deliberately NOT delegated:
+    # Condition must fall back to plain acquire()/release() so waits
+    # stay visible to the held-stack tracking.)
+    def _is_owned(self) -> bool:
+        if hasattr(self._real, "_is_owned"):
+            return self._real._is_owned()
+        return self._real.locked()
+
+    def _at_fork_reinit(self) -> None:
+        self._real._at_fork_reinit()
+
+
+class LockWatch:
+    def __init__(self) -> None:
+        self._mu = _REAL_LOCK()
+        # per-thread held stacks, keyed by thread id and guarded by _mu
+        # (not thread-locals): threading.Lock legally supports acquire
+        # in one thread / release in another, so a release must be able
+        # to find the entry on the ACQUIRING thread's stack
+        self._stacks: dict[int, list[_WatchedLock]] = {}
+        # (held_key, acquired_key) -> (thread name, acquire file:line)
+        self.edges: dict[tuple[str, str], tuple[str, str]] = {}
+        self.acquired_keys: set[str] = set()
+        self.violations: list[str] = []
+
+    # ------------------------------------------------------ recording
+    def _held(self) -> list[_WatchedLock]:
+        with self._mu:
+            return self._stacks.setdefault(threading.get_ident(), [])
+
+    def note_attempt(self, lock: _WatchedLock, blocking: bool) -> None:
+        """Pre-acquire check only: a blocking re-acquire of a held
+        non-reentrant Lock raises here instead of deadlocking the
+        suite.  Order EDGES are recorded on SUCCESS (note_acquired) —
+        a failed `acquire(blocking=False)` probe is the canonical
+        deadlock-AVOIDANCE pattern and must not fabricate an edge."""
+        if (
+            blocking
+            and lock._kind == "Lock"
+            and any(h is lock for h in self._held())
+        ):
+            site = "%s:%d" % _allocation_site()
+            msg = (
+                f"non-reentrant Lock {lock.key} re-acquired while held "
+                f"by the same thread (at {site}) — this WOULD deadlock"
+            )
+            with self._mu:
+                self.violations.append(msg)
+            raise LockOrderViolation(msg)
+
+    def note_acquired(self, lock: _WatchedLock) -> None:
+        held = self._held()
+        new_edges = [
+            (h.key, lock.key) for h in held
+            if h.key != lock.key and h is not lock
+        ]
+        site = "%s:%d" % _allocation_site() if new_edges else ""
+        thread = threading.current_thread().name
+        held.append(lock)
+        with self._mu:
+            self.acquired_keys.add(lock.key)
+            for e in new_edges:
+                self.edges.setdefault(e, (thread, site))
+
+    def note_released(self, lock: _WatchedLock) -> None:
+        # common case: released by the acquiring thread (its own stack
+        # tail); else scan the other threads' stacks for the handoff
+        # pattern so no stale "held" entry poisons later edges
+        ident = threading.get_ident()
+        with self._mu:
+            stacks = [self._stacks.get(ident)] + [
+                s for t, s in self._stacks.items() if t != ident
+            ]
+            for held in stacks:
+                if not held:
+                    continue
+                for i in range(len(held) - 1, -1, -1):
+                    if held[i] is lock:
+                        del held[i]
+                        return
+
+    # ------------------------------------------------------- verdicts
+    def cycles(self) -> list[list[str]]:
+        from tools.graftlint.locks import cycles_from_edges
+
+        graph: dict[str, set] = defaultdict(set)
+        with self._mu:
+            for a, b in self.edges:
+                graph[a].add(b)
+        return cycles_from_edges(graph)
+
+    def assert_no_cycles(self) -> None:
+        problems = list(self.violations)
+        with self._mu:
+            sites = dict(self.edges)
+        for cyc in self.cycles():
+            legs = " -> ".join(cyc)
+            where = ", ".join(
+                f"{a}->{b} ({thread} at {site})"
+                for (a, b), (thread, site) in sites.items()
+                if a in cyc and b in cyc
+            )
+            problems.append(
+                f"observed lock acquisition-order cycle: {legs} [{where}]"
+            )
+        if problems:
+            raise LockOrderViolation("; ".join(problems))
+
+
+def _make_condition(watch: "LockWatch"):
+    def condition(lock=None):
+        # an unsupplied lock becomes a watched RLock allocated at the
+        # Condition() call site, so waits/notifies join the order graph
+        if lock is None:
+            path, line = _allocation_site()
+            lock = _WatchedLock(
+                watch, "RLock", f"{path}:{line}", _interesting(path)
+            )
+        return _REAL_CONDITION(lock)
+    return condition
+
+
+def _make_lock_factory(watch: "LockWatch", kind: str):
+    def factory():
+        path, line = _allocation_site()
+        return _WatchedLock(
+            watch, kind, f"{path}:{line}", _interesting(path)
+        )
+    return factory
+
+
+@contextlib.contextmanager
+def watch() -> Iterator[LockWatch]:
+    """Instrument every lock constructed inside the context.  Locks
+    created BEFORE entry keep their real classes (module-level locks in
+    already-imported modules are out of scope — the static pass owns
+    those); restore is unconditional on exit."""
+    w = LockWatch()
+    saved = (threading.Lock, threading.RLock, threading.Condition)
+    threading.Lock = _make_lock_factory(w, "Lock")       # type: ignore
+    threading.RLock = _make_lock_factory(w, "RLock")     # type: ignore
+    threading.Condition = _make_condition(w)             # type: ignore
+    try:
+        yield w
+    finally:
+        threading.Lock, threading.RLock, threading.Condition = saved

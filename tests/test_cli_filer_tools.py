@@ -272,8 +272,11 @@ def test_filer_replicate_from_spool(tmp_path):
                     f"{dst_cluster.filer.url}.{dst_cluster.filer.grpc_port}",
                 ],
             )
-            with open(spool + ".replicate_offset") as f:
-                assert int(f.read()) == os.path.getsize(spool)
+            from seaweedfs_tpu.utils.aiofile import read_file_text
+
+            assert int(
+                await read_file_text(spool + ".replicate_offset")
+            ) == os.path.getsize(spool)
         finally:
             await src_cluster.stop()
             await dst_cluster.stop()

@@ -158,7 +158,7 @@ def test_digest_ladder_drift_preserves_overflow():
     d.count = 4
     ct.observe("a:1", tel, now=100.0)
     with ct._lock:
-        buckets = list(ct._stages["queue_wait"][0])
+        buckets = list(ct._stages["queue_wait"].buckets)
     assert buckets[0] == 1 and buckets[-1] == 3 and sum(buckets) == 4
     # overflow surfaces as the health doc's p99-is-a-floor flag
     assert ct.health(now=100.0)["cluster"]["stages"]["queue_wait"]["overflow"] == 3
@@ -170,7 +170,7 @@ def test_digest_ladder_drift_preserves_overflow():
     d2.count = len(stats.STAGE_SECONDS_BUCKETS) + 5
     ct.observe("a:1", tel2, now=100.0)
     with ct._lock:
-        buckets = list(ct._stages["shard_read"][0])
+        buckets = list(ct._stages["shard_read"].buckets)
     assert sum(buckets) == d2.count and buckets[-1] == 5
 
 

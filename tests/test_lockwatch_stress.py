@@ -1,0 +1,183 @@
+"""Lockwatch-instrumented stress: DeviceShardCache budget eviction
+racing an in-flight DevicePipeline batch and a concurrent warm() AOT
+compile — the exact cross-locking triangle graftlint's static GL104
+models (cache._lock, pipeline._cond, the warm executor).
+
+Two invariants under the race:
+  * no observed lock acquisition-order cycle (the dynamic complement of
+    the static rule: these schedules actually interleave the locks);
+  * no stale bytes — every reconstruct that SUCCEEDS is byte-exact
+    against the oracle; a read that loses its shards mid-flight fails
+    with a clean CacheMiss/ColdShape, never silent corruption.
+
+Instance locks are created inside `lockwatch.watch()` (the cache is
+constructed there), so they are instrumented; module-level locks born
+at import time stay real and are the static pass's job.  All device
+work runs on the CPU test mesh (conftest), xla kernels only — the warm
+grid is one tiny shape so CI never pays a TPU-scale compile here.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lockwatch
+from seaweedfs_tpu.ops import rs, rs_resident
+
+VID = 21
+MISSING_SID = 3
+SHARD_LEN = 100_000
+
+
+@pytest.fixture(scope="module")
+def coded():
+    rng = np.random.default_rng(23)
+    codec = rs.RSCodec(backend="numpy")
+    data = rng.integers(0, 256, size=(10, SHARD_LEN), dtype=np.uint8)
+    return codec.encode_all(data)  # [14, SHARD_LEN]
+
+
+def test_eviction_vs_inflight_batch_vs_warm_no_cycle_no_stale(coded):
+    errors: list[BaseException] = []
+    good_reads = 0
+    clean_misses = 0
+    stop = threading.Event()
+
+    with lockwatch.watch() as w:
+        cache = rs_resident.DeviceShardCache(
+            shard_quantum=1 << 20, layout="blockdiag"
+        )
+        survivors = [s for s in range(14) if s != MISSING_SID]
+        for sid in survivors:
+            cache.put(VID, sid, coded[sid])
+        # budget for 12 of the 13 survivors: every re-pin cycle below
+        # crosses the budget and evicts the LRU shard while reads and
+        # warms are in flight
+        per_shard = cache.bytes_used // len(survivors)
+        cache.budget = per_shard * 12
+
+        lock = threading.Lock()  # plain counters guard (also watched)
+
+        warm_done = threading.Event()
+
+        def reader():
+            nonlocal good_reads, clean_misses
+            reqs_a = [(MISSING_SID, 0, 4096)]
+            reqs_b = [(MISSING_SID, 17, 4096), (MISSING_SID, 50_000, 4096)]
+            want_a = [coded[MISSING_SID][0:4096].tobytes()]
+            want_b = [
+                coded[MISSING_SID][17 : 17 + 4096].tobytes(),
+                coded[MISSING_SID][50_000 : 50_000 + 4096].tobytes(),
+            ]
+            # until the racing warm() finishes, every read can shed
+            # ColdShape — keep reading until it is done AND a few reads
+            # verified, so the test always exercises the success path
+            mine = 0
+            deadline = time.time() + 30
+            i = 0
+            while time.time() < deadline and not (
+                warm_done.is_set() and mine >= 3
+            ):
+                i += 1
+                reqs, want = (reqs_a, want_a) if i % 2 else (reqs_b, want_b)
+                try:
+                    outs = rs_resident.reconstruct_intervals(
+                        cache, VID, reqs
+                    )
+                except rs_resident.CacheMiss:
+                    # shards lost mid-flight or a still-cold AOT shape:
+                    # a CLEAN failure is the contract
+                    with lock:
+                        clean_misses += 1
+                    time.sleep(0.01)
+                    continue
+                except BaseException as e:  # noqa: BLE001 — collected
+                    errors.append(e)
+                    return
+                if outs != want:
+                    errors.append(
+                        AssertionError(f"stale bytes on read {i}")
+                    )
+                    return
+                mine += 1
+                with lock:
+                    good_reads += 1
+
+        def evictor():
+            i = 0
+            while not stop.is_set():
+                sid = survivors[i % len(survivors)]
+                try:
+                    cache.put(VID, sid, coded[sid])
+                except BaseException as e:  # noqa: BLE001 — collected
+                    errors.append(e)
+                    return
+                i += 1
+
+        def warmer():
+            try:
+                for _ in range(2):
+                    rs_resident.warm(
+                        cache, VID, sizes=(4096,), counts=(1, 2),
+                        aot=True, wait=True,
+                    )
+            except BaseException as e:  # noqa: BLE001 — collected
+                errors.append(e)
+            finally:
+                warm_done.set()
+
+        threads = [
+            threading.Thread(target=reader, name="reader"),
+            threading.Thread(target=reader, name="reader2"),
+            threading.Thread(target=evictor, name="evictor"),
+            threading.Thread(target=warmer, name="warmer"),
+        ]
+        for t in threads:
+            t.start()
+        threads[0].join()
+        threads[1].join()
+        threads[3].join()
+        stop.set()
+        threads[2].join()
+
+    assert not errors, errors
+    # the race must actually have exercised both outcomes' machinery:
+    # reads succeeded (bytes verified above), and the instrumented
+    # serving-stack locks were really observed by the harness
+    assert good_reads > 0
+    # the instrumented serving-stack locks (cache._lock, the pipeline's
+    # Condition) really went through the harness — zero EDGES is the
+    # healthy verdict (the stack never holds two of them at once), but
+    # zero ACQUIRES would mean the watch missed the run entirely
+    assert any(
+        "rs_resident" in k for k in w.acquired_keys
+    ), f"serving-stack locks never observed: {sorted(w.acquired_keys)}"
+    w.assert_no_cycles()
+
+
+def test_eviction_under_watch_keeps_counts_consistent(coded):
+    """Sanity on the same instrumented cache: after the dust settles the
+    budget holds and every resident shard still serves exact bytes."""
+    with lockwatch.watch() as w:
+        cache = rs_resident.DeviceShardCache(
+            shard_quantum=1 << 20, layout="blockdiag"
+        )
+        for sid in range(14):
+            cache.put(VID, sid, coded[sid])
+        per_shard = cache.bytes_used // 14
+        cache.budget = per_shard * 10
+        for sid in range(14):  # re-pin cycle forces budget evictions
+            cache.put(VID, sid, coded[sid])
+        assert cache.bytes_used <= cache.budget
+        resident = [
+            sid for sid in range(14)
+            if (VID, sid) in cache._arrays
+        ]
+        assert len(resident) == 10
+        for sid in resident:
+            got = bytes(
+                np.asarray(cache.get(VID, sid))[: SHARD_LEN]
+            )
+            assert got == coded[sid].tobytes()
+    w.assert_no_cycles()

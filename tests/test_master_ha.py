@@ -142,6 +142,7 @@ def test_master_ha_failover(tmp_path):
             for m in masters:
                 try:
                     await m.stop()
+                # graftlint: allow(no-silent-swallow): best-effort teardown
                 except Exception:
                     pass
 
@@ -182,6 +183,7 @@ def test_growth_replicates_vid_ceiling(tmp_path):
             for m in masters:
                 try:
                     await m.stop()
+                # graftlint: allow(no-silent-swallow): best-effort teardown
                 except Exception:
                     pass
 

@@ -191,6 +191,7 @@ def test_mq_notification_broker_restart_mid_stream(tmp_path):
                 task.cancel()
                 try:
                     await task
+                # graftlint: allow(no-silent-swallow): best-effort teardown
                 except (asyncio.CancelledError, Exception):  # noqa: BLE001
                     pass
             await notifier.close()
@@ -315,6 +316,7 @@ def test_mq_notification_broker_failover(tmp_path):
                 task.cancel()
                 try:
                     await task
+                # graftlint: allow(no-silent-swallow): best-effort teardown
                 except (asyncio.CancelledError, Exception):  # noqa: BLE001
                     pass
             await notifier.close()

@@ -167,6 +167,8 @@ def test_auto_scrub_loop_detects_corruption(tmp_path):
         from seaweedfs_tpu.storage.volume_info import save_volume_info
 
         save_volume_info(base + ".vif", {"version": 3})
+        # graftlint: allow(async-blocking): test fixture touch, nothing
+        # else shares this loop
         open(base + ".ecx", "ab").close()
         vs = VolumeServer(
             masters=[], directories=[str(tmp_path)], port=0, grpc_port=0,
@@ -181,6 +183,8 @@ def test_auto_scrub_loop_detects_corruption(tmp_path):
                 await asyncio.sleep(0.2)
 
             # corrupt a parity shard on disk -> next cycle flags it
+            # graftlint: allow(async-blocking): 1-byte test patch, nothing
+            # else shares this loop
             with open(base + layout.to_ext(10), "r+b") as f:
                 f.seek(64)
                 b = f.read(1)
@@ -194,6 +198,8 @@ def test_auto_scrub_loop_detects_corruption(tmp_path):
             assert stats.VOLUME_SERVER_SCRUB_CORRUPT_GAUGE._value.get() == 1
 
             # repair (restore the byte) -> gauge clears
+            # graftlint: allow(async-blocking): 1-byte test patch, nothing
+            # else shares this loop
             with open(base + layout.to_ext(10), "r+b") as f:
                 f.seek(64)
                 f.write(bytes([b[0]]))

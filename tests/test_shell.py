@@ -239,8 +239,11 @@ def test_volume_configure_replication(tmp_path):
                 SuperBlock,
             )
 
-            with open(v.dat_path, "rb") as f:
-                sb = SuperBlock.from_bytes(f.read(SUPER_BLOCK_SIZE))
+            def _read_sb():
+                with open(v.dat_path, "rb") as f:
+                    return f.read(SUPER_BLOCK_SIZE)
+
+            sb = SuperBlock.from_bytes(await asyncio.to_thread(_read_sb))
             assert str(sb.replica_placement) == "001"
         finally:
             await cluster.stop()

@@ -157,7 +157,11 @@ def test_filer_cipher_compress_e2e(tmp_path):
                 for f in files:
                     if f.endswith(".dat"):
                         found = True
-                        blob = open(os.path.join(root, f), "rb").read()
+                        from seaweedfs_tpu.utils.aiofile import (
+                            read_file_bytes,
+                        )
+
+                        blob = await read_file_bytes(os.path.join(root, f))
                         assert b"A line of very compressible text." not in blob
             assert found, "no .dat volume files written?"
         finally:

@@ -1,0 +1,26 @@
+"""graftlint — repo-native static analysis for the EC serving stack.
+
+The Go reference leans on `go vet` + the race detector; this port's
+hazard surface (threaded DevicePipeline, cross-locking DeviceShardCache
+eviction, async servers, hand-mutated pb2 descriptors, registry-driven
+metrics/stages) gets the equivalent here: AST rules with repo knowledge,
+a static lock-order graph, and a proto/registry drift check — all
+runnable as `python -m tools.graftlint seaweedfs_tpu tests` and wired
+into tier-1 (tests/test_lint_clean.py) and the __graft_entry__ dryrun.
+
+The runtime complement (what static analysis can't see across callbacks)
+is tests/lockwatch.py: it wraps the lock classes under pytest, records
+ACTUAL acquisition orders, and fails on an observed cycle.
+"""
+from .engine import collect_files, main, run_paths
+from .model import RULES, Finding, Rule, rule_table_markdown
+
+__all__ = [
+    "RULES",
+    "Finding",
+    "Rule",
+    "collect_files",
+    "main",
+    "run_paths",
+    "rule_table_markdown",
+]

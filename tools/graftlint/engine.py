@@ -1,0 +1,251 @@
+"""graftlint driver: collect files, run rules, apply waivers, report.
+
+Usage (the tier-1 entry point):
+
+    python -m tools.graftlint seaweedfs_tpu tests
+
+Exit 0 = tree clean.  Findings print as `path:line: GLnnn message`.
+
+Waivers: a finding is suppressed when the flagged line or the line
+directly above carries `# graftlint: allow(<rule-name>)` — a reason
+after the colon is expected and reviewed like any comment.  Waivers are
+for DELIBERATE exceptions (an explicit tiny D2H the code wants), not a
+mute button; every waiver names its rule so a grep lists them all.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+from . import locks, proto, rules
+from .model import Finding, rule_by_id
+
+# seeded-violation fixtures live here: the clean-tree run must skip them
+# (they exist to FAIL), but linting the corpus dir explicitly works
+_CORPUS_DIR = "lint_corpus"
+_WAIVER_RE = re.compile(r"graftlint:\s*allow\(([\w-]+)\)")
+
+
+@dataclass
+class FileUnit:
+    path: str
+    tree: ast.Module
+    lines: list[str] = field(default_factory=list)
+
+
+def collect_files(paths: list[str], include_corpus: bool = False) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+            continue
+        for root, dirnames, filenames in os.walk(p):
+            dirnames[:] = [
+                d for d in dirnames
+                if d != "__pycache__"
+                and (include_corpus or d != _CORPUS_DIR)
+            ]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                if fn.endswith("_pb2.py"):
+                    # generated descriptor-blob modules: no hand-written
+                    # logic to lint, and their megaline literals are not
+                    # series/stage names
+                    continue
+                out.append(os.path.join(root, fn))
+    return sorted(set(out))
+
+
+def parse_files(file_paths: list[str]) -> tuple[list[FileUnit], list[Finding]]:
+    units: list[FileUnit] = []
+    findings: list[Finding] = []
+    for path in file_paths:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            findings.append(Finding(
+                "GL000", path, e.lineno or 0, f"syntax error: {e.msg}"
+            ))
+            continue
+        units.append(FileUnit(path, tree, src.splitlines()))
+    return units, findings
+
+
+def _registry_context(units: list[FileUnit]) -> tuple[set[str], set[str]]:
+    """Declared series bases + stage names.  Parsed from the linted
+    tree when stats/ is part of it, else from the repo's own stats
+    package relative to this file (so linting a single file still has
+    the registry to check against)."""
+    series: set[str] = set()
+    stages: set[str] = set()
+    reg_units = [u for u in units if _is_registry_module(u.path)]
+    if not reg_units:
+        repo_root = _repo_root()
+        for rel in ("seaweedfs_tpu/stats/metrics.py",
+                    "seaweedfs_tpu/stats/cluster.py"):
+            p = os.path.join(repo_root, rel)
+            if os.path.exists(p):
+                with open(p, encoding="utf-8") as f:
+                    reg_units.append(
+                        FileUnit(p, ast.parse(f.read(), filename=p))
+                    )
+    for u in reg_units:
+        series |= rules.declared_series(u.tree)
+        stages |= rules.declared_stages(u.tree)
+    return series, stages
+
+
+def _is_registry_module(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return p.endswith(("stats/metrics.py", "stats/cluster.py"))
+
+
+def _repo_root() -> str:
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def _waived(unit: FileUnit, finding: Finding) -> bool:
+    """True when the flagged line — or the contiguous comment block
+    directly above it — carries `# graftlint: allow(<rule>)`."""
+    rule_name = rule_by_id(finding.rule).name if finding.rule != "GL000" else ""
+
+    def hit(lineno: int) -> bool:
+        m = _WAIVER_RE.search(unit.lines[lineno - 1])
+        return bool(m) and m.group(1) in (rule_name, finding.rule, "all")
+
+    if not (1 <= finding.line <= len(unit.lines)):
+        return False
+    if hit(finding.line):
+        return True
+    lineno = finding.line - 1
+    while lineno >= 1 and unit.lines[lineno - 1].lstrip().startswith("#"):
+        if hit(lineno):
+            return True
+        lineno -= 1
+    return False
+
+
+def run_paths(
+    paths: list[str],
+    proto_pb2_package: str = "seaweedfs_tpu.pb",
+    include_corpus: bool = False,
+) -> list[Finding]:
+    findings: list[Finding] = []
+    for p in paths:
+        # a missing target must FAIL, not lint zero files as "clean":
+        # a typo in the tier-1/dryrun invocation would otherwise
+        # permanently greenlight an unlinted tree
+        if not os.path.exists(p):
+            findings.append(Finding(
+                "GL000", p, 0,
+                "path does not exist — fix the lint invocation",
+            ))
+    file_paths = collect_files(paths, include_corpus=include_corpus)
+    units, parse_findings = parse_files(file_paths)
+    findings.extend(parse_findings)
+    series, stages = _registry_context(units)
+
+    for u in units:
+        per_file: list[Finding] = []
+        per_file += rules.check_async_blocking(u.tree, u.path)
+        per_file += rules.check_device_sync(u.tree, u.path)
+        per_file += rules.check_jit_static(u.tree, u.path)
+        per_file += rules.check_metric_registry(
+            u.tree, u.path, series, _is_registry_module(u.path)
+        )
+        per_file += rules.check_stage_registry(u.tree, u.path, stages)
+        per_file += rules.check_silent_swallow(u.tree, u.path)
+        findings.extend(f for f in per_file if not _waived(u, f))
+
+    # cross-file: the static lock-order graph over the serving stack.
+    # Findings anchor at a lock's declaration site, so the normal waiver
+    # channel applies there (conservative call resolution can err — a
+    # reasoned `# graftlint: allow(lock-order)` must be able to say so)
+    units_by_path = {u.path: u for u in units}
+    for f in locks.check_lock_order({u.path: u.tree for u in units}):
+        u = units_by_path.get(f.path)
+        if u is None or not _waived(u, f):
+            findings.append(f)
+
+    # proto drift: any pb/ directory with .proto files inside the linted
+    # paths (the real tree's seaweedfs_tpu/pb)
+    seen_dirs: set[str] = set()
+    for p in paths:
+        base = p if os.path.isdir(p) else os.path.dirname(p)
+        for root, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            if any(f.endswith(".proto") for f in filenames):
+                seen_dirs.add(root)
+    for d in sorted(seen_dirs):
+        if _CORPUS_DIR in d.replace("\\", "/") and not include_corpus:
+            continue
+        findings.extend(proto.check_proto_dir(d, proto_pb2_package))
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def main(argv: list[str]) -> int:
+    import argparse
+
+    from .model import rule_table_markdown
+    from .mypy_gate import run_mypy
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="repo-native static analysis for the EC serving stack",
+    )
+    ap.add_argument("paths", nargs="*", help="files/dirs to lint")
+    ap.add_argument(
+        "--doc", action="store_true",
+        help="print the README rule table generated from the registry",
+    )
+    ap.add_argument(
+        "--mypy", action="store_true",
+        help="also run the strict-typing gate (mypy.ini adoption list; "
+        "skipped when mypy is not installed)",
+    )
+    ap.add_argument(
+        "--proto-pb2-package", default="seaweedfs_tpu.pb",
+        help="package the *_pb2 modules live in (proto-drift rule)",
+    )
+    ap.add_argument(
+        "--include-corpus", action="store_true",
+        help="lint tests/lint_corpus too (it is SEEDED with violations)",
+    )
+    args = ap.parse_args(argv)
+
+    if args.doc:
+        print(rule_table_markdown())
+        return 0
+
+    rc = 0
+    if args.paths:
+        findings = run_paths(
+            args.paths,
+            proto_pb2_package=args.proto_pb2_package,
+            include_corpus=args.include_corpus,
+        )
+        for f in findings:
+            print(f.render())
+        if findings:
+            print(f"graftlint: {len(findings)} finding(s)")
+            rc = 1
+        else:
+            print(f"graftlint: clean ({', '.join(args.paths)})")
+    if args.mypy:
+        mypy_rc, out = run_mypy(_repo_root())
+        print(out)
+        rc = rc or mypy_rc
+    if not args.paths and not args.mypy:
+        ap.print_usage()
+        return 2
+    return rc

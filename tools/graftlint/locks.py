@@ -1,0 +1,404 @@
+"""Static lock acquisition-order analysis (rule GL104).
+
+Two passes over the concurrency-relevant modules (LOCK_SCOPE_PARTS):
+
+  1. identity collection — every `threading.Lock/RLock/Condition()`
+     assigned to `self.<attr>` (identity "ClassName.<attr>") or a
+     module-level name (identity "module.<name>"), with its kind;
+  2. per-function facts — which identities each function acquires
+     directly (`with self._lock:`, `lock.acquire()`), and which calls it
+     makes while holding each of them.
+
+Call resolution is deliberately conservative (no type inference):
+
+  * `self.foo()`   -> method foo of the enclosing class, if analyzed;
+  * `foo()`        -> module-level function foo (same module first);
+  * `<name>.foo()` -> the ONE analyzed method named foo when the name is
+                      unambiguous across analyzed classes, else skipped;
+  * compound receivers (`self._arrays.get(...)`) are skipped — guessing
+    there is where name-based analysis starts lying.
+
+Effective acquisitions propagate through the resolved call graph to a
+fixpoint, then edges are: lock A -> every lock effectively acquired by
+code reachable while A is held (direct nesting included).  A cycle in
+that graph — including a self-edge on a non-reentrant Lock — is a
+lock-order hazard the runtime lockwatch harness can only catch if the
+schedule actually interleaves; here it fails at lint time.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from collections import defaultdict
+from typing import Iterator
+
+from .model import LOCK_ORDER, Finding
+from .rules import dotted
+
+# modules whose locks participate in the order graph (the EC serving
+# stack named by the issue + the corpus so the seeded fixture fires)
+LOCK_SCOPE_PARTS = (
+    "seaweedfs_tpu/ops/rs_resident.py",
+    "seaweedfs_tpu/serving/",
+    "seaweedfs_tpu/storage/ec/",
+    "seaweedfs_tpu/obs/trace.py",
+    "seaweedfs_tpu/stats/cluster.py",
+    "lint_corpus",
+)
+
+_LOCK_CTORS = {
+    "threading.Lock": "Lock",
+    "threading.RLock": "RLock",
+    "threading.Condition": "Condition",
+}
+
+# method names shared with builtin containers / stdlib objects: a dotted
+# call ending in one of these (on a non-self receiver) is far more
+# likely dict/list/queue traffic than an analyzed method — resolving it
+# by bare name would invent lock edges out of `self._arrays.get(...)`
+_GENERIC_METHODS = {
+    "get", "put", "pop", "popitem", "set", "add", "clear", "items",
+    "keys", "values", "update", "setdefault", "append", "appendleft",
+    "extend", "insert", "remove", "discard", "sort", "copy", "index",
+    "count", "join", "split", "strip", "read", "write", "close", "open",
+    "result", "submit", "cancel", "done", "wait", "notify", "notify_all",
+    "acquire", "release", "locked", "start", "is_alive", "move_to_end",
+    "get_nowait", "put_nowait", "empty", "full", "qsize", "is_set",
+    "inc", "dec", "observe", "labels", "collect", "info", "debug",
+    "warning", "error", "exception",
+}
+
+
+def in_lock_scope(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return any(part in p for part in LOCK_SCOPE_PARTS)
+
+
+def cycles_from_edges(graph: dict) -> list[list[str]]:
+    """Elementary cycles of a {node: {successor}} order graph, each
+    rendered as [a, b, ..., a].  Shared by this static pass and the
+    runtime lockwatch harness (tests/lockwatch.py) so a traversal fix
+    reaches both."""
+    seen: set = set()
+    out: list[list[str]] = []
+    found: set = set()
+
+    def dfs(node: str, stack: list[str], on_stack: set) -> None:
+        seen.add(node)
+        stack.append(node)
+        on_stack.add(node)
+        for nxt in sorted(graph.get(node, ())):
+            if nxt in on_stack:
+                cyc = stack[stack.index(nxt):] + [nxt]
+                key = frozenset(cyc)
+                if key not in found:
+                    found.add(key)
+                    out.append(cyc)
+            elif nxt not in seen:
+                dfs(nxt, stack, on_stack)
+        stack.pop()
+        on_stack.remove(node)
+
+    for node in sorted(graph):
+        if node not in seen:
+            dfs(node, [], set())
+    return out
+
+
+@dataclasses.dataclass
+class FuncFacts:
+    qualname: str                       # "module:Class.method" | "module:fn"
+    direct: set = dataclasses.field(default_factory=set)
+    # calls made while holding a given identity: {identity: {callee-key}}
+    calls_holding: dict = dataclasses.field(
+        default_factory=lambda: defaultdict(set)
+    )
+    # all resolved calls (for transitive acquisition propagation)
+    calls: set = dataclasses.field(default_factory=set)
+    # where each direct acquisition happens (identity -> first lineno)
+    sites: dict = dataclasses.field(default_factory=dict)
+
+
+class _ModuleScan(ast.NodeVisitor):
+    """Collect lock identities + per-function facts for one module."""
+
+    def __init__(self, module: str, path: str, analysis: "LockAnalysis"):
+        self.module = module
+        self.path = path
+        self.analysis = analysis
+        self._class: str | None = None
+        self._func: FuncFacts | None = None
+        self._held: list[str] = []  # identity stack in the current func
+
+    # ---------------------------------------------------- identities
+    def _lock_kind(self, value: ast.AST) -> str | None:
+        if isinstance(value, ast.Call):
+            return _LOCK_CTORS.get(dotted(value.func) or "")
+        return None
+
+    def _record_assign(self, target: ast.AST, value: ast.AST, line: int):
+        kind = self._lock_kind(value)
+        if kind is None:
+            return
+        if (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+            and self._class
+        ):
+            ident = f"{self._class}.{target.attr}"
+        elif isinstance(target, ast.Name) and self._func is None:
+            ident = f"{self.module}.{target.id}"
+        else:
+            return
+        self.analysis.kinds[ident] = kind
+        # real file path + declaration line: findings anchor here, so a
+        # `# graftlint: allow(lock-order)` above the declaration waives
+        self.analysis.decl_sites[ident] = (self.path, line)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._record_assign(t, node.value, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._record_assign(node.target, node.value, node.lineno)
+        self.generic_visit(node)
+
+    # ------------------------------------------------------- scoping
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        prev, self._class = self._class, node.name
+        self.generic_visit(node)
+        self._class = prev
+
+    def _visit_func(self, node) -> None:
+        if self._func is not None:
+            # nested function: analyze within the same facts (it runs on
+            # the same thread unless dispatched, and losing its
+            # acquisitions would under-report)
+            self.generic_visit(node)
+            return
+        qual = (
+            f"{self.module}:{self._class}.{node.name}"
+            if self._class else f"{self.module}:{node.name}"
+        )
+        self._func = FuncFacts(qual)
+        self.analysis.funcs[qual] = self._func
+        key = node.name if self._class is None else f"{self._class}.{node.name}"
+        self.analysis.by_name[node.name].add(qual)
+        self.analysis.by_qual_name[key].add(qual)
+        self.generic_visit(node)
+        self._func = None
+        self._held = []
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    # --------------------------------------------------- acquisitions
+    def _identify_lock_expr(self, expr: ast.AST) -> str | None:
+        """Identity acquired by `with <expr>:` / `<expr>.acquire()`."""
+        name = dotted(expr)
+        if name is None:
+            return None
+        if name.startswith("self.") and self._class:
+            ident = f"{self._class}.{name[5:]}"
+            if ident in self.analysis.kinds:
+                return ident
+            return None
+        mod_ident = f"{self.module}.{name}"
+        if mod_ident in self.analysis.kinds:
+            return mod_ident
+        return None
+
+    def visit_With(self, node: ast.With) -> None:
+        if self._func is None:
+            self.generic_visit(node)
+            return
+        acquired: list[str] = []
+        for item in node.items:
+            # the item expression runs under whatever is already held at
+            # this point (locks from enclosing withs AND earlier items of
+            # this one) — visit it BEFORE noting its own acquisition so
+            # `with A, foo():` records A -> locks(foo)
+            self.visit(item.context_expr)
+            ident = self._identify_lock_expr(item.context_expr)
+            if ident is None:
+                continue
+            self._note_acquire(ident, item.context_expr.lineno
+                               if hasattr(item.context_expr, "lineno")
+                               else node.lineno)
+            acquired.append(ident)
+            self._held.append(ident)
+        for stmt in node.body:
+            self.visit(stmt)
+        for ident in acquired:
+            self._held.remove(ident)
+
+    visit_AsyncWith = visit_With
+
+    def _note_acquire(self, ident: str, line: int) -> None:
+        assert self._func is not None
+        self._func.direct.add(ident)
+        self._func.sites.setdefault(ident, line)
+        for held in self._held:
+            if held != ident:
+                self.analysis.direct_edges[(held, ident)] = (
+                    self._func.qualname, line
+                )
+            elif self.analysis.kinds.get(ident) == "Lock":
+                self.analysis.self_edges[ident] = (self._func.qualname, line)
+
+    # ---------------------------------------------------------- calls
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._func is not None:
+            name = dotted(node.func)
+            if name is not None:
+                if name.endswith(".acquire"):
+                    ident = self._identify_lock_expr(
+                        node.func.value  # type: ignore[attr-defined]
+                    )
+                    if ident is not None:
+                        self._note_acquire(ident, node.lineno)
+                        self.generic_visit(node)
+                        return
+                key = self._resolve_call_key(name)
+                if key is not None:
+                    self._func.calls.add((key, node.lineno))
+                    for held in self._held:
+                        self._func.calls_holding[held].add(
+                            (key, node.lineno)
+                        )
+        self.generic_visit(node)
+
+    def _resolve_call_key(self, name: str) -> str | None:
+        """Map a dotted call to a resolution key handled in pass 2:
+        'm:<module>:<fn>' / 'c:<Class>.<meth>' / 'u:<meth>'."""
+        parts = name.split(".")
+        if len(parts) == 1:
+            return f"m:{self.module}:{parts[0]}"
+        if parts[0] == "self" and len(parts) == 2 and self._class:
+            return f"c:{self._class}.{parts[1]}"
+        # <name>.<meth> (and compound receivers — `cache.pipeline.slot()`
+        # must reach slot()): resolvable only when the method name is
+        # unambiguous among analyzed classes AND not a generic
+        # container/stdlib verb — `self._arrays.get(...)` naming
+        # dict.get must not alias DeviceShardCache.get
+        if parts[-1] in _GENERIC_METHODS:
+            return None
+        return f"u:{parts[-1]}"
+
+
+class LockAnalysis:
+    def __init__(self) -> None:
+        self.kinds: dict[str, str] = {}
+        self.decl_sites: dict[str, tuple[str, int]] = {}
+        self.funcs: dict[str, FuncFacts] = {}
+        self.by_name: dict[str, set] = defaultdict(set)
+        self.by_qual_name: dict[str, set] = defaultdict(set)
+        self.direct_edges: dict[tuple, tuple] = {}
+        self.self_edges: dict[str, tuple] = {}
+
+    # ------------------------------------------------------ resolution
+    def _targets(self, key: str) -> list[FuncFacts]:
+        kind, _, rest = key.partition(":")
+        if kind == "m":
+            module, _, fn = rest.partition(":")
+            qual = f"{module}:{fn}"
+            if qual in self.funcs:
+                return [self.funcs[qual]]
+            # fall back to a unique same-named module function elsewhere
+            quals = {
+                q for q in self.by_name.get(fn, ())
+                if ":" in q and "." not in q.split(":", 1)[1]
+            }
+            return [self.funcs[q] for q in quals] if len(quals) == 1 else []
+        if kind == "c":
+            quals = self.by_qual_name.get(rest, set())
+            return [self.funcs[q] for q in quals]
+        if kind == "u":
+            quals = {
+                q for q in self.by_name.get(rest, ())
+                if "." in q.split(":", 1)[1]  # methods only
+            }
+            if len(quals) == 1:
+                return [self.funcs[quals.pop()]]
+        return []
+
+    def effective_acquires(self) -> dict[str, set]:
+        """Fixpoint: locks acquired by each function directly or via any
+        resolved callee (nested-call depth unbounded, cycles safe)."""
+        eff = {q: set(f.direct) for q, f in self.funcs.items()}
+        changed = True
+        while changed:
+            changed = False
+            for q, f in self.funcs.items():
+                for key, _line in f.calls:
+                    for callee in self._targets(key):
+                        add = eff[callee.qualname] - eff[q]
+                        if add:
+                            eff[q].update(add)
+                            changed = True
+        return eff
+
+    def edges(self) -> dict[tuple, tuple]:
+        """(A, B) -> (where, line): B is acquired while A is held."""
+        out = dict(self.direct_edges)
+        eff = self.effective_acquires()
+        for q, f in self.funcs.items():
+            for held, calls in f.calls_holding.items():
+                for key, line in calls:
+                    for callee in self._targets(key):
+                        for acquired in eff[callee.qualname]:
+                            if acquired == held:
+                                if self.kinds.get(held) == "Lock":
+                                    self.self_edges.setdefault(
+                                        held, (q, line)
+                                    )
+                                continue
+                            out.setdefault(
+                                (held, acquired), (q, line)
+                            )
+        return out
+
+
+
+def analyze(files: dict[str, ast.Module]) -> LockAnalysis:
+    """files: {path: parsed tree} — only lock-scope files are scanned."""
+    analysis = LockAnalysis()
+    for path, tree in sorted(files.items()):
+        if not in_lock_scope(path):
+            continue
+        module = os.path.splitext(os.path.basename(path))[0]
+        _ModuleScan(module, path, analysis).visit(tree)
+    return analysis
+
+
+def check_lock_order(files: dict[str, ast.Module]) -> Iterator[Finding]:
+    analysis = analyze(files)
+    # ONE edges() pass: it runs the effective-acquisition fixpoint and
+    # (as a side effect) completes self_edges — both the cycle graph
+    # and the self-edge findings below read from this single result
+    edge_sites = analysis.edges()
+    graph: dict[str, set] = defaultdict(set)
+    for (a, b) in edge_sites:
+        graph[a].add(b)
+    for cyc in cycles_from_edges(graph):
+        legs = " -> ".join(cyc)
+        first = edge_sites.get((cyc[0], cyc[1]))
+        where = f" (first leg in {first[0]}, line {first[1]})" if first else ""
+        path, line = analysis.decl_sites.get(cyc[0], ("lock-graph", 0))
+        yield Finding(
+            LOCK_ORDER.rule_id, path, line,
+            f"lock acquisition-order cycle: {legs}{where} — pick one "
+            "global order for these locks and release before crossing "
+            "(a waiver above this lock's declaration suppresses)",
+        )
+    for ident, (qual, line) in analysis.self_edges.items():
+        path, decl_line = analysis.decl_sites.get(ident, ("lock-graph", 0))
+        yield Finding(
+            LOCK_ORDER.rule_id, path, decl_line,
+            f"non-reentrant Lock {ident} may be re-acquired while held "
+            f"(in {qual}, line {line}) — use RLock or restructure",
+        )

@@ -1,0 +1,134 @@
+"""Finding + rule registry for graftlint.
+
+Every rule registers itself here with an id, a one-line summary, and a
+tiny example of what it catches; the README's "Static analysis" table is
+GENERATED from this registry (tools.graftlint --doc), and the doc-drift
+test fails when the README falls behind — the same honesty contract the
+metrics table already lives under.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str          # rule id, e.g. "GL101"
+    path: str          # file the finding is in (repo-relative when possible)
+    line: int          # 1-based line number (0 for file-level findings)
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """Registry entry: identity + the doc-table row."""
+
+    rule_id: str       # stable id (GLnnn)
+    name: str          # kebab-case name usable in waiver comments
+    summary: str       # one line: what it catches
+    example: str       # a minimal triggering snippet (doc table column)
+
+
+# ordered registry: the README table renders in this order
+RULES: list[Rule] = []
+_BY_ID: dict[str, Rule] = {}
+_BY_NAME: dict[str, Rule] = {}
+
+
+def register(rule_id: str, name: str, summary: str, example: str) -> Rule:
+    rule = Rule(rule_id, name, summary, example)
+    RULES.append(rule)
+    _BY_ID[rule_id] = rule
+    _BY_NAME[name] = rule
+    return rule
+
+
+def rule_by_id(rule_id: str) -> Rule:
+    return _BY_ID[rule_id]
+
+
+ASYNC_BLOCKING = register(
+    "GL101",
+    "async-blocking",
+    "blocking call (time.sleep, sync file/socket IO, Future.result, "
+    "subprocess) inside an `async def` body without to_thread/executor "
+    "dispatch — stalls the event loop for every connection it serves",
+    "async def h(r): time.sleep(1)",
+)
+DEVICE_SYNC = register(
+    "GL102",
+    "device-sync",
+    "implicit device->host transfer (np.asarray/.item()/jnp truthiness) "
+    "in a serving hot-path module outside a traced d2h_copy span and "
+    "without an explicit waiver — hidden syncs stall the device pipeline",
+    "out = np.asarray(device_arr)  # in serving/",
+)
+JIT_STATIC = register(
+    "GL103",
+    "jit-static-args",
+    "jax.jit static_argnums/static_argnames/donate_argnums that don't "
+    "match the wrapped function's signature (unknown name, out-of-range "
+    "or donated-and-static index) — fails at trace time or silently "
+    "never donates",
+    "@partial(jax.jit, static_argnames=('typo',))",
+)
+LOCK_ORDER = register(
+    "GL104",
+    "lock-order",
+    "cycle in the static lock acquisition-order graph across the EC "
+    "serving stack (DeviceShardCache, DevicePipeline, dispatcher, bulk "
+    "executor) — an AB/BA ordering that can deadlock under load",
+    "with A: take_B()  /  with B: take_A()",
+)
+METRIC_REGISTRY = register(
+    "GL105",
+    "metric-registry",
+    "SeaweedFS_* series literal that is not pre-registered in "
+    "stats/metrics.py / stats/cluster.py (or a series declared outside "
+    "them) — the runtime drift tests only catch this once the code runs",
+    'g("SeaweedFS_bogus_total")',
+)
+STAGE_REGISTRY = register(
+    "GL106",
+    "stage-registry",
+    "trace-stage literal passed to obs span()/record_span() that is not "
+    "in stats.metrics.TRACE_STAGES — the stage histogram would grow an "
+    "undocumented, un-pre-registered label at runtime",
+    'with obs.span("bogus_stage"):',
+)
+PROTO_DRIFT = register(
+    "GL107",
+    "proto-drift",
+    "field name/number mismatch between pb/*.proto and the "
+    "descriptor-mutated *_pb2.py modules (either direction) — the .proto "
+    "is the wire contract, the pb2 is what actually serializes",
+    "master.proto says `= 7`, master_pb2 says `= 9`",
+)
+SILENT_SWALLOW = register(
+    "GL108",
+    "no-silent-swallow",
+    "broad `except Exception/BaseException/bare:` whose body is only "
+    "`pass` — errors vanish without a log line; narrow exception types "
+    "stay allowed",
+    "except Exception:\\n    pass",
+)
+
+
+def rule_table_markdown() -> str:
+    """The README 'Static analysis' rule table, generated from the
+    registry (id, name, what it catches, example)."""
+    lines = [
+        "| id | rule | catches | example |",
+        "| --- | --- | --- | --- |",
+    ]
+    for r in RULES:
+        example = r.example.replace("|", "\\|")
+        lines.append(
+            f"| `{r.rule_id}` | `{r.name}` | {r.summary} | `{example}` |"
+        )
+    return "\n".join(lines)

@@ -1,0 +1,38 @@
+"""Strict-typing gate: run mypy over the incremental adoption list.
+
+The adoption list and strictness live in mypy.ini (repo root) — this
+runner just invokes mypy with that config when the interpreter has it
+and reports the outcome.  The container this repo targets does not ship
+mypy (and nothing may be pip-installed), so absence is a SKIP, not a
+failure: the gate enforces strictness wherever mypy exists (dev
+machines, CI images that carry it) without making the lint run depend
+on an uninstallable tool.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+
+def mypy_available() -> bool:
+    return importlib.util.find_spec("mypy") is not None
+
+
+def run_mypy(repo_root: str) -> tuple[int, str]:
+    """-> (exit code, output).  Exit 0 when clean OR when mypy is not
+    installed (reported as a skip in the output)."""
+    config = os.path.join(repo_root, "mypy.ini")
+    if not os.path.exists(config):
+        return 1, "mypy gate: mypy.ini not found at repo root"
+    if not mypy_available():
+        return 0, "mypy gate: SKIPPED (mypy not installed in this env)"
+    proc = subprocess.run(
+        [sys.executable, "-m", "mypy", "--config-file", config],
+        capture_output=True,
+        text=True,
+        cwd=repo_root,
+    )
+    out = (proc.stdout + proc.stderr).strip()
+    return proc.returncode, f"mypy gate:\n{out}" if out else "mypy gate: ok"

@@ -1,0 +1,147 @@
+"""Proto drift rule (GL107): pb/*.proto vs the descriptor-mutated
+*_pb2.py modules.
+
+This repo regenerates pb2 modules WITHOUT protoc (the container has no
+grpc_tools): new fields are appended by mutating the serialized
+FileDescriptorProto and rewriting the module around the new blob.  That
+workflow makes it easy for the human-readable .proto to fall behind the
+pb2 that actually serializes (or vice versa when someone edits the
+.proto and forgets the mutation).  This rule compares, per message, the
+field name -> number maps in both directions; any mismatch is a wire
+contract drift.
+
+The .proto side is parsed with a small brace-tracking parser (proto3
+subset actually used here: messages, nested messages, repeated/optional
+fields, map<k,v> fields); the pb2 side is read from the imported
+module's DESCRIPTOR — pure metadata, no service/server code runs.
+"""
+from __future__ import annotations
+
+import importlib
+import os
+import re
+from typing import Iterator
+
+from .model import PROTO_DRIFT, Finding
+
+_FIELD_RE = re.compile(
+    r"^(?:repeated\s+|optional\s+)?"
+    r"(?:map\s*<[^>]+>|[A-Za-z_][\w.]*)\s+"
+    r"([a-z_][\w]*)\s*=\s*(\d+)\s*(?:\[[^\]]*\])?$"
+)
+_MSG_RE = re.compile(r"^message\s+([A-Za-z_]\w*)$")
+
+
+def parse_proto(text: str) -> dict[str, dict[str, int]]:
+    """{Message (dotted for nested): {field_name: number}}.
+
+    Token-driven (statements split on `{`/`}`/`;`) rather than
+    line-driven, so one-line bodies like
+    `message M { uint32 id = 1; }` parse the same as the multi-line
+    form.  Blocks that are not messages (service/enum/oneof/rpc bodies)
+    are tracked for brace balance and their statements skipped."""
+    text = re.sub(r"//[^\n]*", "", text)
+    text = re.sub(r"/\*.*?\*/", "", text, flags=re.S)
+    out: dict[str, dict[str, int]] = {}
+    stack: list[str | None] = []  # None = non-message block
+    buf: list[str] = []
+    for tok in re.split(r"([{};])", text):
+        if tok == "{":
+            header = " ".join("".join(buf).split())
+            buf = []
+            m = _MSG_RE.match(header)
+            if m:
+                parent = next(
+                    (s for s in reversed(stack) if s is not None), None
+                )
+                name = f"{parent}.{m.group(1)}" if parent else m.group(1)
+                out[name] = {}
+                stack.append(name)
+            else:
+                stack.append(None)
+        elif tok == "}":
+            buf = []
+            if stack:
+                stack.pop()
+        elif tok == ";":
+            stmt = " ".join("".join(buf).split())
+            buf = []
+            # fields belong to the INNERMOST block only when it is a
+            # message (oneof members would need the enclosing message —
+            # none of this repo's protos use oneof)
+            if stack and stack[-1] is not None:
+                f = _FIELD_RE.match(stmt)
+                if f:
+                    out[stack[-1]][f.group(1)] = int(f.group(2))
+        else:
+            buf.append(tok)
+    return out
+
+
+def _walk_descriptor(msg, prefix: str, out: dict) -> None:
+    out[prefix] = {f.name: f.number for f in msg.fields}
+    for nested in msg.nested_types:
+        if nested.GetOptions().map_entry:
+            continue  # synthesized map-entry message; the map field
+            # itself already carries the user-visible name/number
+        _walk_descriptor(nested, f"{prefix}.{nested.name}", out)
+
+
+def fields_from_pb2(module) -> dict[str, dict[str, int]]:
+    out: dict[str, dict[str, int]] = {}
+    for name, msg in module.DESCRIPTOR.message_types_by_name.items():
+        _walk_descriptor(msg, name, out)
+    return out
+
+
+def check_proto_dir(
+    proto_dir: str, pb2_package: str = "seaweedfs_tpu.pb"
+) -> Iterator[Finding]:
+    """Compare every <stem>.proto in `proto_dir` against
+    <pb2_package>.<stem>_pb2 (skipping stems with no pb2 module)."""
+    for entry in sorted(os.listdir(proto_dir)):
+        if not entry.endswith(".proto"):
+            continue
+        stem = entry[: -len(".proto")]
+        path = os.path.join(proto_dir, entry)
+        try:
+            module = importlib.import_module(f"{pb2_package}.{stem}_pb2")
+        except ImportError:
+            yield Finding(
+                PROTO_DRIFT.rule_id, path, 0,
+                f"no generated module {pb2_package}.{stem}_pb2 for this "
+                ".proto — regenerate (pb/generate.sh / descriptor "
+                "mutation) or remove the schema",
+            )
+            continue
+        with open(path, encoding="utf-8") as f:
+            proto_fields = parse_proto(f.read())
+        pb2_fields = fields_from_pb2(module)
+        for msg in sorted(set(proto_fields) | set(pb2_fields)):
+            in_proto = proto_fields.get(msg)
+            in_pb2 = pb2_fields.get(msg)
+            if in_proto is None:
+                yield Finding(
+                    PROTO_DRIFT.rule_id, path, 0,
+                    f"message {msg} exists in {stem}_pb2 but not in "
+                    f"{entry} — the .proto fell behind a descriptor "
+                    "mutation",
+                )
+                continue
+            if in_pb2 is None:
+                yield Finding(
+                    PROTO_DRIFT.rule_id, path, 0,
+                    f"message {msg} exists in {entry} but not in "
+                    f"{stem}_pb2 — regenerate the pb2 module",
+                )
+                continue
+            for fname in sorted(set(in_proto) | set(in_pb2)):
+                a, b = in_proto.get(fname), in_pb2.get(fname)
+                if a != b:
+                    yield Finding(
+                        PROTO_DRIFT.rule_id, path, 0,
+                        f"{msg}.{fname}: .proto says "
+                        f"{'absent' if a is None else a}, {stem}_pb2 says "
+                        f"{'absent' if b is None else b} — field "
+                        "name/number drift on the wire contract",
+                    )

@@ -1,0 +1,507 @@
+"""Per-file AST rules (stdlib ast only — no third-party linter deps).
+
+Each check_* function takes the parsed tree plus file context and yields
+Finding objects.  Waiver comments (`# graftlint: allow(<rule-name>)` on
+the flagged line or the line above, with a reason) are applied by the
+engine, not here — rules stay pure detectors.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from .model import (
+    ASYNC_BLOCKING,
+    DEVICE_SYNC,
+    JIT_STATIC,
+    METRIC_REGISTRY,
+    SILENT_SWALLOW,
+    STAGE_REGISTRY,
+    Finding,
+)
+
+# ------------------------------------------------------------------ helpers
+
+
+def dotted(node: ast.AST) -> str | None:
+    """'a.b.c' for a Name/Attribute chain, None for anything dynamic."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _str_const(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+# ------------------------------------------------------- GL101 async-blocking
+
+# call roots that block the calling thread.  The event loop serves every
+# connection on one thread: a single blocking call here is a full-stop
+# for the whole server, which is exactly what the dispatcher's
+# to_thread hops exist to avoid.
+_BLOCKING_EXACT = {
+    "time.sleep",
+    "os.pread", "os.preadv", "os.pwrite", "os.pwritev", "os.fsync",
+    "os.fdatasync", "os.sendfile", "os.read", "os.write",
+    "socket.create_connection", "socket.getaddrinfo",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output",
+    "urllib.request.urlopen",
+}
+_BLOCKING_PREFIX = ("requests.",)
+# open() staged reads/writes and Future.result() are attribute-position
+# agnostic: flag the builtin name / the method name.
+_BLOCKING_METHODS = {"result"}  # fut.result() — concurrent.futures sync wait
+# methods on a sync file handle kept alive across awaits (the
+# `f = await to_thread(open, ...)` pattern): calling these directly in
+# the async body blocks the loop just like the open() would have
+_HANDLE_METHODS = {
+    "read", "readline", "readlines", "write", "writelines", "seek",
+    "truncate", "flush", "close",
+}
+
+
+def _opens_file(value: ast.AST) -> bool:
+    """True for `open(...)`, `await asyncio.to_thread(open, ...)`, and
+    `await loop.run_in_executor(ex, open, ...)` — the expressions that
+    bind a SYNC file handle to a name in an async body."""
+    if isinstance(value, ast.Await):
+        value = value.value
+    if not isinstance(value, ast.Call):
+        return False
+    name = dotted(value.func) or ""
+    if name == "open":
+        return True
+    if name.endswith("to_thread") and value.args:
+        return dotted(value.args[0]) == "open"
+    if name.endswith("run_in_executor") and len(value.args) >= 2:
+        return dotted(value.args[1]) == "open"
+    return False
+
+
+def check_async_blocking(tree: ast.Module, path: str) -> Iterator[Finding]:
+    for outer in ast.walk(tree):
+        if not isinstance(outer, ast.AsyncFunctionDef):
+            continue
+        nodes = list(_walk_same_function(outer))
+        handles = {
+            n.targets[0].id
+            for n in nodes
+            if isinstance(n, ast.Assign)
+            and len(n.targets) == 1
+            and isinstance(n.targets[0], ast.Name)
+            and _opens_file(n.value)
+        }
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            hit = None
+            if name in _BLOCKING_EXACT:
+                hit = name
+            elif name == "open":
+                hit = "open()"
+            elif name and name.startswith(_BLOCKING_PREFIX):
+                hit = name
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id in handles
+                and node.func.attr in _HANDLE_METHODS
+            ):
+                hit = (
+                    f"{node.func.value.id}.{node.func.attr}() on a sync "
+                    "file handle"
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _BLOCKING_METHODS
+                and len(node.args) + len(node.keywords) <= 1
+                and all(kw.arg == "timeout" for kw in node.keywords)
+            ):
+                # zero-arg result() or result(timeout=...): bounded is
+                # still a blocked event loop for up to the timeout
+                hit = f"<obj>.{node.func.attr}()"
+            if hit:
+                yield Finding(
+                    ASYNC_BLOCKING.rule_id, path, node.lineno,
+                    f"blocking call {hit} inside `async def "
+                    f"{outer.name}` — dispatch via asyncio.to_thread / "
+                    "run_in_executor instead",
+                )
+
+
+def _walk_same_function(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body without descending into nested function /
+    lambda scopes (their bodies run in whatever context CALLS them —
+    run_in_executor lambdas are the common legitimate case)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# --------------------------------------------------------- GL102 device-sync
+
+# modules on the device serving hot path: an implicit D2H here stalls
+# the pipeline mid-batch.  lint_corpus is in the set so the seeded
+# fixture exercises the rule without faking paths.
+HOT_PATH_PARTS = (
+    "seaweedfs_tpu/serving/",
+    "seaweedfs_tpu/ops/rs_resident.py",
+    "seaweedfs_tpu/storage/ec/",
+    "lint_corpus",
+)
+
+_D2H_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+              "jax.device_get"}
+_JNP_ROOTS = ("jnp.", "jax.numpy.")
+
+
+def is_hot_path(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return any(part in p for part in HOT_PATH_PARTS)
+
+
+class _DeviceSyncVisitor(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: list[Finding] = []
+        self._span_depth = 0  # inside a `with *.span("d2h_copy")` block
+
+    # -- span tracking ------------------------------------------------
+    def _with_d2h_span(self, node: ast.With) -> bool:
+        for item in node.items:
+            call = item.context_expr
+            if not isinstance(call, ast.Call):
+                continue
+            name = dotted(call.func) or ""
+            if name.endswith("span") and call.args:
+                if _str_const(call.args[0]) == "d2h_copy":
+                    return True
+        return False
+
+    def visit_With(self, node: ast.With) -> None:
+        if self._with_d2h_span(node):
+            self._span_depth += 1
+            self.generic_visit(node)
+            self._span_depth -= 1
+        else:
+            self.generic_visit(node)
+
+    # -- detectors ----------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        name = dotted(node.func)
+        if name in _D2H_CALLS and not any(
+            kw.arg == "dtype" for kw in node.keywords
+        ):
+            # dtype= marks host-side coercion/staging (np.asarray of
+            # bytes); a device array fetch never re-types
+            if not self._span_depth:
+                self.findings.append(Finding(
+                    DEVICE_SYNC.rule_id, self.path, node.lineno,
+                    f"{name}(...) in a hot-path module is an implicit "
+                    "device->host transfer: wrap it in an obs span "
+                    '("d2h_copy") or waive it with '
+                    "`# graftlint: allow(device-sync): <reason>`",
+                ))
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "item"
+            and not node.args
+            and not self._span_depth
+        ):
+            self.findings.append(Finding(
+                DEVICE_SYNC.rule_id, self.path, node.lineno,
+                ".item() in a hot-path module is a synchronous "
+                "device->host scalar fetch: hoist it off the serving "
+                "path or waive with a reason",
+            ))
+        self.generic_visit(node)
+
+    def _check_truthiness(self, test: ast.AST, lineno: int) -> None:
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Call):
+                name = dotted(sub.func) or ""
+                if name.startswith(_JNP_ROOTS):
+                    self.findings.append(Finding(
+                        DEVICE_SYNC.rule_id, self.path, lineno,
+                        f"branching on {name}(...) forces a blocking "
+                        "device sync to evaluate the condition — "
+                        "compute the predicate on host or keep it in "
+                        "the jit",
+                    ))
+                    return
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_truthiness(node.test, node.lineno)
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_truthiness(node.test, node.lineno)
+        self.generic_visit(node)
+
+
+def check_device_sync(tree: ast.Module, path: str) -> Iterator[Finding]:
+    if not is_hot_path(path):
+        return
+    v = _DeviceSyncVisitor(path)
+    v.visit(tree)
+    yield from v.findings
+
+
+# ------------------------------------------------------ GL103 jit-static-args
+
+
+def _jit_kwargs(deco: ast.AST) -> dict | None:
+    """static/donate kwargs of a jax.jit decorator form, else None.
+    Handles @functools.partial(jax.jit, ...) / @partial(jax.jit, ...)
+    and @jax.jit(...) (direct call form)."""
+    if not isinstance(deco, ast.Call):
+        return None
+    name = dotted(deco.func)
+    if name in ("functools.partial", "partial"):
+        if not deco.args or dotted(deco.args[0]) not in ("jax.jit", "jit"):
+            return None
+    elif name not in ("jax.jit", "jit"):
+        return None
+    return {kw.arg: kw.value for kw in deco.keywords if kw.arg}
+
+
+def _literal_names(node: ast.AST) -> list[str] | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            s = _str_const(elt)
+            if s is None:
+                return None
+            out.append(s)
+        return out
+    return None
+
+
+def _literal_ints(node: ast.AST) -> list[int] | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if not (
+                isinstance(elt, ast.Constant) and isinstance(elt.value, int)
+            ):
+                return None
+            out.append(elt.value)
+        return out
+    return None
+
+
+def check_jit_static(tree: ast.Module, path: str) -> Iterator[Finding]:
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for deco in node.decorator_list:
+            kw = _jit_kwargs(deco)
+            if kw is None:
+                continue
+            args = node.args
+            positional = [a.arg for a in args.posonlyargs + args.args]
+            all_names = positional + [a.arg for a in args.kwonlyargs]
+            static_idx: set[int] = set()
+            for key in ("static_argnames",):
+                if key in kw:
+                    names = _literal_names(kw[key])
+                    if names is None:
+                        continue  # dynamic expression: not checkable
+                    for n in names:
+                        if n not in all_names:
+                            yield Finding(
+                                JIT_STATIC.rule_id, path, deco.lineno,
+                                f"static_argnames {n!r} is not a "
+                                f"parameter of {node.name}"
+                                f"({', '.join(all_names)})",
+                            )
+            for key in ("static_argnums", "donate_argnums"):
+                if key in kw:
+                    nums = _literal_ints(kw[key])
+                    if nums is None:
+                        continue
+                    for i in nums:
+                        if i < 0 or i >= len(positional):
+                            yield Finding(
+                                JIT_STATIC.rule_id, path, deco.lineno,
+                                f"{key} index {i} is out of range for "
+                                f"{node.name}'s {len(positional)} "
+                                "positional parameter(s)",
+                            )
+                        elif key == "static_argnums":
+                            static_idx.add(i)
+            donate = _literal_ints(kw.get("donate_argnums", ast.Constant(
+                value=None
+            )))
+            if donate:
+                overlap = static_idx.intersection(donate)
+                for i in sorted(overlap):
+                    yield Finding(
+                        JIT_STATIC.rule_id, path, deco.lineno,
+                        f"argument {i} of {node.name} is both static and "
+                        "donated — a static arg is part of the compiled "
+                        "shape and can never donate its buffer",
+                    )
+
+
+# -------------------------------------------- GL105/GL106 registry drift
+
+# suffixes the prometheus exposition appends (usage sites quote the
+# exposition name; declarations quote the family name)
+_SERIES_SUFFIXES = ("_total", "_created", "_bucket", "_count", "_sum")
+_DECL_CALLS = {"Counter", "Gauge", "Histogram", "Summary"}
+
+
+def series_base(name: str) -> str:
+    for suf in _SERIES_SUFFIXES:
+        if name.endswith(suf):
+            return name[: -len(suf)]
+    return name
+
+
+def declared_series(tree: ast.Module) -> set[str]:
+    """Series bases declared via Counter/Gauge/Histogram(...) literals
+    in a registry module (stats/metrics.py, stats/cluster.py)."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = dotted(node.func) or ""
+            if name.split(".")[-1] in _DECL_CALLS and node.args:
+                lit = _str_const(node.args[0])
+                if lit:
+                    out.add(series_base(lit))
+    return out
+
+
+def declared_stages(tree: ast.Module) -> set[str]:
+    """The TRACE_STAGES tuple literal from stats/metrics.py."""
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "TRACE_STAGES"
+                for t in node.targets
+            )
+            and isinstance(node.value, (ast.Tuple, ast.List))
+        ):
+            return {
+                s for s in (_str_const(e) for e in node.value.elts)
+                if s is not None
+            }
+    return set()
+
+
+def check_metric_registry(
+    tree: ast.Module, path: str, registry: set[str], is_registry_module: bool,
+) -> Iterator[Finding]:
+    if not registry:
+        return  # no registry context (linting a loose file set)
+    reported_decls: set[int] = set()  # Constant node ids already flagged
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = dotted(node.func) or ""
+            if (
+                name.split(".")[-1] in _DECL_CALLS
+                and node.args
+                and (_str_const(node.args[0]) or "").startswith("SeaweedFS_")
+                and not is_registry_module
+            ):
+                # one defect, one finding: the walk will reach this
+                # Constant again — suppress the usage-literal report
+                reported_decls.add(id(node.args[0]))
+                yield Finding(
+                    METRIC_REGISTRY.rule_id, path, node.lineno,
+                    f"series {_str_const(node.args[0])!r} declared outside "
+                    "stats/ — register it in stats/metrics.py or "
+                    "stats/cluster.py so the drift tests and the README "
+                    "table see it",
+                )
+        lit = _str_const(node)
+        if (
+            lit
+            and id(node) not in reported_decls
+            and re.fullmatch(r"SeaweedFS_\w+", lit)
+            and series_base(lit) not in registry
+        ):
+            yield Finding(
+                METRIC_REGISTRY.rule_id, path, getattr(node, "lineno", 0),
+                f"series literal {lit!r} does not match any series "
+                "pre-registered in stats/metrics.py / stats/cluster.py",
+            )
+
+
+def check_stage_registry(
+    tree: ast.Module, path: str, stages: set[str]
+) -> Iterator[Finding]:
+    if not stages:
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func) or ""
+        stage = None
+        if name.endswith("span") and not name.endswith("record_span"):
+            if node.args:
+                stage = _str_const(node.args[0])
+        elif name.endswith("record_span") and len(node.args) >= 2:
+            stage = _str_const(node.args[1])
+        if stage is not None and stage not in stages:
+            yield Finding(
+                STAGE_REGISTRY.rule_id, path, node.lineno,
+                f"trace stage {stage!r} is not in stats.metrics."
+                "TRACE_STAGES — add it there (pre-registered + "
+                "README-documented) before recording it",
+            )
+
+
+# --------------------------------------------------- GL108 no-silent-swallow
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [dotted(e) or "" for e in t.elts]
+    else:
+        names = [dotted(t) or ""]
+    return any(n.split(".")[-1] in _BROAD for n in names)
+
+
+def check_silent_swallow(tree: ast.Module, path: str) -> Iterator[Finding]:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad(node):
+            continue
+        if len(node.body) == 1 and isinstance(node.body[0], ast.Pass):
+            yield Finding(
+                SILENT_SWALLOW.rule_id, path, node.lineno,
+                "broad except swallows the error without a log line — "
+                "log it (debug is fine, include the trace id when one "
+                "is in scope) or narrow the exception type",
+            )
