@@ -145,6 +145,7 @@ HEADLINE_KEYS = (
     "serving_headline",
     "encode_headline",
     "scrub_headline",
+    "load_headline",
 )
 
 
@@ -1487,6 +1488,377 @@ def bench_serving_sweep(levels=(1, 16, 64, 256), reads_per_level=384):
     }
 
 
+async def _build_load_cluster(
+    tmp: str,
+    n_objects: int,
+    n_blobs: int,
+    payload: int = 4096,
+    n_big: int = 2,
+    big_payload: int = 192 * 1024,
+    warm_sizes: tuple | None = None,
+    warm_counts: tuple | None = None,
+    cache_budget: int = 2 << 30,
+):
+    """Front-door load fixture: LocalCluster with filer + S3 gateway,
+    `n_objects` uploaded through S3 PutObject and `n_blobs` through
+    direct assign (+ `n_big` large blobs whose responses exceed the
+    64KB streaming threshold, so the stall-budget write path is ON the
+    measured path), then EVERY data volume EC-encoded, device-pinned,
+    and degraded (shards 0+11 destroyed) — so every subsequent read,
+    HTTP or S3, is a degraded EC read eligible for the resident
+    dispatcher.  Returns (cluster, vs, blobs{fid: bytes},
+    big{fid: bytes}, objects{key: bytes}, bucket)."""
+    import asyncio
+
+    import aiohttp
+
+    from seaweedfs_tpu.operation import assign, upload_data
+    from seaweedfs_tpu.ops.rs_resident import DeviceShardCache
+    from seaweedfs_tpu.pb import Stub, channel, volume_server_pb2
+    from seaweedfs_tpu.serving import ServingConfig
+    from seaweedfs_tpu.server.cluster import LocalCluster
+    from seaweedfs_tpu.storage.ec.layout import TOTAL_SHARDS
+
+    bucket = "loadbench"
+    cluster = LocalCluster(
+        base_dir=tmp, n_volume_servers=1, pulse_seconds=1,
+        ec_backend="native", with_s3=True,
+    )
+    await cluster.start()
+    vs = cluster.volume_servers[0]
+    # small quantum: the fill spreads across EVERY assigned volume (the
+    # harness WANTS multi-volume contention), so ~7 volumes x 14 1MB
+    # shards must fit the budget — the default 64MB quantum would cap
+    # residency at 32 shards and silently route everything to host
+    cache = DeviceShardCache(
+        budget_bytes=cache_budget, shard_quantum=1 << 22
+    )
+    cfg = ServingConfig()
+    cache.layout = cfg.layout
+    cache.pipeline.set_slots(cfg.pipeline_slots)
+    if warm_sizes is not None:
+        cache.warm_sizes = warm_sizes
+    if warm_counts is not None:
+        cache.warm_counts = warm_counts
+    vs.store.ec_device_cache = cache
+
+    rng = np.random.default_rng(29)
+    objects: dict[str, bytes] = {}
+    async with aiohttp.ClientSession() as sess:
+        async with sess.put(f"http://{cluster.s3.url}/{bucket}") as r:
+            assert r.status < 300, f"bucket create failed: {r.status}"
+        for i in range(n_objects):
+            key = f"o{i:05d}"
+            data = rng.integers(0, 256, payload, dtype=np.uint8).tobytes()
+            async with sess.put(
+                f"http://{cluster.s3.url}/{bucket}/{key}", data=data
+            ) as r:
+                assert r.status < 300, (key, r.status)
+            objects[key] = data
+    blobs: dict[str, bytes] = {}
+    big: dict[str, bytes] = {}
+    master = cluster.master.advertise_url
+    for i in range(n_blobs + n_big):
+        a = await assign(master)
+        size = payload if i < n_blobs else big_payload
+        data = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+        await upload_data(f"http://{a.url}/{a.fid}", data)
+        (blobs if i < n_blobs else big)[a.fid] = data
+
+    # EC-encode every volume holding data; the whole key space becomes
+    # degraded EC reads
+    stub = Stub(channel(vs.grpc_url), volume_server_pb2, "VolumeServer")
+    vids = sorted(
+        v.id
+        for loc in vs.store.locations
+        for v in loc.volumes.values()
+        if v.info().file_count > 0
+    )
+    for vid in vids:
+        await stub.VolumeMarkReadonly(
+            volume_server_pb2.VolumeMarkReadonlyRequest(volume_id=vid)
+        )
+        await stub.VolumeEcShardsGenerate(
+            volume_server_pb2.VolumeEcShardsGenerateRequest(volume_id=vid)
+        )
+        await stub.VolumeEcShardsMount(
+            volume_server_pb2.VolumeEcShardsMountRequest(
+                volume_id=vid, shard_ids=list(range(TOTAL_SHARDS))
+            )
+        )
+        await stub.VolumeUnmount(
+            volume_server_pb2.VolumeUnmountRequest(volume_id=vid)
+        )
+    deadline = time.time() + 600
+    while time.time() < deadline:
+        if all(len(cache.shard_ids(v)) == TOTAL_SHARDS for v in vids):
+            break
+        await asyncio.sleep(0.25)
+    assert all(
+        len(cache.shard_ids(v)) == TOTAL_SHARDS for v in vids
+    ), "load-cluster pin timeout"
+    await asyncio.to_thread(
+        lambda: [t.join(timeout=900) for t in vs.store._pin_threads]
+    )
+    for vid in vids:
+        for sid in (0, 11):
+            await stub.VolumeEcShardsUnmount(
+                volume_server_pb2.VolumeEcShardsUnmountRequest(
+                    volume_id=vid, shard_ids=[sid]
+                )
+            )
+            cache.evict(vid, sid)
+            p = vs.store._ec_base(vid, "") + f".ec{sid:02d}"
+            if os.path.exists(p):
+                os.remove(p)
+    return cluster, vs, blobs, big, objects, bucket
+
+
+async def _load_sweep_async(
+    levels=(8, 32, 128, 512),
+    reads_per_level=768,
+    n_objects=16,
+    n_blobs=48,
+    smoke=False,
+):
+    """The r13 tentpole measurement: reads/s-vs-connections through the
+    REAL front door (loadgen closed-loop clients over real sockets,
+    zipf keys, hot-volume contention), pre-PR serving config (no QoS, no
+    zero-copy) vs the r13 config (QoS admission + zero-copy responses),
+    every read byte-verified; plus an adversarial pass (slow-client
+    dribble + connection churn) and an S3 GetObject leg whose read_route
+    attribution proves S3 GETs ride the device-resident path."""
+    import asyncio
+
+    from seaweedfs_tpu import stats as swfs_stats
+    from seaweedfs_tpu.loadgen import LoadScenario, run_http_load, run_s3_load
+
+    if smoke:
+        levels = (2, 4, 8, 16)
+        reads_per_level = 48
+        n_objects, n_blobs = 4, 12
+    tmp = tempfile.mkdtemp(prefix="bench_load_", dir=".")
+    out: dict = {
+        "levels": [int(c) for c in levels],
+        "reads_per_level": reads_per_level,
+        "smoke": bool(smoke),
+    }
+    warm_kwargs = (
+        # CI convention: CPU smoke skips the warm-plan compiles entirely
+        dict(warm_sizes=(), warm_counts=())
+        if smoke
+        else dict(warm_sizes=(4096,), warm_counts=None)
+    )
+    cluster, vs, blobs, big, objects, bucket = await _build_load_cluster(
+        tmp, n_objects, n_blobs, **warm_kwargs
+    )
+
+    def _counter(name, labels=None):
+        return swfs_stats.REGISTRY.get_sample_value(name, labels or {}) or 0.0
+
+    try:
+        cfg = vs.ec_dispatcher.cfg
+
+        async def warm(level):
+            sc = LoadScenario(
+                connections=min(level, 8), reads=max(len(blobs), 2 * level),
+                zipf_s=0.0,
+            )
+            res = await run_http_load(vs.url, dict(blobs), sc)
+            assert res.verify_failures == 0, "warm read corrupt"
+            if not smoke:
+                from seaweedfs_tpu.ops import rs_resident
+
+                deadline = time.time() + 900
+                while time.time() < deadline:
+                    if rs_resident.aot_stats()["pending"] == 0:
+                        return
+                    await asyncio.sleep(0.25)
+                raise TimeoutError("AOT executor never drained")
+
+        await warm(max(levels))
+        await warm(max(levels))  # shed retries, now warm
+        # snapshot AFTER the warm passes: the published per-stage
+        # p50/p99 must describe the measured load, not warm-up reads,
+        # cold-shape sheds, or background compiles — and the shed/stall
+        # counters are published as deltas over the same window
+        stage_before = swfs_stats.metrics.stage_histogram_snapshot()
+        shed_before = {
+            reason: _counter(
+                "SeaweedFS_volumeServer_ec_qos_shed_total",
+                {"tier": "interactive", "reason": reason},
+            )
+            for reason in ("queue_budget", "deadline", "breaker_open")
+        }
+        stalls_before = _counter(
+            "SeaweedFS_volumeServer_response_stall_aborts_total"
+        )
+
+        modes = {
+            "pre": dict(qos=False, zero_copy=False),
+            "qos_zero_copy": dict(qos=True, zero_copy=True),
+        }
+        curves: dict = {}
+        adversarial: dict = {}
+        copy_bytes: dict = {}
+        verify_failures = 0
+        for mode, knobs in modes.items():
+            cfg.qos = knobs["qos"]
+            cfg.zero_copy = knobs["zero_copy"]
+            copy0 = _counter(
+                "SeaweedFS_volumeServer_response_copy_bytes_total"
+            )
+            curve = {}
+            for c in levels:
+                sc = LoadScenario(
+                    connections=c, reads=reads_per_level, zipf_s=1.1,
+                    hot_volume_frac=0.5,
+                )
+                res = await run_http_load(vs.url, dict(blobs), sc)
+                verify_failures += res.verify_failures
+                curve[str(c)] = res.summary()
+            curves[mode] = curve
+            # adversarial pass at the top level: 10% of connections
+            # dribble, 5% of reads reconnect first, and the key space
+            # includes the large blobs so the streamed stall-budget
+            # write path (_send_body) is on the measured path — a
+            # regression there fails byte verification here
+            sc = LoadScenario(
+                connections=max(levels),
+                reads=max(reads_per_level // 2, 32),
+                zipf_s=1.1, hot_volume_frac=0.5,
+                slow_client_frac=0.1, churn=0.05,
+                dribble_delay_s=0.005,
+            )
+            # big blobs FIRST: zipf rank follows key order, so the
+            # streamed large bodies take the hot ranks and genuinely
+            # dominate this pass's reads
+            res = await run_http_load(vs.url, {**big, **blobs}, sc)
+            verify_failures += res.verify_failures
+            adversarial[mode] = res.summary()
+            # the copy-bytes window closes AFTER the adversarial pass so
+            # the verdict covers the streamed >64KB body path too — a
+            # bytes() materialization creeping into _send_body must
+            # break zero_copy_is_zero_copy, not hide outside the delta
+            copy_bytes[mode] = int(
+                _counter("SeaweedFS_volumeServer_response_copy_bytes_total")
+                - copy0
+            )
+        cfg.qos = True
+        cfg.zero_copy = True
+
+        # S3 GetObject leg (r13 config): the gateway's direct volume
+        # path must land these on the resident dispatcher — the
+        # s3_batched route delta is the attribution proof
+        s3_batched0 = _counter(
+            "SeaweedFS_volumeServer_ec_read_route_total",
+            {"route": "s3_batched"},
+        )
+        mid = levels[len(levels) // 2]
+        sc = LoadScenario(
+            connections=mid, reads=max(reads_per_level // 2, 32), zipf_s=1.1
+        )
+        s3_res = await run_s3_load(cluster.s3.url, bucket, dict(objects), sc)
+        verify_failures += s3_res.verify_failures
+        out["s3_level"] = s3_res.summary()
+        out["s3_resident_route_reads"] = int(
+            _counter(
+                "SeaweedFS_volumeServer_ec_read_route_total",
+                {"route": "s3_batched"},
+            )
+            - s3_batched0
+        )
+
+        # per-stage p50/p99 over the whole sweep, from the r07 stage
+        # histograms (the server-side view the client latencies can't
+        # decompose)
+        stage_after = swfs_stats.metrics.stage_histogram_snapshot()
+        stage_pcts = {}
+        for stage, deltas, count, _dsum in swfs_stats.metrics.stage_digest_deltas(
+            stage_before, stage_after
+        ):
+            if count <= 0:
+                continue
+            p50 = swfs_stats.quantile_from_buckets(deltas, 0.5)
+            p99 = swfs_stats.quantile_from_buckets(deltas, 0.99)
+            stage_pcts[stage] = {
+                "count": int(count),
+                "p50_us": round(p50 * 1e6, 1) if p50 is not None else None,
+                "p99_us": round(p99 * 1e6, 1) if p99 is not None else None,
+            }
+        out["stage_percentiles"] = stage_pcts
+        out["qos_shed_total"] = {
+            reason: int(
+                _counter(
+                    "SeaweedFS_volumeServer_ec_qos_shed_total",
+                    {"tier": "interactive", "reason": reason},
+                )
+                - shed_before[reason]
+            )
+            for reason in ("queue_budget", "deadline", "breaker_open")
+        }
+        out["stall_aborts"] = int(
+            _counter("SeaweedFS_volumeServer_response_stall_aborts_total")
+            - stalls_before
+        )
+
+        out["curves"] = curves
+        out["adversarial"] = adversarial
+        top = str(max(levels))
+        pre_top = curves["pre"][top]["reads_per_s"]
+        new_top = curves["qos_zero_copy"][top]["reads_per_s"]
+        out["headline"] = {
+            "load_levels": out["levels"],
+            "pre_reads_per_s": {
+                c: r["reads_per_s"] for c, r in curves["pre"].items()
+            },
+            "qos_zero_copy_reads_per_s": {
+                c: r["reads_per_s"]
+                for c, r in curves["qos_zero_copy"].items()
+            },
+            "top_connections": int(top),
+            "pre_top_reads_per_s": pre_top,
+            "qos_zero_copy_top_reads_per_s": new_top,
+            # THE r13 verdict: at the highest concurrency, the QoS +
+            # zero-copy front door must beat the pre-PR configuration
+            "qos_zero_copy_beats_pre": bool(new_top > pre_top),
+            "adversarial_pre_reads_per_s": adversarial["pre"]["reads_per_s"],
+            "adversarial_qos_reads_per_s": adversarial["qos_zero_copy"][
+                "reads_per_s"
+            ],
+            "copy_bytes_pre": copy_bytes["pre"],
+            "copy_bytes_zero_copy": copy_bytes["qos_zero_copy"],
+            "zero_copy_is_zero_copy": copy_bytes["qos_zero_copy"] == 0,
+            "s3_reads_per_s": out["s3_level"]["reads_per_s"],
+            "s3_resident_route_reads": out["s3_resident_route_reads"],
+            "s3_rides_resident_path": bool(
+                out["s3_resident_route_reads"] > 0
+            ),
+            "load_verified": bool(verify_failures == 0),
+        }
+    finally:
+        await cluster.stop()
+        from seaweedfs_tpu.pb.rpc import close_all_channels
+
+        await close_all_channels()
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+def bench_load_sweep(
+    levels=(8, 32, 128, 512), reads_per_level=768, smoke=False
+):
+    import asyncio
+
+    return asyncio.run(
+        _load_sweep_async(
+            levels=levels, reads_per_level=reads_per_level, smoke=smoke
+        )
+    )
+
+
 def probe_tpu(timeout_sec: int = 900) -> str | None:
     """Confirm the device backend can initialize before committing to it.
     A killed TPU process can leave the axon session grant held, making
@@ -1572,6 +1944,9 @@ def main():
     degraded = bench_degraded_read()
     resident = bench_degraded_read_resident()
     serving = bench_serving_sweep()
+    # r13: the concurrent-connections front door (loadgen harness) —
+    # pre-PR config vs QoS+zero-copy, adversarial clients, S3 leg
+    load_sweep = bench_load_sweep()
     scrub = bench_scrub()
     scrub_all = bench_scrub_all()
     disk_pre_mbps = bench_disk_ceiling()
@@ -1672,6 +2047,9 @@ def main():
                 "unit": "GB/s",
                 "extra": {
                     "serving": serving,
+                    "load_sweep": {
+                        k: v for k, v in load_sweep.items() if k != "headline"
+                    },
                     "scrub": scrub,
                     "scrub_all_sweep": scrub_all,
                     "cpu_native_gbps": round(cpu_bps / 1e9, 3),
@@ -1803,10 +2181,23 @@ def main():
                         "blockdiag"
                     ]["per_volume_dispatches"],
                 },
+                # r13 front-door verdict (bench_load_sweep): the
+                # reads/s-vs-connections curve, QoS+zero-copy vs the
+                # pre-PR config, plus the S3-on-resident-path proof —
+                # guaranteed inside the archived tail
+                "load_headline": load_sweep["headline"],
             })
         )
     )
 
 
 if __name__ == "__main__":
+    if len(sys.argv) >= 2 and sys.argv[1] == "bench_load_sweep":
+        # standalone front-door sweep: `python bench.py bench_load_sweep
+        # [--smoke]` — --smoke is the seconds-scale CPU-only pass that
+        # tier-1 (tests/test_loadgen.py) and the dryrun's load step run
+        # so the harness itself can't rot
+        result = bench_load_sweep(smoke="--smoke" in sys.argv[2:])
+        print(json.dumps(order_result(result)))
+        sys.exit(0)
     main()

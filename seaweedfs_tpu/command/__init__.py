@@ -29,6 +29,7 @@ from . import (
     fix,
     fsck,
     iam,
+    loadtest,
     master,
     master_follower,
     mq_broker,
@@ -51,7 +52,7 @@ COMMANDS = {
         filer_replicate, filer_remote_sync, filer_remote_gateway,
         s3, iam, webdav, mount, mq_broker,
         server, shell, fix, fsck, compact, export, backup, upload, download,
-        benchmark, scaffold, autocomplete, version,
+        benchmark, loadtest, scaffold, autocomplete, version,
     )
 }
 

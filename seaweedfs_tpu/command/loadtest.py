@@ -1,0 +1,142 @@
+"""`loadtest` — concurrent front-door load harness against a running
+cluster (seaweedfs_tpu/loadgen; the r13 successor of `weed benchmark`):
+zipf-skewed closed-loop readers over thousands of real connections, with
+slow-client dribble, connection churn, and hot-volume contention, every
+read byte-verified.  Prints one JSON line per connection level plus a
+final curve summary."""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+
+NAME = "loadtest"
+HELP = "drive concurrent read load (HTTP and/or S3) against a cluster"
+
+
+def add_args(p) -> None:
+    p.add_argument("-master", dest="master", default="127.0.0.1:9333")
+    p.add_argument(
+        "-n", dest="count", type=int, default=256,
+        help="objects to write in the fill phase (the key space)",
+    )
+    p.add_argument("-size", dest="size", type=int, default=4096)
+    p.add_argument("-collection", default="")
+    p.add_argument(
+        "-connections", default="16,64,256,1024",
+        help="comma-separated closed-loop connection counts to sweep",
+    )
+    p.add_argument(
+        "-reads", dest="reads", type=int, default=2048,
+        help="reads per connection level",
+    )
+    p.add_argument(
+        "-zipf", dest="zipf_s", type=float, default=1.1,
+        help="key-popularity zipf exponent (0 = uniform)",
+    )
+    p.add_argument(
+        "-hotVolumeFrac", dest="hot_volume_frac", type=float, default=0.0,
+        help="fraction of reads pinned onto the hottest volume",
+    )
+    p.add_argument(
+        "-slowFrac", dest="slow_frac", type=float, default=0.0,
+        help="fraction of connections that dribble-read responses",
+    )
+    p.add_argument(
+        "-churn", dest="churn", type=float, default=0.0,
+        help="per-read probability a connection reconnects first",
+    )
+    p.add_argument(
+        "-tier", default="interactive", choices=["interactive", "bulk"],
+        help="QoS tier stamped on reads (X-Seaweed-QoS)",
+    )
+    p.add_argument(
+        "-s3", dest="s3", default="",
+        help="host:port of an S3 gateway; also sweep GetObject through it",
+    )
+    p.add_argument("-bucket", default="loadtest")
+
+
+async def _fill(master: str, count: int, size: int, collection: str) -> dict:
+    """Write the key space; returns fid -> payload."""
+    from ..operation import assign, upload_data
+
+    import aiohttp
+
+    blobs: dict[str, bytes] = {}
+    sem = asyncio.Semaphore(16)
+    async with aiohttp.ClientSession() as session:
+
+        async def one(i: int) -> None:
+            async with sem:
+                a = await assign(master, collection=collection)
+                data = os.urandom(size)
+                await upload_data(
+                    f"http://{a.url}/{a.fid}", data, f"load{i}",
+                    compress=False, jwt=a.auth, session=session,
+                )
+                blobs[a.fid] = data
+
+        await asyncio.gather(*(one(i) for i in range(count)))
+    return blobs
+
+
+async def run(args) -> None:
+    from ..loadgen import LoadScenario, run_http_load, run_s3_load
+    from ..operation import lookup_file_id
+
+    blobs = await _fill(args.master, args.count, args.size, args.collection)
+    if not blobs:
+        raise SystemExit("fill phase wrote nothing")
+    # one URL base per fid (closed-loop readers hit the holder directly,
+    # like the reference benchmark)
+    any_fid = next(iter(blobs))
+    urls = await lookup_file_id(args.master, any_fid)
+    volume_url = urls[0].split("://", 1)[-1].rsplit("/", 2)[0]
+
+    levels = [int(c) for c in args.connections.split(",") if c.strip()]
+    curve = {}
+    for c in levels:
+        sc = LoadScenario(
+            connections=c, reads=args.reads, zipf_s=args.zipf_s,
+            hot_volume_frac=args.hot_volume_frac,
+            slow_client_frac=args.slow_frac, churn=args.churn,
+            tier=args.tier,
+        )
+        res = await run_http_load(volume_url, blobs, sc)
+        curve[str(c)] = res.summary()
+        print(json.dumps({"http_level": curve[str(c)]}))
+
+    s3_curve = {}
+    if args.s3:
+        import aiohttp
+
+        async with aiohttp.ClientSession() as s:
+            async with s.put(f"http://{args.s3}/{args.bucket}") as r:
+                if r.status >= 300:
+                    raise SystemExit(
+                        f"bucket create failed: HTTP {r.status}"
+                    )
+            objects = {}
+            for i, (fid, data) in enumerate(blobs.items()):
+                key = f"o{i:06d}"
+                async with s.put(
+                    f"http://{args.s3}/{args.bucket}/{key}", data=data
+                ) as r:
+                    if r.status < 300:
+                        objects[key] = data
+        for c in levels:
+            sc = LoadScenario(
+                connections=c, reads=args.reads, zipf_s=args.zipf_s,
+                slow_client_frac=args.slow_frac, churn=args.churn,
+                tier=args.tier,
+            )
+            res = await run_s3_load(args.s3, args.bucket, objects, sc)
+            s3_curve[str(c)] = res.summary()
+            print(json.dumps({"s3_level": s3_curve[str(c)]}))
+
+    print(json.dumps({
+        "reads_per_level": args.reads,
+        "http_curve": {c: r["reads_per_s"] for c, r in curve.items()},
+        "s3_curve": {c: r["reads_per_s"] for c, r in s3_curve.items()},
+    }))

@@ -108,6 +108,69 @@ def add_args(p) -> None:
         "instead of routing to host reconstruct)",
     )
     p.add_argument(
+        "-ec.serving.zerocopy.disable", dest="ec_serving_zerocopy_disable",
+        action="store_true",
+        help="materialize needle payloads as bytes on the HTTP read path "
+        "instead of streaming memoryview windows of the reconstruct/"
+        "pread buffers (the copying pre-r13 behavior; "
+        "response_copy_bytes_total measures the difference)",
+    )
+    # QoS admission control in front of the serving queue (serving/qos.py)
+    p.add_argument(
+        "-ec.qos.disable", dest="ec_qos_disable", action="store_true",
+        help="disable QoS admission control (tier budgets, deadline "
+        "shedding, breaker) — the single shared queue with only the "
+        "maxQueue backstop",
+    )
+    p.add_argument(
+        "-ec.qos.interactiveQueue", dest="ec_qos_interactive_queue",
+        type=int, default=serving_defaults.qos_interactive_queue,
+        help="max interactive-tier reads queued at once (front-door "
+        "traffic; X-Seaweed-QoS header absent or 'interactive')",
+    )
+    p.add_argument(
+        "-ec.qos.bulkQueue", dest="ec_qos_bulk_queue", type=int,
+        default=serving_defaults.qos_bulk_queue,
+        help="max bulk-tier reads queued at once (X-Seaweed-QoS: bulk) — "
+        "a narrow slice so background load can't crowd out the front door",
+    )
+    p.add_argument(
+        "-ec.qos.interactiveDeadlineMs",
+        dest="ec_qos_interactive_deadline_ms", type=int,
+        default=serving_defaults.qos_interactive_deadline_ms,
+        help="shed an interactive read to the host path at admission when "
+        "its estimated queue wait already exceeds this (0 disables)",
+    )
+    p.add_argument(
+        "-ec.qos.bulkDeadlineMs", dest="ec_qos_bulk_deadline_ms", type=int,
+        default=serving_defaults.qos_bulk_deadline_ms,
+        help="deadline budget for bulk-tier reads (0 disables)",
+    )
+    p.add_argument(
+        "-ec.qos.tripAfter", dest="ec_qos_trip_after", type=int,
+        default=serving_defaults.qos_trip_after,
+        help="consecutive sheds that trip a tier's breaker into "
+        "fast-fail (host path) until the recover cooldown's probe",
+    )
+    p.add_argument(
+        "-ec.qos.recoverSeconds", dest="ec_qos_recover_seconds", type=float,
+        default=serving_defaults.qos_recover_seconds,
+        help="breaker cooldown before a half-open probe may re-admit",
+    )
+    p.add_argument(
+        "-ec.qos.stallBudgetSeconds", dest="ec_qos_stall_budget_seconds",
+        type=float, default=serving_defaults.stall_budget_seconds,
+        help="base seconds a streamed read response may stall on a slow "
+        "client before it is disconnected (plus bytes/minRate; 0 "
+        "disables the guard)",
+    )
+    p.add_argument(
+        "-ec.qos.stallMinRateKBps", dest="ec_qos_stall_min_rate_kbps",
+        type=int, default=serving_defaults.stall_min_rate_kbps,
+        help="minimum drain rate a client must sustain for large read "
+        "responses (sizes the per-response stall budget)",
+    )
+    p.add_argument(
         "-ec.scrub.megakernel.disable", dest="ec_scrub_megakernel_disable",
         action="store_true",
         help="scrub resident EC volumes one device call per volume "
@@ -247,6 +310,16 @@ async def run(args) -> None:
             layout=args.ec_serving_layout,
             overlap=not args.ec_serving_overlap_disable,
             aot=not args.ec_serving_aot_disable,
+            zero_copy=not args.ec_serving_zerocopy_disable,
+            qos=not args.ec_qos_disable,
+            qos_interactive_queue=args.ec_qos_interactive_queue,
+            qos_bulk_queue=args.ec_qos_bulk_queue,
+            qos_interactive_deadline_ms=args.ec_qos_interactive_deadline_ms,
+            qos_bulk_deadline_ms=args.ec_qos_bulk_deadline_ms,
+            qos_trip_after=args.ec_qos_trip_after,
+            qos_recover_seconds=args.ec_qos_recover_seconds,
+            stall_budget_seconds=args.ec_qos_stall_budget_seconds,
+            stall_min_rate_kbps=args.ec_qos_stall_min_rate_kbps,
         ),
         **common_args.metrics_kwargs(args),
     )

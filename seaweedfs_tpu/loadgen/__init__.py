@@ -1,0 +1,31 @@
+"""Concurrent-load harness for the volume-server / S3 front door.
+
+ROADMAP item 2: every serving number so far came from one in-process
+bench sweep — this package is the real front door test.  It drives
+thousands of closed-loop HTTP and S3 readers with zipf-skewed keys,
+hot-volume contention, slow-client dribble, and connection churn against
+a RUNNING cluster, byte-verifies every read, and reports
+reads/s-vs-connections curves plus client-side and stage-histogram
+latency percentiles.  Consumed three ways:
+
+  * `bench.py bench_load_sweep` — the archived reads/s-vs-connections
+    curve (load_headline), pre-PR config vs QoS+zero-copy;
+  * `python -m seaweedfs_tpu loadtest` — the weed-benchmark-style CLI
+    against any live cluster;
+  * `__graft_entry__.py` dryrun step 7 / tier-1 smoke — a seconds-scale
+    sweep so the harness itself can't rot.
+
+Reference: weed/command/benchmark.go ships the same kind of driver
+(`weed benchmark`); this one adds the adversarial client behaviors the
+serving fixes of this PR exist for.
+"""
+from .workload import LoadScenario, zipf_ranks
+from .driver import LoadResult, run_http_load, run_s3_load
+
+__all__ = [
+    "LoadResult",
+    "LoadScenario",
+    "run_http_load",
+    "run_s3_load",
+    "zipf_ranks",
+]

@@ -1,0 +1,182 @@
+"""Async load drivers: closed-loop HTTP/S3 readers with adversarial
+client behaviors (dribble, churn), byte verification, and latency
+collection.
+
+Connection model: each of `scenario.connections` workers owns ONE
+aiohttp session with a single-connection pool, so N workers are N real
+TCP connections to the front door (not N coroutines multiplexed over a
+shared pool) — churn tears the socket down and reconnects, dribble
+drains the response body slower than the server's stall budget allows.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .workload import LoadScenario, percentile_ms, plan_keys
+
+
+@dataclass
+class LoadResult:
+    """One load level's outcome (all reads byte-verified when asked)."""
+
+    connections: int
+    reads_ok: int = 0
+    errors: int = 0
+    verify_failures: int = 0
+    slow_connections: int = 0
+    churns: int = 0
+    bytes_read: int = 0
+    wall_s: float = 0.0
+    latencies_s: list = field(default_factory=list)
+
+    @property
+    def reads_per_s(self) -> float:
+        return round(self.reads_ok / self.wall_s, 1) if self.wall_s else 0.0
+
+    def summary(self) -> dict:
+        return {
+            "connections": self.connections,
+            "reads_ok": self.reads_ok,
+            "errors": self.errors,
+            "verify_failures": self.verify_failures,
+            "slow_connections": self.slow_connections,
+            "churns": self.churns,
+            "bytes_read": self.bytes_read,
+            "wall_s": round(self.wall_s, 3),
+            "reads_per_s": self.reads_per_s,
+            "p50_ms": percentile_ms(self.latencies_s, 50),
+            "p99_ms": percentile_ms(self.latencies_s, 99),
+        }
+
+
+async def _run_load(
+    url_of,
+    expected,
+    scenario: LoadScenario,
+    headers: dict,
+    volume_of=None,
+) -> LoadResult:
+    """Shared closed-loop engine: `url_of(key) -> url`, `expected(key) ->
+    bytes|None` (None = skip verification for that key)."""
+    import aiohttp
+
+    keys = scenario.extra.get("keys")
+    if keys is None:
+        raise ValueError("scenario.extra['keys'] must list the key space")
+    picks = plan_keys(list(keys), scenario, volume_of=volume_of)
+    result = LoadResult(connections=scenario.connections)
+    n_slow = int(scenario.connections * scenario.slow_client_frac)
+    result.slow_connections = n_slow
+    # shard the planned sequence across workers without reordering the
+    # skew (worker w takes picks[w::N])
+    shards = [picks[w :: scenario.connections] for w in range(scenario.connections)]
+
+    def new_session():
+        return aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(limit=1),
+            timeout=aiohttp.ClientTimeout(total=120),
+        )
+
+    async def worker(wid: int, my_picks: list) -> None:
+        slow = wid < n_slow
+        rng = np.random.default_rng(scenario.seed * 7919 + wid)
+        session = new_session()
+        try:
+            for key in my_picks:
+                if scenario.churn > 0 and rng.random() < scenario.churn:
+                    await session.close()
+                    session = new_session()
+                    result.churns += 1
+                t0 = time.perf_counter()
+                try:
+                    async with session.get(url_of(key), headers=headers) as r:
+                        if slow:
+                            parts = []
+                            while True:
+                                c = await r.content.read(
+                                    scenario.dribble_chunk
+                                )
+                                if not c:
+                                    break
+                                parts.append(c)
+                                await asyncio.sleep(scenario.dribble_delay_s)
+                            body = b"".join(parts)
+                        else:
+                            body = await r.read()
+                        if r.status != 200:
+                            result.errors += 1
+                            continue
+                        clen = r.headers.get("Content-Length")
+                        if clen is not None and len(body) != int(clen):
+                            # truncated transfer (stall abort, server
+                            # reset): an ERROR, not a corruption — the
+                            # verify counter must only mean wrong BYTES
+                            result.errors += 1
+                            continue
+                except Exception:  # noqa: BLE001 — a failed read is the
+                    # datum (sheds, stall disconnects, churn races)
+                    result.errors += 1
+                    continue
+                result.latencies_s.append(time.perf_counter() - t0)
+                result.bytes_read += len(body)
+                if scenario.verify:
+                    want = expected(key)
+                    if want is not None and body != want:
+                        result.verify_failures += 1
+                        continue
+                result.reads_ok += 1
+        finally:
+            await session.close()
+
+    t0 = time.perf_counter()
+    await asyncio.gather(
+        *(worker(w, shards[w]) for w in range(scenario.connections))
+    )
+    result.wall_s = time.perf_counter() - t0
+    return result
+
+
+async def run_http_load(
+    volume_url: str,
+    blobs: dict,
+    scenario: LoadScenario,
+) -> LoadResult:
+    """Drive the volume server's HTTP data plane directly: `blobs` maps
+    fid -> expected payload bytes (or None to skip verification).  The
+    QoS tier rides the X-Seaweed-QoS header."""
+    scenario.extra.setdefault("keys", list(blobs))
+    headers = {"X-Seaweed-QoS": scenario.tier}
+    return await _run_load(
+        lambda fid: f"http://{volume_url}/{fid}",
+        blobs.get,
+        scenario,
+        headers,
+        volume_of=lambda fid: fid.split(",")[0],
+    )
+
+
+async def run_s3_load(
+    s3_url: str,
+    bucket: str,
+    objects: dict,
+    scenario: LoadScenario,
+) -> LoadResult:
+    """Drive the S3 gateway's GetObject path: `objects` maps key ->
+    expected bytes (or None).  Anonymous requests (the harness targets
+    an IAM-less test gateway; a signed driver belongs to the client SDK
+    tests, not the load path).  The scenario tier rides X-Seaweed-QoS —
+    the gateway forwards it onto its direct volume reads."""
+    scenario.extra.setdefault("keys", list(objects))
+    return await _run_load(
+        lambda key: f"http://{s3_url}/{bucket}/{key}",
+        objects.get,
+        scenario,
+        headers={"X-Seaweed-QoS": scenario.tier},
+        volume_of=None,
+    )
+
+

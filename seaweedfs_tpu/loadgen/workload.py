@@ -1,0 +1,93 @@
+"""Workload shapes for the load harness: key skew and client behavior.
+
+Pure functions + a dataclass — no sockets — so the skew math and the
+scenario knobs are unit-testable without a cluster.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+@dataclass
+class LoadScenario:
+    """One load level: how many clients, how they pick keys, and how
+    adversarially they behave on the wire."""
+
+    connections: int  # concurrent closed-loop clients
+    reads: int  # total reads across all clients this level
+    # key skew: zipf exponent over the key popularity ranks; 0 = uniform.
+    # ~1.1 models a CDN-ish hot set (a handful of keys take most reads)
+    zipf_s: float = 1.1
+    # hot-volume contention: this fraction of reads is forced onto keys
+    # of ONE volume (the first key's volume), so per-volume batching and
+    # the dispatcher queue see a genuinely contended volume
+    hot_volume_frac: float = 0.0
+    # slow clients: this fraction of connections drains responses in
+    # dribble_chunk pieces with dribble_delay_s sleeps between them —
+    # the client the per-response stall budget exists for
+    slow_client_frac: float = 0.0
+    dribble_chunk: int = 512
+    dribble_delay_s: float = 0.02
+    # connection churn: probability a client tears down its session and
+    # reconnects (fresh TCP + TLS-less handshake) before a read
+    churn: float = 0.0
+    # QoS tier stamped on requests (X-Seaweed-QoS)
+    tier: str = "interactive"
+    # byte-verify every response against the expected blob
+    verify: bool = True
+    seed: int = 1337
+    # populated by callers that know the key->volume mapping
+    extra: dict = field(default_factory=dict)
+
+
+def zipf_ranks(n_keys: int, n_samples: int, s: float, rng) -> np.ndarray:
+    """Sample `n_samples` key indices in [0, n_keys) with popularity
+    rank r drawn ∝ 1/(r+1)^s (s=0 → uniform).  Deterministic under the
+    caller's rng, bounded (unlike numpy's unbounded zipf sampler), and
+    O(n_keys) memory."""
+    if n_keys <= 0:
+        raise ValueError("n_keys must be >= 1")
+    if s <= 0:
+        return rng.integers(0, n_keys, size=n_samples)
+    weights = 1.0 / np.power(np.arange(1, n_keys + 1, dtype=np.float64), s)
+    weights /= weights.sum()
+    return rng.choice(n_keys, size=n_samples, p=weights)
+
+
+def plan_keys(
+    keys: list[str],
+    scenario: LoadScenario,
+    volume_of=None,
+) -> list[str]:
+    """The full per-level read sequence: zipf-skewed key picks, with
+    `hot_volume_frac` of them re-pinned onto the hottest volume's keys
+    when a `volume_of(key)` mapping is supplied."""
+    rng = np.random.default_rng(scenario.seed)
+    idx = zipf_ranks(len(keys), scenario.reads, scenario.zipf_s, rng)
+    picks = [keys[i] for i in idx]
+    if scenario.hot_volume_frac > 0 and volume_of is not None:
+        by_vol: dict = {}
+        for k in keys:
+            by_vol.setdefault(volume_of(k), []).append(k)
+        hot_keys = max(by_vol.values(), key=len)
+        hot_mask = rng.random(len(picks)) < scenario.hot_volume_frac
+        hot_picks = zipf_ranks(
+            len(hot_keys), int(hot_mask.sum()), scenario.zipf_s, rng
+        )
+        j = 0
+        for i, hot in enumerate(hot_mask):
+            if hot:
+                picks[i] = hot_keys[hot_picks[j]]
+                j += 1
+    return picks
+
+
+def percentile_ms(latencies_s: list[float], p: float) -> float | None:
+    """Client-side latency percentile in ms (None when no samples)."""
+    if not latencies_s:
+        return None
+    xs = sorted(latencies_s)
+    i = min(len(xs) - 1, int(p / 100.0 * len(xs)))
+    return round(xs[i] * 1e3, 3)

@@ -5,10 +5,18 @@ and in-flight-bytes limits, global and per-bucket, per-action, configured
 in /etc/s3/circuit_breaker.json (shell: s3.circuitbreaker) and applied
 live.  Exceeding any limit rejects the request with 503 SlowDown rather
 than queueing, so an overloaded gateway degrades predictably.
+
+Trip/recover rides the SAME `serving.qos.Breaker` the volume server's
+QoS admission uses (one overload policy across the S3 front door and the
+EC serving queue): sustained limit-rejections trip a per-scope breaker
+that fast-fails further requests without re-walking the limit table,
+then half-opens after its cooldown for a probe.
 """
 from __future__ import annotations
 
 import json
+
+from ..serving.qos import Breaker
 
 
 class CircuitBreakerError(Exception):
@@ -16,10 +24,31 @@ class CircuitBreakerError(Exception):
 
 
 class CircuitBreaker:
+    # consecutive rejections that trip a scope + the fast-fail cooldown;
+    # deliberately the Breaker's own defaults scaled for a public
+    # gateway (a storm of 503s means the limit table is saturated — stop
+    # paying the walk per request until the cooldown probe)
+    TRIP_AFTER = 32
+    RECOVER_S = 1.0
+
     def __init__(self):
         self.cfg: dict = {}
         # in-flight gauges: (scope, action, type) -> current value
         self._inflight: dict[tuple[str, str, str], int] = {}
+        # per-(scope, action) trip/recover state ("" = global scope;
+        # action is the LIMIT's action key, incl. "Total").  Keyed by
+        # action so a saturated Write limit fast-fails writes without
+        # 503ing reads whose own limits have free capacity.
+        self._breakers: dict[tuple[str, str], Breaker] = {}
+
+    def breaker(self, scope: str, action: str = "Total") -> Breaker:
+        key = (scope, action)
+        br = self._breakers.get(key)
+        if br is None:
+            br = self._breakers[key] = Breaker(
+                trip_after=self.TRIP_AFTER, cooldown_s=self.RECOVER_S
+            )
+        return br
 
     def load(self, blob: bytes) -> None:
         """Parse + validate; malformed limit values are dropped at load
@@ -58,6 +87,21 @@ class CircuitBreaker:
         """Reserve capacity or raise; returns a release() callable.
         `content_length=None` (chunked upload) under an MB limit is
         rejected — an unbounded body must not slip past a byte cap."""
+        # fast-fail while a matching breaker is open: that LIMIT was
+        # saturated trip_after times in a row — reject without walking
+        # the table again until the cooldown's half-open probe.  Only
+        # the request's own action (or Total) keys are consulted, so a
+        # tripped Write limit never 503s reads.
+        for key in (
+            ("", action), ("", "Total"), (bucket, action), (bucket, "Total")
+        ):
+            br = self._breakers.get(key)
+            if br is not None and not br.allow():
+                raise CircuitBreakerError(
+                    f"breaker open for {key[1]}"
+                    + (f" in bucket {key[0]}" if key[0] else "")
+                    + "; retry after cooldown"
+                )
         costs = {"Count": 1, "MB": content_length}
         taken: list[tuple[tuple[str, str, str], int]] = []
         for scope, act, ltype, limit in self._limits(bucket, action):
@@ -65,6 +109,9 @@ class CircuitBreaker:
             if ltype == "MB" and cost is None:
                 for kk, cc in taken:
                     self._inflight[kk] -= cc
+                # a per-request client protocol error, NOT saturation:
+                # must not feed the breaker (one broken client retrying
+                # chunked uploads could otherwise 503 the whole scope)
                 raise CircuitBreakerError(
                     "Content-Length required under an MB limit"
                 )
@@ -76,12 +123,18 @@ class CircuitBreaker:
             if cur + cost > limit_abs:
                 for kk, cc in taken:  # roll back partial reservations
                     self._inflight[kk] -= cc
+                self.breaker(scope, act).record_rejection()
                 raise CircuitBreakerError(
                     f"concurrent {act}:{ltype} limit {limit} reached"
                     + (f" for bucket {scope}" if scope else "")
                 )
             self._inflight[k] = cur + cost
             taken.append((k, cost))
+        for key in (
+            ("", action), ("", "Total"), (bucket, action), (bucket, "Total")
+        ):
+            if key in self._breakers:
+                self._breakers[key].record_success()
 
         def release():
             for kk, cc in taken:

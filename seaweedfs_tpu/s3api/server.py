@@ -89,6 +89,9 @@ class S3ApiServer:
         iam: IdentityAccessManagement | None = None,
         metrics_address: str = "",  # pushgateway host:port (ref -metrics.address)
         metrics_interval_seconds: int = 15,  # ref -metrics.intervalSeconds
+        direct_volume_reads: bool = True,  # GETs fetch chunks straight
+        # from the volume servers (one hop less; EC chunks ride the
+        # device-resident dispatcher) instead of proxying the filer
     ):
         self.metrics_address = metrics_address
         self.metrics_interval_seconds = metrics_interval_seconds
@@ -104,6 +107,10 @@ class S3ApiServer:
         self._session: aiohttp.ClientSession | None = None
         self._stub_cache = None
         self._iam_refresh: asyncio.Task | None = None
+        self.direct_volume_reads = direct_volume_reads
+        # file_id volume -> (fetched_at, [volume urls]); same 10s TTL the
+        # volume server uses for its EC location cache
+        self._vol_loc_cache: dict[str, tuple[float, list[str]]] = {}
         from .circuit_breaker import CircuitBreaker
 
         self.circuit_breaker = CircuitBreaker()
@@ -948,6 +955,207 @@ class S3ApiServer:
             body=stream, content_type="application/octet-stream"
         )
 
+    # ----------------------------------------------- direct volume reads
+
+    async def _direct_urls(self, file_id: str) -> list[str]:
+        """Volume-server URLs holding `file_id`'s volume, via the filer's
+        LookupVolume gRPC (which consults the master), cached 10s."""
+        vid = file_id.split(",")[0]
+        now = time.time()
+        cached = self._vol_loc_cache.get(vid)
+        if cached and now - cached[0] < 10.0:
+            return cached[1]
+        resp = await self._stub().LookupVolume(
+            filer_pb2.LookupVolumeRequest(volume_ids=[vid])
+        )
+        urls = []
+        if vid in resp.locations_map:
+            urls = [l.url for l in resp.locations_map[vid].locations]
+        self._vol_loc_cache[vid] = (now, urls)
+        return urls
+
+    async def _fetch_view_direct(self, view, tier: str) -> bytes:
+        """One ChunkView's bytes straight from a volume server.  The
+        request forwards the client's QoS tier (default interactive) and
+        the s3 origin tag, so the volume server's dispatcher admits it
+        under the right budget and attributes it in the read_route
+        series (s3_batched = this read rode the device-resident path)."""
+        from .. import obs
+
+        urls = await self._direct_urls(view.file_id)
+        if not urls:
+            raise RuntimeError(f"chunk {view.file_id}: no locations")
+        hdr = {
+            "X-Seaweed-QoS": tier,
+            "X-Seaweed-Read-Origin": "s3",
+            **obs.outbound_headers(),
+        }
+        if not (view.offset_in_chunk == 0 and view.view_size == view.chunk_size):
+            hdr["Range"] = (
+                f"bytes={view.offset_in_chunk}-"
+                f"{view.offset_in_chunk + view.view_size - 1}"
+            )
+        last_err = None
+        for url in urls:
+            try:
+                async with self._session.get(
+                    f"http://{url}/{view.file_id}", headers=hdr
+                ) as r:
+                    if r.status >= 300:
+                        raise RuntimeError(f"{url}: HTTP {r.status}")
+                    data = await r.read()
+                    if len(data) != view.view_size:
+                        # a wrong-length 2xx (stale replica, stripped
+                        # Range) stitched into a committed
+                        # Content-Length stream would corrupt the
+                        # object silently — treat as a failed replica
+                        raise RuntimeError(
+                            f"{url}: got {len(data)} bytes, "
+                            f"want {view.view_size}"
+                        )
+                    return data
+            except Exception as e:  # noqa: BLE001 — try the next replica
+                last_err = e
+        raise RuntimeError(f"chunk {view.file_id}: {last_err}")
+
+    async def _get_object_direct(
+        self, request: web.Request, entry: filer_pb2.Entry
+    ) -> web.StreamResponse | None:
+        """Serve a GET/HEAD straight from the volume servers, skipping
+        the filer HTTP hop (at thousands of connections the extra proxy
+        hop IS the front door's ceiling; EC-volume chunks additionally
+        land on the volume server's device-resident dispatcher instead
+        of a second-hand host reconstruct).  Returns None when the
+        object needs the filer's richer streaming (manifest chains,
+        cipher, compressed chunks, remote mounts) — the caller falls
+        back to the proxy path."""
+        from ..filer.filechunks import total_size, view_from_chunks
+        from ..serving.qos import normalize_tier
+
+        tier = normalize_tier(request.headers.get("X-Seaweed-QoS"))
+        if any(
+            c.is_chunk_manifest or bytes(c.cipher_key) or c.is_compressed
+            for c in entry.chunks
+        ):
+            return None
+        if entry.extended.get("remote.key"):
+            return None  # remote-mounted: only the filer has the backend
+        inline = bytes(entry.content)
+        # extent-based size (max chunk offset+size), NOT the sum of
+        # chunk sizes: overlapping/overwritten chunks would inflate a
+        # sum and the response would be zero-padded to the wrong length
+        total = max(
+            total_size(entry.chunks),
+            int(entry.attributes.file_size),
+            len(inline),
+        )
+        if not entry.chunks and not inline and total > 0:
+            return None  # data lives somewhere we can't see; let the filer
+        offset, size, status = 0, total, 200
+        headers = {
+            "ETag": f'"{_entry_etag(entry)}"',
+            "Accept-Ranges": "bytes",
+        }
+        rng = request.http_range
+        if rng.start is not None or rng.stop is not None:
+            start = rng.start or 0
+            if start < 0:  # suffix range "bytes=-N"
+                start, stop = max(total + start, 0), total
+            else:
+                stop = min(rng.stop if rng.stop is not None else total, total)
+            if start >= stop:
+                raise web.HTTPRequestRangeNotSatisfiable()
+            offset, size, status = start, stop - start, 206
+            headers["Content-Range"] = (
+                f"bytes {start}-{start + size - 1}/{total}"
+            )
+        if entry.attributes.mtime:
+            from ..server.conditional import format_http_date
+
+            headers["Last-Modified"] = format_http_date(entry.attributes.mtime)
+        from ..server.conditional import canonical_header, is_persisted_header
+
+        for k, v in entry.extended.items():
+            if k.startswith("x-amz-meta-"):
+                headers[k] = v.decode()
+            elif is_persisted_header(k):
+                headers[canonical_header(k)] = v.decode("utf-8", "replace")
+        content_type = entry.attributes.mime or "application/octet-stream"
+        ct_override = request.query.get("response-content-type", "")
+        for q, hdr in _RESPONSE_OVERRIDES.items():
+            if q in request.query:
+                headers[hdr] = request.query[q]
+        if ct_override:
+            content_type = ct_override
+        headers["Content-Length"] = str(size)
+        if request.method == "HEAD":
+            return web.Response(
+                status=status, headers=headers, content_type=content_type
+            )
+        # plan + fetch the FIRST piece before prepare(): the overwhelming
+        # single-chunk case still falls back cleanly to the filer proxy
+        # on any volume-read failure; only a multi-chunk object can fail
+        # mid-stream (connection abort, like any proxy would)
+        pos, stop = offset, offset + size
+        pieces: list = []  # (kind, payload) lazily materialized
+        if inline and pos < len(inline):
+            end = min(stop, len(inline))
+            pieces.append(("bytes", memoryview(inline)[pos:end]))
+            pos = end
+        views = (
+            view_from_chunks(entry.chunks, pos, stop - pos)
+            if pos < stop else []
+        )
+        first = None
+        for v in views:
+            if v.view_offset > pos:
+                pieces.append(("bytes", b"\x00" * (v.view_offset - pos)))
+            pieces.append(("view", v))
+            pos = v.view_offset + v.view_size
+        if pos < stop:
+            pieces.append(("bytes", b"\x00" * (stop - pos)))
+        async def piece_data(i: int) -> bytes:
+            kind, payload = pieces[i]
+            if kind == "bytes":
+                return payload
+            if first is not None and i == first[0]:
+                return first[1]
+            return await self._fetch_view_direct(payload, tier)
+
+        for i, (kind, _payload) in enumerate(pieces):
+            if kind == "view":
+                first = (i, await piece_data(i))
+                break
+        resp = web.StreamResponse(status=status, headers=headers)
+        resp.content_type = content_type
+        await resp.prepare(request)
+        # one-piece prefetch pipeline: fetch(i+1) runs while piece i
+        # writes to the client, so a multi-chunk object pays
+        # max(fetch, write) per piece instead of their sum
+        nxt = None
+        try:
+            for i in range(len(pieces)):
+                cur = nxt if nxt is not None else asyncio.ensure_future(
+                    piece_data(i)
+                )
+                nxt = (
+                    asyncio.ensure_future(piece_data(i + 1))
+                    if i + 1 < len(pieces) else None
+                )
+                await resp.write(await cur)
+            await resp.write_eof()
+        except Exception as e:  # noqa: BLE001 — once prepared, the
+            # response CANNOT fall back to the filer proxy (a second
+            # response on the same socket would corrupt the payload
+            # inside the first one's framing): abort the connection so
+            # the client sees a truncated transfer, not silent junk
+            log.debug("direct volume read aborted mid-stream: %s", e)
+            if nxt is not None:
+                nxt.cancel()
+            if request.transport is not None:
+                request.transport.abort()
+        return resp
+
     async def get_object(self, bucket: str, key: str, request: web.Request) -> web.StreamResponse:
         if any(
             p in request.query
@@ -967,6 +1175,20 @@ class S3ApiServer:
         precond = self._check_preconditions(request, entry)
         if precond is not None:
             return precond
+        if self.direct_volume_reads:
+            try:
+                resp = await self._get_object_direct(request, entry)
+                if resp is not None:
+                    return resp
+            except web.HTTPException:
+                raise
+            except Exception as e:  # noqa: BLE001 — direct path is an
+                # optimization; any volume-side failure falls back to
+                # the filer proxy below rather than surfacing
+                log.debug(
+                    "direct volume read of %s/%s fell back: %s",
+                    bucket, key, e,
+                )
         headers = {}
         if "Range" in request.headers:
             headers["Range"] = request.headers["Range"]

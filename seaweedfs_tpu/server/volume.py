@@ -786,6 +786,7 @@ class VolumeServer:
                 size_hint = (
                     await asyncio.to_thread(v.deleted_needle_size, nid) or 0
                 )
+        serving_cfg = self.ec_dispatcher.cfg
         async with self.download_limiter(size_hint):
             try:
                 if v is not None:
@@ -795,14 +796,24 @@ class VolumeServer:
                         nid,
                         cookie,
                         read_deleted,
+                        serving_cfg.zero_copy,
                     )
                 else:
                     # the serving dispatcher routes per volume: resident
                     # volumes coalesce into pipelined device-resident
                     # reconstruct batches; unpinned/cache-less volumes
                     # (whose concurrent disk reads must not serialize
-                    # behind a batch queue) take the native path inside
-                    n = await self.ec_dispatcher.read(vid, nid, cookie)
+                    # behind a batch queue) take the native path inside.
+                    # QoS tier + origin ride in on headers (the S3
+                    # gateway's direct path and the load harness set
+                    # them; absent = interactive front-door traffic)
+                    n = await self.ec_dispatcher.read(
+                        vid, nid, cookie,
+                        tier=request.headers.get("X-Seaweed-QoS", ""),
+                        origin=request.headers.get(
+                            "X-Seaweed-Read-Origin", ""
+                        ),
+                    )
             except (NotFoundError, KeyError):
                 raise web.HTTPNotFound()
             except CookieMismatch:
@@ -874,7 +885,13 @@ class VolumeServer:
             # BEFORE decompress/resize: a 304 exists to skip the body work;
             # keep the validators so caches can refresh their entry
             return web.Response(status=304, headers=headers)
-        body = n.data
+        copied = 0  # response-path bytes COPIED serving this request
+        body = n.data  # memoryview on the zero-copy parse, else bytes
+        if isinstance(body, bytes) and body:
+            # the copying parse already materialized the payload once —
+            # that copy is exactly what the zero-copy path removes, so
+            # it is what the counter measures
+            copied += len(body)
         if n.is_compressed:
             # transforms need pixels: never hand gzip bytes to crop/resize
             # (they would pass through untouched yet carry the variant
@@ -887,30 +904,121 @@ class VolumeServer:
                 import gzip as _gz
 
                 body = _gz.decompress(body)
+                copied += len(body)
         if crop:
             # reference order: crop first, then resize (volume_server_
             # handlers_read.go shouldCropImages + shouldResizeImages)
             from ..images import cropped
 
-            body = await asyncio.to_thread(cropped, body, cx1, cy1, cx2, cy2)
+            body = await asyncio.to_thread(
+                cropped, bytes(body), cx1, cy1, cx2, cy2
+            )
+            copied += len(body)
         if resize:
             from ..images import resized
 
-            body = await asyncio.to_thread(resized, body, rw, rh, rmode)
+            body = await asyncio.to_thread(resized, bytes(body), rw, rh, rmode)
+            copied += len(body)
         if request.method == "HEAD":
+            stats.VOLUME_SERVER_RESPONSE_COPY_BYTES.inc(copied)
             return web.Response(
                 status=200, headers={**headers, "Content-Length": str(len(body))},
                 content_type=ct,
             )
         # range support
+        status = 200
         rng = request.http_range
         if rng.start is not None or rng.stop is not None:
             start = rng.start or 0
-            stop = rng.stop if rng.stop is not None else len(body)
-            part = body[start:stop]
+            if start < 0:  # suffix range "bytes=-N": last N bytes
+                start, stop = max(len(body) + start, 0), len(body)
+            else:
+                stop = min(
+                    rng.stop if rng.stop is not None else len(body),
+                    len(body),
+                )
+            if start >= stop:
+                # a 206 with an empty body and end<start Content-Range
+                # would read as "object ends here" to resuming clients
+                raise web.HTTPRequestRangeNotSatisfiable(
+                    headers={"Content-Range": f"bytes */{len(body)}"}
+                )
+            # memoryview slice = zero-copy window; a bytes slice copies
+            part = memoryview(body)[start:stop] if isinstance(
+                body, memoryview
+            ) else body[start:stop]
+            if isinstance(part, bytes):
+                copied += len(part)
             headers["Content-Range"] = f"bytes {start}-{start + len(part) - 1}/{len(body)}"
-            return web.Response(status=206, body=part, headers=headers, content_type=ct)
-        return web.Response(body=body, headers=headers, content_type=ct)
+            body = part
+            status = 206
+        stats.VOLUME_SERVER_RESPONSE_COPY_BYTES.inc(copied)
+        return await self._send_body(request, status, body, headers, ct)
+
+    # streamed-write chunk; also the threshold below which a body rides
+    # web.Response (a small body sits in the socket buffer regardless of
+    # how slowly the client drains — nothing worth bounding)
+    _STREAM_CHUNK = 64 * 1024
+
+    async def _send_body(
+        self,
+        request: web.Request,
+        status: int,
+        body,
+        headers: dict,
+        ct: str,
+    ) -> web.StreamResponse:
+        """Write a read response body.  Large bodies stream in chunks
+        (memoryview windows — no further copies) under a per-response
+        stall budget scaled by size, the way r06 bounded mount reads: a
+        dribbling client that can't drain within the budget is
+        disconnected (counted in response_stall_aborts_total) instead of
+        holding the download byte-lease and the needle buffers open."""
+        cfg = self.ec_dispatcher.cfg
+        budget = cfg.stall_budget_for(len(body))
+        if len(body) <= self._STREAM_CHUNK or budget <= 0:
+            return web.Response(
+                status=status, body=body, headers=headers, content_type=ct
+            )
+        resp = web.StreamResponse(
+            status=status,
+            headers={**headers, "Content-Length": str(len(body))},
+        )
+        resp.content_type = ct
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + budget
+        mv = memoryview(body)
+        try:
+            await resp.prepare(request)
+            for off in range(0, len(mv), self._STREAM_CHUNK):
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    raise asyncio.TimeoutError
+                # write() returns once the chunk is buffered; it only
+                # awaits when the transport is above its high-water mark
+                # — i.e. exactly when the client is the bottleneck
+                await asyncio.wait_for(
+                    resp.write(mv[off : off + self._STREAM_CHUNK]),
+                    timeout=remaining,
+                )
+            await resp.write_eof()
+        except ConnectionResetError:
+            # the client went away on its own (churn, cancel): not a
+            # stall — nothing to abort, nothing to count as dribbling
+            log.debug("client disconnected mid-response")
+        except asyncio.TimeoutError:
+            stats.VOLUME_SERVER_RESPONSE_STALL_ABORTS.inc()
+            log.warning(
+                "read response stalled past its %.1fs budget "
+                "(%d bytes); disconnecting slow client", budget, len(mv),
+            )
+            if request.transport is not None:
+                # abort, not close: close() flushes the transport's
+                # buffered backlog first, which a dribbling client would
+                # keep draining for minutes — the budget's whole point
+                # is to stop paying for this socket NOW
+                request.transport.abort()
+        return resp
 
     async def _read_remote(self, request: web.Request, vid: int) -> web.StreamResponse:
         """Volume not local: proxy to or redirect at a peer holding it

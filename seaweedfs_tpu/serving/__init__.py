@@ -25,5 +25,14 @@ weed/storage/store_ec.go:339-393.
 from .config import ServingConfig
 from .coalescer import Coalescer, ReadRequest
 from .dispatcher import EcReadDispatcher
+from .qos import Breaker, QosController, normalize_tier
 
-__all__ = ["Coalescer", "EcReadDispatcher", "ReadRequest", "ServingConfig"]
+__all__ = [
+    "Breaker",
+    "Coalescer",
+    "EcReadDispatcher",
+    "QosController",
+    "ReadRequest",
+    "ServingConfig",
+    "normalize_tier",
+]

@@ -24,6 +24,9 @@ class ReadRequest:
     # serves this request runs outside the request's context, so the
     # trace must ride the queue with the request (obs/trace.py)
     obs_ctx: object | None = None
+    # QoS tier this request was admitted under (serving/qos.py): the
+    # drain loop must credit the SAME tier's budget back at take time
+    tier: str = "interactive"
 
 
 class Coalescer:

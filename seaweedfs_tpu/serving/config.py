@@ -52,10 +52,58 @@ class ServingConfig:
     # dispatcher 20-40s behind an inline compile.  False restores the
     # legacy trace-and-execute warm and inline compiles.
     aot: bool = True
+    # zero-copy response writes (-ec.serving.zerocopy.disable): needle
+    # payloads stay memoryviews over the reconstruct/pread buffers all
+    # the way into the aiohttp body write; False restores the legacy
+    # bytes-materializing path (the r13 load bench's comparison axis).
+    # SeaweedFS_volumeServer_response_copy_bytes_total measures the
+    # difference.
+    zero_copy: bool = True
+    # QoS admission control (-ec.qos.disable): per-tier queue budgets,
+    # deadline-aware shedding, and a trip/recover breaker in front of
+    # the coalescer (serving/qos.py).  False = the pre-r13 single
+    # shared queue with only the max_queue backstop.
+    qos: bool = True
+    # per-tier queue budgets: how many requests of each tier may sit in
+    # the coalescer at once (-ec.qos.interactiveQueue / -ec.qos.bulkQueue).
+    # The defaults PARTITION max_queue (1792 + 256 = 2048), so a tier
+    # budget always binds before the global backstop and bulk can never
+    # crowd the front door out of the queue.
+    qos_interactive_queue: int = 1792
+    qos_bulk_queue: int = 256
+    # deadline budgets (ms): a request whose ESTIMATED queue wait (EWMA
+    # of recent per-needle service time x queue depth / pipeline width)
+    # already exceeds its tier deadline sheds to the host path at
+    # admission instead of timing out inside the queue.  0 disables
+    # deadline shedding for the tier (-ec.qos.*DeadlineMs).
+    qos_interactive_deadline_ms: int = 2000
+    qos_bulk_deadline_ms: int = 20000
+    # breaker: this many CONSECUTIVE sheds trip a tier's breaker
+    # (fast-fail to host) for recoverSeconds, then half-open probe
+    # (-ec.qos.tripAfter / -ec.qos.recoverSeconds)
+    qos_trip_after: int = 64
+    qos_recover_seconds: float = 1.0
+    # slow-client guard: per-response stall budget for streamed bodies =
+    # stall_budget_seconds + body_bytes / (stall_min_rate_kbps KB/s); a
+    # client draining slower than that is disconnected so it can't hold
+    # the download byte-lease + needle buffers open
+    # (-ec.qos.stallBudgetSeconds / -ec.qos.stallMinRateKBps, 0 budget
+    # disables the guard)
+    stall_budget_seconds: float = 30.0
+    stall_min_rate_kbps: int = 64
 
     @property
     def max_wait_s(self) -> float:
         return self.max_wait_us / 1e6
+
+    def stall_budget_for(self, nbytes: int) -> float:
+        """Total seconds a streamed response of `nbytes` may take before
+        the dribbling client is disconnected (0 = unbounded)."""
+        if self.stall_budget_seconds <= 0:
+            return 0.0
+        return self.stall_budget_seconds + nbytes / (
+            max(1, self.stall_min_rate_kbps) * 1024.0
+        )
 
     @property
     def pipeline_slots(self) -> int:
@@ -72,4 +120,17 @@ class ServingConfig:
             raise ValueError("max_wait_us must be >= 0")
         if self.layout not in ("flat", "blockdiag"):
             raise ValueError("layout must be 'flat' or 'blockdiag'")
+        if self.qos_interactive_queue < 1 or self.qos_bulk_queue < 1:
+            raise ValueError("qos tier queue budgets must be >= 1")
+        if (
+            self.qos_interactive_deadline_ms < 0
+            or self.qos_bulk_deadline_ms < 0
+        ):
+            raise ValueError("qos deadlines must be >= 0 (0 disables)")
+        if self.qos_trip_after < 1:
+            raise ValueError("qos_trip_after must be >= 1")
+        if self.qos_recover_seconds <= 0:
+            raise ValueError("qos_recover_seconds must be > 0")
+        if self.stall_min_rate_kbps < 1:
+            raise ValueError("stall_min_rate_kbps must be >= 1")
         return self

@@ -42,6 +42,7 @@ import time
 from .. import obs, stats
 from .coalescer import Coalescer, ReadRequest
 from .config import ServingConfig
+from .qos import QosController, normalize_tier
 
 log = logging.getLogger("serving")
 
@@ -64,6 +65,7 @@ class EcReadDispatcher:
         self._remote_reader = remote_reader_factory
         self.cfg = (config or ServingConfig()).validated()
         self.coalescer = Coalescer(self.cfg.max_batch, self.cfg.max_queue)
+        self.qos = QosController.from_config(self.cfg)
         self._inflight = 0
 
     # ----------------------------------------------------------- telemetry
@@ -86,34 +88,78 @@ class EcReadDispatcher:
         batch overwrites it — a restarted server must report idle."""
         stats.VOLUME_SERVER_EC_BATCH_INFLIGHT.set(0)
         stats.VOLUME_SERVER_EC_QUEUE_DEPTH.set(0)
+        self.qos.shutdown()
 
     # ------------------------------------------------------------- admission
 
-    async def read(self, vid: int, nid: int, cookie: int | None):
+    def _route(self, route: str, origin: str) -> None:
+        """Count the admitting route; S3-originated reads (the gateway's
+        direct volume path) are attributed IN ADDITION under s3_<route>
+        so a dashboard can see S3 GETs riding the resident dispatcher."""
+        stats.VOLUME_SERVER_EC_READ_ROUTE.labels(route=route).inc()
+        if origin == "s3":
+            stats.VOLUME_SERVER_EC_READ_ROUTE.labels(
+                route=f"s3_{route}"
+            ).inc()
+
+    async def read(
+        self,
+        vid: int,
+        nid: int,
+        cookie: int | None,
+        tier: str = "interactive",
+        origin: str = "",
+    ):
         """Serve one EC needle read; returns a Needle or raises the
-        per-needle error (NeedleNotFound / CookieMismatch / ...)."""
+        per-needle error (NeedleNotFound / CookieMismatch / ...).
+        `tier` is the QoS tier (serving/qos.py; unknown values map to
+        interactive); `origin` attributes the read's source in the
+        read_route series ("s3" = the gateway's direct volume path)."""
         cfg = self.cfg
+        tier = normalize_tier(tier)
         if not cfg.enabled:
             # dispatcher disabled = the pre-batching per-read behavior,
             # device reconstruct included: an idle device on a resident
             # volume should still serve width-1 reads
-            stats.VOLUME_SERVER_EC_READ_ROUTE.labels(route="native").inc()
+            self._route("native", origin)
             return await self._read_native(vid, nid, cookie, use_device=True)
         if not self.store.ec_volume_is_resident(vid):
-            stats.VOLUME_SERVER_EC_READ_ROUTE.labels(route="native").inc()
+            self._route("native", origin)
+            return await self._read_native(vid, nid, cookie)
+        if cfg.qos and self.qos.admit(
+            tier, len(self.coalescer), cfg.max_inflight
+        ) is not None:
+            # QoS shed (tier budget / deadline / breaker): serve on the
+            # host path NOW rather than joining a queue this request
+            # would time out inside — reasons are counted per tier in
+            # the qos_shed series by admit() itself
+            self._route("native", origin)
             return await self._read_native(vid, nid, cookie)
         loop = asyncio.get_running_loop()
         req = ReadRequest(
             vid, nid, cookie, loop.create_future(), loop.time(),
-            obs_ctx=obs.current(),
+            obs_ctx=obs.current(), tier=tier,
         )
         if not self.coalescer.offer(req):
             # saturated: shed to the native path rather than queue without
-            # bound — the fallback count is the dashboard's overload signal
+            # bound — the fallback count is the dashboard's overload signal,
+            # and QoS must see it as overload too (breaker + shed series),
+            # not as the success admit() pre-approved
             stats.VOLUME_SERVER_EC_BATCH_FALLBACK.inc()
-            stats.VOLUME_SERVER_EC_READ_ROUTE.labels(route="native").inc()
+            if cfg.qos:
+                self.qos.saturated(tier)
+            self._route("native", origin)
             return await self._read_native(vid, nid, cookie)
-        stats.VOLUME_SERVER_EC_READ_ROUTE.labels(route="batched").inc()
+        if cfg.qos:
+            # commit the admission (admitted counter, breaker success,
+            # tier queue gauge).  Guarded so -ec.qos.disable really
+            # leaves every qos series flat — req.tier is cleared too so
+            # the drain loop's dequeue credit stays symmetric even if
+            # the flag is toggled while requests are queued.
+            self.qos.enqueued(tier)
+        else:
+            req.tier = ""
+        self._route("batched", origin)
         stats.VOLUME_SERVER_EC_QUEUE_DEPTH.set(len(self.coalescer))
         self._maybe_spawn()
         return await req.future
@@ -134,6 +180,7 @@ class EcReadDispatcher:
             cookie,
             self._remote_reader(vid),
             use_device,
+            self.cfg.zero_copy,
         )
 
     # ------------------------------------------------------------ dispatch
@@ -182,6 +229,8 @@ class EcReadDispatcher:
                 for vid, items in taken.items():
                     stats.VOLUME_SERVER_EC_BATCH_SIZE.observe(len(items))
                     for r in items:
+                        if r.tier:  # "" = enqueued with qos off
+                            self.qos.dequeued(r.tier)
                         wait = now - r.enqueued
                         stats.VOLUME_SERVER_EC_BATCH_QUEUE_WAIT.observe(wait)
                         # the trace's view of the same wait: admission ->
@@ -209,9 +258,15 @@ class EcReadDispatcher:
                         vid,
                         [(r.nid, r.cookie) for r in items],
                         self._remote_reader(vid),
+                        self.cfg.zero_copy,
                     )
             except Exception as e:  # noqa: BLE001 — volume-level failure
                 results = [e] * len(items)
+        # feed the deadline estimator: per-needle service time of THIS
+        # batch (wall across the store call / width)
+        self.qos.observe_service(
+            (time.perf_counter() - t0) / max(1, len(items))
+        )
         for r in items:
             if r.obs_ctx is None:
                 continue

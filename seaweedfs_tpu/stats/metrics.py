@@ -141,12 +141,81 @@ VOLUME_SERVER_EC_READ_ROUTE = Counter(
     "was still AOT-cold — counted per reconstruct interval, not per "
     "needle, and IN ADDITION to the admitting batched/native count: "
     "batched+native partitions admissions, shed_cold_shape marks which "
-    "of those were re-routed after admission).",
+    "of those were re-routed after admission).  s3_batched/s3_native are "
+    "attribution counts IN ADDITION to the admitting route for reads the "
+    "S3 gateway sent down its direct volume path — s3_batched rising "
+    "means S3 GETs are riding the device-resident dispatcher.",
     ["route"],
     registry=REGISTRY,
 )
-for _route in ("batched", "native", "shed_cold_shape"):
+for _route in (
+    "batched", "native", "shed_cold_shape", "s3_batched", "s3_native"
+):
     VOLUME_SERVER_EC_READ_ROUTE.labels(route=_route)
+VOLUME_SERVER_RESPONSE_COPY_BYTES = Counter(
+    "SeaweedFS_volumeServer_response_copy_bytes_total",
+    "Bytes COPIED while assembling volume-server HTTP read responses "
+    "(needle-buffer materialization, range slices of bytes bodies, "
+    "decompress/transform output).  The zero-copy serving path "
+    "(-ec.serving.zerocopy.disable off) streams memoryview slices of the "
+    "reconstruct/needle buffers instead, so this stays 0 for its reads — "
+    "a nonzero rate under zero-copy means a request fell onto a copying "
+    "branch (transforms, gzip, tombstones).",
+    registry=REGISTRY,
+)
+VOLUME_SERVER_RESPONSE_STALL_ABORTS = Counter(
+    "SeaweedFS_volumeServer_response_stall_aborts_total",
+    "HTTP read responses aborted because the client drained the body "
+    "slower than the per-response stall budget (-ec.qos.stallBudget "
+    "Seconds + bytes/minRate): a dribbling reader is disconnected "
+    "instead of holding the download byte-lease and its needle buffers "
+    "open indefinitely.",
+    registry=REGISTRY,
+)
+
+# QoS admission control on the EC serving dispatcher (serving/qos.py):
+# per-tier queue budgets + deadline-aware shedding + a breaker that
+# fast-fails while overload persists.  These series are how an operator
+# sees WHICH tier is being shed and WHY before queues collapse.
+VOLUME_SERVER_EC_QOS_ADMITTED = Counter(
+    "SeaweedFS_volumeServer_ec_qos_admitted_total",
+    "EC reads admitted to the serving queue by QoS tier (interactive = "
+    "front-door reads, bulk = background/batch traffic).",
+    ["tier"],
+    registry=REGISTRY,
+)
+VOLUME_SERVER_EC_QOS_SHED = Counter(
+    "SeaweedFS_volumeServer_ec_qos_shed_total",
+    "EC reads the QoS admission controller re-routed to the host path "
+    "before they could queue, by tier and reason: queue_budget = the "
+    "tier's queue slice is full, deadline = the estimated queue wait "
+    "already exceeds the tier's deadline, breaker_open = the tier's "
+    "breaker tripped on sustained shedding and is fast-failing until "
+    "its cooldown probe succeeds.",
+    ["tier", "reason"],
+    registry=REGISTRY,
+)
+VOLUME_SERVER_EC_QOS_QUEUE_DEPTH = Gauge(
+    "SeaweedFS_volumeServer_ec_qos_queue_depth",
+    "EC reads currently queued in the serving coalescer, by QoS tier "
+    "(the tier budgets partition -ec.serving.maxQueue).",
+    ["tier"],
+    registry=REGISTRY,
+)
+VOLUME_SERVER_EC_QOS_BREAKER_STATE = Gauge(
+    "SeaweedFS_volumeServer_ec_qos_breaker_state",
+    "QoS admission breaker state by tier: 0 closed (admitting), 1 "
+    "half-open (cooldown elapsed, probing), 2 open (fast-failing to "
+    "the host path).",
+    ["tier"],
+    registry=REGISTRY,
+)
+for _tier in ("interactive", "bulk"):
+    VOLUME_SERVER_EC_QOS_ADMITTED.labels(tier=_tier)
+    VOLUME_SERVER_EC_QOS_QUEUE_DEPTH.labels(tier=_tier)
+    VOLUME_SERVER_EC_QOS_BREAKER_STATE.labels(tier=_tier)
+    for _reason in ("queue_budget", "deadline", "breaker_open"):
+        VOLUME_SERVER_EC_QOS_SHED.labels(tier=_tier, reason=_reason)
 VOLUME_SERVER_EC_SHED_COLD_SHAPE = Counter(
     "SeaweedFS_volumeServer_ec_shed_cold_shape_total",
     "Resident reconstruct interval requests shed to the host path "

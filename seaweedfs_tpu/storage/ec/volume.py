@@ -381,16 +381,21 @@ class EcVolume:
         # the .ecx binary search is a real disk read serving the request
         with obs_trace.span("shard_read", op="locate"):
             _, _, intervals = self.locate_needle(needle_id)
-        return b"".join(
+        parts = [
             self.read_interval(iv, remote_read, backend, use_device)
             for iv in intervals
-        )
+        ]
+        # single-interval needles (the common small-object case) hand
+        # their one buffer through untouched so the zero-copy parse can
+        # view it instead of re-joining
+        return parts[0] if len(parts) == 1 else b"".join(parts)
 
     def read_needles_batch(
         self,
         needle_ids: list[int],
         remote_read: RemoteReadFn | None = None,
         backend: str = "cpu",
+        zero_copy: bool = False,
     ) -> list[Needle | Exception]:
         """Serve a burst of needle reads with all degraded-read
         reconstructions coalesced into (at most one-per-size-bucket)
@@ -448,24 +453,31 @@ class EcVolume:
                 continue
             nid, parts = plan
             try:
-                raw = bytearray()
+                pieces: list = []
                 for p in parts:
                     if p[0] == "local":
                         _, sid, off, size = p
                         with obs_trace.span(
                             "shard_read", shard=sid, bytes=size
                         ):
-                            raw += self.shards[sid].read_at(off, size)
+                            pieces.append(self.shards[sid].read_at(off, size))
                     else:
                         i = p[1]
                         if recon is not None:
-                            raw += recon[i]
+                            pieces.append(recon[i])
                         else:
                             sid, off, size = requests[i]
-                            raw += self._read_shard_interval(
+                            pieces.append(self._read_shard_interval(
                                 sid, off, size, remote_read, backend
-                            )
-                n = Needle.from_bytes(bytes(raw), self.version)
+                            ))
+                # zero_copy: the parse keeps `data` a memoryview over the
+                # single source buffer (or the one join for multi-interval
+                # needles) instead of materializing bytes twice — the
+                # response writer streams it straight out
+                raw = pieces[0] if len(pieces) == 1 else b"".join(pieces)
+                n = Needle.from_bytes(
+                    raw, self.version, copy=not zero_copy
+                )
                 if n.id != nid:
                     raise NeedleNotFound(
                         f"ec batch read got needle {n.id:x}, expected {nid:x}"
@@ -482,11 +494,12 @@ class EcVolume:
         remote_read: RemoteReadFn | None = None,
         backend: str = "cpu",
         use_device: bool = True,
+        zero_copy: bool = False,
     ) -> Needle:
         """Full needle with CRC verification (ReadEcShardNeedle
         store_ec.go:136-174)."""
         raw = self.read_needle_bytes(needle_id, remote_read, backend, use_device)
-        n = Needle.from_bytes(raw, self.version)
+        n = Needle.from_bytes(raw, self.version, copy=not zero_copy)
         if n.id != needle_id:
             raise NeedleNotFound(
                 f"ec read got needle {n.id:x}, expected {needle_id:x}"

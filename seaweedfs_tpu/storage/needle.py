@@ -67,7 +67,9 @@ def actual_size(size: int, version: int) -> int:
 class Needle:
     id: int = 0
     cookie: int = 0
-    data: bytes = b""
+    # memoryview only on the zero-copy serving parse (from_bytes
+    # copy=False); everywhere else this is bytes
+    data: bytes | memoryview = b""
     name: bytes = b""
     mime: bytes = b""
     pairs: bytes = b""
@@ -158,19 +160,28 @@ class Needle:
 
     @classmethod
     def from_bytes(
-        cls, buf: bytes, version: int = CURRENT_VERSION, verify: bool = True
+        cls,
+        buf: bytes | bytearray | memoryview,
+        version: int = CURRENT_VERSION,
+        verify: bool = True,
+        copy: bool = True,
     ) -> "Needle":
         """Parse a full record produced by to_bytes (header..footer; padding
-        may be absent or present)."""
+        may be absent or present).  `copy=False` keeps `data` a memoryview
+        over `buf` (the zero-copy serving path: the reconstruct/needle
+        buffer is streamed straight into the HTTP response without a
+        bytes materialization) — the caller owns keeping `buf` alive and
+        unmutated for the needle's lifetime.  Name/mime/pairs stay small
+        bytes copies either way."""
         cookie, nid, size = _HDR.unpack_from(buf)
         n = cls(id=nid, cookie=cookie, size=size)
         if size < 0:  # tombstone record
             return n
-        body = buf[t.NEEDLE_HEADER_SIZE : t.NEEDLE_HEADER_SIZE + size]
+        body = memoryview(buf)[t.NEEDLE_HEADER_SIZE : t.NEEDLE_HEADER_SIZE + size]
         if version == VERSION1:
-            n.data = bytes(body)
+            n.data = body if not copy else bytes(body)
         else:
-            n._parse_body_v2(body)
+            n._parse_body_v2(body, copy=copy)
         off = t.NEEDLE_HEADER_SIZE + size
         (n.checksum,) = struct.unpack_from(">I", buf, off)
         off += 4
@@ -189,12 +200,15 @@ class Needle:
             n.checksum = computed
         return n
 
-    def _parse_body_v2(self, body: bytes) -> None:
+    def _parse_body_v2(
+        self, body: bytes | memoryview, copy: bool = True
+    ) -> None:
         if not body:
             return
         (data_size,) = struct.unpack_from(">I", body, 0)
         idx = 4
-        self.data = bytes(body[idx : idx + data_size])
+        payload = memoryview(body)[idx : idx + data_size]
+        self.data = bytes(payload) if copy else payload
         idx += data_size
         self.flags = body[idx]
         idx += 1
@@ -214,7 +228,7 @@ class Needle:
             )
             idx += LAST_MODIFIED_BYTES
         if self.flags & FLAG_HAS_TTL:
-            self.ttl = t.TTL.from_bytes(body[idx : idx + 2])
+            self.ttl = t.TTL.from_bytes(bytes(body[idx : idx + 2]))
             idx += 2
         if self.flags & FLAG_HAS_PAIRS:
             (ps,) = struct.unpack_from(">H", body, idx)

@@ -385,13 +385,17 @@ class Store:
         needle_id: int,
         cookie: int | None = None,
         read_deleted: bool = False,
+        zero_copy: bool = False,
     ) -> Needle:
         v = self.find_volume(vid)
         if v is not None:
-            return v.read(needle_id, cookie, read_deleted=read_deleted)
+            return v.read(
+                needle_id, cookie, read_deleted=read_deleted,
+                zero_copy=zero_copy,
+            )
         ev = self.find_ec_volume(vid)
         if ev is not None:
-            return self.read_ec_needle(vid, needle_id, cookie)
+            return self.read_ec_needle(vid, needle_id, cookie, zero_copy=zero_copy)
         raise NotFoundError(f"volume {vid} not found")
 
     def delete_needle(self, vid: int, needle_id: int, cookie: int | None = None) -> int:
@@ -691,6 +695,7 @@ class Store:
         cookie: int | None = None,
         remote_read: RemoteReadFn | None = None,
         use_device: bool = True,
+        zero_copy: bool = False,
     ) -> Needle:
         """(ReadEcShardNeedle store_ec.go:136-174); falls back to remote
         shards then degraded reconstruction via the EcVolume.
@@ -701,7 +706,7 @@ class Store:
             raise NotFoundError(f"ec volume {vid} not found")
         return ev.read_needle(
             needle_id, cookie, remote_read, backend=self.ec_backend,
-            use_device=use_device,
+            use_device=use_device, zero_copy=zero_copy,
         )
 
     def read_ec_needles_batch(
@@ -709,6 +714,7 @@ class Store:
         vid: int,
         requests: list[tuple[int, int | None]],  # (needle_id, cookie)
         remote_read: RemoteReadFn | None = None,
+        zero_copy: bool = False,
     ) -> list[Needle | Exception]:
         """Serve a burst of EC needle reads in one coalesced call: all
         degraded-read reconstructions in the batch become (at most a few)
@@ -719,7 +725,8 @@ class Store:
         if ev is None:
             raise NotFoundError(f"ec volume {vid} not found")
         results = ev.read_needles_batch(
-            [nid for nid, _ in requests], remote_read, backend=self.ec_backend
+            [nid for nid, _ in requests], remote_read, backend=self.ec_backend,
+            zero_copy=zero_copy,
         )
         out: list[Needle | Exception] = []
         for (nid, cookie), r in zip(requests, results):

@@ -367,25 +367,32 @@ class Volume:
     # -- read path -----------------------------------------------------------
 
     def _read_at(
-        self, offset: int, size: int, st: _ReadState | None = None
+        self,
+        offset: int,
+        size: int,
+        st: _ReadState | None = None,
+        zero_copy: bool = False,
     ) -> Needle:
         st = st or self._state
         total = needle_mod.actual_size(size, self.version)
         buf = _pread(st.dat, total, offset)
-        return Needle.from_bytes(buf, self.version)
+        # zero_copy: data stays a memoryview over the one pread buffer
+        # (the HTTP serving path streams it out without materializing)
+        return Needle.from_bytes(buf, self.version, copy=not zero_copy)
 
     def read(
         self,
         needle_id: int,
         cookie: int | None = None,
         read_deleted: bool = False,
+        zero_copy: bool = False,
     ) -> Needle:
         # one state capture: the offset from st.nm is only ever applied to
         # st.dat, so a concurrent vacuum swap can't mix old map / new file
         st = self._state
         loc = st.nm.get(needle_id)
         if loc is not None:
-            n = self._read_at(loc[0], loc[1], st)
+            n = self._read_at(loc[0], loc[1], st, zero_copy=zero_copy)
         else:
             n = self._read_tombstoned(needle_id, st) if read_deleted else None
             if n is None:

@@ -19,6 +19,7 @@ def test_headline_keys_are_the_contract():
         "serving_headline",
         "encode_headline",
         "scrub_headline",
+        "load_headline",
     )
 
 
@@ -26,6 +27,7 @@ def test_order_result_puts_headline_keys_last():
     shuffled = {
         "serving_headline": {"device_wins": True},
         "metric": "rs_10_4_encode_blockdiag_pallas",
+        "load_headline": {"qos_zero_copy_beats_pre": True},
         "scrub_headline": {"megakernel_beats_per_volume": True},
         "value": 12.3,
         "encode_headline": {"overlap_beats_serial": True},
@@ -91,6 +93,24 @@ def _bulky_result():
                 "megakernel_dispatches": 1.0,
                 "per_volume_dispatches": 4.0,
             },
+            "load_headline": {
+                "load_levels": [8, 32, 128, 512],
+                "pre_reads_per_s": {"8": 100.0, "512": 90.0},
+                "qos_zero_copy_reads_per_s": {"8": 110.0, "512": 200.0},
+                "top_connections": 512,
+                "pre_top_reads_per_s": 90.0,
+                "qos_zero_copy_top_reads_per_s": 200.0,
+                "qos_zero_copy_beats_pre": True,
+                "adversarial_pre_reads_per_s": 60.0,
+                "adversarial_qos_reads_per_s": 80.0,
+                "copy_bytes_pre": 786432,
+                "copy_bytes_zero_copy": 0,
+                "zero_copy_is_zero_copy": True,
+                "s3_reads_per_s": 100.0,
+                "s3_resident_route_reads": 32,
+                "s3_rides_resident_path": True,
+                "load_verified": True,
+            },
         }
     )
 
@@ -136,6 +156,25 @@ def test_archived_tail_carries_r11_verdicts():
         "megakernel_beats_per_volume",
         "megakernel_dispatches",
         "per_volume_dispatches",
+    ):
+        assert f'"{key}"' in tail, f"{key} fell outside the archived tail"
+
+
+def test_archived_tail_carries_r13_load_verdicts():
+    """The r13 front-door verdict keys — QoS+zero-copy beating the
+    pre-PR config at top concurrency, the zero-copy copy-bytes proof,
+    and the S3-on-resident-path attribution — must survive the
+    2000-char archive window."""
+    tail = json.dumps(_bulky_result())[-2000:]
+    for key in (
+        "qos_zero_copy_beats_pre",
+        "qos_zero_copy_top_reads_per_s",
+        "pre_top_reads_per_s",
+        "copy_bytes_zero_copy",
+        "zero_copy_is_zero_copy",
+        "s3_rides_resident_path",
+        "s3_resident_route_reads",
+        "load_verified",
     ):
         assert f'"{key}"' in tail, f"{key} fell outside the archived tail"
 

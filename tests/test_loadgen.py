@@ -1,0 +1,108 @@
+"""Load-harness suite (seaweedfs_tpu/loadgen): the workload math unit-
+tested without sockets, and the r13 front-door smoke sweep — the
+seconds-scale CPU run of `bench.py bench_load_sweep --smoke` — invoked
+from tier-1 so the harness (cluster build, loadgen drivers, QoS +
+zero-copy toggles, S3 leg, headline contract) can't rot between the
+real benchmarked runs."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.loadgen import LoadScenario, zipf_ranks
+from seaweedfs_tpu.loadgen.workload import percentile_ms, plan_keys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ----------------------------------------------------------- workload math
+
+
+def test_zipf_ranks_skew_and_determinism():
+    rng = np.random.default_rng(7)
+    a = zipf_ranks(100, 5000, 1.1, np.random.default_rng(7))
+    b = zipf_ranks(100, 5000, 1.1, np.random.default_rng(7))
+    np.testing.assert_array_equal(a, b)  # deterministic under the seed
+    counts = np.bincount(a, minlength=100)
+    # rank 0 must dominate the tail decisively under s=1.1
+    assert counts[0] > 5 * counts[50:].mean()
+    assert a.min() >= 0 and a.max() < 100
+    # s=0 is uniform: no rank may dominate
+    u = zipf_ranks(100, 5000, 0.0, rng)
+    uc = np.bincount(u, minlength=100)
+    assert uc.max() < 3 * max(uc.min(), 1)
+
+
+def test_zipf_ranks_rejects_empty_keyspace():
+    with pytest.raises(ValueError):
+        zipf_ranks(0, 10, 1.0, np.random.default_rng(0))
+
+
+def test_plan_keys_hot_volume_pinning():
+    # keys across three "volumes"; volume b holds the most keys and must
+    # absorb ~the configured fraction of reads when pinning is on
+    keys = [f"a,{i}" for i in range(3)] + [f"b,{i}" for i in range(9)] + [
+        f"c,{i}" for i in range(3)
+    ]
+    sc = LoadScenario(
+        connections=4, reads=2000, zipf_s=0.0, hot_volume_frac=0.9, seed=3
+    )
+    picks = plan_keys(keys, sc, volume_of=lambda k: k.split(",")[0])
+    hot = sum(1 for p in picks if p.startswith("b,"))
+    assert hot / len(picks) > 0.85
+    sc2 = LoadScenario(connections=4, reads=2000, zipf_s=0.0, seed=3)
+    picks2 = plan_keys(keys, sc2, volume_of=lambda k: k.split(",")[0])
+    hot2 = sum(1 for p in picks2 if p.startswith("b,"))
+    assert hot2 / len(picks2) < 0.8  # without pinning, ~9/15
+
+
+def test_percentile_ms():
+    assert percentile_ms([], 50) is None
+    xs = [i / 1000 for i in range(1, 101)]  # 1..100 ms
+    assert percentile_ms(xs, 50) == pytest.approx(51.0, abs=2)
+    assert percentile_ms(xs, 99) == pytest.approx(100.0, abs=2)
+
+
+# ------------------------------------------------------------- smoke sweep
+
+
+def test_bench_load_sweep_smoke_contract():
+    """`bench.py bench_load_sweep --smoke` must complete in seconds on
+    CPU and emit the full load_headline contract: a >=4-point
+    reads/s-vs-connections curve per config, every read byte-verified,
+    zero copy-bytes on the zero-copy route, and S3 GETs attributed on
+    the resident device path."""
+    proc = subprocess.run(
+        [sys.executable, "bench.py", "bench_load_sweep", "--smoke"],
+        cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    out = json.loads(line)
+    head = out["headline"]
+    assert len(out["levels"]) >= 4
+    for mode in ("pre", "qos_zero_copy"):
+        curve = out["curves"][mode]
+        assert len(curve) >= 4
+        for level in curve.values():
+            assert level["verify_failures"] == 0
+            assert level["reads_per_s"] > 0
+    assert head["load_verified"] is True
+    assert head["zero_copy_is_zero_copy"] is True
+    assert head["copy_bytes_zero_copy"] == 0
+    assert head["copy_bytes_pre"] > 0
+    assert head["s3_rides_resident_path"] is True
+    assert head["s3_resident_route_reads"] > 0
+    # the adversarial pass actually ran its adversaries
+    assert out["adversarial"]["qos_zero_copy"]["slow_connections"] >= 1
+    assert out["adversarial"]["qos_zero_copy"]["churns"] >= 1
+    # p50/p99 from the r07 stage histograms made it into the artifact
+    assert "queue_wait" in out["stage_percentiles"]
+    assert out["stage_percentiles"]["queue_wait"]["p99_us"] is not None

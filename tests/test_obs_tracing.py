@@ -153,7 +153,9 @@ def test_drain_lane_does_not_inherit_spawner_trace():
         def ec_volume_is_resident(self, vid):
             return True
 
-        def read_ec_needles_batch(self, vid, requests, remote_read=None):
+        def read_ec_needles_batch(
+            self, vid, requests, remote_read=None, zero_copy=False
+        ):
             time.sleep(0.002)  # keep the lane alive across both reads
             return [b"x"] * len(requests)
 

@@ -1,0 +1,359 @@
+"""QoS admission suite (serving/qos.py + the dispatcher seam + the S3
+circuit breaker fold-in): tier budgets, deadline-aware shedding, and the
+shared trip/recover Breaker — unit-tested with fake clocks and the
+FakeStore double, no cluster."""
+import asyncio
+
+import pytest
+
+from seaweedfs_tpu import stats
+from seaweedfs_tpu.serving import (
+    Breaker,
+    EcReadDispatcher,
+    QosController,
+    ServingConfig,
+    normalize_tier,
+)
+from seaweedfs_tpu.serving.qos import (
+    BULK,
+    INTERACTIVE,
+    SHED_BREAKER_OPEN,
+    SHED_DEADLINE,
+    SHED_QUEUE_BUDGET,
+    TierPolicy,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 100.0
+
+    def __call__(self):
+        return self.now
+
+
+# ----------------------------------------------------------------- breaker
+
+
+def test_breaker_trips_after_consecutive_rejections_and_recovers():
+    clk = FakeClock()
+    b = Breaker(trip_after=3, cooldown_s=5.0, clock=clk)
+    assert b.state == Breaker.CLOSED and b.allow()
+    b.record_rejection()
+    b.record_rejection()
+    assert b.state == Breaker.CLOSED  # 2 < trip_after
+    b.record_rejection()
+    assert b.state == Breaker.OPEN and not b.allow()
+    clk.now += 4.9
+    assert b.state == Breaker.OPEN  # still cooling down
+    clk.now += 0.2
+    assert b.state == Breaker.HALF_OPEN and b.allow()  # probe window
+    b.record_success()
+    assert b.state == Breaker.CLOSED
+
+
+def test_breaker_failed_probe_reopens_and_fast_fails_dont_extend():
+    clk = FakeClock()
+    b = Breaker(trip_after=1, cooldown_s=5.0, clock=clk)
+    b.record_rejection()
+    assert b.state == Breaker.OPEN
+    opened = clk.now
+    clk.now += 1.0
+    # open-state rejections (fast fails) must NOT extend the trip
+    b.record_rejection()
+    clk.now = opened + 5.1
+    assert b.state == Breaker.HALF_OPEN
+    b.record_rejection()  # failed probe: fresh cooldown from NOW
+    assert b.state == Breaker.OPEN
+    clk.now += 4.9
+    assert b.state == Breaker.OPEN
+    clk.now += 0.2
+    assert b.state == Breaker.HALF_OPEN
+
+
+def test_success_resets_consecutive_count():
+    b = Breaker(trip_after=2, cooldown_s=1.0, clock=FakeClock())
+    b.record_rejection()
+    b.record_success()
+    b.record_rejection()
+    assert b.state == Breaker.CLOSED  # never 2 consecutive
+
+
+# ------------------------------------------------------- s3 circuit breaker
+
+
+def test_s3_circuit_breaker_trips_and_recovers():
+    """The satellite contract: the S3 gateway's limit breaker and the
+    volume server's QoS share one trip/recover policy (serving.qos.
+    Breaker).  Saturating a limit TRIP_AFTER times in a row must trip
+    the scope into fast-fail (rejects WITHOUT walking the limit table),
+    and the cooldown's half-open probe must recover it."""
+    from seaweedfs_tpu.s3api.circuit_breaker import (
+        CircuitBreaker,
+        CircuitBreakerError,
+    )
+
+    cb = CircuitBreaker()
+    cb.load(
+        b'{"global": {"enabled": true, "actions": {"Read:Count": 1}}}'
+    )
+    clk = FakeClock()
+    cb.breaker("", "Read")._clock = clk  # deterministic cooldown
+
+    hold = cb.acquire("b", "Read", None)  # occupies the whole limit
+    for _ in range(CircuitBreaker.TRIP_AFTER):
+        with pytest.raises(CircuitBreakerError):
+            cb.acquire("b", "Read", None)
+    assert cb.breaker("", "Read").state == Breaker.OPEN
+    hold()  # capacity free again — but the breaker still fast-fails
+    with pytest.raises(CircuitBreakerError, match="breaker open"):
+        cb.acquire("b", "Read", None)
+    clk.now += CircuitBreaker.RECOVER_S + 0.1
+    release = cb.acquire("b", "Read", None)  # half-open probe succeeds
+    assert cb.breaker("", "Read").state == Breaker.CLOSED
+    release()
+
+
+def test_s3_circuit_breaker_failed_probe_reopens():
+    from seaweedfs_tpu.s3api.circuit_breaker import (
+        CircuitBreaker,
+        CircuitBreakerError,
+    )
+
+    cb = CircuitBreaker()
+    cb.load(
+        b'{"global": {"enabled": true, "actions": {"Write:Count": 1}}}'
+    )
+    clk = FakeClock()
+    cb.breaker("", "Write")._clock = clk
+    hold = cb.acquire("b", "Write", 10)
+    for _ in range(CircuitBreaker.TRIP_AFTER):
+        with pytest.raises(CircuitBreakerError):
+            cb.acquire("b", "Write", 10)
+    clk.now += CircuitBreaker.RECOVER_S + 0.1
+    # probe while STILL saturated: re-opens for a fresh cooldown
+    with pytest.raises(CircuitBreakerError):
+        cb.acquire("b", "Write", 10)
+    assert cb.breaker("", "Write").state == Breaker.OPEN
+    hold()
+
+
+# ---------------------------------------------------------- qos controller
+
+
+def _controller(**kw):
+    defaults = dict(
+        policies={
+            INTERACTIVE: TierPolicy(INTERACTIVE, 4, 0.5),
+            BULK: TierPolicy(BULK, 2, 0.0),
+        },
+        trip_after=100,
+        cooldown_s=1.0,
+    )
+    defaults.update(kw)
+    return QosController(**defaults)
+
+
+def test_tier_budget_shed_is_per_tier():
+    q = _controller()
+    for _ in range(2):
+        assert q.admit(BULK, 0, 4) is None
+        q.enqueued(BULK)
+    # bulk slice is full; interactive is untouched
+    assert q.admit(BULK, 2, 4) == SHED_QUEUE_BUDGET
+    assert q.admit(INTERACTIVE, 2, 4) is None
+    q.dequeued(BULK)
+    assert q.admit(BULK, 1, 4) is None
+
+
+def test_deadline_shed_uses_service_estimate():
+    q = _controller()
+    # 50ms per needle served depth-1 → 100 queued ≈ 5s wait > 0.5s SLA
+    q.observe_service(0.05)
+    assert q.admit(INTERACTIVE, 100, 1) == SHED_DEADLINE
+    # the same queue drained by 8 lanes estimates under the deadline
+    assert q.estimated_wait_s(100, 8) < 1.0
+    # bulk has deadline 0 = disabled: never deadline-shed
+    assert q.admit(BULK, 100, 1) is None
+
+
+def test_sustained_sheds_trip_the_breaker_then_fast_fail():
+    clk = FakeClock()
+    q = _controller(trip_after=3, clock=clk)
+    q.observe_service(1.0)
+    for _ in range(3):
+        assert q.admit(INTERACTIVE, 1000, 1) == SHED_DEADLINE
+    # tripped: now fast-fails with the breaker reason, even for an
+    # admissible request
+    assert q.admit(INTERACTIVE, 0, 1) == SHED_BREAKER_OPEN
+    clk.now += 1.1
+    assert q.admit(INTERACTIVE, 0, 1) is None  # probe recovers
+
+
+def test_observe_service_ewma_and_counters():
+    q = _controller()
+    q.observe_service(0.010)
+    q.observe_service(0.020)
+    assert 0.010 < q._service_s < 0.020
+    g = stats.REGISTRY.get_sample_value
+    before = g(
+        "SeaweedFS_volumeServer_ec_qos_admitted_total",
+        {"tier": "interactive"},
+    )
+    assert q.admit(INTERACTIVE, 0, 4) is None
+    # admitted commits only when the coalescer accepted (enqueued):
+    # admit() alone must NOT count — the global backstop can still
+    # reject between the two
+    assert g(
+        "SeaweedFS_volumeServer_ec_qos_admitted_total",
+        {"tier": "interactive"},
+    ) == before
+    q.enqueued(INTERACTIVE)
+    assert g(
+        "SeaweedFS_volumeServer_ec_qos_admitted_total",
+        {"tier": "interactive"},
+    ) == before + 1
+    q.dequeued(INTERACTIVE)
+
+
+def test_global_backstop_saturation_feeds_the_breaker():
+    """admit() passing and the coalescer then rejecting must count as a
+    queue_budget shed AND trip the breaker under sustained saturation —
+    the exact overload mode the pre-fix bookkeeping read as success."""
+    clk = FakeClock()
+    q = _controller(trip_after=3, clock=clk)
+    g = stats.REGISTRY.get_sample_value
+    shed0 = g(
+        "SeaweedFS_volumeServer_ec_qos_shed_total",
+        {"tier": "interactive", "reason": "queue_budget"},
+    ) or 0
+    for _ in range(3):
+        assert q.admit(INTERACTIVE, 0, 4) is None
+        q.saturated(INTERACTIVE)  # coalescer said no
+    assert g(
+        "SeaweedFS_volumeServer_ec_qos_shed_total",
+        {"tier": "interactive", "reason": "queue_budget"},
+    ) == shed0 + 3
+    assert q.admit(INTERACTIVE, 0, 4) == SHED_BREAKER_OPEN
+
+
+def test_normalize_tier():
+    assert normalize_tier("bulk") == BULK
+    assert normalize_tier("interactive") == INTERACTIVE
+    assert normalize_tier("") == INTERACTIVE
+    assert normalize_tier(None) == INTERACTIVE
+    assert normalize_tier("premium") == INTERACTIVE
+
+
+def test_serving_config_qos_validation():
+    with pytest.raises(ValueError):
+        ServingConfig(qos_bulk_queue=0).validated()
+    with pytest.raises(ValueError):
+        ServingConfig(qos_interactive_deadline_ms=-1).validated()
+    with pytest.raises(ValueError):
+        ServingConfig(qos_trip_after=0).validated()
+    with pytest.raises(ValueError):
+        ServingConfig(qos_recover_seconds=0).validated()
+    with pytest.raises(ValueError):
+        ServingConfig(stall_min_rate_kbps=0).validated()
+    cfg = ServingConfig().validated()
+    assert cfg.stall_budget_for(0) == cfg.stall_budget_seconds
+    assert cfg.stall_budget_for(1 << 20) > cfg.stall_budget_seconds
+    assert ServingConfig(stall_budget_seconds=0).stall_budget_for(1) == 0.0
+
+
+# -------------------------------------------------------- dispatcher seam
+
+
+class FakeStore:
+    def __init__(self):
+        self.batch_nids: list[int] = []
+        self.native_nids: list[int] = []
+
+    def ec_volume_is_resident(self, vid):
+        return True
+
+    def read_ec_needles_batch(
+        self, vid, requests, remote_read=None, zero_copy=False
+    ):
+        self.batch_nids.extend(nid for nid, _ in requests)
+        return [f"n-{nid}".encode() for nid, _ in requests]
+
+    def read_ec_needle(
+        self, vid, nid, cookie=None, remote_read=None, use_device=True,
+        zero_copy=False,
+    ):
+        self.native_nids.append(nid)
+        return f"n-{nid}".encode()
+
+
+def test_dispatcher_sheds_bulk_tier_to_native_keeps_interactive():
+    """A bulk flood past its tier budget must shed to the host path
+    while interactive reads keep riding the batched queue — and both
+    must return correct bytes."""
+    store = FakeStore()
+
+    async def go():
+        d = EcReadDispatcher(
+            store, lambda vid: None,
+            ServingConfig(
+                max_inflight=1, max_wait_us=0, qos_bulk_queue=1,
+            ),
+        )
+        # seed the lane with a slow-ish first batch so the queue holds
+        d.qos.enqueued("bulk")  # bulk slice now full
+        got = await asyncio.gather(
+            d.read(1, 1, None, tier="bulk"),
+            d.read(1, 2, None, tier="interactive"),
+        )
+        assert got == [b"n-1", b"n-2"]
+        assert 1 in store.native_nids  # bulk shed to host path
+        assert 2 in store.batch_nids  # interactive rode the queue
+
+    asyncio.run(go())
+
+
+def test_dispatcher_qos_disabled_skips_admission():
+    store = FakeStore()
+
+    async def go():
+        d = EcReadDispatcher(
+            store, lambda vid: None,
+            ServingConfig(max_inflight=1, max_wait_us=0, qos=False),
+        )
+        d.qos.enqueued("bulk")  # would shed if qos were consulted
+        assert await d.read(1, 5, None, tier="bulk") == b"n-5"
+        assert 5 in store.batch_nids
+
+    asyncio.run(go())
+
+
+def test_dispatcher_s3_origin_attribution():
+    store = FakeStore()
+    g = stats.REGISTRY.get_sample_value
+
+    async def go():
+        d = EcReadDispatcher(
+            store, lambda vid: None,
+            ServingConfig(max_inflight=1, max_wait_us=0),
+        )
+        b0 = g(
+            "SeaweedFS_volumeServer_ec_read_route_total",
+            {"route": "s3_batched"},
+        ) or 0
+        admit0 = g(
+            "SeaweedFS_volumeServer_ec_read_route_total",
+            {"route": "batched"},
+        ) or 0
+        assert await d.read(1, 7, None, origin="s3") == b"n-7"
+        # attribution is IN ADDITION to the admitting route
+        assert g(
+            "SeaweedFS_volumeServer_ec_read_route_total",
+            {"route": "s3_batched"},
+        ) == b0 + 1
+        assert g(
+            "SeaweedFS_volumeServer_ec_read_route_total",
+            {"route": "batched"},
+        ) == admit0 + 1
+
+    asyncio.run(go())

@@ -245,7 +245,9 @@ class TestEcVolumeWiring:
             def ec_volume_is_resident(self, vid):
                 return True
 
-            def read_ec_needles_batch(self, vid, requests, remote_read=None):
+            def read_ec_needles_batch(
+                self, vid, requests, remote_read=None, zero_copy=False
+            ):
                 calls.append(list(requests))
                 out = []
                 for nid, _cookie in requests:

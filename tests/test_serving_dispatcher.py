@@ -90,7 +90,9 @@ class FakeStore:
     def _value(self, vid, nid):
         return f"needle-{vid}-{nid}".encode()
 
-    def read_ec_needles_batch(self, vid, requests, remote_read=None):
+    def read_ec_needles_batch(
+        self, vid, requests, remote_read=None, zero_copy=False
+    ):
         with self._lock:
             self._active += 1
             self.peak_active = max(self.peak_active, self._active)
@@ -110,7 +112,8 @@ class FakeStore:
         return out
 
     def read_ec_needle(
-        self, vid, nid, cookie=None, remote_read=None, use_device=True
+        self, vid, nid, cookie=None, remote_read=None, use_device=True,
+        zero_copy=False,
     ):
         self.native_calls.append(nid)
         if nid == 666:

@@ -104,9 +104,9 @@ def test_degraded_serving_batcher_coalesces(tmp_path):
             store = vs.store
             orig = store.read_ec_needles_batch
 
-            def spying(vid, requests, remote_read=None):
+            def spying(vid, requests, remote_read=None, zero_copy=False):
                 seen_widths.append(len(requests))
-                return orig(vid, requests, remote_read)
+                return orig(vid, requests, remote_read, zero_copy)
 
             store.read_ec_needles_batch = spying
             async with aiohttp.ClientSession() as sess:
