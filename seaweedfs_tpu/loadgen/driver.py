@@ -133,10 +133,22 @@ async def _run_load(
             await session.close()
 
     t0 = time.perf_counter()
-    await asyncio.gather(
-        *(worker(w, shards[w]) for w in range(scenario.connections))
-    )
+    # named, retained tasks + return_exceptions: a crashing worker must
+    # not leave the other N-1 connections running unawaited behind an
+    # early-raising gather (graftlint GL111's leak class) — every worker
+    # finishes (or fails) before the sweep's wall clock stops, then the
+    # first real error is re-raised with its worker attributed
+    workers = [
+        asyncio.ensure_future(worker(w, shards[w]))
+        for w in range(scenario.connections)
+    ]
+    outcomes = await asyncio.gather(*workers, return_exceptions=True)
     result.wall_s = time.perf_counter() - t0
+    for wid, out in enumerate(outcomes):
+        if isinstance(out, BaseException):
+            raise RuntimeError(
+                f"load worker {wid}/{scenario.connections} crashed"
+            ) from out
     return result
 
 

@@ -18,6 +18,8 @@ import logging
 import os
 import struct
 
+from ..utils.tasks import spawn_logged
+
 log = logging.getLogger("fuse")
 
 # opcodes (linux/fuse.h)
@@ -171,6 +173,10 @@ class FuseConnection:
         self._bufsize = max_write + (1 << 16)
         self._closed = asyncio.Event()
         self.proto_minor = 31
+        # strong refs to in-flight request handlers: the loop's own task
+        # refs are weak, and a GC'd handler would drop a kernel request
+        # on the floor (the process would hang in the syscall)
+        self._inflight: set = set()
 
     def start(self) -> None:
         os.set_blocking(self.fd, False)
@@ -208,7 +214,10 @@ class FuseConnection:
             if not buf:
                 self.close()
                 return
-            asyncio.ensure_future(self._handle(buf))
+            spawn_logged(
+                self._handle(buf), log, "fuse request handler",
+                registry=self._inflight,
+            )
 
     def _reply(self, unique: int, error: int, payload: bytes = b"") -> None:
         out = OUT_HEADER.pack(OUT_HEADER.size + len(payload), -error, unique)

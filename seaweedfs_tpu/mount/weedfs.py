@@ -200,7 +200,9 @@ class WeedFS:
                     since = max(since, ev.ts_ns)
                     self.meta.apply_event(ev)
             except asyncio.CancelledError:
-                return
+                # close() cancelled us: end CANCELLED, not "succeeded" —
+                # a supervisor awaiting this task must see the truth
+                raise
             except Exception as e:  # noqa: BLE001 — filer restart etc.
                 log.debug("meta subscription retry: %s", e)
                 await asyncio.sleep(1.0)

@@ -15,12 +15,14 @@ class ObsConfig:
 
     # record per-request traces into the /debug/traces ring and forward
     # the trace header on fan-out; False keeps only the per-stage
-    # Prometheus histograms (spans become pure timers)
+    # Prometheus histograms (spans become pure timers) (-obs.disable)
     enabled: bool = True
     # any request whose end-to-end trace exceeds this many milliseconds
     # is logged with its per-span breakdown; 0 disables the slow log
+    # (-obs.slowMs)
     slow_ms: float = 0.0
     # completed traces kept in memory for /debug/traces (newest win)
+    # (-obs.traceRing)
     trace_ring: int = 256
 
     def validated(self) -> "ObsConfig":

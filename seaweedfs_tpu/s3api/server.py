@@ -30,6 +30,7 @@ from aiohttp import web
 
 from ..pb import Stub, filer_pb2
 from ..pb.rpc import GRPC_OPTIONS, channel
+from ..utils.tasks import spawn_logged
 from .auth import (
     ACTION_ADMIN,
     ACTION_LIST,
@@ -195,7 +196,9 @@ class S3ApiServer:
             await self._load_cb_from_filer()
         except Exception as e:  # noqa: BLE001 — filer may not be up yet
             log.debug("initial circuit-breaker config load failed: %s", e)
-        self._iam_refresh = asyncio.create_task(self._iam_refresh_loop())
+        self._iam_refresh = spawn_logged(
+            self._iam_refresh_loop(), log, "iam refresh loop"
+        )
         app = web.Application(client_max_size=1024 * 1024 * 1024)
         from .. import obs
 

@@ -36,6 +36,7 @@ from ..security import gen_volume_write_jwt
 from ..security import tls as tls_mod
 from ..security import guard as guard_mod
 from ..storage import types as t
+from ..utils.tasks import spawn_logged
 from ..topology import (
     MemorySequencer,
     NoFreeSpace,
@@ -220,9 +221,13 @@ class MasterServer:
         )
         await self.raft.start()
 
-        self._tasks.append(asyncio.create_task(self._grower_loop()))
+        self._tasks.append(
+            spawn_logged(self._grower_loop(), log, "volume grower loop")
+        )
         if self.auto_vacuum:
-            self._tasks.append(asyncio.create_task(self._vacuum_loop()))
+            self._tasks.append(
+                spawn_logged(self._vacuum_loop(), log, "auto-vacuum loop")
+            )
         push = stats.start_push_loop(
             "master", self.url, self.metrics_address,
             self.metrics_interval_seconds,

@@ -49,6 +49,7 @@ from ..security import tls as tls_mod
 from ..security import guard as guard_mod
 from ..storage.needle import CrcError, Needle
 from ..storage.store import Store
+from ..utils.tasks import spawn_logged
 from ..storage.volume import CookieMismatch, NotFoundError, Volume, VolumeReadOnly
 from .conversions import ec_msg_to_pb, volume_msg_to_pb
 
@@ -287,12 +288,19 @@ class VolumeServer:
         if self.store.public_url == f"{self.ip}:0":
             self.store.public_url = self.url
 
+        # spawn_logged: a heartbeat/sweep/scrub loop dying early must
+        # log AT death with its spawn trace, not sit silent until stop()
+        # gathers the corpse (GL111 hardening)
         if heartbeat and self.masters:
-            self._tasks.append(asyncio.create_task(self._heartbeat_forever()))
-        self._tasks.append(asyncio.create_task(self._ttl_sweep_forever()))
+            self._tasks.append(
+                spawn_logged(self._heartbeat_forever(), log, "heartbeat loop")
+            )
+        self._tasks.append(
+            spawn_logged(self._ttl_sweep_forever(), log, "ttl sweep loop")
+        )
         if self.ec_scrub_interval_seconds > 0:
             self._tasks.append(
-                asyncio.create_task(self._ec_scrub_forever())
+                spawn_logged(self._ec_scrub_forever(), log, "ec scrub loop")
             )
         push = stats.start_push_loop(
             "volumeServer", self.url, self.metrics_address,
@@ -592,7 +600,9 @@ class VolumeServer:
             try:
                 await self._heartbeat_stream(master)
             except asyncio.CancelledError:
-                return
+                # stop() cancelled us: propagate so the awaited task
+                # reads CANCELLED instead of silently "done"
+                raise
             except Exception as e:
                 log.debug("heartbeat to %s failed: %s", master, e)
             await asyncio.sleep(min(self.pulse_seconds, 1))

@@ -17,24 +17,25 @@ class ServingConfig:
 
     # route EC reads of resident volumes through the batching dispatcher;
     # False serves every read on the native per-read path
+    # (-ec.serving.disable)
     enabled: bool = True
     # widest coalesced batch; matches COUNT_BUCKETS[-1] so a full batch
-    # is one already-warm device shape
+    # is one already-warm device shape (-ec.serving.maxBatch)
     max_batch: int = 256
     # admission window: when a dispatch slot frees and the queue holds a
     # partial batch, wait this long for the batch to fill before
     # dispatching.  Only applied once a drain loop is already hot (the
     # first batch after idle dispatches immediately), so a lone request
-    # never waits.  0 disables the window.
+    # never waits.  0 disables the window.  (-ec.serving.maxWaitUs)
     max_wait_us: int = 200
     # pipelined batches in flight: batch N+1's device dispatch overlaps
     # batch N's D2H + response fan-out.  Round 5 measured depth 2 leaving
     # the resident path at 13% of the tunnel ceiling; bench.py sweeps
-    # 2/4/8 and publishes the curve
+    # 2/4/8 and publishes the curve (-ec.serving.maxInflight)
     max_inflight: int = 4
     # backpressure: queued requests beyond this fall back to the native
     # per-read path (counted in the fallback metric) instead of growing
-    # the queue without bound
+    # the queue without bound (-ec.serving.maxQueue)
     max_queue: int = 2048
     # resident shard layout the reconstruct kernels serve through:
     # "blockdiag" is the ~157 GB/s round-3 g=4 system (default — the
@@ -44,6 +45,7 @@ class ServingConfig:
     # double-buffered device staging: 2 slots let batch N+1 pack and
     # ship while batch N executes (only N's D2H blocks N); False = one
     # slot, the serial baseline bench.py's overlap-off axis measures
+    # (-ec.serving.overlap.disable)
     overlap: bool = True
     # AOT serving grid + cold-shape shed (-ec.serving.aot.disable):
     # warm plans compile ahead-of-time on a background executor, and a
@@ -75,7 +77,8 @@ class ServingConfig:
     # of recent per-needle service time x queue depth / pipeline width)
     # already exceeds its tier deadline sheds to the host path at
     # admission instead of timing out inside the queue.  0 disables
-    # deadline shedding for the tier (-ec.qos.*DeadlineMs).
+    # deadline shedding for the tier (-ec.qos.interactiveDeadlineMs /
+    # -ec.qos.bulkDeadlineMs).
     qos_interactive_deadline_ms: int = 2000
     qos_bulk_deadline_ms: int = 20000
     # breaker: this many CONSECUTIVE sheds trip a tier's breaker

@@ -40,6 +40,7 @@ import logging
 import time
 
 from .. import obs, stats
+from ..utils.tasks import spawn_logged
 from .coalescer import Coalescer, ReadRequest
 from .config import ServingConfig
 from .qos import QosController, normalize_tier
@@ -67,6 +68,11 @@ class EcReadDispatcher:
         self.coalescer = Coalescer(self.cfg.max_batch, self.cfg.max_queue)
         self.qos = QosController.from_config(self.cfg)
         self._inflight = 0
+        # strong refs to the live drain-lane tasks (the event loop only
+        # holds weak ones) + an exception-logging done-callback: a lane
+        # dying outside _serve_batch's own catch must be attributable,
+        # not a silent narrowing of the pipeline (GL111)
+        self._lanes: set = set()
 
     # ----------------------------------------------------------- telemetry
 
@@ -195,7 +201,10 @@ class EcReadDispatcher:
             # (finished) trace — member traces ride ReadRequest.obs_ctx
             # instead
             with obs.detached():
-                asyncio.ensure_future(self._drain())
+                spawn_logged(
+                    self._drain(), log, "ec-read drain lane",
+                    registry=self._lanes,
+                )
 
     async def _drain(self) -> None:
         """One pipeline lane: serve batches until the queue empties.

@@ -32,8 +32,12 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
+from typing import TYPE_CHECKING, Callable
 
 from .. import stats
+
+if TYPE_CHECKING:
+    from .config import ServingConfig
 
 INTERACTIVE = "interactive"
 BULK = "bulk"
@@ -67,8 +71,11 @@ class Breaker:
     CLOSED, HALF_OPEN, OPEN = 0, 1, 2
 
     def __init__(
-        self, trip_after: int = 64, cooldown_s: float = 1.0, clock=time.monotonic
-    ):
+        self,
+        trip_after: int = 64,
+        cooldown_s: float = 1.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
         self.trip_after = max(1, int(trip_after))
         self.cooldown_s = cooldown_s
         self._clock = clock
@@ -129,21 +136,21 @@ class QosController:
         policies: dict[str, TierPolicy],
         trip_after: int = 64,
         cooldown_s: float = 1.0,
-        clock=time.monotonic,
-    ):
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
         self.policies = policies
-        self._queued = {t: 0 for t in policies}
-        self._breakers = {
+        self._queued: dict[str, int] = {t: 0 for t in policies}
+        self._breakers: dict[str, Breaker] = {
             t: Breaker(trip_after, cooldown_s, clock) for t in policies
         }
         # last gauge-published breaker state per tier: the gauge is only
         # touched on transitions, not on every hot-path admission
-        self._published_state = {t: -1 for t in policies}
+        self._published_state: dict[str, int] = {t: -1 for t in policies}
         # per-needle service seconds EWMA; None until the first batch
         self._service_s: float | None = None
 
     @classmethod
-    def from_config(cls, cfg) -> "QosController":
+    def from_config(cls, cfg: ServingConfig) -> "QosController":
         """Build from a ServingConfig (the -ec.qos.* flags)."""
         return cls(
             {

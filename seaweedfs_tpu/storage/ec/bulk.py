@@ -83,12 +83,14 @@ class BulkConfig:
 
     # run the reader/writer legs on dedicated threads; False = the
     # serial baseline (every leg on the caller thread) the bench sweep's
-    # overlap-off axis measures
+    # overlap-off axis measures (-ec.bulk.overlap.disable)
     overlap: bool = True
     # bounded stripe-queue depth: how many read batches the reader leg
     # may run ahead of the codec (and results ahead of the writer)
+    # (-ec.bulk.prefetch)
     prefetch: int = 3
     # per-shard bytes per codec call; 0 = DEFAULT_STRIDE
+    # (-ec.bulk.strideMB)
     stride: int = 0
 
     def validated(self) -> "BulkConfig":

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+from typing import IO, Any, AsyncIterator
 
 
 def _read_bytes(path: str) -> bytes:
@@ -49,7 +50,9 @@ async def write_file_text(path: str, text: str) -> None:
 
 
 @contextlib.asynccontextmanager
-async def open_in_thread(path: str, mode: str = "r", **kw):
+async def open_in_thread(
+    path: str, mode: str = "r", **kw: Any
+) -> AsyncIterator[IO[Any]]:
     """`async with open_in_thread(p, "rb") as f:` — open and close run
     in to_thread; the caller dispatches each read/write the same way
     (`await asyncio.to_thread(f.read, n)`).  The shared form of the

@@ -1,0 +1,54 @@
+"""Supervised background-task spawning — the canonical fix for
+graftlint's GL111 task-leak rule.
+
+A bare `asyncio.create_task(...)` whose handle nobody holds has two
+failure modes: the event loop only keeps a WEAK reference to running
+tasks, so the GC may collect (and thereby cancel) it mid-flight, and
+any exception it dies with is never observed — the loop logs "Task
+exception was never retrieved" at interpreter exit, long after the
+trace that would explain it is gone.
+
+`spawn_logged` returns a real handle, optionally retains it in a
+caller-owned registry (discarded on completion), and attaches a
+done-callback that logs failures WITH the trace id that was active at
+spawn time, so a dead heartbeat/refresh/handler loop is attributable
+to the request that spawned it.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Coroutine, MutableSet
+
+from .. import obs
+
+
+def spawn_logged(
+    coro: Coroutine[Any, Any, Any],
+    log: logging.Logger,
+    what: str,
+    registry: MutableSet[asyncio.Task] | None = None,
+) -> asyncio.Task:
+    """Spawn `coro`, retain the task (in `registry` when given — the
+    strong reference the event loop itself does not keep), and log any
+    exception it dies with, stamped with the spawn-time trace id.
+    Cancellation is not an error and is not logged."""
+    cur = obs.current()
+    trace_id = cur[0].trace_id if cur is not None else "-"
+    task = asyncio.ensure_future(coro)
+    if registry is not None:
+        registry.add(task)
+
+    def _done(t: asyncio.Task) -> None:
+        if registry is not None:
+            registry.discard(t)
+        if t.cancelled():
+            return
+        exc = t.exception()
+        if exc is not None:
+            log.warning(
+                "background task %s died: %r (trace %s)", what, exc, trace_id
+            )
+
+    task.add_done_callback(_done)
+    return task

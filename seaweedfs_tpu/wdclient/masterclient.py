@@ -57,7 +57,9 @@ class MasterClient:
             try:
                 await self._subscribe(master)
             except asyncio.CancelledError:
-                return
+                # stop() cancelled us (it awaits and eats the
+                # CancelledError itself): propagate the true state
+                raise
             except Exception as e:
                 log.debug("keepConnected to %s: %s", master, e)
             self._connected.clear()

@@ -44,3 +44,21 @@ def _lockwatch_sweep():
     with lockwatch.watch() as w:
         yield
     w.assert_no_cycles()
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _viewguard_sweep():
+    """Opt-in suite-wide view-lifetime sweep: SWFS_VIEWGUARD=1 wraps the
+    zero-copy/staging buffer sources (tests/viewguard.py) and fails the
+    run on any view that outlives its buffer's reuse or whose bytes
+    drift while a holder is still reading — the dynamic complement of
+    graftlint's GL109/GL110.  Off by default: fingerprinting every
+    zero-copy payload adds per-read overhead to the tier-1 run."""
+    if os.environ.get("SWFS_VIEWGUARD") != "1":
+        yield
+        return
+    import viewguard
+
+    with viewguard.watch() as g:
+        yield
+    g.assert_clean()

@@ -1,0 +1,17 @@
+"""Seeded GL112 violations: a flag with no README row and no config
+mention (2 findings: one per missing contract side — it is in the
+-ec.qos.* namespace ServingConfig owns)."""
+
+
+def seeded_undocumented_flag(p) -> None:
+    p.add_argument(
+        "-ec.qos.seededBogusKnob", dest="seeded_bogus", type=int, default=0,
+        help="seeded GL112 fixture: no README row, no config mention",
+    )
+
+
+def fine_documented_flag(p) -> None:
+    # a real, fully-documented flag: README row + ServingConfig mention
+    p.add_argument(
+        "-ec.qos.tripAfter", dest="ec_qos_trip_after", type=int, default=64,
+    )

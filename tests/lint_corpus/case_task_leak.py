@@ -1,0 +1,48 @@
+"""Seeded GL111 violations: dropped task handles + swallowed
+cancellation."""
+import asyncio
+import logging
+
+log = logging.getLogger(__name__)
+
+
+async def seeded_dropped_task(work) -> None:
+    asyncio.create_task(work())  # GL111: handle dropped, GC may collect
+
+
+async def seeded_assigned_never_used(work) -> None:
+    t = asyncio.ensure_future(work())  # GL111: `t` never read again
+    await asyncio.sleep(0)
+
+
+async def seeded_swallowed_cancellation(work) -> None:
+    try:
+        await work()
+    except asyncio.CancelledError:  # GL111: no cancel() here, no re-raise
+        log.debug("cancelled")
+
+
+async def fine_retained_with_callback(work, tasks: set) -> None:
+    t = asyncio.create_task(work())
+    tasks.add(t)
+    t.add_done_callback(tasks.discard)
+
+
+async def fine_cancel_then_await(task) -> None:
+    task.cancel()
+    try:
+        await task
+    except asyncio.CancelledError:
+        pass  # we cancelled it ourselves: the canonical shutdown pattern
+
+
+async def fine_reraise(work) -> None:
+    try:
+        await work()
+    except asyncio.CancelledError:
+        log.debug("cancelled mid-flight")
+        raise
+
+
+async def fine_awaited_inline(work) -> None:
+    await asyncio.create_task(work())

@@ -1,0 +1,7 @@
+"""Seeded GL113 violation: a waiver whose violation is long gone."""
+import asyncio
+
+
+async def seeded_stale_waiver() -> None:
+    # graftlint: allow(async-blocking): stale — the sleep became await
+    await asyncio.sleep(0.01)  # GL113 fires on the waiver line above

@@ -1,0 +1,35 @@
+"""Seeded GL110 violations: donated buffers referenced after the call."""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def seeded_donating_kernel(buf, scale):
+    return buf * scale
+
+
+@functools.partial(jax.jit, donate_argnames=("staging",))
+def seeded_donating_named(x, staging):
+    return x + staging
+
+
+def seeded_use_after_donate(buf, scale):
+    out = seeded_donating_kernel(buf, scale)
+    return out, buf.sum()  # GL110: buf was donated above
+
+
+def seeded_named_use_after_donate(x, staging):
+    out = seeded_donating_named(x, staging=staging)
+    checksum = jnp.sum(staging)  # GL110: staging was donated by name
+    return out, checksum
+
+
+def fine_rebound_donation(buf, scale):
+    buf = seeded_donating_kernel(buf, scale)  # rebind: the result is new
+    return buf.sum()
+
+
+def fine_last_use(buf, scale):
+    return seeded_donating_kernel(buf, scale)  # donation is the last use

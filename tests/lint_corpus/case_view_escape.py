@@ -1,0 +1,41 @@
+"""Seeded GL109 violations: views of reusable buffers escaping the
+deriving function (field store, container append, scheduled closure)."""
+import numpy as np
+
+
+class SeededArenaHolder:
+    def __init__(self) -> None:
+        self._staging = np.empty((4, 1024), dtype=np.int32)
+        self._held = []
+        self.last_view = None
+
+    def seeded_field_escape(self) -> None:
+        scratch = bytearray(4096)
+        window = memoryview(scratch)[16:128]
+        self.last_view = window  # GL109: view of a local bytearray escapes
+
+    def seeded_container_escape(self) -> None:
+        row = self._staging[0, :64]  # view of the reusable arena attr
+        self._held.append(row)  # GL109: appended into a long-lived list
+
+    def seeded_closure_escape(self, loop) -> None:
+        buf = np.zeros(256, dtype=np.uint8)
+        tail = buf[128:]
+        loop.call_soon(lambda: tail.sum())  # GL109: scheduled closure
+
+    def fine_copy_escape(self) -> None:
+        scratch = bytearray(4096)
+        window = memoryview(scratch)[16:128]
+        self.last_view = bytes(window)  # copy: no finding
+
+    def fine_return_view(self):
+        # returning a view is the zero-copy contract (the CALLER owns
+        # the lifetime) — not an escape into longer-lived storage
+        view = self._staging[1, :32]
+        return view
+
+
+def fine_immutable_source(payload: bytes, out: dict) -> None:
+    # a view over immutable `bytes` is safe: nothing can mutate it and
+    # the refcount keeps it alive — not tracked
+    out["v"] = memoryview(payload)[4:]

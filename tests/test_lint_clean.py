@@ -88,6 +88,11 @@ def test_every_rule_fires_on_its_corpus_fixture(corpus_findings):
         ("GL106", "case_stage_registry"),
         ("GL107", "case_proto"),
         ("GL108", "case_silent_swallow"),
+        ("GL109", "case_view_escape"),
+        ("GL110", "case_use_after_donate"),
+        ("GL111", "case_task_leak"),
+        ("GL112", "case_flag_drift"),
+        ("GL113", "case_unused_waiver"),
     ],
 )
 def test_rule_fires_in_the_named_case_file(
@@ -113,6 +118,11 @@ def test_seeded_counts_are_exact(corpus_findings):
         "GL106": 2,  # span + record_span
         "GL107": 4,  # number drift, 2 one-sided fields, 1 message
         "GL108": 2,  # bare broad + tuple-with-BaseException
+        "GL109": 3,  # field store, container append, scheduled closure
+        "GL110": 2,  # donate_argnums use-after + donate_argnames use-after
+        "GL111": 3,  # dropped handle, dead assignment, swallowed cancel
+        "GL112": 2,  # no README row + no config mention (one flag, both)
+        "GL113": 1,  # the stale waiver
     }, by_rule
 
 
@@ -121,6 +131,72 @@ def test_seeded_counts_are_exact(corpus_findings):
 
 def test_waiver_suppresses_named_rule(corpus_findings):
     assert not [f for f in corpus_findings if "case_waived" in f.path]
+
+
+def test_used_waiver_produces_no_gl113(corpus_findings):
+    """case_waived's waiver SUPPRESSES a finding, so the unused-waiver
+    rule must stay quiet there — GL113 only fires on dead waivers."""
+    assert not [
+        f for f in corpus_findings
+        if f.rule == "GL113" and "case_waived" in f.path
+    ]
+
+
+def test_waiver_inside_string_literal_is_not_a_waiver(tmp_path):
+    """Only COMMENT tokens count: a waiver spelled in a string is
+    documentation, and must neither suppress nor be reported stale."""
+    p = tmp_path / "strlit.py"
+    p.write_text(
+        'DOC = "# graftlint: allow(async-blocking): in a string"\n'
+    )
+    findings = engine.run_paths([str(p)], use_cache=False)
+    assert not [f for f in findings if f.rule == "GL113"], findings
+
+
+# ------------------------------------- 3b. fingerprint cache + --jobs
+
+
+def test_cache_hits_are_equivalent_and_invalidate_on_edit(
+    tmp_path, monkeypatch
+):
+    monkeypatch.setenv("SWFS_LINT_CACHE", str(tmp_path / "cache.json"))
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "import asyncio, time\n\n\n"
+        "async def h():\n    time.sleep(1)\n"
+    )
+    first = engine.run_paths([str(p)])
+    assert [f.rule for f in first] == ["GL101"]
+    # second run: served from cache, identical findings
+    second = engine.run_paths([str(p)])
+    assert [(f.rule, f.line, f.message) for f in first] == [
+        (f.rule, f.line, f.message) for f in second
+    ]
+    assert (tmp_path / "cache.json").exists()
+    # editing the file invalidates its entry: the fix is seen
+    p.write_text(
+        "import asyncio\n\n\n"
+        "async def h():\n    await asyncio.sleep(1)\n"
+    )
+    assert engine.run_paths([str(p)]) == []
+
+
+def test_jobs_pool_matches_serial_findings():
+    sys.path.insert(0, CORPUS)
+    try:
+        serial = engine.run_paths(
+            [CORPUS], proto_pb2_package="case_proto",
+            include_corpus=True, use_cache=False, jobs=1,
+        )
+        pooled = engine.run_paths(
+            [CORPUS], proto_pb2_package="case_proto",
+            include_corpus=True, use_cache=False, jobs=4,
+        )
+    finally:
+        sys.path.remove(CORPUS)
+    assert [(f.path, f.line, f.rule) for f in serial] == [
+        (f.path, f.line, f.rule) for f in pooled
+    ]
 
 
 # ----------------------------------------- 4. runtime lockwatch harness

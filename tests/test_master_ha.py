@@ -142,7 +142,8 @@ def test_master_ha_failover(tmp_path):
             for m in masters:
                 try:
                     await m.stop()
-                # graftlint: allow(no-silent-swallow): best-effort teardown
+                # graftlint: allow(no-silent-swallow): best-effort
+                # m.stop() teardown of an already-failed master
                 except Exception:
                     pass
 
@@ -183,7 +184,8 @@ def test_growth_replicates_vid_ceiling(tmp_path):
             for m in masters:
                 try:
                     await m.stop()
-                # graftlint: allow(no-silent-swallow): best-effort teardown
+                # graftlint: allow(no-silent-swallow): best-effort
+                # m.stop() teardown of an already-failed master
                 except Exception:
                     pass
 

@@ -191,7 +191,8 @@ def test_mq_notification_broker_restart_mid_stream(tmp_path):
                 task.cancel()
                 try:
                     await task
-                # graftlint: allow(no-silent-swallow): best-effort teardown
+                # graftlint: allow(no-silent-swallow): best-effort
+                # `await task` drain of the cancelled notifier task
                 except (asyncio.CancelledError, Exception):  # noqa: BLE001
                     pass
             await notifier.close()
@@ -316,7 +317,8 @@ def test_mq_notification_broker_failover(tmp_path):
                 task.cancel()
                 try:
                     await task
-                # graftlint: allow(no-silent-swallow): best-effort teardown
+                # graftlint: allow(no-silent-swallow): best-effort
+                # `await task` drain of the cancelled notifier task
                 except (asyncio.CancelledError, Exception):  # noqa: BLE001
                     pass
             await notifier.close()

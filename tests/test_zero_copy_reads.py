@@ -50,6 +50,14 @@ def test_from_bytes_zero_copy_over_bytearray_and_crc():
     raw = bytearray(n.to_bytes())
     m = Needle.from_bytes(raw, copy=False)
     assert bytes(m.data) == b"x" * 1000
+    # the corruption below is DELIBERATE: under a SWFS_VIEWGUARD sweep,
+    # release the export first so the sanitizer doesn't (correctly!)
+    # flag this fixture as a stale-byte serve
+    import viewguard
+
+    vg = viewguard.current()
+    if vg is not None:
+        vg.release(m.data)
     raw[20] ^= 0xFF  # corrupt the payload under the view
     with pytest.raises(CrcError):
         Needle.from_bytes(bytes(raw))
@@ -209,8 +217,8 @@ def test_dribbling_client_releases_server_resources_at_budget(tmp_path):
                         got += len(chunk)
                         dribbling.set()
                         await asyncio.sleep(0.05)  # ~20KB/s
-                except (ConnectionResetError, asyncio.CancelledError):
-                    pass
+                except ConnectionResetError:
+                    pass  # the stall guard aborted us: expected
                 finally:
                     writer.close()
                 return got

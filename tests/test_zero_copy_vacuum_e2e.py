@@ -1,0 +1,126 @@
+"""E2e satellite for graftflow: a vacuum/compaction rewrites the volume
+while a zero-copy streamed response over the OLD buffer is still
+dribbling out to a slow client.  The response must be byte-stable (or
+cleanly aborted) — never interleaved old/new bytes — because the
+zero-copy design views immutable pread `bytes` and the commit swaps the
+dat fd by reference (readers on the old inode drain via refcount).
+
+Runs under viewguard: every server-side zero-copy payload view is
+fingerprinted at parse and re-verified at each vacuum commit and at
+watch exit, so a stale-byte serve fails HERE even if the client-side
+byte comparison were somehow satisfied by luck.
+"""
+import asyncio
+import os
+
+import viewguard
+from seaweedfs_tpu.operation.assign import assign
+from seaweedfs_tpu.operation.upload import upload_data
+from seaweedfs_tpu.server.cluster import LocalCluster
+from seaweedfs_tpu.storage import vacuum as vacuum_mod
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_vacuum_racing_streamed_zero_copy_response(tmp_path):
+    async def go():
+        cluster = LocalCluster(base_dir=str(tmp_path))
+        await cluster.start()
+        drib_task = None
+        try:
+            master = cluster.master.advertise_url
+            vs = cluster.volume_servers[0]
+            # big enough that _respond_needle streams it chunked
+            # (>64KB) and the dribbler holds the response open long
+            # enough for two vacuums to land mid-stream
+            payload = os.urandom(1 << 20)
+            a = await assign(master)
+            await upload_data(f"http://{a.url}/{a.fid}", payload, "big.bin")
+            vid = int(a.fid.split(",")[0])
+            # garbage for the vacuum to reclaim: a second needle,
+            # deleted right away
+            b = await assign(master)
+            while int(b.fid.split(",")[0]) != vid:
+                b = await assign(master)
+            await upload_data(
+                f"http://{b.url}/{b.fid}", os.urandom(200_000), "junk.bin"
+            )
+            v = vs.store.find_volume(vid)
+            assert v is not None
+            assert vs.ec_dispatcher.cfg.zero_copy  # the path under test
+
+            got = bytearray()
+            streaming = asyncio.Event()
+
+            async def dribble() -> None:
+                reader, writer = await asyncio.open_connection(
+                    vs.ip, vs.port
+                )
+                try:
+                    writer.write(
+                        f"GET /{a.fid} HTTP/1.1\r\nHost: {vs.url}\r\n"
+                        "Connection: close\r\n\r\n".encode()
+                    )
+                    await writer.drain()
+                    # consume headers
+                    while True:
+                        line = await reader.readline()
+                        if line in (b"\r\n", b""):
+                            break
+                    while True:
+                        chunk = await reader.read(32 * 1024)
+                        if not chunk:
+                            break
+                        got.extend(chunk)
+                        streaming.set()
+                        await asyncio.sleep(0.02)  # ~1.6 MB/s dribble
+                finally:
+                    writer.close()
+
+            drib_task = asyncio.ensure_future(dribble())
+            await asyncio.wait_for(streaming.wait(), timeout=30)
+
+            # two compactions UNDER the in-flight response: first
+            # reclaims the junk needle, second re-proves idempotence
+            await asyncio.to_thread(
+                lambda: (
+                    v.delete(int(b.fid.split(",")[1][:-8], 16)),
+                    vacuum_mod.vacuum(v),
+                    vacuum_mod.vacuum(v),
+                )
+            )
+            await asyncio.wait_for(drib_task, timeout=120)
+            drib_task = None
+            # byte-stable: the streamed body is exactly the original
+            # payload — no interleaved post-compaction bytes.  (A clean
+            # abort would show as a short body and fail here loudly,
+            # which the contract also allows us to catch and report.)
+            assert bytes(got) == payload, (
+                f"streamed body diverged: {len(got)} bytes vs "
+                f"{len(payload)} expected"
+            )
+            # and the volume still serves byte-exact AFTER the race
+            import aiohttp
+
+            async with aiohttp.ClientSession() as sess:
+                async with sess.get(f"http://{vs.url}/{a.fid}") as r:
+                    assert r.status == 200
+                    assert await r.read() == payload
+        finally:
+            if drib_task is not None:
+                drib_task.cancel()
+                try:
+                    await drib_task
+                except asyncio.CancelledError:
+                    pass
+            await cluster.stop()
+            from seaweedfs_tpu.pb.rpc import close_all_channels
+
+            await close_all_channels()
+
+    with viewguard.watch() as g:
+        run(go())
+    assert g.exports_total > 0, "server never took the zero-copy parse"
+    g.assert_clean()

@@ -1,0 +1,286 @@
+"""Runtime view-lifetime sanitizer — the dynamic complement of
+graftlint's static GL109 (view-escape) and GL110 (use-after-donate).
+
+The zero-copy serving path (r13) hands memoryviews of needle source
+buffers all the way into HTTP body writes, and the staging arenas (r11)
+hand numpy views of reused pinned blocks into donated device calls.
+Static analysis proves views don't ESCAPE; it cannot prove the bytes a
+still-outstanding view reads are the bytes that were exported.  This
+harness closes that gap at test time:
+
+  * every zero-copy `Needle.from_bytes(copy=False)` payload view is
+    registered with a content fingerprint at export;
+  * every `StagingArena.stage_*` view is registered against its arena,
+    and REUSING an arena (the next `stage_*` on it) while a previous
+    export is still outstanding is a violation — that is exactly the
+    aliasing scribble the two-slot pipeline exists to prevent;
+  * arena exports auto-release when their `DevicePipeline` slot is
+    returned (the device call that consumed them has completed);
+  * `vacuum.commit` triggers an immediate re-verification of every
+    outstanding view: a vacuum that mutated bytes under a live zero-copy
+    response fails HERE, not as interleaved bytes on a client socket;
+  * `release(view)` / watch-exit verify fingerprints: any drift means a
+    stale-byte serve and raises ViewGuardViolation.
+
+Usage:
+
+    with viewguard.watch() as g:
+        ... exercise zero-copy reads / vacuum / batches ...
+    g.assert_clean()        # verifies every outstanding view too
+
+Suite-wide sweep (opt-in, see tests/conftest.py):
+    SWFS_VIEWGUARD=1 pytest tests/
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Iterator
+
+
+class ViewGuardViolation(AssertionError):
+    """A view outlived its buffer's reuse, or its bytes drifted."""
+
+
+def _fingerprint(view: Any) -> int:
+    """crc32 of the view's current bytes (cheap at test sizes)."""
+    if isinstance(view, memoryview):
+        return zlib.crc32(view)
+    # numpy view (arena staging) — tobytes() copies, fine for tests
+    return zlib.crc32(view.tobytes() if hasattr(view, "tobytes") else bytes(view))
+
+
+@dataclass
+class _Export:
+    view: Any          # strong ref: id() stays valid while registered
+    source_id: int     # id() of the buffer/arena the view derives from
+    label: str
+    crc: int
+
+
+@dataclass
+class ViewGuard:
+    violations: list = field(default_factory=list)
+    exports_total: int = 0
+    releases_total: int = 0
+    reuse_checks_total: int = 0
+    _mu: threading.Lock = field(default_factory=threading.Lock)
+    _exports: dict = field(default_factory=dict)  # id(view) -> _Export
+
+    # ------------------------------------------------------- registration
+
+    def export(self, view: Any, source: Any, label: str) -> None:
+        with self._mu:
+            self.exports_total += 1
+            self._exports[id(view)] = _Export(
+                view, id(source), label, _fingerprint(view)
+            )
+
+    def release(self, view: Any) -> None:
+        """Verify-and-drop one export (call when the holder is done
+        reading — response fully written, device call returned)."""
+        with self._mu:
+            exp = self._exports.pop(id(view), None)
+        if exp is None:
+            return
+        self.releases_total += 1
+        self._verify(exp)
+
+    def release_source(self, source: Any) -> None:
+        """Release every outstanding export derived from `source`."""
+        sid = id(source)
+        with self._mu:
+            mine = [k for k, e in self._exports.items() if e.source_id == sid]
+            exps = [self._exports.pop(k) for k in mine]
+        for exp in exps:
+            self.releases_total += 1
+            self._verify(exp)
+
+    # --------------------------------------------------------- enforcement
+
+    def check_reuse(self, source: Any, what: str) -> None:
+        """A guarded source is about to be reused/overwritten: any
+        outstanding export over it is a use-after-reuse hazard."""
+        sid = id(source)
+        self.reuse_checks_total += 1
+        with self._mu:
+            live = [e for e in self._exports.values() if e.source_id == sid]
+        for exp in live:
+            self._fail(
+                f"{what} while view {exp.label!r} is still outstanding — "
+                "the holder would read scribbled bytes"
+            )
+
+    def check_donation(self, arr: Any, what: str) -> None:
+        """An array is being donated to a device call: donating a
+        still-outstanding exported view hands its memory to XLA."""
+        with self._mu:
+            exp = self._exports.get(id(arr))
+        if exp is not None:
+            self._fail(
+                f"{what} donates view {exp.label!r} that is still "
+                "outstanding — the kernel may alias its buffer as output"
+            )
+
+    def verify_outstanding(self, why: str) -> None:
+        """Re-fingerprint every outstanding export (e.g. right after a
+        vacuum commit): drift = stale bytes already served."""
+        with self._mu:
+            live = list(self._exports.values())
+        for exp in live:
+            self._verify(exp, why=why)
+
+    # ------------------------------------------------------------ verdicts
+
+    def _verify(self, exp: _Export, why: str = "release") -> None:
+        try:
+            now = _fingerprint(exp.view)
+        except ValueError:
+            # underlying buffer was resized/closed with the view live:
+            # that is its own violation (BufferError normally guards it)
+            self._fail(
+                f"view {exp.label!r} lost its buffer before {why}"
+            )
+            return
+        if now != exp.crc:
+            self._fail(
+                f"view {exp.label!r} bytes changed under the holder "
+                f"(detected at {why}): exported crc {exp.crc:08x}, now "
+                f"{now:08x} — stale/interleaved bytes would have been "
+                "served"
+            )
+
+    def _fail(self, msg: str) -> None:
+        with self._mu:
+            self.violations.append(msg)
+        raise ViewGuardViolation(msg)
+
+    def assert_clean(self) -> None:
+        self.verify_outstanding("watch exit")
+        if self.violations:
+            raise ViewGuardViolation("; ".join(self.violations))
+
+    @property
+    def outstanding(self) -> int:
+        with self._mu:
+            return len(self._exports)
+
+
+# the innermost active watch, so a test that DELIBERATELY mutates a
+# buffer under a zero-copy view (the CRC-corruption fixtures) can
+# release its export first instead of tripping the suite-wide sweep
+_ACTIVE: list[ViewGuard] = []
+
+
+def current() -> ViewGuard | None:
+    return _ACTIVE[-1] if _ACTIVE else None
+
+
+@contextlib.contextmanager
+def watch() -> Iterator[ViewGuard]:
+    """Instrument the view sources for the duration of the context:
+
+      Needle.from_bytes(copy=False)  -> export payload views
+      StagingArena.stage_fused/xla   -> reuse check + export
+      DevicePipeline.slot            -> auto-release the slot arena's
+                                        exports when the slot returns
+      vacuum.commit                  -> verify outstanding views after
+    """
+    from seaweedfs_tpu.ops import rs_resident
+    from seaweedfs_tpu.storage import needle as needle_mod
+    from seaweedfs_tpu.storage import vacuum as vacuum_mod
+
+    g = ViewGuard()
+
+    real_from_bytes = needle_mod.Needle.from_bytes.__func__
+    real_stage_fused = rs_resident.StagingArena.stage_fused
+    real_stage_xla = rs_resident.StagingArena.stage_xla
+    real_slot = rs_resident.DevicePipeline.slot
+    real_commit = vacuum_mod.commit
+    real_dispatch = rs_resident._dispatch_call
+
+    # nested watches stack their patches (a test's own watch() inside
+    # the SWFS_VIEWGUARD session sweep): only the INNERMOST guard
+    # registers, so a scoped test that deliberately scribbles under a
+    # view (and verifies the violation itself) cannot leak an
+    # already-poisoned export into the outer sweep's ledger
+    def _mine() -> bool:
+        return bool(_ACTIVE) and _ACTIVE[-1] is g
+
+    def from_bytes(cls, buf, version=needle_mod.CURRENT_VERSION,
+                   verify=True, copy=True):
+        n = real_from_bytes(cls, buf, version, verify, copy)
+        if (
+            _mine() and not copy
+            and isinstance(n.data, memoryview) and len(n.data)
+        ):
+            g.export(n.data, buf, f"needle {n.id:x} payload")
+        return n
+
+    def stage_fused(self, packed, pad):
+        if _mine():
+            g.check_reuse(self, "StagingArena.stage_fused reuses the arena")
+        view = real_stage_fused(self, packed, pad)
+        if _mine():
+            g.export(view, self, f"arena fused meta [{len(packed)}+{pad}]")
+        return view
+
+    def stage_xla(self, offsets, rows, deltas, pad):
+        if _mine():
+            g.check_reuse(self, "StagingArena.stage_xla reuses the arena")
+        view = real_stage_xla(self, offsets, rows, deltas, pad)
+        if _mine():
+            g.export(view, self, f"arena xla meta [{len(offsets)}+{pad}]")
+        return view
+
+    @contextlib.contextmanager
+    def slot(self):
+        with real_slot(self) as s:
+            try:
+                yield s
+            finally:
+                # the device call holding this slot has returned: its
+                # arena exports are dead (verified on the way out)
+                g.release_source(s.arena)
+
+    def dispatch_call(kind, vec, *args, **kw):
+        # donation boundary: the staged vec rides donate_argnums into
+        # the kernel.  On a COPYING client (TPU: device_put copies) a
+        # live arena export at this position is the designed fast path;
+        # on a zero-copy PJRT client (CPU) it would hand the export's
+        # actual memory to XLA — exactly the aliasing the arena gating
+        # in reconstruct_intervals exists to prevent, enforced here so
+        # a gating regression fails the test at the dispatch boundary.
+        from seaweedfs_tpu.ops import rs_tpu
+
+        if not rs_tpu.on_tpu():
+            g.check_donation(vec, f"_dispatch_call({kind})")
+        return real_dispatch(kind, vec, *args, **kw)
+
+    def commit(v, cpd, cpx, idx_snapshot, shadow_db=None):
+        out = real_commit(v, cpd, cpx, idx_snapshot, shadow_db)
+        # the .dat was just swapped: every outstanding zero-copy view
+        # must still read its exported bytes (old preads are immutable
+        # `bytes` over the old inode — this is what PROVES it)
+        g.verify_outstanding(f"vacuum commit of volume {v.id}")
+        return out
+
+    needle_mod.Needle.from_bytes = classmethod(from_bytes)
+    rs_resident.StagingArena.stage_fused = stage_fused
+    rs_resident.StagingArena.stage_xla = stage_xla
+    rs_resident.DevicePipeline.slot = slot
+    vacuum_mod.commit = commit
+    rs_resident._dispatch_call = dispatch_call
+    _ACTIVE.append(g)
+    try:
+        yield g
+    finally:
+        _ACTIVE.remove(g)
+        needle_mod.Needle.from_bytes = classmethod(real_from_bytes)
+        rs_resident.StagingArena.stage_fused = real_stage_fused
+        rs_resident.StagingArena.stage_xla = real_stage_xla
+        rs_resident.DevicePipeline.slot = real_slot
+        vacuum_mod.commit = real_commit
+        rs_resident._dispatch_call = real_dispatch

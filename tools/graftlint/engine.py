@@ -6,26 +6,43 @@ Usage (the tier-1 entry point):
 
 Exit 0 = tree clean.  Findings print as `path:line: GLnnn message`.
 
-Waivers: a finding is suppressed when the flagged line or the line
-directly above carries `# graftlint: allow(<rule-name>)` — a reason
-after the colon is expected and reviewed like any comment.  Waivers are
-for DELIBERATE exceptions (an explicit tiny D2H the code wants), not a
-mute button; every waiver names its rule so a grep lists them all.
+Waivers: a finding is suppressed when the flagged line — or the
+contiguous comment block directly above it — carries a COMMENT reading
+`# graftlint: allow(<rule-name>): reason`.  Waivers are for DELIBERATE
+exceptions, not a mute button: GL113 fails the gate on any waiver that
+no longer suppresses anything, so a waiver that outlives its violation
+must be deleted with it.  Only real comment tokens count (a waiver
+spelled inside a string literal is documentation, not a waiver).
+
+Performance: per-file results are cached in `.graftlint_cache.json`
+keyed by file content hash + a salt over the linter's own sources and
+the metric/stage registry, so an unchanged file re-lints for the cost
+of one hash; `--jobs N` fans uncached files over a process pool.  The
+cross-file passes (lock order, proto drift, flag drift, unused-waiver
+accounting) always run — they are cheap and their inputs span files.
 """
 from __future__ import annotations
 
 import ast
+import hashlib
+import io
+import json
 import os
 import re
+import sys
+import tokenize
 from dataclasses import dataclass, field
 
-from . import locks, proto, rules
-from .model import Finding, rule_by_id
+from . import flags as flags_mod
+from . import flow, locks, proto, rules
+from .model import UNUSED_WAIVER, Finding, rule_by_id
 
 # seeded-violation fixtures live here: the clean-tree run must skip them
 # (they exist to FAIL), but linting the corpus dir explicitly works
 _CORPUS_DIR = "lint_corpus"
 _WAIVER_RE = re.compile(r"graftlint:\s*allow\(([\w-]+)\)")
+_CACHE_NAME = ".graftlint_cache.json"
+_CACHE_VERSION = 2
 
 
 @dataclass
@@ -33,6 +50,40 @@ class FileUnit:
     path: str
     tree: ast.Module
     lines: list[str] = field(default_factory=list)
+    # lineno -> waived rule name, from COMMENT tokens only
+    waivers: dict[int, str] = field(default_factory=dict)
+
+
+@dataclass
+class FileResult:
+    """Everything the cross-file passes need from one file — the unit
+    of the fingerprint cache (must stay JSON-serializable)."""
+
+    path: str
+    findings: list[Finding] = field(default_factory=list)  # post-waiver
+    waiver_lines: list[tuple[int, str]] = field(default_factory=list)
+    used_waivers: list[int] = field(default_factory=list)
+    flag_decls: list[tuple[str, int]] = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {
+            "findings": [
+                [f.rule, f.path, f.line, f.message] for f in self.findings
+            ],
+            "waivers": list(self.waiver_lines),
+            "used": list(self.used_waivers),
+            "flags": list(self.flag_decls),
+        }
+
+    @classmethod
+    def from_json(cls, path: str, d: dict) -> "FileResult":
+        return cls(
+            path=path,
+            findings=[Finding(*row) for row in d.get("findings", ())],
+            waiver_lines=[tuple(w) for w in d.get("waivers", ())],
+            used_waivers=list(d.get("used", ())),
+            flag_decls=[tuple(w) for w in d.get("flags", ())],
+        )
 
 
 def collect_files(paths: list[str], include_corpus: bool = False) -> list[str]:
@@ -60,44 +111,62 @@ def collect_files(paths: list[str], include_corpus: bool = False) -> list[str]:
     return sorted(set(out))
 
 
-def parse_files(file_paths: list[str]) -> tuple[list[FileUnit], list[Finding]]:
-    units: list[FileUnit] = []
-    findings: list[Finding] = []
-    for path in file_paths:
-        with open(path, encoding="utf-8") as f:
-            src = f.read()
-        try:
-            tree = ast.parse(src, filename=path)
-        except SyntaxError as e:
-            findings.append(Finding(
-                "GL000", path, e.lineno or 0, f"syntax error: {e.msg}"
-            ))
-            continue
-        units.append(FileUnit(path, tree, src.splitlines()))
-    return units, findings
+def comment_waivers(src: str) -> dict[int, str]:
+    """lineno -> rule name for every `# graftlint: allow(<rule>)` that
+    is a real COMMENT token.  Waiver text inside string literals is
+    deliberately ignored (GL113 would otherwise flag the lint's own
+    docstrings as stale waivers)."""
+    out: dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(src).readline):
+            if tok.type == tokenize.COMMENT:
+                m = _WAIVER_RE.search(tok.string)
+                if m:
+                    out[tok.start[0]] = m.group(1)
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass  # the parse pass reports the syntax error as GL000
+    return out
 
 
-def _registry_context(units: list[FileUnit]) -> tuple[set[str], set[str]]:
-    """Declared series bases + stage names.  Parsed from the linted
-    tree when stats/ is part of it, else from the repo's own stats
-    package relative to this file (so linting a single file still has
-    the registry to check against)."""
+def parse_unit(path: str, src: str) -> tuple[FileUnit | None, Finding | None]:
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return None, Finding(
+            "GL000", path, e.lineno or 0, f"syntax error: {e.msg}"
+        )
+    return FileUnit(
+        path, tree, src.splitlines(), comment_waivers(src)
+    ), None
+
+
+def _registry_context(
+    file_paths: list[str],
+) -> tuple[set[str], set[str]]:
+    """Declared series bases + stage names, parsed from the registry
+    modules inside the linted set when present, else from the repo's
+    own stats package (so linting a single file still has the registry
+    to check against)."""
     series: set[str] = set()
     stages: set[str] = set()
-    reg_units = [u for u in units if _is_registry_module(u.path)]
-    if not reg_units:
+    reg_paths = [p for p in file_paths if _is_registry_module(p)]
+    if not reg_paths:
         repo_root = _repo_root()
-        for rel in ("seaweedfs_tpu/stats/metrics.py",
-                    "seaweedfs_tpu/stats/cluster.py"):
-            p = os.path.join(repo_root, rel)
-            if os.path.exists(p):
-                with open(p, encoding="utf-8") as f:
-                    reg_units.append(
-                        FileUnit(p, ast.parse(f.read(), filename=p))
-                    )
-    for u in reg_units:
-        series |= rules.declared_series(u.tree)
-        stages |= rules.declared_stages(u.tree)
+        reg_paths = [
+            os.path.join(repo_root, rel)
+            for rel in ("seaweedfs_tpu/stats/metrics.py",
+                        "seaweedfs_tpu/stats/cluster.py")
+        ]
+    for p in reg_paths:
+        if not os.path.exists(p):
+            continue
+        with open(p, encoding="utf-8") as f:
+            try:
+                tree = ast.parse(f.read(), filename=p)
+            except SyntaxError:
+                continue
+        series |= rules.declared_series(tree)
+        stages |= rules.declared_stages(tree)
     return series, stages
 
 
@@ -112,31 +181,157 @@ def _repo_root() -> str:
     )
 
 
-def _waived(unit: FileUnit, finding: Finding) -> bool:
-    """True when the flagged line — or the contiguous comment block
-    directly above it — carries `# graftlint: allow(<rule>)`."""
+def _waiver_line_for(unit: FileUnit, finding: Finding) -> int | None:
+    """Line of the waiver covering `finding`, else None.  The flagged
+    line itself or the contiguous comment block directly above it."""
     rule_name = rule_by_id(finding.rule).name if finding.rule != "GL000" else ""
 
     def hit(lineno: int) -> bool:
-        m = _WAIVER_RE.search(unit.lines[lineno - 1])
-        return bool(m) and m.group(1) in (rule_name, finding.rule, "all")
+        got = unit.waivers.get(lineno)
+        return got is not None and got in (rule_name, finding.rule, "all")
 
     if not (1 <= finding.line <= len(unit.lines)):
-        return False
+        return None
     if hit(finding.line):
-        return True
+        return finding.line
     lineno = finding.line - 1
     while lineno >= 1 and unit.lines[lineno - 1].lstrip().startswith("#"):
         if hit(lineno):
-            return True
+            return lineno
         lineno -= 1
-    return False
+    return None
+
+
+# ------------------------------------------------------- per-file stage
+
+
+def lint_one_file(
+    path: str, series: tuple[str, ...], stages: tuple[str, ...]
+) -> FileResult:
+    """Run every per-file rule over one file and apply its waivers.
+    Pure function of (file content, registry context) — the unit of
+    both the fingerprint cache and the --jobs process pool."""
+    res = FileResult(path)
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    unit, err = parse_unit(path, src)
+    if err is not None:
+        res.findings.append(err)
+        return res
+    assert unit is not None
+    res.waiver_lines = sorted(unit.waivers.items())
+    res.flag_decls = flags_mod.flag_decls(unit.tree, path)
+
+    raw: list[Finding] = []
+    raw += rules.check_async_blocking(unit.tree, path)
+    raw += rules.check_device_sync(unit.tree, path)
+    raw += rules.check_jit_static(unit.tree, path)
+    raw += rules.check_metric_registry(
+        unit.tree, path, set(series), _is_registry_module(path)
+    )
+    raw += rules.check_stage_registry(unit.tree, path, set(stages))
+    raw += rules.check_silent_swallow(unit.tree, path)
+    raw += flow.check_view_escape(unit.tree, path)
+    raw += flow.check_use_after_donate(unit.tree, path)
+    raw += flow.check_task_leak(unit.tree, path)
+
+    used: set[int] = set()
+    for f in raw:
+        w = _waiver_line_for(unit, f)
+        if w is None:
+            res.findings.append(f)
+        else:
+            used.add(w)
+    res.used_waivers = sorted(used)
+    return res
+
+
+# ------------------------------------------------------------ cache
+
+
+def _file_fingerprint(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        h.update(f.read())
+    return h.hexdigest()
+
+
+def _tool_salt(series: tuple[str, ...], stages: tuple[str, ...]) -> str:
+    """Changes whenever the linter itself (any tools/graftlint source)
+    or the registry context changes — either invalidates every cached
+    per-file result."""
+    h = hashlib.sha256()
+    h.update(f"v{_CACHE_VERSION}py{sys.version_info[:2]}".encode())
+    tool_dir = os.path.dirname(os.path.abspath(__file__))
+    for fn in sorted(os.listdir(tool_dir)):
+        if fn.endswith(".py"):
+            with open(os.path.join(tool_dir, fn), "rb") as f:
+                h.update(f.read())
+    for name in series + ("|",) + stages:
+        h.update(name.encode())
+    return h.hexdigest()
+
+
+class _Cache:
+    def __init__(self, path: str, salt: str, enabled: bool):
+        self.path = path
+        self.salt = salt
+        self.enabled = enabled
+        self._files: dict[str, dict] = {}
+        self._dirty = False
+        if not enabled:
+            return
+        try:
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+            if data.get("salt") == salt:
+                self._files = data.get("files", {})
+        except (OSError, ValueError):
+            self._files = {}
+
+    def get(self, path: str, fp: str) -> FileResult | None:
+        if not self.enabled:
+            return None
+        entry = self._files.get(path)
+        if entry and entry.get("fp") == fp:
+            try:
+                return FileResult.from_json(path, entry["res"])
+            except (KeyError, TypeError):
+                return None
+        return None
+
+    def put(self, path: str, fp: str, res: FileResult) -> None:
+        if not self.enabled:
+            return
+        self._files[path] = {"fp": fp, "res": res.to_json()}
+        self._dirty = True
+
+    def save(self) -> None:
+        if not (self.enabled and self._dirty):
+            return
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"salt": self.salt, "files": self._files}, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            # cache is an accelerator, never a correctness input: a
+            # read-only checkout just re-lints every file
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+# ------------------------------------------------------------- driver
 
 
 def run_paths(
     paths: list[str],
     proto_pb2_package: str = "seaweedfs_tpu.pb",
     include_corpus: bool = False,
+    jobs: int = 1,
+    use_cache: bool = True,
 ) -> list[Finding]:
     findings: list[Finding] = []
     for p in paths:
@@ -149,31 +344,90 @@ def run_paths(
                 "path does not exist — fix the lint invocation",
             ))
     file_paths = collect_files(paths, include_corpus=include_corpus)
-    units, parse_findings = parse_files(file_paths)
-    findings.extend(parse_findings)
-    series, stages = _registry_context(units)
+    series_set, stages_set = _registry_context(file_paths)
+    series = tuple(sorted(series_set))
+    stages = tuple(sorted(stages_set))
 
-    for u in units:
-        per_file: list[Finding] = []
-        per_file += rules.check_async_blocking(u.tree, u.path)
-        per_file += rules.check_device_sync(u.tree, u.path)
-        per_file += rules.check_jit_static(u.tree, u.path)
-        per_file += rules.check_metric_registry(
-            u.tree, u.path, series, _is_registry_module(u.path)
-        )
-        per_file += rules.check_stage_registry(u.tree, u.path, stages)
-        per_file += rules.check_silent_swallow(u.tree, u.path)
-        findings.extend(f for f in per_file if not _waived(u, f))
+    cache = _Cache(
+        os.environ.get("SWFS_LINT_CACHE")
+        or os.path.join(_repo_root(), _CACHE_NAME),
+        _tool_salt(series, stages),
+        enabled=use_cache,
+    )
+
+    results: dict[str, FileResult] = {}
+    todo: list[tuple[str, str]] = []  # (path, fingerprint)
+    for path in file_paths:
+        try:
+            fp = _file_fingerprint(path)
+        except OSError as e:
+            findings.append(Finding("GL000", path, 0, f"unreadable: {e}"))
+            continue
+        hit = cache.get(path, fp)
+        if hit is not None:
+            results[path] = hit
+        else:
+            todo.append((path, fp))
+
+    if jobs > 1 and len(todo) > 1:
+        import concurrent.futures
+
+        with concurrent.futures.ProcessPoolExecutor(
+            max_workers=min(jobs, len(todo))
+        ) as pool:
+            for (path, fp), res in zip(
+                todo,
+                pool.map(
+                    lint_one_file,
+                    [p for p, _ in todo],
+                    [series] * len(todo),
+                    [stages] * len(todo),
+                ),
+            ):
+                results[path] = res
+                cache.put(path, fp, res)
+    else:
+        for path, fp in todo:
+            res = lint_one_file(path, series, stages)
+            results[path] = res
+            cache.put(path, fp, res)
+
+    for path in file_paths:
+        if path in results:
+            findings.extend(results[path].findings)
+
+    # waiver usage across EVERY pass feeds GL113 at the end
+    used_by_path: dict[str, set[int]] = {
+        p: set(r.used_waivers) for p, r in results.items()
+    }
 
     # cross-file: the static lock-order graph over the serving stack.
-    # Findings anchor at a lock's declaration site, so the normal waiver
-    # channel applies there (conservative call resolution can err — a
-    # reasoned `# graftlint: allow(lock-order)` must be able to say so)
-    units_by_path = {u.path: u for u in units}
-    for f in locks.check_lock_order({u.path: u.tree for u in units}):
-        u = units_by_path.get(f.path)
-        if u is None or not _waived(u, f):
+    # Lock-scope files are re-parsed here even when their per-file
+    # results were cached — the graph's inputs span files, so its
+    # findings can never be cached per-file.  Findings anchor at a
+    # lock's declaration site, so the normal waiver channel applies
+    # there (conservative call resolution can err — a reasoned
+    # `# graftlint: allow(lock-order)` must be able to say so)
+    lock_units: dict[str, FileUnit] = {}
+    for path in file_paths:
+        if not locks.in_lock_scope(path):
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                unit, _err = parse_unit(path, f.read())
+        except OSError:
+            continue
+        if unit is not None:
+            lock_units[path] = unit
+    for f in locks.check_lock_order(
+        {p: u.tree for p, u in lock_units.items()}
+    ):
+        u = lock_units.get(f.path)
+        w = _waiver_line_for(u, f) if u is not None else None
+        if w is None:
             findings.append(f)
+        else:
+            used_by_path.setdefault(f.path, set()).add(w)
 
     # proto drift: any pb/ directory with .proto files inside the linted
     # paths (the real tree's seaweedfs_tpu/pb)
@@ -189,6 +443,71 @@ def run_paths(
             continue
         findings.extend(proto.check_proto_dir(d, proto_pb2_package))
 
+    # GL112 flag drift: declarations from every linted file vs README
+    # and the config modules.  The README/config reverse directions
+    # only run on a full-tree lint (command/ modules present).
+    decls = [
+        (flag, p, line)
+        for p, r in results.items()
+        for flag, line in r.flag_decls
+    ]
+    full_tree = any(
+        "seaweedfs_tpu/command/" in p.replace("\\", "/") for p in results
+    )
+    # memoized waiver-unit lookup keyed by ABSOLUTE path: flag-drift
+    # findings in config modules carry repo_root-joined paths while the
+    # linted set is keyed as-invoked (often relative) — without the
+    # normalization a config-module waiver could never suppress (and
+    # would then be double-reported as GL113 unused)
+    waiver_units: dict[str, FileUnit | None] = {
+        os.path.abspath(p): u for p, u in lock_units.items()
+    }
+
+    def _unit_for(path: str) -> FileUnit | None:
+        ap = os.path.abspath(path)
+        if ap not in waiver_units:
+            unit = None
+            if path.endswith(".py"):
+                try:
+                    with open(ap, encoding="utf-8") as fh:
+                        unit, _err = parse_unit(path, fh.read())
+                except OSError:
+                    unit = None
+            waiver_units[ap] = unit
+        return waiver_units[ap]
+
+    for f in flags_mod.check_flag_drift(decls, _repo_root(), full_tree):
+        u = _unit_for(f.path)
+        w = _waiver_line_for(u, f) if u is not None else None
+        if w is None:
+            findings.append(f)
+        else:
+            # key by every alias of the path present in `results` so the
+            # GL113 pass (keyed as-invoked) sees the use
+            ap = os.path.abspath(f.path)
+            for p in results:
+                if os.path.abspath(p) == ap:
+                    used_by_path.setdefault(p, set()).add(w)
+                    break
+            else:
+                used_by_path.setdefault(f.path, set()).add(w)
+
+    # GL113 unused waivers: every comment waiver that suppressed nothing
+    # in ANY pass above.  Computed last so cross-file suppressions count
+    # as use; not itself waivable (a waiver for the unused-waiver rule
+    # would be unused by construction).
+    for path in sorted(results):
+        used = used_by_path.get(path, set())
+        for line, rule_name in results[path].waiver_lines:
+            if line not in used:
+                findings.append(Finding(
+                    UNUSED_WAIVER.rule_id, path, line,
+                    f"waiver allow({rule_name}) suppresses nothing — "
+                    "the violation it covered is gone; delete the "
+                    "waiver (or fix the rule name if it drifted)",
+                ))
+
+    cache.save()
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
 
@@ -221,6 +540,16 @@ def main(argv: list[str]) -> int:
         "--include-corpus", action="store_true",
         help="lint tests/lint_corpus too (it is SEEDED with violations)",
     )
+    ap.add_argument(
+        "--jobs", type=int,
+        default=int(os.environ.get("SWFS_LINT_JOBS", "1") or "1"),
+        help="process-pool width for uncached files (default: "
+        "$SWFS_LINT_JOBS or 1)",
+    )
+    ap.add_argument(
+        "--no-cache", action="store_true",
+        help="ignore and do not write .graftlint_cache.json",
+    )
     args = ap.parse_args(argv)
 
     if args.doc:
@@ -233,6 +562,8 @@ def main(argv: list[str]) -> int:
             args.paths,
             proto_pb2_package=args.proto_pb2_package,
             include_corpus=args.include_corpus,
+            jobs=max(1, args.jobs),
+            use_cache=not args.no_cache,
         )
         for f in findings:
             print(f.render())

@@ -1,0 +1,153 @@
+"""GL112 flag-drift: the `-ec.*`/`-obs.*` CLI surface is a three-way
+contract — the `add_argument` declaration in command/, the config
+dataclass the value lands in (ServingConfig / BulkConfig / ObsConfig),
+and the README flag table an operator reads.  This rule pins all three
+to each other, both directions:
+
+  1. every declared flag must have a README flag-table row;
+  2. every declared flag in a config-owned namespace must be NAMED in
+     its config module's source (comments count — the dataclass field
+     comments are where flags are documented per-knob);
+  3. every README row must correspond to a declared flag (stale docs);
+  4. every config-source flag mention must correspond to a declared
+     flag (stale comments).
+
+Directions 3 and 4 only run when the linted set actually contains the
+command/ modules (a full-tree run): linting a loose file set must not
+report the whole README as drifted.
+
+Wildcard doc references like `-ec.qos.*` are skipped — the rule wants
+every real knob named somewhere exact, and the namespace prose can stay.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Iterable, Iterator
+
+from .model import FLAG_DRIFT, Finding
+
+_FLAG_RE = re.compile(
+    r"-(?:ec|obs)\.[A-Za-z][A-Za-z0-9]*(?:\.[A-Za-z][A-Za-z0-9]*)*"
+)
+# README table row: `| `-ec.foo` | ...`
+_README_ROW_RE = re.compile(r"^\|\s*`(-(?:ec|obs)\.[^`]+)`")
+
+# namespace -> config module (repo-relative) that must name each flag
+CONFIG_OWNERS: tuple[tuple[str, str], ...] = (
+    ("-ec.serving.", "seaweedfs_tpu/serving/config.py"),
+    ("-ec.qos.", "seaweedfs_tpu/serving/config.py"),
+    ("-ec.bulk.", "seaweedfs_tpu/storage/ec/bulk.py"),
+    ("-obs.", "seaweedfs_tpu/obs/config.py"),
+)
+
+
+def config_owner(flag: str) -> str | None:
+    for prefix, path in CONFIG_OWNERS:
+        if flag.startswith(prefix):
+            return path
+    return None
+
+
+def flag_decls(tree, path: str) -> list[tuple[str, int]]:
+    """(flag, line) for every add_argument("-ec..."/"-obs...") literal
+    in one parsed file."""
+    import ast
+
+    from .rules import _str_const, dotted
+
+    out: list[tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func) or ""
+        if not name.endswith("add_argument") or not node.args:
+            continue
+        lit = _str_const(node.args[0])
+        if lit and (lit.startswith("-ec.") or lit.startswith("-obs.")):
+            out.append((lit, node.lineno))
+    return out
+
+
+def _mentions(source: str) -> list[tuple[str, int]]:
+    """Exact flag literals mentioned anywhere in a source text (comments
+    and docstrings included), wildcard references skipped."""
+    out: list[tuple[str, int]] = []
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        for m in _FLAG_RE.finditer(line):
+            tail = line[m.end():]
+            if tail.startswith("*") or tail.startswith(".*"):
+                continue  # `-ec.qos.*DeadlineMs`-style namespace prose
+            out.append((m.group(0), lineno))
+    return out
+
+
+def check_flag_drift(
+    decls: Iterable[tuple[str, str, int]],  # (flag, path, line)
+    repo_root: str,
+    full_tree: bool,
+) -> Iterator[Finding]:
+    decls = list(decls)
+    declared = {flag for flag, _, _ in decls}
+
+    readme_path = os.path.join(repo_root, "README.md")
+    readme_rows: list[tuple[str, int]] = []
+    if os.path.exists(readme_path):
+        with open(readme_path, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, start=1):
+                m = _README_ROW_RE.match(line)
+                if m:
+                    readme_rows.append((m.group(1), lineno))
+    readme_flags = {flag for flag, _ in readme_rows}
+
+    config_texts: dict[str, list[tuple[str, int]]] = {}
+    for _, rel in CONFIG_OWNERS:
+        if rel in config_texts:
+            continue
+        p = os.path.join(repo_root, rel)
+        if os.path.exists(p):
+            with open(p, encoding="utf-8") as f:
+                config_texts[rel] = _mentions(f.read())
+        else:
+            config_texts[rel] = []
+
+    # 1 + 2: declaration-side checks
+    for flag, path, line in decls:
+        if flag not in readme_flags:
+            yield Finding(
+                FLAG_DRIFT.rule_id, path, line,
+                f"flag {flag!r} has no README flag-table row — an "
+                "operator cannot discover it; add the row (and keep the "
+                "default/meaning columns honest)",
+            )
+        owner = config_owner(flag)
+        if owner is not None:
+            mentioned = {f for f, _ in config_texts.get(owner, ())}
+            if flag not in mentioned:
+                yield Finding(
+                    FLAG_DRIFT.rule_id, path, line,
+                    f"flag {flag!r} is not named in its config module "
+                    f"{owner} — the dataclass field it lands in must "
+                    "document which flag feeds it",
+                )
+
+    if not full_tree:
+        return
+
+    # 3: README rows with no declaration
+    for flag, lineno in readme_rows:
+        if flag not in declared:
+            yield Finding(
+                FLAG_DRIFT.rule_id, readme_path, lineno,
+                f"README flag-table row {flag!r} matches no "
+                "add_argument declaration — stale doc row",
+            )
+    # 4: config mentions with no declaration
+    for rel, mentions in config_texts.items():
+        for flag, lineno in mentions:
+            if flag not in declared:
+                yield Finding(
+                    FLAG_DRIFT.rule_id, os.path.join(repo_root, rel), lineno,
+                    f"config comment names {flag!r} but no add_argument "
+                    "declares it — stale config doc",
+                )

@@ -1,0 +1,471 @@
+"""Dataflow-aware per-file rules (graftflow): GL109/GL110/GL111.
+
+Where rules.py checks statements in isolation, these follow values —
+which names hold views of reusable buffers, which arrays a jitted call
+donated, which spawned tasks anybody still holds.  All of it stays
+stdlib-ast and deliberately intraprocedural: a lint that guesses across
+call boundaries starts lying, and the runtime sanitizers
+(tests/viewguard.py, tests/lockwatch.py) own the cross-function half.
+
+The hazard classes are the ones r11/r13 created:
+
+  * GL109 — zero-copy made needle payloads memoryviews over their source
+    buffers; a view over a REUSABLE buffer (bytearray, np.empty staging,
+    an arena attribute) that escapes into a field/container/scheduled
+    closure outlives the deriving frame, and the next reuse scribbles
+    over bytes the holder still reads.  Views over immutable `bytes`
+    (pread results) are safe and not tracked.
+  * GL110 — donate_argnums hands the buffer to XLA; touching the name
+    again afterwards (without rebinding it to the call's result) reads
+    memory the kernel may have aliased as output.
+  * GL111 — a dropped create_task/ensure_future handle is a task the GC
+    can cancel mid-flight and whose exception nobody ever observes; an
+    `except CancelledError` that neither re-raises nor follows this
+    function's own `.cancel()` converts shutdown into a silent hang.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .model import TASK_LEAK, USE_AFTER_DONATE, VIEW_ESCAPE, Finding
+from .rules import dotted
+
+
+def _walk_same_function(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body without descending into nested function /
+    lambda scopes (same contract as rules._walk_same_function)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _functions(tree: ast.Module) -> Iterator[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+# ------------------------------------------------------- GL109 view-escape
+
+# allocators of REUSABLE/MUTABLE buffers: a view over one of these is
+# only valid while the allocation is neither reused nor freed.  Views
+# over immutable `bytes` (pread returns) are deliberately not tracked —
+# the refcount keeps those alive and nothing can mutate them.
+_MUTABLE_ALLOC = {
+    "bytearray",
+    "np.empty", "np.zeros", "np.ones", "np.empty_like", "np.zeros_like",
+    "numpy.empty", "numpy.zeros", "numpy.ones",
+    "np.frombuffer", "numpy.frombuffer",
+    "mmap.mmap",
+}
+# methods that produce another view of the same memory when called on a
+# tracked view/buffer name
+_VIEW_METHODS = {"cast", "toreadonly", "reshape", "view", "ravel"}
+# scheduling sinks: a closure handed to one of these outlives the frame
+_SCHEDULERS = (
+    "create_task", "ensure_future", "call_soon", "call_later",
+    "call_soon_threadsafe", "add_done_callback", "submit", "run_coroutine_threadsafe",
+)
+_CONTAINER_ADD = {"append", "add", "appendleft", "extend", "insert"}
+
+
+def _mutable_buffer_attrs(cls: ast.ClassDef) -> set[str]:
+    """Attribute names this class binds to reusable buffers
+    (`self.X = np.empty(...)` anywhere in its methods)."""
+    out: set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        if (
+            isinstance(node.value, ast.Call)
+            and dotted(node.value.func) in _MUTABLE_ALLOC
+        ):
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                ):
+                    out.add(t.attr)
+    return out
+
+
+class _ViewTracker:
+    """Per-function name state: which locals hold a reusable buffer,
+    which hold a view derived from one."""
+
+    def __init__(self, buffer_attrs: set[str]):
+        self.buffers: set[str] = set()
+        self.views: set[str] = set()
+        self.buffer_attrs = buffer_attrs  # self.<attr> reusable buffers
+
+    def _is_tracked_source(self, node: ast.AST) -> bool:
+        """True when `node` evaluates to a tracked buffer or view."""
+        name = dotted(node)
+        if name is None:
+            return False
+        if name in self.buffers or name in self.views:
+            return True
+        return name.startswith("self.") and name[5:] in self.buffer_attrs
+
+    def classify(self, value: ast.AST) -> str | None:
+        """'buffer' | 'view' | None for an expression.  Recursive so
+        chained derivations (`memoryview(scratch)[16:128]`) resolve."""
+        if self._is_tracked_source(value):
+            name = dotted(value) or ""
+            if name in self.views:
+                return "view"
+            return "buffer"
+        if isinstance(value, ast.Call):
+            fname = dotted(value.func)
+            if fname in _MUTABLE_ALLOC:
+                return "buffer"
+            if fname == "memoryview" and value.args and (
+                self.classify(value.args[0]) is not None
+            ):
+                return "view"
+            if (
+                isinstance(value.func, ast.Attribute)
+                and value.func.attr in _VIEW_METHODS
+                and self.classify(value.func.value) is not None
+            ):
+                return "view"
+        if isinstance(value, ast.Subscript) and (
+            self.classify(value.value) is not None
+        ):
+            # a subscript only yields a VIEW when it slices (scalar
+            # indexing of a bytearray yields an int, of an ndarray a
+            # scalar/row copy-or-view — only slices are unambiguous)
+            if _has_slice(value.slice):
+                return "view"
+        return None
+
+    def is_view_expr(self, node: ast.AST) -> bool:
+        """True for an expression that IS a tracked view (a view-holding
+        name, or an inline derivation from a tracked source)."""
+        name = dotted(node)
+        if name is not None and name in self.views:
+            return True
+        return self.classify(node) == "view"
+
+
+def _has_slice(node: ast.AST) -> bool:
+    if isinstance(node, ast.Slice):
+        return True
+    if isinstance(node, ast.Tuple):
+        return any(isinstance(e, ast.Slice) for e in node.elts)
+    return False
+
+
+def check_view_escape(tree: ast.Module, path: str) -> Iterator[Finding]:
+    # class pass: reusable buffers held as attributes (arena pattern)
+    attrs_by_class: dict[ast.AST, set[str]] = {}
+    class_of_fn: dict[ast.AST, ast.ClassDef | None] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            attrs_by_class[node] = _mutable_buffer_attrs(node)
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    class_of_fn[sub] = node
+
+    for fn in _functions(tree):
+        cls = class_of_fn.get(fn)
+        tracker = _ViewTracker(attrs_by_class.get(cls, set()) if cls else set())
+        nodes = sorted(
+            _walk_same_function(fn),
+            key=lambda n: (getattr(n, "lineno", 0), getattr(n, "col_offset", 0)),
+        )
+        # pass 1: bind names (source order so derivations chain)
+        for node in nodes:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and (
+                isinstance(node.targets[0], ast.Name)
+            ):
+                kind = tracker.classify(node.value)
+                name = node.targets[0].id
+                tracker.buffers.discard(name)
+                tracker.views.discard(name)
+                if kind == "buffer":
+                    tracker.buffers.add(name)
+                elif kind == "view":
+                    tracker.views.add(name)
+        # pass 2: escapes
+        for node in nodes:
+            yield from _escapes_in(node, tracker, path, fn)
+        # pass 3: closures over tracked views handed to schedulers or
+        # stored on attributes
+        yield from _closure_escapes(fn, tracker, path)
+
+
+def _escapes_in(node, tracker: "_ViewTracker", path, fn) -> Iterator[Finding]:
+    if isinstance(node, ast.Assign):
+        for target in node.targets:
+            stored_long = isinstance(target, ast.Attribute) or (
+                isinstance(target, ast.Subscript)
+                and dotted(target.value) is not None
+                and "." in (dotted(target.value) or "")
+            )
+            if stored_long and tracker.is_view_expr(node.value):
+                where = dotted(target) or dotted(
+                    getattr(target, "value", target)
+                ) or "<target>"
+                yield Finding(
+                    VIEW_ESCAPE.rule_id, path, node.lineno,
+                    f"view of a reusable buffer stored into {where} "
+                    f"outlives `{fn.name}` — copy (`bytes(view)`) or keep "
+                    "the holder's lifetime inside the buffer owner's",
+                )
+    elif isinstance(node, ast.Call):
+        # self._held.append(view) / registry.add(view)
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _CONTAINER_ADD
+        ):
+            recv = dotted(node.func.value)
+            if recv is not None and "." in recv:
+                for arg in node.args:
+                    if tracker.is_view_expr(arg):
+                        yield Finding(
+                            VIEW_ESCAPE.rule_id, path, node.lineno,
+                            f"view of a reusable buffer appended to "
+                            f"{recv} outlives `{fn.name}` — copy it or "
+                            "bound the container's lifetime",
+                        )
+
+
+def _closure_escapes(fn, tracker: "_ViewTracker", path) -> Iterator[Finding]:
+    if not tracker.views:
+        return
+    for node in _walk_same_function_with_nested_heads(fn):
+        nested = None
+        sink = None
+        if isinstance(node, ast.Call):
+            fname = dotted(node.func) or ""
+            if fname.split(".")[-1] in _SCHEDULERS:
+                for arg in node.args:
+                    if isinstance(arg, ast.Lambda):
+                        nested, sink = arg, fname
+        if nested is None:
+            continue
+        captured = {
+            n.id
+            for n in ast.walk(nested)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+        } & tracker.views
+        for name in sorted(captured):
+            yield Finding(
+                VIEW_ESCAPE.rule_id, path, node.lineno,
+                f"closure scheduled via {sink} captures view {name!r} of "
+                "a reusable buffer — the callback runs after the frame "
+                "(and possibly the buffer's reuse); copy before capture",
+            )
+
+
+def _walk_same_function_with_nested_heads(fn) -> Iterator[ast.AST]:
+    """Like _walk_same_function but yields (without entering) nested
+    defs/lambdas so closure sinks can inspect them."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# --------------------------------------------------- GL110 use-after-donate
+
+
+def _donating_callables(tree: ast.Module) -> dict[str, tuple[set, set]]:
+    """name -> (donated positional indices, donated argnames) for
+    module functions jitted with donation: decorator form
+    (@partial(jax.jit, donate_argnums=...)) and wrapper assignment form
+    (g = jax.jit(f, donate_argnums=...))."""
+    from .rules import _jit_kwargs, _literal_ints, _literal_names
+
+    out: dict[str, tuple[set, set]] = {}
+
+    def record(name: str, kw: dict) -> None:
+        nums = _literal_ints(kw.get("donate_argnums", ast.Constant(value=None)))
+        names = _literal_names(
+            kw.get("donate_argnames", ast.Constant(value=None))
+        )
+        if nums or names:
+            out[name] = (set(nums or ()), set(names or ()))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                kw = _jit_kwargs(deco)
+                if kw is not None:
+                    record(node.name, kw)
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            fname = dotted(node.value.func)
+            if fname in ("jax.jit", "jit") and len(node.targets) == 1 and (
+                isinstance(node.targets[0], ast.Name)
+            ):
+                record(
+                    node.targets[0].id,
+                    {k.arg: k.value for k in node.value.keywords if k.arg},
+                )
+    return out
+
+
+def check_use_after_donate(tree: ast.Module, path: str) -> Iterator[Finding]:
+    donators = _donating_callables(tree)
+    if not donators:
+        return
+    for fn in _functions(tree):
+        # events per donated NAME: (line, kind) kind in {donate, bind, load}
+        donations: list[tuple[int, str, str]] = []  # (line, name, callee)
+        binds: dict[str, list[int]] = {}
+        loads: dict[str, list[tuple[int, ast.Name]]] = {}
+        donated_arg_nodes: set[int] = set()
+        for node in _walk_same_function(fn):
+            if isinstance(node, ast.Call):
+                fname = dotted(node.func) or ""
+                callee = fname.split(".")[-1]
+                if callee in donators:
+                    idxs, names = donators[callee]
+                    picked: list[ast.AST] = [
+                        node.args[i] for i in idxs if i < len(node.args)
+                    ] + [
+                        k.value for k in node.keywords if k.arg in names
+                    ]
+                    for arg in picked:
+                        if isinstance(arg, ast.Name):
+                            donations.append((node.lineno, arg.id, callee))
+                            donated_arg_nodes.add(id(arg))
+            elif isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load):
+                    loads.setdefault(node.id, []).append((node.lineno, node))
+                else:  # Store / Del rebinds the name
+                    binds.setdefault(node.id, []).append(node.lineno)
+        for line, name, callee in donations:
+            rebind_after = [ln for ln in binds.get(name, ()) if ln >= line]
+            first_rebind = min(rebind_after) if rebind_after else None
+            # ascending source order: the window between the donating
+            # call and the first rebind is where a load is a violation —
+            # ast.walk yields loads in arbitrary order, so sort or the
+            # rebind check can mask an earlier real use
+            for load_line, load_node in sorted(
+                loads.get(name, ()), key=lambda t: t[0]
+            ):
+                if load_line <= line or id(load_node) in donated_arg_nodes:
+                    continue
+                if first_rebind is not None and load_line >= first_rebind:
+                    break  # rebound (e.g. `x = f(x)`): later uses are new
+                yield Finding(
+                    USE_AFTER_DONATE.rule_id, path, load_line,
+                    f"{name!r} was donated to {callee}() on line {line} "
+                    "and is referenced again here — the kernel may alias "
+                    "its buffer as output; rebind the name to the call's "
+                    "result or copy before the call",
+                )
+                break  # one finding per donation site
+
+
+# --------------------------------------------------------- GL111 task-leak
+
+_SPAWNERS = ("create_task", "ensure_future")
+
+
+def _is_spawn(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted(node.func) or ""
+    return name.split(".")[-1] in _SPAWNERS
+
+
+def check_task_leak(tree: ast.Module, path: str) -> Iterator[Finding]:
+    for fn in _functions(tree):
+        nodes = list(_walk_same_function(fn))
+        # name -> Load lines; retention means a load AFTER the spawn
+        # assignment (a pre-assignment load of the same name — `t = None;
+        # if t: ...; t = create_task(...)` — retains nothing).  Loop
+        # bodies are the exception: a textually-earlier load there runs
+        # after the assignment on the next iteration.
+        load_lines: dict[str, list[int]] = {}
+        cancel_lines: list[int] = []
+        loop_spans: list[tuple[int, int]] = []
+        for node in nodes:
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                load_lines.setdefault(node.id, []).append(node.lineno)
+            if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                loop_spans.append(
+                    (node.lineno, node.end_lineno or node.lineno)
+                )
+            if isinstance(node, ast.Call):
+                cname = dotted(node.func) or ""
+                if cname.endswith(".cancel"):
+                    cancel_lines.append(node.lineno)
+
+        def retained(name: str, assign_line: int) -> bool:
+            in_loop = any(a <= assign_line <= b for a, b in loop_spans)
+            return any(
+                ln > assign_line or in_loop
+                for ln in load_lines.get(name, ())
+            )
+
+        for node in nodes:
+            # dropped handle: `asyncio.create_task(...)` as a statement
+            if isinstance(node, ast.Expr) and _is_spawn(node.value):
+                yield Finding(
+                    TASK_LEAK.rule_id, path, node.lineno,
+                    "task spawned and dropped — retain it (named set / "
+                    "attribute) and attach a done-callback that logs the "
+                    "exception, or await it",
+                )
+            # assigned but never used again: the GC can still collect it
+            elif (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and _is_spawn(node.value)
+                and not retained(node.targets[0].id, node.lineno)
+            ):
+                yield Finding(
+                    TASK_LEAK.rule_id, path, node.lineno,
+                    f"task bound to {node.targets[0].id!r} but the name "
+                    "is never read afterwards — the reference dies with "
+                    "this frame; retain it somewhere owned or add a "
+                    "done-callback",
+                )
+        # CancelledError swallowed outside a cancel-then-await pattern
+        for node in nodes:
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _handles_cancelled(node):
+                continue
+            if any(isinstance(n, ast.Raise) for n in ast.walk(node)):
+                continue
+            if any(ln < node.lineno for ln in cancel_lines):
+                # this function cancelled something itself: awaiting the
+                # cancelled task and eating ITS CancelledError is the
+                # canonical shutdown pattern
+                continue
+            yield Finding(
+                TASK_LEAK.rule_id, path, node.lineno,
+                "except CancelledError neither re-raises nor follows a "
+                "`.cancel()` this function issued — swallowing foreign "
+                "cancellation turns shutdown into a hang; re-raise "
+                "after cleanup",
+            )
+
+
+def _handles_cancelled(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return False
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    return any(
+        (dotted(e) or "").split(".")[-1] == "CancelledError" for e in elts
+    )

@@ -117,6 +117,52 @@ SILENT_SWALLOW = register(
     "stay allowed",
     "except Exception:\\n    pass",
 )
+VIEW_ESCAPE = register(
+    "GL109",
+    "view-escape",
+    "a memoryview/ndarray view derived from a reusable or mutable "
+    "buffer (bytearray, np.empty staging, an arena attribute) escapes "
+    "the deriving function — stored into an object field, container, "
+    "or a scheduled closure — so buffer reuse/free mutates bytes the "
+    "holder still reads (the zero-copy hazard class)",
+    "self.cache[k] = memoryview(staging)[a:b]",
+)
+USE_AFTER_DONATE = register(
+    "GL110",
+    "use-after-donate",
+    "an array passed at a donate_argnums/donate_argnames position of a "
+    "jitted call is referenced again afterwards in the same function "
+    "without being rebound — the donated buffer may already be aliased "
+    "by the kernel's output",
+    "y = f(buf); buf[0]  # buf was donated to f",
+)
+TASK_LEAK = register(
+    "GL111",
+    "task-leak",
+    "an asyncio.create_task/ensure_future result that is neither "
+    "awaited, retained, nor given a done-callback (fire-and-forget "
+    "tasks can be GC'd mid-flight and their exceptions vanish), or an "
+    "`except CancelledError` that neither re-raises nor follows a "
+    "`.cancel()` this function itself issued",
+    "asyncio.create_task(loop())  # result dropped",
+)
+FLAG_DRIFT = register(
+    "GL112",
+    "flag-drift",
+    "an `-ec.*`/`-obs.*` CLI flag drifted from its contract: declared "
+    "without a README flag-table row, a serving/qos/bulk/obs flag its "
+    "config module never names, a README row or config mention with no "
+    "declaring add_argument — both directions checked",
+    'add_argument("-ec.qos.bogusKnob")  # no README row, no config',
+)
+UNUSED_WAIVER = register(
+    "GL113",
+    "unused-waiver",
+    "a `# graftlint: allow(<rule>)` comment that no longer suppresses "
+    "any finding — stale waivers hide future violations at the exact "
+    "line a reviewer already stopped reading",
+    "# graftlint: allow(async-blocking): stale — nothing here blocks",
+)
 
 
 def rule_table_markdown() -> str:
