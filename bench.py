@@ -146,6 +146,7 @@ HEADLINE_KEYS = (
     "encode_headline",
     "scrub_headline",
     "load_headline",
+    "tiering_headline",
 )
 
 
@@ -1802,6 +1803,183 @@ async def _load_sweep_async(
             - stalls_before
         )
 
+        # --- r15: oversubscribed heat-tiering pass -----------------------
+        # Working set deliberately ~4x the device budget (the
+        # LoadScenario.oversubscribe knob names the ratio): the same
+        # cluster and key space, swept twice — static pin + blind LRU
+        # budget eviction (today's behavior: whichever volumes pinned
+        # LAST hold the budget, popularity never consulted) vs the
+        # heat-tiered ladder (serving/tiering.py: hot volumes promoted
+        # into HBM with an AOT pre-warm, warm volumes staged into the
+        # pinned host-RAM reconstruct cache, cold volumes on disk).
+        # Every read stays byte-verified; the compile-miss and
+        # shed_cold_shape deltas over the whole tiered pass (which
+        # contains every promotion) back the stall-free-promotion
+        # verdict.
+        from seaweedfs_tpu.serving import ServingConfig as _TierCfg
+        from seaweedfs_tpu.serving.tiering import TieringController
+
+        oversubscribe = 4.0
+        # smoke: the two TOP levels x more reads — at 32 reads/level the
+        # per-level wall is ~0.1s and scheduler noise swamps the
+        # tiered-vs-static contrast the verdict gates on, and the
+        # device-batching advantage the ladder protects only shows
+        # under real concurrency
+        tier_levels = list(levels[2:]) if smoke else list(levels)
+        tier_reads = 3 * reads_per_level if smoke else reads_per_level
+        cache = vs.store.ec_device_cache
+        working_set = int(cache.bytes_used)
+        tier_budget = max(1, int(working_set / oversubscribe))
+        data_vids = sorted({int(fid.split(",")[0]) for fid in blobs})
+        tier_verify_failures = 0
+
+        def _tier_scenario(c):
+            # hot_volume_frac 0.7: the oversubscribed scenario IS a
+            # skewed working set — most traffic lands on the volume
+            # whose placement separates the two policies (static-LRU
+            # throws the first-pinned hot volume away; the heat ladder
+            # keeps it device-resident)
+            return LoadScenario(
+                connections=c, reads=tier_reads, zipf_s=1.1,
+                hot_volume_frac=0.7, oversubscribe=oversubscribe,
+            )
+
+        # STATIC-LRU baseline: shrink the budget, then re-pin every
+        # volume in vid order — the LRU keeps the LAST ~budget's worth,
+        # so the zipf-hottest volume (the first assigned, first pinned)
+        # is exactly what the blind eviction throws away
+        def _repin_static():
+            for v in data_vids:
+                cache.evict(v)
+            for v in data_vids:
+                vs.store.find_ec_volume(v).load_shards_to_device(cache)
+
+        vs.ec_dispatcher.tiering = None
+        cache.budget = tier_budget
+        await asyncio.to_thread(_repin_static)
+        static_curve = {}
+        for c in tier_levels:
+            res = await run_http_load(vs.url, dict(blobs), _tier_scenario(c))
+            tier_verify_failures += res.verify_failures
+            static_curve[str(c)] = res.summary()
+
+        # TIERED: start from an empty cache and let the heat ladder
+        # place the working set — promotions/demotions run concurrently
+        # with live load (the rebalance tick below), which IS the
+        # promotion window the stall-free verdict measures
+        for v in data_vids:
+            cache.evict(v)
+        tier_cfg = _TierCfg(
+            tier_host_cache_mb=max(1, working_set >> 20),
+            tier_half_life_seconds=5.0 if smoke else 30.0,
+            tier_min_residency_seconds=0.25 if smoke else 5.0,
+            tier_interval_seconds=0.0,  # bench drives rebalance itself
+        ).validated()
+        controller = TieringController(vs.store, tier_cfg)
+        controller.attach_qos(vs.ec_dispatcher.qos)
+        vs.ec_dispatcher.tiering = controller
+        miss0 = _counter(
+            "SeaweedFS_volumeServer_ec_device_compile_total",
+            {"result": "miss"},
+        )
+        shed0 = _counter("SeaweedFS_volumeServer_ec_shed_cold_shape_total")
+        host0 = _counter("SeaweedFS_volumeServer_ec_tier_host_reads_total")
+
+        # heat seeding + first promotions under live (untimed) load, so
+        # the timed levels start with the hot set device-resident while
+        # the ladder keeps moving underneath them
+        tick_stop = asyncio.Event()
+
+        async def _tick():
+            while not tick_stop.is_set():
+                await asyncio.to_thread(controller.rebalance)
+                try:
+                    await asyncio.wait_for(tick_stop.wait(), 0.2)
+                except asyncio.TimeoutError:
+                    pass
+
+        tick = asyncio.ensure_future(_tick())
+        tiered_curve = {}
+        try:
+            res = await run_http_load(
+                vs.url, dict(blobs), _tier_scenario(max(2, tier_levels[0]))
+            )
+            tier_verify_failures += res.verify_failures
+            for c in tier_levels:
+                res = await run_http_load(
+                    vs.url, dict(blobs), _tier_scenario(c)
+                )
+                tier_verify_failures += res.verify_failures
+                tiered_curve[str(c)] = res.summary()
+        finally:
+            tick_stop.set()
+            await tick
+            vs.ec_dispatcher.tiering = None
+
+        promo = sum(controller.promotions.values())
+        demo = sum(controller.demotions.values())
+        timed_misses = int(
+            _counter(
+                "SeaweedFS_volumeServer_ec_device_compile_total",
+                {"result": "miss"},
+            )
+            - miss0
+        )
+        shed_delta = int(
+            _counter("SeaweedFS_volumeServer_ec_shed_cold_shape_total")
+            - shed0
+        )
+        host_reads = int(
+            _counter("SeaweedFS_volumeServer_ec_tier_host_reads_total")
+            - host0
+        )
+        beats = all(
+            tiered_curve[str(c)]["reads_per_s"]
+            >= static_curve[str(c)]["reads_per_s"]
+            for c in tier_levels
+        )
+        tiered_series = [
+            tiered_curve[str(c)]["reads_per_s"] for c in tier_levels
+        ]
+        max_drop = 0.0
+        for a, b in zip(tiered_series, tiered_series[1:]):
+            if a > 0:
+                max_drop = max(max_drop, (a - b) / a)
+        out["tiering"] = {
+            "static_curve": static_curve,
+            "tiered_curve": tiered_curve,
+            "controller": controller.status(),
+        }
+        out["tiering_headline"] = {
+            "oversubscribe": oversubscribe,
+            "working_set_bytes": working_set,
+            "device_budget_bytes": tier_budget,
+            "tier_levels": [int(c) for c in tier_levels],
+            "static_reads_per_s": {
+                c: r["reads_per_s"] for c, r in static_curve.items()
+            },
+            "tiered_reads_per_s": {
+                c: r["reads_per_s"] for c, r in tiered_curve.items()
+            },
+            # THE r15 verdict: under a 4x-oversubscribed working set the
+            # heat ladder must beat static pin + blind LRU at EVERY
+            # connection count, and degrade smoothly instead of cliffing
+            "tiering_beats_static": bool(beats),
+            "max_step_drop_frac": round(max_drop, 3),
+            "no_cliff": bool(max_drop < 0.5),
+            "tier_promotions": promo,
+            "tier_demotions": demo,
+            "host_tier_reads": host_reads,
+            "timed_compile_misses": timed_misses,
+            "shed_cold_shape_delta": shed_delta,
+            # promotions happened (under live load) and none of them put
+            # a compile, or a shed spike, on the serving path
+            "promotion_stall_free": bool(
+                promo > 0 and timed_misses == 0 and shed_delta == 0
+            ),
+            "tier_verified": bool(tier_verify_failures == 0),
+        }
+
         out["curves"] = curves
         out["adversarial"] = adversarial
         top = str(max(levels))
@@ -2048,7 +2226,9 @@ def main():
                 "extra": {
                     "serving": serving,
                     "load_sweep": {
-                        k: v for k, v in load_sweep.items() if k != "headline"
+                        k: v
+                        for k, v in load_sweep.items()
+                        if k not in ("headline", "tiering_headline")
                     },
                     "scrub": scrub,
                     "scrub_all_sweep": scrub_all,
@@ -2181,11 +2361,49 @@ def main():
                         "blockdiag"
                     ]["per_volume_dispatches"],
                 },
-                # r13 front-door verdict (bench_load_sweep): the
-                # reads/s-vs-connections curve, QoS+zero-copy vs the
-                # pre-PR config, plus the S3-on-resident-path proof —
-                # guaranteed inside the archived tail
-                "load_headline": load_sweep["headline"],
+                # r13 front-door verdict (bench_load_sweep), COMPACT:
+                # the per-level reads/s dicts stay in extra.load_sweep —
+                # with the r15 tiering block added, the full forms would
+                # push `value`/`vs_baseline` out of the 2000-char
+                # archived tail (test_bench_contract pins the budget)
+                "load_headline": {
+                    k: v
+                    for k, v in load_sweep["headline"].items()
+                    if k not in (
+                        "load_levels",
+                        "pre_reads_per_s",
+                        "qos_zero_copy_reads_per_s",
+                    )
+                },
+                # r15 oversubscribed-tiering verdict, COMPACT for the
+                # same reason (full curves in extra.load_sweep.tiering):
+                # with the working set ~4x the device budget, the heat
+                # ladder vs static pin + blind LRU, promotion-stall-
+                # free, byte-verified
+                "tiering_headline": {
+                    **{
+                        k: v
+                        for k, v in load_sweep["tiering_headline"].items()
+                        if k not in (
+                            "working_set_bytes",
+                            "device_budget_bytes",
+                            "tier_levels",
+                            "static_reads_per_s",
+                            "tiered_reads_per_s",
+                            "shed_cold_shape_delta",
+                        )
+                    },
+                    "static_top_reads_per_s": load_sweep[
+                        "tiering_headline"
+                    ]["static_reads_per_s"][
+                        str(load_sweep["tiering_headline"]["tier_levels"][-1])
+                    ],
+                    "tiered_top_reads_per_s": load_sweep[
+                        "tiering_headline"
+                    ]["tiered_reads_per_s"][
+                        str(load_sweep["tiering_headline"]["tier_levels"][-1])
+                    ],
+                },
             })
         )
     )

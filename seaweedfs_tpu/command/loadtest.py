@@ -51,6 +51,13 @@ def add_args(p) -> None:
         help="QoS tier stamped on reads (X-Seaweed-QoS)",
     )
     p.add_argument(
+        "-oversubscribe", dest="oversubscribe", type=float, default=1.0,
+        help="working-set multiplier: scale the fill phase's object "
+        "count by this factor so the key space spans N times the "
+        "serving tier's device budget (oversubscribed tiering sweeps) "
+        "without hand-editing -n",
+    )
+    p.add_argument(
         "-s3", dest="s3", default="",
         help="host:port of an S3 gateway; also sweep GetObject through it",
     )
@@ -85,7 +92,10 @@ async def run(args) -> None:
     from ..loadgen import LoadScenario, run_http_load, run_s3_load
     from ..operation import lookup_file_id
 
-    blobs = await _fill(args.master, args.count, args.size, args.collection)
+    if args.oversubscribe <= 0:
+        raise SystemExit("-oversubscribe must be > 0")
+    count = max(1, int(round(args.count * args.oversubscribe)))
+    blobs = await _fill(args.master, count, args.size, args.collection)
     if not blobs:
         raise SystemExit("fill phase wrote nothing")
     # one URL base per fid (closed-loop readers hit the holder directly,
@@ -101,7 +111,7 @@ async def run(args) -> None:
             connections=c, reads=args.reads, zipf_s=args.zipf_s,
             hot_volume_frac=args.hot_volume_frac,
             slow_client_frac=args.slow_frac, churn=args.churn,
-            tier=args.tier,
+            tier=args.tier, oversubscribe=args.oversubscribe,
         )
         res = await run_http_load(volume_url, blobs, sc)
         curve[str(c)] = res.summary()
@@ -129,7 +139,7 @@ async def run(args) -> None:
             sc = LoadScenario(
                 connections=c, reads=args.reads, zipf_s=args.zipf_s,
                 slow_client_frac=args.slow_frac, churn=args.churn,
-                tier=args.tier,
+                tier=args.tier, oversubscribe=args.oversubscribe,
             )
             res = await run_s3_load(args.s3, args.bucket, objects, sc)
             s3_curve[str(c)] = res.summary()
@@ -137,6 +147,7 @@ async def run(args) -> None:
 
     print(json.dumps({
         "reads_per_level": args.reads,
+        "oversubscribe": args.oversubscribe,
         "http_curve": {c: r["reads_per_s"] for c, r in curve.items()},
         "s3_curve": {c: r["reads_per_s"] for c, r in s3_curve.items()},
     }))

@@ -170,6 +170,49 @@ def add_args(p) -> None:
         help="minimum drain rate a client must sustain for large read "
         "responses (sizes the per-response stall budget)",
     )
+    # heat-tiered residency ladder (serving/tiering.py): HBM -> host RAM
+    # -> disk, driven by decayed per-volume read heat
+    p.add_argument(
+        "-ec.tier.disable", dest="ec_tier_disable", action="store_true",
+        help="disable the automatic residency ladder (residency falls "
+        "back to manual pin/unpin + blind LRU budget eviction)",
+    )
+    p.add_argument(
+        "-ec.tier.intervalSeconds", dest="ec_tier_interval_seconds",
+        type=float, default=serving_defaults.tier_interval_seconds,
+        help="tier-loop rebalance cadence; 0 disables the loop",
+    )
+    p.add_argument(
+        "-ec.tier.hostCacheMB", dest="ec_tier_host_cache_mb", type=int,
+        default=serving_defaults.tier_host_cache_mb,
+        help="pinned host-RAM warm-tier budget: demoted volumes' shard "
+        "bytes stage here and serve reconstructs without disk reads "
+        "(0 disables the host tier)",
+    )
+    p.add_argument(
+        "-ec.tier.halfLifeSeconds", dest="ec_tier_half_life_seconds",
+        type=float, default=serving_defaults.tier_half_life_seconds,
+        help="decay half-life of the per-volume read-heat counters",
+    )
+    p.add_argument(
+        "-ec.tier.promoteRatio", dest="ec_tier_promote_ratio", type=float,
+        default=serving_defaults.tier_promote_ratio,
+        help="hysteresis margin: a promotion swap needs the candidate "
+        "to out-heat the coldest eligible resident by this factor",
+    )
+    p.add_argument(
+        "-ec.tier.minResidencySeconds",
+        dest="ec_tier_min_residency_seconds", type=float,
+        default=serving_defaults.tier_min_residency_seconds,
+        help="a promoted volume is not swap-eligible before this age "
+        "(over-budget pressure demotions ignore it)",
+    )
+    p.add_argument(
+        "-ec.tier.bulkWeight", dest="ec_tier_bulk_weight", type=float,
+        default=serving_defaults.tier_bulk_weight,
+        help="QoS weight of bulk-tier reads in the heat signal, so "
+        "background scans cannot evict the interactive hot set",
+    )
     p.add_argument(
         "-ec.scrub.megakernel.disable", dest="ec_scrub_megakernel_disable",
         action="store_true",
@@ -320,6 +363,13 @@ async def run(args) -> None:
             qos_recover_seconds=args.ec_qos_recover_seconds,
             stall_budget_seconds=args.ec_qos_stall_budget_seconds,
             stall_min_rate_kbps=args.ec_qos_stall_min_rate_kbps,
+            tier=not args.ec_tier_disable,
+            tier_interval_seconds=args.ec_tier_interval_seconds,
+            tier_host_cache_mb=args.ec_tier_host_cache_mb,
+            tier_half_life_seconds=args.ec_tier_half_life_seconds,
+            tier_promote_ratio=args.ec_tier_promote_ratio,
+            tier_min_residency_seconds=args.ec_tier_min_residency_seconds,
+            tier_bulk_weight=args.ec_tier_bulk_weight,
         ),
         **common_args.metrics_kwargs(args),
     )

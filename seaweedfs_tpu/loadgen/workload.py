@@ -35,6 +35,13 @@ class LoadScenario:
     churn: float = 0.0
     # QoS tier stamped on requests (X-Seaweed-QoS)
     tier: str = "interactive"
+    # working-set multiplier: how many times the device (HBM) budget
+    # the key space is meant to span.  The sizing hook for
+    # oversubscribed sweeps — `loadtest -oversubscribe N` scales its
+    # fill phase by it, and bench.py's tiering pass shrinks the cache
+    # budget to working_set/oversubscribe — so a 4x-over-budget sweep
+    # needs no hand-edited volume counts.  1.0 = the working set fits.
+    oversubscribe: float = 1.0
     # byte-verify every response against the expected blob
     verify: bool = True
     seed: int = 1337

@@ -226,6 +226,17 @@ class VolumeServer:
         self.ec_dispatcher = EcReadDispatcher(
             self.store, self._remote_shard_reader, ec_serving
         )
+        # heat-tiered residency ladder (serving/tiering.py, -ec.tier.*):
+        # only meaningful with a device cache; the dispatcher feeds the
+        # heat signal, the QoS controller gates swap churn under
+        # overload, and the tier loop below runs the rebalance cycles
+        self.tiering = None
+        if device_cache is not None and ec_serving.tier:
+            from ..serving.tiering import TieringController
+
+            self.tiering = TieringController(self.store, ec_serving)
+            self.tiering.attach_qos(self.ec_dispatcher.qos)
+            self.ec_dispatcher.tiering = self.tiering
         # stage-digest shipping state: deltas against _stage_snapshot
         # accrue in _digest_backlog until the heartbeat that carried
         # them is ACKED (the master answers every heartbeat in order),
@@ -301,6 +312,13 @@ class VolumeServer:
         if self.ec_scrub_interval_seconds > 0:
             self._tasks.append(
                 spawn_logged(self._ec_scrub_forever(), log, "ec scrub loop")
+            )
+        if (
+            self.tiering is not None
+            and self.ec_dispatcher.cfg.tier_interval_seconds > 0
+        ):
+            self._tasks.append(
+                spawn_logged(self._tier_loop_forever(), log, "ec tier loop")
             )
         push = stats.start_push_loop(
             "volumeServer", self.url, self.metrics_address,
@@ -387,6 +405,30 @@ class VolumeServer:
             stats.VOLUME_SERVER_SCRUB_CORRUPT_GAUGE.set(
                 sum(verdicts.values())
             )
+
+    async def _tier_loop_forever(self) -> None:
+        """The residency ladder's rebalance loop
+        (-ec.tier.intervalSeconds): each cycle re-ranks volumes by
+        decayed read heat and makes at most a couple of ladder moves —
+        promotion pins (host-RAM bytes first) + AOT pre-warm, demotion
+        through the claim/evict release path, host-tier staging.  The
+        blocking pin/stage IO runs on a worker thread so the event loop
+        keeps serving."""
+        interval = self.ec_dispatcher.cfg.tier_interval_seconds
+        while not self._stopping:
+            await asyncio.sleep(interval)
+            try:
+                moves = await asyncio.to_thread(self.tiering.rebalance)
+                if moves:
+                    log.info(
+                        "tier rebalance: %s",
+                        " ".join(f"{kind}:{vid}" for kind, vid in moves),
+                    )
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — one failed cycle must
+                # not end the ladder; the next cycle retries
+                log.exception("tier rebalance failed")
 
     async def _ttl_sweep_forever(self, interval: float = 60.0) -> None:
         while not self._stopping:
@@ -501,6 +543,17 @@ class VolumeServer:
         tel.compile_cache_enabled = bool(
             rs_resident.compile_cache_status()["enabled"]
         )
+        # residency-ladder state (serving/tiering.py): census from the
+        # last rebalance + cumulative promotion/demotion counters, so
+        # cluster.health can show where each node's working set lives
+        # and whether its ladder is thrashing
+        if self.tiering is not None:
+            tel.tier_hbm_volumes = self.tiering.last_sizes.get("hbm", 0)
+            tel.tier_host_volumes = self.tiering.last_sizes.get("host", 0)
+            tel.tier_promotions = sum(self.tiering.promotions.values())
+            tel.tier_demotions = sum(self.tiering.demotions.values())
+            hc = self.tiering.host_cache
+            tel.tier_host_bytes = hc.bytes_used if hc is not None else 0
         tel.dispatcher_queue_depth = self.ec_dispatcher.queue_depth
         tel.dispatcher_inflight = self.ec_dispatcher.inflight
         tel.dispatcher_shed = int(

@@ -26,13 +26,17 @@ from .config import ServingConfig
 from .coalescer import Coalescer, ReadRequest
 from .dispatcher import EcReadDispatcher
 from .qos import Breaker, QosController, normalize_tier
+from .tiering import HeatTracker, HostShardCache, TieringController
 
 __all__ = [
     "Breaker",
     "Coalescer",
     "EcReadDispatcher",
+    "HeatTracker",
+    "HostShardCache",
     "QosController",
     "ReadRequest",
     "ServingConfig",
+    "TieringController",
     "normalize_tier",
 ]

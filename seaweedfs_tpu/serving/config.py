@@ -86,6 +86,36 @@ class ServingConfig:
     # (-ec.qos.tripAfter / -ec.qos.recoverSeconds)
     qos_trip_after: int = 64
     qos_recover_seconds: float = 1.0
+    # heat-tiered residency ladder (serving/tiering.py): HBM -> pinned
+    # host-RAM reconstruct cache -> disk, driven by decayed per-volume
+    # read heat fed from the dispatcher's admission accounting.
+    # -ec.tier.disable turns the ladder off (residency falls back to
+    # the manual pin/unpin + blind LRU budget eviction).
+    tier: bool = True
+    # rebalance cadence of the volume server's tier loop
+    # (-ec.tier.intervalSeconds); 0 disables the loop — rebalance() can
+    # still be driven manually (tests, bench)
+    tier_interval_seconds: float = 5.0
+    # pinned host-RAM warm tier budget (-ec.tier.hostCacheMB); 0
+    # disables the host tier, so demotions fall straight to disk
+    tier_host_cache_mb: int = 0
+    # heat decay half-life (-ec.tier.halfLifeSeconds): popularity is an
+    # exponentially-decayed read counter, so idle volumes cool to zero
+    tier_half_life_seconds: float = 60.0
+    # hysteresis, promotion side (-ec.tier.promoteRatio): a swap needs
+    # the candidate to out-heat the coldest eligible resident by this
+    # factor — the demotion threshold sits promoteRatio BELOW the
+    # promotion threshold, so equally hot volumes never flap
+    tier_promote_ratio: float = 1.5
+    # hysteresis, time side (-ec.tier.minResidencySeconds): a promoted
+    # volume is not swap-eligible before this age; over-budget pressure
+    # demotions ignore it (staying over budget would re-trigger the
+    # blind LRU eviction the ladder replaces)
+    tier_min_residency_seconds: float = 10.0
+    # QoS weight of bulk-tier reads in the heat signal
+    # (-ec.tier.bulkWeight): a background scan must not out-heat the
+    # interactive front door's hot set
+    tier_bulk_weight: float = 0.25
     # slow-client guard: per-response stall budget for streamed bodies =
     # stall_budget_seconds + body_bytes / (stall_min_rate_kbps KB/s); a
     # client draining slower than that is disconnected so it can't hold
@@ -136,4 +166,18 @@ class ServingConfig:
             raise ValueError("qos_recover_seconds must be > 0")
         if self.stall_min_rate_kbps < 1:
             raise ValueError("stall_min_rate_kbps must be >= 1")
+        if self.tier_interval_seconds < 0:
+            raise ValueError("tier_interval_seconds must be >= 0")
+        if self.tier_host_cache_mb < 0:
+            raise ValueError("tier_host_cache_mb must be >= 0")
+        if self.tier_half_life_seconds <= 0:
+            raise ValueError("tier_half_life_seconds must be > 0")
+        if self.tier_promote_ratio < 1.0:
+            raise ValueError(
+                "tier_promote_ratio must be >= 1 (hysteresis margin)"
+            )
+        if self.tier_min_residency_seconds < 0:
+            raise ValueError("tier_min_residency_seconds must be >= 0")
+        if not 0.0 <= self.tier_bulk_weight <= 1.0:
+            raise ValueError("tier_bulk_weight must be in [0, 1]")
         return self

@@ -68,6 +68,11 @@ class EcReadDispatcher:
         self.coalescer = Coalescer(self.cfg.max_batch, self.cfg.max_queue)
         self.qos = QosController.from_config(self.cfg)
         self._inflight = 0
+        # heat-tiered residency (serving/tiering.py): when a controller
+        # is attached, every EC read's (vid, tier) feeds its decayed
+        # popularity counters BEFORE routing — the ladder's heat signal
+        # is the same per-volume accounting the read_route series sees
+        self.tiering = None
         # strong refs to the live drain-lane tasks (the event loop only
         # holds weak ones) + an exception-logging done-callback: a lane
         # dying outside _serve_batch's own catch must be attributable,
@@ -123,6 +128,8 @@ class EcReadDispatcher:
         read_route series ("s3" = the gateway's direct volume path)."""
         cfg = self.cfg
         tier = normalize_tier(tier)
+        if self.tiering is not None:
+            self.tiering.note_read(vid, tier)
         if not cfg.enabled:
             # dispatcher disabled = the pre-batching per-read behavior,
             # device reconstruct included: an idle device on a resident

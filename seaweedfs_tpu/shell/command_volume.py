@@ -860,6 +860,55 @@ async def cmd_volume_device_status(env, args):
             env.write(f"  ec volume {vid}: {count} resident shards")
 
 
+@command("volume.tier.status")
+async def cmd_volume_tier_status(env, args):
+    """[-node <host:port>] : per-node residency-ladder view from the
+    master's telemetry plane — EC volume census by tier (hbm / host RAM
+    / disk), cumulative promotion/demotion counters (the thrash
+    signal), and host-RAM warm-tier occupancy"""
+    from .command_cluster import fetch_cluster_health, fmt_bytes
+
+    flags = parse_flags(args)
+    want = flags.get("node") or flags.get("")
+    health = await fetch_cluster_health(env)
+    nodes = health["nodes"]
+    if want:
+        if want not in nodes:
+            raise ValueError(
+                f"node {want!r} not in telemetry plane (known: "
+                f"{', '.join(sorted(nodes)) or 'none'})"
+            )
+        nodes = {want: nodes[want]}
+    for url, n in nodes.items():
+        state = "STALE" if n["stale"] else "fresh"
+        tiers = n.get("tiering")
+        if not tiers:
+            env.write(
+                f"{url} [{state}] no tiering telemetry "
+                "(ladder disabled or pre-telemetry server)"
+            )
+            continue
+        env.write(
+            f"{url} [{state}] hbm={tiers['hbm_volumes']} "
+            f"host={tiers['host_volumes']} volumes; "
+            f"host tier {fmt_bytes(tiers['host_bytes'])}; "
+            # promotions vs demotions: a demotion rate chasing the
+            # promotion rate means the ladder is thrashing — widen
+            # -ec.tier.promoteRatio / -ec.tier.minResidencySeconds
+            f"promotions={tiers['promotions_total']} "
+            f"demotions={tiers['demotions_total']}"
+        )
+    cluster = health.get("cluster", {})
+    tv = cluster.get("tier_volumes")
+    if tv:
+        env.write(
+            f"cluster: hbm={tv['hbm']} host={tv['host']} volumes, "
+            f"host tier {fmt_bytes(cluster.get('tier_host_bytes', 0))}, "
+            f"promotions={cluster.get('tier_promotions_total', 0)} "
+            f"demotions={cluster.get('tier_demotions_total', 0)}"
+        )
+
+
 @command("volume.trace")
 async def cmd_volume_trace(env, args):
     """-node <host:port> [-limit N] [-id <trace_id>] : fetch

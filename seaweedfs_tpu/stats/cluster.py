@@ -96,6 +96,28 @@ CLUSTER_OVERLAP_FRACTION = Gauge(
     ["node"],
     registry=REGISTRY,
 )
+CLUSTER_TIER_VOLUMES = Gauge(
+    "SeaweedFS_cluster_tier_volumes",
+    "Per-node EC volume census by residency tier (hbm/host/disk) at the "
+    "node's last tier rebalance, re-exported from heartbeat telemetry.",
+    ["node", "tier"],
+    registry=REGISTRY,
+)
+CLUSTER_TIER_PROMOTIONS = Gauge(
+    "SeaweedFS_cluster_tier_promotions",
+    "Per-node cumulative residency-ladder promotions (hbm + host), "
+    "re-exported from heartbeat telemetry.",
+    ["node"],
+    registry=REGISTRY,
+)
+CLUSTER_TIER_DEMOTIONS = Gauge(
+    "SeaweedFS_cluster_tier_demotions",
+    "Per-node cumulative residency-ladder demotions (hbm + host) — "
+    "rising fast relative to promotions means that node's ladder is "
+    "thrashing.",
+    ["node"],
+    registry=REGISTRY,
+)
 CLUSTER_STAGE_P50 = Gauge(
     "SeaweedFS_cluster_stage_p50_seconds",
     "Cluster-wide p50 estimate per serving stage, interpolated from the "
@@ -160,6 +182,11 @@ class NodeTelemetry:
     overlap_fraction: float = 0.0
     ec_h2d_bytes: int = 0
     ec_d2h_bytes: int = 0
+    tier_hbm_volumes: int = 0
+    tier_host_volumes: int = 0
+    tier_promotions: int = 0
+    tier_demotions: int = 0
+    tier_host_bytes: int = 0
     resident_by_volume: dict[int, int] = field(default_factory=dict)
 
     def to_dict(self, now: float, stale_after: float) -> dict[str, Any]:
@@ -194,6 +221,13 @@ class NodeTelemetry:
                 "overlap_fraction": round(self.overlap_fraction, 3),
                 "h2d_bytes_total": self.ec_h2d_bytes,
                 "d2h_bytes_total": self.ec_d2h_bytes,
+            }
+            d["tiering"] = {
+                "hbm_volumes": self.tier_hbm_volumes,
+                "host_volumes": self.tier_host_volumes,
+                "promotions_total": self.tier_promotions,
+                "demotions_total": self.tier_demotions,
+                "host_bytes": self.tier_host_bytes,
             }
         return d
 
@@ -272,6 +306,14 @@ class ClusterTelemetry:
             )
             nt.ec_h2d_bytes = int(getattr(tel, "ec_h2d_bytes", 0))
             nt.ec_d2h_bytes = int(getattr(tel, "ec_d2h_bytes", 0))
+            # getattr-guarded: pre-r15 servers lack the tiering fields
+            nt.tier_hbm_volumes = int(getattr(tel, "tier_hbm_volumes", 0))
+            nt.tier_host_volumes = int(
+                getattr(tel, "tier_host_volumes", 0)
+            )
+            nt.tier_promotions = int(getattr(tel, "tier_promotions", 0))
+            nt.tier_demotions = int(getattr(tel, "tier_demotions", 0))
+            nt.tier_host_bytes = int(getattr(tel, "tier_host_bytes", 0))
             nt.resident_by_volume = dict(tel.resident_shards_by_volume)
             n_buckets = len(STAGE_SECONDS_BUCKETS) + 1
             for d in tel.stage_digests:
@@ -345,6 +387,8 @@ class ClusterTelemetry:
             CLUSTER_DEVICE_RESIDENT, CLUSTER_DEVICE_EVICTIONS,
             CLUSTER_DISPATCHER_QUEUE, CLUSTER_DISPATCHER_INFLIGHT,
             CLUSTER_DISPATCHER_SHED, CLUSTER_OVERLAP_FRACTION,
+            CLUSTER_TIER_VOLUMES, CLUSTER_TIER_PROMOTIONS,
+            CLUSTER_TIER_DEMOTIONS,
         ):
             g.clear()
         fresh = stale = 0
@@ -371,6 +415,14 @@ class ClusterTelemetry:
             CLUSTER_OVERLAP_FRACTION.labels(node=url).set(
                 nt.overlap_fraction
             )
+            CLUSTER_TIER_VOLUMES.labels(node=url, tier="hbm").set(
+                nt.tier_hbm_volumes
+            )
+            CLUSTER_TIER_VOLUMES.labels(node=url, tier="host").set(
+                nt.tier_host_volumes
+            )
+            CLUSTER_TIER_PROMOTIONS.labels(node=url).set(nt.tier_promotions)
+            CLUSTER_TIER_DEMOTIONS.labels(node=url).set(nt.tier_demotions)
         CLUSTER_NODES.labels(state="fresh").set(fresh)
         CLUSTER_NODES.labels(state="stale").set(stale)
         for stage, (buckets, _count, _sum) in stages.items():
@@ -453,6 +505,19 @@ class ClusterTelemetry:
                 ),
                 "dispatcher_shed_total": sum(
                     nt.dispatcher_shed for nt in fresh
+                ),
+                "tier_volumes": {
+                    "hbm": sum(nt.tier_hbm_volumes for nt in fresh),
+                    "host": sum(nt.tier_host_volumes for nt in fresh),
+                },
+                "tier_promotions_total": sum(
+                    nt.tier_promotions for nt in fresh
+                ),
+                "tier_demotions_total": sum(
+                    nt.tier_demotions for nt in fresh
+                ),
+                "tier_host_bytes": sum(
+                    nt.tier_host_bytes for nt in fresh
                 ),
                 "ec_volume_residency": residency,
                 "stages": stage_docs,

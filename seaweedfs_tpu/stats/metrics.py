@@ -387,6 +387,56 @@ for _p in ("encode", "rebuild", "verify"):
     VOLUME_SERVER_EC_BULK_BATCHES.labels(pipeline=_p)
     VOLUME_SERVER_EC_BULK_OVERLAP_FRACTION.labels(pipeline=_p)
 
+# heat-tiered residency ladder (serving/tiering.py): HBM -> host RAM ->
+# disk, driven by the decayed per-volume read heat.  The census gauge
+# shows where the working set lives; the promotion/demotion counters are
+# the thrash signal (hysteresis exists to keep them low under a flash
+# crowd); host_reads proves the warm tier actually serves from RAM.
+VOLUME_SERVER_EC_TIER_VOLUMES = Gauge(
+    "SeaweedFS_volumeServer_ec_tier_volumes",
+    "EC volumes by residency tier after the last tier rebalance (hbm = "
+    "device-resident serving, host = shard bytes pinned in host RAM, "
+    "disk = served from shard files / remote).",
+    ["tier"],
+    registry=REGISTRY,
+)
+VOLUME_SERVER_EC_TIER_PROMOTIONS = Counter(
+    "SeaweedFS_volumeServer_ec_tier_promotions",
+    "Tier-ladder promotions by destination tier (hbm = pinned into the "
+    "device cache with an AOT pre-warm, host = shard bytes staged into "
+    "the pinned host-RAM reconstruct cache).",
+    ["tier"],
+    registry=REGISTRY,
+)
+VOLUME_SERVER_EC_TIER_DEMOTIONS = Counter(
+    "SeaweedFS_volumeServer_ec_tier_demotions",
+    "Tier-ladder demotions by source tier (hbm = heat-chosen device "
+    "eviction under budget pressure or a hotter candidate's swap, host "
+    "= host-RAM bytes dropped for a warmer volume).  A high rate means "
+    "the ladder is thrashing — widen -ec.tier.promoteRatio or "
+    "-ec.tier.minResidencySeconds.",
+    ["tier"],
+    registry=REGISTRY,
+)
+VOLUME_SERVER_EC_TIER_HOST_BYTES = Gauge(
+    "SeaweedFS_volumeServer_ec_tier_host_bytes",
+    "Host RAM held by the warm-tier shard cache (-ec.tier.hostCacheMB "
+    "budget).",
+    registry=REGISTRY,
+)
+VOLUME_SERVER_EC_TIER_HOST_READS = Counter(
+    "SeaweedFS_volumeServer_ec_tier_host_reads",
+    "Shard interval reads served from the pinned host-RAM tier "
+    "(zero-copy memoryview slices of the staged shard bytes — no disk "
+    "pread).",
+    registry=REGISTRY,
+)
+for _tier in ("hbm", "host", "disk"):
+    VOLUME_SERVER_EC_TIER_VOLUMES.labels(tier=_tier)
+for _tier in ("hbm", "host"):
+    VOLUME_SERVER_EC_TIER_PROMOTIONS.labels(tier=_tier)
+    VOLUME_SERVER_EC_TIER_DEMOTIONS.labels(tier=_tier)
+
 MQ_FENCE_CONFLICT = Counter(
     "SeaweedFS_mq_fence_conflict",
     "Partition activations that found the durable log tail moved after "

@@ -158,6 +158,12 @@ class EcVolume:
         # survivors of this volume are resident, degraded reads reconstruct
         # on-device without per-call H2D of survivor bytes
         self.device_cache = None
+        # optional host-RAM warm tier (serving/tiering.HostShardCache):
+        # when set and this volume's shard bytes are staged, interval
+        # reads serve zero-copy memoryview slices of the staged arrays
+        # instead of disk preads — the middle rung of the residency
+        # ladder
+        self.host_cache = None
 
     # -- shard management ----------------------------------------------------
 
@@ -204,11 +210,31 @@ class EcVolume:
             if should_stop is not None and should_stop():
                 break
             if self.device_cache.get(self.id, sid) is None:
+                # promotion from the host tier never re-reads disk: the
+                # staged bytes ARE the shard file's bytes (staged once
+                # at demotion), so the ladder's hot path is RAM -> HBM
+                staged = (
+                    self.host_cache.shard_array(self.id, sid)
+                    if self.host_cache is not None
+                    else None
+                )
                 self.device_cache.put(
-                    self.id, sid, np.fromfile(shard.path, dtype=np.uint8)
+                    self.id, sid,
+                    staged if staged is not None
+                    else np.fromfile(shard.path, dtype=np.uint8),
                 )
                 n += 1
         return n
+
+    def stage_host_shards(self) -> dict[int, np.ndarray]:
+        """Read every locally mounted shard's bytes once (demotion-time
+        staging for the host-RAM warm tier).  Raises OSError when a
+        shard file is unreadable — the caller keeps the volume on its
+        current tier rather than staging a partial set silently."""
+        return {
+            sid: np.fromfile(shard.path, dtype=np.uint8)
+            for sid, shard in list(self.shards.items())
+        }
 
     def is_device_resident(self) -> bool:
         """True when enough of THIS location's shards are pinned in HBM
@@ -279,6 +305,15 @@ class EcVolume:
         )
         return data
 
+    def _host_tier_read(self, shard_id: int, off: int, size: int):
+        """Zero-copy slice of the host-RAM tier's staged shard bytes, or
+        None when the shard is not staged (the single host-tier probe
+        every interval-read path shares)."""
+        hc = self.host_cache
+        if hc is None:
+            return None
+        return hc.read(self.id, shard_id, off, size)
+
     def _read_shard_interval(
         self,
         shard_id: int,
@@ -288,6 +323,12 @@ class EcVolume:
         backend: str,
         use_device: bool = True,
     ) -> bytes:
+        staged = self._host_tier_read(shard_id, off, size)
+        if staged is not None and len(staged) == size:
+            with obs_trace.span(
+                "shard_read", shard=shard_id, bytes=size, source="host_tier"
+            ):
+                return staged
         shard = self.shards.get(shard_id)
         if shard is not None:
             with obs_trace.span("shard_read", shard=shard_id, bytes=size):
@@ -342,15 +383,20 @@ class EcVolume:
                 if sid == missing_shard:
                     continue
                 shard = self.shards.get(sid)
-                buf = None
-                if shard is not None:
-                    buf = shard.read_at(off, size)
-                elif remote_read is not None:
-                    with obs_trace.span(
-                        "remote_shard_read", shard=sid, bytes=size
-                    ):
-                        buf = remote_read(sid, off, size)
-                    n_remote += 1
+                # host tier first: a warm volume's survivor gather must
+                # not touch disk (the whole point of the middle rung)
+                buf = self._host_tier_read(sid, off, size)
+                if buf is not None and len(buf) != size:
+                    buf = None
+                if buf is None:
+                    if shard is not None:
+                        buf = shard.read_at(off, size)
+                    elif remote_read is not None:
+                        with obs_trace.span(
+                            "remote_shard_read", shard=sid, bytes=size
+                        ):
+                            buf = remote_read(sid, off, size)
+                        n_remote += 1
                 if buf is not None and len(buf) == size:
                     got[sid] = np.frombuffer(buf, dtype=np.uint8)
                 if len(got) >= DATA_SHARDS:
@@ -457,6 +503,10 @@ class EcVolume:
                 for p in parts:
                     if p[0] == "local":
                         _, sid, off, size = p
+                        staged = self._host_tier_read(sid, off, size)
+                        if staged is not None and len(staged) == size:
+                            pieces.append(staged)
+                            continue
                         with obs_trace.span(
                             "shard_read", shard=sid, bytes=size
                         ):

@@ -98,6 +98,11 @@ class Store:
         self.public_url = public_url or f"{ip}:{port}"
         self.ec_backend = ec_backend
         self.ec_device_cache = ec_device_cache
+        # host-RAM warm tier (serving/tiering.HostShardCache | None):
+        # attached by the tiering controller; every mounted EcVolume
+        # carries the reference so interval reads probe it without the
+        # controller on the read path
+        self.ec_host_cache = None
         self.volume_size_limit = 30 * 1024 * 1024 * 1024  # set by master pulse
         self._lock = threading.RLock()
         # device-cache pin/warm threads: cancellable + joined on close so
@@ -131,6 +136,27 @@ class Store:
             if ev is not None:
                 return ev
         return None
+
+    def set_ec_host_cache(self, host_cache) -> None:
+        """Attach (or detach, None) the host-RAM warm tier to every
+        mounted EC volume — and to future mounts via `ec_host_cache`."""
+        self.ec_host_cache = host_cache
+        with self._lock:
+            for loc in self.locations:
+                for ev in loc.ec_volumes.values():
+                    ev.host_cache = host_cache
+
+    def ec_volume_tier(self, vid: int) -> str:
+        """Residency tier of `vid` right now: "hbm" (device-resident,
+        the dispatcher's batched route), "host" (shard bytes pinned in
+        host RAM — the native path serves without disk preads), or
+        "disk"."""
+        if self.ec_volume_is_resident(vid):
+            return "hbm"
+        hc = self.ec_host_cache
+        if hc is not None and hc.resident_count(vid) >= DATA_SHARDS:
+            return "host"
+        return "disk"
 
     def ec_volume_is_resident(self, vid: int) -> bool:
         """Routing predicate for the serving dispatcher: True when the
@@ -473,6 +499,7 @@ class Store:
                 if loc is None:
                     raise NotFoundError(f"ec volume {vid} has no local files")
                 ev = EcVolume(loc.directory, vid, collection)
+                ev.host_cache = self.ec_host_cache
                 loc.ec_volumes[vid] = ev
             for sid in shard_ids:
                 ev.add_shard(sid)
@@ -558,6 +585,11 @@ class Store:
                 cache = self.ec_device_cache
                 if cache is not None and cache.pin_source(vid) == ev.dir:
                     cache.evict(vid)
+                # the warm tier's claim must not outlive the volume
+                # either (outstanding zero-copy views keep their own
+                # arrays alive via refcount — eviction is safe)
+                if self.ec_host_cache is not None:
+                    self.ec_host_cache.evict(vid)
 
     def delete_ec_shards(self, vid: int, shard_ids: list[int], collection: str = "") -> None:
         """Unmount + remove the shard files; drop sidecars when the last
@@ -586,6 +618,8 @@ class Store:
                 if ev is not None:
                     self.deleted_ec_shards.put(self._ec_message(ev))
                     ev.destroy()
+                    if self.ec_host_cache is not None:
+                        self.ec_host_cache.evict(vid)
 
     def scrub_ec_volume(self, vid: int) -> dict:
         """Parity scrub of a mounted EC volume: recompute parity and

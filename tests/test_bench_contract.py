@@ -20,6 +20,7 @@ def test_headline_keys_are_the_contract():
         "encode_headline",
         "scrub_headline",
         "load_headline",
+        "tiering_headline",
     )
 
 
@@ -28,6 +29,7 @@ def test_order_result_puts_headline_keys_last():
         "serving_headline": {"device_wins": True},
         "metric": "rs_10_4_encode_blockdiag_pallas",
         "load_headline": {"qos_zero_copy_beats_pre": True},
+        "tiering_headline": {"tiering_beats_static": True},
         "scrub_headline": {"megakernel_beats_per_volume": True},
         "value": 12.3,
         "encode_headline": {"overlap_beats_serial": True},
@@ -93,10 +95,10 @@ def _bulky_result():
                 "megakernel_dispatches": 1.0,
                 "per_volume_dispatches": 4.0,
             },
+            # main() ships the COMPACT load headline (per-level dicts
+            # live in extra.load_sweep): the r15 tiering block below
+            # would otherwise push `value` out of the archived tail
             "load_headline": {
-                "load_levels": [8, 32, 128, 512],
-                "pre_reads_per_s": {"8": 100.0, "512": 90.0},
-                "qos_zero_copy_reads_per_s": {"8": 110.0, "512": 200.0},
                 "top_connections": 512,
                 "pre_top_reads_per_s": 90.0,
                 "qos_zero_copy_top_reads_per_s": 200.0,
@@ -110,6 +112,20 @@ def _bulky_result():
                 "s3_resident_route_reads": 32,
                 "s3_rides_resident_path": True,
                 "load_verified": True,
+            },
+            "tiering_headline": {
+                "oversubscribe": 4.0,
+                "tiering_beats_static": True,
+                "max_step_drop_frac": 0.053,
+                "no_cliff": True,
+                "tier_promotions": 14,
+                "tier_demotions": 12,
+                "host_tier_reads": 123456,
+                "timed_compile_misses": 0,
+                "promotion_stall_free": True,
+                "tier_verified": True,
+                "static_top_reads_per_s": 10423.5,
+                "tiered_top_reads_per_s": 19960.3,
             },
         }
     )
@@ -175,6 +191,28 @@ def test_archived_tail_carries_r13_load_verdicts():
         "s3_rides_resident_path",
         "s3_resident_route_reads",
         "load_verified",
+    ):
+        assert f'"{key}"' in tail, f"{key} fell outside the archived tail"
+
+
+def test_archived_tail_carries_r15_tiering_verdicts():
+    """The r15 verdict keys — the heat ladder beating static pin+LRU
+    under a 4x-oversubscribed working set, the smooth-degradation
+    no-cliff check, and the stall-free-promotion proof — must survive
+    the 2000-char archive window."""
+    tail = json.dumps(_bulky_result())[-2000:]
+    for key in (
+        "oversubscribe",
+        "tiering_beats_static",
+        "no_cliff",
+        "max_step_drop_frac",
+        "tier_promotions",
+        "tier_demotions",
+        "host_tier_reads",
+        "promotion_stall_free",
+        "tier_verified",
+        "static_top_reads_per_s",
+        "tiered_top_reads_per_s",
     ):
         assert f'"{key}"' in tail, f"{key} fell outside the archived tail"
 

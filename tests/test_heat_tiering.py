@@ -1,0 +1,679 @@
+"""Heat-tiered residency (serving/tiering.py): the decayed heat signal,
+the host-RAM warm tier, the promote/demote ladder with hysteresis and
+QoS-aware pressure demotion, the DeviceShardCache budget/claim symmetry
+across demote->promote cycles (r15 satellite), and the telemetry
+plumbing into the cluster health plane.
+
+All device work runs on the CPU test mesh (conftest); volumes follow
+the CI convention warm_sizes=() so no AOT grid compiles."""
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops import rs_resident
+from seaweedfs_tpu.serving import ServingConfig
+from seaweedfs_tpu.serving.tiering import (
+    TIER_DISK,
+    TIER_HBM,
+    TIER_HOST,
+    HeatTracker,
+    HostShardCache,
+    TieringController,
+)
+from seaweedfs_tpu.storage import ec
+from seaweedfs_tpu.storage.disk_location import DiskLocation
+from seaweedfs_tpu.storage.ec.volume import EcVolumeShard
+from seaweedfs_tpu.storage.store import Store
+from seaweedfs_tpu.storage.volume import Volume
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _make_ec_volume(dirname, vid, count=8, seed=7, sizes=(500, 4096, 20_000)):
+    """Write a small volume, EC-encode it, drop the .dat/.idx (the
+    standard post-encode state), return {nid: (cookie, data)}."""
+    import os
+
+    rng = random.Random(seed + vid)
+    v = Volume(str(dirname), vid)
+    blobs = {}
+    for i in range(1, count + 1):
+        data = rng.randbytes(rng.choice(list(sizes)))
+        cookie = rng.getrandbits(32)
+        v.write(i, cookie, data)
+        blobs[i] = (cookie, data)
+    v.sync()
+    base = Volume.base_name(v.dir, vid, v.collection)
+    ec.write_ec_files(base, backend="cpu")
+    ec.write_sorted_file_from_idx(base)
+    v.close()
+    for ext in (".dat", ".idx"):
+        p = base + ext
+        if os.path.exists(p):
+            os.remove(p)
+    return blobs
+
+
+def _make_store(tmp_path, vids, cache_budget=None, count=8):
+    """Real Store over `vids` mounted EC volumes + a DeviceShardCache
+    attached AFTER mount so no pin threads race the tests."""
+    blobs = {vid: _make_ec_volume(tmp_path, vid, count=count) for vid in vids}
+    store = Store([DiskLocation(str(tmp_path))])
+    for vid in vids:
+        store.mount_ec_shards(vid, list(range(14)))
+    cache = rs_resident.DeviceShardCache(
+        budget_bytes=cache_budget or (8 << 30), shard_quantum=1 << 20
+    )
+    cache.warm_sizes = ()  # CI convention: no AOT grid compiles
+    store.ec_device_cache = cache
+    for loc in store.locations:
+        for ev in loc.ec_volumes.values():
+            ev.device_cache = cache
+    return store, cache, blobs
+
+
+def _cfg(**kw):
+    defaults = dict(
+        tier_host_cache_mb=64,
+        tier_half_life_seconds=10.0,
+        tier_promote_ratio=1.5,
+        tier_min_residency_seconds=5.0,
+        tier_interval_seconds=0.0,
+    )
+    defaults.update(kw)
+    return ServingConfig(**defaults).validated()
+
+
+def _vol_bytes(store, cache, vid):
+    ev = store.find_ec_volume(vid)
+    return len(ev.shards) * cache._padded_len(ev.shard_size)
+
+
+# --------------------------------------------------------------- heat
+
+
+def test_heat_decays_with_half_life():
+    clock = FakeClock()
+    h = HeatTracker(half_life_s=10.0, clock=clock)
+    for _ in range(8):
+        h.note(5)
+    assert h.value(5) == pytest.approx(8.0)
+    clock.advance(10.0)
+    assert h.value(5) == pytest.approx(4.0)
+    clock.advance(20.0)
+    assert h.value(5) == pytest.approx(1.0)
+    h.forget(5)
+    assert h.value(5) == 0.0
+
+
+def test_heat_weighs_bulk_reads_down():
+    clock = FakeClock()
+    h = HeatTracker(half_life_s=60.0, bulk_weight=0.25, clock=clock)
+    for _ in range(4):
+        h.note(1, tier="bulk")
+    h.note(2, tier="interactive")
+    # 4 bulk reads == 1 interactive read: a background scan cannot
+    # out-heat the front door
+    assert h.value(1) == pytest.approx(h.value(2))
+
+
+# ---------------------------------------------------------- host cache
+
+
+def test_host_cache_budget_is_all_or_nothing():
+    hc = HostShardCache(budget_bytes=100)
+    small = {0: np.zeros(30, np.uint8), 1: np.zeros(30, np.uint8)}
+    big = {0: np.zeros(80, np.uint8), 1: np.zeros(80, np.uint8)}
+    assert hc.put_volume(1, small)
+    assert hc.bytes_used == 60
+    assert not hc.put_volume(2, big)  # would overflow: rejected whole
+    assert hc.bytes_used == 60 and hc.resident_count(2) == 0
+    assert hc.evict(1) == 60
+    assert hc.bytes_used == 0
+    assert hc.put_volume(2, big) is False  # 160 > 100 even when empty
+    assert hc.put_volume(2, {0: np.zeros(80, np.uint8)})
+    assert hc.volume_bytes(2) == 80
+
+
+def test_host_cache_reads_are_zero_copy_views():
+    hc = HostShardCache(budget_bytes=1 << 20)
+    arr = np.arange(256, dtype=np.uint8)
+    assert hc.put_volume(3, {0: arr})
+    view = hc.read(3, 0, 10, 20)
+    assert isinstance(view, memoryview)
+    assert bytes(view) == bytes(range(10, 30))
+    # eviction drops the cache's claim, not the view's buffer
+    hc.evict(3)
+    assert bytes(view) == bytes(range(10, 30))
+    assert hc.read(3, 0, 0, 4) is None
+
+
+def test_host_tier_serves_without_disk_reads(tmp_path):
+    """A warm volume's needle reads come entirely out of the staged RAM
+    bytes: with every shard pread forced to fail, reads still verify
+    byte-exact against the original blobs."""
+    store, cache, blobs = _make_store(tmp_path, [21])
+    ev = store.find_ec_volume(21)
+    hc = HostShardCache(budget_bytes=1 << 30)
+    assert hc.put_volume(21, ev.stage_host_shards())
+    store.set_ec_host_cache(hc)
+    assert ev.host_cache is hc
+
+    def no_disk(self, off, size):  # pread is the cold path now
+        raise AssertionError("host-tier read touched disk")
+
+    from seaweedfs_tpu import stats
+
+    before = (
+        stats.REGISTRY.get_sample_value(
+            "SeaweedFS_volumeServer_ec_tier_host_reads_total"
+        )
+        or 0
+    )
+    orig = EcVolumeShard.read_at
+    EcVolumeShard.read_at = no_disk
+    try:
+        for nid, (cookie, data) in blobs[21].items():
+            n = store.read_ec_needle(21, nid, cookie)
+            assert bytes(n.data) == data
+    finally:
+        EcVolumeShard.read_at = orig
+    after = stats.REGISTRY.get_sample_value(
+        "SeaweedFS_volumeServer_ec_tier_host_reads_total"
+    )
+    assert after > before
+    assert store.ec_volume_tier(21) == TIER_HOST
+
+
+def test_host_tier_degraded_gather_without_disk(tmp_path):
+    """The degraded path too: with a shard missing AND disk reads
+    forbidden, the >=10-survivor gather reconstructs from the staged
+    host bytes."""
+    store, cache, blobs = _make_store(tmp_path, [22])
+    ev = store.find_ec_volume(22)
+    hc = HostShardCache(budget_bytes=1 << 30)
+    assert hc.put_volume(22, ev.stage_host_shards())
+    store.set_ec_host_cache(hc)
+    ev.shards.pop(3).close()  # degrade: shard 3 no longer mounted
+
+    def no_disk(self, off, size):
+        raise AssertionError("host-tier gather touched disk")
+
+    orig = EcVolumeShard.read_at
+    EcVolumeShard.read_at = no_disk
+    try:
+        for nid, (cookie, data) in blobs[22].items():
+            n = store.read_ec_needle(22, nid, cookie, use_device=False)
+            assert bytes(n.data) == data
+    finally:
+        EcVolumeShard.read_at = orig
+
+
+# -------------------------------------------------------------- ladder
+
+
+def test_rebalance_promotes_hot_volume_with_aot_prewarm(tmp_path):
+    store, cache, _ = _make_store(tmp_path, [1, 2, 3])
+    clock = FakeClock()
+    ctl = TieringController(store, _cfg(), clock=clock)
+    warmed = []
+    orig_warm = rs_resident.warm
+
+    def spy_warm(c, vid, **kw):
+        warmed.append((vid, kw.get("aot"), kw.get("wait")))
+        return orig_warm(c, vid, **kw)
+
+    rs_resident.warm = spy_warm
+    try:
+        for _ in range(5):
+            ctl.note_read(2)
+        moves = ctl.rebalance()
+    finally:
+        rs_resident.warm = orig_warm
+    assert ("promote_hbm", 2) in moves
+    assert ctl.tier_of(2) == TIER_HBM
+    assert store.ec_volume_is_resident(2)
+    # the r11 pre-warm ran, ahead-of-time (non-blocking), keyed to the
+    # cache's shed policy — never an inline trace-and-execute on the
+    # promotion path
+    assert warmed and warmed[0] == (2, cache.shed_cold, False)
+    assert ctl.promotions[TIER_HBM] == 1
+    # cold volumes (zero heat) are never promoted
+    assert ctl.tier_of(1) == TIER_DISK and ctl.tier_of(3) == TIER_DISK
+
+
+def test_rebalance_hysteresis_blocks_flap_then_allows_swap(tmp_path):
+    store, cache, _ = _make_store(tmp_path, [1, 2])
+    clock = FakeClock()
+    # budget fits exactly ONE volume: promotion of the second must swap
+    cache.budget = _vol_bytes(store, cache, 1)
+    ctl = TieringController(store, _cfg(), clock=clock)
+    for _ in range(4):
+        ctl.note_read(1)
+    ctl.rebalance()
+    assert ctl.tier_of(1) == TIER_HBM
+    # volume 2 gets hotter, but NOT promote_ratio (1.5x) hotter: no swap
+    for _ in range(5):
+        ctl.note_read(2)
+    clock.advance(6.0)  # past min_residency
+    ctl.rebalance()
+    assert ctl.tier_of(1) == TIER_HBM and ctl.tier_of(2) != TIER_HBM
+    # now decisively hotter, but within min_residency of a fresh
+    # promotion clock: re-pin volume 1's residency stamp by demote+
+    # promote cycle is NOT what happens — advance makes it eligible
+    for _ in range(20):
+        ctl.note_read(2)
+    moves = ctl.rebalance()
+    assert ("demote_hbm", 1) in moves and ("promote_hbm", 2) in moves
+    assert ctl.tier_of(2) == TIER_HBM
+    # the demoted-but-mounted volume landed on the host tier (warm),
+    # not disk
+    assert ctl.tier_of(1) == TIER_HOST
+    assert ctl.demotions[TIER_HBM] == 1
+
+
+def test_rebalance_min_residency_blocks_immediate_swap(tmp_path):
+    store, cache, _ = _make_store(tmp_path, [1, 2])
+    clock = FakeClock()
+    cache.budget = _vol_bytes(store, cache, 1)
+    ctl = TieringController(store, _cfg(), clock=clock)
+    for _ in range(4):
+        ctl.note_read(1)
+    ctl.rebalance()
+    for _ in range(40):  # way past the ratio threshold
+        ctl.note_read(2)
+    ctl.rebalance()  # but volume 1 is only just resident
+    assert ctl.tier_of(1) == TIER_HBM, "min-residency floor ignored"
+    clock.advance(6.0)
+    ctl.rebalance()
+    assert ctl.tier_of(2) == TIER_HBM
+
+
+def test_pressure_demotion_is_heat_chosen_and_ignores_min_residency(
+    tmp_path,
+):
+    store, cache, _ = _make_store(tmp_path, [1, 2, 3])
+    clock = FakeClock()
+    ctl = TieringController(store, _cfg(), clock=clock)
+    for vid in (1, 2, 3):
+        for _ in range(2 + 3 * vid):  # heat: 3 > 2 > 1
+            ctl.note_read(vid)
+    ctl.rebalance()
+    ctl.rebalance()  # MAX_MOVES=2/cycle: second cycle finishes the set
+    assert all(ctl.tier_of(v) == TIER_HBM for v in (1, 2, 3))
+    # budget collapses to one volume: the two COLDEST demote (heat-
+    # chosen pressure eviction, not LRU insertion order), min-residency
+    # notwithstanding
+    cache.budget = _vol_bytes(store, cache, 3)
+    moves = ctl.rebalance()
+    demoted = {vid for kind, vid in moves if kind == "demote_hbm"}
+    assert demoted == {1, 2}
+    assert ctl.tier_of(3) == TIER_HBM
+    # both landed warm: host tier serves them without disk
+    assert ctl.tier_of(1) == TIER_HOST and ctl.tier_of(2) == TIER_HOST
+
+
+def test_pressure_evicts_partial_orphan_shard_sets(tmp_path):
+    """Mount pins racing the LRU (or a budget shrink mid-pin) can leave
+    PARTIAL shard sets in the cache — never serving, but holding device
+    bytes.  Under pressure those orphans must be evicted too, or they
+    block every future promotion forever (found by the r15 e2e drive)."""
+    store, cache, _ = _make_store(tmp_path, [1, 2])
+    clock = FakeClock()
+    # fake the orphan state: a handful of shards of each volume, well
+    # under DATA_SHARDS, with the budget below what they hold
+    for vid in (1, 2):
+        ev = store.find_ec_volume(vid)
+        for sid in range(4):
+            cache.put(vid, sid, np.fromfile(
+                ev.shards[sid].path, dtype=np.uint8
+            ))
+    assert cache.bytes_used > 0
+    cache.budget = cache.bytes_used // 4
+    ctl = TieringController(store, _cfg(), clock=clock)
+    for _ in range(3):
+        ctl.note_read(2)  # volume 2 is the warmer orphan
+    moves = ctl.rebalance()
+    demoted = [vid for kind, vid in moves if kind == "demote_hbm"]
+    assert demoted and demoted[0] == 1, "coldest orphan must go first"
+    assert cache.bytes_used <= cache.budget, (
+        "orphaned partial shard sets still squat on the budget"
+    )
+
+
+def test_qos_storm_freezes_swaps_but_not_free_promotions(tmp_path):
+    store, cache, _ = _make_store(tmp_path, [1, 2])
+    clock = FakeClock()
+    cache.budget = _vol_bytes(store, cache, 1)
+    ctl = TieringController(store, _cfg(), clock=clock)
+
+    class StormyQos:
+        policies = {"interactive": None, "bulk": None}
+
+        def breaker_state(self, tier):
+            return 2  # OPEN
+
+    for _ in range(4):
+        ctl.note_read(1)
+    ctl.rebalance()  # free-budget promotion: allowed even in a storm
+    clock.advance(10.0)
+    for _ in range(40):
+        ctl.note_read(2)
+    ctl.attach_qos(StormyQos())
+    ctl.rebalance()
+    # the swap would have happened (ratio + age satisfied) but the open
+    # breaker froze it: no pin/evict churn while the device is shedding
+    assert ctl.tier_of(1) == TIER_HBM and ctl.tier_of(2) != TIER_HBM
+    ctl.attach_qos(None)
+    ctl.rebalance()
+    assert ctl.tier_of(2) == TIER_HBM
+
+
+def test_promotion_from_host_tier_skips_disk(tmp_path):
+    """RAM -> HBM: a volume demoted to the host tier re-promotes from
+    the staged bytes, never re-reading shard files."""
+    store, cache, _ = _make_store(tmp_path, [1, 2])
+    clock = FakeClock()
+    cache.budget = _vol_bytes(store, cache, 1)
+    ctl = TieringController(store, _cfg(), clock=clock)
+    for _ in range(8):
+        ctl.note_read(1)
+    ctl.rebalance()
+    clock.advance(6.0)
+    for _ in range(40):
+        ctl.note_read(2)
+    ctl.rebalance()  # 1 -> host, 2 -> hbm
+    assert ctl.tier_of(1) == TIER_HOST
+    clock.advance(6.0)
+    ctl.heat.forget(2)
+    for _ in range(60):
+        ctl.note_read(1)
+
+    np_fromfile = np.fromfile
+
+    def no_fromfile_for_v1(path, *a, **kw):
+        # volume 2's concurrent demotion MAY stage its own bytes from
+        # disk; the PROMOTED volume must come out of the host tier
+        if "/1.ec" in str(path):
+            raise AssertionError("host->HBM promotion re-read disk")
+        return np_fromfile(path, *a, **kw)
+
+    np.fromfile = no_fromfile_for_v1
+    try:
+        moves = ctl.rebalance()
+    finally:
+        np.fromfile = np_fromfile
+    assert ("promote_hbm", 1) in moves
+    assert ctl.tier_of(1) == TIER_HBM
+
+
+# ------------------------------------------- budget/claim symmetry (r15)
+
+
+def test_demote_promote_cycle_keeps_budget_accounting_symmetric(tmp_path):
+    """The satellite contract: pin-source claims and padded-byte
+    accounting held by a demoted-then-repromoted volume must not
+    double-count against the HBM budget — three full cycles land on
+    identical bytes_used/shard counts, one fresh claim per cycle."""
+    store, cache, _ = _make_store(tmp_path, [9])
+    ev = store.find_ec_volume(9)
+    ev.load_shards_to_device(cache)
+    shards0, bytes0 = cache.stats()
+    claims0 = cache.pin_claims
+    assert shards0 == 14 and bytes0 > 0
+    for cycle in range(1, 4):
+        cache.evict(9)  # the demotion release path
+        assert cache.stats() == (0, 0)
+        assert cache.pin_source(9) is None, "claim outlived the demotion"
+        n = ev.load_shards_to_device(cache)
+        assert n == 14
+        assert cache.stats() == (shards0, bytes0), (
+            f"cycle {cycle}: budget accounting drifted"
+        )
+        assert cache.pin_claims == claims0 + cycle
+        assert cache.resident_count(9) == 14
+
+
+def test_repin_over_existing_shards_does_not_double_count(tmp_path):
+    store, cache, _ = _make_store(tmp_path, [9])
+    ev = store.find_ec_volume(9)
+    ev.load_shards_to_device(cache)
+    _, bytes0 = cache.stats()
+    # a second pin pass over an already-resident set is a no-op
+    assert ev.load_shards_to_device(cache) == 0
+    # and a direct double-put of one shard replaces, never adds
+    data = np.fromfile(ev.shards[0].path, dtype=np.uint8)
+    cache.put(9, 0, data)
+    assert cache.stats()[1] == bytes0
+
+
+# ----------------------------------------------------------- telemetry
+
+
+def test_tier_telemetry_rides_heartbeat_into_cluster_health():
+    from seaweedfs_tpu.pb import master_pb2
+    from seaweedfs_tpu.stats.cluster import ClusterTelemetry
+
+    tel = master_pb2.VolumeServerTelemetry(
+        tier_hbm_volumes=2,
+        tier_host_volumes=3,
+        tier_promotions=7,
+        tier_demotions=4,
+        tier_host_bytes=1 << 20,
+    )
+    ct = ClusterTelemetry(pulse_seconds=1)
+    ct.observe("node:1", tel, now=100.0)
+    doc = ct.health(now=100.5)
+    tiers = doc["nodes"]["node:1"]["tiering"]
+    assert tiers == {
+        "hbm_volumes": 2,
+        "host_volumes": 3,
+        "promotions_total": 7,
+        "demotions_total": 4,
+        "host_bytes": 1 << 20,
+    }
+    cluster = doc["cluster"]
+    assert cluster["tier_volumes"] == {"hbm": 2, "host": 3}
+    assert cluster["tier_promotions_total"] == 7
+    assert cluster["tier_demotions_total"] == 4
+    assert cluster["tier_host_bytes"] == 1 << 20
+    ct.refresh_gauges(now=100.5)  # gauges export without raising
+
+
+def test_controller_status_and_census(tmp_path):
+    store, cache, _ = _make_store(tmp_path, [1, 2])
+    ctl = TieringController(store, _cfg(), clock=FakeClock())
+    for _ in range(3):
+        ctl.note_read(1)
+    ctl.rebalance()
+    st = ctl.status()
+    assert st["tiers"][TIER_HBM] == 1
+    assert st["promotions"][TIER_HBM] == 1
+    assert st["host_budget_bytes"] == 64 << 20
+    assert list(st["heat"]) == [1]  # hottest-first ordering
+
+
+def test_unmount_releases_host_tier(tmp_path):
+    store, cache, _ = _make_store(tmp_path, [1])
+    ev = store.find_ec_volume(1)
+    hc = HostShardCache(budget_bytes=1 << 30)
+    store.set_ec_host_cache(hc)
+    assert hc.put_volume(1, ev.stage_host_shards())
+    assert hc.bytes_used > 0
+    store.unmount_ec_shards(1, list(range(14)))
+    assert hc.bytes_used == 0 and hc.resident_count(1) == 0
+
+
+# ------------------------------------------------------------- config
+
+
+def test_tier_config_validation():
+    assert ServingConfig().validated().tier is True
+    with pytest.raises(ValueError):
+        ServingConfig(tier_promote_ratio=0.5).validated()
+    with pytest.raises(ValueError):
+        ServingConfig(tier_half_life_seconds=0).validated()
+    with pytest.raises(ValueError):
+        ServingConfig(tier_bulk_weight=1.5).validated()
+    with pytest.raises(ValueError):
+        ServingConfig(tier_interval_seconds=-1).validated()
+    with pytest.raises(ValueError):
+        ServingConfig(tier_host_cache_mb=-1).validated()
+    with pytest.raises(ValueError):
+        ServingConfig(tier_min_residency_seconds=-1).validated()
+
+
+def test_load_scenario_oversubscribe_knob():
+    from seaweedfs_tpu.loadgen import LoadScenario
+
+    assert LoadScenario(connections=1, reads=1).oversubscribe == 1.0
+    sc = LoadScenario(connections=1, reads=1, oversubscribe=4.0)
+    assert sc.oversubscribe == 4.0
+
+
+def test_heat_tracker_prunes_probe_traffic():
+    """A client scanning random fids feeds note() a new vid per probe;
+    the tracked set must stay bounded and cooled-off entries must drop
+    at prune time instead of accreting forever."""
+    clock = FakeClock()
+    h = HeatTracker(half_life_s=1.0, clock=clock)
+    for vid in range(3 * HeatTracker.MAX_TRACKED):
+        h.note(vid)
+    assert len(h._heat) <= HeatTracker.MAX_TRACKED
+    # cooled entries vanish on the periodic prune hook
+    clock.advance(60.0)  # 60 half-lives: everything below the floor
+    h.prune()
+    assert len(h._heat) == 0
+
+
+def test_host_read_counter_only_counts_full_serves():
+    from seaweedfs_tpu import stats
+
+    def host_reads():
+        return (
+            stats.REGISTRY.get_sample_value(
+                "SeaweedFS_volumeServer_ec_tier_host_reads_total"
+            )
+            or 0
+        )
+
+    hc = HostShardCache(budget_bytes=1 << 20)
+    assert hc.put_volume(4, {0: np.zeros(100, np.uint8)})
+    before = host_reads()
+    full = hc.read(4, 0, 0, 50)
+    assert len(full) == 50 and host_reads() == before + 1
+    # a tail short-read the caller will discard and re-serve from disk
+    # must NOT claim a host-tier serve
+    short = hc.read(4, 0, 90, 50)
+    assert len(short) == 10 and host_reads() == before + 1
+
+
+def test_failed_promotion_backs_off_and_spares_residents(tmp_path):
+    """One unreadable hot volume must not demote a healthy resident
+    every cycle: the first failed swap is the last until the backoff
+    lapses."""
+    import os
+
+    store, cache, _ = _make_store(tmp_path, [1, 2])
+    clock = FakeClock()
+    cache.budget = _vol_bytes(store, cache, 1)
+    ctl = TieringController(store, _cfg(), clock=clock)
+    for _ in range(4):
+        ctl.note_read(1)
+    ctl.rebalance()
+    assert ctl.tier_of(1) == TIER_HBM
+    # break volume 2's shard files, then make it decisively hottest
+    ev2 = store.find_ec_volume(2)
+    for sid, shard in list(ev2.shards.items()):
+        shard.close()
+        os.remove(shard.path)
+    clock.advance(6.0)
+    for _ in range(40):
+        ctl.note_read(2)
+    moves = ctl.rebalance()
+    # the failed swap cost at most one demotion...
+    assert ctl.tier_of(2) != TIER_HBM
+    first_demos = ctl.demotions[TIER_HBM]
+    assert first_demos <= 1
+    # ...and is NOT retried while the backoff holds: volume 1 re-heats,
+    # re-promotes, and stays put across further cycles
+    for _ in range(50):
+        ctl.note_read(1)
+    for _ in range(3):
+        clock.advance(6.0)
+        ctl.rebalance()
+    assert ctl.tier_of(1) == TIER_HBM
+    assert ctl.demotions[TIER_HBM] == first_demos
+
+
+def test_swap_collects_enough_victims_to_fit(tmp_path):
+    """A candidate bigger than one victim demotes as many (eligible,
+    colder) residents as it needs BEFORE pinning — never overflowing
+    the budget into the blind per-shard LRU."""
+    for vid in (1, 2):
+        _make_ec_volume(tmp_path, vid, count=4)
+    # volume 4's shards pad to more than one small volume's bytes
+    _make_ec_volume(tmp_path, 4, count=60, sizes=(200_000,))
+    store = Store([DiskLocation(str(tmp_path))])
+    for vid in (1, 2, 4):
+        store.mount_ec_shards(vid, list(range(14)))
+    cache = rs_resident.DeviceShardCache(
+        budget_bytes=8 << 30, shard_quantum=1 << 20
+    )
+    cache.warm_sizes = ()
+    store.ec_device_cache = cache  # after mounts: no pin threads
+    for loc in store.locations:
+        for ev in loc.ec_volumes.values():
+            ev.device_cache = cache
+    clock = FakeClock()
+    cache.budget = (
+        _vol_bytes(store, cache, 1) + _vol_bytes(store, cache, 2)
+    )
+    need = _vol_bytes(store, cache, 4)
+    assert _vol_bytes(store, cache, 1) < need <= cache.budget, (
+        "fixture must make volume 4 bigger than one victim but fitting"
+    )
+    ctl = TieringController(store, _cfg(), clock=clock)
+    for vid in (1, 2):
+        for _ in range(3):
+            ctl.note_read(vid)
+    ctl.rebalance()
+    assert ctl.tier_of(1) == TIER_HBM and ctl.tier_of(2) == TIER_HBM
+    clock.advance(6.0)
+    for _ in range(40):
+        ctl.note_read(4)
+    moves = ctl.rebalance()
+    demoted = {vid for kind, vid in moves if kind == "demote_hbm"}
+    # BOTH small victims had to go to fit the big candidate, and the
+    # budget was never overflowed into the blind LRU backstop
+    assert ("promote_hbm", 4) in moves
+    assert demoted == {1, 2}
+    assert ctl.tier_of(4) == TIER_HBM
+    assert cache.bytes_used <= cache.budget
+    assert cache.evictions == 0, "blind LRU eviction fired mid-swap"
+
+
+def test_concurrent_note_read_is_thread_safe():
+    h = HeatTracker(half_life_s=1e9)  # no decay inside the test window
+    threads = [
+        threading.Thread(
+            target=lambda: [h.note(1) for _ in range(500)]
+        )
+        for _ in range(4)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.value(1) == pytest.approx(2000.0)

@@ -252,6 +252,150 @@ def test_zero_copy_ec_reads_race_eviction_under_viewguard(tmp_path):
     g.assert_clean()
 
 
+# ------------------------------------------- tier promote/demote race
+
+
+def test_zero_copy_reads_race_tier_promotion_demotion(tmp_path):
+    """r15 ladder race: readers pull zero-copy batches while a tiering
+    controller flips two volumes between HBM, the host-RAM tier, and
+    disk (budget fits only one volume, hysteresis disabled so every
+    flip is a promote+demote pair).  Demotion routes through the
+    claim/evict release path and host staging, so every successful read
+    is byte-exact (views — over reconstruct output AND host-tier
+    arrays — verified at release) and losses are clean CacheMiss, never
+    stale bytes."""
+    from seaweedfs_tpu.ops.rs_resident import DeviceShardCache
+    from seaweedfs_tpu.serving import ServingConfig
+    from seaweedfs_tpu.serving.tiering import TieringController
+    from seaweedfs_tpu.storage.disk_location import DiskLocation
+    from seaweedfs_tpu.storage.store import Store
+
+    vids = (61, 62)
+    blobs = {}
+    for vid in vids:
+        v, vol_blobs = _make_volume(tmp_path, vid=vid, count=10, seed=vid)
+        base = Volume.base_name(v.dir, v.id, v.collection)
+        ec.write_ec_files(base, backend="cpu")
+        ec.write_sorted_file_from_idx(base)
+        v.close()
+        import os
+
+        for ext in (".dat", ".idx"):
+            if os.path.exists(base + ext):
+                os.remove(base + ext)
+        blobs[vid] = vol_blobs
+
+    errors: list[BaseException] = []
+    good_reads = 0
+    clean_misses = 0
+    stop = threading.Event()
+    lock = threading.Lock()
+
+    with viewguard.watch() as g:
+        store = Store([DiskLocation(str(tmp_path))])
+        cache = DeviceShardCache(shard_quantum=1 << 20, layout="blockdiag")
+        cache.warm_sizes = ()  # CI convention: no AOT grid compile
+        evs = {}
+        for vid in vids:
+            store.mount_ec_shards(vid, list(range(14)))
+            ev = store.find_ec_volume(vid)
+            ev.device_cache = cache
+            # degrade each volume differently so batch reads exercise
+            # the device/host reconstruct, not just local preads
+            ev.shards.pop(vid % 14).close()
+            evs[vid] = ev
+        # cache attached AFTER the mounts: the controller owns every
+        # placement (no mount-time pin threads racing the ladder)
+        store.ec_device_cache = cache
+        ctl = TieringController(
+            store,
+            ServingConfig(
+                tier_host_cache_mb=64,
+                tier_min_residency_seconds=0.0,
+                tier_promote_ratio=1.0,
+                tier_interval_seconds=0.0,
+            ).validated(),
+        )
+        ev0 = evs[vids[0]]
+        cache.budget = len(ev0.shards) * cache._padded_len(ev0.shard_size)
+
+        def reader(seed: int):
+            nonlocal good_reads, clean_misses
+            rng = random.Random(seed)
+            deadline = time.time() + 30
+            # read until the mover finished its flips (stop) so every
+            # promotion/demotion races live zero-copy reads
+            while time.time() < deadline and not stop.is_set():
+                vid = vids[rng.random() > 0.5]
+                nids = rng.sample(sorted(blobs[vid]), 3)
+                try:
+                    out = evs[vid].read_needles_batch(
+                        nids, backend="cpu", zero_copy=True
+                    )
+                except rs_resident.CacheMiss:
+                    with lock:
+                        clean_misses += 1
+                    time.sleep(0.005)
+                    continue
+                except BaseException as e:  # noqa: BLE001 — collected
+                    errors.append(e)
+                    return
+                for nid, res in zip(nids, out):
+                    if isinstance(res, rs_resident.CacheMiss):
+                        with lock:
+                            clean_misses += 1
+                        continue
+                    if isinstance(res, Exception):
+                        errors.append(res)
+                        return
+                    if bytes(res.data) != blobs[vid][nid][1]:
+                        errors.append(
+                            AssertionError(f"stale bytes for {vid}/{nid}")
+                        )
+                        return
+                    if isinstance(res.data, memoryview):
+                        g.release(res.data)
+                with lock:
+                    good_reads += 1
+
+        def mover():
+            try:
+                for flip in range(6):
+                    hot = vids[flip % 2]
+                    for v in vids:
+                        ctl.heat.forget(v)
+                    for _ in range(10):
+                        ctl.note_read(hot)
+                    ctl.rebalance()
+                    time.sleep(0.05)  # let reads land between flips
+            except BaseException as e:  # noqa: BLE001 — collected
+                errors.append(e)
+            finally:
+                stop.set()
+
+        threads = [
+            threading.Thread(target=reader, args=(1,), name="tier-reader"),
+            threading.Thread(target=reader, args=(2,), name="tier-reader2"),
+            threading.Thread(target=mover, name="tier-mover"),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        promos = sum(ctl.promotions.values())
+        demos = sum(ctl.demotions.values())
+        for ev in evs.values():
+            ev.close()
+        store.close()
+
+    assert not errors, errors
+    assert good_reads > 0
+    # the race actually raced: the ladder moved under the readers
+    assert promos >= 2 and demos >= 1, (promos, demos)
+    assert g.exports_total > 0
+    g.assert_clean()
+
+
 # -------------------------------------------------------- vacuum race
 
 

@@ -37,6 +37,7 @@ _README_ROW_RE = re.compile(r"^\|\s*`(-(?:ec|obs)\.[^`]+)`")
 CONFIG_OWNERS: tuple[tuple[str, str], ...] = (
     ("-ec.serving.", "seaweedfs_tpu/serving/config.py"),
     ("-ec.qos.", "seaweedfs_tpu/serving/config.py"),
+    ("-ec.tier.", "seaweedfs_tpu/serving/config.py"),
     ("-ec.bulk.", "seaweedfs_tpu/storage/ec/bulk.py"),
     ("-obs.", "seaweedfs_tpu/obs/config.py"),
 )
