@@ -49,12 +49,66 @@ def add_args(p) -> None:
         type=int, default=1000,
         help="compact the raft log into a snapshot past this many entries",
     )
+    # self-healing repair plane (repair/config.py RepairConfig): the
+    # master's autonomous ec.rebuild loop over the telemetry plane
+    p.add_argument(
+        "-ec.repair.disable", dest="ec_repair_disable",
+        action="store_true",
+        help="disable the autonomous EC repair scheduler (detection "
+        "status stays live; only manual ec.rebuild restores redundancy)",
+    )
+    p.add_argument(
+        "-ec.repair.intervalSeconds", dest="ec_repair_interval_seconds",
+        type=float, default=5.0,
+        help="repair scan cadence: how often the master diffs the EC "
+        "census against full redundancy and plans repairs",
+    )
+    p.add_argument(
+        "-ec.repair.maxInflight", dest="ec_repair_max_inflight",
+        type=int, default=2,
+        help="concurrent repair jobs (one volume's gather/rebuild "
+        "choreography each)",
+    )
+    p.add_argument(
+        "-ec.repair.fanout", dest="ec_repair_fanout", type=int, default=4,
+        help="per-job shard-copy fan-out width (the r10 gather/spread "
+        "concurrency bound)",
+    )
+    p.add_argument(
+        "-ec.repair.backoffBaseSeconds",
+        dest="ec_repair_backoff_base_seconds", type=float, default=1.0,
+        help="first retry delay after a failed repair; doubles per "
+        "attempt",
+    )
+    p.add_argument(
+        "-ec.repair.backoffMaxSeconds",
+        dest="ec_repair_backoff_max_seconds", type=float, default=60.0,
+        help="exponential backoff ceiling for failed repairs",
+    )
+    p.add_argument(
+        "-ec.repair.maxAttempts", dest="ec_repair_max_attempts",
+        type=int, default=8,
+        help="park a volume as failed after this many repair attempts",
+    )
+    p.add_argument(
+        "-ec.repair.scrubIntervalSeconds",
+        dest="ec_repair_scrub_interval_seconds", type=float, default=0.0,
+        help="master-driven parity scrub sweep cadence feeding corrupt-"
+        "shard verdicts into the repair queue (0 disables)",
+    )
+    p.add_argument(
+        "-ec.repair.breakerPauseSeconds",
+        dest="ec_repair_breaker_pause_seconds", type=float, default=2.0,
+        help="defer repair scheduling this long whenever a fresh node "
+        "reports an open interactive QoS breaker",
+    )
     common_args.add_metrics_args(p)
     common_args.add_obs_args(p)
 
 
 async def run(args) -> None:
     common_args.apply_obs_args(args)
+    from ..repair import RepairConfig
     from ..server.master import MasterServer
     from ..storage import types as storage_types
 
@@ -78,6 +132,17 @@ async def run(args) -> None:
         meta_dir=args.meta_dir or None,
         raft_snapshot_threshold=args.raft_snapshot_threshold,
         white_list=guard_mod.from_security_toml(),
+        ec_repair=RepairConfig(
+            enabled=not args.ec_repair_disable,
+            interval_seconds=args.ec_repair_interval_seconds,
+            max_inflight=args.ec_repair_max_inflight,
+            fanout_concurrency=args.ec_repair_fanout,
+            backoff_base_seconds=args.ec_repair_backoff_base_seconds,
+            backoff_max_seconds=args.ec_repair_backoff_max_seconds,
+            max_attempts=args.ec_repair_max_attempts,
+            scrub_interval_seconds=args.ec_repair_scrub_interval_seconds,
+            breaker_pause_seconds=args.ec_repair_breaker_pause_seconds,
+        ).validated(),
         **common_args.metrics_kwargs(args),
     )
     await ms.start()

@@ -21,8 +21,10 @@ serving fixes of this PR exist for.
 """
 from .workload import LoadScenario, zipf_ranks
 from .driver import LoadResult, run_http_load, run_s3_load
+from .chaos import ChaosInjector
 
 __all__ = [
+    "ChaosInjector",
     "LoadResult",
     "LoadScenario",
     "run_http_load",

@@ -1,0 +1,120 @@
+"""Chaos harness: fault injectors for an in-process LocalCluster.
+
+The load drivers (driver.py) generate the traffic; this module breaks
+the cluster underneath it, in the four ways production does:
+
+  * `kill_volume_server` / `revive_volume_server` — the in-process
+    SIGKILL: endpoints vanish, the heartbeat stream breaks, the master
+    unregisters the node's shards.  Store state survives on disk, so a
+    revive is a node coming back after a crash.
+  * `partition_heartbeats` — the stream stays connected but pulses
+    stop (VolumeServer.heartbeat_pause): the master's staleness window
+    flags the node STALE, the repair scheduler's stale-node detection
+    source.
+  * `slow_disk` — every shard pread sleeps (storage/ec/volume.py
+    FAULT_READ_DELAY_S), the degraded-spindle latency injector.
+  * `corrupt_shard` — flips bytes inside an .ecNN shard file on disk
+    (and drops any device-cache copy so reads/scrubs see the disk),
+    the bit-rot the scrub verdict plane exists for.
+
+`run_with_faults` executes a LoadScenario's kill_at/revive_at schedule
+NEXT TO any awaitable load, so the chaos sweep and plain churn share
+one workload model (the satellite fix: churn alone could not express a
+server that dies and stays dead mid-sweep).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+
+from ..storage.ec import volume as ec_volume_mod
+from .workload import LoadScenario
+
+log = logging.getLogger("chaos")
+
+
+class ChaosInjector:
+    """Fault injection against a server.cluster.LocalCluster."""
+
+    def __init__(self, cluster) -> None:
+        self.cluster = cluster
+        self.dead: set[int] = set()
+        self.events: list[tuple[float, str, int]] = []  # (unix, action, idx)
+
+    def _note(self, action: str, idx: int) -> None:
+        self.events.append((time.time(), action, idx))
+        log.info("chaos: %s volume server %d", action, idx)
+
+    def volume_server(self, idx: int):
+        return self.cluster.volume_servers[idx]
+
+    async def kill_volume_server(self, idx: int) -> None:
+        if idx in self.dead:
+            return
+        await self.volume_server(idx).kill()
+        self.dead.add(idx)
+        self._note("kill", idx)
+
+    async def revive_volume_server(self, idx: int) -> None:
+        if idx not in self.dead:
+            return
+        await self.volume_server(idx).revive()
+        self.dead.discard(idx)
+        self._note("revive", idx)
+
+    def partition_heartbeats(self, idx: int, partitioned: bool = True) -> None:
+        """Stop (or restore) the node's heartbeat pulses without
+        breaking the stream — the stale-node injector."""
+        self.volume_server(idx).heartbeat_pause = partitioned
+        self._note(
+            "partition" if partitioned else "heal_partition", idx
+        )
+
+    def slow_disk(self, delay_s: float) -> None:
+        """Process-wide shard-pread latency (0 restores full speed)."""
+        ec_volume_mod.FAULT_READ_DELAY_S = float(delay_s)
+        self.events.append((time.time(), f"slow_disk={delay_s}", -1))
+
+    def corrupt_shard(
+        self, idx: int, vid: int, shard_id: int,
+        collection: str = "", offset: int = 12345, xor: int = 0x5A,
+    ) -> str:
+        """Flip a byte inside the shard file on disk and evict any
+        device-cache copy, so every subsequent read/scrub sees the
+        corruption.  Returns the path touched."""
+        vs = self.volume_server(idx)
+        path = vs.store._ec_base(vid, collection) + f".ec{shard_id:02d}"
+        size = os.path.getsize(path)
+        off = offset % max(1, size)
+        with open(path, "r+b") as f:
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ xor]))
+            f.flush()
+            os.fsync(f.fileno())
+        cache = vs.store.ec_device_cache
+        if cache is not None:
+            cache.evict(vid, shard_id)
+        self._note(f"corrupt_shard {vid}.{shard_id}", idx)
+        return path
+
+    async def run_with_faults(
+        self, load: asyncio.Future | asyncio.Task, scenario: LoadScenario
+    ) -> None:
+        """Execute the scenario's kill_at/revive_at schedule against
+        `fault_target` while `load` runs; waits for the load to finish
+        and re-raises its failure.  The schedule clock starts NOW (the
+        caller starts the load immediately before)."""
+        t0 = time.monotonic()
+        for at, action in scenario.fault_events():
+            delay = at - (time.monotonic() - t0)
+            if delay > 0:
+                await asyncio.sleep(delay)
+            if action == "kill":
+                await self.kill_volume_server(scenario.fault_target)
+            else:
+                await self.revive_volume_server(scenario.fault_target)
+        await load

@@ -45,8 +45,37 @@ class LoadScenario:
     # byte-verify every response against the expected blob
     verify: bool = True
     seed: int = 1337
+    # fault schedule (the chaos axis churn alone can't express: churn
+    # reconnects CLIENTS, this kills a SERVER that may stay dead):
+    # `kill_at` seconds into the sweep the harness abruptly stops
+    # volume server `fault_target`; `revive_at` (optional, > kill_at)
+    # brings it back.  kill_at set with revive_at None = the server
+    # dies and STAYS dead mid-sweep — the repair scheduler's case.
+    # The loadgen drivers don't act on these themselves: the chaos
+    # harness (loadgen/chaos.py run_with_faults) executes the schedule
+    # next to the driven load, so plain churn scenarios and the chaos
+    # harness share one workload model.
+    kill_at: float | None = None
+    revive_at: float | None = None
+    fault_target: int = 0
     # populated by callers that know the key->volume mapping
     extra: dict = field(default_factory=dict)
+
+    def fault_events(self) -> list[tuple[float, str]]:
+        """The validated schedule: sorted [(seconds_into_sweep,
+        "kill"|"revive")].  Empty when no fault is scheduled."""
+        if self.kill_at is None:
+            if self.revive_at is not None:
+                raise ValueError("revive_at requires kill_at")
+            return []
+        if self.kill_at < 0:
+            raise ValueError("kill_at must be >= 0")
+        events = [(float(self.kill_at), "kill")]
+        if self.revive_at is not None:
+            if self.revive_at <= self.kill_at:
+                raise ValueError("revive_at must be > kill_at")
+            events.append((float(self.revive_at), "revive"))
+        return events
 
 
 def zipf_ranks(n_keys: int, n_samples: int, s: float, rng) -> np.ndarray:

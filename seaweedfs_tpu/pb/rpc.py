@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import functools
 import inspect
+import threading
 
 import grpc
 from google.protobuf import message_factory
@@ -221,13 +222,39 @@ def sync_channel(address: str) -> grpc.Channel:
     return grpc.insecure_channel(address, options=GRPC_OPTIONS)
 
 
+_sync_channels: dict[str, grpc.Channel] = {}
+_sync_channels_lock = threading.Lock()
+
+
+def sync_channel_cached(address: str) -> grpc.Channel:
+    """Shared SYNC channel per address, for worker-thread hooks on HOT
+    paths: the degraded-read survivor gather dials up to 10 peers per
+    read, and an uncached dial pays TCP+HTTP/2 setup per shard — the
+    chaos sweep's p99-during-repair found it.  Sync channels are
+    thread-safe; callers must NOT close what they get here.  The cache
+    drops with the async one (drop_cached_channels /
+    close_all_channels), so TLS rotation keeps working."""
+    with _sync_channels_lock:
+        ch = _sync_channels.get(address)
+        if ch is None:
+            ch = sync_channel(address)
+            _sync_channels[address] = ch
+        return ch
+
+
 def drop_cached_channels() -> None:
     """Forget cached channels (without closing: callers may hold stubs).
     Used when the TLS config changes so new dials pick it up."""
     _channels.clear()
+    with _sync_channels_lock:
+        _sync_channels.clear()
 
 
 async def close_all_channels() -> None:
     for ch in list(_channels.values()):
         await ch.close()
     _channels.clear()
+    with _sync_channels_lock:
+        for ch in _sync_channels.values():
+            ch.close()
+        _sync_channels.clear()

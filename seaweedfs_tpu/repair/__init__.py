@@ -1,0 +1,13 @@
+"""Self-healing repair plane: the master's autonomous EC repair loop.
+
+ROADMAP item 3 closed: the telemetry plane (r08), the parallel rebuild
+fan-out (r10), and QoS admission (r13) are joined by a scheduler that
+ACTS — detecting shard loss / corruption / stale nodes, planning
+prioritized rate-limited repairs, and executing them as QoS-bulk
+traffic that yields to the interactive front door.
+"""
+from .config import RepairConfig
+from .planner import PlanResult, RepairJob, plan
+from .scheduler import RepairScheduler
+
+__all__ = ["PlanResult", "RepairConfig", "RepairJob", "RepairScheduler", "plan"]

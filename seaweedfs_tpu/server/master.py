@@ -92,6 +92,7 @@ class MasterServer:
         white_list: list[str] | None = None,  # [access] white_list guard
         metrics_address: str = "",  # pushgateway host:port (ref -metrics.address)
         metrics_interval_seconds: int = 15,  # ref -metrics.intervalSeconds
+        ec_repair=None,  # repair.RepairConfig | None (-ec.repair.* flags)
     ):
         self.metrics_address = metrics_address
         self.metrics_interval_seconds = metrics_interval_seconds
@@ -117,6 +118,13 @@ class MasterServer:
         # and the SeaweedFS_cluster_* series; a node missing 2 pulse
         # intervals is flagged stale (stats/cluster.py)
         self.telemetry = stats.ClusterTelemetry(pulse_seconds)
+        # self-healing repair plane (repair/scheduler.py): watches the
+        # EC census + telemetry for missing/corrupt shards and drives
+        # prioritized, QoS-subordinated ec.rebuild fan-outs; its loop
+        # starts in start() and only acts while this master leads
+        from ..repair import RepairScheduler
+
+        self.repair = RepairScheduler(self, ec_repair)
         self._subscribers: dict[object, asyncio.Queue] = {}
         self._grow_queue: asyncio.Queue = asyncio.Queue()
         self._growing: set[tuple] = set()
@@ -224,6 +232,7 @@ class MasterServer:
         self._tasks.append(
             spawn_logged(self._grower_loop(), log, "volume grower loop")
         )
+        self.repair.start()
         if self.auto_vacuum:
             self._tasks.append(
                 spawn_logged(self._vacuum_loop(), log, "auto-vacuum loop")
@@ -240,6 +249,7 @@ class MasterServer:
         )
 
     async def stop(self) -> None:
+        await self.repair.stop()
         if self.raft is not None:
             await self.raft.stop()
         for t_ in self._tasks:
@@ -749,6 +759,23 @@ class MasterServer:
         self.vacuum_disabled = False
         return master_pb2.EnableVacuumResponse()
 
+    async def PauseRepair(self, request, context):
+        """volume.repair.pause: quiesce the autonomous repair loop
+        (planned maintenance, debugging) — detection keeps running via
+        the status plane, but no new repair jobs start."""
+        proxied = await self._maybe_proxy("PauseRepair", request, context)
+        if proxied is not None:
+            return proxied
+        self.repair.pause()
+        return master_pb2.PauseRepairResponse()
+
+    async def ResumeRepair(self, request, context):
+        proxied = await self._maybe_proxy("ResumeRepair", request, context)
+        if proxied is not None:
+            return proxied
+        self.repair.resume()
+        return master_pb2.ResumeRepairResponse()
+
     # -------------------------------------------------- raft administration
 
     async def RaftListClusterServers(self, request, context):
@@ -1067,7 +1094,11 @@ class MasterServer:
         Telemetry lands on the leader (volume servers heartbeat to it
         alone), so followers redirect like every control-plane handler."""
         self._redirect_if_follower(request)
-        return web.json_response(self.telemetry.health())
+        doc = self.telemetry.health()
+        # the repair plane's live view rides the same document: queue
+        # depth, in-flight jobs, per-volume verdicts, convergence state
+        doc["repair"] = self.repair.status()
+        return web.json_response(doc)
 
     async def h_grow(self, request: web.Request) -> web.Response:
         self._redirect_if_follower(request)

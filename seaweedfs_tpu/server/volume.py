@@ -252,6 +252,12 @@ class VolumeServer:
         self._http_runner: web.AppRunner | None = None
         self._tasks: list[asyncio.Task] = []
         self._stopping = False
+        # chaos-harness hook (loadgen/chaos.py): True stops the pulse
+        # loop from sending WITHOUT breaking the stream — the master
+        # sees missed heartbeats and flags the node stale, which is the
+        # partition signal the repair scheduler watches (a broken
+        # stream would instead unregister the node immediately)
+        self.heartbeat_pause = False
 
     @property
     def url(self) -> str:
@@ -472,6 +478,32 @@ class VolumeServer:
                 deleted.append(vid)
         return deleted
 
+    async def kill(self) -> None:
+        """Abrupt stop — the in-process analogue of SIGKILL for the
+        chaos harness (loadgen/chaos.py): the HTTP/gRPC endpoints
+        vanish and the heartbeat stream breaks mid-pulse (so the master
+        unregisters the node's shards), but the store stays OPEN — a
+        SIGKILLed process doesn't get to flush or unmount either, and
+        `revive()` must bring the same on-disk state back."""
+        self._stopping = True
+        for t_ in self._tasks:
+            t_.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks.clear()
+        if self._grpc_server:
+            await self._grpc_server.stop(0)
+            self._grpc_server = None
+        if self._http_runner:
+            await self._http_runner.cleanup()
+            self._http_runner = None
+
+    async def revive(self) -> None:
+        """Restart after `kill()` on the same ports (fids cached by
+        clients keep resolving) with the same store."""
+        self._stopping = False
+        self.heartbeat_pause = False
+        await self.start()
+
     async def stop(self) -> None:
         self._stopping = True
         for t_ in self._tasks:
@@ -556,6 +588,15 @@ class VolumeServer:
             tel.tier_host_bytes = hc.bytes_used if hc is not None else 0
         tel.dispatcher_queue_depth = self.ec_dispatcher.queue_depth
         tel.dispatcher_inflight = self.ec_dispatcher.inflight
+        # INTERACTIVE admission breaker state: the master's repair
+        # scheduler defers bulk repair traffic while any node reports
+        # an open front-door breaker (serving/qos.py Breaker.OPEN)
+        from ..serving import qos as qos_mod
+
+        tel.qos_breaker_open = bool(
+            self.ec_dispatcher.qos.breaker_state(qos_mod.INTERACTIVE)
+            == qos_mod.Breaker.OPEN
+        )
         tel.dispatcher_shed = int(
             g("SeaweedFS_volumeServer_ec_batch_fallback_total") or 0
         )
@@ -678,6 +719,10 @@ class VolumeServer:
                     or not self.store.new_ec_shards.empty()
                     else self.pulse_seconds
                 )
+                while self.heartbeat_pause and not self._stopping:
+                    # chaos partition: stay connected, stop pulsing —
+                    # the master's staleness window does the rest
+                    await asyncio.sleep(0.05)
                 hb = self._delta_heartbeat()
                 n += 1
                 if hb is None:
@@ -1359,9 +1404,14 @@ class VolumeServer:
             locations = self._cached_ec_locations(vid)
             for addr in locations.get(shard_id, []):
                 try:
-                    from ..pb.rpc import sync_channel
+                    # cached per-address channel: the survivor gather
+                    # hits up to 10 peers per degraded read, and a
+                    # fresh dial per shard was the p99 cliff the chaos
+                    # sweep measured (channels are thread-safe; never
+                    # closed here)
+                    from ..pb.rpc import sync_channel_cached
 
-                    ch = sync_channel(addr)
+                    ch = sync_channel_cached(addr)
                     stub = Stub(ch, volume_server_pb2, "VolumeServer")
                     chunks = []
                     for resp in stub.VolumeEcShardRead(
@@ -1372,7 +1422,6 @@ class VolumeServer:
                         if resp.is_deleted:
                             return None
                         chunks.append(resp.data)
-                    ch.close()
                     return b"".join(chunks)
                 except grpc.RpcError:
                     continue
@@ -1390,9 +1439,9 @@ class VolumeServer:
             from ..pb import server_address
 
             try:
-                from ..pb.rpc import sync_channel
+                from ..pb.rpc import sync_channel_cached
 
-                ch = sync_channel(
+                ch = sync_channel_cached(
                     server_address.grpc_address(self.current_master)
                 )
                 stub = Stub(ch, master_pb2, "Seaweed")
@@ -1404,7 +1453,6 @@ class VolumeServer:
                         f"{l.url.rsplit(':', 1)[0]}:{l.grpc_port}" for l in e.locations
                         if l.url != self.url
                     ]
-                ch.close()
             except grpc.RpcError:
                 pass
         self._ec_locations[vid] = (now, locs)

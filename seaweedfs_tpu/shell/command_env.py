@@ -53,6 +53,29 @@ class TopoNode:
         return sum(self.max_volume_counts.values()) - used
 
 
+def topo_nodes_from_info(info: dict) -> list[TopoNode]:
+    """Flatten a Topology.to_info() snapshot into TopoNodes — shared by
+    the shell's collect_topology (which gets the JSON over VolumeList)
+    and the master's repair scheduler (which reads its own topology
+    in-process), so the two views can never parse differently."""
+    nodes = []
+    for dc in info.get("data_centers", []):
+        for rack in dc.get("racks", []):
+            for n in rack.get("nodes", []):
+                nodes.append(
+                    TopoNode(
+                        url=n["id"],
+                        grpc_port=n.get("grpc_port", 0),
+                        data_center=dc["id"],
+                        rack=rack["id"],
+                        volumes=n.get("volumes", []),
+                        ec_shards=n.get("ec_shards", []),
+                        max_volume_counts=n.get("max_volume_counts", {}),
+                    )
+                )
+    return nodes
+
+
 class CommandEnv:
     def __init__(self, masters: list[str], out: io.TextIOBase | None = None):
         self.masters = masters
@@ -137,19 +160,4 @@ class CommandEnv:
         (collectTopologyInfo command_ec_common.go:208)."""
         resp = await self.master_stub.VolumeList(master_pb2.VolumeListRequest())
         info = json.loads(resp.topology_info_json)
-        nodes = []
-        for dc in info.get("data_centers", []):
-            for rack in dc.get("racks", []):
-                for n in rack.get("nodes", []):
-                    nodes.append(
-                        TopoNode(
-                            url=n["id"],
-                            grpc_port=n.get("grpc_port", 0),
-                            data_center=dc["id"],
-                            rack=rack["id"],
-                            volumes=n.get("volumes", []),
-                            ec_shards=n.get("ec_shards", []),
-                            max_volume_counts=n.get("max_volume_counts", {}),
-                        )
-                    )
-        return nodes, resp.volume_size_limit_mb
+        return topo_nodes_from_info(info), resp.volume_size_limit_mb

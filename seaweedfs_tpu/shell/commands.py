@@ -71,5 +71,6 @@ from . import command_lock  # noqa: E402,F401
 from . import command_mount  # noqa: E402,F401
 from . import command_mq  # noqa: E402,F401
 from . import command_remote  # noqa: E402,F401
+from . import command_repair  # noqa: E402,F401
 from . import command_s3  # noqa: E402,F401
 from . import command_volume  # noqa: E402,F401

@@ -179,6 +179,7 @@ class NodeTelemetry:
     dispatcher_queue_depth: int = 0
     dispatcher_inflight: int = 0
     dispatcher_shed: int = 0
+    qos_breaker_open: bool = False
     overlap_fraction: float = 0.0
     ec_h2d_bytes: int = 0
     ec_d2h_bytes: int = 0
@@ -218,6 +219,9 @@ class NodeTelemetry:
                 "queue_depth": self.dispatcher_queue_depth,
                 "inflight": self.dispatcher_inflight,
                 "shed_total": self.dispatcher_shed,
+                # true while the node's INTERACTIVE admission breaker is
+                # open — the repair scheduler's yield signal
+                "qos_breaker_open": self.qos_breaker_open,
                 "overlap_fraction": round(self.overlap_fraction, 3),
                 "h2d_bytes_total": self.ec_h2d_bytes,
                 "d2h_bytes_total": self.ec_d2h_bytes,
@@ -299,6 +303,10 @@ class ClusterTelemetry:
             nt.dispatcher_queue_depth = tel.dispatcher_queue_depth
             nt.dispatcher_inflight = tel.dispatcher_inflight
             nt.dispatcher_shed = tel.dispatcher_shed
+            # getattr-guarded: pre-r16 servers lack the breaker field
+            nt.qos_breaker_open = bool(
+                getattr(tel, "qos_breaker_open", False)
+            )
             # getattr-guarded: a pre-r09 volume server's telemetry pb
             # simply lacks the pipeline fields
             nt.overlap_fraction = float(
@@ -433,6 +441,29 @@ class ClusterTelemetry:
             if p99 is not None:
                 CLUSTER_STAGE_P99.labels(stage=stage).set(p99)
 
+    def stale_node_urls(self, now: float | None = None) -> set[str]:
+        """Nodes past the staleness window (missed heartbeats): the
+        repair scheduler treats shards held ONLY by these as suspect."""
+        now = time.time() if now is None else now
+        with self._lock:
+            return {
+                url for url, nt in self._nodes.items()
+                if self._stale(nt, now)
+            }
+
+    def breakers_open(self, now: float | None = None) -> int:
+        """Fresh nodes whose last pulse reported an open INTERACTIVE
+        QoS breaker — nonzero means the front door is overloaded and
+        repair traffic must yield."""
+        now = time.time() if now is None else now
+        with self._lock:
+            return sum(
+                1 for nt in self._nodes.values()
+                if nt.has_payload
+                and nt.qos_breaker_open
+                and not self._stale(nt, now)
+            )
+
     def stage_quantile(self, stage: str, q: float) -> float | None:
         """Interpolated quantile estimate for one stage's merged digest
         (tests cross-check this against the per-server histograms)."""
@@ -505,6 +536,9 @@ class ClusterTelemetry:
                 ),
                 "dispatcher_shed_total": sum(
                     nt.dispatcher_shed for nt in fresh
+                ),
+                "qos_breakers_open": sum(
+                    1 for nt in fresh if nt.qos_breaker_open
                 ),
                 "tier_volumes": {
                     "hbm": sum(nt.tier_hbm_volumes for nt in fresh),

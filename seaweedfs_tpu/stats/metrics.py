@@ -59,6 +59,52 @@ MASTER_RECEIVED_HEARTBEATS = Counter(
     registry=REGISTRY,
 )
 
+# self-healing repair plane (repair/scheduler.py): the master's
+# autonomous ec.rebuild loop.  queued/completed/failed/backoff are
+# lifecycle counters per repair JOB (one EC volume's gather -> rebuild
+# -> remount choreography); inflight is the live job gauge; the
+# time-to-healthy histogram is the recovery SLO itself — wall seconds
+# from first observing the cluster under-replicated to full redundancy
+MASTER_REPAIR_QUEUED = Counter(
+    "SeaweedFS_master_repair_queued_total",
+    "Repair jobs admitted to the scheduler's queue (one per EC volume "
+    "per detection; re-queues after backoff count again).",
+    registry=REGISTRY,
+)
+MASTER_REPAIR_INFLIGHT = Gauge(
+    "SeaweedFS_master_repair_inflight",
+    "Repair jobs currently executing their gather/rebuild fan-out.",
+    registry=REGISTRY,
+)
+MASTER_REPAIR_COMPLETED = Counter(
+    "SeaweedFS_master_repair_completed_total",
+    "Repair jobs that restored their volume's shards.",
+    registry=REGISTRY,
+)
+MASTER_REPAIR_FAILED = Counter(
+    "SeaweedFS_master_repair_failed_total",
+    "Repair jobs parked after exhausting -ec.repair.maxAttempts.",
+    registry=REGISTRY,
+)
+MASTER_REPAIR_BACKOFF = Counter(
+    "SeaweedFS_master_repair_backoff_total",
+    "Repair deferrals, by reason: 'retry' = a failed job entering "
+    "exponential backoff; 'breaker_open' = a whole scheduling cycle "
+    "deferred because a fresh node reported an open interactive QoS "
+    "breaker (repair yields to the front door).",
+    ["reason"],
+    registry=REGISTRY,
+)
+for _r in ("retry", "breaker_open"):
+    MASTER_REPAIR_BACKOFF.labels(reason=_r)
+MASTER_REPAIR_TIME_TO_HEALTHY = Histogram(
+    "SeaweedFS_master_repair_time_to_healthy_seconds",
+    "Wall seconds from first observing missing/corrupt EC shards to "
+    "the cluster reaching full redundancy again (the recovery SLO).",
+    registry=REGISTRY,
+    buckets=(0.5, 1, 2, 5, 10, 30, 60, 120, 300, 600, 1800),
+)
+
 VOLUME_SERVER_REQUEST_COUNTER = Counter(
     "SeaweedFS_volumeServer_request_total",
     "Counter of volume server requests.",
@@ -424,6 +470,19 @@ VOLUME_SERVER_EC_TIER_HOST_BYTES = Gauge(
     "budget).",
     registry=REGISTRY,
 )
+VOLUME_SERVER_EC_DEGRADED_MEMO = Counter(
+    "SeaweedFS_volumeServer_ec_degraded_memo",
+    "Degraded-read reconstructed-interval memo outcomes: a 'hit' "
+    "serves a previously reconstructed interval without re-gathering "
+    ">=10 survivor shards (the repair-window hot-needle fast path "
+    "bench_chaos_sweep measures); 'miss' pays the full gather + "
+    "reconstruct and populates the memo.",
+    ["result"],
+    registry=REGISTRY,
+)
+for _r in ("hit", "miss"):
+    VOLUME_SERVER_EC_DEGRADED_MEMO.labels(result=_r)
+
 VOLUME_SERVER_EC_TIER_HOST_READS = Counter(
     "SeaweedFS_volumeServer_ec_tier_host_reads",
     "Shard interval reads served from the pinned host-RAM tier "

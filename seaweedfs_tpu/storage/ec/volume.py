@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Callable, Optional
 
 import numpy as np
@@ -96,6 +97,49 @@ def iter_ecj(path: str):
 RemoteReadFn = Callable[[int, int, int], Optional[bytes]]
 
 
+# shared fetch pool for the degraded-read survivor gather: sized for a
+# few concurrent degraded reads' waves; a per-read pool would spawn ~10
+# threads per reconstruct, and thread churn IS tail latency under load
+_GATHER_POOL = None
+_GATHER_POOL_LOCK = threading.Lock()
+
+
+# budget for the per-volume reconstructed-interval memo (bytes): sized
+# for a hot needle set, far below one shard
+RECONSTRUCT_MEMO_BUDGET = 8 << 20
+# memo entry lifetime — the corruption-exposure bound.  A reconstruct
+# whose gather included a corrupt survivor is wrong with or without the
+# memo (the pre-memo code served the same wrong bytes on every read
+# until the corrupt copy was dropped); the memo can only EXTEND that
+# window, and only by this TTL, because no shard-lifecycle event is a
+# reliable invalidation signal: the corrupt copy usually lives on a
+# REMOTE peer whose drop this node never observes, and local
+# delete_shard fires for content-fine moves too (repair's borrowed
+# cleanup and spread-source unmounts — clearing on those measurably
+# re-created the repair-window p99 cliff the memo removes)
+RECONSTRUCT_MEMO_TTL_S = 15.0
+
+
+def _gather_pool():
+    global _GATHER_POOL
+    with _GATHER_POOL_LOCK:
+        if _GATHER_POOL is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            _GATHER_POOL = ThreadPoolExecutor(
+                max_workers=32, thread_name_prefix="ec-gather"
+            )
+        return _GATHER_POOL
+
+
+# chaos-harness hook (loadgen/chaos.py slow_disk): >0 sleeps this long
+# before every shard pread, simulating a degraded spindle.  Module-level
+# and process-wide — the in-process chaos harness targets reads of a
+# specific server's shards by WHAT it reads, not by which server object
+# executes the pread.  Never set outside tests/bench.
+FAULT_READ_DELAY_S = 0.0
+
+
 class EcVolumeShard:
     """One mounted .ecNN file (ec_shard.go:17-97)."""
 
@@ -109,6 +153,8 @@ class EcVolumeShard:
         self.size = os.path.getsize(self.path)
 
     def read_at(self, offset: int, size: int) -> bytes:
+        if FAULT_READ_DELAY_S > 0:
+            time.sleep(FAULT_READ_DELAY_S)
         return os.pread(self._f.fileno(), size, offset)
 
     def close(self) -> None:
@@ -164,6 +210,26 @@ class EcVolume:
         # instead of disk preads — the middle rung of the residency
         # ladder
         self.host_cache = None
+        # reconstructed-interval memo: while a shard is missing, the
+        # zipf-hot needles hit the SAME (sid, off, size) interval over
+        # and over, and every reconstruct pays a >=10-shard survivor
+        # gather (remote under spread placement).  bench_chaos_sweep
+        # measured that as a sustained ~3x read-p99 cliff for the whole
+        # repair window.  Shard content is immutable once encoded
+        # (deletes are .ecj tombstones, never byte rewrites), so ADDING
+        # a shard never invalidates the memo — repair re-mounting a
+        # shard mid-window must NOT wipe the hot set (the re-gather
+        # spike was measurable), and once a shard is back, reads bypass
+        # the memo entirely.  What CAN go stale-wrong is an entry whose
+        # gather included a corrupt survivor — bounded by the entry TTL
+        # (see RECONSTRUCT_MEMO_TTL_S for why time, not lifecycle
+        # events, is the invalidation).  The budget keeps it to the hot
+        # set.
+        self._reconstruct_memo: dict[
+            tuple[int, int, int], tuple[bytes, float]
+        ] = {}
+        self._reconstruct_memo_bytes = 0
+        self._reconstruct_memo_lock = threading.Lock()
 
     # -- shard management ----------------------------------------------------
 
@@ -362,6 +428,27 @@ class EcVolume:
         `use_device=False` forces the host reconstruct — the serving
         dispatcher's shed path must not add width-1 device dispatches to
         a device that is already the bottleneck."""
+        from ... import stats as swfs_stats
+
+        memo_key = (missing_shard, off, size)
+        hit = None
+        with self._reconstruct_memo_lock:
+            rec = self._reconstruct_memo.get(memo_key)
+            if rec is not None:
+                data_m, expires = rec
+                if time.monotonic() < expires:
+                    hit = data_m
+                else:
+                    self._reconstruct_memo_bytes -= len(data_m)
+                    del self._reconstruct_memo[memo_key]
+        if hit is not None:
+            swfs_stats.VOLUME_SERVER_EC_DEGRADED_MEMO.labels(
+                result="hit"
+            ).inc()
+            return hit
+        swfs_stats.VOLUME_SERVER_EC_DEGRADED_MEMO.labels(
+            result="miss"
+        ).inc()
         if use_device and self.device_cache is not None:
             from ...ops import rs_resident
 
@@ -378,7 +465,9 @@ class EcVolume:
                 pass
         got: dict[int, np.ndarray] = {}
         n_remote = 0
+        n_remote_ok = 0
         with obs_trace.span("shard_read", op="gather_survivors") as gather:
+            remote_candidates: list[int] = []
             for sid in range(TOTAL_SHARDS):
                 if sid == missing_shard:
                     continue
@@ -392,15 +481,42 @@ class EcVolume:
                     if shard is not None:
                         buf = shard.read_at(off, size)
                     elif remote_read is not None:
-                        with obs_trace.span(
-                            "remote_shard_read", shard=sid, bytes=size
-                        ):
-                            buf = remote_read(sid, off, size)
-                        n_remote += 1
+                        remote_candidates.append(sid)
+                        continue
                 if buf is not None and len(buf) == size:
                     got[sid] = np.frombuffer(buf, dtype=np.uint8)
                 if len(got) >= DATA_SHARDS:
                     break
+            # remote survivors fetch CONCURRENTLY: a sequential gather
+            # pays up to 10 peer round-trips back to back, which is
+            # exactly the p99-during-repair cliff bench_chaos_sweep
+            # measures after a shard holder dies.  Each wave requests
+            # only the shortfall (no overfetch); failed fetches widen
+            # the next wave to the remaining candidates.  This hook
+            # already runs on a to_thread worker, so a small pool of
+            # sibling fetch threads is the sync analogue of the
+            # reference's per-shard goroutine fan-in.
+            while (
+                len(got) < DATA_SHARDS
+                and remote_candidates
+                and remote_read is not None
+            ):
+                wave = remote_candidates[: DATA_SHARDS - len(got)]
+                remote_candidates = remote_candidates[len(wave):]
+                n_remote += len(wave)
+                if len(wave) == 1:
+                    results = [(wave[0], remote_read(wave[0], off, size))]
+                else:
+                    results = list(zip(
+                        wave,
+                        _gather_pool().map(
+                            lambda s: remote_read(s, off, size), wave
+                        ),
+                    ))
+                for sid, buf in results:
+                    if buf is not None and len(buf) == size:
+                        got[sid] = np.frombuffer(buf, dtype=np.uint8)
+                        n_remote_ok += 1
             gather.annotate(
                 survivors=len(got), remote=n_remote,
                 bytes=size * len(got),
@@ -415,7 +531,39 @@ class EcVolume:
         ):
             codec = rs.RSCodec(backend=backend)
             out = codec.reconstruct(got, wanted=[missing_shard])
-            return out[missing_shard].tobytes()
+            data = out[missing_shard].tobytes()
+        if n_remote_ok > 0:
+            # memo ONLY results whose gather actually PULLED survivor
+            # bytes off a peer: that is the cost the memo amortizes
+            # (up to 10 peer round-trips per interval).  A reconstruct
+            # from purely local bytes is near-disk speed — failed
+            # remote ATTEMPTS at cluster-wide-missing shards don't
+            # count — and its byte caching belongs to the residency
+            # ladder (HBM/host tiers); memoing it here would shadow
+            # the tiering policy's placement decisions.
+            self._memo_reconstructed(memo_key, data)
+        return data
+
+    def _memo_reconstructed(
+        self, key: tuple[int, int, int], data: bytes
+    ) -> None:
+        with self._reconstruct_memo_lock:
+            if key in self._reconstruct_memo:
+                return
+            self._reconstruct_memo[key] = (
+                data, time.monotonic() + RECONSTRUCT_MEMO_TTL_S,
+            )
+            self._reconstruct_memo_bytes += len(data)
+            while (
+                self._reconstruct_memo_bytes > RECONSTRUCT_MEMO_BUDGET
+                and self._reconstruct_memo
+            ):
+                # dicts iterate in insertion order: drop the oldest
+                old_key = next(iter(self._reconstruct_memo))
+                self._reconstruct_memo_bytes -= len(
+                    self._reconstruct_memo.pop(old_key)[0]
+                )
+
 
     def read_needle_bytes(
         self,

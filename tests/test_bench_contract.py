@@ -21,11 +21,13 @@ def test_headline_keys_are_the_contract():
         "scrub_headline",
         "load_headline",
         "tiering_headline",
+        "repair_headline",
     )
 
 
 def test_order_result_puts_headline_keys_last():
     shuffled = {
+        "repair_headline": {"healthy_within_slo": True},
         "serving_headline": {"device_wins": True},
         "metric": "rs_10_4_encode_blockdiag_pallas",
         "load_headline": {"qos_zero_copy_beats_pre": True},
@@ -116,6 +118,8 @@ def _bulky_result():
             "tiering_headline": {
                 "oversubscribe": 4.0,
                 "tiering_beats_static": True,
+                "tiering_beats_static_strict": True,
+                "hot_volume_placement_ok": True,
                 "max_step_drop_frac": 0.053,
                 "no_cliff": True,
                 "tier_promotions": 14,
@@ -126,6 +130,23 @@ def _bulky_result():
                 "tier_verified": True,
                 "static_top_reads_per_s": 10423.5,
                 "tiered_top_reads_per_s": 19960.3,
+            },
+            # r16 chaos/repair verdict, COMPACT like main() ships it
+            # (full numbers live in extra.chaos_sweep): recovery SLOs
+            # measured with a server killed and a shard corrupted
+            # during the load window
+            "repair_headline": {
+                "slo_s": 90.0,
+                "time_to_healthy_s": 2.961,
+                "healthy_within_slo": True,
+                "calm_p99_ms": 62.5,
+                "repair_era_p99_ms": 75.8,
+                "repair_p99_ratio": 1.21,
+                "p99_within_2x": True,
+                "reads_verified": True,
+                "zero_unrecoverable_reads": True,
+                "corrupt_repaired": True,
+                "repair_sheds_under_breaker": True,
             },
         }
     )

@@ -38,6 +38,7 @@ CONFIG_OWNERS: tuple[tuple[str, str], ...] = (
     ("-ec.serving.", "seaweedfs_tpu/serving/config.py"),
     ("-ec.qos.", "seaweedfs_tpu/serving/config.py"),
     ("-ec.tier.", "seaweedfs_tpu/serving/config.py"),
+    ("-ec.repair.", "seaweedfs_tpu/repair/config.py"),
     ("-ec.bulk.", "seaweedfs_tpu/storage/ec/bulk.py"),
     ("-obs.", "seaweedfs_tpu/obs/config.py"),
 )
