@@ -25,11 +25,13 @@ def metrics_kwargs(args) -> dict:
 
 
 def add_obs_args(p) -> None:
-    """The -obs.* request-tracing flags every server role shares
-    (obs/config.py ObsConfig is the single source of the defaults)."""
-    from ..obs import ObsConfig
+    """The -obs.* request-tracing + flight-recorder flags every server
+    role shares (obs/config.py ObsConfig and obs/incident.py
+    IncidentConfig are the single sources of the defaults)."""
+    from ..obs import IncidentConfig, ObsConfig
 
     d = ObsConfig()
+    di = IncidentConfig()
     p.add_argument(
         "-obs.disable", dest="obs_disable", action="store_true",
         help="disable request tracing (/debug/traces stays empty; the "
@@ -45,11 +47,24 @@ def add_obs_args(p) -> None:
         default=d.trace_ring,
         help="completed traces kept in memory for /debug/traces",
     )
+    p.add_argument(
+        "-obs.incident.disable", dest="obs_incident_disable",
+        action="store_true",
+        help="disable the flight recorder (decision events — QoS sheds/"
+        "breaker flips, tier moves, repair state changes, cold-shape "
+        "sheds, stall aborts — stop landing in /debug/incident)",
+    )
+    p.add_argument(
+        "-obs.incident.events", dest="obs_incident_events", type=int,
+        default=di.events,
+        help="flight-recorder events kept in memory per process "
+        "(newest win), served at /debug/incident",
+    )
 
 
 def apply_obs_args(args) -> None:
     """Process-global, like the stats registry: call once at entry."""
-    from ..obs import ObsConfig, configure
+    from ..obs import IncidentConfig, ObsConfig, configure, incident
 
     configure(
         ObsConfig(
@@ -57,4 +72,126 @@ def apply_obs_args(args) -> None:
             slow_ms=args.obs_slow_ms,
             trace_ring=args.obs_trace_ring,
         )
+    )
+    incident.configure(
+        IncidentConfig(
+            enabled=not args.obs_incident_disable,
+            events=args.obs_incident_events,
+        )
+    )
+
+
+def add_slo_incident_args(p) -> None:
+    """Master-only incident-plane flags: the declared SLOs
+    (obs/slo.py SloConfig) and the bundler's disk/rate knobs
+    (obs/incident.py IncidentConfig)."""
+    from ..obs import IncidentConfig, SloConfig
+
+    d = SloConfig()
+    di = IncidentConfig()
+    p.add_argument(
+        "-obs.slo.disable", dest="obs_slo_disable", action="store_true",
+        help="disable SLO evaluation entirely (individual objectives "
+        "are also off while their target flag is 0)",
+    )
+    p.add_argument(
+        "-obs.slo.readP99Ms", dest="obs_slo_read_p99_ms", type=float,
+        default=d.read_p99_ms,
+        help="read-latency SLO: at most 1%% of -obs.slo.readStage "
+        "observations may exceed this many ms (0 = objective off)",
+    )
+    p.add_argument(
+        "-obs.slo.readStage", dest="obs_slo_read_stage",
+        default=d.read_stage,
+        help="stage digest the read-latency SLO judges (a "
+        "SeaweedFS_request_stage_seconds stage name)",
+    )
+    p.add_argument(
+        "-obs.slo.errorRatePct", dest="obs_slo_error_rate_pct",
+        type=float, default=d.error_rate_pct,
+        help="error-rate SLO: allowed percent of EC reads shed/failed "
+        "per window (0 = objective off)",
+    )
+    p.add_argument(
+        "-obs.slo.timeToHealthySeconds",
+        dest="obs_slo_time_to_healthy_seconds", type=float,
+        default=d.time_to_healthy_seconds,
+        help="recovery SLO: the repair plane must restore full "
+        "redundancy within this many seconds (0 = objective off)",
+    )
+    p.add_argument(
+        "-obs.slo.breakerOpenPct", dest="obs_slo_breaker_open_pct",
+        type=float, default=d.breaker_open_pct,
+        help="front-door SLO: allowed percent of telemetry pulses with "
+        "any open interactive QoS breaker (0 = objective off)",
+    )
+    p.add_argument(
+        "-obs.slo.fastWindowSeconds", dest="obs_slo_fast_window_seconds",
+        type=float, default=d.fast_window_seconds,
+        help="fast burn-rate alert window (trips quickly)",
+    )
+    p.add_argument(
+        "-obs.slo.slowWindowSeconds", dest="obs_slo_slow_window_seconds",
+        type=float, default=d.slow_window_seconds,
+        help="slow burn-rate alert window (confirms the fast trip; "
+        "also the error-budget horizon)",
+    )
+    p.add_argument(
+        "-obs.slo.burnThreshold", dest="obs_slo_burn_threshold",
+        type=float, default=d.burn_threshold,
+        help="burn rate BOTH windows must reach to fire a violation "
+        "(1.0 = burning exactly the budgeted rate)",
+    )
+    p.add_argument(
+        "-obs.incident.dir", dest="obs_incident_dir", default=di.dir,
+        help="directory incident bundles are written under; empty "
+        "disables bundling (SLO-fired and cluster.incident.dump alike)",
+    )
+    p.add_argument(
+        "-obs.incident.keep", dest="obs_incident_keep", type=int,
+        default=di.keep,
+        help="incident bundles kept on disk, oldest deleted first",
+    )
+    p.add_argument(
+        "-obs.incident.minIntervalSeconds",
+        dest="obs_incident_min_interval_seconds", type=float,
+        default=di.min_interval_seconds,
+        help="minimum seconds between SLO-fired bundles (a flapping "
+        "SLO writes one bundle per interval, not one per pulse)",
+    )
+    p.add_argument(
+        "-obs.incident.profileSeconds",
+        dest="obs_incident_profile_seconds", type=float,
+        default=di.profile_seconds,
+        help="when a LATENCY SLO burns, grab a device-profile capture "
+        "of this many seconds from the busiest fresh node's "
+        "/debug/profile (0 = off; the endpoint needs SWFS_DEBUG=1)",
+    )
+
+
+def slo_incident_kwargs(args) -> dict:
+    """MasterServer kwargs from the -obs.slo.* / master-side
+    -obs.incident.* flags (validated at server construction)."""
+    from ..obs import IncidentConfig, SloConfig
+
+    return dict(
+        obs_slo=SloConfig(
+            enabled=not args.obs_slo_disable,
+            read_p99_ms=args.obs_slo_read_p99_ms,
+            read_stage=args.obs_slo_read_stage,
+            error_rate_pct=args.obs_slo_error_rate_pct,
+            time_to_healthy_seconds=args.obs_slo_time_to_healthy_seconds,
+            breaker_open_pct=args.obs_slo_breaker_open_pct,
+            fast_window_seconds=args.obs_slo_fast_window_seconds,
+            slow_window_seconds=args.obs_slo_slow_window_seconds,
+            burn_threshold=args.obs_slo_burn_threshold,
+        ),
+        obs_incident=IncidentConfig(
+            enabled=not args.obs_incident_disable,
+            events=args.obs_incident_events,
+            dir=args.obs_incident_dir,
+            keep=args.obs_incident_keep,
+            min_interval_seconds=args.obs_incident_min_interval_seconds,
+            profile_seconds=args.obs_incident_profile_seconds,
+        ),
     )

@@ -104,6 +104,9 @@ def add_args(p) -> None:
     )
     common_args.add_metrics_args(p)
     common_args.add_obs_args(p)
+    # incident plane (obs/slo.py + obs/incident.py): declared SLOs +
+    # bundle disk/rate knobs — master-side, it hosts the engine
+    common_args.add_slo_incident_args(p)
 
 
 async def run(args) -> None:
@@ -144,6 +147,7 @@ async def run(args) -> None:
             breaker_pause_seconds=args.ec_repair_breaker_pause_seconds,
         ).validated(),
         **common_args.metrics_kwargs(args),
+        **common_args.slo_incident_kwargs(args),
     )
     await ms.start()
     await asyncio.Event().wait()  # serve until interrupted

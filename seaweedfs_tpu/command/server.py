@@ -38,6 +38,8 @@ def add_args(p) -> None:
     p.add_argument("-s3.config", dest="s3_config", default="")
     common_args.add_metrics_args(p)
     common_args.add_obs_args(p)
+    # the co-hosted master carries the incident plane's engine/bundler
+    common_args.add_slo_incident_args(p)
 
 
 async def run(args) -> None:
@@ -66,6 +68,7 @@ async def run(args) -> None:
         jwt_expires_sec=config_util.jwt_expires_sec(),
         white_list=white_list,
         **metrics_kw,
+        **common_args.slo_incident_kwargs(args),
     )
     await ms.start()
 

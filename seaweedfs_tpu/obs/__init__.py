@@ -1,5 +1,12 @@
-"""End-to-end request tracing (trace ids, spans, /debug/traces)."""
+"""End-to-end observability: request tracing (trace ids, spans,
+/debug/traces), the incident plane's flight recorder + bundler
+(incident.py), the master-side SLO burn-rate engine (slo.py), and
+on-demand device profiling (profile.py)."""
+from . import incident, profile, slo
 from .config import ObsConfig
+from .incident import IncidentBundler, IncidentConfig
+from .profile import device_hot_handler, profile_handler
+from .slo import SloConfig, SloEngine
 from .trace import (
     GRPC_TRACE_KEY,
     RING,
@@ -24,8 +31,17 @@ from .trace import (
 
 __all__ = [
     "GRPC_TRACE_KEY",
+    "IncidentBundler",
+    "IncidentConfig",
     "ObsConfig",
     "RING",
+    "SloConfig",
+    "SloEngine",
+    "device_hot_handler",
+    "incident",
+    "profile",
+    "profile_handler",
+    "slo",
     "TRACE_HEADER",
     "Trace",
     "configure",

@@ -146,7 +146,23 @@ class Trace:
 
 
 class TraceRing:
-    """Bounded ring of completed traces (newest win, oldest drop)."""
+    """Bounded ring of completed traces (newest win, oldest drop).
+
+    Locking contract, audited for the incident fan-out (r17): finished
+    requests `add()` from any thread while /debug/traces and the
+    incident bundler `snapshot()` and `configure()` may `resize()` the
+    deque concurrently — EVERY deque touch (append, list-copy, the
+    resize swap, clear) runs under `_lock`, so a snapshot can never
+    observe the deque mid-resize (deque itself gives no such guarantee
+    while `maxlen` is being swapped via rebuild).  Serialization runs
+    OUTSIDE the ring lock on the copied Trace references: `to_dict`
+    takes each trace's own `_lock` for its span list, and the ring lock
+    is never held while a trace lock is taken (nor vice versa — Trace
+    never touches the ring), so the two lock classes cannot form an
+    order cycle.  A trace's scalar `end`/`status` may still be written
+    by `finish_trace` while an already-snapshotted reference serializes
+    — benign torn reads of floats/strs, never a torn container.
+    tests/test_trace_ring_stress.py races all four operations."""
 
     def __init__(self, capacity: int = 256):
         self._lock = threading.Lock()
@@ -157,17 +173,31 @@ class TraceRing:
             self._dq.append(trace)
 
     def snapshot(
-        self, limit: int | None = None, trace_id: str | None = None
+        self,
+        limit: int | None = None,
+        trace_id: str | None = None,
+        since_unix: float | None = None,
     ) -> list[dict]:
         """Newest-first JSON-ready dicts; `trace_id` narrows to one
         trace's entries (a request can leave several per-role entries in
-        a co-hosted ring) BEFORE the limit applies, so `volume.trace -id`
-        fetches one trace instead of paging the whole ring."""
+        a co-hosted ring) and `since_unix` keeps only traces still
+        ACTIVE at/after that wall time (start + duration, not start:
+        a request that stalled for a minute and finished during the
+        burn is exactly the culprit an incident bundle exists to
+        capture, and it STARTED before any short window) — both applied
+        BEFORE the limit, so `volume.trace -id`/`-since` (and the
+        incident bundler's burn window) fetch their slice instead of
+        paging the whole ring."""
         with self._lock:
             items = list(self._dq)
         items.reverse()
         if trace_id is not None:
             items = [t for t in items if t.trace_id == trace_id]
+        if since_unix is not None:
+            items = [
+                t for t in items
+                if t.wall_start + t.duration_s >= since_unix
+            ]
         if limit is not None:
             items = items[:limit]
         return [t.to_dict() for t in items]
@@ -397,21 +427,41 @@ async def response_prepare_signal(request, response):
         response.headers[TRACE_HEADER] = f"{t.trace_id}-{t.root_id}"
 
 
-async def traces_handler(request):
-    """aiohttp GET /debug/traces: recent complete traces, newest-first,
-    with per-span durations.  ?limit=N bounds the payload; ?id=<trace_id>
-    fetches one trace's entries instead of the whole ring."""
+def parse_limit_since(request) -> tuple[int | None, float | None]:
+    """Validated (?limit, ?since) -> (limit or None, since_unix cutoff
+    or None) — ONE home for the debug endpoints' window parsing
+    (/debug/traces and /debug/incident share the semantics, and the
+    incident bundler fetches both).  Raises 400 on negative or
+    non-finite values: nan would sail past `< 0` and silently filter
+    everything out."""
     from aiohttp import web
+
+    import math
 
     try:
         limit = int(request.query.get("limit", 0))
+        since_s = float(request.query.get("since", 0))
     except ValueError:
-        raise web.HTTPBadRequest(text="limit must be an integer")
-    if limit < 0:
-        raise web.HTTPBadRequest(text="limit must be >= 0")
+        raise web.HTTPBadRequest(text="limit/since must be numeric")
+    if limit < 0 or not math.isfinite(since_s) or since_s < 0:
+        raise web.HTTPBadRequest(text="limit/since must be finite >= 0")
+    return limit or None, (time.time() - since_s) if since_s else None
+
+
+async def traces_handler(request):
+    """aiohttp GET /debug/traces: recent complete traces, newest-first,
+    with per-span durations.  ?limit=N bounds the payload;
+    ?id=<trace_id> fetches one trace's entries instead of the whole
+    ring; ?since=S keeps only traces still active in the last S seconds
+    (the incident bundler's burn-window fetch; a long-stalled request
+    finishing inside the window counts) — filters apply before the
+    limit."""
+    from aiohttp import web
+
+    limit, since_unix = parse_limit_since(request)
     trace_id = request.query.get("id") or None
     return web.json_response(
-        {"traces": RING.snapshot(limit or None, trace_id)}
+        {"traces": RING.snapshot(limit, trace_id, since_unix)}
     )
 
 

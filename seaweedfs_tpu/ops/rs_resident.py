@@ -41,6 +41,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from . import gf256, rs_tpu
+from ..obs import incident as obs_incident
 from ..obs import trace as obs_trace
 from ..stats import metrics as stats_metrics
 
@@ -1317,6 +1318,69 @@ def observed_buckets() -> list[tuple[int, int]]:
     return [k for k, _ in items]
 
 
+# per-_call_key dispatch accounting for the live "what shape is hot
+# right now" view (/debug/device/hot, volume.device.status -hot): the
+# observed-bucket ranking above orders COMPILES, this answers the
+# operator's runtime question — which compiled shape the device is
+# actually spending its time in, and how long one dispatch of it takes.
+# key -> [dispatch count, latency EWMA seconds, last dispatch unix]
+_call_stats: dict[tuple, list] = {}
+# EWMA weight: ~last 10 dispatches of the shape, same horizon as the
+# QoS deadline estimator
+_CALL_EWMA_ALPHA = 0.2
+
+
+def _note_call_latency(key: tuple, seconds: float) -> None:
+    """Record one device call's dispatch->fetch-complete wall seconds.
+    Measured across the async pipeline (overlapped calls include their
+    queue time behind siblings), so it is an OBSERVED service latency,
+    not a pure kernel time — exactly what a tail investigation wants."""
+    now = time.time()
+    with _shapes_lock:
+        rec = _call_stats.get(key)
+        if rec is None:
+            _call_stats[key] = [1, seconds, now]
+            return
+        rec[0] += 1
+        rec[1] += _CALL_EWMA_ALPHA * (seconds - rec[1])
+        rec[2] = now
+
+
+def hot_shapes(limit: int = 10) -> list[dict]:
+    """The hottest compiled call shapes, most-dispatched first:
+    dispatch counts, per-dispatch latency EWMA, last-seen age — the
+    `volume.device.status -hot` / /debug/device/hot payload."""
+    with _shapes_lock:
+        items = sorted(
+            _call_stats.items(), key=lambda kv: -kv[1][0]
+        )[: max(0, limit)]
+    now = time.time()
+    out = []
+    for key, (count, ewma_s, last) in items:
+        (
+            family, groups, w_true, tile, fetch, n_bucket, k, a_shape,
+            surv_len, interpret,
+        ) = key
+        out.append(
+            {
+                "kernel": family,
+                "groups": groups,
+                "w_true": w_true,
+                "tile": tile,
+                "fetch": fetch,
+                "count_bucket": n_bucket,
+                "k": k,
+                "a_shape": list(a_shape),
+                "survivor_len": surv_len,
+                "interpret": bool(interpret),
+                "dispatches": count,
+                "ewma_ms": round(ewma_s * 1e3, 3),
+                "last_dispatch_age_s": round(max(0.0, now - last), 3),
+            }
+        )
+    return out
+
+
 def _blockdiag_fetch_tile(fetch: int, groups: int) -> tuple[int, int]:
     """(fetch, tile) for the fused blockdiag kernel: per-chunk segments
     must stay FUSED_ALIGN-provable, so fetch rounds UP to a multiple of
@@ -1704,6 +1768,12 @@ def reconstruct_intervals(
             stats_metrics.VOLUME_SERVER_EC_READ_ROUTE.labels(
                 route="shed_cold_shape"
             ).inc(len(requests))
+            # flight recorder: the shed decision, trace-stamped — an
+            # incident bundle can say "this tail read hit a cold shape"
+            obs_incident.record(
+                "cold_shape_shed", vid=vid, requests=len(requests),
+                cold_shapes=len(cold),
+            )
             raise ColdShape(
                 f"vid {vid}: {len(cold)} device shape(s) still AOT-cold"
             )
@@ -1726,11 +1796,11 @@ def reconstruct_intervals(
     # per size bucket.  Aggregate un-fetched output is bounded: every
     # pending call holds its [n, fetch] result in HBM, so a huge batch
     # must drain the oldest call before dispatching more.
-    pending: list[tuple[list, object, int, list[int] | None]] = []
+    pending: list[tuple] = []
     pending_bytes = 0
 
     def _finish(entry) -> int:
-        part, arr, fetch, deltas = entry
+        part, arr, fetch, deltas, key, t_dispatch = entry
         nbytes = int(arr.size)  # padded rows ride the fetch too
         # completion boundary BEFORE the d2h span: jax dispatch is
         # async, so without it the fetch would absorb the kernel's
@@ -1738,6 +1808,9 @@ def reconstruct_intervals(
         # read as "tunnel-bound fetch" in the stage histogram — the
         # blocking wait lands in device_execute, where it belongs
         arr.block_until_ready()
+        # the hot-shape view's latency sample: dispatch -> result ready
+        # (pipelined calls include their wait behind siblings)
+        _note_call_latency(key, time.perf_counter() - t_dispatch)
         with obs_trace.span("d2h_copy", bytes=nbytes):
             out = np.asarray(arr).reshape(-1, fetch)
         stats_metrics.VOLUME_SERVER_EC_D2H_BYTES.inc(nbytes)
@@ -1785,11 +1858,12 @@ def reconstruct_intervals(
             # a_bm's shape — keying on the shape neither misses a real
             # compile nor counts phantom ones
             dev_misses += _note_shape(key)
+            t_dispatch = time.perf_counter()
             arr = _dispatch_call(
                 kind, dev_vec, a_prep, survivors, len(use), w_true,
                 groups, tile, fetch, kernel, interpret, key=key,
             )
-            pending.append((part, arr, fetch, deltas))
+            pending.append((part, arr, fetch, deltas, key, t_dispatch))
             pending_bytes += len(part) * fetch
             dev_calls += 1
             # the padded rows ride the wire too: count what the

@@ -37,6 +37,7 @@ import time
 from typing import Any
 
 from .. import stats
+from ..obs import incident as obs_incident
 from ..pb import volume_server_pb2
 from ..shell.command_env import TopoNode, topo_nodes_from_info
 from ..storage.ec import DATA_SHARDS, TOTAL_SHARDS
@@ -149,6 +150,10 @@ class RepairScheduler:
             )
             self.totals["backoff_breaker"] += 1
             stats.MASTER_REPAIR_BACKOFF.labels(reason="breaker_open").inc()
+            obs_incident.record(
+                "repair_deferred", reason="breaker_open",
+                open_breakers=open_breakers,
+            )
             log.info(
                 "repair deferred: %d node(s) report an open interactive "
                 "QoS breaker", open_breakers,
@@ -184,6 +189,11 @@ class RepairScheduler:
                 continue
             self.totals["queued"] += 1
             stats.MASTER_REPAIR_QUEUED.inc()
+            obs_incident.record(
+                "repair_queued", vid=job.vid, missing=list(job.missing),
+                corrupt=sorted(job.corrupt), critical=job.critical,
+                reason=job.reason,
+            )
             self._inflight[job.vid] = spawn_logged(
                 self._run_job(job, nodes, stale),
                 log,
@@ -265,6 +275,11 @@ class RepairScheduler:
             self._verdicts.setdefault(job.vid, {}).update(
                 state="repaired", last_result=result, last_error=None,
             )
+            obs_incident.record(
+                "repair_completed", vid=job.vid,
+                rebuilt=result.get("rebuilt"),
+                rebuilder=result.get("rebuilder"),
+            )
             log.info(
                 "repaired ec volume %d: rebuilt %s on %s",
                 job.vid, result["rebuilt"], result["rebuilder"],
@@ -281,6 +296,11 @@ class RepairScheduler:
             self._backoff[job.vid] = (attempts, self.clock() + delay)
             self._verdicts.setdefault(job.vid, {}).update(
                 state="backoff", attempts=attempts, last_error=str(e),
+            )
+            obs_incident.record(
+                "repair_failed", vid=job.vid, attempts=attempts,
+                error=str(e),
+                parked=bool(attempts >= self.cfg.max_attempts),
             )
             if attempts >= self.cfg.max_attempts:
                 self._parked[job.vid] = str(e)
@@ -367,6 +387,15 @@ class RepairScheduler:
                 )
 
     # --------------------------------------------------------------- status
+
+    def unhealthy_for(self) -> float | None:
+        """Seconds the cluster has been CONTINUOUSLY under-redundant
+        (None when healthy) — the live half of the time-to-healthy SLO:
+        the histogram observes episodes after they end, this exposes
+        the one still running so obs/slo.py can burn DURING it."""
+        if self._unhealthy_since is None:
+            return None
+        return max(0.0, self.clock() - self._unhealthy_since)
 
     def status(self) -> dict[str, Any]:
         """The repair block of /cluster/health.json (and

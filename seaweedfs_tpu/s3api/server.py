@@ -243,7 +243,9 @@ class S3ApiServer:
         from .. import obs, stats
         from .circuit_breaker import CircuitBreakerError
 
-        if request.match_info["tail"] in ("debug/traces", "debug/stacks"):
+        if request.match_info["tail"] in (
+            "debug/traces", "debug/stacks", "debug/incident"
+        ):
             # reserved observability paths (this catch-all owns the
             # namespace; a bucket literally named "debug" loses these
             # keys to it).  The s3 port is the PUBLIC customer endpoint
@@ -259,6 +261,8 @@ class S3ApiServer:
                 from ..utils.profiling import debug_stacks_handler
 
                 return await debug_stacks_handler(request)
+            if request.match_info["tail"] == "debug/incident":
+                return await obs.incident.incident_handler(request)
             return await obs.traces_handler(request)
         tid, psid = obs.parse_trace_header(
             request.headers.get(obs.TRACE_HEADER, "")

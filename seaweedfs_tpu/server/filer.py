@@ -210,6 +210,11 @@ class FilerServer:
             # do: the data app's catch-all owns the whole namespace, so
             # a filer path "/debug/traces" must stay a file path
             mapp.router.add_get("/debug/traces", obs.traces_handler)
+            # the filer's flight-recorder ring rides the metrics port
+            # too (co-hosted roles share one ring, like the registry)
+            mapp.router.add_get(
+                "/debug/incident", obs.incident.incident_handler
+            )
             if os.environ.get("SWFS_DEBUG") == "1":
                 # thread-stack dumps for a wedged filer (same opt-in
                 # gate as the other roles' /debug/stacks)

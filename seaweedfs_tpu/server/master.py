@@ -93,6 +93,8 @@ class MasterServer:
         metrics_address: str = "",  # pushgateway host:port (ref -metrics.address)
         metrics_interval_seconds: int = 15,  # ref -metrics.intervalSeconds
         ec_repair=None,  # repair.RepairConfig | None (-ec.repair.* flags)
+        obs_slo=None,  # obs.SloConfig | None (-obs.slo.* flags)
+        obs_incident=None,  # obs.IncidentConfig | None (-obs.incident.*)
     ):
         self.metrics_address = metrics_address
         self.metrics_interval_seconds = metrics_interval_seconds
@@ -125,6 +127,21 @@ class MasterServer:
         from ..repair import RepairScheduler
 
         self.repair = RepairScheduler(self, ec_repair)
+        # incident plane (obs/slo.py + obs/incident.py): declared SLOs
+        # evaluated against the telemetry plane every pulse; a sustained
+        # burn (fast window trips, slow window confirms) fires the
+        # bundler, which snapshots every fresh node's flight recorder +
+        # trace ring into one correlated bundle under -obs.incident.dir
+        from .. import obs
+
+        if obs_incident is not None:
+            obs.incident.configure(obs_incident)
+        self.slo = obs.SloEngine(obs_slo, self.telemetry, self.repair)
+        self.incident = obs.IncidentBundler(
+            self.telemetry.fresh_node_urls, self._health_doc
+        )
+        self.slo.on_violation.append(self._on_slo_violation)
+        self._incident_captures: set = set()
         self._subscribers: dict[object, asyncio.Queue] = {}
         self._grow_queue: asyncio.Queue = asyncio.Queue()
         self._growing: set[tuple] = set()
@@ -197,6 +214,10 @@ class MasterServer:
         # through the same hook)
         app[stats.metrics.metrics_collect_key()] = self.telemetry.refresh_gauges
         app.router.add_get("/debug/traces", obs.traces_handler)
+        # the master's own flight-recorder ring (repair + SLO events);
+        # volume servers serve the same endpoint for the fan-out
+        app.router.add_get("/debug/incident", obs.incident.incident_handler)
+        app.router.add_post("/cluster/incident/dump", self.h_incident_dump)
         if os.environ.get("SWFS_DEBUG") == "1":
             # stack dumps reveal internals; opt-in only (the reference
             # gates pprof handlers the same way)
@@ -232,6 +253,10 @@ class MasterServer:
         self._tasks.append(
             spawn_logged(self._grower_loop(), log, "volume grower loop")
         )
+        if self.slo.specs:
+            self._tasks.append(
+                spawn_logged(self._slo_loop(), log, "slo evaluation loop")
+            )
         self.repair.start()
         if self.auto_vacuum:
             self._tasks.append(
@@ -252,9 +277,12 @@ class MasterServer:
         await self.repair.stop()
         if self.raft is not None:
             await self.raft.stop()
-        for t_ in self._tasks:
+        captures = list(self._incident_captures)
+        for t_ in self._tasks + captures:
             t_.cancel()
-        await asyncio.gather(*self._tasks, return_exceptions=True)
+        await asyncio.gather(
+            *self._tasks, *captures, return_exceptions=True
+        )
         if self._grpc_server:
             await self._grpc_server.stop(0.1)
         if self._http_runner:
@@ -942,6 +970,94 @@ class MasterServer:
     def _volume_stub(self, node: DataNode) -> Stub:
         return Stub(channel(node.grpc_url), volume_server_pb2, "VolumeServer")
 
+    # ---------------------------------------------------------- incident plane
+
+    async def _slo_loop(self) -> None:
+        """Evaluate the declared SLOs once per telemetry pulse — the
+        judging half of the observability loop (obs/slo.py).  Runs only
+        while this master leads: heartbeat telemetry lands on the
+        leader alone, so a follower's windows would judge silence."""
+        while True:
+            await asyncio.sleep(self.pulse_seconds)
+            if not self.is_leader:
+                continue
+            try:
+                self.slo.evaluate()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — one failed evaluation
+                # must not end the judge; the next pulse re-samples
+                log.exception("slo evaluation failed")
+
+    def _on_slo_violation(self, verdict: dict) -> None:
+        """Rising-edge hook from the SLO engine: record the verdict
+        into the master's own flight recorder, then capture an incident
+        bundle in the background (the evaluate() caller must not block
+        on a cluster-wide fan-out)."""
+        from .. import obs
+
+        obs.incident.record("slo_violation", **verdict)
+        # the bundle should cover the burn AND its lead-up: second-scale
+        # test/bench windows would otherwise capture only the last pulse
+        # or two of traces (the rings bound the cost either way)
+        window = max(self.slo.cfg.slow_window_seconds, 30.0)
+        # registry set, not _tasks: captures self-discard on completion,
+        # so a flapping SLO can't grow the master's task list forever
+        spawn_logged(
+            self.incident.capture(verdict, window_s=window),
+            log,
+            f"incident bundle for {verdict.get('slo')}",
+            registry=self._incident_captures,
+        )
+
+    def _health_doc(self) -> dict:
+        """The /cluster/health.json document — telemetry plane + repair
+        + slo blocks; also what every incident bundle embeds."""
+        doc = self.telemetry.health()
+        doc["repair"] = self.repair.status()
+        doc["slo"] = self.slo.status()
+        return doc
+
+    async def h_incident_dump(self, request: web.Request) -> web.Response:
+        """POST /cluster/incident/dump: operator-triggered incident
+        bundle (shell `cluster.incident.dump`) — same fan-out and
+        bundle shape as an SLO fire, skipping only the rate limit.
+        ?window=S overrides the capture window (default: the slow SLO
+        window)."""
+        self._redirect_if_follower(request)
+        from ..obs import incident as obs_incident
+
+        if not obs_incident.CONFIG.dir:
+            return web.json_response(
+                {"error": "incident bundling disabled: set -obs.incident.dir"},
+                status=503,
+            )
+        import math
+
+        try:
+            window = float(
+                request.query.get(
+                    "window", self.slo.cfg.slow_window_seconds
+                )
+            )
+        except ValueError:
+            window = math.nan
+        if not math.isfinite(window) or window <= 0:
+            # nan/-5 would silently produce an EMPTY bundle (every
+            # since-comparison false) — the operator's manual capture
+            # must fail loudly instead of capturing nothing
+            return web.json_response(
+                {"error": "window must be a positive number of seconds"},
+                status=400,
+            )
+        summary = await self.incident.capture(
+            {"slo": "manual", "latency": False},
+            window_s=window,
+            trigger="manual",
+            force=True,
+        )
+        return web.json_response(summary)
+
     # ------------------------------------------------------------------ vacuum
 
     async def _vacuum_loop(self) -> None:
@@ -1094,11 +1210,10 @@ class MasterServer:
         Telemetry lands on the leader (volume servers heartbeat to it
         alone), so followers redirect like every control-plane handler."""
         self._redirect_if_follower(request)
-        doc = self.telemetry.health()
-        # the repair plane's live view rides the same document: queue
-        # depth, in-flight jobs, per-volume verdicts, convergence state
-        doc["repair"] = self.repair.status()
-        return web.json_response(doc)
+        # telemetry plane + the repair plane's live view + the SLO
+        # engine's verdicts, one document (_health_doc — the incident
+        # bundler embeds the same)
+        return web.json_response(self._health_doc())
 
     async def h_grow(self, request: web.Request) -> web.Response:
         self._redirect_if_follower(request)

@@ -288,12 +288,22 @@ class VolumeServer:
         app.router.add_get("/status", self.h_status)
         app.router.add_get("/metrics", stats.metrics_handler)
         app.router.add_get("/debug/traces", obs.traces_handler)
+        # incident plane: this node's flight-recorder ring + trace
+        # window (the master's bundle fan-out target) and the live
+        # per-shape device dispatch view (volume.device.status -hot)
+        app.router.add_get("/debug/incident", obs.incident.incident_handler)
+        app.router.add_get("/debug/device/hot", obs.device_hot_handler)
         if os.environ.get("SWFS_DEBUG") == "1":
             # stack dumps reveal internals; opt-in only (the reference
             # gates pprof handlers the same way)
             from ..utils.profiling import debug_stacks_handler
 
             app.router.add_get("/debug/stacks", debug_stacks_handler)
+            # on-demand device profiling (obs/profile.py): wraps
+            # jax.profiler around the live serving loop — same opt-in
+            # gate as the stack dumps (it reveals internals AND costs
+            # device attention)
+            app.router.add_get("/debug/profile", obs.profile_handler)
         app[stats.metrics.metrics_collect_key()] = self._collect_metrics
         app.router.add_route("*", "/{fid:.*}", self.h_needle)
         self._http_runner = web.AppRunner(app)
@@ -599,6 +609,35 @@ class VolumeServer:
         )
         tel.dispatcher_shed = int(
             g("SeaweedFS_volumeServer_ec_batch_fallback_total") or 0
+        )
+        # error-rate SLO raw counters (obs/slo.py): admitted EC reads
+        # (batched+native partitions admissions — the re-route counts
+        # like shed_cold_shape ride on top and must not double-count)
+        # and total sheds.  With QoS enabled, every coalescer-saturation
+        # fallback ALSO lands in qos_shed{queue_budget} via saturated(),
+        # so the qos series alone is the complete shed count — adding
+        # dispatcher_shed on top would double-count saturation and
+        # inflate the error-rate burn; only the -ec.qos.disable config
+        # (fixed at construction) leaves the fallback counter as the
+        # sole record.
+        tel.ec_reads_total = sum(
+            int(
+                g("SeaweedFS_volumeServer_ec_read_route_total",
+                  {"route": r}) or 0
+            )
+            for r in ("batched", "native")
+        )
+        qos_sheds = sum(
+            int(
+                g("SeaweedFS_volumeServer_ec_qos_shed_total",
+                  {"tier": t_, "reason": r_}) or 0
+            )
+            for t_ in ("interactive", "bulk")
+            for r_ in ("queue_budget", "deadline", "breaker_open")
+        )
+        tel.ec_reads_shed_total = (
+            qos_sheds if self.ec_dispatcher.cfg.qos
+            else tel.dispatcher_shed
         )
         # double-buffered batch pipeline: last window's device-busy /
         # wall ratio + cumulative staged bytes, so cluster.health can
@@ -1116,6 +1155,12 @@ class VolumeServer:
             log.debug("client disconnected mid-response")
         except asyncio.TimeoutError:
             stats.VOLUME_SERVER_RESPONSE_STALL_ABORTS.inc()
+            # flight recorder: the abort decision, trace-stamped — an
+            # incident bundle joins "this client got cut off" with the
+            # request trace that was dribbling
+            obs.incident.record(
+                "stall_abort", bytes=len(mv), budget_s=round(budget, 1)
+            )
             log.warning(
                 "read response stalled past its %.1fs budget "
                 "(%d bytes); disconnecting slow client", budget, len(mv),

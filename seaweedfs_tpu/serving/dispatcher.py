@@ -40,6 +40,7 @@ import logging
 import time
 
 from .. import obs, stats
+from ..obs import incident as obs_incident
 from ..utils.tasks import spawn_logged
 from .coalescer import Coalescer, ReadRequest
 from .config import ServingConfig
@@ -159,6 +160,12 @@ class EcReadDispatcher:
             # and QoS must see it as overload too (breaker + shed series),
             # not as the success admit() pre-approved
             stats.VOLUME_SERVER_EC_BATCH_FALLBACK.inc()
+            # flight recorder: the raw saturation decision (also visible
+            # when -ec.qos.disable leaves no QoS layer to record it)
+            obs_incident.record(
+                "dispatch_saturated", vid=vid, tier=tier,
+                queue_depth=len(self.coalescer),
+            )
             if cfg.qos:
                 self.qos.saturated(tier)
             self._route("native", origin)

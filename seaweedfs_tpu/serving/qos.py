@@ -35,6 +35,7 @@ from dataclasses import dataclass
 from typing import TYPE_CHECKING, Callable
 
 from .. import stats
+from ..obs import incident as obs_incident
 
 if TYPE_CHECKING:
     from .config import ServingConfig
@@ -192,6 +193,15 @@ class QosController:
         pol = self.policies[tier]
         br = self._breakers[tier]
         if br.state != self._published_state[tier]:
+            # breaker TRANSITION: the gauge flip doubles as the flight
+            # recorder's moment — "when exactly did the front door trip"
+            # is the first question an incident bundle answers
+            names = ("closed", "half_open", "open")
+            prev = self._published_state[tier]
+            obs_incident.record(
+                "qos_breaker", tier=tier, state=names[br.state],
+                prev=names[prev] if 0 <= prev < len(names) else "unset",
+            )
             self._published_state[tier] = br.state
             stats.VOLUME_SERVER_EC_QOS_BREAKER_STATE.labels(tier=tier).set(
                 br.state
@@ -200,6 +210,9 @@ class QosController:
             stats.VOLUME_SERVER_EC_QOS_SHED.labels(
                 tier=tier, reason=SHED_BREAKER_OPEN
             ).inc()
+            obs_incident.record(
+                "qos_shed", tier=tier, reason=SHED_BREAKER_OPEN
+            )
             return SHED_BREAKER_OPEN
         reason = None
         if self._queued[tier] >= pol.queue_budget:
@@ -215,6 +228,10 @@ class QosController:
             stats.VOLUME_SERVER_EC_QOS_SHED.labels(
                 tier=tier, reason=reason
             ).inc()
+            obs_incident.record(
+                "qos_shed", tier=tier, reason=reason,
+                queue_depth=queue_depth,
+            )
             return reason
         return None
 
@@ -227,6 +244,10 @@ class QosController:
         stats.VOLUME_SERVER_EC_QOS_SHED.labels(
             tier=tier, reason=SHED_QUEUE_BUDGET
         ).inc()
+        obs_incident.record(
+            "qos_shed", tier=tier, reason=SHED_QUEUE_BUDGET,
+            saturated=True,
+        )
 
     # ----------------------------------------------------------- accounting
 

@@ -44,6 +44,7 @@ from typing import TYPE_CHECKING, Callable
 
 import numpy as np
 
+from ..obs import incident as obs_incident
 from ..stats import metrics as stats_metrics
 from .qos import BULK
 
@@ -442,6 +443,9 @@ class TieringController:
         stats_metrics.VOLUME_SERVER_EC_TIER_PROMOTIONS.labels(
             tier=TIER_HBM
         ).inc()
+        obs_incident.record(
+            "tier_promote", vid=ev.id, tier=TIER_HBM, shards=n
+        )
         return True
 
     def _demote_hbm(self, ev, stage: bool = True) -> None:
@@ -462,6 +466,9 @@ class TieringController:
         stats_metrics.VOLUME_SERVER_EC_TIER_DEMOTIONS.labels(
             tier=TIER_HBM
         ).inc()
+        obs_incident.record(
+            "tier_demote", vid=ev.id, tier=TIER_HBM, staged_host=stage
+        )
 
     def _stage_host(self, ev) -> bool:
         hc = self.host_cache
@@ -492,6 +499,9 @@ class TieringController:
             stats_metrics.VOLUME_SERVER_EC_TIER_PROMOTIONS.labels(
                 tier=TIER_HOST
             ).inc()
+            obs_incident.record(
+                "tier_promote", vid=ev.id, tier=TIER_HOST
+            )
             return True
         return False
 
@@ -501,6 +511,7 @@ class TieringController:
             stats_metrics.VOLUME_SERVER_EC_TIER_DEMOTIONS.labels(
                 tier=TIER_HOST
             ).inc()
+            obs_incident.record("tier_demote", vid=vid, tier=TIER_HOST)
 
     # ---------------------------------------------------------- rebalance
 

@@ -154,6 +154,109 @@ async def cmd_cluster_health(env, args):
             )
 
 
+@command("cluster.slo")
+async def cmd_cluster_slo(env, args):
+    """[-json] : declared SLOs and their live burn state from the
+    master's SLO engine — per-objective fast/slow burn rates, budget
+    remaining, violation counts, and the latency objective's windowed
+    p99 estimate (obs/slo.py)"""
+    flags = parse_flags(args)
+    health = await fetch_cluster_health(env)
+    slo = health.get("slo") or {}
+    if "json" in flags:
+        env.write(json.dumps(slo, indent=2, sort_keys=True))
+        return
+    objectives = slo.get("objectives") or {}
+    if not slo.get("enabled", False) or not objectives:
+        env.write(
+            "no SLOs declared (set -obs.slo.readP99Ms / "
+            "-obs.slo.errorRatePct / -obs.slo.timeToHealthySeconds / "
+            "-obs.slo.breakerOpenPct on the master)"
+        )
+        return
+    env.write(
+        f"windows: fast={slo['fast_window_seconds']:.0f}s "
+        f"slow={slo['slow_window_seconds']:.0f}s "
+        f"threshold={slo['burn_threshold']}"
+    )
+    env.write(
+        "  {:<16} {:>10} {:>10} {:>10} {:>8} {:>10} {:>6}".format(
+            "slo", "target", "fast_burn", "slow_burn", "budget",
+            "violations", "state"
+        )
+    )
+    for name, o in objectives.items():
+        target = o["target"]
+        target_s = (
+            f"{target * 1e3:.1f}ms" if name == "read_p99"
+            else f"{target:.0f}s" if name == "time_to_healthy"
+            else f"{target * 100:.2f}%"
+        )
+        env.write(
+            "  {:<16} {:>10} {:>10.2f} {:>10.2f} {:>7.0%} {:>10} {:>6}".format(
+                name, target_s, o["fast_burn"], o["slow_burn"],
+                o["budget_remaining"], o["violations_total"],
+                "BURN" if o["violating"] else "ok",
+            )
+        )
+        if name == "read_p99" and o.get("window_p99_seconds") is not None:
+            overflow = o.get("window_p99_overflow", 0)
+            env.write(
+                f"    window p99 ~{o['window_p99_seconds'] * 1e3:.2f}ms "
+                f"(stage {o['stage']}"
+                + (f"; +{overflow} overflow — estimate is a floor"
+                   if overflow else "")
+                + ")"
+            )
+
+
+@command("cluster.incident.dump")
+async def cmd_cluster_incident_dump(env, args):
+    """[-window <seconds>] [-json] : snapshot the cluster's flight
+    recorders + trace rings into one incident bundle on the master
+    (same fan-out an SLO violation triggers; needs -obs.incident.dir)"""
+    import aiohttp
+
+    flags = parse_flags(args)
+    url = (
+        f"http://{server_address.http_address(env.masters[0])}"
+        "/cluster/incident/dump"
+    )
+    params = {}
+    if flags.get("window"):
+        params["window"] = flags["window"]
+    async with aiohttp.ClientSession() as sess:
+        async with sess.post(
+            url, params=params, allow_redirects=True
+        ) as r:
+            payload = await r.json()
+            if r.status != 200:
+                raise ValueError(
+                    payload.get("error", f"{url} returned HTTP {r.status}")
+                )
+    if "json" in flags:
+        env.write(json.dumps(payload, indent=2, sort_keys=True))
+        return
+    corr = payload.get("correlation", {})
+    env.write(f"incident bundle written: {payload['path']}")
+    env.write(
+        f"  nodes snapshotted: {len(payload.get('nodes', []))} "
+        f"({corr.get('nodes_with_data', 0)} with data)"
+    )
+    multi = corr.get("trace_ids_multi_node", [])
+    env.write(
+        f"  trace ids seen on 2+ nodes: {len(multi)}"
+        + (f" (e.g. {multi[0]})" if multi else "")
+    )
+    prof = payload.get("profile")
+    if prof:
+        env.write(
+            f"  device profile: "
+            + (prof.get("trace_dir") or f"failed ({prof.get('error')})")
+            + f" on {prof.get('node')}"
+        )
+
+
 @command("cluster.check")
 async def cmd_cluster_check(env, args):
     """sanity-check cluster connectivity (master + every volume server)"""

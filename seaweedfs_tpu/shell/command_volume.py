@@ -818,9 +818,13 @@ async def cmd_volume_configure_replication(env, args):
 
 @command("volume.device.status")
 async def cmd_volume_device_status(env, args):
-    """[-node <host:port>] : per-node device shard-cache status from the
-    master's telemetry plane — HBM used/budget/headroom, resident shard
-    counts per EC volume, compile-cache hit/miss, evictions, pin claims"""
+    """[-node <host:port>] [-hot [N]] : per-node device shard-cache
+    status from the master's telemetry plane — HBM used/budget/
+    headroom, resident shard counts per EC volume, compile-cache
+    hit/miss, evictions, pin claims.  -hot additionally fetches each
+    node's /debug/device/hot: the per-call-shape dispatch counters and
+    latency EWMAs, hottest first — "what shape is the device actually
+    spending its time in" as one command"""
     from .command_cluster import fetch_cluster_health, fmt_bytes
 
     flags = parse_flags(args)
@@ -834,6 +838,9 @@ async def cmd_volume_device_status(env, args):
                 f"{', '.join(sorted(nodes)) or 'none'})"
             )
         nodes = {want: nodes[want]}
+    hot_limit = 0
+    if "hot" in flags:
+        hot_limit = 10 if flags["hot"] == "true" else int(flags["hot"])
     for url, n in nodes.items():
         state = "STALE" if n["stale"] else "fresh"
         dev = n.get("device")
@@ -858,6 +865,44 @@ async def cmd_volume_device_status(env, args):
         )
         for vid, count in dev["resident_shards_by_volume"].items():
             env.write(f"  ec volume {vid}: {count} resident shards")
+        if hot_limit and not n["stale"]:
+            await _print_hot_shapes(env, url, hot_limit)
+
+
+async def _print_hot_shapes(env, url: str, limit: int) -> None:
+    """Fetch + print one node's /debug/device/hot view (the
+    rs_resident per-call-shape dispatch counters/latency EWMAs)."""
+    import aiohttp
+
+    try:
+        async with aiohttp.ClientSession() as sess:
+            async with sess.get(
+                f"http://{url}/debug/device/hot",
+                params={"limit": str(limit)},
+            ) as r:
+                if r.status != 200:
+                    raise ValueError(f"HTTP {r.status}")
+                payload = await r.json()
+    except Exception as e:  # noqa: BLE001 — one unreachable node must
+        # not kill the whole status sweep
+        env.write(f"  hot shapes: unavailable ({e})")
+        return
+    shapes = payload.get("shapes", [])
+    aot = payload.get("aot", {})
+    env.write(
+        f"  hot shapes (aot compiled={aot.get('compiled', 0)} "
+        f"pending={aot.get('pending', 0)} failed={aot.get('failed', 0)}):"
+    )
+    if not shapes:
+        env.write("    none dispatched yet")
+    for s in shapes:
+        env.write(
+            f"    {s['kernel']}{' g' + str(s['groups']) if s['groups'] > 1 else ''}"
+            f" fetch={s['fetch']} tile={s['tile']}"
+            f" count={s['count_bucket']}: {s['dispatches']} dispatches,"
+            f" ewma {s['ewma_ms']}ms,"
+            f" last {s['last_dispatch_age_s']}s ago"
+        )
 
 
 @command("volume.tier.status")
@@ -911,22 +956,28 @@ async def cmd_volume_tier_status(env, args):
 
 @command("volume.trace")
 async def cmd_volume_trace(env, args):
-    """-node <host:port> [-limit N] [-id <trace_id>] : fetch
-    /debug/traces from a running volume server and pretty-print the
-    recent request traces (trace id, per-span stage durations,
-    annotations) newest-first; -id fetches one trace instead of the ring"""
+    """-node <host:port> [-limit N] [-id <trace_id>] [-since <seconds>]
+    : fetch /debug/traces from a running volume server and pretty-print
+    the recent request traces (trace id, per-span stage durations,
+    annotations) newest-first; -id fetches one trace instead of the
+    ring, -since only traces still active in the last N seconds (the
+    burn window an incident bundle covers; a long-stalled request
+    finishing inside it counts) — both filter before the limit"""
     import aiohttp
 
     flags = parse_flags(args)
     node = flags.get("node") or flags.get("")
     if not node:
         raise ValueError(
-            "volume.trace -node <host:port(http)> [-limit N] [-id <trace_id>]"
+            "volume.trace -node <host:port(http)> [-limit N] "
+            "[-id <trace_id>] [-since <seconds>]"
         )
     limit = int(flags.get("limit", 10))
     params = {"limit": str(limit)}
     if flags.get("id"):
         params["id"] = flags["id"]
+    if flags.get("since"):
+        params["since"] = flags["since"]
     async with aiohttp.ClientSession() as sess:
         async with sess.get(
             f"http://{node}/debug/traces", params=params

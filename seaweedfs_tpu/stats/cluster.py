@@ -30,7 +30,7 @@ from collections.abc import Sequence
 from dataclasses import dataclass, field
 from typing import Any
 
-from prometheus_client import Gauge
+from prometheus_client import Counter, Gauge
 
 from .metrics import REGISTRY, STAGE_SECONDS_BUCKETS
 
@@ -118,6 +118,41 @@ CLUSTER_TIER_DEMOTIONS = Gauge(
     ["node"],
     registry=REGISTRY,
 )
+# SLO engine (obs/slo.py): declared objectives evaluated every
+# telemetry pulse with multi-window burn-rate alerting.  Burn rate =
+# (observed bad fraction over the window) / (budgeted bad fraction);
+# >= the threshold on BOTH windows = a violation, which also fires the
+# incident bundler.  Budget remaining is 1 - slow-window burn, clamped.
+CLUSTER_SLO_BURN_RATE = Gauge(
+    "SeaweedFS_cluster_slo_burn_rate",
+    "Error-budget burn rate per declared SLO and alert window (fast = "
+    "-obs.slo.fastWindowSeconds, slow = -obs.slo.slowWindowSeconds); "
+    "1.0 = burning exactly the budgeted rate, >= the threshold on both "
+    "windows fires a violation.",
+    ["slo", "window"],
+    registry=REGISTRY,
+)
+CLUSTER_SLO_BUDGET = Gauge(
+    "SeaweedFS_cluster_slo_budget_remaining",
+    "Fraction of the error budget left over the slow alert window per "
+    "declared SLO (1.0 = untouched, 0.0 = fully burned); refills on "
+    "its own as bad pulses age out of the window.",
+    ["slo"],
+    registry=REGISTRY,
+)
+CLUSTER_SLO_VIOLATIONS = Counter(
+    "SeaweedFS_cluster_slo_violations",
+    "SLO violations fired (rising edges only: fast AND slow burn "
+    "crossed the threshold together) — each one also triggers an "
+    "incident bundle when -obs.incident.dir is set.",
+    ["slo"],
+    registry=REGISTRY,
+)
+for _slo in ("read_p99", "error_rate", "time_to_healthy", "breaker_open"):
+    CLUSTER_SLO_BUDGET.labels(slo=_slo)
+    CLUSTER_SLO_VIOLATIONS.labels(slo=_slo)
+    for _w in ("fast", "slow"):
+        CLUSTER_SLO_BURN_RATE.labels(slo=_slo, window=_w)
 CLUSTER_STAGE_P50 = Gauge(
     "SeaweedFS_cluster_stage_p50_seconds",
     "Cluster-wide p50 estimate per serving stage, interpolated from the "
@@ -180,6 +215,10 @@ class NodeTelemetry:
     dispatcher_inflight: int = 0
     dispatcher_shed: int = 0
     qos_breaker_open: bool = False
+    # cumulative EC reads admitted / shed on this node — the master's
+    # error-rate SLO numerator & denominator (obs/slo.py)
+    ec_reads_total: int = 0
+    ec_reads_shed_total: int = 0
     overlap_fraction: float = 0.0
     ec_h2d_bytes: int = 0
     ec_d2h_bytes: int = 0
@@ -225,6 +264,8 @@ class NodeTelemetry:
                 "overlap_fraction": round(self.overlap_fraction, 3),
                 "h2d_bytes_total": self.ec_h2d_bytes,
                 "d2h_bytes_total": self.ec_d2h_bytes,
+                "ec_reads_total": self.ec_reads_total,
+                "ec_reads_shed_total": self.ec_reads_shed_total,
             }
             d["tiering"] = {
                 "hbm_volumes": self.tier_hbm_volumes,
@@ -306,6 +347,11 @@ class ClusterTelemetry:
             # getattr-guarded: pre-r16 servers lack the breaker field
             nt.qos_breaker_open = bool(
                 getattr(tel, "qos_breaker_open", False)
+            )
+            # getattr-guarded: pre-r17 servers lack the read counters
+            nt.ec_reads_total = int(getattr(tel, "ec_reads_total", 0))
+            nt.ec_reads_shed_total = int(
+                getattr(tel, "ec_reads_shed_total", 0)
             )
             # getattr-guarded: a pre-r09 volume server's telemetry pb
             # simply lacks the pipeline fields
@@ -463,6 +509,44 @@ class ClusterTelemetry:
                 and nt.qos_breaker_open
                 and not self._stale(nt, now)
             )
+
+    def fresh_node_urls(self, now: float | None = None) -> list[str]:
+        """Nodes inside the staleness window — the incident bundler's
+        fan-out targets (a stale node's HTTP endpoint is likely gone;
+        its last state is in the health doc the bundle embeds)."""
+        now = time.time() if now is None else now
+        with self._lock:
+            return sorted(
+                url for url, nt in self._nodes.items()
+                if not self._stale(nt, now)
+            )
+
+    def read_shed_totals(self) -> tuple[int, int]:
+        """(cumulative EC reads, cumulative sheds) summed over every
+        node with telemetry — the error-rate SLO's raw counters.  The
+        SLO engine diffs consecutive calls and clamps negative deltas
+        (a node restart resets its counters; a pruned node drops out of
+        the sum)."""
+        with self._lock:
+            return (
+                sum(
+                    nt.ec_reads_total for nt in self._nodes.values()
+                    if nt.has_payload
+                ),
+                sum(
+                    nt.ec_reads_shed_total for nt in self._nodes.values()
+                    if nt.has_payload
+                ),
+            )
+
+    def stage_buckets(self, stage: str) -> list[int] | None:
+        """Cumulative merged per-bucket counts for one stage (fixed
+        ladder + trailing +Inf overflow), or None before the first
+        digest — the latency SLO's raw histogram; the engine diffs
+        consecutive snapshots into per-pulse deltas."""
+        with self._lock:
+            rec = self._stages.get(stage)
+            return list(rec.buckets) if rec is not None else None
 
     def stage_quantile(self, stage: str, q: float) -> float | None:
         """Interpolated quantile estimate for one stage's merged digest

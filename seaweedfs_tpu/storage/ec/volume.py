@@ -12,6 +12,7 @@ store_ec.go:339-393).
 """
 from __future__ import annotations
 
+import contextvars
 import os
 import threading
 import time
@@ -507,10 +508,20 @@ class EcVolume:
                 if len(wave) == 1:
                     results = [(wave[0], remote_read(wave[0], off, size))]
                 else:
+                    # copy_context per wave: the SHARED pool's threads
+                    # don't inherit this worker's contextvars, so
+                    # without it the fan-out's VolumeEcShardRead RPCs
+                    # carry no trace id and the peers' entries never
+                    # correlate with the read's trace — exactly the
+                    # cross-node join the incident bundler exists for
+                    ctx = contextvars.copy_context()
                     results = list(zip(
                         wave,
                         _gather_pool().map(
-                            lambda s: remote_read(s, off, size), wave
+                            lambda s: ctx.copy().run(
+                                remote_read, s, off, size
+                            ),
+                            wave,
                         ),
                     ))
                 for sid, buf in results:

@@ -22,12 +22,14 @@ def test_headline_keys_are_the_contract():
         "load_headline",
         "tiering_headline",
         "repair_headline",
+        "incident_headline",
     )
 
 
 def test_order_result_puts_headline_keys_last():
     shuffled = {
         "repair_headline": {"healthy_within_slo": True},
+        "incident_headline": {"burn_detected": True},
         "serving_headline": {"device_wins": True},
         "metric": "rs_10_4_encode_blockdiag_pallas",
         "load_headline": {"qos_zero_copy_beats_pre": True},
@@ -105,12 +107,9 @@ def _bulky_result():
                 "pre_top_reads_per_s": 90.0,
                 "qos_zero_copy_top_reads_per_s": 200.0,
                 "qos_zero_copy_beats_pre": True,
-                "adversarial_pre_reads_per_s": 60.0,
-                "adversarial_qos_reads_per_s": 80.0,
                 "copy_bytes_pre": 786432,
                 "copy_bytes_zero_copy": 0,
                 "zero_copy_is_zero_copy": True,
-                "s3_reads_per_s": 100.0,
                 "s3_resident_route_reads": 32,
                 "s3_rides_resident_path": True,
                 "load_verified": True,
@@ -118,14 +117,11 @@ def _bulky_result():
             "tiering_headline": {
                 "oversubscribe": 4.0,
                 "tiering_beats_static": True,
-                "tiering_beats_static_strict": True,
-                "hot_volume_placement_ok": True,
                 "max_step_drop_frac": 0.053,
                 "no_cliff": True,
                 "tier_promotions": 14,
                 "tier_demotions": 12,
                 "host_tier_reads": 123456,
-                "timed_compile_misses": 0,
                 "promotion_stall_free": True,
                 "tier_verified": True,
                 "static_top_reads_per_s": 10423.5,
@@ -139,14 +135,25 @@ def _bulky_result():
                 "slo_s": 90.0,
                 "time_to_healthy_s": 2.961,
                 "healthy_within_slo": True,
-                "calm_p99_ms": 62.5,
-                "repair_era_p99_ms": 75.8,
                 "repair_p99_ratio": 1.21,
                 "p99_within_2x": True,
                 "reads_verified": True,
                 "zero_unrecoverable_reads": True,
                 "corrupt_repaired": True,
                 "repair_sheds_under_breaker": True,
+            },
+            # r17 incident-plane verdict, COMPACT like main() ships it
+            # (full numbers live in extra.incident_sweep): SLO burn
+            # detection under chaos, the correlated bundle, recorder
+            # overhead bounds
+            "incident_headline": {
+                "burn_detected": True,
+                "burn_within_pulses": True,
+                "bundle_written": True,
+                "cross_node_trace_correlation": True,
+                "profile_captured": True,
+                "recorder_overhead_pct": 0.4,
+                "recorder_overhead_ok": True,
             },
         }
     )
@@ -234,6 +241,24 @@ def test_archived_tail_carries_r15_tiering_verdicts():
         "tier_verified",
         "static_top_reads_per_s",
         "tiered_top_reads_per_s",
+    ):
+        assert f'"{key}"' in tail, f"{key} fell outside the archived tail"
+
+
+def test_archived_tail_carries_r17_incident_verdicts():
+    """The r17 incident-plane verdict keys — burn detected within the
+    pulse budget, bundle written with cross-node trace correlation plus
+    a device-profile capture, and the recorder's steady-state overhead
+    bound — must survive the 2000-char archive window."""
+    tail = json.dumps(_bulky_result())[-2000:]
+    for key in (
+        "burn_detected",
+        "burn_within_pulses",
+        "bundle_written",
+        "cross_node_trace_correlation",
+        "profile_captured",
+        "recorder_overhead_pct",
+        "recorder_overhead_ok",
     ):
         assert f'"{key}"' in tail, f"{key} fell outside the archived tail"
 

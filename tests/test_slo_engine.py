@@ -1,0 +1,309 @@
+"""Multi-window burn-rate math, table-driven (ISSUE r17 satellite):
+fast-window trip needs the slow-window confirm, recovery resets the
+budget, and +Inf overflow folds from r08 digest merges must not poison
+the latency estimates.  The engine is driven with a pinned clock and a
+stub telemetry plane so every window boundary is exact."""
+from __future__ import annotations
+
+import math
+
+import pytest
+
+from seaweedfs_tpu.obs import slo as slo_mod
+from seaweedfs_tpu.obs.slo import (
+    BurnWindow,
+    SloConfig,
+    SloEngine,
+    _bad_from_buckets,
+)
+from seaweedfs_tpu.stats.metrics import STAGE_SECONDS_BUCKETS
+
+N_BUCKETS = len(STAGE_SECONDS_BUCKETS) + 1
+
+
+class StubTelemetry:
+    """Just the three accessors the engine samples."""
+
+    def __init__(self):
+        self.buckets: dict[str, list[float]] = {}
+        self.reads = 0
+        self.sheds = 0
+        self.breakers = 0
+
+    def stage_buckets(self, stage):
+        b = self.buckets.get(stage)
+        return list(b) if b is not None else None
+
+    def read_shed_totals(self):
+        return self.reads, self.sheds
+
+    def breakers_open(self):
+        return self.breakers
+
+
+class StubRepair:
+    def __init__(self):
+        self.unhealthy_s: float | None = None
+
+    def unhealthy_for(self):
+        return self.unhealthy_s
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _bucket_at(seconds: float) -> int:
+    """Index of the ladder bucket containing `seconds`."""
+    for i, edge in enumerate(STAGE_SECONDS_BUCKETS):
+        if seconds <= edge:
+            return i
+    return N_BUCKETS - 1
+
+
+# ----------------------------------------------------------- BurnWindow
+
+
+def test_burn_window_table():
+    # (samples as (t, bad, total), window_s, budget, now, expect_burn)
+    cases = [
+        # no traffic -> no burn
+        ([], 60, 0.01, 100.0, 0.0),
+        # 1% bad at a 1% budget = burning exactly the budgeted rate
+        ([(95.0, 1, 100)], 60, 0.01, 100.0, 1.0),
+        # 5% bad at 1% budget = 5x
+        ([(95.0, 5, 100)], 60, 0.01, 100.0, 5.0),
+        # sample outside the window does not count
+        ([(30.0, 50, 100), (95.0, 0, 100)], 60, 0.01, 100.0, 0.0),
+        # split across samples inside the window
+        ([(70.0, 1, 100), (95.0, 1, 100)], 60, 0.01, 100.0, 1.0),
+    ]
+    for samples, window, budget, now, expect in cases:
+        w = BurnWindow(retain_seconds=600)
+        for t, bad, total in samples:
+            w.observe(t, bad, total)
+        assert w.burn(window, budget, now) == pytest.approx(expect), (
+            samples, window, budget,
+        )
+
+
+def test_burn_window_retention_drops_old_samples():
+    w = BurnWindow(retain_seconds=100)
+    w.observe(0.0, 10, 10)
+    w.observe(200.0, 0, 10)  # the t=0 sample is past retention
+    assert w.fractions(1000, 200.0) == (0.0, 10.0)
+
+
+# -------------------------------------------------- fast trip / slow confirm
+
+
+def _latency_engine(fast=60.0, slow=600.0, target_ms=1.0):
+    tel = StubTelemetry()
+    clock = Clock()
+    cfg = SloConfig(
+        read_p99_ms=target_ms, fast_window_seconds=fast,
+        slow_window_seconds=slow,
+    )
+    eng = SloEngine(cfg, tel, repair=None, clock=clock)
+    tel.buckets["batch_dispatch"] = [0.0] * N_BUCKETS
+    return eng, tel, clock
+
+
+def _pulse(eng, tel, clock, good=0, bad=0, dt=5.0):
+    """Advance one pulse: `good` observations in the fastest bucket,
+    `bad` in the +Inf overflow (slower than every edge)."""
+    clock.t += dt
+    tel.buckets["batch_dispatch"][0] += good
+    tel.buckets["batch_dispatch"][-1] += bad
+    return eng.evaluate()
+
+
+def test_fast_trip_needs_slow_confirm_then_fires():
+    # slow window = 3 pulses of history at dt=5: a single bad pulse
+    # trips the fast window immediately but the SLOW window must also
+    # cross the threshold before a violation fires
+    eng, tel, clock = _latency_engine(fast=5.0, slow=15.0)
+    _pulse(eng, tel, clock)  # baseline snapshot (no delta yet)
+    # lots of good traffic far beyond the budget: no violation
+    for _ in range(3):
+        assert _pulse(eng, tel, clock, good=1000) == []
+    spec = eng.specs[slo_mod.READ_P99]
+    assert spec.last_fast_burn == 0.0 and not spec.violating
+
+    # one heavily-bad pulse: fast window (one pulse wide) burns hard;
+    # the slow window still holds the 2 earlier good pulses, so the
+    # slow burn is diluted — but 100 bad / 2100 total = 4.8% >> 1%
+    # budget, so BOTH cross and the violation fires exactly once
+    fired = _pulse(eng, tel, clock, good=0, bad=100)
+    assert [v["slo"] for v in fired] == [slo_mod.READ_P99]
+    assert spec.violating and spec.violations_total == 1
+    assert spec.last_fast_burn >= spec.last_slow_burn > 1.0
+
+    # still burning: no RE-fire while the violation holds
+    assert _pulse(eng, tel, clock, bad=50) == []
+    assert spec.violations_total == 1
+
+
+def test_slow_window_dilution_blocks_the_fast_trip():
+    # same shape, but the bad pulse is small enough that the slow
+    # window's accumulated good traffic keeps slow burn under 1.0:
+    # fast trips, slow does NOT confirm, nothing fires
+    eng, tel, clock = _latency_engine(fast=5.0, slow=15.0)
+    _pulse(eng, tel, clock)
+    for _ in range(2):
+        _pulse(eng, tel, clock, good=10_000)
+    # 200 bad: fast window (this pulse + the boundary pulse) sees
+    # 200/10200 = 2% > 1% budget; slow sees 200/20200 = 0.99% < 1%
+    fired = _pulse(eng, tel, clock, good=0, bad=200)
+    spec = eng.specs[slo_mod.READ_P99]
+    assert fired == []
+    assert spec.last_fast_burn > 1.0  # the fast window IS burning
+    assert spec.last_slow_burn < 1.0  # ... but slow says blip
+    assert not spec.violating
+
+
+def test_recovery_resets_budget():
+    eng, tel, clock = _latency_engine(fast=5.0, slow=15.0)
+    _pulse(eng, tel, clock)
+    _pulse(eng, tel, clock, bad=100)
+    spec = eng.specs[slo_mod.READ_P99]
+    assert spec.violating
+    assert eng.status()["objectives"][slo_mod.READ_P99][
+        "budget_remaining"
+    ] == 0.0
+    # good pulses age the bad sample out of both windows: the
+    # violation clears and the budget refills to 1.0 on its own
+    for _ in range(4):
+        _pulse(eng, tel, clock, good=1000)
+    assert not spec.violating
+    doc = eng.status()["objectives"][slo_mod.READ_P99]
+    assert doc["budget_remaining"] == 1.0
+    assert doc["fast_burn"] == 0.0 and doc["slow_burn"] == 0.0
+    # the historical violation count survives recovery
+    assert doc["violations_total"] == 1
+
+
+# ------------------------------------------------------- overflow honesty
+
+
+def test_overflow_folds_do_not_poison_p99():
+    """r08 digest merges fold foreign ladders into the +Inf bucket; the
+    engine's windowed p99 estimate must stay finite (the last finite
+    edge, flagged as overflow), never inf/NaN."""
+    eng, tel, clock = _latency_engine(fast=5.0, slow=50.0)
+    _pulse(eng, tel, clock)
+    _pulse(eng, tel, clock, good=10, bad=10_000)  # overflow-dominated
+    p99, overflow = eng._window_p99()
+    assert p99 is not None and math.isfinite(p99)
+    assert p99 == pytest.approx(STAGE_SECONDS_BUCKETS[-1])
+    assert overflow == 10_000
+    doc = eng.status()["objectives"][slo_mod.READ_P99]
+    assert doc["window_p99_seconds"] == pytest.approx(
+        STAGE_SECONDS_BUCKETS[-1]
+    )
+    assert doc["window_p99_overflow"] == 10_000
+
+
+def test_bad_from_buckets_partial_and_overflow():
+    deltas = [0.0] * N_BUCKETS
+    # target exactly on a bucket edge: everything above is bad
+    t_idx = 5
+    target = STAGE_SECONDS_BUCKETS[t_idx]
+    deltas[t_idx] = 100.0  # bucket ENDING at the target: all good
+    deltas[t_idx + 1] = 40.0  # next bucket: all bad
+    deltas[-1] = 7.0  # overflow: all bad
+    bad, total = _bad_from_buckets(deltas, target)
+    assert total == 147.0
+    assert bad == pytest.approx(47.0)
+    # target mid-bucket: linear share of that bucket counts bad
+    lo, hi = STAGE_SECONDS_BUCKETS[3], STAGE_SECONDS_BUCKETS[4]
+    mid = lo + 0.25 * (hi - lo)
+    deltas2 = [0.0] * N_BUCKETS
+    deltas2[4] = 100.0  # the (lo, hi] bucket
+    bad2, total2 = _bad_from_buckets(deltas2, mid)
+    assert total2 == 100.0
+    assert bad2 == pytest.approx(75.0)
+    # empty pulse
+    assert _bad_from_buckets([0.0] * N_BUCKETS, 0.001) == (0.0, 0.0)
+
+
+def test_counter_reset_clamps_negative_deltas():
+    """A restarted volume server resets its cumulative read counters;
+    the per-pulse delta must clamp to 0, not burn the error budget."""
+    tel = StubTelemetry()
+    clock = Clock()
+    eng = SloEngine(
+        SloConfig(error_rate_pct=1.0, fast_window_seconds=5,
+                  slow_window_seconds=15),
+        tel, clock=clock,
+    )
+    tel.reads, tel.sheds = 1000, 500
+    eng.evaluate()  # baseline
+    tel.reads, tel.sheds = 100, 0  # restart: counters went backwards
+    clock.t += 5
+    assert eng.evaluate() == []
+    spec = eng.specs[slo_mod.ERROR_RATE]
+    assert spec.last_fast_burn == 0.0
+
+
+def test_error_rate_and_breaker_and_tth_objectives():
+    tel = StubTelemetry()
+    rep = StubRepair()
+    clock = Clock()
+    eng = SloEngine(
+        SloConfig(
+            error_rate_pct=1.0, breaker_open_pct=10.0,
+            time_to_healthy_seconds=30.0,
+            fast_window_seconds=5, slow_window_seconds=10,
+        ),
+        tel, repair=rep, clock=clock,
+    )
+    eng.evaluate()  # baselines
+    # 50% sheds vs a 1% budget, breakers open, repair 60s unhealthy:
+    # all three objectives burn on the next two pulses
+    tel.reads, tel.sheds = 1000, 500
+    tel.breakers = 2
+    rep.unhealthy_s = 60.0
+    clock.t += 5
+    fired1 = {v["slo"] for v in eng.evaluate()}
+    tel.reads, tel.sheds = 2000, 1000
+    clock.t += 5
+    fired2 = {v["slo"] for v in eng.evaluate()}
+    assert slo_mod.ERROR_RATE in fired1 | fired2
+    assert slo_mod.BREAKER_OPEN in fired1 | fired2
+    assert slo_mod.TIME_TO_HEALTHY in fired1 | fired2
+    # none of them is a latency SLO -> no profile capture gate
+    for spec in eng.specs.values():
+        assert spec.latency is False
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        SloConfig(read_p99_ms=-1).validated()
+    with pytest.raises(ValueError):
+        # a typo'd stage must fail loudly, not arm an objective that
+        # samples (0, 0) forever
+        SloConfig(read_p99_ms=5, read_stage="batch_dispach").validated()
+    with pytest.raises(ValueError):
+        # a target past the ladder's last finite edge would count
+        # IN-target reads (landing in +Inf) as violations
+        SloConfig(
+            read_p99_ms=STAGE_SECONDS_BUCKETS[-1] * 1e3 + 1
+        ).validated()
+    with pytest.raises(ValueError):
+        SloConfig(error_rate_pct=101).validated()
+    with pytest.raises(ValueError):
+        SloConfig(fast_window_seconds=0).validated()
+    with pytest.raises(ValueError):
+        SloConfig(
+            fast_window_seconds=60, slow_window_seconds=30
+        ).validated()
+    with pytest.raises(ValueError):
+        SloConfig(burn_threshold=0).validated()
+    # all-zero targets = engine with no specs = evaluate() no-ops
+    eng = SloEngine(SloConfig(), StubTelemetry())
+    assert eng.specs == {} and eng.evaluate() == []

@@ -33,13 +33,18 @@ _FLAG_RE = re.compile(
 # README table row: `| `-ec.foo` | ...`
 _README_ROW_RE = re.compile(r"^\|\s*`(-(?:ec|obs)\.[^`]+)`")
 
-# namespace -> config module (repo-relative) that must name each flag
+# namespace -> config module (repo-relative) that must name each flag.
+# Order matters: config_owner() returns the FIRST matching prefix, so
+# sub-namespaces with their own config module (-obs.slo.*,
+# -obs.incident.*) must precede their parent's catch-all entry.
 CONFIG_OWNERS: tuple[tuple[str, str], ...] = (
     ("-ec.serving.", "seaweedfs_tpu/serving/config.py"),
     ("-ec.qos.", "seaweedfs_tpu/serving/config.py"),
     ("-ec.tier.", "seaweedfs_tpu/serving/config.py"),
     ("-ec.repair.", "seaweedfs_tpu/repair/config.py"),
     ("-ec.bulk.", "seaweedfs_tpu/storage/ec/bulk.py"),
+    ("-obs.slo.", "seaweedfs_tpu/obs/slo.py"),
+    ("-obs.incident.", "seaweedfs_tpu/obs/incident.py"),
     ("-obs.", "seaweedfs_tpu/obs/config.py"),
 )
 
