@@ -213,6 +213,36 @@ def add_args(p) -> None:
         help="QoS weight of bulk-tier reads in the heat signal, so "
         "background scans cannot evict the interactive hot set",
     )
+    # tail-tolerant RPC plane (utils/faultpolicy.py): deadline budgets,
+    # hedged shard gathers, per-peer retry budgets
+    p.add_argument(
+        "-ec.rpc.deadlineMs", dest="ec_rpc_deadline_ms", type=int,
+        default=30000,
+        help="default deadline budget stamped on requests arriving "
+        "without an X-Seaweed-Deadline-Ms header; every cross-node hop "
+        "subtracts elapsed time and refuses doomed work (0 = no "
+        "default stamp)",
+    )
+    p.add_argument(
+        "-ec.rpc.hedgeQuantile", dest="ec_rpc_hedge_quantile", type=float,
+        default=0.95,
+        help="per-peer latency EWMA quantile a survivor-shard fetch "
+        "must exceed before a hedge is armed to a spare parity holder",
+    )
+    p.add_argument(
+        "-ec.rpc.hedgeBudgetPct", dest="ec_rpc_hedge_budget_pct",
+        type=float, default=10.0,
+        help="hedge token budget as a percentage of primary fetches — "
+        "hedging can add at most this much cluster load (0 disables "
+        "hedging)",
+    )
+    p.add_argument(
+        "-ec.rpc.retryBudgetPct", dest="ec_rpc_retry_budget_pct",
+        type=float, default=10.0,
+        help="per-peer retry token budget as a percentage of first "
+        "attempts — a sick peer degrades into fast-fail instead of a "
+        "retry storm (0 disables retries)",
+    )
     p.add_argument(
         "-ec.scrub.megakernel.disable", dest="ec_scrub_megakernel_disable",
         action="store_true",
@@ -294,6 +324,16 @@ async def run(args) -> None:
             overlap=not args.ec_bulk_overlap_disable,
             prefetch=args.ec_bulk_prefetch,
             stride=args.ec_bulk_stride_mb << 20,
+        )
+    )
+    from ..utils import faultpolicy
+
+    faultpolicy.configure(
+        faultpolicy.FaultPolicyConfig(
+            deadline_ms=args.ec_rpc_deadline_ms,
+            hedge_quantile=args.ec_rpc_hedge_quantile,
+            hedge_budget_pct=args.ec_rpc_hedge_budget_pct,
+            retry_budget_pct=args.ec_rpc_retry_budget_pct,
         )
     )
 

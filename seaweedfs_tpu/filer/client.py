@@ -17,7 +17,8 @@ async def list_all_entries(stub, directory: str) -> list[filer_pb2.Entry]:
         async for resp in stub.ListEntries(
             filer_pb2.ListEntriesRequest(
                 directory=directory, start_from_file_name=last, limit=_PAGE
-            )
+            ),
+            timeout=60.0,  # one page off a healthy filer is ms (GL114)
         ):
             out.append(resp.entry)
             last = resp.entry.name

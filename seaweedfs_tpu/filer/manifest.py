@@ -65,7 +65,7 @@ async def fetch_chunk_via_lookup(stub, session, file_id: str) -> bytes:
     source, mounts, sinks)."""
     vid = file_id.split(",")[0]
     resp = await stub.LookupVolume(
-        filer_pb2.LookupVolumeRequest(volume_ids=[vid])
+        filer_pb2.LookupVolumeRequest(volume_ids=[vid]), timeout=10.0
     )
     locs = resp.locations_map.get(vid)
     if locs is None or not locs.locations:

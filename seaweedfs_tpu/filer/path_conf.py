@@ -92,7 +92,8 @@ async def save_conf_entry(stub, directory: str, name: str, blob: bytes,
                     file_size=len(blob),
                 ),
             ),
-        )
+        ),
+        timeout=30.0,  # a small config write is one round-trip (GL114)
     )
     if resp.error:
         raise ValueError(resp.error)

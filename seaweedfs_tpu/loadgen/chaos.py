@@ -16,11 +16,19 @@ the cluster underneath it, in the four ways production does:
   * `corrupt_shard` — flips bytes inside an .ecNN shard file on disk
     (and drops any device-cache copy so reads/scrubs see the disk),
     the bit-rot the scrub verdict plane exists for.
+  * NETWORK gray failures (r18, the faults the tail-tolerant RPC plane
+    exists to survive): `hang_shard_reads` — the peer accepts a
+    VolumeEcShardRead then never answers; `stall_shard_reads` — it
+    answers N chunks then stops mid-stream; `delay_shard_reads` —
+    fixed added latency before the first byte (a tail-slow peer, not a
+    dead one); `flaky_shard_reads` — a fraction of calls fail
+    UNAVAILABLE immediately (the flaky-dial model).
 
-`run_with_faults` executes a LoadScenario's kill_at/revive_at schedule
-NEXT TO any awaitable load, so the chaos sweep and plain churn share
-one workload model (the satellite fix: churn alone could not express a
-server that dies and stays dead mid-sweep).
+`run_with_faults` executes a LoadScenario's COMPOSED fault schedule
+(`fault_schedule()`: the kill_at/revive_at pair merged with the
+`faults` list, so hang + slow-disk + partition can ride one scenario)
+NEXT TO any awaitable load — the chaos sweeps and plain churn share
+one workload model.
 """
 from __future__ import annotations
 
@@ -101,20 +109,73 @@ class ChaosInjector:
         self._note(f"corrupt_shard {vid}.{shard_id}", idx)
         return path
 
+    # -- network gray failures (r18) -----------------------------------
+
+    def hang_shard_reads(self, idx: int, on: bool = True) -> None:
+        """Peer-hang: the server accepts VolumeEcShardRead RPCs and
+        never answers — the fault only a caller-side timeout survives."""
+        self.volume_server(idx).fault_shard_read_hang = bool(on)
+        self._note("hang_shard_reads" if on else "unhang_shard_reads", idx)
+
+    def stall_shard_reads(self, idx: int, after_chunks: int | None = 0) -> None:
+        """Mid-stream stall: answer `after_chunks` 1MB chunks then stop
+        (None restores normal streaming)."""
+        self.volume_server(idx).fault_shard_read_stall_after = (
+            None if after_chunks is None else int(after_chunks)
+        )
+        self._note(f"stall_shard_reads={after_chunks}", idx)
+
+    def delay_shard_reads(self, idx: int, seconds: float) -> None:
+        """Fixed added latency on the shard-read RPC (0 restores) — the
+        tail-slow peer the hedged gather routes around."""
+        self.volume_server(idx).fault_shard_read_delay_s = float(seconds)
+        self._note(f"delay_shard_reads={seconds}", idx)
+
+    def flaky_shard_reads(self, idx: int, fail_pct: float) -> None:
+        """Probability [0,1] a shard-read RPC fails UNAVAILABLE
+        immediately — the flaky-dial model the retry budget meters."""
+        self.volume_server(idx).fault_shard_read_fail_pct = float(fail_pct)
+        self._note(f"flaky_shard_reads={fail_pct}", idx)
+
+    async def apply(self, action: str, **kwargs) -> None:
+        """Dispatch one named fault action (the composed-schedule entry
+        point).  An absent `idx` is filled by the caller before this."""
+        handlers = {
+            "kill": self.kill_volume_server,
+            "revive": self.revive_volume_server,
+            "partition": self.partition_heartbeats,
+            "heal_partition":
+                lambda idx: self.partition_heartbeats(idx, False),
+            "slow_disk": self.slow_disk,
+            "hang_shard_reads": self.hang_shard_reads,
+            "stall_shard_reads": self.stall_shard_reads,
+            "delay_shard_reads": self.delay_shard_reads,
+            "flaky_shard_reads": self.flaky_shard_reads,
+            "corrupt_shard": self.corrupt_shard,
+        }
+        fn = handlers.get(action)
+        if fn is None:
+            raise ValueError(f"unknown fault action {action!r}")
+        r = fn(**kwargs)
+        if asyncio.iscoroutine(r):
+            await r
+
     async def run_with_faults(
         self, load: asyncio.Future | asyncio.Task, scenario: LoadScenario
     ) -> None:
-        """Execute the scenario's kill_at/revive_at schedule against
-        `fault_target` while `load` runs; waits for the load to finish
-        and re-raises its failure.  The schedule clock starts NOW (the
-        caller starts the load immediately before)."""
+        """Execute the scenario's COMPOSED fault schedule
+        (`fault_schedule()`) while `load` runs; waits for the load to
+        finish and re-raises its failure.  The schedule clock starts
+        NOW (the caller starts the load immediately before).  Actions
+        taking a server index default it to `scenario.fault_target`;
+        `slow_disk` takes none."""
         t0 = time.monotonic()
-        for at, action in scenario.fault_events():
+        for at, action, kwargs in scenario.fault_schedule():
             delay = at - (time.monotonic() - t0)
             if delay > 0:
                 await asyncio.sleep(delay)
-            if action == "kill":
-                await self.kill_volume_server(scenario.fault_target)
-            else:
-                await self.revive_volume_server(scenario.fault_target)
+            kw = dict(kwargs)
+            if action != "slow_disk" and "idx" not in kw:
+                kw["idx"] = scenario.fault_target
+            await self.apply(action, **kw)
         await load

@@ -58,11 +58,22 @@ class LoadScenario:
     kill_at: float | None = None
     revive_at: float | None = None
     fault_target: int = 0
+    # COMPOSABLE fault schedule (r18): arbitrary injector actions next
+    # to (or instead of) the kill/revive pair, so one scenario can
+    # express hang + slow-disk + partition together.  Each entry is
+    # (seconds_into_sweep, action, kwargs); `action` names a
+    # ChaosInjector verb ("kill", "revive", "partition",
+    # "heal_partition", "slow_disk", "hang_shard_reads",
+    # "stall_shard_reads", "delay_shard_reads", "flaky_shard_reads",
+    # "corrupt_shard"), kwargs are passed through (an absent "idx"
+    # defaults to `fault_target`).  Executed by
+    # loadgen/chaos.py run_with_faults.
+    faults: list = field(default_factory=list)
     # populated by callers that know the key->volume mapping
     extra: dict = field(default_factory=dict)
 
     def fault_events(self) -> list[tuple[float, str]]:
-        """The validated schedule: sorted [(seconds_into_sweep,
+        """The validated kill/revive pair: sorted [(seconds_into_sweep,
         "kill"|"revive")].  Empty when no fault is scheduled."""
         if self.kill_at is None:
             if self.revive_at is not None:
@@ -75,6 +86,28 @@ class LoadScenario:
             if self.revive_at <= self.kill_at:
                 raise ValueError("revive_at must be > kill_at")
             events.append((float(self.revive_at), "revive"))
+        return events
+
+    def fault_schedule(self) -> list[tuple[float, str, dict]]:
+        """The FULL composed schedule: the kill/revive pair merged with
+        `faults`, validated and time-sorted — what run_with_faults
+        executes.  Stable under ties: same-time events run in the order
+        they were declared."""
+        events: list[tuple[float, str, dict]] = [
+            (at, action, {}) for at, action in self.fault_events()
+        ]
+        for entry in self.faults:
+            if len(entry) == 2:
+                at, action = entry
+                kwargs: dict = {}
+            else:
+                at, action, kwargs = entry
+            if at < 0:
+                raise ValueError(f"fault at {at} must be >= 0")
+            if not isinstance(kwargs, dict):
+                raise ValueError(f"fault kwargs must be a dict: {entry!r}")
+            events.append((float(at), str(action), dict(kwargs)))
+        events.sort(key=lambda e: e[0])
         return events
 
 

@@ -31,6 +31,11 @@ from .pages import CHUNK_SIZE, MAX_RESIDENT, DirtyPages
 
 log = logging.getLogger("mount")
 
+# per-call bound for the mount's filer metadata RPCs: one entry op is a
+# metadata round-trip; finite always so a hung filer surfaces as EIO
+# after the retry budget instead of a wedged kernel VFS op (GL114)
+_GRPC_TIMEOUT_S = 30.0
+
 GETATTR_IN = struct.Struct("<IIQ")
 SETATTR_IN = struct.Struct("<IIQQQQQQIIIIIIII")
 OPEN_IN = struct.Struct("<II")
@@ -190,6 +195,10 @@ class WeedFS:
         since = time.time_ns() - 60_000_000_000
         while True:
             try:
+                # graftlint: allow(unbounded-rpc): the metadata
+                # subscription is a deliberately long-lived stream; a
+                # broken/hung filer surfaces as a reconnect in the
+                # while-loop around it
                 async for ev in self._stub().SubscribeMetadata(
                     filer_pb2.SubscribeMetadataRequest(
                         client_name="mount",
@@ -233,7 +242,8 @@ class WeedFS:
             resp = await self._stub().LookupDirectoryEntry(
                 filer_pb2.LookupDirectoryEntryRequest(
                     directory=d or "/", name=name
-                )
+                ),
+                timeout=_GRPC_TIMEOUT_S,
             )
         except grpc.aio.AioRpcError as e:
             if e.code() == grpc.StatusCode.NOT_FOUND:
@@ -374,7 +384,8 @@ class WeedFS:
     async def statfs(self, nodeid: int, body: bytes, **kw) -> bytes:
         try:
             resp = await self._stub().Statistics(
-                filer_pb2.StatisticsRequest(replication="", collection="", ttl="")
+                filer_pb2.StatisticsRequest(replication="", collection="", ttl=""),
+                timeout=_GRPC_TIMEOUT_S,
             )
             total, used = resp.total_size, resp.used_size
             files = resp.file_count
@@ -471,7 +482,8 @@ class WeedFS:
                         uid=uid, gid=gid,
                     ),
                 ),
-            )
+            ),
+            timeout=_GRPC_TIMEOUT_S,
         )
         if resp.error:
             raise fk.FuseError(errno.EEXIST)
@@ -502,7 +514,8 @@ class WeedFS:
             filer_pb2.DeleteEntryRequest(
                 directory=directory, name=name, is_delete_data=True,
                 is_recursive=recursive, ignore_recursive_error=recursive,
-            )
+            ),
+            timeout=_GRPC_TIMEOUT_S,
         )
         if resp.error:
             raise fk.FuseError(errno.ENOENT)
@@ -530,7 +543,8 @@ class WeedFS:
             filer_pb2.AtomicRenameEntryRequest(
                 old_directory=old_dir, old_name=oldname.decode(),
                 new_directory=new_dir, new_name=newname.decode(),
-            )
+            ),
+            timeout=_GRPC_TIMEOUT_S,
         )
         old_path = (old_dir.rstrip("/") or "") + "/" + oldname.decode()
         new_path = (new_dir.rstrip("/") or "") + "/" + newname.decode()
@@ -567,7 +581,8 @@ class WeedFS:
                         uid=uid, gid=gid, symlink_target=target.decode(),
                     ),
                 ),
-            )
+            ),
+            timeout=_GRPC_TIMEOUT_S,
         )
         path = (parent.rstrip("/") or "") + "/" + name.decode()
         self.meta.invalidate(path)
@@ -578,7 +593,8 @@ class WeedFS:
     async def _update_entry(self, path: str, entry) -> None:
         d, _, _n = path.rpartition("/")
         await self._stub().UpdateEntry(
-            filer_pb2.UpdateEntryRequest(directory=d or "/", entry=entry)
+            filer_pb2.UpdateEntryRequest(directory=d or "/", entry=entry),
+            timeout=_GRPC_TIMEOUT_S,
         )
         self.meta.invalidate(path)
 
@@ -605,7 +621,8 @@ class WeedFS:
         resp = await self._stub().CreateEntry(
             filer_pb2.CreateEntryRequest(
                 directory=new_parent, entry=new_entry
-            )
+            ),
+            timeout=_GRPC_TIMEOUT_S,
         )
         if resp.error:
             raise fk.FuseError(errno.EEXIST)
@@ -741,7 +758,8 @@ class WeedFS:
         from ..operation.upload import upload_data
 
         a = await self._stub().AssignVolume(
-            filer_pb2.AssignVolumeRequest(count=1)
+            filer_pb2.AssignVolumeRequest(count=1),
+            timeout=_GRPC_TIMEOUT_S,
         )
         if a.error:
             log.warning("assign failed: %s", a.error)
@@ -894,7 +912,15 @@ class WeedFS:
     _MIN_PROGRESS_BPS = 256 * 1024
 
     def _stall_budget(self, nbytes: int) -> float:
-        return self._BUDGET_FLOOR_S + nbytes / self._MIN_PROGRESS_BPS
+        """Per-attempt wall budget for one transfer, capped by the
+        remaining request deadline when one is ambient
+        (utils/faultpolicy.py): a FUSE op serving a budgeted caller must
+        not outlive that budget on a dribbling peer."""
+        from ..utils import faultpolicy
+
+        budget = self._BUDGET_FLOOR_S + nbytes / self._MIN_PROGRESS_BPS
+        rem = faultpolicy.remaining_s()
+        return budget if rem is None else max(1e-3, min(budget, rem))
 
     async def _retry_http(self, what: str, path: str, attempt):
         """Run `attempt()` up to _RETRIES times.  attempt() raises
@@ -915,8 +941,13 @@ class WeedFS:
                 await asyncio.sleep(0.2 * (i + 1))
 
     async def _read_range(self, path: str, offset: int, size: int) -> bytes:
+        from ..utils import faultpolicy
+
         sess = await self._sess()
         hdr = {"Range": f"bytes={offset}-{offset + size - 1}"} if size else {}
+        # propagate any ambient deadline budget to the filer hop so the
+        # whole chain subtracts from one budget
+        hdr.update(faultpolicy.outbound_headers())
         # a dribbling response (one byte per 50s) would block the kernel
         # VFS read indefinitely under sock_read alone
         budget = self._stall_budget(size)

@@ -472,8 +472,15 @@ _UNTRACED_PATHS = ("/metrics", "/status")
 
 def middleware(role: str, server: str = ""):
     """aiohttp middleware: adopt/start a trace for every inbound data
-    request, echo the trace id on the response, finish into the ring."""
+    request, echo the trace id on the response, finish into the ring.
+    Also the deadline front door (utils/faultpolicy.py): the request's
+    X-Seaweed-Deadline-Ms budget is adopted — or the configured default
+    stamped — for the handler's duration, so every outbound hop below
+    subtracts from one continuous budget; a spent budget surfaces as
+    504, the honest verdict for work the client already gave up on."""
     from aiohttp import web
+
+    from ..utils import faultpolicy
 
     @web.middleware
     async def trace_middleware(request, handler):
@@ -487,7 +494,8 @@ def middleware(role: str, server: str = ""):
         )
         status = ""
         try:
-            resp = await handler(request)
+            with faultpolicy.request_scope(request.headers):
+                resp = await handler(request)
             status = resp.status
             stamp_trace_header(resp, t)
             return resp
@@ -495,6 +503,14 @@ def middleware(role: str, server: str = ""):
             status = e.status
             stamp_trace_header(e, t)
             raise
+        except faultpolicy.DeadlineExceeded as e:
+            status = 504
+            timeout = web.HTTPGatewayTimeout(text=str(e))
+            # deadline sheds are exactly the responses an operator
+            # wants to correlate — echo the trace id like every other
+            # exit path
+            stamp_trace_header(timeout, t)
+            raise timeout
         except Exception:
             status = 500
             raise

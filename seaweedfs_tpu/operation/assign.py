@@ -39,7 +39,8 @@ async def assign(
             ttl=ttl,
             data_center=data_center,
             disk_type=disk_type,
-        )
+        ),
+        timeout=10.0,  # an assign is a metadata round-trip (GL114)
     )
     if resp.error:
         raise RuntimeError(f"assign failed: {resp.error}")

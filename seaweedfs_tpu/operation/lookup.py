@@ -12,7 +12,8 @@ async def lookup_volume_ids(
     resp = await stub.LookupVolume(
         master_pb2.LookupVolumeRequest(
             volume_or_file_ids=[str(v) for v in vids], collection=collection
-        )
+        ),
+        timeout=10.0,
     )
     out: dict[str, list[dict]] = {}
     for e in resp.volume_id_locations:
@@ -37,7 +38,8 @@ async def lookup_file_id_with_auth(master: str, fid: str) -> tuple[list[str], st
     reference master_grpc_server_volume.go)."""
     stub = Stub(channel(server_address.grpc_address(master)), master_pb2, "Seaweed")
     resp = await stub.LookupVolume(
-        master_pb2.LookupVolumeRequest(volume_or_file_ids=[fid])
+        master_pb2.LookupVolumeRequest(volume_or_file_ids=[fid]),
+        timeout=10.0,
     )
     entry = resp.volume_id_locations[0]
     if entry.error:

@@ -30,6 +30,9 @@ async def tail_volume_from_source(
     )
     body = bytearray()
     last_ns = since_ns
+    # graftlint: allow(unbounded-rpc): tailing a growing volume is a
+    # deliberately long-lived stream; the server's idle_timeout_seconds
+    # bounds a silent peer, and callers own the overall lifetime
     async for resp in stub.VolumeTailSender(
         volume_server_pb2.VolumeTailSenderRequest(
             volume_id=vid,

@@ -49,21 +49,42 @@ _SERVICE_ROLES = {
 
 
 def _trace_wrap_call(call):
-    """Attach the active trace id as gRPC metadata on every outbound RPC
-    (obs/trace.py contextvar) — fan-out propagation without touching any
-    call site.  Explicit caller metadata wins; untraced contexts add
-    nothing."""
+    """Attach the active trace id AND the remaining deadline budget as
+    gRPC metadata on every outbound RPC (obs/trace.py +
+    utils/faultpolicy.py contextvars) — fan-out propagation without
+    touching any call site.  Explicit caller metadata wins; untraced /
+    budget-less contexts add nothing.  When a deadline scope is active
+    and the caller passed no explicit `timeout=`, the call gets a hard
+    per-call timeout equal to the remaining budget — one hung peer can
+    no longer outlive the request it serves.  Outside any scope the
+    stub adds no timeout (long-lived streams like SendHeartbeat /
+    KeepConnected must stay unbounded; bounded defaults are the call
+    sites' job, enforced by graftlint GL114)."""
 
     def invoke(request, **kw):
-        if "metadata" not in kw:
-            from ..obs import trace as obs_trace
+        from ..obs import trace as obs_trace
+        from ..utils import faultpolicy
 
-            md = obs_trace.grpc_metadata()
-            if md is not None:
+        if "metadata" not in kw:
+            md = (obs_trace.grpc_metadata() or ()) + (
+                faultpolicy.grpc_metadata() or ()
+            )
+            if md:
                 kw["metadata"] = md
+        if "timeout" not in kw:
+            rem = faultpolicy.remaining_s()
+            if rem is not None:
+                kw["timeout"] = max(rem, 1e-3)
         return call(request, **kw)
 
     return invoke
+
+
+def _inbound_metadata(context) -> dict:
+    try:
+        return dict(context.invocation_metadata() or ())
+    except Exception:  # noqa: BLE001 — context impl without metadata
+        return {}
 
 
 def _adopt_inbound_trace(context, role: str, method: str):
@@ -73,10 +94,7 @@ def _adopt_inbound_trace(context, role: str, method: str):
     (None, None) when the caller sent no trace id."""
     from ..obs import trace as obs_trace
 
-    try:
-        md = dict(context.invocation_metadata() or ())
-    except Exception:  # noqa: BLE001 — context impl without metadata
-        return None, None
+    md = _inbound_metadata(context)
     tid, psid = obs_trace.parse_trace_header(
         md.get(obs_trace.GRPC_TRACE_KEY, "")
     )
@@ -85,6 +103,18 @@ def _adopt_inbound_trace(context, role: str, method: str):
     return obs_trace.start_trace(
         f"grpc {method}", role, trace_id=tid, parent_span_id=psid
     )
+
+
+def _adopt_inbound_deadline(context):
+    """Adopt the caller's remaining deadline budget
+    (`x-seaweed-deadline` metadata, ms) as this handler's ambient
+    deadline — the subtract-as-you-hop half of budget propagation.
+    Returns a context manager (no-op when the caller sent none; a
+    default budget is never stamped here, so background streams stay
+    budget-free)."""
+    from ..utils import faultpolicy
+
+    return faultpolicy.adopt_scope_from_metadata(_inbound_metadata(context))
 
 
 def _trace_wrap_handler(fn, role: str, method: str):
@@ -99,8 +129,9 @@ def _trace_wrap_handler(fn, role: str, method: str):
             t, token = _adopt_inbound_trace(context, role, method)
             status = "OK"
             try:
-                async for item in fn(request, context):
-                    yield item
+                with _adopt_inbound_deadline(context):
+                    async for item in fn(request, context):
+                        yield item
             except BaseException:
                 status = "error"
                 raise
@@ -114,7 +145,8 @@ def _trace_wrap_handler(fn, role: str, method: str):
         t, token = _adopt_inbound_trace(context, role, method)
         status = "OK"
         try:
-            return await fn(request, context)
+            with _adopt_inbound_deadline(context):
+                return await fn(request, context)
         except BaseException:
             status = "error"
             raise

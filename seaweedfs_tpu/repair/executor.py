@@ -2,7 +2,8 @@
 
 This is the r10 `ec.rebuild` fan-out (shell/command_ec.py) driven by the
 master instead of a human: every borrowed shard set is pulled onto the
-rebuilder CONCURRENTLY (bounded, per-RPC retry/timeout via _retry_rpc),
+rebuilder CONCURRENTLY (bounded, per-RPC retry/timeout/budget via the
+shared utils/faultpolicy.retry_rpc),
 the missing shards are rebuilt in one VolumeEcShardsRebuild, and any
 excess above the rebuilder's fair share is re-spread with the same
 copy->mount->unmount->delete choreography `ec.encode` uses.
@@ -19,12 +20,12 @@ import math
 from ..pb import Stub, volume_server_pb2
 from ..pb.rpc import channel
 from ..shell.command_ec import (
-    _retry_rpc,
     ec_nodes_by_freeness,
     gather_ec_shards,
     node_shards,
     spread_ec_shards,
 )
+from ..utils.faultpolicy import retry_rpc
 from ..shell.command_env import TopoNode
 from ..storage.ec import TOTAL_SHARDS
 from .planner import RepairJob
@@ -36,9 +37,10 @@ BULK = "bulk"
 class BulkQosStub:
     """Stub proxy stamping every outbound RPC with the bulk QoS tier.
 
-    The underlying descriptor stub attaches the active trace id only
-    when no explicit metadata is passed, so this wrapper rebuilds the
-    merged metadata itself: caller's -> trace id -> the tier stamp."""
+    The underlying descriptor stub attaches the active trace id and
+    deadline budget only when no explicit metadata is passed, so this
+    wrapper rebuilds the merged metadata itself: caller's -> trace id
+    -> deadline budget -> the tier stamp."""
 
     def __init__(self, stub: Stub):
         self._stub = stub
@@ -49,10 +51,14 @@ class BulkQosStub:
         def invoke(request, **kw):
             md = list(kw.pop("metadata", ()) or ())
             from ..obs import trace as obs_trace
+            from ..utils import faultpolicy
 
             tmd = obs_trace.grpc_metadata()
             if tmd is not None:
                 md.extend(tmd)
+            dmd = faultpolicy.grpc_metadata()
+            if dmd is not None:
+                md.extend(dmd)
             md.append((QOS_METADATA_KEY, BULK))
             return call(request, metadata=tuple(md), **kw)
 
@@ -109,15 +115,16 @@ async def drop_corrupt_shards(
         if holder is None:
             continue  # the holder died since the verdict; already gone
         stub = env.volume_stub(holder.grpc_address)
-        await _retry_rpc(
+        await retry_rpc(
             lambda: stub.VolumeEcShardsUnmount(
                 volume_server_pb2.VolumeEcShardsUnmountRequest(
                     volume_id=job.vid, shard_ids=[sid]
                 )
             ),
             f"unmount corrupt shard {job.vid}.{sid} at {url}",
+            peer=holder.grpc_address,
         )
-        await _retry_rpc(
+        await retry_rpc(
             lambda: stub.VolumeEcShardsDelete(
                 volume_server_pb2.VolumeEcShardsDeleteRequest(
                     volume_id=job.vid, collection=job.collection,
@@ -125,6 +132,7 @@ async def drop_corrupt_shards(
                 )
             ),
             f"delete corrupt shard {job.vid}.{sid} at {url}",
+            peer=holder.grpc_address,
         )
         dropped.append(sid)
     return dropped
@@ -184,7 +192,7 @@ async def repair_volume(
         rescued = sorted(
             sid for sids in rescue_copy.values() for sid in sids
         )
-        await _retry_rpc(
+        await retry_rpc(
             lambda: stub.VolumeEcShardsMount(
                 volume_server_pb2.VolumeEcShardsMountRequest(
                     volume_id=job.vid, collection=job.collection,
@@ -192,6 +200,7 @@ async def repair_volume(
                 )
             ),
             f"mount rescued shards {rescued} of {job.vid}",
+            peer=rebuilder.grpc_address,
         )
         local = local | set(rescued)
     else:
@@ -208,17 +217,18 @@ async def repair_volume(
         await gather_ec_shards(
             stub, job.vid, job.collection, to_copy, concurrency=concurrency
         )
-    resp = await _retry_rpc(
+    resp = await retry_rpc(
         lambda: stub.VolumeEcShardsRebuild(
             volume_server_pb2.VolumeEcShardsRebuildRequest(
                 volume_id=job.vid, collection=job.collection
             )
         ),
         f"rebuild missing shards of {job.vid} on {rebuilder.url}",
+        peer=rebuilder.grpc_address,
     )
     rebuilt = sorted(resp.rebuilt_shard_ids)
     if rebuilt:
-        await _retry_rpc(
+        await retry_rpc(
             lambda: stub.VolumeEcShardsMount(
                 volume_server_pb2.VolumeEcShardsMountRequest(
                     volume_id=job.vid, collection=job.collection,
@@ -226,19 +236,21 @@ async def repair_volume(
                 )
             ),
             f"mount rebuilt shards {rebuilt} of {job.vid}",
+            peer=rebuilder.grpc_address,
         )
     # drop the shards borrowed only as rebuild input
     borrowed = [sid for sids in to_copy.values() for sid in sids]
     if borrowed:
-        await _retry_rpc(
+        await retry_rpc(
             lambda: stub.VolumeEcShardsUnmount(
                 volume_server_pb2.VolumeEcShardsUnmountRequest(
                     volume_id=job.vid, shard_ids=borrowed
                 )
             ),
             f"unmount borrowed shards of {job.vid}",
+            peer=rebuilder.grpc_address,
         )
-        await _retry_rpc(
+        await retry_rpc(
             lambda: stub.VolumeEcShardsDelete(
                 volume_server_pb2.VolumeEcShardsDeleteRequest(
                     volume_id=job.vid, collection=job.collection,
@@ -246,6 +258,7 @@ async def repair_volume(
                 )
             ),
             f"delete borrowed shards of {job.vid}",
+            peer=rebuilder.grpc_address,
         )
     # re-spread: the rebuilder now holds its prior shards + everything
     # rebuilt; anything beyond its fair share moves to the least-loaded

@@ -357,7 +357,10 @@ class RepairScheduler:
                 ).VolumeEcShardsVerify(
                     volume_server_pb2.VolumeEcShardsVerifyRequest(
                         volume_id=vid
-                    )
+                    ),
+                    # bounded: a hung scrub target must not wedge the
+                    # whole repair cycle (GL114)
+                    timeout=600.0,
                 )
             except Exception as e:  # noqa: BLE001 — a failed scrub is a
                 # skipped verdict, not a dead repair plane

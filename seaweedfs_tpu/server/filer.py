@@ -44,6 +44,7 @@ from ..filer import (
     view_from_chunks,
 )
 from .. import obs, stats
+from ..utils import faultpolicy
 from ..operation.assign import assign as assign_rpc
 from ..operation.delete import delete_files
 from ..operation.upload import upload_data
@@ -54,6 +55,11 @@ from ..pb.rpc import GRPC_OPTIONS
 from ..wdclient import MasterClient
 
 log = logging.getLogger("filer")
+
+# per-chunk-fetch fallback timeout when the request carries no deadline
+# budget (the front door stamps one by default; this bounds direct
+# callers) — generous for a 4MB chunk off a loaded peer, finite always
+_CHUNK_FETCH_TIMEOUT_S = 30.0
 
 
 class FilerServer:
@@ -383,7 +389,7 @@ class FilerServer:
             )
         last_err = None
         for url in urls:
-            hdr = obs.outbound_headers()
+            hdr = {**obs.outbound_headers(), **faultpolicy.outbound_headers()}
             if not (view.offset_in_chunk == 0 and view.view_size == view.chunk_size):
                 hdr["Range"] = (
                     f"bytes={view.offset_in_chunk}-"
@@ -394,7 +400,17 @@ class FilerServer:
                     "chunk_fetch", file_id=view.file_id,
                     bytes=view.view_size,
                 ):
-                    async with self._session.get(url, headers=hdr) as r:
+                    async with self._session.get(
+                        url, headers=hdr,
+                        # hard per-fetch timeout from the remaining
+                        # request budget (a hung volume server must not
+                        # pin this filer read past its deadline)
+                        timeout=aiohttp.ClientTimeout(
+                            total=faultpolicy.rpc_timeout_s(
+                                _CHUNK_FETCH_TIMEOUT_S, what="chunk_fetch"
+                            )
+                        ),
+                    ) as r:
                         if r.status >= 300:
                             raise RuntimeError(f"{url}: HTTP {r.status}")
                         data = await r.read()
@@ -412,7 +428,16 @@ class FilerServer:
             try:
                 with obs.span("chunk_fetch", file_id=file_id):
                     async with self._session.get(
-                        url, headers=obs.outbound_headers()
+                        url,
+                        headers={
+                            **obs.outbound_headers(),
+                            **faultpolicy.outbound_headers(),
+                        },
+                        timeout=aiohttp.ClientTimeout(
+                            total=faultpolicy.rpc_timeout_s(
+                                _CHUNK_FETCH_TIMEOUT_S, what="chunk_fetch"
+                            )
+                        ),
                     ) as r:
                         if r.status < 300:
                             return await r.read()
@@ -458,7 +483,11 @@ class FilerServer:
         )
         status = 500
         try:
-            resp = await self._http_dispatch_inner(request)
+            # the filer is a deadline front door too: adopt the inbound
+            # budget or stamp the default, so the chunk fetches below
+            # ride one continuous budget (utils/faultpolicy.py)
+            with faultpolicy.request_scope(request.headers):
+                resp = await self._http_dispatch_inner(request)
             status = resp.status
             obs.stamp_trace_header(resp, trace)
             return resp
@@ -466,6 +495,11 @@ class FilerServer:
             status = e.status
             obs.stamp_trace_header(e, trace)
             raise
+        except faultpolicy.DeadlineExceeded as e:
+            status = 504
+            timeout = web.HTTPGatewayTimeout(text=str(e))
+            obs.stamp_trace_header(timeout, trace)  # correlate the shed
+            raise timeout
         finally:
             obs.finish_trace(trace, token, status)
 
@@ -1059,7 +1093,8 @@ class FilerServer:
             master_pb2.CollectionListRequest(
                 include_normal_volumes=request.include_normal_volumes,
                 include_ec_volumes=request.include_ec_volumes,
-            )
+            ),
+            timeout=30.0,  # master metadata round-trip (GL114)
         )
         return filer_pb2.CollectionListResponse(
             collections=[filer_pb2.Collection(name=c.name) for c in resp.collections]
@@ -1068,7 +1103,8 @@ class FilerServer:
     async def DeleteCollection(self, request, context):
         stub = self._master_stub()
         await stub.CollectionDelete(
-            master_pb2.CollectionDeleteRequest(name=request.collection)
+            master_pb2.CollectionDeleteRequest(name=request.collection),
+            timeout=60.0,  # deletes fan out to volume servers (GL114)
         )
         return filer_pb2.DeleteCollectionResponse()
 
@@ -1080,7 +1116,8 @@ class FilerServer:
                 collection=request.collection,
                 ttl=request.ttl,
                 disk_type=request.disk_type,
-            )
+            ),
+            timeout=30.0,  # master metadata round-trip (GL114)
         )
         return filer_pb2.StatisticsResponse(
             total_size=resp.total_size,

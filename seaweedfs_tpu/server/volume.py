@@ -24,6 +24,7 @@ import asyncio
 import json
 import logging
 import os
+import random
 import time
 
 import grpc
@@ -49,6 +50,7 @@ from ..security import tls as tls_mod
 from ..security import guard as guard_mod
 from ..storage.needle import CrcError, Needle
 from ..storage.store import Store
+from ..utils import faultpolicy
 from ..utils.tasks import spawn_logged
 from ..storage.volume import CookieMismatch, NotFoundError, Volume, VolumeReadOnly
 from .conversions import ec_msg_to_pb, volume_msg_to_pb
@@ -56,6 +58,11 @@ from .conversions import ec_msg_to_pb, volume_msg_to_pb
 log = logging.getLogger("volume")
 
 _EC_LOCATION_TTL = 10.0  # seconds; reference refreshes at 11s (store_ec.go:254)
+# per-call bounds for the degraded-read fan-out when no request budget
+# is tighter: one shard interval off a healthy peer is milliseconds, so
+# these are generous — but FINITE, which is the whole point (r18)
+_SHARD_READ_TIMEOUT_S = 10.0
+_EC_LOOKUP_TIMEOUT_S = 5.0
 
 
 class ByteLimiter:
@@ -258,6 +265,17 @@ class VolumeServer:
         # partition signal the repair scheduler watches (a broken
         # stream would instead unregister the node immediately)
         self.heartbeat_pause = False
+        # chaos-harness NETWORK faults on the VolumeEcShardRead servicer
+        # (loadgen/chaos.py; r18 tail-tolerance sweep): the gray-failure
+        # injectors fast faults can't model.  hang = accept the RPC then
+        # never answer; stall_after_chunks = answer N chunks then hang
+        # mid-stream; delay_s = fixed added latency before the first
+        # byte; fail_pct = probability of an immediate UNAVAILABLE (the
+        # flaky-dial model).  Never set outside tests/bench.
+        self.fault_shard_read_hang = False
+        self.fault_shard_read_stall_after: int | None = None
+        self.fault_shard_read_delay_s = 0.0
+        self.fault_shard_read_fail_pct = 0.0
 
     @property
     def url(self) -> str:
@@ -777,6 +795,9 @@ class VolumeServer:
                 yield hb
 
         try:
+            # graftlint: allow(unbounded-rpc): the heartbeat stream IS
+            # the liveness signal — deliberately unbounded; a wedged
+            # master surfaces as a broken stream and a redial
             async for resp in stub.SendHeartbeat(pulses()):
                 self._hb_acked += 1
                 if resp.volume_size_limit:
@@ -1239,7 +1260,8 @@ class VolumeServer:
         )
         try:
             resp = await stub.LookupVolume(
-                master_pb2.LookupVolumeRequest(volume_or_file_ids=[str(vid)])
+                master_pb2.LookupVolumeRequest(volume_or_file_ids=[str(vid)]),
+                timeout=10.0,  # master metadata round-trip (GL114)
             )
         except grpc.aio.AioRpcError:
             return []
@@ -1443,9 +1465,21 @@ class VolumeServer:
         peer found via master LookupEcVolume (store_ec.go:238-337).  Both the
         location lookup and the shard fetch happen lazily INSIDE the hook,
         which runs on a to_thread worker — sync gRPC on the event-loop
-        thread would deadlock against our own servers."""
+        thread would deadlock against our own servers.  Every fetch
+        carries a hard per-call timeout (the remaining deadline budget,
+        capped at _SHARD_READ_TIMEOUT_S): a peer that accepts the RPC
+        and never answers — the gray failure bench_netchaos_sweep
+        injects — frees this worker thread at the timeout instead of
+        pinning it forever.  `read.peer_of` exposes the shard's primary
+        holder so the hedged gather can key its latency EWMAs per peer."""
 
         def read(shard_id: int, offset: int, size: int):
+            try:
+                timeout = faultpolicy.rpc_timeout_s(
+                    _SHARD_READ_TIMEOUT_S, what="remote_shard_read"
+                )
+            except faultpolicy.DeadlineExceeded:
+                return None  # doomed: the gather's verdict tells the truth
             locations = self._cached_ec_locations(vid)
             for addr in locations.get(shard_id, []):
                 try:
@@ -1462,7 +1496,8 @@ class VolumeServer:
                     for resp in stub.VolumeEcShardRead(
                         volume_server_pb2.VolumeEcShardReadRequest(
                             volume_id=vid, shard_id=shard_id, offset=offset, size=size
-                        )
+                        ),
+                        timeout=timeout,
                     ):
                         if resp.is_deleted:
                             return None
@@ -1472,6 +1507,12 @@ class VolumeServer:
                     continue
             return None
 
+        def peer_of(shard_id: int):
+            return next(
+                iter(self._cached_ec_locations(vid).get(shard_id, ())), None
+            )
+
+        read.peer_of = peer_of
         return read
 
     def _cached_ec_locations(self, vid: int) -> dict[int, list[str]]:
@@ -1490,8 +1531,13 @@ class VolumeServer:
                     server_address.grpc_address(self.current_master)
                 )
                 stub = Stub(ch, master_pb2, "Seaweed")
+                # FIXED timeout, not the ambient budget: this refresh
+                # fills a process-level cache serving MANY requests, so
+                # it must not ride (or be refused by) whichever dying
+                # request happened to trigger it
                 resp = stub.LookupEcVolume(
-                    master_pb2.LookupEcVolumeRequest(volume_id=vid)
+                    master_pb2.LookupEcVolumeRequest(volume_id=vid),
+                    timeout=_EC_LOOKUP_TIMEOUT_S,
                 )
                 for e in resp.shard_id_locations:
                     locs[e.shard_id] = [
@@ -1499,7 +1545,15 @@ class VolumeServer:
                         if l.url != self.url
                     ]
             except grpc.RpcError:
-                pass
+                # unreachable master: keep serving the STALE snapshot
+                # rather than poisoning the cache with an empty map for
+                # a full TTL (no remote candidates = every degraded
+                # read fails for 2s — the netchaos sweep caught this).
+                # Re-stamp the timestamp so a down master costs ONE
+                # blocking lookup per TTL, not one per call.
+                if cached:
+                    self._ec_locations[vid] = (now, cached[1])
+                    return cached[1]
         self._ec_locations[vid] = (now, locs)
         return locs
 
@@ -1781,7 +1835,10 @@ class VolumeServer:
                         collection=collection,
                         ext=ext,
                         ignore_source_file_not_found=ignore_missing,
-                    )
+                    ),
+                    # whole-shard pulls ship tens of MB: heavy but
+                    # FINITE, so a hung source frees the copier (GL114)
+                    timeout=600.0,
                 ):
                     got_any = True
                     await asyncio.to_thread(f.write, resp.file_content)
@@ -1924,6 +1981,19 @@ class VolumeServer:
 
     async def VolumeEcShardRead(self, request, context):
         """Stream raw shard bytes (volume_grpc_erasure_coding.go:309-375)."""
+        # chaos network faults (loadgen/chaos.py): the gray failures the
+        # r18 fault-policy layer exists to survive — callers must carry
+        # per-call timeouts (graftlint GL114) and hedge around us
+        if self.fault_shard_read_fail_pct > 0 and (
+            random.random() < self.fault_shard_read_fail_pct
+        ):
+            await context.abort(
+                grpc.StatusCode.UNAVAILABLE, "chaos: flaky dial"
+            )
+        if self.fault_shard_read_delay_s > 0:
+            await asyncio.sleep(self.fault_shard_read_delay_s)
+        if self.fault_shard_read_hang:
+            await asyncio.Event().wait()  # hold until the caller times out
         ev = self.store.find_ec_volume(request.volume_id)
         if ev is None or request.shard_id not in ev.shards:
             await context.abort(
@@ -1945,7 +2015,13 @@ class VolumeServer:
         remaining = request.size
         offset = request.offset
         chunk = 1024 * 1024
+        sent_chunks = 0
         while remaining > 0:
+            stall_after = self.fault_shard_read_stall_after
+            if stall_after is not None and sent_chunks >= stall_after:
+                # chaos: mid-stream stall — bytes stop flowing but the
+                # stream stays open (the half-answered gray failure)
+                await asyncio.Event().wait()
             buf = await asyncio.to_thread(
                 self.store.read_ec_shard_interval,
                 request.volume_id,
@@ -1956,6 +2032,7 @@ class VolumeServer:
             if not buf:
                 break
             yield volume_server_pb2.VolumeEcShardReadResponse(data=buf)
+            sent_chunks += 1
             offset += len(buf)
             remaining -= len(buf)
 

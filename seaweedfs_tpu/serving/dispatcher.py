@@ -41,6 +41,7 @@ import time
 
 from .. import obs, stats
 from ..obs import incident as obs_incident
+from ..utils import faultpolicy
 from ..utils.tasks import spawn_logged
 from .coalescer import Coalescer, ReadRequest
 from .config import ServingConfig
@@ -129,6 +130,11 @@ class EcReadDispatcher:
         read_route series ("s3" = the gateway's direct volume path)."""
         cfg = self.cfg
         tier = normalize_tier(tier)
+        # refuse doomed work early: a spent deadline budget raises here
+        # (504 at the front door) instead of burning a queue slot and a
+        # device dispatch on a client that already gave up — the
+        # admission end of the one continuous budget (faultpolicy)
+        remaining_s = faultpolicy.check_remaining("ec read admission")
         if self.tiering is not None:
             self.tiering.note_read(vid, tier)
         if not cfg.enabled:
@@ -141,7 +147,8 @@ class EcReadDispatcher:
             self._route("native", origin)
             return await self._read_native(vid, nid, cookie)
         if cfg.qos and self.qos.admit(
-            tier, len(self.coalescer), cfg.max_inflight
+            tier, len(self.coalescer), cfg.max_inflight,
+            remaining_s=remaining_s,
         ) is not None:
             # QoS shed (tier budget / deadline / breaker): serve on the
             # host path NOW rather than joining a queue this request
@@ -213,8 +220,10 @@ class EcReadDispatcher:
             # lane spawned from a traced request would otherwise append
             # every LATER request's batch spans to the spawner's
             # (finished) trace — member traces ride ReadRequest.obs_ctx
-            # instead
-            with obs.detached():
+            # instead.  The DEADLINE detaches for the same reason: a
+            # lane outliving its spawner's budget must not doom every
+            # later batch it serves (faultpolicy.detached).
+            with obs.detached(), faultpolicy.detached():
                 spawn_logged(
                     self._drain(), log, "ec-read drain lane",
                     registry=self._lanes,

@@ -182,14 +182,24 @@ class QosController:
         return queue_depth * self._service_s / max(1, max_inflight)
 
     def admit(
-        self, tier: str, queue_depth: int, max_inflight: int
+        self,
+        tier: str,
+        queue_depth: int,
+        max_inflight: int,
+        remaining_s: float | None = None,
     ) -> str | None:
         """None = may proceed to the coalescer; else the shed reason.
         Counts sheds; the SUCCESS side (admitted counter, breaker
         success, queue accounting) is committed by `enqueued()` only
         once the coalescer actually accepted the request — the global
         max_queue backstop can still reject between the two, and that
-        rejection must read as overload (`saturated()`), not success."""
+        rejection must read as overload (`saturated()`), not success.
+
+        `remaining_s` is the request's propagated deadline budget
+        (utils/faultpolicy.py): when present, the deadline shed judges
+        the estimated queue wait against min(tier deadline, remaining
+        budget) — the admission end of ONE continuous budget stamped at
+        the front door, instead of a local per-tier guess."""
         pol = self.policies[tier]
         br = self._breakers[tier]
         if br.state != self._published_state[tier]:
@@ -214,13 +224,21 @@ class QosController:
                 "qos_shed", tier=tier, reason=SHED_BREAKER_OPEN
             )
             return SHED_BREAKER_OPEN
+        # the effective deadline: the tier policy's, tightened by the
+        # request's own remaining budget when one was propagated
+        deadline_s = pol.deadline_s
+        if remaining_s is not None:
+            deadline_s = (
+                min(deadline_s, remaining_s) if deadline_s > 0
+                else remaining_s
+            )
         reason = None
         if self._queued[tier] >= pol.queue_budget:
             reason = SHED_QUEUE_BUDGET
         elif (
-            pol.deadline_s > 0
+            deadline_s > 0
             and self.estimated_wait_s(queue_depth, max_inflight)
-            > pol.deadline_s
+            > deadline_s
         ):
             reason = SHED_DEADLINE
         if reason is not None:

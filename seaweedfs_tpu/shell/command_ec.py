@@ -15,6 +15,7 @@ import math
 
 from ..pb import master_pb2, volume_server_pb2
 from ..storage.ec import DATA_SHARDS, TOTAL_SHARDS
+from ..utils.faultpolicy import retry_rpc
 from .command_env import CommandEnv, TopoNode
 from .commands import command, parse_flags
 
@@ -109,47 +110,15 @@ def balanced_ec_distribution(nodes: list[TopoNode], n_shards: int = TOTAL_SHARDS
 # concurrency bound keeps a wide cluster from saturating the source's
 # uplink, and the per-RPC timeout/retry keeps one wedged peer from
 # hanging the whole verb (the reference's parallelCopyEcShardsFromSource
-# runs one goroutine per target with an ErrorWaitGroup)
+# runs one goroutine per target with an ErrorWaitGroup).  The retry
+# policy itself — backoff, jitter, per-peer retry budget — is the ONE
+# shared implementation in utils/faultpolicy.py (the repair executor
+# rides the same one); `retry_rpc`'s defaults match the knobs here.
 FANOUT_CONCURRENCY = 4
 RPC_ATTEMPTS = 3
 RPC_TIMEOUT_S = 300.0
-
-
-async def _retry_rpc(
-    call_factory,
-    what: str,
-    *,
-    timeout_s: float = RPC_TIMEOUT_S,
-    attempts: int = RPC_ATTEMPTS,
-):
-    """Await `call_factory()` (a fresh RPC per attempt) under a deadline,
-    retrying TRANSIENT transport failures with exponential backoff.  The
-    shard-move RPCs are all idempotent (copy overwrites, mount/unmount/
-    delete converge), so a retry after an ambiguous failure is safe —
-    but deterministic server verdicts (NOT_FOUND, FAILED_PRECONDITION,
-    ...) surface immediately instead of burning attempts*timeout on an
-    answer that will not change."""
-    import grpc
-
-    transient = (
-        grpc.StatusCode.UNAVAILABLE,
-        grpc.StatusCode.DEADLINE_EXCEEDED,
-        grpc.StatusCode.UNKNOWN,  # ambiguous transport/middlebox failures
-    )
-    delay = 0.2
-    for attempt in range(1, attempts + 1):
-        try:
-            return await asyncio.wait_for(call_factory(), timeout_s)
-        except (grpc.RpcError, asyncio.TimeoutError, ConnectionError) as e:
-            code = e.code() if isinstance(e, grpc.RpcError) else None
-            if code is not None and code not in transient:
-                raise  # a real answer, not a delivery problem
-            if attempt == attempts:
-                raise RuntimeError(
-                    f"{what} failed after {attempts} attempts: {e!r}"
-                ) from e
-            await asyncio.sleep(delay)
-            delay *= 2
+# generate/rebuild/decode re-stripe whole volumes: heavy but FINITE
+RPC_HEAVY_TIMEOUT_S = 600.0
 
 
 async def spread_ec_shards(
@@ -178,7 +147,7 @@ async def spread_ec_shards(
     async def ship(node: TopoNode, shard_ids: list[int]) -> None:
         async with sem:
             stub = env.volume_stub(node.grpc_address)
-            await _retry_rpc(
+            await retry_rpc(
                 lambda: stub.VolumeEcShardsCopy(
                     volume_server_pb2.VolumeEcShardsCopyRequest(
                         volume_id=vid,
@@ -191,8 +160,9 @@ async def spread_ec_shards(
                     )
                 ),
                 f"copy shards {shard_ids} of {vid} to {node.url}",
+                peer=node.grpc_address,
             )
-            await _retry_rpc(
+            await retry_rpc(
                 lambda: stub.VolumeEcShardsMount(
                     volume_server_pb2.VolumeEcShardsMountRequest(
                         volume_id=vid, collection=collection,
@@ -200,17 +170,19 @@ async def spread_ec_shards(
                     )
                 ),
                 f"mount shards {shard_ids} of {vid} on {node.url}",
+                peer=node.grpc_address,
             )
             src_stub = env.volume_stub(source.grpc_address)
-            await _retry_rpc(
+            await retry_rpc(
                 lambda: src_stub.VolumeEcShardsUnmount(
                     volume_server_pb2.VolumeEcShardsUnmountRequest(
                         volume_id=vid, shard_ids=shard_ids
                     )
                 ),
                 f"unmount shards {shard_ids} of {vid} at source",
+                peer=source.grpc_address,
             )
-            await _retry_rpc(
+            await retry_rpc(
                 lambda: src_stub.VolumeEcShardsDelete(
                     volume_server_pb2.VolumeEcShardsDeleteRequest(
                         volume_id=vid, collection=collection,
@@ -218,6 +190,7 @@ async def spread_ec_shards(
                     )
                 ),
                 f"delete shards {shard_ids} of {vid} at source",
+                peer=source.grpc_address,
             )
 
     await _gather_strict(ship(node, sids) for node, sids in real)
@@ -270,7 +243,8 @@ async def _encode_one(env, nodes: list[TopoNode], vid: int, collection: str):
     # 1. freeze all replicas (markVolumeReplicasWritable false)
     for n in holders:
         await env.volume_stub(n.grpc_address).VolumeMarkReadonly(
-            volume_server_pb2.VolumeMarkReadonlyRequest(volume_id=vid)
+            volume_server_pb2.VolumeMarkReadonlyRequest(volume_id=vid),
+            timeout=RPC_TIMEOUT_S,
         )
     source = holders[0]
     src_stub = env.volume_stub(source.grpc_address)
@@ -281,13 +255,15 @@ async def _encode_one(env, nodes: list[TopoNode], vid: int, collection: str):
     await src_stub.VolumeEcShardsGenerate(
         volume_server_pb2.VolumeEcShardsGenerateRequest(
             volume_id=vid, collection=collection
-        )
+        ),
+        timeout=RPC_HEAVY_TIMEOUT_S,
     )
     await src_stub.VolumeEcShardsMount(
         volume_server_pb2.VolumeEcShardsMountRequest(
             volume_id=vid, collection=collection,
             shard_ids=list(range(TOTAL_SHARDS)),
-        )
+        ),
+        timeout=RPC_TIMEOUT_S,
     )
     # 3. spread with balanced distribution
     targets = balanced_ec_distribution(nodes)
@@ -295,7 +271,8 @@ async def _encode_one(env, nodes: list[TopoNode], vid: int, collection: str):
     # 4. drop the original volume from every replica
     for n in holders:
         await env.volume_stub(n.grpc_address).VolumeDelete(
-            volume_server_pb2.VolumeDeleteRequest(volume_id=vid)
+            volume_server_pb2.VolumeDeleteRequest(volume_id=vid),
+            timeout=RPC_TIMEOUT_S,
         )
 
 
@@ -368,7 +345,8 @@ async def cmd_ec_scrub(env, args):
                 r = await env.volume_stub(addr).VolumeEcShardsVerify(
                     volume_server_pb2.VolumeEcShardsVerifyRequest(
                         all_resident=True
-                    )
+                    ),
+                    timeout=RPC_HEAVY_TIMEOUT_S,
                 )
             except Exception:  # noqa: BLE001 — pre-r11 server: the
                 # per-volume path below still covers everything
@@ -386,7 +364,8 @@ async def cmd_ec_scrub(env, args):
             )
             continue
         r = await env.volume_stub(addr).VolumeEcShardsVerify(
-            volume_server_pb2.VolumeEcShardsVerifyRequest(volume_id=vid)
+            volume_server_pb2.VolumeEcShardsVerifyRequest(volume_id=vid),
+            timeout=RPC_HEAVY_TIMEOUT_S,
         )
         _fmt_scrub_row(
             env, vid, r.parity_mismatch_bytes, r.backend,
@@ -410,7 +389,7 @@ async def gather_ec_shards(
 
     async def pull(src_addr: str, sids: list[int]) -> None:
         async with sem:
-            await _retry_rpc(
+            await retry_rpc(
                 lambda: stub.VolumeEcShardsCopy(
                     volume_server_pb2.VolumeEcShardsCopyRequest(
                         volume_id=vid,
@@ -423,6 +402,7 @@ async def gather_ec_shards(
                     )
                 ),
                 f"gather shards {sids} of {vid} from {src_addr}",
+                peer=src_addr,
             )
 
     await _gather_strict(pull(src, sids) for src, sids in to_copy.items())
@@ -470,13 +450,15 @@ async def cmd_ec_rebuild(env, args):
         resp = await stub.VolumeEcShardsRebuild(
             volume_server_pb2.VolumeEcShardsRebuildRequest(
                 volume_id=vid, collection=collection, fsync=fsync
-            )
+            ),
+            timeout=RPC_HEAVY_TIMEOUT_S,
         )
         await stub.VolumeEcShardsMount(
             volume_server_pb2.VolumeEcShardsMountRequest(
                 volume_id=vid, collection=collection,
                 shard_ids=list(resp.rebuilt_shard_ids),
-            )
+            ),
+            timeout=RPC_TIMEOUT_S,
         )
         # drop the borrowed shards it only needed as rebuild input
         borrowed = [sid for sids in to_copy.values() for sid in sids]
@@ -484,12 +466,14 @@ async def cmd_ec_rebuild(env, args):
             await stub.VolumeEcShardsUnmount(
                 volume_server_pb2.VolumeEcShardsUnmountRequest(
                     volume_id=vid, shard_ids=borrowed
-                )
+                ),
+                timeout=RPC_TIMEOUT_S,
             )
             await stub.VolumeEcShardsDelete(
                 volume_server_pb2.VolumeEcShardsDeleteRequest(
                     volume_id=vid, collection=collection, shard_ids=borrowed
-                )
+                ),
+                timeout=RPC_TIMEOUT_S,
             )
         env.write(f"ec volume {vid}: rebuilt {list(resp.rebuilt_shard_ids)}")
 
@@ -669,21 +653,25 @@ async def move_ec_shard(env, vid, collection, sid, src, dst):
             volume_id=vid, collection=collection, shard_ids=[sid],
             copy_ecx_file=True, copy_ecj_file=True, copy_vif_file=True,
             source_data_node=src.grpc_address,
-        )
+        ),
+        timeout=RPC_TIMEOUT_S,
     )
     await stub.VolumeEcShardsMount(
         volume_server_pb2.VolumeEcShardsMountRequest(
             volume_id=vid, collection=collection, shard_ids=[sid]
-        )
+        ),
+        timeout=RPC_TIMEOUT_S,
     )
     src_stub = env.volume_stub(src.grpc_address)
     await src_stub.VolumeEcShardsUnmount(
-        volume_server_pb2.VolumeEcShardsUnmountRequest(volume_id=vid, shard_ids=[sid])
+        volume_server_pb2.VolumeEcShardsUnmountRequest(volume_id=vid, shard_ids=[sid]),
+        timeout=RPC_TIMEOUT_S,
     )
     await src_stub.VolumeEcShardsDelete(
         volume_server_pb2.VolumeEcShardsDeleteRequest(
             volume_id=vid, collection=collection, shard_ids=[sid]
-        )
+        ),
+        timeout=RPC_TIMEOUT_S,
     )
 
 
@@ -720,12 +708,14 @@ async def cmd_ec_decode(env, args):
                 volume_id=vid, collection=collection, shard_ids=sids,
                 copy_ecx_file=True, copy_ecj_file=True, copy_vif_file=True,
                 source_data_node=src_addr,
-            )
+            ),
+            timeout=RPC_TIMEOUT_S,
         )
     await stub.VolumeEcShardsToVolume(
         volume_server_pb2.VolumeEcShardsToVolumeRequest(
             volume_id=vid, collection=collection
-        )
+        ),
+        timeout=RPC_HEAVY_TIMEOUT_S,
     )
     # remove EC shards everywhere
     for n in {n.url: n for n in shards.values()}.values():
@@ -735,17 +725,20 @@ async def cmd_ec_decode(env, args):
             await s_stub.VolumeEcShardsUnmount(
                 volume_server_pb2.VolumeEcShardsUnmountRequest(
                     volume_id=vid, shard_ids=sids
-                )
+                ),
+                timeout=RPC_TIMEOUT_S,
             )
             await s_stub.VolumeEcShardsDelete(
                 volume_server_pb2.VolumeEcShardsDeleteRequest(
                     volume_id=vid, collection=collection, shard_ids=sids
-                )
+                ),
+                timeout=RPC_TIMEOUT_S,
             )
     await env.volume_stub(decoder.grpc_address).VolumeEcShardsDelete(
         volume_server_pb2.VolumeEcShardsDeleteRequest(
             volume_id=vid, collection=collection,
             shard_ids=list(range(TOTAL_SHARDS)),
-        )
+        ),
+        timeout=RPC_TIMEOUT_S,
     )
     env.write(f"decoded ec volume {vid} back to a normal volume on {decoder.url}")

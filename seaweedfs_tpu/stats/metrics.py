@@ -496,6 +496,52 @@ for _tier in ("hbm", "host"):
     VOLUME_SERVER_EC_TIER_PROMOTIONS.labels(tier=_tier)
     VOLUME_SERVER_EC_TIER_DEMOTIONS.labels(tier=_tier)
 
+# -- fault policy (utils/faultpolicy.py): the tail-tolerant RPC plane's
+# decision counters.  hedge_sent/hedge_wins/hedge_cancelled bound and
+# prove the hedged survivor gather (a win = the spare shard beat a
+# tail-slow holder); deadline_exceeded counts doomed work refused
+# early; retry_budget_exhausted counts fast-fails where the per-peer
+# retry budget said "stop retrying a sick node".
+VOLUME_SERVER_EC_HEDGE_SENT = Counter(
+    "SeaweedFS_volumeServer_ec_hedge_sent",
+    "Hedge fetches armed by the degraded-read survivor gather: a "
+    "pending shard fetch exceeded its peer's latency-EWMA quantile "
+    "(-ec.rpc.hedgeQuantile) and a spare parity holder was asked for a "
+    "different shard instead of waiting.  Bounded by the hedge token "
+    "budget (-ec.rpc.hedgeBudgetPct), so this can never exceed that "
+    "fraction of primary fetches.",
+    registry=REGISTRY,
+)
+VOLUME_SERVER_EC_HEDGE_WINS = Counter(
+    "SeaweedFS_volumeServer_ec_hedge_wins",
+    "Hedge fetches whose bytes completed a reconstruct before the "
+    "tail-slow primary they covered — each one is a read that did NOT "
+    "ride a slow peer's tail.",
+    registry=REGISTRY,
+)
+VOLUME_SERVER_EC_HEDGE_CANCELLED = Counter(
+    "SeaweedFS_volumeServer_ec_hedge_cancelled",
+    "Hedge fetches cancelled or abandoned because the gather was "
+    "satisfied first (the loser side of the race; their per-call RPC "
+    "timeout frees the worker thread).",
+    registry=REGISTRY,
+)
+VOLUME_SERVER_EC_DEADLINE_EXCEEDED = Counter(
+    "SeaweedFS_volumeServer_ec_deadline_exceeded",
+    "Work refused or abandoned because the request's propagated "
+    "deadline budget (X-Seaweed-Deadline-Ms) was already spent — "
+    "admission sheds, doomed RPCs, and survivor gathers that ran out "
+    "of budget.",
+    registry=REGISTRY,
+)
+VOLUME_SERVER_EC_RETRY_BUDGET_EXHAUSTED = Counter(
+    "SeaweedFS_volumeServer_ec_retry_budget_exhausted",
+    "RPC retries refused because the peer's token-bucket retry budget "
+    "(-ec.rpc.retryBudgetPct) was drained — the fast-fail that keeps a "
+    "sick node from turning into a cluster-wide retry storm.",
+    registry=REGISTRY,
+)
+
 MQ_FENCE_CONFLICT = Counter(
     "SeaweedFS_mq_fence_conflict",
     "Partition activations that found the durable log tail moved after "

@@ -12,7 +12,6 @@ store_ec.go:339-393).
 """
 from __future__ import annotations
 
-import contextvars
 import os
 import threading
 import time
@@ -488,46 +487,41 @@ class EcVolume:
                     got[sid] = np.frombuffer(buf, dtype=np.uint8)
                 if len(got) >= DATA_SHARDS:
                     break
-            # remote survivors fetch CONCURRENTLY: a sequential gather
-            # pays up to 10 peer round-trips back to back, which is
-            # exactly the p99-during-repair cliff bench_chaos_sweep
-            # measures after a shard holder dies.  Each wave requests
-            # only the shortfall (no overfetch); failed fetches widen
-            # the next wave to the remaining candidates.  This hook
-            # already runs on a to_thread worker, so a small pool of
-            # sibling fetch threads is the sync analogue of the
-            # reference's per-shard goroutine fan-in.
-            while (
+            # remote survivors fetch CONCURRENTLY through the hedged
+            # gather (utils/faultpolicy.py): the `need` cheapest peers
+            # (per-peer latency EWMAs) are asked first, a fetch that
+            # exceeds its peer's EWMA-quantile threshold gets a hedge
+            # to a spare parity holder (RS(10,4): ANY 10 of 14 shards
+            # reconstruct, so a tail-slow peer is routed around, not
+            # waited on), failed fetches are replaced from the spares,
+            # and the first `need` completions win — all bounded by the
+            # hedge token budget and the remaining deadline budget.
+            # Each fetch runs under a copy of this worker's contextvars
+            # (the r17 fix: the fan-out's VolumeEcShardRead RPCs must
+            # carry the trace id so peers' entries correlate).
+            if (
                 len(got) < DATA_SHARDS
                 and remote_candidates
                 and remote_read is not None
             ):
-                wave = remote_candidates[: DATA_SHARDS - len(got)]
-                remote_candidates = remote_candidates[len(wave):]
-                n_remote += len(wave)
-                if len(wave) == 1:
-                    results = [(wave[0], remote_read(wave[0], off, size))]
-                else:
-                    # copy_context per wave: the SHARED pool's threads
-                    # don't inherit this worker's contextvars, so
-                    # without it the fan-out's VolumeEcShardRead RPCs
-                    # carry no trace id and the peers' entries never
-                    # correlate with the read's trace — exactly the
-                    # cross-node join the incident bundler exists for
-                    ctx = contextvars.copy_context()
-                    results = list(zip(
-                        wave,
-                        _gather_pool().map(
-                            lambda s: ctx.copy().run(
-                                remote_read, s, off, size
-                            ),
-                            wave,
-                        ),
-                    ))
-                for sid, buf in results:
-                    if buf is not None and len(buf) == size:
-                        got[sid] = np.frombuffer(buf, dtype=np.uint8)
-                        n_remote_ok += 1
+                from ...utils import faultpolicy
+
+                res = faultpolicy.hedged_gather(
+                    DATA_SHARDS - len(got),
+                    remote_candidates,
+                    lambda sid: remote_read(sid, off, size),
+                    pool=_gather_pool(),
+                    validate=lambda b: b is not None and len(b) == size,
+                    peer_of=getattr(remote_read, "peer_of", None),
+                    what=f"ec {self.id} survivor gather",
+                )
+                n_remote = res.sent
+                for sid, buf in res.got.items():
+                    got[sid] = np.frombuffer(buf, dtype=np.uint8)
+                    n_remote_ok += 1
+                gather.annotate(
+                    hedges=res.hedges_sent, hedge_wins=res.hedge_wins,
+                )
             gather.annotate(
                 survivors=len(got), remote=n_remote,
                 bytes=size * len(got),

@@ -80,6 +80,9 @@ class MasterClient:
                     client_type=self.client_type, client_address=self.client_address
                 )
 
+        # graftlint: allow(unbounded-rpc): KeepConnected is the
+        # deliberately long-lived master subscription; a hung master
+        # surfaces as a broken stream and a redial in the outer loop
         async for resp in stub.KeepConnected(requests()):
             if resp.leader:
                 self.current_master = resp.leader
@@ -122,7 +125,8 @@ class MasterClient:
         )
         try:
             resp = await stub.LookupVolume(
-                master_pb2.LookupVolumeRequest(volume_or_file_ids=[str(vid)])
+                master_pb2.LookupVolumeRequest(volume_or_file_ids=[str(vid)]),
+                timeout=10.0,  # master metadata round-trip (GL114)
             )
         except grpc.aio.AioRpcError:
             return []

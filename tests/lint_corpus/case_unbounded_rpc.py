@@ -1,0 +1,49 @@
+"""GL114 seed: cross-node RPC call sites without a timeout/deadline.
+
+Three violations; the bounded forms below them must stay clean."""
+import asyncio
+
+from seaweedfs_tpu.utils.faultpolicy import retry_rpc
+
+
+async def unbounded_unary(stub, req):
+    return await stub.VolumeEcShardsCopy(req)  # GL114: no timeout
+
+
+async def unbounded_stream(stub, req):
+    chunks = []
+    async for resp in stub.VolumeEcShardRead(req):  # GL114: no timeout
+        chunks.append(resp.data)
+    return chunks
+
+
+async def unbounded_in_helper(stub, req):
+    async def call():
+        # GL114: the wait_for is OUTSIDE this def — a closure called
+        # later is not lexically bounded by where it is built
+        return await stub.LookupEcVolume(req)
+
+    return call
+
+
+async def bounded_kwarg(stub, req):
+    return await stub.VolumeEcShardsCopy(req, timeout=30.0)  # clean
+
+
+async def bounded_wait_for(stub, req):
+    return await asyncio.wait_for(stub.VolumeEcShardsMount(req), 30.0)  # clean
+
+
+async def bounded_retry_rpc(stub, req):
+    return await retry_rpc(
+        lambda: stub.VolumeEcShardsRebuild(req), "rebuild", peer="p:1"
+    )  # clean: the lambda runs under retry_rpc's wait_for + budget
+
+
+async def waived_stream(stub, req):
+    out = []
+    # graftlint: allow(unbounded-rpc): deliberately long-lived
+    # subscription; the outer reconnect loop owns its lifetime
+    async for resp in stub.SubscribeMetadata(req):
+        out.append(resp)
+    return out

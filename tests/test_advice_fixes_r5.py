@@ -253,7 +253,7 @@ def test_ec_scrub_report_single_byte_basis():
     seconds = 2.0
 
     class FakeStub:
-        async def VolumeEcShardsVerify(self, req):
+        async def VolumeEcShardsVerify(self, req, **kw):
             return SimpleNamespace(
                 parity_mismatch_bytes=[0, 0, 0, 0],
                 bytes_verified=bytes_verified,

@@ -210,9 +210,14 @@ async def _incident_e2e(tmp_path, monkeypatch):
             assert burned is not None, "SLO never burned under load"
             assert burned["last_verdict"]["slo"] == "read_p99"
 
-            # the violation wrote an incident bundle (rate limit 0)
+            # the violation wrote an incident bundle (rate limit 0).
+            # The wait budget must EXCEED the bundler's own
+            # device-profile capture timeout (30s): the capture runs
+            # before the write by design, and a warmed full-suite
+            # process pays 20s+ of jax profiler init + trace dump —
+            # a 20s test bound raced the component's 30s contract
             bundle_path = None
-            deadline = time.monotonic() + 20
+            deadline = time.monotonic() + 40
             while time.monotonic() < deadline and bundle_path is None:
                 files = sorted(os.listdir(inc_dir)) if os.path.isdir(
                     inc_dir

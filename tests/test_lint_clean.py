@@ -93,6 +93,7 @@ def test_every_rule_fires_on_its_corpus_fixture(corpus_findings):
         ("GL111", "case_task_leak"),
         ("GL112", "case_flag_drift"),
         ("GL113", "case_unused_waiver"),
+        ("GL114", "case_unbounded_rpc"),
     ],
 )
 def test_rule_fires_in_the_named_case_file(
@@ -123,6 +124,7 @@ def test_seeded_counts_are_exact(corpus_findings):
         "GL111": 3,  # dropped handle, dead assignment, swallowed cancel
         "GL112": 2,  # no README row + no config mention (one flag, both)
         "GL113": 1,  # the stale waiver
+        "GL114": 3,  # bare unary, unbounded stream, closure-built call
     }, by_rule
 
 

@@ -42,7 +42,7 @@ from .model import UNUSED_WAIVER, Finding, rule_by_id
 _CORPUS_DIR = "lint_corpus"
 _WAIVER_RE = re.compile(r"graftlint:\s*allow\(([\w-]+)\)")
 _CACHE_NAME = ".graftlint_cache.json"
-_CACHE_VERSION = 2
+_CACHE_VERSION = 3
 
 
 @dataclass
@@ -175,6 +175,29 @@ def _is_registry_module(path: str) -> bool:
     return p.endswith(("stats/metrics.py", "stats/cluster.py"))
 
 
+_RPC_RE = re.compile(r"\brpc\s+(\w+)")
+
+
+def _rpc_context() -> set[str]:
+    """Proto rpc method names from the repo's own pb/*.proto — the
+    GL114 universe of cross-node call attributes.  Read from the repo
+    (like the registry fallback): linting a loose file set must still
+    know what an RPC is."""
+    names: set[str] = set()
+    pb_dir = os.path.join(_repo_root(), "seaweedfs_tpu", "pb")
+    if not os.path.isdir(pb_dir):
+        return names
+    for fn in sorted(os.listdir(pb_dir)):
+        if not fn.endswith(".proto"):
+            continue
+        try:
+            with open(os.path.join(pb_dir, fn), encoding="utf-8") as f:
+                names |= set(_RPC_RE.findall(f.read()))
+        except OSError:
+            continue
+    return names
+
+
 def _repo_root() -> str:
     return os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -206,7 +229,10 @@ def _waiver_line_for(unit: FileUnit, finding: Finding) -> int | None:
 
 
 def lint_one_file(
-    path: str, series: tuple[str, ...], stages: tuple[str, ...]
+    path: str,
+    series: tuple[str, ...],
+    stages: tuple[str, ...],
+    rpcs: tuple[str, ...] = (),
 ) -> FileResult:
     """Run every per-file rule over one file and apply its waivers.
     Pure function of (file content, registry context) — the unit of
@@ -231,6 +257,7 @@ def lint_one_file(
     )
     raw += rules.check_stage_registry(unit.tree, path, set(stages))
     raw += rules.check_silent_swallow(unit.tree, path)
+    raw += rules.check_unbounded_rpc(unit.tree, path, set(rpcs))
     raw += flow.check_view_escape(unit.tree, path)
     raw += flow.check_use_after_donate(unit.tree, path)
     raw += flow.check_task_leak(unit.tree, path)
@@ -256,10 +283,14 @@ def _file_fingerprint(path: str) -> str:
     return h.hexdigest()
 
 
-def _tool_salt(series: tuple[str, ...], stages: tuple[str, ...]) -> str:
+def _tool_salt(
+    series: tuple[str, ...],
+    stages: tuple[str, ...],
+    rpcs: tuple[str, ...] = (),
+) -> str:
     """Changes whenever the linter itself (any tools/graftlint source)
-    or the registry context changes — either invalidates every cached
-    per-file result."""
+    or the registry/rpc context changes — any of them invalidates every
+    cached per-file result."""
     h = hashlib.sha256()
     h.update(f"v{_CACHE_VERSION}py{sys.version_info[:2]}".encode())
     tool_dir = os.path.dirname(os.path.abspath(__file__))
@@ -267,7 +298,7 @@ def _tool_salt(series: tuple[str, ...], stages: tuple[str, ...]) -> str:
         if fn.endswith(".py"):
             with open(os.path.join(tool_dir, fn), "rb") as f:
                 h.update(f.read())
-    for name in series + ("|",) + stages:
+    for name in series + ("|",) + stages + ("|",) + rpcs:
         h.update(name.encode())
     return h.hexdigest()
 
@@ -347,11 +378,12 @@ def run_paths(
     series_set, stages_set = _registry_context(file_paths)
     series = tuple(sorted(series_set))
     stages = tuple(sorted(stages_set))
+    rpcs = tuple(sorted(_rpc_context()))
 
     cache = _Cache(
         os.environ.get("SWFS_LINT_CACHE")
         or os.path.join(_repo_root(), _CACHE_NAME),
-        _tool_salt(series, stages),
+        _tool_salt(series, stages, rpcs),
         enabled=use_cache,
     )
 
@@ -382,13 +414,14 @@ def run_paths(
                     [p for p, _ in todo],
                     [series] * len(todo),
                     [stages] * len(todo),
+                    [rpcs] * len(todo),
                 ),
             ):
                 results[path] = res
                 cache.put(path, fp, res)
     else:
         for path, fp in todo:
-            res = lint_one_file(path, series, stages)
+            res = lint_one_file(path, series, stages, rpcs)
             results[path] = res
             cache.put(path, fp, res)
 

@@ -42,6 +42,7 @@ CONFIG_OWNERS: tuple[tuple[str, str], ...] = (
     ("-ec.qos.", "seaweedfs_tpu/serving/config.py"),
     ("-ec.tier.", "seaweedfs_tpu/serving/config.py"),
     ("-ec.repair.", "seaweedfs_tpu/repair/config.py"),
+    ("-ec.rpc.", "seaweedfs_tpu/utils/faultpolicy.py"),
     ("-ec.bulk.", "seaweedfs_tpu/storage/ec/bulk.py"),
     ("-obs.slo.", "seaweedfs_tpu/obs/slo.py"),
     ("-obs.incident.", "seaweedfs_tpu/obs/incident.py"),

@@ -163,6 +163,16 @@ UNUSED_WAIVER = register(
     "line a reviewer already stopped reading",
     "# graftlint: allow(async-blocking): stale — nothing here blocks",
 )
+UNBOUNDED_RPC = register(
+    "GL114",
+    "unbounded-rpc",
+    "a cross-node RPC call site (proto rpc method name) in the EC "
+    "serving/repair/mount path without a `timeout=` argument and "
+    "outside a bounded wrapper (asyncio.wait_for / "
+    "faultpolicy.retry_rpc) — one hung peer pins the caller forever; "
+    "deliberately unbounded long-lived streams carry a reasoned waiver",
+    "await stub.VolumeEcShardsCopy(req)  # no timeout",
+)
 
 
 def rule_table_markdown() -> str:

@@ -18,6 +18,7 @@ from .model import (
     METRIC_REGISTRY,
     SILENT_SWALLOW,
     STAGE_REGISTRY,
+    UNBOUNDED_RPC,
     Finding,
 )
 
@@ -505,3 +506,81 @@ def check_silent_swallow(tree: ast.Module, path: str) -> Iterator[Finding]:
                 "log it (debug is fine, include the trace id when one "
                 "is in scope) or narrow the exception type",
             )
+
+
+# ----------------------------------------------------- GL114 unbounded-rpc
+
+# modules where an unbounded cross-node wait pins a serving/repair/mount
+# thread — the EC read path, its repair plane, and the FUSE/operation
+# clients.  Control verbs outside this scope are bounded by the stub
+# layer's deadline propagation instead (pb/rpc.py attaches the remaining
+# budget as the per-call timeout whenever a deadline scope is active).
+RPC_SCOPE_PARTS = (
+    "seaweedfs_tpu/storage/ec/",
+    "seaweedfs_tpu/serving/",
+    "seaweedfs_tpu/repair/",
+    "seaweedfs_tpu/mount/",
+    "seaweedfs_tpu/operation/",
+    "seaweedfs_tpu/wdclient/",
+    "seaweedfs_tpu/filer/",
+    "seaweedfs_tpu/server/volume.py",
+    "seaweedfs_tpu/server/filer.py",
+    "seaweedfs_tpu/shell/command_ec.py",
+    "lint_corpus",
+)
+
+# enclosing calls that bound the wrapped RPC themselves: asyncio's
+# wait_for and the shared fault-policy retry helper (a lambda passed to
+# retry_rpc runs under its wait_for + deadline budget)
+_BOUNDED_WRAPPERS = {"wait_for", "retry_rpc"}
+
+
+def in_rpc_scope(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return any(part in p for part in RPC_SCOPE_PARTS)
+
+
+def check_unbounded_rpc(
+    tree: ast.Module, path: str, rpc_names: set[str]
+) -> Iterator[Finding]:
+    """Every call whose attribute name is a proto rpc method must carry
+    `timeout=` or sit (lexically, lambdas included) inside a bounded
+    wrapper call.  Handler DEFINITIONS (servicer methods named after
+    rpcs) are not calls and never match; nested function definitions
+    stop the ancestor walk — a closure called later is not lexically
+    bounded by where it is built."""
+    if not rpc_names or not in_rpc_scope(path):
+        return
+    parents: dict[ast.AST, ast.AST] = {}
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            parents[child] = parent
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not isinstance(func, ast.Attribute) or func.attr not in rpc_names:
+            continue
+        if any(kw.arg == "timeout" for kw in node.keywords):
+            continue
+        cur = parents.get(node)
+        bounded = False
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+            if isinstance(cur, ast.Call):
+                name = dotted(cur.func) or ""
+                if name.rsplit(".", 1)[-1] in _BOUNDED_WRAPPERS:
+                    bounded = True
+                    break
+            cur = parents.get(cur)
+        if bounded:
+            continue
+        yield Finding(
+            UNBOUNDED_RPC.rule_id, path, node.lineno,
+            f"cross-node RPC {func.attr} has no timeout/deadline — a "
+            "hung peer pins this caller forever; pass timeout= (derive "
+            "it from faultpolicy.rpc_timeout_s), wrap in "
+            "faultpolicy.retry_rpc / asyncio.wait_for, or waive a "
+            "deliberately unbounded long-lived stream with a reason",
+        )
