@@ -108,6 +108,28 @@ def add_args(p) -> None:
         "instead of routing to host reconstruct)",
     )
     p.add_argument(
+        "-ec.serving.mesh.disable", dest="ec_serving_mesh_disable",
+        action="store_true",
+        help="pin resident EC volumes whole onto the default device "
+        "instead of lane-sharding them across the local device mesh "
+        "(pod-scale residency: sharded volumes size to the WHOLE "
+        "mesh's HBM and split reconstruct lane work across devices)",
+    )
+    p.add_argument(
+        "-ec.serving.mesh.devices", dest="ec_serving_mesh_devices",
+        type=int, default=serving_defaults.mesh_devices,
+        help="devices the serving mesh may span (0 = every local "
+        "device, n = the first n)",
+    )
+    p.add_argument(
+        "-ec.serving.mesh.minShardMB", dest="ec_serving_mesh_min_shard_mb",
+        type=int, default=serving_defaults.mesh_min_shard_mb,
+        help="volumes with shard files below this pin whole onto the "
+        "least-loaded mesh device instead of lane-sharding (a tiny "
+        "volume spread across the mesh buys no capacity and pays "
+        "cross-device dispatch per batch)",
+    )
+    p.add_argument(
         "-ec.serving.zerocopy.disable", dest="ec_serving_zerocopy_disable",
         action="store_true",
         help="materialize needle payloads as bytes on the HTTP read path "
@@ -393,6 +415,9 @@ async def run(args) -> None:
             layout=args.ec_serving_layout,
             overlap=not args.ec_serving_overlap_disable,
             aot=not args.ec_serving_aot_disable,
+            mesh=not args.ec_serving_mesh_disable,
+            mesh_devices=args.ec_serving_mesh_devices,
+            mesh_min_shard_mb=args.ec_serving_mesh_min_shard_mb,
             zero_copy=not args.ec_serving_zerocopy_disable,
             qos=not args.ec_qos_disable,
             qos_interactive_queue=args.ec_qos_interactive_queue,

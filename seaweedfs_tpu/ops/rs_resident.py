@@ -39,8 +39,15 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.8 promoted shard_map out of experimental
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
 
 from . import gf256, rs_tpu
+from ..parallel import mesh as mesh_mod
 from ..obs import incident as obs_incident
 from ..obs import trace as obs_trace
 from ..stats import metrics as stats_metrics
@@ -439,6 +446,21 @@ class DeviceShardCache:
     inserting past the budget evicts least-recently-used shards (whole
     shards — a partially resident volume simply fails over to the host
     path via CacheMiss).
+
+    Mesh-sharded residency (r19, -ec.serving.mesh.*): with
+    `mesh_devices` set (0 = every local device) the cache lays volumes
+    out ACROSS the serving mesh instead of whole onto the default
+    device.  A volume whose shard files reach `mesh_min_shard_bytes`
+    is lane-sharded: each shard's padded buffer is staged with
+    `jax.device_put(x, NamedSharding(mesh, P("shard")))`, so device d
+    holds byte-chunk d of every shard and the volume's resident
+    capacity is the WHOLE mesh's budget, not one chip's.  Smaller
+    volumes pin whole onto the least-loaded device (spreading a tiny
+    volume across 8 chips buys no capacity and pays mesh dispatch).
+    Budgets are accounted PER DEVICE (`budget_bytes / n_devices`
+    each): eviction pressure targets the device that is actually full,
+    and the tiering ladder's fit arithmetic follows the same per-device
+    vectors (serving/tiering.py).
     """
 
     def __init__(
@@ -447,6 +469,8 @@ class DeviceShardCache:
         shard_quantum: int = SHARD_QUANTUM,
         layout: str = "flat",
         groups: int = rs_tpu.BLOCKDIAG_GROUPS,
+        mesh_devices: int | None = None,
+        mesh_min_shard_bytes: int = 8 << 20,
     ):
         if layout not in LAYOUTS:
             raise ValueError(f"unknown resident layout {layout!r}")
@@ -461,6 +485,36 @@ class DeviceShardCache:
             )
         self.budget = budget_bytes
         self.quantum = shard_quantum
+        # the serving mesh (parallel/mesh.py — the one home shared with
+        # the bulk plane): None = the pre-r19 single-device layout.
+        # mesh_devices=None keeps it off; 0 = all local devices; n = the
+        # first n.  A resolved 1-wide mesh degrades to None (shard_map
+        # overhead with no capacity win).
+        self.mesh = (
+            mesh_mod.serving_mesh(mesh_devices)
+            if mesh_devices is not None else None
+        )
+        self.n_devices = (
+            int(self.mesh.devices.size) if self.mesh is not None else 1
+        )
+        self.mesh_min_shard_bytes = mesh_min_shard_bytes
+        # interleaved stripe width of the lane-sharded layout: stripe c
+        # of a padded buffer lives on device c % n (the host permutes
+        # the buffer owner-major at put time so NamedSharding's
+        # contiguous split lands each device exactly its stripes).
+        # Interleaving is what keeps ownership EVEN at any volume size:
+        # a contiguous chunk-per-device split would park all of a
+        # small-ish volume's data (and every zipf-hot byte range) on
+        # the first chunks' owners while the padding tail's owners sat
+        # idle, and the per-device count padding of a skewed batch
+        # multiplies compute.  Each stripe must fit the largest gather
+        # window placeable in it (>= SIZE_BUCKETS[0]) and stay
+        # FUSED_ALIGN-aligned.
+        self.stripe = 0
+        if self.mesh is not None:
+            q = self.n_devices * max(FUSED_ALIGN, SIZE_BUCKETS[0])
+            self.quantum = -(-self.quantum // q) * q
+            self.stripe = self.quantum // self.n_devices
         # which reconstruct/scrub kernel family serves this cache's bytes
         # (-ec.serving.layout); mutable at runtime — the bytes are
         # layout-agnostic (blockdiag segments are contiguous slices of
@@ -502,7 +556,17 @@ class DeviceShardCache:
         # serving path's per-read routing predicate is O(1) instead of
         # a scan-and-sort of the whole key set under the lock
         self._vid_counts: dict[int, int] = {}
-        self.bytes_used = 0
+        # per-device padded bytes held (len 1 without a mesh): the
+        # accounting the per-device budget/eviction/tiering all share.
+        # bytes_used (the pre-r19 scalar every caller reads) is the sum.
+        self._dev_bytes: list[int] = [0] * self.n_devices
+        # vid -> "mesh" | device index: where this volume's arrays
+        # live, decided at first put (claimed like the pin source so a
+        # partially pinned volume can never interleave placements)
+        self._vid_place: dict[int, object] = {}
+        # key -> (place, padded size): what evicting the key frees, per
+        # device
+        self._foot: dict[tuple[int, int], tuple[object, int]] = {}
         # cumulative telemetry counters, reported up the heartbeat
         # (pb VolumeServerTelemetry): budget-pressure evictions are the
         # "HBM is too small for the working set" signal, pin claims the
@@ -513,6 +577,148 @@ class DeviceShardCache:
     def _padded_len(self, n: int) -> int:
         need = n + MAX_TILE
         return -(-need // self.quantum) * self.quantum
+
+    # ------------------------------------------------- per-device accounting
+
+    @property
+    def bytes_used(self) -> int:
+        """Total padded device bytes held (sum over the mesh) — the
+        pre-r19 scalar every status/telemetry caller reads."""
+        return sum(self._dev_bytes)
+
+    @property
+    def device_budget(self) -> int:
+        """Per-device byte budget: the total budget split evenly over
+        the mesh (the whole budget on a single-device cache)."""
+        return self.budget // self.n_devices
+
+    def _shares(self, place, size: int) -> list[tuple[int, int]]:
+        """(device index, padded bytes) pairs one array of `size` costs
+        under placement `place` ("mesh" = an even split — NamedSharding
+        over the byte axis gives every device exactly size/n)."""
+        if place == "mesh":
+            per = size // self.n_devices
+            return [(d, per) for d in range(self.n_devices)]
+        return [(int(place), size)]
+
+    def _publish_dev_gauges(self) -> None:
+        for d, used in enumerate(self._dev_bytes):
+            stats_metrics.VOLUME_SERVER_EC_DEVICE_CACHE_BYTES.labels(
+                device=str(d)
+            ).set(used)
+
+    def _claim_place_locked(self, vid: int, shard_bytes: int):
+        """First put of a vid decides (and pins) its placement: mesh
+        lane-sharding for volumes worth spreading, else whole onto the
+        least-loaded device.  Later puts of the same vid follow the
+        claim — one volume must never straddle placements (the
+        reconstruct kernels assume a uniform survivor layout)."""
+        place = self._vid_place.get(vid)
+        if place is None:
+            if self.mesh is None:
+                place = 0
+            elif shard_bytes >= self.mesh_min_shard_bytes:
+                place = "mesh"
+            else:
+                place = min(
+                    range(self.n_devices), key=lambda d: self._dev_bytes[d]
+                )
+            self._vid_place[vid] = place
+        return place
+
+    def placement(self, vid: int):
+        """"mesh" | device index | None (nothing of `vid` was ever
+        placed) — the layout the serving path must dispatch for."""
+        with self._lock:
+            return self._vid_place.get(vid)
+
+    def vid_sharded(self, vid: int) -> bool:
+        with self._lock:
+            return self._vid_place.get(vid) == "mesh"
+
+    def device_stats(self) -> list[dict]:
+        """Per-device [{"used_bytes", "budget_bytes"}] — the telemetry
+        breakdown behind volume.device.status and cluster.health."""
+        budget = self.device_budget
+        with self._lock:
+            return [
+                {"used_bytes": used, "budget_bytes": budget}
+                for used in self._dev_bytes
+            ]
+
+    def pressure_devices(self) -> list[int]:
+        """Devices currently over their per-device budget, fullest
+        first — what the tiering ladder's pressure demotion targets."""
+        budget = self.device_budget
+        with self._lock:
+            over = [
+                (used - budget, d)
+                for d, used in enumerate(self._dev_bytes)
+                if used > budget
+            ]
+        return [d for _, d in sorted(over, reverse=True)]
+
+    def vid_device_bytes(self, vid: int) -> dict[int, int]:
+        """device -> padded bytes held by `vid` (what demoting it
+        frees, per device)."""
+        out: dict[int, int] = {}
+        with self._lock:
+            for key, (place, size) in self._foot.items():
+                if key[0] != vid:
+                    continue
+                for d, share in self._shares(place, size):
+                    out[d] = out.get(d, 0) + share
+        return out
+
+    def device_bytes_by_vid(self) -> dict[int, dict[int, int]]:
+        """vid -> {device -> padded bytes} in ONE locked pass over the
+        footprint map — the rebalance-cycle bulk form of
+        vid_device_bytes (a per-vid call rescans the whole map under
+        the serving-path lock once per volume per cycle)."""
+        out: dict[int, dict[int, int]] = {}
+        with self._lock:
+            for (vid, _sid), (place, size) in self._foot.items():
+                dev = out.setdefault(vid, {})
+                for d, share in self._shares(place, size):
+                    dev[d] = dev.get(d, 0) + share
+        return out
+
+    def plan_pin(
+        self, n_shards: int, shard_bytes: int, vid: int | None = None
+    ) -> dict[int, int]:
+        """device -> padded bytes a full pin of (n_shards x shard_bytes)
+        WOULD add, previewing the placement rule — the tiering ladder's
+        per-device fit arithmetic.  Pass `vid` so an existing placement
+        claim wins over the least-loaded preview: budget-pressure
+        eviction deliberately RETAINS a vid's claim, so a re-pin lands
+        back on the claimed device — the fit check must judge the
+        device the pin will ACTUALLY land on, not where a fresh volume
+        would go."""
+        padded = self._padded_len(shard_bytes)
+        with self._lock:
+            place = self._vid_place.get(vid) if vid is not None else None
+            if place is None:
+                if self.mesh is None:
+                    place = 0
+                elif shard_bytes >= self.mesh_min_shard_bytes:
+                    place = "mesh"
+                else:
+                    place = min(
+                        range(self.n_devices),
+                        key=lambda i: self._dev_bytes[i],
+                    )
+        if place == "mesh":
+            per = padded // self.n_devices
+            return {d: n_shards * per for d in range(self.n_devices)}
+        return {int(place): n_shards * padded}
+
+    def _device_of(self, place):
+        """The jax device (or sharding) one placement stages through."""
+        if place == "mesh":
+            return NamedSharding(self.mesh, P(mesh_mod.SHARD_AXIS))
+        if self.mesh is not None:
+            return self.mesh.devices.reshape(-1)[int(place)]
+        return jax.local_devices()[0]
 
     def put(self, vid: int, shard_id: int, data) -> None:
         host = np.frombuffer(bytes(data), dtype=np.uint8) if isinstance(
@@ -532,33 +738,105 @@ class DeviceShardCache:
         padded = np.empty(self._padded_len(host.size), dtype=np.uint8)
         padded[: host.size] = host
         padded[host.size :] = 0
-        arr = jax.device_put(padded)
-        key = (vid, shard_id)
         with self._lock:
+            place = self._claim_place_locked(vid, host.size)
+        if place == "mesh":
+            # owner-major stripe permutation: NamedSharding splits the
+            # 1-D buffer into n contiguous blocks, so reordering stripe
+            # c to position (c % n major, c // n minor) lands device d
+            # exactly its interleaved stripes {d, d+n, d+2n, ...}.  One
+            # extra host copy per shard, paid at pin time.
+            s_n = padded.size // self.stripe
+            perm = (
+                np.arange(s_n)
+                .reshape(s_n // self.n_devices, self.n_devices)
+                .T.ravel()
+            )
+            padded = padded.reshape(s_n, self.stripe)[perm].reshape(-1)
+        # the H2D lands directly on the owning device(s): an explicit
+        # sharding/device for every put (mesh puts split host-side and
+        # ship each device its stripes; whole pins ship to the claimed
+        # device) — also what graftlint GL115 enforces in this scope
+        arr = jax.device_put(padded, self._device_of(place))
+        key = (vid, shard_id)
+        shares = self._shares(place, padded.size)
+        budget = self.device_budget
+        with self._lock:
+            if self._vid_place.get(vid) != place:
+                # the claim this array was staged/permuted for vanished
+                # (evict()/clear() between the claim read and here —
+                # tiering demoting a vid whose pin thread is mid-upload
+                # is a supported race): inserting would let one vid's
+                # shards straddle placements, turning later reads into
+                # jit device-mismatch errors instead of the documented
+                # clean CacheMiss.  Drop the array; the pin loop's next
+                # put re-claims fresh.
+                return
             if key in self._arrays:
-                self.bytes_used -= self._arrays.pop(key).size
-                self._vid_counts[vid] -= 1
-            while self._arrays and self.bytes_used + padded.size > self.budget:
-                old_key, old = self._arrays.popitem(last=False)
-                self._true_sizes.pop(old_key, None)
-                self.bytes_used -= old.size
+                self._drop_key_locked(key)
+            # evict while any device the incoming array lands on would
+            # exceed ITS budget: LRU order, restricted to keys that
+            # actually hold bytes on an over-budget device — pressure
+            # on a full device never flushes a whole-pin parked on a
+            # device with headroom (mesh-sharded arrays touch every
+            # device, so they stay evictable under any pressure).  ONE
+            # forward pass suffices: dropping victims only shrinks the
+            # over set, so a key skipped as off-pressure can never
+            # match later — rescanning from the LRU head per victim
+            # would cost O(victims x resident keys) under this lock
+            lru = iter(list(self._arrays))
+            while self._arrays:
+                over = {
+                    d
+                    for d, share in shares
+                    if self._dev_bytes[d] + share > budget
+                }
+                if not over:
+                    break
+                victim = next(
+                    (
+                        k
+                        for k in lru
+                        if any(
+                            d in over
+                            for d, _ in self._shares(*self._foot[k])
+                        )
+                    ),
+                    None,
+                )
+                if victim is None:
+                    break  # pressure is on devices nothing else holds
+                self._drop_key_locked(victim)
                 self.evictions += 1
-                self._vid_counts[old_key[0]] -= 1
-                if not self._vid_counts[old_key[0]]:
-                    del self._vid_counts[old_key[0]]
-                # deliberately KEEP the evicted vid's pin-source claim:
-                # budget pressure can evict a volume's own oldest shards
-                # while its pin thread is still uploading, and dropping
-                # the claim here would leave the remaining pins
-                # unclaimed (never routed resident) or let a second
-                # location interleave its shard set.  A stale claim is
-                # conservative: scrub/serving just see too few resident
-                # shards and stay on the file path; explicit evict()/
-                # clear() (unmount, destroy) release the claim.
+                # deliberately KEEP the evicted vid's pin-source claim
+                # (and placement): budget pressure can evict a volume's
+                # own oldest shards while its pin thread is still
+                # uploading, and dropping the claim here would leave
+                # the remaining pins unclaimed (never routed resident)
+                # or let a second location interleave its shard set.  A
+                # stale claim is conservative: scrub/serving just see
+                # too few resident shards and stay on the file path;
+                # explicit evict()/clear() (unmount, destroy) release
+                # the claim.
             self._arrays[key] = arr
             self._true_sizes[key] = host.size
+            self._foot[key] = (place, padded.size)
             self._vid_counts[vid] = self._vid_counts.get(vid, 0) + 1
-            self.bytes_used += padded.size
+            for d, share in shares:
+                self._dev_bytes[d] += share
+            self._publish_dev_gauges()
+
+    def _drop_key_locked(self, key: tuple[int, int]) -> None:
+        """Remove one key's array + every piece of its accounting
+        (caller holds the lock and owns claim/placement policy)."""
+        self._arrays.pop(key)
+        self._true_sizes.pop(key, None)
+        place, size = self._foot.pop(key)
+        for d, share in self._shares(place, size):
+            self._dev_bytes[d] -= share
+        self._vid_counts[key[0]] -= 1
+        if not self._vid_counts[key[0]]:
+            del self._vid_counts[key[0]]
 
     def resident_count(self, vid: int) -> int:
         """O(1) resident shard count for `vid` (the serving dispatcher's
@@ -599,6 +877,7 @@ class DeviceShardCache:
             self._vid_counts.pop(vid, None)
             self._pin_source.pop(vid, None)
             self._aot_states.pop(vid, None)  # a re-pin re-plans
+            self._vid_place.pop(vid, None)  # a re-pin re-places
 
     def claim_pin_source(self, vid: int, source: str) -> str:
         """Atomically claim which disk location's shard files back this
@@ -667,9 +946,9 @@ class DeviceShardCache:
                 if k[0] == vid and (shard_id is None or k[1] == shard_id)
             ]
             for k in keys:
-                self.bytes_used -= self._arrays.pop(k).size
-                self._true_sizes.pop(k, None)
-                self._vid_counts[vid] -= 1
+                self._drop_key_locked(k)
+            if keys:
+                self._publish_dev_gauges()
             if shard_id is None or keys:
                 # a whole-vid evict (unmount/destroy) always releases
                 # the claim — even when budget pressure already removed
@@ -686,7 +965,10 @@ class DeviceShardCache:
             self._pin_source.clear()
             self._vid_counts.clear()
             self._aot_states.clear()
-            self.bytes_used = 0
+            self._vid_place.clear()
+            self._foot.clear()
+            self._dev_bytes = [0] * self.n_devices
+            self._publish_dev_gauges()
 
 
 @functools.lru_cache(maxsize=64)
@@ -1178,32 +1460,174 @@ def _gather_reconstruct_blockdiag(
     return sel.reshape(-1)
 
 
-def _plan(requests: list[tuple[int, int, int]]):
+# --- mesh-sharded twins ------------------------------------------------------
+#
+# With the cache's mesh layout (r19), a volume's shard buffers are
+# lane-sharded in INTERLEAVED STRIPES: stripe c (cache.stripe bytes) of
+# every shard lives on device c % n — the host permutes each padded
+# buffer owner-major at put time, so NamedSharding(mesh, P("shard"))'s
+# contiguous split hands device d exactly its stripes.  Interleaving
+# keeps ownership even at any volume size (a contiguous
+# chunk-per-device split parks all of a small volume's data — and any
+# zipf-hot byte range — on the first chunks' owners, and the uniform
+# per-device count padding then multiplies compute).  The planner
+# routes each sub-request to the device owning its gather window
+# (splitting requests that straddle a stripe boundary, backward-
+# aligning windows that would overhang one), so the whole batch
+# reconstructs in ONE shard_map program across the mesh: each device
+# gathers its own requests' survivor slices locally, runs the (flat or
+# block-diagonal) GF(2) matmul over its ~1/n of the batch, and
+# row-selects its wanted bytes — lane work genuinely parallelizes
+# across devices instead of queueing on one chip, and no survivor byte
+# ever crosses the interconnect (only the per-device request vectors
+# go up and the reconstructed rows come down).  The staged vec is
+# [n_dev, 2, N] int32 (per-device LOCAL offsets + wanted rows),
+# sharded P("shard") so each device receives exactly its own requests
+# — the sharding-aware H2D.  Host trims the alignment delta after D2H
+# (the fused kernels' contract), so the kernel never shifts.
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "mesh", "tile", "groups", "w_true", "kernel", "interpret", "k_true",
+    ),
+    donate_argnums=(2,),
+)
+def _sharded_gather_reconstruct(
+    a_prep, survivors, vecs, *, mesh, tile, groups, w_true, kernel,
+    interpret, k_true,
+):
+    """survivors: tuple of [L_pad] u8 shards sharded P("shard") over
+    `mesh`; vecs [n_dev, 2, N] int32 (donated), sharded P("shard") —
+    row 0 each request's CHUNK-LOCAL aligned offset, row 1 its wanted
+    matrix row.  `tile` is both the gather width and the D2H width
+    (the planner sizes it to cover every request's delta+take, so the
+    host-side delta trim needs no wider window).  groups > 1 applies
+    the block-diagonal system exactly like _gather_reconstruct_blockdiag
+    (g contiguous segments per window, per-group row select at
+    jg*w_true + row).  -> [n_dev, N, tile] u8 sharded P("shard")."""
+    k = len(survivors)
+    if k_true is not None and k != k_true:
+        raise ValueError(f"{k} survivors but matrix was built for {k_true}")
+    seg = tile // groups
+
+    def kern(vecs_l, a_l, *surv_l):
+        offsets, rows = vecs_l[0, 0], vecs_l[0, 1]
+        n = offsets.shape[0]
+        cols = []
+        for jg in range(groups):
+            for arr in surv_l:
+                cols.append(
+                    jax.vmap(
+                        lambda o, arr=arr, jg=jg: jax.lax.dynamic_slice(
+                            arr, (o + jg * seg,), (seg,)
+                        )
+                    )(offsets)
+                )
+        x = jnp.stack(cols, axis=0)  # [g*k, N, seg]
+        gk = x.shape[0]
+        out = rs_tpu.apply_matrix_device(
+            a_l,
+            x.reshape(gk, n * seg),
+            kernel=kernel,
+            interpret=interpret,
+            k_true=None if k_true is None else groups * k_true,
+        )  # [m_pad, N*seg]
+        out3 = out.reshape(out.shape[0], n, seg).transpose(1, 0, 2)
+        segs = []
+        for jg in range(groups):
+            want = rows + jg * w_true if groups > 1 else rows
+            segs.append(
+                jnp.take_along_axis(out3, want[:, None, None], axis=1)[
+                    :, 0, :
+                ]
+            )
+        sel = segs[0] if groups == 1 else jnp.concatenate(segs, axis=-1)
+        return sel[None]  # [1, N, tile]: this device's chunk of the out
+
+    return _shard_map(
+        kern,
+        mesh=mesh,
+        in_specs=(
+            P(mesh_mod.SHARD_AXIS, None, None),
+            P(None, None),
+            *([P(mesh_mod.SHARD_AXIS)] * k),
+        ),
+        out_specs=P(mesh_mod.SHARD_AXIS, None, None),
+    )(vecs, a_prep, *survivors)
+
+
+def _plan(requests: list[tuple[int, int, int]], l_loc: int = 0):
     """Split/align requests into device sub-requests.
 
     Each request (wanted_shard, offset, size) becomes >=1 sub-requests
     (req_index, aligned_off, delta, take, bucket) with delta+take <= bucket.
+
+    `l_loc` > 0 is the mesh layout's stripe width: every sub-request's
+    whole bucket window [aligned, aligned+bucket) must then sit inside
+    ONE stripe (each stripe lives whole on its owner device), so
+    requests additionally split at stripe boundaries and a window that
+    would overhang its boundary is backward-aligned to END there
+    instead (the delta grows up to bucket - take; the host trims it
+    after D2H like any other delta).  Stripe starts are LANE-aligned by
+    construction (the stripe is a multiple of FUSED_ALIGN), so
+    backward-aligned offsets stay lane-aligned.
     """
+    cap = SIZE_BUCKETS[-1]
+    if l_loc:
+        cap = max(v for v in SIZE_BUCKETS if v <= l_loc)
     subs = []
     for idx, (_, off, size) in enumerate(requests):
         pos = off
         remaining = size
         while remaining > 0:
             take = min(remaining, CHUNK)
-            aligned = pos - (pos % LANE)
-            delta = pos - aligned
-            subs.append(
-                (idx, aligned, delta, take, _bucket(SIZE_BUCKETS, delta + take))
-            )
+            if l_loc:
+                boundary = (pos // l_loc + 1) * l_loc
+                take = min(take, boundary - pos)
+                aligned = pos - (pos % LANE)
+                delta = pos - aligned
+                if delta + take > cap:
+                    take = cap - delta
+                bucket = _bucket(SIZE_BUCKETS, delta + take)
+                if aligned + bucket > boundary:
+                    # overhang: end the window AT the boundary (bucket
+                    # <= cap <= l_loc keeps it inside the chunk); the
+                    # residual pos - aligned joins the trimmed delta
+                    aligned = boundary - bucket
+                    delta = pos - aligned
+            else:
+                aligned = pos - (pos % LANE)
+                delta = pos - aligned
+                bucket = _bucket(SIZE_BUCKETS, delta + take)
+            subs.append((idx, aligned, delta, take, bucket))
             pos += take
             remaining -= take
     return subs
 
 
+@functools.lru_cache(maxsize=64)
+def _prepared_matrix_placed(matrix_bytes, m, k, groups, mesh, place):
+    """Prepared (flat or blockdiag) matrix staged where the placement's
+    kernels need it: replicated over the mesh for lane-sharded volumes,
+    committed to the owning device for whole-pins — jit refuses to mix
+    committed inputs across device sets, so the matrix must follow the
+    survivors.  Cached per (system, placement) like _prepared_matrix."""
+    if groups > 1:
+        base = _prepared_blockdiag_matrix(matrix_bytes, m, k, groups)
+    else:
+        base = _prepared_matrix(matrix_bytes, m, k)
+    if place == "mesh":
+        return jax.device_put(base, NamedSharding(mesh, P(None, None)))
+    return jax.device_put(base, mesh.devices.reshape(-1)[int(place)])
+
+
 def _resolve_codec(cache, vid, requests, data_shards, total_shards, layout):
     """Shared preamble: reconstruction matrix (flat or block-diagonal,
-    per the active layout) + resident survivor tuple + the system's
-    pre-expansion row count."""
+    per the active layout, staged on the vid's placement) + resident
+    survivor tuple + the system's pre-expansion row count + the vid's
+    placement ("mesh" | device index | 0 for the legacy default)."""
     wanted = sorted({r[0] for r in requests})
     resident = cache.shard_ids(vid)
     present = [s for s in resident if s not in wanted]
@@ -1214,7 +1638,15 @@ def _resolve_codec(cache, vid, requests, data_shards, total_shards, layout):
     rmat, use = gf256.reconstruction_matrix(
         data_shards, total_shards, present, wanted
     )
-    if layout == "blockdiag":
+    place = cache.placement(vid)
+    if place is None:
+        place = 0
+    groups = cache.groups if layout == "blockdiag" else 1
+    if cache.mesh is not None:
+        a_prep = _prepared_matrix_placed(
+            rmat.tobytes(), *rmat.shape, groups, cache.mesh, place
+        )
+    elif layout == "blockdiag":
         a_prep = _prepared_blockdiag_matrix(
             rmat.tobytes(), *rmat.shape, cache.groups
         )
@@ -1223,8 +1655,12 @@ def _resolve_codec(cache, vid, requests, data_shards, total_shards, layout):
     survivors = tuple(cache.get(vid, s) for s in use)
     if any(s is None for s in survivors):  # evicted between listing and get
         raise CacheMiss(f"vid {vid}: survivor shard evicted mid-request")
+    if place == "mesh" and len({int(s.size) for s in survivors}) != 1:
+        # the sharded planner derives ONE per-device chunk length for
+        # the whole batch; mixed padded lengths cannot serve sharded
+        raise CacheMiss(f"vid {vid}: sharded survivors differ in size")
     row_of = {sid: i for i, sid in enumerate(wanted)}
-    return a_prep, survivors, row_of, use, rmat.shape[0]
+    return a_prep, survivors, row_of, use, rmat.shape[0], place
 
 
 def _group_vectors(part, requests, row_of):
@@ -1247,6 +1683,25 @@ def _fetch_cover(span: int) -> int:
     p = max(1 << (span - 1).bit_length(), 2048)
     three_halves = 3 * (p >> 2)
     return three_halves if three_halves >= max(span, 2048) else p
+
+
+def _sharded_fetch_rungs(fetch: int) -> list[int]:
+    """Every fetch a live sharded sub-request in `fetch`'s size bucket
+    can produce.  A sharded call's fetch is min(bucket, _fetch_cover(span))
+    with span anywhere in (0, bucket]: _plan's stripe-boundary backward
+    alignment grows delta up to bucket - take, so the reachable set is
+    the whole {2^n, 3*2^(n-1)} cover ladder from 2048 up to the bucket —
+    not just the aligned / off-by-one spans warm's probes enumerate.
+    The smaller rungs double as cover for the boundary-SPLIT halves of a
+    probe-sized read, whose takes land in buckets no probe size maps to."""
+    bucket = _bucket(SIZE_BUCKETS, fetch)
+    rungs, f = [], 2048
+    while f <= bucket:
+        rungs.append(f)
+        if f + (f >> 1) <= bucket:
+            rungs.append(f + (f >> 1))
+        f <<= 1
+    return rungs
 
 
 def _fused_tile_for(fetch: int) -> int:
@@ -1359,7 +1814,7 @@ def hot_shapes(limit: int = 10) -> list[dict]:
     for key, (count, ewma_s, last) in items:
         (
             family, groups, w_true, tile, fetch, n_bucket, k, a_shape,
-            surv_len, interpret,
+            surv_len, interpret, place,
         ) = key
         out.append(
             {
@@ -1373,6 +1828,10 @@ def hot_shapes(limit: int = 10) -> list[dict]:
                 "a_shape": list(a_shape),
                 "survivor_len": surv_len,
                 "interpret": bool(interpret),
+                # 0 = default device; n = lane-sharded over n devices;
+                # ["dev", d] = whole-pin on mesh device d
+                "placement": list(place) if isinstance(place, tuple)
+                else place,
                 "dispatches": count,
                 "ewma_ms": round(ewma_s * 1e3, 3),
                 "last_dispatch_age_s": round(max(0.0, now - last), 3),
@@ -1434,15 +1893,22 @@ _AOT_EXECUTOR: concurrent.futures.Executor | None = None
 
 def _call_key(
     kind, kernel, groups, w_true, tile, fetch, n_bucket, k, a_shape,
-    surv_len, interpret,
+    surv_len, interpret, place=0,
 ) -> tuple:
     """Canonical identity of ONE device call's compiled shape — every
-    static arg plus every aval dim of the four reconstruct kernels.
+    static arg plus every aval dim of the reconstruct kernels.
     Shared by the miss counter, the AOT registry, and the shed check so
     the three can never disagree about what 'warm' means.  w_true only
     shapes the blockdiag kernels (the flat kernels' row select is purely
     data); normalizing it to 0 for flat keeps a warm plan's w_true=1
-    probes valid for any wanted-set width with the same matrix shape."""
+    probes valid for any wanted-set width with the same matrix shape.
+
+    `place` is the r19 placement axis of the identity: 0 = the legacy
+    default device, n >= 2 = lane-sharded over an n-device mesh (the
+    sharded twin, compiled against NamedSharding avals), ("dev", d) = a
+    whole-pin on mesh device d (an executable compiled for device 0
+    cannot serve arrays committed to device d, so each owning device is
+    its own compiled shape)."""
     return (
         "fused" if kind == "fused" else kernel,
         groups,
@@ -1454,7 +1920,19 @@ def _call_key(
         tuple(a_shape),
         surv_len,
         bool(interpret),
+        place,
     )
+
+
+def _key_place(cache, place):
+    """Map a cache placement to the _call_key placement element: the
+    mesh width for lane-sharded vids, ("dev", d) for whole-pins on a
+    mesh cache, 0 for the legacy single-device cache."""
+    if place == "mesh":
+        return cache.n_devices
+    if cache.mesh is not None:
+        return ("dev", int(place))
+    return 0
 
 
 def _aot_executor() -> concurrent.futures.Executor:
@@ -1473,18 +1951,69 @@ def _aot_executor() -> concurrent.futures.Executor:
 def _compile_shape(key: tuple) -> None:
     """Build the Compiled executable for one call key (runs on the AOT
     executor).  Lowers against abstract avals only — no resident buffer
-    is held while a 20-40s compile runs."""
+    is held while a 20-40s compile runs.  Placement rides in the avals:
+    lane-sharded keys lower against NamedSharding'd ShapeDtypeStructs
+    (the executable spans the mesh), whole-pin keys against the owning
+    device, so a sharded volume's first read can hit a parked
+    executable exactly like a single-device one."""
     (
         family, groups, w_true, tile, fetch, n_bucket, k, a_shape,
-        surv_len, interpret,
+        surv_len, interpret, place,
     ) = key
-    a_aval = jax.ShapeDtypeStruct(a_shape, jnp.int8)
-    survivors = tuple(
-        jax.ShapeDtypeStruct((surv_len,), jnp.uint8) for _ in range(k)
-    )
+    if isinstance(place, int) and place >= 2:
+        mesh = mesh_mod.serving_mesh(place)
+        if mesh is None or int(mesh.devices.size) != place:
+            raise RuntimeError(
+                f"serving mesh of {place} devices unavailable"
+            )
+        a_aval = jax.ShapeDtypeStruct(
+            a_shape, jnp.int8, sharding=NamedSharding(mesh, P(None, None))
+        )
+        sv = NamedSharding(mesh, P(mesh_mod.SHARD_AXIS))
+        survivors = tuple(
+            jax.ShapeDtypeStruct((surv_len,), jnp.uint8, sharding=sv)
+            for _ in range(k)
+        )
+        vec = jax.ShapeDtypeStruct(
+            (place, 2, n_bucket), jnp.int32,
+            sharding=NamedSharding(mesh, P(mesh_mod.SHARD_AXIS, None, None)),
+        )
+        with _quiet_donation():
+            exe = _sharded_gather_reconstruct.lower(
+                a_aval, survivors, vec, mesh=mesh, tile=tile,
+                groups=groups, w_true=w_true if groups > 1 else 1,
+                kernel=family, interpret=interpret, k_true=k,
+            ).compile()
+        _register_compiled(key, exe)
+        return
+    if isinstance(place, tuple):
+        # whole-pin on mesh device place[1]: the avals commit there
+        mesh = mesh_mod.serving_mesh(0)
+        dev = mesh.devices.reshape(-1)[place[1]]
+        from jax.sharding import SingleDeviceSharding
+
+        sds = SingleDeviceSharding(dev)
+        a_aval = jax.ShapeDtypeStruct(a_shape, jnp.int8, sharding=sds)
+        survivors = tuple(
+            jax.ShapeDtypeStruct((surv_len,), jnp.uint8, sharding=sds)
+            for _ in range(k)
+        )
+        vec_sharding = sds
+    else:
+        a_aval = jax.ShapeDtypeStruct(a_shape, jnp.int8)
+        survivors = tuple(
+            jax.ShapeDtypeStruct((surv_len,), jnp.uint8) for _ in range(k)
+        )
+        vec_sharding = None
+
+    def _vec_aval(shape):
+        if vec_sharding is None:
+            return jax.ShapeDtypeStruct(shape, jnp.int32)
+        return jax.ShapeDtypeStruct(shape, jnp.int32, sharding=vec_sharding)
+
     with _quiet_donation():
         if family == "fused":
-            vec = jax.ShapeDtypeStruct((n_bucket,), jnp.int32)
+            vec = _vec_aval((n_bucket,))
             if groups > 1:
                 lowered = _fused_reconstruct_blockdiag.lower(
                     a_aval, survivors, vec, tile=tile, fetch=fetch,
@@ -1497,7 +2026,7 @@ def _compile_shape(key: tuple) -> None:
                     k_true=k, interpret=interpret,
                 )
         else:
-            vec = jax.ShapeDtypeStruct((3, n_bucket), jnp.int32)
+            vec = _vec_aval((3, n_bucket))
             if groups > 1:
                 lowered = _gather_reconstruct_blockdiag.lower(
                     a_aval, survivors, vec, tile=tile, fetch=fetch,
@@ -1510,6 +2039,10 @@ def _compile_shape(key: tuple) -> None:
                     kernel=family, interpret=interpret, k_true=k,
                 )
         exe = lowered.compile()
+    _register_compiled(key, exe)
+
+
+def _register_compiled(key: tuple, exe) -> None:
     with _shapes_lock:
         _aot_executables[key] = exe
         # the shape is warm: a dispatch through the executable never
@@ -1573,6 +2106,62 @@ def aot_stats() -> dict:
         }
 
 
+def _pack_calls_sharded(cache, requests, row_of, survivors, record_observed):
+    """PACK stage for a lane-sharded volume: plan against the stripe
+    width (requests split at stripe boundaries), partition each
+    size-bucket group by OWNER DEVICE (stripe c lives on device c % n —
+    the interleaving is what keeps ownership even at any volume size),
+    and build per-device column lists of DEVICE-LOCAL offsets — device
+    d's slots carry only d's requests, so the mesh does ~1/n of the
+    batch's lane work per device.  Returns (calls, subs) with each call
+    ("sharded", part, (dev_cols, width), 0, fetch, fetch, n_bucket,
+    None): part entries are (sub_idx, sub, flat_row) where flat_row
+    indexes the call's [n_dev * n_bucket, fetch] output (device-major),
+    and fetch both gathers and ships — it covers every member's
+    delta+take (backward-aligned deltas included), and the host trims
+    the delta like the fused kernels' contract."""
+    n_dev = cache.n_devices
+    stripe = cache.stripe
+    subs = _plan(requests, stripe)
+    calls = []
+    for bucket in SIZE_BUCKETS:
+        group = [(i, s) for i, s in enumerate(subs) if s[4] == bucket]
+        if not group:
+            continue
+        by_dev: list[list] = [[] for _ in range(n_dev)]
+        for i, s in group:
+            by_dev[(s[1] // stripe) % n_dev].append((i, s))
+        widest = max(len(b) for b in by_dev)
+        n_bucket = _bucket(COUNT_BUCKETS, min(widest, _max_count(bucket)))
+        for start in range(0, widest, n_bucket):
+            part = []
+            dev_cols = []
+            span = 0
+            for d in range(n_dev):
+                chunk = by_dev[d][start : start + n_bucket]
+                # device-local offset of a global aligned offset o in
+                # stripe c = o // stripe: the device holds its stripes
+                # owner-major, so stripe c sits at local stripe index
+                # c // n_dev
+                offs = [
+                    (s[1] // stripe // n_dev) * stripe + s[1] % stripe
+                    for _, s in chunk
+                ]
+                rows = [row_of[requests[s[0]][0]] for _, s in chunk]
+                dev_cols.append((offs, rows))
+                for j, (i, s) in enumerate(chunk):
+                    part.append((i, s, d * n_bucket + j))
+                    span = max(span, s[2] + s[3])
+            if record_observed:
+                _note_observed(bucket, n_bucket)
+            fetch = min(bucket, _fetch_cover(span))
+            calls.append(
+                ("sharded", part, (dev_cols, n_bucket), 0, fetch, fetch,
+                 n_bucket, None)
+            )
+    return calls, subs
+
+
 def _pack_calls(
     cache, vid, requests, kernel, interpret, layout, data_shards,
     total_shards, record_observed=True,
@@ -1587,11 +2176,20 @@ def _pack_calls(
     `record_observed=False` keeps synthetic probes (warm's ladder walk)
     out of the observed-shape ranking, which must reflect live traffic
     only."""
-    a_prep, survivors, row_of, use, w_true = _resolve_codec(
+    a_prep, survivors, row_of, use, w_true, place = _resolve_codec(
         cache, vid, requests, data_shards, total_shards, layout
     )
-    fused = _use_fused(kernel, interpret)
     groups = cache.groups if layout == "blockdiag" else 1
+    if place == "mesh":
+        # lane-sharded volume: one cross-device program per call — the
+        # planner routes every sub-request to the device owning its
+        # gather window, so the fused single-device DMA kernels do not
+        # apply (the sharded twin IS the batched gather)
+        calls, subs = _pack_calls_sharded(
+            cache, requests, row_of, survivors, record_observed
+        )
+        return calls, subs, survivors, a_prep, use, w_true, place
+    fused = _use_fused(kernel, interpret)
     subs = _plan(requests)
     calls = []
     for bucket in SIZE_BUCKETS:
@@ -1628,7 +2226,7 @@ def _pack_calls(
                     ("xla", part, cols, pad, fetch, bucket, n_bucket,
                      None)
                 )
-    return calls, subs, survivors, a_prep, use, w_true
+    return calls, subs, survivors, a_prep, use, w_true, place
 
 
 def _stage_call_vec(kind, cols, pad, arena=None) -> np.ndarray:
@@ -1639,6 +2237,17 @@ def _stage_call_vec(kind, cols, pad, arena=None) -> np.ndarray:
     array otherwise (CPU PJRT zero-copies aligned numpy into the jax
     Array, so a reused buffer would alias an asynchronously executing
     call's input)."""
+    if kind == "sharded":
+        # [n_dev, 2, width] per-device (local offset, wanted row)
+        # slots: the NamedSharding put splits this host-side and ships
+        # each device exactly its own requests — never through the
+        # arena (one pinned block cannot back a device-sharded put)
+        dev_cols, width = cols
+        vec = np.zeros((len(dev_cols), 2, width), dtype=np.int32)
+        for d, (offs, rows) in enumerate(dev_cols):
+            vec[d, 0, : len(offs)] = offs
+            vec[d, 1, : len(rows)] = rows
+        return vec
     if kind == "fused":
         if arena is not None:
             return arena.stage_fused(cols, pad)
@@ -1654,7 +2263,7 @@ def _stage_call_vec(kind, cols, pad, arena=None) -> np.ndarray:
 
 def _dispatch_call(
     kind, vec, a_prep, survivors, n_use, w_true, groups, tile,
-    fetch, kernel, interpret, key=None,
+    fetch, kernel, interpret, key=None, mesh=None,
 ):
     """Route one packed call's staged vector to its kernel — the single
     home of the fused/xla x flat/blockdiag dispatch, shared by
@@ -1677,6 +2286,12 @@ def _dispatch_call(
     if exe is not None:
         return exe(a_prep, survivors, vec)
     with _quiet_donation():
+        if kind == "sharded":
+            return _sharded_gather_reconstruct(
+                a_prep, survivors, vec, mesh=mesh, tile=tile,
+                groups=groups, w_true=w_true if groups > 1 else 1,
+                kernel=kernel, interpret=interpret, k_true=n_use,
+            )
         if kind == "fused":
             if groups > 1:
                 return _fused_reconstruct_blockdiag(
@@ -1742,15 +2357,16 @@ def reconstruct_intervals(
     with obs_trace.span(
         "batch_pack", requests=len(requests), layout=layout
     ):
-        calls, subs, survivors, a_prep, use, w_true = _pack_calls(
+        calls, subs, survivors, a_prep, use, w_true, place = _pack_calls(
             cache, vid, requests, kernel, interpret, layout,
             data_shards, total_shards, record_observed,
         )
     surv_len = int(survivors[0].size)
+    key_place = _key_place(cache, place)
     call_keys = [
         _call_key(
             kind, kernel, groups, w_true, tile, fetch, n_bucket,
-            len(use), a_prep.shape, surv_len, interpret,
+            len(use), a_prep.shape, surv_len, interpret, key_place,
         )
         for kind, _part, _cols, _pad, fetch, tile, n_bucket, _d in calls
     ]
@@ -1783,8 +2399,9 @@ def reconstruct_intervals(
     # read can say "compile cliff" or "tunnel-bound fetch" by itself
     dev_span = obs_trace.span(
         "device_execute", requests=len(requests), layout=layout,
-        kernel=(("fused_" if fused else "") + ("blockdiag" if groups > 1
-                                               else kernel)),
+        kernel=(("sharded_" if place == "mesh" else
+                 "fused_" if fused else "")
+                + ("blockdiag" if groups > 1 else kernel)),
     )
     dev_calls = dev_misses = dev_h2d = dev_d2h = 0
     sub_out: list[bytes | None] = [None] * len(subs)
@@ -1800,7 +2417,7 @@ def reconstruct_intervals(
     pending_bytes = 0
 
     def _finish(entry) -> int:
-        part, arr, fetch, deltas, key, t_dispatch = entry
+        part, arr, fetch, deltas, key, t_dispatch, wire_bytes = entry
         nbytes = int(arr.size)  # padded rows ride the fetch too
         # completion boundary BEFORE the d2h span: jax dispatch is
         # async, so without it the fetch would absorb the kernel's
@@ -1818,12 +2435,19 @@ def reconstruct_intervals(
             for j, (sub_idx, (_, _, _, take, _)) in enumerate(part):
                 d = deltas[j]
                 sub_out[sub_idx] = out[j, d : d + take].tobytes()
+        elif part and len(part[0]) == 3:
+            # sharded: part entries carry their flat output row (the
+            # call's [n_dev * n_bucket, fetch] layout is device-major,
+            # with padded slots between devices); the host trims the
+            # delta — backward-aligned windows fold theirs into it
+            for sub_idx, (_, _, delta, take, _), row in part:
+                sub_out[sub_idx] = out[row, delta : delta + take].tobytes()
         else:  # XLA fallback: delta was shifted on device iff narrowed
             bucket = part[0][1][4]
             for j, (sub_idx, (_, _, delta, take, _)) in enumerate(part):
                 lo = 0 if fetch < bucket else delta
                 sub_out[sub_idx] = out[j, lo : lo + take].tobytes()
-        return len(part) * fetch
+        return wire_bytes
 
     with cache.pipeline.slot() as pslot, dev_span:
         slot_wait_s = pslot.wait_s
@@ -1843,7 +2467,24 @@ def reconstruct_intervals(
             vec_np = _stage_call_vec(kind, cols, pad, arena)
             h2d_bytes = int(vec_np.nbytes)
             with obs_trace.span("h2d_copy", bytes=h2d_bytes):
-                dev_vec = jnp.asarray(vec_np)
+                # sharding-aware staging: the vector lands directly on
+                # the owning device(s) — split across the mesh for a
+                # sharded call (each device receives only its own
+                # requests' slots), committed to the claimed device for
+                # a whole-pin, default device otherwise
+                if kind == "sharded":
+                    dev_vec = jax.device_put(
+                        vec_np,
+                        NamedSharding(
+                            cache.mesh, P(mesh_mod.SHARD_AXIS, None, None)
+                        ),
+                    )
+                elif cache.mesh is not None:
+                    dev_vec = jax.device_put(
+                        vec_np, cache.mesh.devices.reshape(-1)[int(place)]
+                    )
+                else:
+                    dev_vec = jnp.asarray(vec_np)
                 # the put is async too: wait it out INSIDE the span so
                 # the stage measures the transfer, not the enqueue —
                 # and so the arena rows are safe to reuse for the next
@@ -1862,13 +2503,21 @@ def reconstruct_intervals(
             arr = _dispatch_call(
                 kind, dev_vec, a_prep, survivors, len(use), w_true,
                 groups, tile, fetch, kernel, interpret, key=key,
+                mesh=cache.mesh if kind == "sharded" else None,
             )
-            pending.append((part, arr, fetch, deltas, key, t_dispatch))
-            pending_bytes += len(part) * fetch
+            # the padded rows ride the wire too: count what the fetch
+            # actually moves, not just the useful subset (a sharded
+            # call fetches every device's n_bucket rows)
+            wire_rows = n_bucket * (
+                cache.n_devices if kind == "sharded" else 1
+            )
+            pending.append(
+                (part, arr, fetch, deltas, key, t_dispatch,
+                 wire_rows * fetch)
+            )
+            pending_bytes += wire_rows * fetch
             dev_calls += 1
-            # the padded rows ride the wire too: count what the
-            # fetch actually moves, not just the useful subset
-            dev_d2h += n_bucket * fetch
+            dev_d2h += wire_rows * fetch
             while pending_bytes > _MAX_PENDING_OUT and len(pending) > 1:
                 pending_bytes -= _finish(pending.pop(0))
         for entry in pending:
@@ -1909,9 +2558,40 @@ def make_batched_call(
     if layout is None:
         layout = cache.layout
     groups = cache.groups if layout == "blockdiag" else 1
-    a_prep, survivors, row_of, use, w_true = _resolve_codec(
+    a_prep, survivors, row_of, use, w_true, place = _resolve_codec(
         cache, vid, requests, DATA_SHARDS, TOTAL_SHARDS, layout
     )
+    if place == "mesh":
+        # lane-sharded volume: the bench thunk runs the same ONE-call
+        # contract through the sharded twin (the serving path's calls
+        # route per-device; a homogeneous batch is one call there too)
+        calls, _subs = _pack_calls_sharded(
+            cache, requests, row_of, survivors, record_observed=False
+        )
+        if len(calls) != 1:
+            raise ValueError(
+                "bench batch must be one homogeneous bucket group"
+            )
+        kind, _p, cols, pad, fetch, tile, n_bucket, _d = calls[0]
+        key = _call_key(
+            kind, kernel, groups, w_true, tile, fetch, n_bucket,
+            len(use), a_prep.shape, int(survivors[0].size), interpret,
+            _key_place(cache, place),
+        )
+
+        def sharded_thunk():
+            vec = jax.device_put(
+                _stage_call_vec(kind, cols, pad),
+                NamedSharding(
+                    cache.mesh, P(mesh_mod.SHARD_AXIS, None, None)
+                ),
+            )
+            return _dispatch_call(
+                kind, vec, a_prep, survivors, len(use), w_true, groups,
+                tile, fetch, kernel, interpret, key=key, mesh=cache.mesh,
+            )
+
+        return sharded_thunk
     subs = _plan(requests)
     buckets = {s[4] for s in subs}
     if len(buckets) != 1 or len(subs) > COUNT_BUCKETS[-1]:
@@ -1940,11 +2620,23 @@ def make_batched_call(
     # DONATE it, so a captured device array would be invalid on the
     # second timed invocation — and shipping per call is exactly what
     # the serving path pays per batch, so the bench measures that too
+    key = _call_key(
+        kind, kernel, groups, w_true, tile, fetch,
+        pad + len(part), len(use), a_prep.shape,
+        int(survivors[0].size), interpret, _key_place(cache, place),
+    )
+
     def thunk():
-        vec = jnp.asarray(_stage_call_vec(kind, cols, pad))
+        vec_np = _stage_call_vec(kind, cols, pad)
+        if cache.mesh is not None:
+            vec = jax.device_put(
+                vec_np, cache.mesh.devices.reshape(-1)[int(place)]
+            )
+        else:
+            vec = jnp.asarray(vec_np)
         return _dispatch_call(
             kind, vec, a_prep, survivors, len(use), w_true, groups,
-            tile, fetch, kernel, interpret,
+            tile, fetch, kernel, interpret, key=key,
         )
 
     return thunk
@@ -2071,6 +2763,14 @@ def scrub_volume(
     )
     if any(s is None for s in data + parity):
         raise CacheMiss(f"vid {vid}: shard evicted mid-scrub")
+    if cache.vid_sharded(vid):
+        # lane-sharded buffers are stripe-PERMUTED on device: parity is
+        # byte-wise, so verifying the permuted layout is positionally
+        # consistent across shards — but a true_size-bounded span would
+        # cover an arbitrary stripe subset, so scrub the WHOLE padded
+        # buffer (the zero padding verifies trivially: parity of zeros
+        # is zero, identically placed in every shard)
+        true_size = int(data[0].size)
     if layout == "blockdiag":
         quant = cache.groups * LANE
         n_lanes = -(-true_size // quant) * quant
@@ -2222,9 +2922,13 @@ def scrub_all_resident(
     quant = groups * LANE
     if vids is None:
         vids = sorted(cache.resident_by_vid())
-    # (n_lanes, [(vid, shard tuple)]) stacks: only fully resident,
-    # uniform-size volumes qualify (same rule as scrub_volume)
-    stacks: dict[int, list[tuple[int, tuple]]] = {}
+    # ((n_lanes, placement), [(vid, shard tuple)]) stacks: only fully
+    # resident, uniform-size volumes qualify (same rule as
+    # scrub_volume).  Placement is part of the stack key: one
+    # _scrub_all_call's inputs must share a device set — stacking a
+    # device-0 whole-pin with a device-1 one (or a mesh-sharded volume)
+    # is a jit device-mismatch ValueError, not a slow path
+    stacks: dict[tuple[int, object], list[tuple[int, tuple]]] = {}
     for vid in vids:
         if cache.resident_count(vid) < total_shards:
             continue
@@ -2234,8 +2938,17 @@ def scrub_all_resident(
         shards = tuple(cache.get(vid, s) for s in range(total_shards))
         if any(s is None for s in shards):
             continue
-        n_lanes = -(-sizes.pop() // quant) * quant
-        stacks.setdefault(n_lanes, []).append((vid, shards))
+        size = sizes.pop()
+        if cache.vid_sharded(vid):
+            # permuted stripe layout: scrub the whole padded buffer
+            # (see scrub_volume — positional consistency holds, a
+            # true_size-bounded span would cover an arbitrary subset)
+            size = int(shards[0].size)
+        n_lanes = -(-size // quant) * quant
+        place = cache.placement(vid)
+        stacks.setdefault((n_lanes, 0 if place is None else place), []).append(
+            (vid, shards)
+        )
     parity_m = gf256.build_matrix(data_shards, total_shards)[data_shards:]
     # the SAME prepared system scrub_volume uses (one cached device
     # copy): volumes stack along lanes, never into a bigger matrix
@@ -2244,7 +2957,9 @@ def scrub_all_resident(
     )
     results: dict[int, tuple[list[int], int]] = {}
     device_calls = 0
-    for n_lanes, members in sorted(stacks.items()):
+    for (n_lanes, _place), members in sorted(
+        stacks.items(), key=lambda kv: (kv[0][0], str(kv[0][1]))
+    ):
         # bound the call's transient HBM (see _SCRUB_STACK_BYTES); the
         # step stays a power of two so the pow2 volume padding below
         # never re-crosses the byte cap
@@ -2395,9 +3110,11 @@ def warm(
                 return
             reqs = [(missing, off, size)] * count
             try:
-                calls, _subs, survivors, a_prep, use, w_true = _pack_calls(
-                    cache, vid, reqs, kernel, interpret, layout,
-                    DATA_SHARDS, total_shards, record_observed=False,
+                calls, _subs, survivors, a_prep, use, w_true, place = (
+                    _pack_calls(
+                        cache, vid, reqs, kernel, interpret, layout,
+                        DATA_SHARDS, total_shards, record_observed=False,
+                    )
                 )
             except CacheMiss:
                 # evicted under the planner: nothing to warm — reset the
@@ -2406,13 +3123,37 @@ def warm(
                 cache._set_aot_state(vid, "none")
                 return
             surv_len = int(survivors[0].size)
-            futures.extend(_schedule_aot_compiles([
+            key_place = _key_place(cache, place)
+            keys = [
                 _call_key(
                     kind, kernel, groups, w_true, tile, fetch, n_bucket,
                     len(use), a_prep.shape, surv_len, interpret,
+                    key_place,
                 )
                 for kind, _p, _c, _pad, fetch, tile, n_bucket, _d in calls
-            ]))
+            ]
+            if isinstance(key_place, int) and key_place >= 2:
+                # lane-sharded: the key's count bucket is the PER-DEVICE
+                # width — a live batch of `count` reads lands anywhere
+                # between ceil(count/n_dev) (spread) and count (every
+                # hot needle in one chunk) per device — and its
+                # fetch(=tile) can be any cover-ladder rung up to the
+                # probe's bucket (stripe-boundary splits shrink the
+                # span, backward alignment grows it to the full
+                # bucket).  Compile every (fetch rung, count rung at or
+                # below the probe's) so no distribution or boundary
+                # placement of a warmed batch width hits a cold shape
+                # (tile/fetch are key[3:5], n_bucket key[5])
+                keys = list(
+                    dict.fromkeys(
+                        key[:3] + (f, f, cb) + key[6:]
+                        for key in keys
+                        for f in _sharded_fetch_rungs(key[4])
+                        for cb in COUNT_BUCKETS
+                        if cb <= key[5]
+                    )
+                )
+            futures.extend(_schedule_aot_compiles(keys))
     if wait:
         for f in futures:
             f.result()

@@ -33,16 +33,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops import gf256
 from ..ops.rs_tpu import _pack_bits_bitmajor, _unpack_bits_bitmajor
 
-
-def make_mesh(
-    n_shard: int = 1, n_batch: int | None = None, devices=None
-) -> Mesh:
-    """(n_shard, n_batch) device mesh with axes ("shard", "batch")."""
-    devices = devices if devices is not None else jax.devices()
-    if n_batch is None:
-        n_batch = len(devices) // n_shard
-    devs = np.asarray(devices[: n_shard * n_batch]).reshape(n_shard, n_batch)
-    return Mesh(devs, axis_names=("shard", "batch"))
+# mesh construction lives in parallel/mesh.py (ONE home for axis names
+# and device ordering, shared with the r19 sharded serving layout);
+# re-exported here because every bulk call site imports it from this
+# module
+from .mesh import make_mesh  # noqa: F401  (re-export)
 
 
 def split_matrix_bitmajor(m_gf: np.ndarray, n_groups: int) -> jax.Array:
@@ -285,9 +280,7 @@ def _staged_worker_main(argv) -> None:
         process_id=args.pid,
     )
     devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
-    mesh = Mesh(
-        np.asarray(devs).reshape(args.nproc, -1), axis_names=("shard", "batch")
-    )
+    mesh = make_mesh(args.nproc, devices=devs)
 
     from ..ops import rs_cpu
     from ..ops.rs import RSCodec

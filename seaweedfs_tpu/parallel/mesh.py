@@ -1,0 +1,69 @@
+"""One home for device-mesh construction and axis naming.
+
+Both halves of the system place work on the same physical devices:
+
+  * the BULK plane (parallel/distributed.py) runs encode/rebuild as a
+    (shard, batch) shard_map with a psum over the "shard" axis;
+  * the SERVING plane (ops/rs_resident.py, r19) lane-shards resident
+    EC volumes across the mesh under ``PartitionSpec("shard")`` and
+    runs the batched reconstruct as one cross-device program.
+
+Before r19 each would have built its own ``Mesh(devs, ...)`` — two
+copies of the axis-naming and device-ordering conventions that MUST
+agree (an AOT executable compiled against one mesh object serves calls
+whose arrays were placed with another only if the two resolve to the
+same devices in the same order).  This module is the single home:
+`make_mesh` is the 2-D bulk constructor, `serving_mesh` the cached 1-D
+serving constructor, and both use the same "shard" axis name.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+SHARD_AXIS = "shard"
+BATCH_AXIS = "batch"
+
+
+def make_mesh(n_shard: int = 1, n_batch: int | None = None, devices=None):
+    """(n_shard, n_batch) device mesh with axes ("shard", "batch") —
+    the bulk-plane constructor (encode/rebuild psum over "shard",
+    data-parallel over "batch")."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = devices if devices is not None else jax.devices()
+    if n_batch is None:
+        n_batch = len(devices) // n_shard
+    devs = np.asarray(devices[: n_shard * n_batch]).reshape(n_shard, n_batch)
+    return Mesh(devs, axis_names=(SHARD_AXIS, BATCH_AXIS))
+
+
+def local_device_count() -> int:
+    """Devices addressable by this process (the serving mesh's ceiling)."""
+    import jax
+
+    return jax.local_device_count()
+
+
+@functools.lru_cache(maxsize=8)
+def serving_mesh(n_devices: int = 0):
+    """Cached 1-D mesh over the first `n_devices` local devices (0 = all)
+    with the single axis ("shard",) — the resident-serving layout's
+    mesh.  Cached so every call site (put-time placement, the sharded
+    reconstruct kernels, AOT shape compiles) shares ONE Mesh object:
+    jax hashes meshes by identity-equivalent content, and handing the
+    compile path a different-but-equal mesh would still fracture the
+    jit cache.  Returns None when the resolved mesh would be a single
+    device — a 1-wide mesh only adds shard_map overhead over the plain
+    single-device path."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.local_devices()
+    if n_devices > 0:
+        devs = devs[:n_devices]
+    if len(devs) < 2:
+        return None
+    return Mesh(np.asarray(devs), axis_names=(SHARD_AXIS,))

@@ -182,6 +182,13 @@ class VolumeServer:
             device_cache = DeviceShardCache(
                 budget_bytes=ec_device_cache_mb << 20,
                 layout=ec_serving.layout,
+                # pod-scale mesh residency (-ec.serving.mesh.*): lane-
+                # shard resident volumes across the local device mesh;
+                # None keeps the single-device layout
+                mesh_devices=(
+                    ec_serving.mesh_devices if ec_serving.mesh else None
+                ),
+                mesh_min_shard_bytes=ec_serving.mesh_min_shard_mb << 20,
             )
             device_cache.pipeline.set_slots(ec_serving.pipeline_slots)
             # -ec.serving.aot.disable: inline compiles instead of the
@@ -585,6 +592,11 @@ class VolumeServer:
             tel.device_resident_shards = n_resident
             tel.device_evictions = cache.evictions
             tel.device_pin_claims = cache.pin_claims
+            # per-device breakdown (r19 mesh layout): index-ordered so
+            # the master can show which chip a lopsided mesh is full on
+            tel.device_bytes_per_device.extend(
+                d["used_bytes"] for d in cache.device_stats()
+            )
             for vid, sids in cache.resident_by_vid().items():
                 tel.resident_shards_by_volume[vid] = len(sids)
         g = stats.REGISTRY.get_sample_value

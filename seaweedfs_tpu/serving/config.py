@@ -54,6 +54,22 @@ class ServingConfig:
     # dispatcher 20-40s behind an inline compile.  False restores the
     # legacy trace-and-execute warm and inline compiles.
     aot: bool = True
+    # pod-scale mesh residency (-ec.serving.mesh.disable): lane-shard
+    # resident volumes across the local device mesh under
+    # PartitionSpec("shard") so a volume's resident capacity is the
+    # WHOLE mesh's HBM, not one chip's, and batched reconstruct lane
+    # work runs 1/n per device.  False pins volumes whole onto the
+    # default device (the pre-r19 layout).  Only takes effect when >1
+    # local device is visible.
+    mesh: bool = True
+    # devices the serving mesh may span (-ec.serving.mesh.devices):
+    # 0 = every local device, n = the first n
+    mesh_devices: int = 0
+    # volumes whose shard files are smaller than this pin whole onto
+    # the least-loaded mesh device instead of lane-sharding
+    # (-ec.serving.mesh.minShardMB): spreading a tiny volume across the
+    # mesh buys no capacity and pays cross-device dispatch per batch
+    mesh_min_shard_mb: int = 8
     # zero-copy response writes (-ec.serving.zerocopy.disable): needle
     # payloads stay memoryviews over the reconstruct/pread buffers all
     # the way into the aiohttp body write; False restores the legacy
@@ -153,6 +169,10 @@ class ServingConfig:
             raise ValueError("max_wait_us must be >= 0")
         if self.layout not in ("flat", "blockdiag"):
             raise ValueError("layout must be 'flat' or 'blockdiag'")
+        if self.mesh_devices < 0:
+            raise ValueError("mesh_devices must be >= 0 (0 = all local)")
+        if self.mesh_min_shard_mb < 0:
+            raise ValueError("mesh_min_shard_mb must be >= 0")
         if self.qos_interactive_queue < 1 or self.qos_bulk_queue < 1:
             raise ValueError("qos tier queue budgets must be >= 1")
         if (

@@ -101,6 +101,15 @@ class EcReadDispatcher:
         batch overwrites it — a restarted server must report idle."""
         stats.VOLUME_SERVER_EC_BATCH_INFLIGHT.set(0)
         stats.VOLUME_SERVER_EC_QUEUE_DEPTH.set(0)
+        # the per-device residency series (r19 mesh layout) follows the
+        # same contract: a restarted server's devices report empty
+        # until its pin threads repopulate them
+        cache = getattr(self.store, "ec_device_cache", None)
+        if cache is not None:
+            for d in range(cache.n_devices):
+                stats.VOLUME_SERVER_EC_DEVICE_CACHE_BYTES.labels(
+                    device=str(d)
+                ).set(0)
         self.qos.shutdown()
 
     # ------------------------------------------------------------- admission

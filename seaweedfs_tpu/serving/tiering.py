@@ -378,26 +378,34 @@ class TieringController:
         return out, meta
 
     @staticmethod
-    def _est_bytes(cache, meta: tuple[int, int]) -> int:
-        """Padded device bytes a full pin would hold, from a locked
-        (shard count, shard size) snapshot: the budget-fit arithmetic
-        promotions and pressure demotions share."""
+    def _pin_need(cache, vid: int, meta: tuple[int, int]) -> dict[int, int]:
+        """device -> padded bytes a full pin of `vid` would add,
+        previewing the cache's placement rule (mesh-sharded volumes
+        split evenly, small ones land whole on the least-loaded device
+        — unless `vid` still holds a placement claim, which the pin
+        will follow): the budget-fit arithmetic promotions and
+        pressure demotions share.  Empty dict = nothing to pin
+        (unknown sizing)."""
         n, shard_size = meta
         if not n or not shard_size:
-            return 0
-        return n * cache._padded_len(shard_size)
+            return {}
+        return cache.plan_pin(n, shard_size, vid=vid)
 
     @staticmethod
-    def _resident_bytes(cache, vid: int, meta: tuple[int, int]) -> int:
-        """Padded device bytes ACTUALLY held by `vid` right now — what a
-        demotion truly frees.  A partially resident victim (earlier LRU
-        pressure ate some shards) holds less than a full pin would, and
-        overestimating `freed` would let a swap overflow the budget
-        into the blind per-shard LRU eviction the ladder replaces."""
-        _n, shard_size = meta
-        if not shard_size:
-            return 0
-        return cache.resident_count(vid) * cache._padded_len(shard_size)
+    def _fits(cache, need: dict[int, int], freed: dict[int, int]) -> bool:
+        """Would `need` fit every device it lands on, after `freed`
+        bytes per device are released?  Judged against the PER-DEVICE
+        budget (r19): an aggregate-fits answer would still overflow the
+        one chip a whole-pin lands on and hand eviction back to the
+        blind per-shard LRU."""
+        if not need:
+            return False
+        budget = cache.device_budget
+        stats = cache.device_stats()
+        return all(
+            stats[d]["used_bytes"] - freed.get(d, 0) + add <= budget
+            for d, add in need.items()
+        )
 
     def tier_of(self, vid: int) -> str:
         """Delegates to Store.ec_volume_tier — ONE home for the
@@ -553,22 +561,41 @@ class TieringController:
                 >= cfg.tier_min_residency_seconds
             )
 
-        # 1. PRESSURE: over budget -> demote coldest residents until the
-        # estimated working set fits.  Ignores the min-residency floor:
-        # staying over budget would hand control back to the blind
-        # per-shard LRU eviction in DeviceShardCache.put.
+        # 1. PRESSURE: any device over ITS budget -> demote coldest
+        # residents actually HOLDING bytes on the fullest over-budget
+        # device (r19 per-device accounting: demoting a volume parked
+        # on an idle chip frees nothing where the pressure is).
+        # Ignores the min-residency floor: staying over budget would
+        # hand control back to the blind per-shard LRU eviction in
+        # DeviceShardCache.put.
         def hbm_residents() -> list[int]:
             return [vid for vid in vols if resident(vid)]
 
-        while cache.bytes_used > cache.budget:
-            pool = hbm_residents()
+        while True:
+            pressure = cache.pressure_devices()
+            if not pressure:
+                break
+            dev = pressure[0]  # fullest first
+            # one locked footprint snapshot per demotion round (a
+            # per-volume vid_device_bytes probe would rescan the whole
+            # map under the serving-path lock once per resident)
+            foot = cache.device_bytes_by_vid()
+
+            def on_dev(v: int) -> bool:
+                return bool(foot.get(v, {}).get(dev))
+
+            pool = [v for v in hbm_residents() if on_dev(v)]
             if not pool:
                 # partial shard sets (mount pins racing the LRU, or a
                 # budget shrink mid-pin) hold device bytes without ever
                 # serving a reconstruct: under pressure they are pure
                 # waste — evict them too, or the orphaned bytes block
                 # every future promotion forever
-                pool = [v for v in vols if cache.resident_count(v) > 0]
+                pool = [
+                    v
+                    for v in vols
+                    if cache.resident_count(v) > 0 and on_dev(v)
+                ]
             if not pool:
                 break
             vid = min(pool, key=lambda v: (heat.get(v, 0.0), v))
@@ -597,10 +624,10 @@ class TieringController:
                 < PROMOTE_FAILURE_BACKOFF_S
             ):
                 continue  # recent pin failure: don't burn a victim on it
-            need = self._est_bytes(cache, meta[vid])
+            need = self._pin_need(cache, vid, meta[vid])
             if not need:
                 continue
-            if cache.bytes_used + need <= cache.budget:
+            if self._fits(cache, need, {}):
                 if self._promote_hbm(vols[vid], now):
                     moves.append(("promote_hbm", vid))
                 continue
@@ -615,9 +642,27 @@ class TieringController:
             # threshold, so equally hot volumes never flap) to actually
             # FIT the candidate before demoting anything: a one-victim
             # swap that still overflowed would hand eviction back to
-            # the blind per-shard LRU in DeviceShardCache.put
+            # the blind per-shard LRU in DeviceShardCache.put.  Only
+            # volumes holding bytes on a device the candidate still
+            # lacks headroom on count (r19): demoting a resident parked
+            # on an idle chip frees nothing where the pin lands, loses
+            # its HBM residency for nothing, and can exhaust the victim
+            # cap before a useful victim is ever reached.
+            budget = cache.device_budget
+
+            def still_tight(freed: dict[int, int]) -> set[int]:
+                stats = cache.device_stats()
+                return {
+                    d
+                    for d, add in need.items()
+                    if stats[d]["used_bytes"] - freed.get(d, 0) + add
+                    > budget
+                }
+
             victims: list[int] = []
-            freed = 0
+            freed: dict[int, int] = {}
+            # one locked footprint snapshot for the whole victim scan
+            foot = cache.device_bytes_by_vid()
             for v in sorted(
                 (v for v in hbm_residents() if age_ok(v)),
                 key=lambda v: (heat.get(v, 0.0), v),
@@ -626,13 +671,19 @@ class TieringController:
                     heat.get(v, 0.0), 1e-9
                 ) or len(victims) >= MAX_SWAP_VICTIMS:
                     break  # remaining victims are hotter still / capped
+                # freed = bytes the victim ACTUALLY holds per device —
+                # a partially resident victim frees less than a full
+                # pin's estimate, and bytes freed on an idle chip do
+                # not make room where the candidate lands
+                held = foot.get(v, {})
+                if not any(d in still_tight(freed) for d in held):
+                    continue  # holds nothing where room is still needed
                 victims.append(v)
-                # freed = bytes the victim ACTUALLY holds — a partially
-                # resident victim frees less than a full pin's estimate
-                freed += self._resident_bytes(cache, v, meta[v])
-                if cache.bytes_used - freed + need <= cache.budget:
+                for d, b in held.items():
+                    freed[d] = freed.get(d, 0) + b
+                if self._fits(cache, need, freed):
                     break
-            if not victims or cache.bytes_used - freed + need > cache.budget:
+            if not victims or not self._fits(cache, need, freed):
                 # cannot fit THIS candidate without demoting something
                 # too hot — but a colder, smaller candidate further down
                 # may still fit the free budget, so keep scanning (the

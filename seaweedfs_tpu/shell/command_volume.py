@@ -820,7 +820,8 @@ async def cmd_volume_configure_replication(env, args):
 async def cmd_volume_device_status(env, args):
     """[-node <host:port>] [-hot [N]] : per-node device shard-cache
     status from the master's telemetry plane — HBM used/budget/
-    headroom, resident shard counts per EC volume, compile-cache
+    headroom (aggregate AND one row per mesh device under the r19
+    sharded layout), resident shard counts per EC volume, compile-cache
     hit/miss, evictions, pin claims.  -hot additionally fetches each
     node's /debug/device/hot: the per-call-shape dispatch counters and
     latency EWMAs, hottest first — "what shape is the device actually
@@ -863,6 +864,16 @@ async def cmd_volume_device_status(env, args):
             f"compile_cache="
             f"{'on' if dev.get('compile_cache_enabled') else 'OFF'}"
         )
+        # per-device breakdown (r19 mesh residency): a lopsided mesh —
+        # whole-pins crowding one chip while the lane-sharded volumes
+        # spread evenly — shows as one row per device, not an aggregate
+        for row in dev.get("per_device", []):
+            env.write(
+                f"  device {row['device']}: "
+                f"{fmt_bytes(row['used_bytes'])}"
+                f"/{fmt_bytes(row['budget_bytes'])} "
+                f"(headroom {fmt_bytes(row['headroom_bytes'])})"
+            )
         for vid, count in dev["resident_shards_by_volume"].items():
             env.write(f"  ec volume {vid}: {count} resident shards")
         if hot_limit and not n["stale"]:

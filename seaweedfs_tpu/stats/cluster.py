@@ -227,6 +227,9 @@ class NodeTelemetry:
     tier_promotions: int = 0
     tier_demotions: int = 0
     tier_host_bytes: int = 0
+    # per-device residency breakdown (r19 mesh layout), index-ordered:
+    # one entry per serving-mesh device; [] = no cache / pre-r19 server
+    device_bytes_per_device: list[int] = field(default_factory=list)
     resident_by_volume: dict[int, int] = field(default_factory=dict)
 
     def to_dict(self, now: float, stale_after: float) -> dict[str, Any]:
@@ -254,6 +257,22 @@ class NodeTelemetry:
                     str(v): n for v, n in sorted(self.resident_by_volume.items())
                 },
             }
+            if self.device_bytes_per_device:
+                # the device-axis breakdown: per-device used/budget so a
+                # lopsided mesh (one chip full, others idle) reads off
+                # cluster.health instead of hiding in the aggregate
+                per = self.device_budget_bytes // max(
+                    1, len(self.device_bytes_per_device)
+                )
+                d["device"]["per_device"] = [
+                    {
+                        "device": i,
+                        "used_bytes": used,
+                        "budget_bytes": per,
+                        "headroom_bytes": max(0, per - used),
+                    }
+                    for i, used in enumerate(self.device_bytes_per_device)
+                ]
             d["dispatcher"] = {
                 "queue_depth": self.dispatcher_queue_depth,
                 "inflight": self.dispatcher_inflight,
@@ -368,6 +387,10 @@ class ClusterTelemetry:
             nt.tier_promotions = int(getattr(tel, "tier_promotions", 0))
             nt.tier_demotions = int(getattr(tel, "tier_demotions", 0))
             nt.tier_host_bytes = int(getattr(tel, "tier_host_bytes", 0))
+            # getattr-guarded: pre-r19 servers lack the per-device axis
+            nt.device_bytes_per_device = [
+                int(b) for b in getattr(tel, "device_bytes_per_device", ())
+            ]
             nt.resident_by_volume = dict(tel.resident_shards_by_volume)
             n_buckets = len(STAGE_SECONDS_BUCKETS) + 1
             for d in tel.stage_digests:

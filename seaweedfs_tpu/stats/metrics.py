@@ -351,6 +351,20 @@ VOLUME_SERVER_EC_DEVICE_D2H_BYTES = Counter(
     "(the reconstructed intervals).",
     registry=REGISTRY,
 )
+# per-device residency of the shard cache (r19 mesh layout): one series
+# per mesh device, so a lopsided mesh — whole-pins crowding one chip
+# while lane-sharded volumes spread evenly — is visible as a device-axis
+# breakdown instead of hiding inside the aggregate used-bytes gauge.
+# Labels are device indices within the serving mesh ("0".."n-1"),
+# registered lazily at cache construction (the mesh width is a runtime
+# property, not an import-time constant).
+VOLUME_SERVER_EC_DEVICE_CACHE_BYTES = Gauge(
+    "SeaweedFS_volumeServer_ec_device_cache_bytes",
+    "Padded EC shard-cache bytes resident per serving-mesh device "
+    "(device = mesh index; the sum over devices is device_used_bytes).",
+    ["device"],
+    registry=REGISTRY,
+)
 VOLUME_SERVER_EC_DEVICE_COMPILE = Counter(
     "SeaweedFS_volumeServer_ec_device_compile",
     "Resident EC reconstruct device calls by compile-cache outcome: "

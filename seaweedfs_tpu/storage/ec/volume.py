@@ -271,8 +271,13 @@ class EcVolume:
         if self.device_cache.claim_pin_source(self.id, self.dir) != self.dir:
             return 0
         n = 0
-        # snapshot: mount RPCs may add shards while a pin thread iterates
-        for sid, shard in list(self.shards.items()):
+        # snapshot: mount RPCs may add shards while a pin thread iterates.
+        # Sorted by shard id: puts claim the volume's mesh placement on
+        # first touch (rs_resident r19) and budget pressure evicts in
+        # LRU(=pin) order, so a deterministic order keeps restarts and
+        # the tiering ladder's plan_pin previews reproducible instead
+        # of following mount-RPC arrival order
+        for sid, shard in sorted(self.shards.items()):
             if should_stop is not None and should_stop():
                 break
             if self.device_cache.get(self.id, sid) is None:

@@ -1,0 +1,41 @@
+"""GL115 seed: jax.device_put without an explicit sharding/device.
+
+Three violations; the placed forms below them must stay clean."""
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def bare_put(padded):
+    return jax.device_put(padded)  # GL115: lands on the default device
+
+
+def bare_put_short_name(padded):
+    from jax import device_put
+
+    return device_put(padded)  # GL115: same, imported name
+
+
+def bare_put_in_loop(shards):
+    out = []
+    for s in shards:
+        out.append(jax.device_put(np.asarray(s, dtype=np.uint8)))  # GL115
+    return out
+
+
+def placed_on_mesh(padded, mesh):
+    return jax.device_put(padded, NamedSharding(mesh, P("shard")))  # clean
+
+
+def placed_on_device(padded, dev):
+    return jax.device_put(padded, device=dev)  # clean
+
+
+def placed_positional(padded, dev):
+    return jax.device_put(padded, dev)  # clean
+
+
+def waived_default_staging(vec):
+    # graftlint: allow(unsharded-device-put): single-device CI rig —
+    # the comparison axis deliberately stages on the default device
+    return jax.device_put(vec)

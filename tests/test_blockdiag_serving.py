@@ -101,6 +101,45 @@ class TestBlockdiagReconstruct:
             )
             assert out == shards[0][17 : 17 + big].tobytes()
 
+    def test_sharded_layouts_equal_single_device_and_oracle(self, coded):
+        """r19: the mesh-sharded twins (flat AND blockdiag) serve the
+        same bytes as the single-device kernels and the encode oracle —
+        including requests the planner splits at per-device chunk
+        boundaries."""
+        single = fill_cache(coded, missing=(3,))
+        caches = {
+            layout: rs_resident.DeviceShardCache(
+                shard_quantum=1 << 20, layout=layout,
+                mesh_devices=0, mesh_min_shard_bytes=0,
+            )
+            for layout in ("flat", "blockdiag")
+        }
+        for cache in caches.values():
+            for sid in range(coded.shape[0]):
+                if sid != 3:
+                    cache.put(7, sid, coded[sid])
+        length = coded.shape[1]
+        rng = random.Random(12)
+        # (chunk-boundary straddles need data longer than one per-device
+        # chunk — test_mesh_serving covers them with a 4MB volume; this
+        # fixture's 300KB sits inside chunk 0)
+        reqs = [
+            (3, 5, 4096),
+            (3, length // 2 - 99, 4096),
+            (3, length - 900, 900),
+        ] + [
+            (3, rng.randrange(0, length - 70000), rng.choice([512, 4096, 33000]))
+            for _ in range(20)
+        ]
+        want = rs_resident.reconstruct_intervals(single, 7, reqs)
+        for layout, cache in caches.items():
+            assert cache.placement(7) == "mesh"
+            outs = rs_resident.reconstruct_intervals(cache, 7, reqs)
+            for (sid, off, size), out, w in zip(reqs, outs, want):
+                assert out == w == coded[sid][off : off + size].tobytes(), (
+                    f"sharded {layout} drifted at off={off} size={size}"
+                )
+
     def test_layout_flat_blockdiag_equal(self, coded):
         """Same cache bytes, both layouts, byte-identical results — the
         layout knob must never change what a read returns."""

@@ -94,6 +94,7 @@ def test_every_rule_fires_on_its_corpus_fixture(corpus_findings):
         ("GL112", "case_flag_drift"),
         ("GL113", "case_unused_waiver"),
         ("GL114", "case_unbounded_rpc"),
+        ("GL115", "case_unsharded_device_put"),
     ],
 )
 def test_rule_fires_in_the_named_case_file(
@@ -125,6 +126,7 @@ def test_seeded_counts_are_exact(corpus_findings):
         "GL112": 2,  # no README row + no config mention (one flag, both)
         "GL113": 1,  # the stale waiver
         "GL114": 3,  # bare unary, unbounded stream, closure-built call
+        "GL115": 3,  # bare put, imported-name put, loop-staged put
     }, by_rule
 
 

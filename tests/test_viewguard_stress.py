@@ -252,6 +252,132 @@ def test_zero_copy_ec_reads_race_eviction_under_viewguard(tmp_path):
     g.assert_clean()
 
 
+def test_sharded_zero_copy_reads_race_eviction_and_warm(tmp_path):
+    """r19 mesh-layout race: readers pull zero-copy batches through the
+    LANE-SHARDED reconstruct while an evictor cycles shards across the
+    per-device budgets AND a warm thread keeps re-arming the sharded
+    AOT plan.  Every successful read is byte-exact (views verified at
+    release), losses are clean CacheMiss (ColdShape sheds included —
+    the host path serves the same bytes), never stale bytes."""
+    v, blobs = _make_volume(tmp_path, vid=VID)
+    base = Volume.base_name(v.dir, v.id, v.collection)
+    ec.write_ec_files(base, backend="cpu")
+    ec.write_sorted_file_from_idx(base)
+    v.close()
+
+    errors: list[BaseException] = []
+    good_reads = 0
+    clean_misses = 0
+    stop = threading.Event()
+    lock = threading.Lock()
+
+    with viewguard.watch() as g:
+        ev = ec.EcVolume(str(tmp_path), v.id)
+        for sid in range(14):
+            if sid != MISSING:
+                ev.add_shard(sid)
+        cache = rs_resident.DeviceShardCache(
+            shard_quantum=1 << 20, layout="blockdiag",
+            mesh_devices=0, mesh_min_shard_bytes=0,
+        )
+        cache.warm_sizes = (4096,)
+        cache.warm_counts = (4,)
+        ev.load_shards_to_device(cache)
+        assert cache.placement(VID) == "mesh"
+        # per-device budget of 12 of the 13 pinned shards' chunks:
+        # every re-pin crosses the per-device budgets and evicts the
+        # LRU sharded entry on EVERY device at once
+        cache.budget = (cache.bytes_used // 13) * 12
+
+        nids = sorted(blobs)
+
+        def reader(seed: int):
+            nonlocal good_reads, clean_misses
+            rng = random.Random(seed)
+            deadline = time.time() + 20
+            mine = 0
+            while time.time() < deadline and mine < 8:
+                batch = rng.sample(nids, 3)
+                try:
+                    out = ev.read_needles_batch(
+                        batch, backend="cpu", zero_copy=True
+                    )
+                except rs_resident.CacheMiss:
+                    with lock:
+                        clean_misses += 1
+                    time.sleep(0.01)
+                    continue
+                except BaseException as e:  # noqa: BLE001 — collected
+                    errors.append(e)
+                    return
+                for nid, res in zip(batch, out):
+                    if isinstance(res, rs_resident.CacheMiss):
+                        with lock:
+                            clean_misses += 1
+                        continue
+                    if isinstance(res, Exception):
+                        errors.append(res)
+                        return
+                    want = blobs[nid][1]
+                    if bytes(res.data) != want:
+                        errors.append(
+                            AssertionError(f"stale bytes for {nid}")
+                        )
+                        return
+                    if isinstance(res.data, memoryview):
+                        g.release(res.data)
+                mine += 1
+                with lock:
+                    good_reads += 1
+
+        def evictor():
+            i = 0
+            sids = [s for s in range(14) if s != MISSING]
+            while not stop.is_set():
+                sid = sids[i % len(sids)]
+                try:
+                    cache.put(
+                        VID, sid,
+                        np.fromfile(ev.shards[sid].path, dtype=np.uint8),
+                    )
+                except BaseException as e:  # noqa: BLE001 — collected
+                    errors.append(e)
+                    return
+                i += 1
+
+        def warmer():
+            while not stop.is_set():
+                try:
+                    rs_resident.warm(
+                        cache, VID, sizes=cache.warm_sizes,
+                        counts=cache.warm_counts, aot=True, wait=False,
+                    )
+                except BaseException as e:  # noqa: BLE001 — collected
+                    errors.append(e)
+                    return
+                time.sleep(0.05)
+
+        threads = [
+            threading.Thread(target=reader, args=(5,), name="s-reader"),
+            threading.Thread(target=reader, args=(6,), name="s-reader2"),
+            threading.Thread(target=evictor, name="s-evictor"),
+            threading.Thread(target=warmer, name="s-warmer"),
+        ]
+        for t in threads:
+            t.start()
+        threads[0].join()
+        threads[1].join()
+        stop.set()
+        threads[2].join()
+        threads[3].join()
+        ev.close()
+
+    assert not errors, errors
+    assert good_reads > 0
+    assert g.exports_total > 0, "no zero-copy views were ever tracked"
+    g.assert_clean()
+
+
 # ------------------------------------------- tier promote/demote race
 
 

@@ -173,6 +173,16 @@ UNBOUNDED_RPC = register(
     "deliberately unbounded long-lived streams carry a reasoned waiver",
     "await stub.VolumeEcShardsCopy(req)  # no timeout",
 )
+UNSHARDED_DEVICE_PUT = register(
+    "GL115",
+    "unsharded-device-put",
+    "a jax.device_put in the serving/ops/parallel scope without an "
+    "explicit sharding/device argument — the buffer lands on the "
+    "default device regardless of the mesh layout, silently crowding "
+    "device 0 and breaking the per-device budget accounting the r19 "
+    "sharded residency relies on",
+    "arr = jax.device_put(padded)  # no sharding/device",
+)
 
 
 def rule_table_markdown() -> str:

@@ -19,6 +19,7 @@ from .model import (
     SILENT_SWALLOW,
     STAGE_REGISTRY,
     UNBOUNDED_RPC,
+    UNSHARDED_DEVICE_PUT,
     Finding,
 )
 
@@ -538,6 +539,58 @@ _BOUNDED_WRAPPERS = {"wait_for", "retry_rpc"}
 def in_rpc_scope(path: str) -> bool:
     p = path.replace("\\", "/")
     return any(part in p for part in RPC_SCOPE_PARTS)
+
+
+# ------------------------------------------------ GL115 unsharded-device-put
+
+# modules where buffer PLACEMENT is policy: the resident serving layout
+# (ops), its mesh helpers (parallel), and the serving plane.  A bare
+# jax.device_put(x) here lands on the default device no matter what the
+# mesh layout says — it crowds device 0 past its per-device budget and
+# the r19 accounting/eviction never sees the bytes where they actually
+# are.  Every put must say where: a Sharding (NamedSharding for the
+# lane-sharded layout) or an explicit device.  storage/ec's bulk legs
+# stay out of scope — the bulk executor feeds single jit calls whose
+# inputs the default device is correct for.
+DEVICE_PUT_SCOPE_PARTS = (
+    "seaweedfs_tpu/ops/",
+    "seaweedfs_tpu/serving/",
+    "seaweedfs_tpu/parallel/",
+    "lint_corpus",
+)
+
+
+def in_device_put_scope(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return any(part in p for part in DEVICE_PUT_SCOPE_PARTS)
+
+
+def check_unsharded_device_put(
+    tree: ast.Module, path: str
+) -> Iterator[Finding]:
+    """`jax.device_put(x)` (or `device_put(x)`) without a second
+    positional argument or a `device=` keyword in the placement-policy
+    scope is a finding — the placement must be explicit."""
+    if not in_device_put_scope(path):
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func) or ""
+        if name.rsplit(".", 1)[-1] != "device_put":
+            continue
+        if len(node.args) >= 2 or any(
+            kw.arg == "device" for kw in node.keywords
+        ):
+            continue
+        yield Finding(
+            UNSHARDED_DEVICE_PUT.rule_id, path, node.lineno,
+            "jax.device_put without an explicit sharding/device lands "
+            "on the default device regardless of the mesh layout — "
+            "pass a NamedSharding (lane-sharded residency), the owning "
+            "device, or waive a deliberate default-device staging with "
+            "a reason",
+        )
 
 
 def check_unbounded_rpc(
