@@ -62,6 +62,19 @@ def add_args(p) -> None:
         help="host:port of an S3 gateway; also sweep GetObject through it",
     )
     p.add_argument("-bucket", default="loadtest")
+    p.add_argument(
+        "-mixed", dest="write_frac", type=float, default=0.0,
+        help="also sweep a MIXED leg where this fraction of ops are "
+        "uploads (reference `weed benchmark` shape) — written keys "
+        "feed back into the read key stream and reads are verified "
+        "while writes stream-encode under them",
+    )
+    p.add_argument(
+        "-writeSizes", dest="write_sizes", default="",
+        help="comma-separated upload payload sizes for the mixed leg, "
+        "sampled uniformly (a discrete size distribution; default: "
+        "-size)",
+    )
 
 
 async def _fill(master: str, count: int, size: int, collection: str) -> dict:
@@ -117,6 +130,29 @@ async def run(args) -> None:
         curve[str(c)] = res.summary()
         print(json.dumps({"http_level": curve[str(c)]}))
 
+    mixed_curve = {}
+    if args.write_frac > 0:
+        from ..loadgen import run_mixed_http_load
+
+        if not 0 < args.write_frac <= 1:
+            raise SystemExit("-mixed must be in (0, 1]")
+        sizes = [
+            int(s) for s in args.write_sizes.split(",") if s.strip()
+        ] or [args.size]
+        for c in levels:
+            sc = LoadScenario(
+                connections=c, reads=args.reads, zipf_s=args.zipf_s,
+                slow_client_frac=args.slow_frac, churn=args.churn,
+                tier=args.tier, oversubscribe=args.oversubscribe,
+                write_frac=args.write_frac, write_sizes=sizes,
+            )
+            res = await run_mixed_http_load(
+                args.master, volume_url, blobs, sc,
+                collection=args.collection,
+            )
+            mixed_curve[str(c)] = res.summary()
+            print(json.dumps({"mixed_level": mixed_curve[str(c)]}))
+
     s3_curve = {}
     if args.s3:
         import aiohttp
@@ -149,5 +185,13 @@ async def run(args) -> None:
         "reads_per_level": args.reads,
         "oversubscribe": args.oversubscribe,
         "http_curve": {c: r["reads_per_s"] for c, r in curve.items()},
+        "mixed_curve": {
+            c: {
+                "reads_per_s": r["reads_per_s"],
+                "writes_per_s": r.get("writes_per_s", 0.0),
+                "ingest_mb_per_s": r.get("ingest_mb_per_s", 0.0),
+            }
+            for c, r in mixed_curve.items()
+        },
         "s3_curve": {c: r["reads_per_s"] for c, r in s3_curve.items()},
     }))

@@ -265,6 +265,79 @@ def add_args(p) -> None:
         "attempts — a sick peer degrades into fast-fail instead of a "
         "retry storm (0 disables retries)",
     )
+    # streaming ingest plane (ingest/): writes EC-encode on the device
+    # as they land; IngestConfig is the single source of the defaults
+    from ..ingest import IngestConfig
+
+    ingest_defaults = IngestConfig()
+    p.add_argument(
+        "-ec.ingest.disable", dest="ec_ingest_disable",
+        action="store_true",
+        help="disable the streaming write-path EC encode: every volume "
+        "reverts to the after-the-fact bulk encode at ec.encode time",
+    )
+    p.add_argument(
+        "-ec.ingest.backend", dest="ec_ingest_backend",
+        default=ingest_defaults.backend,
+        choices=["auto", "cpu", "native", "numpy", "xla", "pallas"],
+        help="codec backend for the streaming row encode (auto = device "
+        "when one is visible, else the native/numpy host kernel)",
+    )
+    p.add_argument(
+        "-ec.ingest.arenaSlots", dest="ec_ingest_arena_slots", type=int,
+        default=ingest_defaults.arena_slots,
+        help="staged 10MB row buffers per actively-written volume; the "
+        "pool is the ingest backpressure — a writer that cannot stage "
+        "blocks until the encode leg drains",
+    )
+    p.add_argument(
+        "-ec.ingest.backpressureMs", dest="ec_ingest_backpressure_ms",
+        type=int, default=ingest_defaults.backpressure_ms,
+        help="how long a writer may block on a free staging row before "
+        "the volume falls back to the offline encode at seal",
+    )
+    p.add_argument(
+        "-ec.ingest.fsync", dest="ec_ingest_fsync", action="store_true",
+        help="group-commit durability: writers ack from a batched fsync "
+        "instead of the page cache",
+    )
+    p.add_argument(
+        "-ec.ingest.fsyncMaxBatch", dest="ec_ingest_fsync_max_batch",
+        type=int, default=ingest_defaults.fsync_max_batch,
+        help="writers per group-commit fsync batch before it fires",
+    )
+    p.add_argument(
+        "-ec.ingest.fsyncMaxDelayMs", dest="ec_ingest_fsync_max_delay_ms",
+        type=float, default=ingest_defaults.fsync_max_delay_ms,
+        help="longest a group-commit writer lingers for batch-mates "
+        "before the fsync fires anyway",
+    )
+    p.add_argument(
+        "-ec.ingest.minRateKBps", dest="ec_ingest_min_rate_kbps",
+        type=int, default=ingest_defaults.min_rate_kbps,
+        help="deadline doom check: refuse an upload at the door when its "
+        "size over this floor rate exceeds the request's remaining "
+        "X-Seaweed-Deadline-Ms budget (0 disables)",
+    )
+    p.add_argument(
+        "-ec.ingest.interactiveQueue", dest="ec_ingest_interactive_queue",
+        type=int, default=ingest_defaults.interactive_queue,
+        help="max interactive-tier writes queued at admission "
+        "(X-Seaweed-QoS header absent or 'interactive')",
+    )
+    p.add_argument(
+        "-ec.ingest.bulkQueue", dest="ec_ingest_bulk_queue", type=int,
+        default=ingest_defaults.bulk_queue,
+        help="max bulk-tier writes queued at admission (multipart parts, "
+        "batch loaders) — a narrow slice so loader floods can't crowd "
+        "out interactive PUTs",
+    )
+    p.add_argument(
+        "-ec.ingest.deadlineMs", dest="ec_ingest_deadline_ms", type=int,
+        default=ingest_defaults.deadline_ms,
+        help="per-tier write admission deadline when the client sent no "
+        "deadline header of its own (0 disables)",
+    )
     p.add_argument(
         "-ec.scrub.megakernel.disable", dest="ec_scrub_megakernel_disable",
         action="store_true",
@@ -336,6 +409,7 @@ def add_args(p) -> None:
 
 async def run(args) -> None:
     common_args.apply_obs_args(args)
+    from ..ingest import IngestConfig
     from ..server.volume import VolumeServer
     from ..storage.ec import bulk as ec_bulk
 
@@ -435,6 +509,19 @@ async def run(args) -> None:
             tier_promote_ratio=args.ec_tier_promote_ratio,
             tier_min_residency_seconds=args.ec_tier_min_residency_seconds,
             tier_bulk_weight=args.ec_tier_bulk_weight,
+        ),
+        ec_ingest=IngestConfig(
+            enabled=not args.ec_ingest_disable,
+            backend=args.ec_ingest_backend,
+            arena_slots=args.ec_ingest_arena_slots,
+            backpressure_ms=args.ec_ingest_backpressure_ms,
+            fsync=args.ec_ingest_fsync,
+            fsync_max_batch=args.ec_ingest_fsync_max_batch,
+            fsync_max_delay_ms=args.ec_ingest_fsync_max_delay_ms,
+            min_rate_kbps=args.ec_ingest_min_rate_kbps,
+            interactive_queue=args.ec_ingest_interactive_queue,
+            bulk_queue=args.ec_ingest_bulk_queue,
+            deadline_ms=args.ec_ingest_deadline_ms,
         ),
         **common_args.metrics_kwargs(args),
     )

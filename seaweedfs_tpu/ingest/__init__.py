@@ -1,0 +1,20 @@
+"""Streaming ingest plane: write-path EC encode as data arrives.
+
+`IngestPlane` (pipeline.py) sits on the volume server's write path:
+uploads are admitted through QoS write tiers + the r18 deadline budget
+at the front door, land in per-volume `IngestPipeline`s that stage
+completed stripe rows in a bounded arena and EC-encode them on the
+accelerator while the `.dat` is still growing (ops/rs_ingest.py), and
+group-commit their fsyncs.  `ec.encode` of a streamed volume then only
+sweeps the zero-padded tail row — the bulk after-the-fact batch job
+becomes an online pipeline.
+"""
+from .config import IngestConfig
+from .pipeline import GroupCommitter, IngestPipeline, IngestPlane
+
+__all__ = [
+    "GroupCommitter",
+    "IngestConfig",
+    "IngestPipeline",
+    "IngestPlane",
+]

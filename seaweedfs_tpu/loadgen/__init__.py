@@ -19,15 +19,22 @@ Reference: weed/command/benchmark.go ships the same kind of driver
 (`weed benchmark`); this one adds the adversarial client behaviors the
 serving fixes of this PR exist for.
 """
-from .workload import LoadScenario, zipf_ranks
-from .driver import LoadResult, run_http_load, run_s3_load
+from .workload import LoadScenario, ZipfPicker, zipf_ranks
+from .driver import (
+    LoadResult,
+    run_http_load,
+    run_mixed_http_load,
+    run_s3_load,
+)
 from .chaos import ChaosInjector
 
 __all__ = [
     "ChaosInjector",
     "LoadResult",
     "LoadScenario",
+    "ZipfPicker",
     "run_http_load",
+    "run_mixed_http_load",
     "run_s3_load",
     "zipf_ranks",
 ]

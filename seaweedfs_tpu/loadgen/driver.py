@@ -16,7 +16,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from .workload import LoadScenario, percentile_ms, plan_keys
+from .workload import LoadScenario, ZipfPicker, percentile_ms, plan_keys
 
 
 @dataclass
@@ -32,13 +32,28 @@ class LoadResult:
     bytes_read: int = 0
     wall_s: float = 0.0
     latencies_s: list = field(default_factory=list)
+    # mixed read/write leg (scenario.write_frac > 0)
+    writes_ok: int = 0
+    write_errors: int = 0
+    bytes_written: int = 0
+    write_latencies_s: list = field(default_factory=list)
 
     @property
     def reads_per_s(self) -> float:
         return round(self.reads_ok / self.wall_s, 1) if self.wall_s else 0.0
 
+    @property
+    def writes_per_s(self) -> float:
+        return round(self.writes_ok / self.wall_s, 1) if self.wall_s else 0.0
+
+    @property
+    def ingest_mb_per_s(self) -> float:
+        if not self.wall_s:
+            return 0.0
+        return round(self.bytes_written / self.wall_s / 2**20, 3)
+
     def summary(self) -> dict:
-        return {
+        d = {
             "connections": self.connections,
             "reads_ok": self.reads_ok,
             "errors": self.errors,
@@ -51,6 +66,17 @@ class LoadResult:
             "p50_ms": percentile_ms(self.latencies_s, 50),
             "p99_ms": percentile_ms(self.latencies_s, 99),
         }
+        if self.writes_ok or self.write_errors:
+            d.update({
+                "writes_ok": self.writes_ok,
+                "write_errors": self.write_errors,
+                "bytes_written": self.bytes_written,
+                "writes_per_s": self.writes_per_s,
+                "ingest_mb_per_s": self.ingest_mb_per_s,
+                "write_p50_ms": percentile_ms(self.write_latencies_s, 50),
+                "write_p99_ms": percentile_ms(self.write_latencies_s, 99),
+            })
+        return d
 
 
 async def _run_load(
@@ -169,6 +195,139 @@ async def run_http_load(
         headers,
         volume_of=lambda fid: fid.split(",")[0],
     )
+
+
+async def run_mixed_http_load(
+    master: str,
+    volume_url: str,
+    blobs: dict,
+    scenario: LoadScenario,
+    collection: str = "",
+    written: dict | None = None,
+) -> LoadResult:
+    """Closed-loop MIXED read/write against the volume data plane (the
+    reference `weed benchmark` shape, interleaved instead of
+    write-phase-then-read-phase): each op is an upload with probability
+    `scenario.write_frac`, else a read.  Writes assign fresh fids from
+    the master, ride the scenario's X-Seaweed-QoS tier into ingest
+    admission, and feed the written key straight back into the SHARED
+    read key stream — so reads increasingly land on volumes whose
+    stripe rows are being encoded under them, which is exactly the
+    contention the ingest plane must not let bleed into read p99.
+
+    `blobs` seeds the key space (fid -> bytes, all served by
+    `volume_url`); every write's payload is deterministic from the
+    worker rng and byte-verified on later reads like any seed key.
+    `written`, when passed, collects every successful write as
+    fid -> (holder_url, payload) so the caller can read back EVERY
+    written byte after the sweep (the bench's readback verdict)."""
+    import aiohttp
+
+    from ..operation import assign, upload_data
+
+    result = LoadResult(connections=scenario.connections)
+    # shared mutable key space: list for rank order, dicts for payload
+    # and holder; appends only, under the event loop (no lock needed)
+    keys: list[str] = list(blobs)
+    store: dict[str, bytes] = dict(blobs)
+    holder: dict[str, str] = {}
+    sizes = [int(s) for s in (scenario.write_sizes or [4096])]
+    if any(s <= 0 for s in sizes):
+        raise ValueError("write_sizes must be positive")
+    headers = {"X-Seaweed-QoS": scenario.tier}
+    # shard the op budget like _run_load shards picks
+    ops_of = [
+        len(range(w, scenario.reads, scenario.connections))
+        for w in range(scenario.connections)
+    ]
+
+    def new_session():
+        return aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(limit=1),
+            timeout=aiohttp.ClientTimeout(total=120),
+        )
+
+    async def do_write(wid: int, seq: int, rng, session) -> None:
+        size = sizes[int(rng.integers(0, len(sizes)))]
+        data = rng.integers(0, 256, size=size, dtype=np.uint8).tobytes()
+        t0 = time.perf_counter()
+        try:
+            a = await assign(master, collection=collection)
+            await upload_data(
+                f"http://{a.url}/{a.fid}", data, f"mix{wid}_{seq}",
+                compress=False, jwt=a.auth, session=session,
+                headers=headers,
+            )
+        except Exception:  # noqa: BLE001 — a refused write (429/504
+            # ingest shed, dead server) is the datum
+            result.write_errors += 1
+            return
+        result.write_latencies_s.append(time.perf_counter() - t0)
+        result.bytes_written += len(data)
+        result.writes_ok += 1
+        store[a.fid] = data
+        holder[a.fid] = a.url
+        keys.append(a.fid)
+        if written is not None:
+            written[a.fid] = (a.url, data)
+
+    async def do_read(key: str, rng, session) -> None:
+        url = holder.get(key, volume_url)
+        t0 = time.perf_counter()
+        try:
+            async with session.get(
+                f"http://{url}/{key}", headers=headers
+            ) as r:
+                body = await r.read()
+                if r.status != 200:
+                    result.errors += 1
+                    return
+                clen = r.headers.get("Content-Length")
+                if clen is not None and len(body) != int(clen):
+                    result.errors += 1
+                    return
+        except Exception:  # noqa: BLE001
+            result.errors += 1
+            return
+        result.latencies_s.append(time.perf_counter() - t0)
+        result.bytes_read += len(body)
+        if scenario.verify and body != store[key]:
+            result.verify_failures += 1
+            return
+        result.reads_ok += 1
+
+    async def worker(wid: int, n_ops: int) -> None:
+        rng = np.random.default_rng(scenario.seed * 7919 + wid)
+        picker = ZipfPicker(scenario.zipf_s)
+        session = new_session()
+        try:
+            for seq in range(n_ops):
+                if scenario.churn > 0 and rng.random() < scenario.churn:
+                    await session.close()
+                    session = new_session()
+                    result.churns += 1
+                if keys and rng.random() >= scenario.write_frac:
+                    await do_read(
+                        keys[picker.pick(len(keys), rng)], rng, session
+                    )
+                else:
+                    await do_write(wid, seq, rng, session)
+        finally:
+            await session.close()
+
+    t0 = time.perf_counter()
+    workers = [
+        asyncio.ensure_future(worker(w, ops_of[w]))
+        for w in range(scenario.connections)
+    ]
+    outcomes = await asyncio.gather(*workers, return_exceptions=True)
+    result.wall_s = time.perf_counter() - t0
+    for wid, out in enumerate(outcomes):
+        if isinstance(out, BaseException):
+            raise RuntimeError(
+                f"mixed load worker {wid}/{scenario.connections} crashed"
+            ) from out
+    return result
 
 
 async def run_s3_load(

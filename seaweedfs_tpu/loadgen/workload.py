@@ -35,6 +35,14 @@ class LoadScenario:
     churn: float = 0.0
     # QoS tier stamped on requests (X-Seaweed-QoS)
     tier: str = "interactive"
+    # mixed read/write: this fraction of ops are uploads (the reference
+    # `weed benchmark` write leg), with payload sizes drawn uniformly
+    # from write_sizes — a discrete size distribution, one entry = the
+    # reference's fixed -size.  Every written key feeds straight back
+    # into the read key stream and is byte-verified like a pre-filled
+    # key.  0 = the pure-read sweeps above.
+    write_frac: float = 0.0
+    write_sizes: list = field(default_factory=lambda: [4096])
     # working-set multiplier: how many times the device (HBM) budget
     # the key space is meant to span.  The sizing hook for
     # oversubscribed sweeps — `loadtest -oversubscribe N` scales its
@@ -151,6 +159,33 @@ def plan_keys(
                 picks[i] = hot_keys[hot_picks[j]]
                 j += 1
     return picks
+
+
+class ZipfPicker:
+    """One-at-a-time zipf sampler over a GROWING key space — the mixed
+    read/write driver's read-side picker, where every freshly written
+    key joins the popularity tail mid-sweep (plan_keys can't: it needs
+    the whole key space upfront).  The weight vector is recomputed only
+    when the space has grown, so a sweep whose keys grow by W writes
+    pays O(W) rebuilds, not one per read."""
+
+    def __init__(self, s: float):
+        self.s = s
+        self._n = 0
+        self._weights: np.ndarray | None = None
+
+    def pick(self, n_keys: int, rng) -> int:
+        if n_keys <= 0:
+            raise ValueError("n_keys must be >= 1")
+        if self.s <= 0:
+            return int(rng.integers(0, n_keys))
+        if n_keys != self._n:
+            w = 1.0 / np.power(
+                np.arange(1, n_keys + 1, dtype=np.float64), self.s
+            )
+            self._weights = w / w.sum()
+            self._n = n_keys
+        return int(rng.choice(n_keys, p=self._weights))
 
 
 def percentile_ms(latencies_s: list[float], p: float) -> float | None:

@@ -29,9 +29,13 @@ async def upload_data(
     retries: int = 2,
     jwt: str = "",
     session: aiohttp.ClientSession | None = None,
+    headers: dict | None = None,
 ) -> dict:
     """POST to http://volume/fid as multipart/form-data; returns the
-    volume server's JSON ({name, size, eTag})."""
+    volume server's JSON ({name, size, eTag}).  `headers` are extra
+    request headers — the filer passes the QoS write tier and the
+    remaining deadline budget through to the volume server's ingest
+    admission here."""
     body = data
     gzipped = False
     if compress and _should_gzip(mime, data):
@@ -54,7 +58,8 @@ async def upload_data(
                     part.headers["Content-Encoding"] = "gzip"
                 s = session if session is not None else aiohttp.ClientSession()
                 try:
-                    async with s.post(url, data=mpw, headers=_auth_headers(jwt)) as r:
+                    hdrs = {**(headers or {}), **_auth_headers(jwt)}
+                    async with s.post(url, data=mpw, headers=hdrs) as r:
                         if r.status >= 300:
                             raise RuntimeError(
                                 f"upload {url}: HTTP {r.status} {await r.text()}"

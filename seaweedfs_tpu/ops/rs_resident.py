@@ -2106,7 +2106,7 @@ def aot_stats() -> dict:
         }
 
 
-def _pack_calls_sharded(cache, requests, row_of, survivors, record_observed):
+def _pack_calls_sharded(cache, requests, row_of, record_observed):
     """PACK stage for a lane-sharded volume: plan against the stripe
     width (requests split at stripe boundaries), partition each
     size-bucket group by OWNER DEVICE (stripe c lives on device c % n —
@@ -2186,7 +2186,7 @@ def _pack_calls(
         # gather window, so the fused single-device DMA kernels do not
         # apply (the sharded twin IS the batched gather)
         calls, subs = _pack_calls_sharded(
-            cache, requests, row_of, survivors, record_observed
+            cache, requests, row_of, record_observed
         )
         return calls, subs, survivors, a_prep, use, w_true, place
     fused = _use_fused(kernel, interpret)
@@ -2566,7 +2566,7 @@ def make_batched_call(
         # contract through the sharded twin (the serving path's calls
         # route per-device; a homogeneous batch is one call there too)
         calls, _subs = _pack_calls_sharded(
-            cache, requests, row_of, survivors, record_observed=False
+            cache, requests, row_of, record_observed=False
         )
         if len(calls) != 1:
             raise ValueError(

@@ -860,11 +860,18 @@ class S3ApiServer:
                 status=200, headers={"ETag": f'"{hashlib.md5(b"").hexdigest()}"'}
             )
         data = await self._body(request)
+        from ..serving.qos import normalize_tier
         from ..server.conditional import persistable_headers
 
         # forward caching/presentation headers so `aws s3 cp
         # --cache-control ...` persists them like a direct filer PUT
         headers = dict(persistable_headers(request.headers))
+        # write tier rides through the filer to the volume server's
+        # ingest admission — a plain PUT defaults interactive, the
+        # client may demote itself to bulk
+        headers["X-Seaweed-QoS"] = normalize_tier(
+            request.headers.get("X-Seaweed-QoS")
+        )
         if request.headers.get("Content-Type"):
             headers["Content-Type"] = request.headers["Content-Type"]
         if isinstance(data, (bytes, bytearray)):
@@ -1544,7 +1551,10 @@ class S3ApiServer:
             f"{upload_id}/{name}"
         )
         data = await self._body(request)
-        headers = {}
+        # multipart parts are the batch-loader write shape: bulk tier,
+        # so concurrent part floods bind at ingest admission before
+        # interactive single PUTs do
+        headers = {"X-Seaweed-QoS": "bulk"}
         if isinstance(data, (bytes, bytearray)):
             headers["Content-Length"] = str(len(data))
         elif request.content_length is not None:

@@ -295,7 +295,7 @@ class FilerServer:
     async def _upload_chunk(
         self, data: bytes, offset: int, filename: str,
         collection: str = "", replication: str = "", ttl: str = "",
-        mime: str = "",
+        mime: str = "", qos_tier: str = "",
     ) -> filer_pb2.FileChunk:
         # compress-then-encrypt; chunk.size stays the logical (plaintext)
         # length so the interval algebra never sees wire sizes
@@ -313,12 +313,19 @@ class FilerServer:
             cipher_key = gen_cipher_key()
             payload = encrypt(payload, cipher_key)
         a = await self._assign(1, collection, replication, ttl)
+        # carry the write tier and remaining deadline budget to the
+        # volume server's ingest admission (the doomed upload is refused
+        # there, before any bytes hit the .dat)
+        hdr = dict(faultpolicy.outbound_headers())
+        if qos_tier:
+            hdr["X-Seaweed-QoS"] = qos_tier
         result = await upload_data(
             f"http://{a.url}/{a.fid}",
             payload,
             filename=filename,
             compress=False,
             jwt=a.auth,
+            headers=hdr,
         )
         return filer_pb2.FileChunk(
             file_id=a.fid,
@@ -743,6 +750,9 @@ class FilerServer:
         # reference uploads chunks via a worker pool the same way)
         tasks: list[asyncio.Task] = []
         upload_name = filename or path.rsplit("/", 1)[-1]
+        # write tier rides the same header the read path uses; the s3
+        # gateway stamps it (multipart parts = bulk), direct PUTs may too
+        qos_tier = request.headers.get("X-Seaweed-QoS", "")
 
         def launch(data: bytes, off: int) -> None:
             tasks.append(
@@ -750,6 +760,7 @@ class FilerServer:
                     self._upload_chunk(
                         data, off, upload_name,
                         collection, replication, ttl_str, mime=content_type,
+                        qos_tier=qos_tier,
                     )
                 )
             )

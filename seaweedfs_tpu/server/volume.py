@@ -156,6 +156,7 @@ class VolumeServer:
         metrics_interval_seconds: int = 15,  # ref -metrics.intervalSeconds
         ec_scrub_interval_seconds: int = 0,  # >0: periodic parity scrub
         ec_serving=None,  # serving.ServingConfig | None (-ec.serving.* flags)
+        ec_ingest=None,  # ingest.IngestConfig | None (-ec.ingest.* flags)
         ec_scrub_megakernel: bool = True,  # fuse resident scrubs into one
         # device pass per cycle (-ec.scrub.megakernel.disable)
     ):
@@ -251,6 +252,22 @@ class VolumeServer:
             self.tiering = TieringController(self.store, ec_serving)
             self.tiering.attach_qos(self.ec_dispatcher.qos)
             self.ec_dispatcher.tiering = self.tiering
+        # streaming ingest plane (ingest/, -ec.ingest.*): QoS write-tier
+        # admission + whole-upload deadline doom at the door, per-volume
+        # pipelines stream-encoding stripe rows as appends land, group-
+        # commit fsync.  Write heat feeds the same HeatTracker the read
+        # path feeds, so a freshly written volume enters the tiering
+        # ladder already warm.
+        from ..ingest import IngestConfig, IngestPlane
+
+        ec_ingest = (ec_ingest or IngestConfig()).validated()
+        self.ingest = None
+        if ec_ingest.enabled:
+            self.ingest = IngestPlane(
+                ec_ingest,
+                heat=self.tiering.heat if self.tiering is not None else None,
+            )
+        self.store.ingest = self.ingest
         # stage-digest shipping state: deltas against _stage_snapshot
         # accrue in _digest_backlog until the heartbeat that carried
         # them is ACKED (the master answers every heartbeat in order),
@@ -553,6 +570,9 @@ class VolumeServer:
         # server must not report the dead instance's last occupancy
         # until its first batch
         self.ec_dispatcher.shutdown()
+        if self.ingest is not None:
+            # joins encode workers + the group-commit flusher
+            await asyncio.to_thread(self.ingest.close)
         # off the loop: close() joins pin/warm threads that may sit in a
         # 20-40s jit compile — blocking here would freeze every other
         # coroutine in the process (co-hosted servers, in-flight HTTP)
@@ -681,6 +701,26 @@ class VolumeServer:
         tel.ec_d2h_bytes = int(
             g("SeaweedFS_volumeServer_ec_d2h_bytes_total") or 0
         )
+        # streaming ingest plane (ingest/): write bytes admitted, rows
+        # encoded online split device/host, door sheds, group-commit
+        # fsyncs, live pipelines, and seals that skipped the offline
+        # encode — cluster.health rolls these up next to the read plane
+        if self.ingest is not None:
+            ing = self.ingest.snapshot()
+            tel.ingest_bytes_total = int(
+                g("SeaweedFS_volumeServer_ingest_bytes_total") or 0
+            )
+            tel.ingest_rows_device = int(ing["rows_device"])
+            tel.ingest_rows_host = int(ing["rows_host"])
+            tel.ingest_shed_total = sum(ing["sheds"].values())
+            tel.ingest_fsyncs_total = int(
+                g("SeaweedFS_volumeServer_ingest_fsyncs_total") or 0
+            )
+            tel.ingest_active_pipelines = int(ing["pipelines"])
+            tel.ingest_streamed_seals = int(
+                g("SeaweedFS_volumeServer_ingest_seals_total",
+                  {"path": "streamed"}) or 0
+            )
         snap = stats.metrics.stage_histogram_snapshot()
         for stage, buckets, count, dsum in stats.metrics.stage_digest_deltas(
             self._stage_snapshot, snap
@@ -1284,14 +1324,58 @@ class VolumeServer:
 
     async def h_write(self, request: web.Request) -> web.Response:
         """(PostHandler volume_server_handlers_write.go) — parse upload,
-        append locally, fan out to replicas unless this IS a replica write."""
+        append locally, fan out to replicas unless this IS a replica write.
+
+        The ingest plane's front door: the write rides one deadline
+        budget end to end (r18 request_scope), and admission happens
+        BEFORE any body byte is buffered — a QoS write-tier shed or a
+        doomed upload (content_length at the floor rate overruns the
+        remaining budget) is refused at the door instead of discovered
+        at fsync."""
         try:
             vid, nid, cookie = self._parse_fid(request)
         except ValueError as e:
             raise web.HTTPBadRequest(text=str(e))
         if not self.store.has_volume(vid):
             raise web.HTTPNotFound(text=f"volume {vid} not local")
+        tier = request.headers.get("X-Seaweed-QoS", "")
+        # The doom projection only binds against a deadline the CLIENT
+        # propagated: the server-stamped default budget is a backstop
+        # for in-flight work, not a contract the uploader agreed to —
+        # dooming an undeadlined large body against it would refuse
+        # uploads the client is happy to wait for.
+        client_ms = faultpolicy.parse_deadline_ms(
+            request.headers.get(faultpolicy.DEADLINE_HEADER, "")
+        )
+        with faultpolicy.request_scope(request.headers):
+            if self.ingest is None:
+                return await self._h_write_admitted(request, vid, nid, cookie, tier)
+            shed = self.ingest.admit(
+                tier,
+                request.content_length or 0,
+                faultpolicy.remaining_s() if client_ms is not None else None,
+            )
+            if shed == "deadline":
+                raise web.HTTPGatewayTimeout(
+                    text="upload cannot finish within its deadline budget"
+                )
+            if shed is not None:
+                err = web.HTTPTooManyRequests(
+                    text=f"write admission shed ({shed})"
+                )
+                err.headers["Retry-After"] = "1"
+                raise err
+            t0 = time.monotonic()
+            try:
+                return await self._h_write_admitted(
+                    request, vid, nid, cookie, tier
+                )
+            finally:
+                self.ingest.complete(tier, time.monotonic() - t0)
 
+    async def _h_write_admitted(
+        self, request: web.Request, vid: int, nid: int, cookie: int, tier: str
+    ) -> web.Response:
         # lease BEFORE buffering the body, or the throttle bounds nothing;
         # chunked uploads (no Content-Length) pass a 0 lease
         async with self.upload_limiter(request.content_length or 0):
@@ -1330,6 +1414,12 @@ class VolumeServer:
                 size = await asyncio.to_thread(self.store.write_needle, vid, n)
             except VolumeReadOnly:
                 raise web.HTTPConflict(text=f"volume {vid} is read-only")
+            if self.ingest is not None and v is not None:
+                # post-append hook on the worker thread: write heat,
+                # stage newly completed stripe rows (the arena wait is
+                # the plane's backpressure, landing on THIS writer), and
+                # park on the group commit when durability is on
+                await asyncio.to_thread(self.ingest.on_write, v, size, tier)
             if not is_replicate:
                 err, acked = await self._replicate(
                     request, vid, body_override=body

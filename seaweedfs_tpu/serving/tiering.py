@@ -676,7 +676,8 @@ class TieringController:
                 # pin's estimate, and bytes freed on an idle chip do
                 # not make room where the candidate lands
                 held = foot.get(v, {})
-                if not any(d in still_tight(freed) for d in held):
+                tight = still_tight(freed)
+                if not tight & held.keys():
                     continue  # holds nothing where room is still needed
                 victims.append(v)
                 for d, b in held.items():

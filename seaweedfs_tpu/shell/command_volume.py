@@ -965,6 +965,55 @@ async def cmd_volume_tier_status(env, args):
         )
 
 
+@command("volume.ingest.status")
+async def cmd_volume_ingest_status(env, args):
+    """[-node <host:port>] : per-node streaming-ingest view from the
+    master's telemetry plane — write bytes accepted, stripe rows
+    encoded online (device vs host codec), writes shed at the door,
+    group-commit fsyncs, live per-volume pipelines, and seals that
+    skipped the offline encode"""
+    from .command_cluster import fetch_cluster_health, fmt_bytes
+
+    flags = parse_flags(args)
+    want = flags.get("node") or flags.get("")
+    health = await fetch_cluster_health(env)
+    nodes = health["nodes"]
+    if want:
+        if want not in nodes:
+            raise ValueError(
+                f"node {want!r} not in telemetry plane (known: "
+                f"{', '.join(sorted(nodes)) or 'none'})"
+            )
+        nodes = {want: nodes[want]}
+    for url, n in nodes.items():
+        state = "STALE" if n["stale"] else "fresh"
+        ing = n.get("ingest")
+        if not ing:
+            env.write(
+                f"{url} [{state}] no ingest telemetry "
+                "(plane disabled or pre-telemetry server)"
+            )
+            continue
+        env.write(
+            f"{url} [{state}] {fmt_bytes(ing['bytes_total'])} written; "
+            f"rows device={ing['rows_device']} host={ing['rows_host']}; "
+            # every shed here was refused AT THE DOOR — the client got a
+            # fast 429/504 instead of a doomed slow upload
+            f"shed={ing['shed_total']} fsyncs={ing['fsyncs_total']} "
+            f"pipelines={ing['active_pipelines']} "
+            f"streamed_seals={ing['streamed_seals']}"
+        )
+    ci = health.get("cluster", {}).get("ingest")
+    if ci:
+        env.write(
+            f"cluster: {fmt_bytes(ci['bytes_total'])} written, rows "
+            f"device={ci['rows_device']} host={ci['rows_host']}, "
+            f"shed={ci['shed_total']} fsyncs={ci['fsyncs_total']} "
+            f"pipelines={ci['active_pipelines']} "
+            f"streamed_seals={ci['streamed_seals']}"
+        )
+
+
 @command("volume.trace")
 async def cmd_volume_trace(env, args):
     """-node <host:port> [-limit N] [-id <trace_id>] [-since <seconds>]

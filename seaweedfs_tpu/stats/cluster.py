@@ -231,6 +231,16 @@ class NodeTelemetry:
     # one entry per serving-mesh device; [] = no cache / pre-r19 server
     device_bytes_per_device: list[int] = field(default_factory=list)
     resident_by_volume: dict[int, int] = field(default_factory=dict)
+    # streaming ingest plane (r20): write bytes accepted, stripe rows
+    # encoded online split by codec locus, door sheds, group-commit
+    # fsyncs, live pipelines, seals that skipped the offline encode
+    ingest_bytes_total: int = 0
+    ingest_rows_device: int = 0
+    ingest_rows_host: int = 0
+    ingest_shed_total: int = 0
+    ingest_fsyncs_total: int = 0
+    ingest_active_pipelines: int = 0
+    ingest_streamed_seals: int = 0
 
     def to_dict(self, now: float, stale_after: float) -> dict[str, Any]:
         age = now - self.last_seen
@@ -292,6 +302,15 @@ class NodeTelemetry:
                 "promotions_total": self.tier_promotions,
                 "demotions_total": self.tier_demotions,
                 "host_bytes": self.tier_host_bytes,
+            }
+            d["ingest"] = {
+                "bytes_total": self.ingest_bytes_total,
+                "rows_device": self.ingest_rows_device,
+                "rows_host": self.ingest_rows_host,
+                "shed_total": self.ingest_shed_total,
+                "fsyncs_total": self.ingest_fsyncs_total,
+                "active_pipelines": self.ingest_active_pipelines,
+                "streamed_seals": self.ingest_streamed_seals,
             }
         return d
 
@@ -391,6 +410,26 @@ class ClusterTelemetry:
             nt.device_bytes_per_device = [
                 int(b) for b in getattr(tel, "device_bytes_per_device", ())
             ]
+            # getattr-guarded: pre-r20 servers lack the ingest plane
+            nt.ingest_bytes_total = int(
+                getattr(tel, "ingest_bytes_total", 0)
+            )
+            nt.ingest_rows_device = int(
+                getattr(tel, "ingest_rows_device", 0)
+            )
+            nt.ingest_rows_host = int(getattr(tel, "ingest_rows_host", 0))
+            nt.ingest_shed_total = int(
+                getattr(tel, "ingest_shed_total", 0)
+            )
+            nt.ingest_fsyncs_total = int(
+                getattr(tel, "ingest_fsyncs_total", 0)
+            )
+            nt.ingest_active_pipelines = int(
+                getattr(tel, "ingest_active_pipelines", 0)
+            )
+            nt.ingest_streamed_seals = int(
+                getattr(tel, "ingest_streamed_seals", 0)
+            )
             nt.resident_by_volume = dict(tel.resident_shards_by_volume)
             n_buckets = len(STAGE_SECONDS_BUCKETS) + 1
             for d in tel.stage_digests:
@@ -660,6 +699,29 @@ class ClusterTelemetry:
                 "tier_host_bytes": sum(
                     nt.tier_host_bytes for nt in fresh
                 ),
+                "ingest": {
+                    "bytes_total": sum(
+                        nt.ingest_bytes_total for nt in fresh
+                    ),
+                    "rows_device": sum(
+                        nt.ingest_rows_device for nt in fresh
+                    ),
+                    "rows_host": sum(
+                        nt.ingest_rows_host for nt in fresh
+                    ),
+                    "shed_total": sum(
+                        nt.ingest_shed_total for nt in fresh
+                    ),
+                    "fsyncs_total": sum(
+                        nt.ingest_fsyncs_total for nt in fresh
+                    ),
+                    "active_pipelines": sum(
+                        nt.ingest_active_pipelines for nt in fresh
+                    ),
+                    "streamed_seals": sum(
+                        nt.ingest_streamed_seals for nt in fresh
+                    ),
+                },
                 "ec_volume_residency": residency,
                 "stages": stage_docs,
             },

@@ -556,6 +556,83 @@ VOLUME_SERVER_EC_RETRY_BUDGET_EXHAUSTED = Counter(
     registry=REGISTRY,
 )
 
+# streaming ingest plane (seaweedfs_tpu/ingest/): writes land in bounded
+# staging arenas and EC-encode per stripe row as the .dat grows, instead
+# of the after-the-fact bulk encode.  bytes/rows split by where the row
+# encoded (device vs host-shed) is the plane's health headline; the
+# backpressure counter is the honest "writers outran the codec" signal;
+# shed splits by reason so QoS write-tier sheds, deadline dooms and
+# arena overflows are distinguishable at a glance.
+VOLUME_SERVER_INGEST_BYTES = Counter(
+    "SeaweedFS_volumeServer_ingest_bytes",
+    "Payload bytes accepted into per-volume streaming ingest pipelines "
+    "(staged toward stripe rows; every byte here is EC-encoded online "
+    "or swept into the offline fallback at seal).",
+    registry=REGISTRY,
+)
+VOLUME_SERVER_INGEST_ROWS = Counter(
+    "SeaweedFS_volumeServer_ingest_rows",
+    "Completed stripe rows encoded by the streaming ingest plane, by "
+    "where the parity was computed (device = AOT-warmed accelerator "
+    "call, host = CPU codec after a shed-cold or on a CPU backend).",
+    ["path"],
+    registry=REGISTRY,
+)
+VOLUME_SERVER_INGEST_BACKPRESSURE = Counter(
+    "SeaweedFS_volumeServer_ingest_backpressure",
+    "Ingest arena stage() calls that had to BLOCK for a free staging "
+    "row — each one is a writer stalled because the encode leg hasn't "
+    "drained; a steady rate means the arena (-ec.ingest.arenaSlots) or "
+    "the device is undersized for the write load.",
+    registry=REGISTRY,
+)
+VOLUME_SERVER_INGEST_SHED = Counter(
+    "SeaweedFS_volumeServer_ingest_shed",
+    "Writes refused at the door by the ingest plane, by reason "
+    "(qos = write-tier admission shed, deadline = the r18 budget says "
+    "the upload cannot finish in time, arena = no staging row freed "
+    "within the backpressure budget).",
+    ["reason"],
+    registry=REGISTRY,
+)
+for _reason in ("qos", "deadline", "arena"):
+    VOLUME_SERVER_INGEST_SHED.labels(reason=_reason)
+VOLUME_SERVER_INGEST_FSYNCS = Counter(
+    "SeaweedFS_volumeServer_ingest_fsyncs",
+    "Group-commit fsync batches issued by ingest pipelines — many "
+    "writes acknowledged per fsync is the point; compare against "
+    "SeaweedFS_volumeServer_ingest_fsync_writes for the batching "
+    "factor.",
+    registry=REGISTRY,
+)
+VOLUME_SERVER_INGEST_FSYNC_WRITES = Counter(
+    "SeaweedFS_volumeServer_ingest_fsync_writes",
+    "Writes whose durability was covered by a group-commit fsync batch "
+    "(fsync_writes / fsyncs = achieved group-commit factor).",
+    registry=REGISTRY,
+)
+VOLUME_SERVER_INGEST_PIPELINES = Gauge(
+    "SeaweedFS_volumeServer_ingest_pipelines",
+    "Per-volume streaming ingest pipelines currently live (streaming "
+    "state valid: rows encoded so far remain byte-identical to an "
+    "offline re-encode of the final .dat).",
+    registry=REGISTRY,
+)
+VOLUME_SERVER_INGEST_STREAMED_SEALS = Counter(
+    "SeaweedFS_volumeServer_ingest_seals",
+    "Volume EC seals by provenance (streamed = parity rows were "
+    "already encoded online and only the zero-padded tail row remained "
+    "at ec.encode time; offline = the pipeline had been invalidated — "
+    "vacuum, large-row boundary, restart — and the bulk executor "
+    "re-encoded from scratch).",
+    ["path"],
+    registry=REGISTRY,
+)
+for _path in ("streamed", "offline"):
+    VOLUME_SERVER_INGEST_STREAMED_SEALS.labels(path=_path)
+for _path in ("device", "host"):
+    VOLUME_SERVER_INGEST_ROWS.labels(path=_path)
+
 MQ_FENCE_CONFLICT = Counter(
     "SeaweedFS_mq_fence_conflict",
     "Partition activations that found the durable log tail moved after "

@@ -103,6 +103,10 @@ class Store:
         # carries the reference so interval reads probe it without the
         # controller on the read path
         self.ec_host_cache = None
+        # streaming write plane (ingest.IngestPlane | None), attached by
+        # the volume server: ec_generate consults it for a streamed
+        # seal, vacuum/delete invalidate its per-volume pipelines
+        self.ingest = None
         self.volume_size_limit = 30 * 1024 * 1024 * 1024  # set by master pulse
         self._lock = threading.RLock()
         # device-cache pin/warm threads: cancellable + joined on close so
@@ -234,6 +238,8 @@ class Store:
             for loc in self.locations:
                 v = loc.volumes.pop(vid, None)
                 if v is not None:
+                    if self.ingest is not None:
+                        self.ingest.drop(vid)
                     msg = self._volume_message(v, loc.disk_type)
                     v.destroy()
                     self.deleted_volumes.put(msg)
@@ -245,6 +251,8 @@ class Store:
             for loc in self.locations:
                 v = loc.volumes.pop(vid, None)
                 if v is not None:
+                    if self.ingest is not None:
+                        self.ingest.drop(vid)
                     msg = self._volume_message(v, loc.disk_type)
                     v.close()
                     self.deleted_volumes.put(msg)
@@ -440,6 +448,11 @@ class Store:
             raise ValueError(
                 f"volume {vid} is tiered; download before vacuuming"
             )
+        if self.ingest is not None:
+            # the compaction swap moves every needle's offset: streamed
+            # parity rows no longer describe the new .dat.  Invalidate
+            # BEFORE the swap so no feed stages a row mid-rewrite.
+            self.ingest.invalidate(vid, "vacuum rewrote the .dat")
         ratio = vacuum_volume(v)
         # a vacuumed volume that shrank back under the limit re-opens for
         # writes; tell the master right away
@@ -459,7 +472,16 @@ class Store:
             raise NotFoundError(f"volume {vid} not found")
         v.sync()
         base = Volume.base_name(v.dir, vid, v.collection)
-        write_ec_files(base, backend=self.ec_backend)
+        # streamed-seal-first: when the ingest plane already encoded the
+        # volume's interior stripe rows online, the seal only re-reads
+        # the .dat for the data shards and encodes the zero-padded tail;
+        # any invalidated/absent pipeline falls through to the offline
+        # bulk encode (same bytes either way)
+        streamed = False
+        if self.ingest is not None:
+            streamed = self.ingest.seal(vid, base, backend=self.ec_backend)
+        if not streamed:
+            write_ec_files(base, backend=self.ec_backend)
         write_sorted_file_from_idx(base)
 
     def ec_rebuild(

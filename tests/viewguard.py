@@ -188,7 +188,7 @@ def watch() -> Iterator[ViewGuard]:
                                         exports when the slot returns
       vacuum.commit                  -> verify outstanding views after
     """
-    from seaweedfs_tpu.ops import rs_resident
+    from seaweedfs_tpu.ops import rs_ingest, rs_resident
     from seaweedfs_tpu.storage import needle as needle_mod
     from seaweedfs_tpu.storage import vacuum as vacuum_mod
 
@@ -200,6 +200,10 @@ def watch() -> Iterator[ViewGuard]:
     real_slot = rs_resident.DevicePipeline.slot
     real_commit = vacuum_mod.commit
     real_dispatch = rs_resident._dispatch_call
+    real_ing_stage = rs_ingest.IngestArena.stage
+    real_ing_seal = rs_ingest.IngestArena.seal
+    real_ing_reclaim = rs_ingest.IngestArena.reclaim
+    real_ing_donatable = rs_ingest._donatable
 
     # nested watches stack their patches (a test's own watch() inside
     # the SWFS_VIEWGUARD session sweep): only the INNERMOST guard
@@ -259,6 +263,37 @@ def watch() -> Iterator[ViewGuard]:
             g.check_donation(vec, f"_dispatch_call({kind})")
         return real_dispatch(kind, vec, *args, **kw)
 
+    def ing_stage(self, timeout_s=None):
+        buf = real_ing_stage(self, timeout_s)
+        if _mine():
+            # the pool just handed this row out for overwrite: a still-
+            # outstanding seal export over it means reclaim was skipped
+            g.check_reuse(buf, "IngestArena.stage reuses a staging row")
+        return buf
+
+    def ing_seal(self, buf):
+        out = real_ing_seal(self, buf)
+        if _mine():
+            g.export(
+                out, out, f"ingest row [{self.k}, {self.block}]"
+            )
+        return out
+
+    def ing_reclaim(self, buf):
+        if _mine():
+            # verifies the fingerprint: the encode leg must only READ
+            # the sealed row between seal() and here
+            g.release_source(buf)
+        real_ing_reclaim(self, buf)
+
+    def ing_donatable(rows, on_tpu):
+        out = real_ing_donatable(rows, on_tpu)
+        if _mine() and out is rows and not on_tpu:
+            # the defensive-copy gate was skipped on a zero-copy client:
+            # donating the live arena row hands its memory to XLA
+            g.check_donation(rows, "rs_ingest._donatable")
+        return out
+
     def commit(v, cpd, cpx, idx_snapshot, shadow_db=None):
         out = real_commit(v, cpd, cpx, idx_snapshot, shadow_db)
         # the .dat was just swapped: every outstanding zero-copy view
@@ -273,6 +308,10 @@ def watch() -> Iterator[ViewGuard]:
     rs_resident.DevicePipeline.slot = slot
     vacuum_mod.commit = commit
     rs_resident._dispatch_call = dispatch_call
+    rs_ingest.IngestArena.stage = ing_stage
+    rs_ingest.IngestArena.seal = ing_seal
+    rs_ingest.IngestArena.reclaim = ing_reclaim
+    rs_ingest._donatable = ing_donatable
     _ACTIVE.append(g)
     try:
         yield g
@@ -284,3 +323,7 @@ def watch() -> Iterator[ViewGuard]:
         rs_resident.DevicePipeline.slot = real_slot
         vacuum_mod.commit = real_commit
         rs_resident._dispatch_call = real_dispatch
+        rs_ingest.IngestArena.stage = real_ing_stage
+        rs_ingest.IngestArena.seal = real_ing_seal
+        rs_ingest.IngestArena.reclaim = real_ing_reclaim
+        rs_ingest._donatable = real_ing_donatable

@@ -41,6 +41,7 @@ CONFIG_OWNERS: tuple[tuple[str, str], ...] = (
     ("-ec.serving.", "seaweedfs_tpu/serving/config.py"),
     ("-ec.qos.", "seaweedfs_tpu/serving/config.py"),
     ("-ec.tier.", "seaweedfs_tpu/serving/config.py"),
+    ("-ec.ingest.", "seaweedfs_tpu/ingest/config.py"),
     ("-ec.repair.", "seaweedfs_tpu/repair/config.py"),
     ("-ec.rpc.", "seaweedfs_tpu/utils/faultpolicy.py"),
     ("-ec.bulk.", "seaweedfs_tpu/storage/ec/bulk.py"),
