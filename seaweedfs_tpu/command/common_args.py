@@ -60,19 +60,49 @@ def add_obs_args(p) -> None:
         help="flight-recorder events kept in memory per process "
         "(newest win), served at /debug/incident",
     )
+    p.add_argument(
+        "-obs.ledger.disable", dest="obs_ledger_disable",
+        action="store_true",
+        help="disable the per-workload device-time ledger (the "
+        "SeaweedFS_volumeServer_device_* attribution series stop "
+        "moving; workload tagging context still propagates)",
+    )
+    p.add_argument(
+        "-obs.timeline.disable", dest="obs_timeline_disable",
+        action="store_true",
+        help="disable the flight-timeline sampler (/debug/timeline "
+        "stays empty and heartbeats stop carrying samples)",
+    )
+    p.add_argument(
+        "-obs.timeline.intervalSeconds",
+        dest="obs_timeline_interval_seconds", type=float,
+        default=d.timeline_interval_seconds,
+        help="seconds between flight-timeline samples",
+    )
+    p.add_argument(
+        "-obs.timeline.window", dest="obs_timeline_window", type=int,
+        default=d.timeline_window,
+        help="flight-timeline samples kept per node (the ring bound; "
+        "default 120 ≈ two minutes at the 1s interval)",
+    )
 
 
 def apply_obs_args(args) -> None:
     """Process-global, like the stats registry: call once at entry."""
-    from ..obs import IncidentConfig, ObsConfig, configure, incident
+    from ..obs import IncidentConfig, ObsConfig, configure, devledger, incident
 
     configure(
         ObsConfig(
             enabled=not args.obs_disable,
             slow_ms=args.obs_slow_ms,
             trace_ring=args.obs_trace_ring,
+            ledger_enabled=not args.obs_ledger_disable,
+            timeline_enabled=not args.obs_timeline_disable,
+            timeline_interval_seconds=args.obs_timeline_interval_seconds,
+            timeline_window=args.obs_timeline_window,
         )
     )
+    devledger.configure(enabled=not args.obs_ledger_disable)
     incident.configure(
         IncidentConfig(
             enabled=not args.obs_incident_disable,

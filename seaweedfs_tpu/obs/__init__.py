@@ -1,10 +1,13 @@
 """End-to-end observability: request tracing (trace ids, spans,
 /debug/traces), the incident plane's flight recorder + bundler
-(incident.py), the master-side SLO burn-rate engine (slo.py), and
-on-demand device profiling (profile.py)."""
-from . import incident, profile, slo
+(incident.py), the master-side SLO burn-rate engine (slo.py),
+on-demand device profiling (profile.py), the per-workload device-time
+ledger (devledger.py), and the flight timeline (timeline.py)."""
+from . import devledger, incident, profile, slo, timeline
 from .config import ObsConfig
+from .devledger import DeviceLedger, LEDGER
 from .incident import IncidentBundler, IncidentConfig
+from .timeline import TimelineSampler
 from .profile import device_hot_handler, profile_handler
 from .slo import SloConfig, SloEngine
 from .trace import (
@@ -30,18 +33,23 @@ from .trace import (
 )
 
 __all__ = [
+    "DeviceLedger",
     "GRPC_TRACE_KEY",
     "IncidentBundler",
     "IncidentConfig",
+    "LEDGER",
     "ObsConfig",
     "RING",
     "SloConfig",
     "SloEngine",
+    "TimelineSampler",
     "device_hot_handler",
+    "devledger",
     "incident",
     "profile",
     "profile_handler",
     "slo",
+    "timeline",
     "TRACE_HEADER",
     "Trace",
     "configure",

@@ -24,10 +24,30 @@ class ObsConfig:
     # completed traces kept in memory for /debug/traces (newest win)
     # (-obs.traceRing)
     trace_ring: int = 256
+    # record per-workload device-time attribution into the devledger
+    # and its SeaweedFS_volumeServer_device_* series
+    # (-obs.ledger.disable)
+    ledger_enabled: bool = True
+    # sample the flight timeline (ledger deltas, QoS depths, ingest
+    # backpressure, cache residency, breaker states + slow-span
+    # exemplars) into the per-node ring and ship it over heartbeats
+    # (-obs.timeline.disable)
+    timeline_enabled: bool = True
+    # seconds between timeline samples (-obs.timeline.intervalSeconds)
+    timeline_interval_seconds: float = 1.0
+    # samples kept in the per-node ring — window = interval * this
+    # (-obs.timeline.window)
+    timeline_window: int = 120
 
     def validated(self) -> "ObsConfig":
         if self.slow_ms < 0:
             raise ValueError("slow_ms must be >= 0")
         if self.trace_ring < 1:
             raise ValueError("trace_ring must be >= 1")
+        if self.timeline_interval_seconds <= 0:
+            raise ValueError("timeline_interval_seconds must be > 0")
+        if self.timeline_window < 2:
+            # a single-sample ring can never show a ramp — the
+            # timeline's whole job — so reject it at flag-parse time
+            raise ValueError("timeline_window must be >= 2")
         return self

@@ -1,0 +1,198 @@
+"""Device-time attribution ledger: who is burning the accelerator.
+
+After r20 six workloads share the device — serving reconstruct
+(interactive + bulk QoS tiers), streaming ingest encode, the scrub
+megakernel, repair re-encode, AOT pre-warm compiles, and the bulk
+executor — and each kept private busy-time bookkeeping
+(DevicePipeline._busy_s, bulk Codec.busy_s, per-stage spans).  This
+module is the shared ledger those paths record into: every device
+dispatch is tagged with a workload class and accumulates busy-seconds,
+dispatch count, boundary bytes, and queue-wait per class *per device*,
+exported as the SeaweedFS_volumeServer_device_* series.
+
+Tagging rides a contextvar so the class set at the edge (the QoS tier
+in the serving dispatcher, the scrub loop, the rebuild handler)
+propagates through asyncio.to_thread into the ops layer without
+threading a parameter through every call.  Worker threads that outlive
+the tagging context (the bulk Codec's dedicated leg, the AOT compile
+executor) re-enter a class explicitly via `workload(...)` — graftlint
+GL116 (untagged-device-dispatch) pins that every dispatch site does one
+or the other.
+
+Conservation invariant (tests/test_devledger_timeline.py): the
+per-class busy sums reconcile against the wall clocks that already
+existed — DevicePipeline.total_busy_s for the pipeline-slotted classes
+and Codec.busy_s for the bulk legs — so attribution can never invent
+or lose device time.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+from typing import Any
+
+from ..stats import metrics as stats_metrics
+
+# the seven classes + the escape hatch; also the metric label universe
+# (stats/metrics.py DEVICE_WORKLOADS is the same tuple, re-exported
+# there so the series declaration and the ledger can't drift)
+WORKLOADS = stats_metrics.DEVICE_WORKLOADS
+UNTAGGED = "untagged"
+
+_WORKLOAD: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "swfs_device_workload", default=UNTAGGED
+)
+_DEVICE: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "swfs_device_label", default="default"
+)
+
+
+def current_workload() -> str:
+    return _WORKLOAD.get()
+
+
+def current_device() -> str:
+    return _DEVICE.get()
+
+
+@contextlib.contextmanager
+def workload(cls: str, device: str | None = None):
+    """Tag every device dispatch in this context (and in
+    asyncio.to_thread hops made from it) with workload class `cls`;
+    `device` optionally pins the device label too (mesh / an index /
+    default / host)."""
+    if cls not in WORKLOADS:
+        cls = UNTAGGED
+    tok = _WORKLOAD.set(cls)
+    dtok = _DEVICE.set(device) if device is not None else None
+    try:
+        yield
+    finally:
+        _WORKLOAD.reset(tok)
+        if dtok is not None:
+            _DEVICE.reset(dtok)
+
+
+@contextlib.contextmanager
+def device(label: str):
+    """Pin only the device label (the workload class flows from the
+    caller's context) — reconstruct knows placement, not tenancy."""
+    tok = _DEVICE.set(label)
+    try:
+        yield
+    finally:
+        _DEVICE.reset(tok)
+
+
+class DeviceLedger:
+    """Thread-safe per-(workload, device) accumulator, mirrored to the
+    SeaweedFS_volumeServer_device_* counters on every record."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.enabled = True
+        # (workload, device) -> [busy_s, dispatches, bytes, queue_wait_s]
+        self._cells: dict[tuple[str, str], list[float]] = {}
+
+    def record(
+        self,
+        workload: str | None = None,
+        device: str | None = None,
+        busy_s: float = 0.0,
+        dispatches: int = 0,
+        nbytes: int = 0,
+        queue_wait_s: float = 0.0,
+    ) -> None:
+        if not self.enabled:
+            return
+        wl = workload if workload is not None else _WORKLOAD.get()
+        if wl not in WORKLOADS:
+            wl = UNTAGGED
+        dev = device if device is not None else _DEVICE.get()
+        with self._lock:
+            cell = self._cells.setdefault(
+                (wl, dev), [0.0, 0.0, 0.0, 0.0]
+            )
+            cell[0] += busy_s
+            cell[1] += dispatches
+            cell[2] += nbytes
+            cell[3] += queue_wait_s
+        if busy_s:
+            stats_metrics.VOLUME_SERVER_DEVICE_BUSY_SECONDS.labels(
+                workload=wl, device=dev
+            ).inc(busy_s)
+        if dispatches:
+            stats_metrics.VOLUME_SERVER_DEVICE_DISPATCHES.labels(
+                workload=wl, device=dev
+            ).inc(dispatches)
+        if nbytes:
+            stats_metrics.VOLUME_SERVER_DEVICE_DISPATCH_BYTES.labels(
+                workload=wl, device=dev
+            ).inc(nbytes)
+        if queue_wait_s:
+            stats_metrics.VOLUME_SERVER_DEVICE_QUEUE_WAIT_SECONDS.labels(
+                workload=wl, device=dev
+            ).inc(queue_wait_s)
+
+    def snapshot(self) -> dict[str, Any]:
+        """{workload: {devices: {label: {...}}, totals}} — the
+        volume.device.attribution document and the timeline sampler's
+        counter source."""
+        with self._lock:
+            cells = {k: list(v) for k, v in self._cells.items()}
+        out: dict[str, Any] = {}
+        for (wl, dev), (busy, calls, nbytes, wait) in sorted(cells.items()):
+            doc = out.setdefault(
+                wl,
+                {
+                    "busy_s": 0.0, "dispatches": 0, "bytes": 0,
+                    "queue_wait_s": 0.0, "devices": {},
+                },
+            )
+            doc["busy_s"] += busy
+            doc["dispatches"] += int(calls)
+            doc["bytes"] += int(nbytes)
+            doc["queue_wait_s"] += wait
+            doc["devices"][dev] = {
+                "busy_s": busy, "dispatches": int(calls),
+                "bytes": int(nbytes), "queue_wait_s": wait,
+            }
+        return out
+
+    def busy_by_workload(self) -> dict[str, float]:
+        with self._lock:
+            out: dict[str, float] = {}
+            for (wl, _dev), cell in self._cells.items():
+                out[wl] = out.get(wl, 0.0) + cell[0]
+            return out
+
+    def dispatches_by_workload(self) -> dict[str, int]:
+        with self._lock:
+            out: dict[str, int] = {}
+            for (wl, _dev), cell in self._cells.items():
+                out[wl] = out.get(wl, 0) + int(cell[1])
+            return out
+
+    def total_busy_s(self) -> float:
+        with self._lock:
+            return sum(cell[0] for cell in self._cells.values())
+
+    def reset_for_tests(self) -> None:
+        with self._lock:
+            self._cells.clear()
+
+
+LEDGER = DeviceLedger()
+
+
+def record(**kw) -> None:
+    """Module-level shorthand used by the dispatch sites (workload=/
+    device= default to the context)."""
+    LEDGER.record(**kw)
+
+
+def configure(enabled: bool) -> None:
+    """-obs.ledger.disable: recording becomes a no-op (the series stay
+    registered, they just stop moving)."""
+    LEDGER.enabled = bool(enabled)

@@ -204,11 +204,17 @@ class IncidentBundler:
     correlation, and — for latency SLOs with profiling enabled — a
     device-profile capture from the busiest node."""
 
-    def __init__(self, node_urls_fn, health_fn, clock=time.monotonic):
+    def __init__(
+        self, node_urls_fn, health_fn, clock=time.monotonic,
+        timeline_fn=None,
+    ):
         # node_urls_fn() -> fresh volume-server HTTP urls;
-        # health_fn() -> the /cluster/health.json dict (slo block incl.)
+        # health_fn() -> the /cluster/health.json dict (slo block incl.);
+        # timeline_fn(window_s) -> the assembled cluster flight timeline
+        # (stats/cluster.py) — the "what happened BEFORE the burn" view
         self._node_urls = node_urls_fn
         self._health = health_fn
+        self._timeline = timeline_fn
         self._clock = clock
         self._last_bundle_at: float | None = None
         self._lock = asyncio.Lock()  # one capture at a time
@@ -299,12 +305,23 @@ class IncidentBundler:
                     and CONFIG.profile_seconds > 0
                 ):
                     profile = await self._capture_profile(sess, urls)
+            timeline = None
+            if self._timeline is not None:
+                try:
+                    # the trailing flight-timeline window: per-class
+                    # device attribution + QoS/ingest pressure leading
+                    # INTO the burn, clock-aligned across nodes
+                    timeline = self._timeline(window_s)
+                except Exception:  # noqa: BLE001 — a timeline failure
+                    # must not lose the bundle
+                    log.exception("incident timeline assembly failed")
             bundle = {
                 "written_unix_ms": now_ms,
                 "trigger": trigger,
                 "window_seconds": window_s,
                 "reason": reason,
                 "health": self._health(),
+                "timeline": timeline,
                 "nodes": nodes,
                 "correlation": self._correlate(nodes),
                 "profile": profile,

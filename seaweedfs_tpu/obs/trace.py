@@ -213,6 +213,12 @@ class TraceRing:
 
 RING = TraceRing(CONFIG.trace_ring)
 
+# finished-trace taps (timeline.py's exemplar sampler).  Append-only
+# registration; called after the ring add with the completed trace.
+# Kept dumb on purpose: an observer that raises is dropped from the
+# hot path's perspective (finish_trace must never fail a request).
+FINISH_OBSERVERS: list = []
+
 
 def configure(cfg: ObsConfig) -> None:
     """Apply the -obs.* flags; process-global like stats.REGISTRY."""
@@ -254,6 +260,11 @@ def finish_trace(trace, token, status="") -> None:
     trace.end = time.perf_counter()
     trace.status = str(status)
     RING.add(trace)
+    for obs_fn in FINISH_OBSERVERS:
+        try:
+            obs_fn(trace)
+        except Exception:  # noqa: BLE001 — observers never fail a request
+            log.exception("trace finish observer failed")
     dur_ms = trace.duration_s * 1e3
     if CONFIG.slow_ms > 0 and dur_ms >= CONFIG.slow_ms:
         stages = ", ".join(

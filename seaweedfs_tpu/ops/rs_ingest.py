@@ -32,8 +32,11 @@ from __future__ import annotations
 import queue
 import threading
 
+import time
+
 import numpy as np
 
+from ..obs import devledger
 from ..stats import metrics as stats_metrics
 from . import rs
 
@@ -201,8 +204,16 @@ class StreamEncoder:
     def _compile_logged(self, key: tuple) -> None:
         from . import rs_resident
 
+        # explicit warmup attribution: the shared compile executor's
+        # thread has no tagging context (see rs_resident._compile_shape_logged)
+        t0 = time.perf_counter()
         try:
-            self._compile_key(key)
+            with devledger.workload("warmup"):
+                self._compile_key(key)
+            devledger.record(
+                workload="warmup",
+                busy_s=time.perf_counter() - t0, dispatches=1,
+            )
         except Exception:  # noqa: BLE001 — a failed ingest AOT compile
             # must not kill the shared executor; the shape keeps
             # encoding on the host codec, which serves it fine
@@ -243,7 +254,12 @@ class StreamEncoder:
         rs_resident._note_shape(key)
         x = _donatable(rows, self._tpu.on_tpu())
         exe = rs_resident._aot_executables.get(key)
-        with rs_resident._quiet_donation():
+        # pipeline workers call encode() directly from their own threads,
+        # so the ingest class is pinned here rather than inherited; the
+        # busy window covers dispatch through the D2H np.asarray fetch —
+        # the row's whole device occupancy
+        t0 = time.perf_counter()
+        with devledger.workload("ingest"), rs_resident._quiet_donation():
             if exe is not None:
                 out = exe(self._a_prep, x)
             else:
@@ -251,9 +267,15 @@ class StreamEncoder:
                     self._a_prep, x, kernel=self.backend,
                     interpret=self.interpret, k_true=self.k,
                 )
+            parity = np.asarray(out)[: self.p]
+        devledger.record(
+            workload="ingest",
+            busy_s=time.perf_counter() - t0, dispatches=1,
+            nbytes=int(x.nbytes) + int(parity.nbytes),
+        )
         with self._mu:
             self.device_rows += 1
-        return np.asarray(out)[: self.p]
+        return parity
 
     def encode_host(self, rows: np.ndarray) -> np.ndarray:
         with self._mu:

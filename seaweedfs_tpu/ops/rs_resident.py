@@ -48,6 +48,7 @@ except ImportError:  # pragma: no cover - older jax
 
 from . import gf256, rs_tpu
 from ..parallel import mesh as mesh_mod
+from ..obs import devledger
 from ..obs import incident as obs_incident
 from ..obs import trace as obs_trace
 from ..stats import metrics as stats_metrics
@@ -386,6 +387,10 @@ class DevicePipeline:
         self._slots = max(1, slots)
         self._active = 0
         self._busy_s = 0.0
+        # cumulative (never-reset) busy clock — the conservation anchor
+        # the devledger per-class sums reconcile against; _busy_s stays
+        # windowed because the overlap gauge needs the window semantics
+        self.total_busy_s = 0.0
         self._window_t0 = 0.0
         self.last_overlap = 0.0
         # arena pool: one per concurrently held slot, grown on demand so
@@ -430,6 +435,7 @@ class DevicePipeline:
                 self._active -= 1
                 self._free_arenas.append(arena_idx)
                 self._busy_s += dur
+                self.total_busy_s += dur
                 wall = time.perf_counter() - self._window_t0
                 if wall > 0:
                     self.last_overlap = self._busy_s / wall
@@ -437,6 +443,10 @@ class DevicePipeline:
                         self.last_overlap
                     )
                 self._cond.notify()
+            # slot duration IS the device section's busy time, so the
+            # ledger's per-class sum conserves against total_busy_s by
+            # construction (workload/device ride the caller's context)
+            devledger.record(busy_s=dur, queue_wait_s=t0 - t_req)
 
 
 class DeviceShardCache:
@@ -2053,8 +2063,24 @@ def _register_compiled(key: tuple, exe) -> None:
 
 
 def _compile_shape_logged(key: tuple) -> None:
+    # the compile executor's worker thread never inherits the caller's
+    # tagging context, so warmup attribution is explicit here; a compile
+    # occupies the (single) compile stream, not a serving slot, hence
+    # its own class rather than folding into the requester's
+    place = key[-1]
+    dev_label = (
+        "mesh" if isinstance(place, int) and place >= 2
+        else str(place[1]) if isinstance(place, tuple)
+        else "default"
+    )
+    t0 = time.perf_counter()
     try:
-        _compile_shape(key)
+        with devledger.workload("warmup", device=dev_label):
+            _compile_shape(key)
+        devledger.record(
+            workload="warmup", device=dev_label,
+            busy_s=time.perf_counter() - t0, dispatches=1,
+        )
     except Exception:  # noqa: BLE001 — a failed AOT compile must not
         # kill the executor; the shape stays cold and falls back to the
         # inline-compile path on a later non-shedding caller
@@ -2449,7 +2475,15 @@ def reconstruct_intervals(
                 sub_out[sub_idx] = out[j, lo : lo + take].tobytes()
         return wire_bytes
 
-    with cache.pipeline.slot() as pslot, dev_span:
+    # the ledger's device label follows placement; the workload class is
+    # whatever the caller tagged (devledger.current_workload()) — the
+    # serving dispatcher / scrub loop / repair handler set it at the edge
+    dev_label = (
+        "mesh" if place == "mesh"
+        else str(int(place)) if cache.mesh is not None
+        else "default"
+    )
+    with devledger.device(dev_label), cache.pipeline.slot() as pslot, dev_span:
         slot_wait_s = pslot.wait_s
         # the slot's preallocated arena only where device_put COPIES
         # (TPU/GPU); the CPU PJRT client zero-copies aligned numpy, so a
@@ -2529,6 +2563,9 @@ def reconstruct_intervals(
         )
         stats_metrics.VOLUME_SERVER_EC_DEVICE_H2D_BYTES.inc(dev_h2d)
         stats_metrics.VOLUME_SERVER_EC_DEVICE_D2H_BYTES.inc(dev_d2h)
+        # busy/queue-wait are the slot's (recorded on exit); dispatches
+        # and boundary bytes are this batch's
+        devledger.record(dispatches=dev_calls, nbytes=dev_h2d + dev_d2h)
     outputs: list[list[bytes]] = [[] for _ in requests]
     for (idx, *_), piece in zip(subs, sub_out):
         outputs[idx].append(piece)  # subs are in offset order per request
@@ -2586,6 +2623,9 @@ def make_batched_call(
                     cache.mesh, P(mesh_mod.SHARD_AXIS, None, None)
                 ),
             )
+            # graftlint: allow(untagged-device-dispatch): bench thunk —
+            # the profiler times this measured region externally; ledger
+            # tagging inside it would bill bench time to a serving class
             return _dispatch_call(
                 kind, vec, a_prep, survivors, len(use), w_true, groups,
                 tile, fetch, kernel, interpret, key=key, mesh=cache.mesh,
@@ -2634,6 +2674,8 @@ def make_batched_call(
             )
         else:
             vec = jnp.asarray(vec_np)
+        # graftlint: allow(untagged-device-dispatch): bench thunk — see
+        # sharded_thunk above; the measured region stays ledger-free
         return _dispatch_call(
             kind, vec, a_prep, survivors, len(use), w_true, groups,
             tile, fetch, kernel, interpret, key=key,
@@ -2771,33 +2813,43 @@ def scrub_volume(
         # buffer (the zero padding verifies trivially: parity of zeros
         # is zero, identically placed in every shard)
         true_size = int(data[0].size)
+    # scrub is scrub no matter who invoked it (the background loop, the
+    # shell verb, a repair preflight) — pin the ledger class here, where
+    # the dispatch happens
+    t0 = time.perf_counter()
     if layout == "blockdiag":
         quant = cache.groups * LANE
         n_lanes = -(-true_size // quant) * quant
         a_blk = _prepared_blockdiag_matrix(
             parity_m.tobytes(), *parity_m.shape, cache.groups
         )
-        # graftlint: allow(device-sync): deliberate D2H of the tiny
-        # [p, n_seg] int32 mismatch partials — the whole point of scrub
-        # is that only this verdict leaves the device
-        partials = np.asarray(
-            _scrub_call_blockdiag(
-                a_blk, data, parity,
-                n_lanes=n_lanes, groups=cache.groups,
-                kernel=kernel, interpret=interpret,
+        with devledger.workload("scrub"):
+            # graftlint: allow(device-sync): deliberate D2H of the tiny
+            # [p, n_seg] int32 mismatch partials — the whole point of
+            # scrub is that only this verdict leaves the device
+            partials = np.asarray(
+                _scrub_call_blockdiag(
+                    a_blk, data, parity,
+                    n_lanes=n_lanes, groups=cache.groups,
+                    kernel=kernel, interpret=interpret,
+                )
             )
-        )
     else:
         n_lanes = -(-true_size // LANE) * LANE
         a_bm = _prepared_matrix(parity_m.tobytes(), *parity_m.shape)
-        # graftlint: allow(device-sync): deliberate D2H of the tiny
-        # [p, n_seg] int32 mismatch partials (see blockdiag branch)
-        partials = np.asarray(
-            _scrub_call(
-                a_bm, data, parity,
-                n_lanes=n_lanes, kernel=kernel, interpret=interpret,
+        with devledger.workload("scrub"):
+            # graftlint: allow(device-sync): deliberate D2H of the tiny
+            # [p, n_seg] int32 mismatch partials (see blockdiag branch)
+            partials = np.asarray(
+                _scrub_call(
+                    a_bm, data, parity,
+                    n_lanes=n_lanes, kernel=kernel, interpret=interpret,
+                )
             )
-        )
+    devledger.record(
+        workload="scrub", busy_s=time.perf_counter() - t0,
+        dispatches=1, nbytes=int(partials.nbytes),
+    )
     stats_metrics.VOLUME_SERVER_EC_SCRUB_DISPATCH.labels(
         mode="per_volume"
     ).inc()
@@ -2973,15 +3025,21 @@ def scrub_all_resident(
             vols = 1 << (len(chunk) - 1).bit_length()
             padded = chunk + [chunk[0]] * (vols - len(chunk))
             flat = tuple(s for _vid, shards in padded for s in shards)
-            # graftlint: allow(device-sync): deliberate D2H — the
-            # [V, p, n_seg] mismatch partials are the megakernel's only
-            # output, host-reduced to per-volume verdict bitmaps
-            partials = np.asarray(
-                _scrub_all_call(
-                    a_blk, flat, n_lanes=n_lanes, groups=groups,
-                    vols=vols, k=k, p=p, kernel=kernel,
-                    interpret=interpret,
+            t0 = time.perf_counter()
+            with devledger.workload("scrub"):
+                # graftlint: allow(device-sync): deliberate D2H — the
+                # [V, p, n_seg] mismatch partials are the megakernel's
+                # only output, host-reduced to per-volume verdict bitmaps
+                partials = np.asarray(
+                    _scrub_all_call(
+                        a_blk, flat, n_lanes=n_lanes, groups=groups,
+                        vols=vols, k=k, p=p, kernel=kernel,
+                        interpret=interpret,
+                    )
                 )
+            devledger.record(
+                workload="scrub", busy_s=time.perf_counter() - t0,
+                dispatches=1, nbytes=int(partials.nbytes),
             )
             device_calls += 1
             stats_metrics.VOLUME_SERVER_EC_SCRUB_DISPATCH.labels(

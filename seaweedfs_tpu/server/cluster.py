@@ -35,6 +35,8 @@ class LocalCluster:
         tier_backends: dict | None = None,  # default: local backend in base_dir/tier
         disk_types: list[str] | None = None,  # per-directory, all servers
         master_kwargs: dict | None = None,
+        volume_kwargs: dict | None = None,  # extra VolumeServer kwargs,
+        # all servers (e.g. ec_ingest=IngestConfig(backend="xla"))
     ):
         import os
 
@@ -79,6 +81,7 @@ class LocalCluster:
                     rack=(racks or ["r1"])[i % len(racks or ["r1"])],
                     tier_backends=tier_backends,
                     disk_types=disk_types,
+                    **(volume_kwargs or {}),
                 )
             )
         self.volume_servers: list[VolumeServer] = []

@@ -138,7 +138,8 @@ class MasterServer:
             obs.incident.configure(obs_incident)
         self.slo = obs.SloEngine(obs_slo, self.telemetry, self.repair)
         self.incident = obs.IncidentBundler(
-            self.telemetry.fresh_node_urls, self._health_doc
+            self.telemetry.fresh_node_urls, self._health_doc,
+            timeline_fn=self.telemetry.timeline,
         )
         self.slo.on_violation.append(self._on_slo_violation)
         self._incident_captures: set = set()
@@ -214,6 +215,9 @@ class MasterServer:
         # through the same hook)
         app[stats.metrics.metrics_collect_key()] = self.telemetry.refresh_gauges
         app.router.add_get("/debug/traces", obs.traces_handler)
+        # the assembled cluster flight timeline (heartbeat-shipped node
+        # samples, clock-aligned) — ?window=<seconds> trims the tail
+        app.router.add_get("/debug/timeline", self.h_debug_timeline)
         # the master's own flight-recorder ring (repair + SLO events);
         # volume servers serve the same endpoint for the fan-out
         app.router.add_get("/debug/incident", obs.incident.incident_handler)
@@ -1214,6 +1218,20 @@ class MasterServer:
         # engine's verdicts, one document (_health_doc — the incident
         # bundler embeds the same)
         return web.json_response(self._health_doc())
+
+    async def h_debug_timeline(self, request: web.Request) -> web.Response:
+        """GET /debug/timeline[?window=S]: the clock-aligned cluster
+        flight timeline assembled from heartbeat-shipped node samples.
+        Lands on the leader with the rest of the telemetry plane."""
+        self._redirect_if_follower(request)
+        window = request.query.get("window")
+        try:
+            window_s = float(window) if window else None
+        except ValueError:
+            return web.json_response(
+                {"error": f"bad window: {window!r}"}, status=400
+            )
+        return web.json_response(self.telemetry.timeline(window_s=window_s))
 
     async def h_grow(self, request: web.Request) -> web.Response:
         self._redirect_if_follower(request)

@@ -279,6 +279,15 @@ class VolumeServer:
         self._digest_inflight_at: int | None = None  # its heartbeat seq
         self._hb_sent = 0  # per-stream counters (reset on reconnect)
         self._hb_acked = 0
+        # flight-timeline shipping state (obs/timeline.py): samples
+        # accrue in the backlog until the heartbeat that carried them is
+        # ACKed — same protocol as the stage digests above; reships
+        # after a stream break are safe because the master dedupes
+        # samples by (node, t)
+        self.timeline = None  # TimelineSampler, built in start()
+        self._timeline_backlog: list[dict] = []
+        self._timeline_shipped = 0  # leading backlog entries in flight
+        self._timeline_inflight_at: int | None = None
         self._grpc_server: grpc.aio.Server | None = None
         self._http_runner: web.AppRunner | None = None
         self._tasks: list[asyncio.Task] = []
@@ -335,6 +344,14 @@ class VolumeServer:
         # per-shape device dispatch view (volume.device.status -hot)
         app.router.add_get("/debug/incident", obs.incident.incident_handler)
         app.router.add_get("/debug/device/hot", obs.device_hot_handler)
+        # this node's flight-timeline ring (obs/timeline.py): the local
+        # view of what the master assembles cluster-wide
+        app.router.add_get("/debug/timeline", self.h_debug_timeline)
+        # this node's device-time ledger: per-workload busy/dispatch/
+        # bytes attribution (shell volume.device.attribution)
+        app.router.add_get(
+            "/debug/device/attribution", self.h_debug_device_attribution
+        )
         if os.environ.get("SWFS_DEBUG") == "1":
             # stack dumps reveal internals; opt-in only (the reference
             # gates pprof handlers the same way)
@@ -378,6 +395,18 @@ class VolumeServer:
             self._tasks.append(
                 spawn_logged(self._tier_loop_forever(), log, "ec tier loop")
             )
+        from ..obs import timeline as timeline_mod
+        from ..obs import trace as obs_trace_mod
+
+        if obs_trace_mod.CONFIG.timeline_enabled:
+            self.timeline = timeline_mod.TimelineSampler(
+                node=self.url
+            ).install()
+            self._tasks.append(
+                spawn_logged(
+                    self._timeline_forever(), log, "timeline sampler loop"
+                )
+            )
         push = stats.start_push_loop(
             "volumeServer", self.url, self.metrics_address,
             self.metrics_interval_seconds, collect=self._collect_metrics,
@@ -385,6 +414,45 @@ class VolumeServer:
         if push is not None:
             self._tasks.append(push)
         log.info("volume server up http=%s grpc=%s", self.url, self.grpc_url)
+
+    async def _timeline_forever(self) -> None:
+        """~1s flight-timeline sampling (-obs.timeline.intervalSeconds):
+        each tick snapshots the ledger/QoS/ingest counters into one
+        clock-aligned sample; the heartbeat builder drains the ring's
+        new suffix into its ACK-gated backlog."""
+        from ..obs import trace as obs_trace_mod
+
+        interval = obs_trace_mod.CONFIG.timeline_interval_seconds
+        while not self._stopping:
+            await asyncio.sleep(interval)
+            try:
+                self.timeline.sample()
+            except Exception:  # noqa: BLE001 — sampling must never die
+                log.exception("timeline sample failed")
+
+    async def h_debug_timeline(self, request: web.Request) -> web.Response:
+        window = request.query.get("window")
+        samples = (
+            self.timeline.snapshot(float(window) if window else None)
+            if self.timeline is not None
+            else []
+        )
+        return web.json_response({"node": self.url, "samples": samples})
+
+    async def h_debug_device_attribution(
+        self, request: web.Request
+    ) -> web.Response:
+        """GET /debug/device/attribution: the device-time ledger — busy
+        seconds / dispatches / bytes / queue-wait per workload class,
+        with the per-device breakdown (shell volume.device.attribution)."""
+        from ..obs import devledger
+
+        return web.json_response({
+            "node": self.url,
+            "enabled": devledger.LEDGER.enabled,
+            "total_busy_seconds": devledger.LEDGER.total_busy_s(),
+            "workloads": devledger.LEDGER.snapshot(),
+        })
 
     async def _ec_scrub_forever(self) -> None:
         """Periodic parity scrub of every locally-complete EC volume
@@ -570,6 +638,10 @@ class VolumeServer:
         # server must not report the dead instance's last occupancy
         # until its first batch
         self.ec_dispatcher.shutdown()
+        if self.timeline is not None:
+            # unhook the finished-trace tap: the process-global observer
+            # list outlives this server (co-hosted roles, test restarts)
+            self.timeline.uninstall()
         if self.ingest is not None:
             # joins encode workers + the group-commit flusher
             await asyncio.to_thread(self.ingest.close)
@@ -758,6 +830,33 @@ class VolumeServer:
             # pulses() bumps _hb_sent right after this build, so the
             # heartbeat carrying this shipment is number _hb_sent + 1
             self._digest_inflight_at = self._hb_sent + 1
+        # flight-timeline samples ride the same ACK gate: fold the
+        # ring's new suffix into the backlog, retire the in-flight
+        # shipment once its heartbeat is answered, ship the backlog only
+        # while nothing is unconfirmed.  The backlog is capped at one
+        # ring's worth — a long partition drops the OLDEST unshipped
+        # samples (bounded memory; the local /debug/timeline ring still
+        # has them until they age out).
+        if self.timeline is not None:
+            self._timeline_backlog.extend(self.timeline.take_new())
+            drop = len(self._timeline_backlog) - self.timeline.capacity
+            if drop > 0:
+                del self._timeline_backlog[:drop]
+                self._timeline_shipped = max(0, self._timeline_shipped - drop)
+            if (
+                self._timeline_inflight_at is not None
+                and self._hb_acked >= self._timeline_inflight_at
+            ):
+                del self._timeline_backlog[: self._timeline_shipped]
+                self._timeline_shipped = 0
+                self._timeline_inflight_at = None
+            if self._timeline_inflight_at is None and self._timeline_backlog:
+                tel.timeline_samples_json.extend(
+                    json.dumps(s, separators=(",", ":"))
+                    for s in self._timeline_backlog
+                )
+                self._timeline_shipped = len(self._timeline_backlog)
+                self._timeline_inflight_at = self._hb_sent + 1
         return tel
 
     def _identity_heartbeat(self) -> master_pb2.Heartbeat:
@@ -864,6 +963,10 @@ class VolumeServer:
             self._hb_acked = 0
             self._digest_shipped = {}
             self._digest_inflight_at = None
+            # unconfirmed timeline samples stay in the backlog and
+            # re-ship whole on the next connection (master dedupes by t)
+            self._timeline_shipped = 0
+            self._timeline_inflight_at = None
 
     # ------------------------------------------------------------------ HTTP data plane
 

@@ -40,6 +40,7 @@ import logging
 import time
 
 from .. import obs, stats
+from ..obs import devledger
 from ..obs import incident as obs_incident
 from ..utils import faultpolicy
 from ..utils.tasks import spawn_logged
@@ -291,9 +292,21 @@ class EcReadDispatcher:
         # sink and are replayed onto every member trace afterwards —
         # observe=False so the stage histograms count each stage once
         t0 = time.perf_counter()
+        # device-ledger class for the batch: a batch is bulk-tier only
+        # when every member is (mixed batches serve an interactive
+        # reader, so they attribute interactive); "" = qos off =
+        # interactive.  asyncio.to_thread copies the context, so the
+        # tag reaches the device section in ops/rs_resident.
+        wl = (
+            "serving_bulk"
+            if items and all(r.tier == "bulk" for r in items)
+            else "serving_interactive"
+        )
         with obs.stage_sink() as sink:
             try:
-                with obs.span("batch_dispatch", needles=len(items), vid=vid):
+                with devledger.workload(wl), obs.span(
+                    "batch_dispatch", needles=len(items), vid=vid
+                ):
                     results = await asyncio.to_thread(
                         self.store.read_ec_needles_batch,
                         vid,

@@ -210,6 +210,70 @@ async def cmd_cluster_slo(env, args):
             )
 
 
+@command("cluster.timeline")
+async def cmd_cluster_timeline(env, args):
+    """[-window <seconds>] [-json] : the cluster flight timeline —
+    clock-aligned ~1s samples shipped in heartbeats from every node
+    (per-workload device busy/dispatch deltas, QoS depth/shed/breaker,
+    ingest pressure, resident bytes, slowest-trace exemplars)"""
+    import aiohttp
+
+    flags = parse_flags(args)
+    url = (
+        f"http://{server_address.http_address(env.masters[0])}"
+        "/debug/timeline"
+    )
+    params = {}
+    if flags.get("window"):
+        params["window"] = flags["window"]
+    async with aiohttp.ClientSession() as sess:
+        async with sess.get(url, params=params, allow_redirects=True) as r:
+            if r.status != 200:
+                raise ValueError(f"{url} returned HTTP {r.status}")
+            doc = await r.json()
+    if "json" in flags:
+        env.write(json.dumps(doc, indent=2, sort_keys=True))
+        return
+    samples = doc.get("samples", [])
+    env.write(
+        f"nodes: {', '.join(doc.get('nodes', [])) or '-'}  "
+        f"samples: {len(samples)}"
+        + (f"  window: {doc['window_seconds']:.0f}s"
+           if doc.get("window_seconds") else "")
+    )
+    if not samples:
+        env.write(
+            "no samples yet (nodes ship one per heartbeat; check "
+            "-obs.timeline.disable)"
+        )
+        return
+    for row in samples:
+        for node, s in sorted(row.get("nodes", {}).items()):
+            busy = " ".join(
+                f"{wl}={ms:.0f}ms"
+                for wl, ms in sorted(s.get("busy_ms", {}).items())
+            )
+            qos = s.get("qos", {})
+            shed = sum(qos.get("shed", {}).values())
+            ingest = s.get("ingest", {})
+            line = (
+                f"  t={row['t']} {node}: "
+                + (busy or "idle")
+                + (f" qshed={shed}" if shed else "")
+                + (f" ingest={fmt_bytes(ingest['bytes'])}"
+                   if ingest.get("bytes") else "")
+                + (f" backpressure={ingest['backpressure']}"
+                   if ingest.get("backpressure") else "")
+            )
+            ex = s.get("exemplar")
+            if ex:
+                line += (
+                    f"  [slowest {ex['name']} {ex['ms']:.1f}ms "
+                    f"trace={ex['trace_id']} span={ex['span']}]"
+                )
+            env.write(line)
+
+
 @command("cluster.incident.dump")
 async def cmd_cluster_incident_dump(env, args):
     """[-window <seconds>] [-json] : snapshot the cluster's flight

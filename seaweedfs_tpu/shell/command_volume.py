@@ -7,6 +7,7 @@ command_volume_vacuum.go, command_volume_mark.go.
 from __future__ import annotations
 
 import itertools
+import json
 
 from ..pb import master_pb2, volume_server_pb2
 from ..storage import types as t
@@ -878,6 +879,81 @@ async def cmd_volume_device_status(env, args):
             env.write(f"  ec volume {vid}: {count} resident shards")
         if hot_limit and not n["stale"]:
             await _print_hot_shapes(env, url, hot_limit)
+
+
+@command("volume.device.attribution")
+async def cmd_volume_device_attribution(env, args):
+    """[-node <host:port>] [-json] : per-workload device-time
+    attribution from each node's ledger (/debug/device/attribution) —
+    busy seconds, dispatches, bytes, and queue wait per workload class
+    (serving_interactive/serving_bulk/ingest/scrub/repair/warmup/bulk),
+    with the per-device-label breakdown.  "Who is burning the
+    accelerator" as one command"""
+    import aiohttp
+
+    from .command_cluster import fetch_cluster_health, fmt_bytes
+
+    flags = parse_flags(args)
+    want = flags.get("node") or flags.get("")
+    health = await fetch_cluster_health(env)
+    urls = sorted(health["nodes"])
+    if want:
+        if want not in urls:
+            raise ValueError(
+                f"node {want!r} not in telemetry plane (known: "
+                f"{', '.join(urls) or 'none'})"
+            )
+        urls = [want]
+    docs = []
+    for url in urls:
+        try:
+            async with aiohttp.ClientSession() as sess:
+                async with sess.get(
+                    f"http://{url}/debug/device/attribution"
+                ) as r:
+                    if r.status != 200:
+                        raise ValueError(f"HTTP {r.status}")
+                    docs.append(await r.json())
+        except Exception as e:  # noqa: BLE001 — one unreachable node
+            # must not kill the whole sweep
+            env.write(f"{url}: unavailable ({e})")
+    if "json" in flags:
+        env.write(json.dumps(docs, indent=2, sort_keys=True))
+        return
+    for doc in docs:
+        total = doc.get("total_busy_seconds", 0.0)
+        env.write(
+            f"{doc['node']} device busy {total:.3f}s"
+            + ("" if doc.get("enabled", True)
+               else "  [ledger DISABLED: -obs.ledger.disable]")
+        )
+        workloads = doc.get("workloads", {})
+        if not workloads:
+            env.write("  nothing dispatched yet")
+            continue
+        env.write(
+            "  {:<20} {:>10} {:>10} {:>8} {:>10} {:>10}".format(
+                "workload", "busy_s", "share", "calls", "bytes", "qwait_s"
+            )
+        )
+        for wl, row in sorted(
+            workloads.items(), key=lambda kv: -kv[1]["busy_s"]
+        ):
+            share = row["busy_s"] / total if total > 0 else 0.0
+            env.write(
+                "  {:<20} {:>10.3f} {:>9.1%} {:>8} {:>10} {:>10.3f}".format(
+                    wl, row["busy_s"], share, row["dispatches"],
+                    fmt_bytes(row["bytes"]), row["queue_wait_s"],
+                )
+            )
+            devices = row.get("devices", {})
+            if len(devices) > 1:
+                for dev, d in sorted(devices.items()):
+                    env.write(
+                        f"    device {dev}: {d['busy_s']:.3f}s "
+                        f"calls={d['dispatches']} "
+                        f"bytes={fmt_bytes(d['bytes'])}"
+                    )
 
 
 async def _print_hot_shapes(env, url: str, limit: int) -> None:

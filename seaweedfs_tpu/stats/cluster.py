@@ -23,6 +23,7 @@ is the receiving half:
 """
 from __future__ import annotations
 
+import json
 import math
 import threading
 import time
@@ -33,6 +34,13 @@ from typing import Any
 from prometheus_client import Counter, Gauge
 
 from .metrics import REGISTRY, STAGE_SECONDS_BUCKETS
+
+# ONE retention window for everything the telemetry plane keeps past a
+# node's last heartbeat: a disconnected node's final snapshot AND its
+# shipped flight-timeline samples age out together (two magic numbers
+# here previously meant the post-mortem views could expire at different
+# times — useless for correlating them)
+RETENTION_SECONDS = 3600.0
 
 CLUSTER_NODES = Gauge(
     "SeaweedFS_cluster_volume_nodes",
@@ -241,6 +249,10 @@ class NodeTelemetry:
     ingest_fsyncs_total: int = 0
     ingest_active_pipelines: int = 0
     ingest_streamed_seals: int = 0
+    # flight-timeline samples shipped over heartbeats (obs/timeline.py),
+    # keyed by the sample's whole-second `t` — the key IS the dedupe for
+    # ACK-protocol reships — trimmed to RETENTION_SECONDS
+    timeline: dict[int, dict] = field(default_factory=dict)
 
     def to_dict(self, now: float, stale_after: float) -> dict[str, Any]:
         age = now - self.last_seen
@@ -336,14 +348,15 @@ class ClusterTelemetry:
         self,
         pulse_seconds: float,
         stale_after_pulses: float = 2.0,
-        retention_seconds: float = 3600.0,
+        retention_seconds: float = RETENTION_SECONDS,
     ) -> None:
         self.pulse_seconds = pulse_seconds
         self.stale_after = stale_after_pulses * pulse_seconds
         # a DISCONNECTED node's last snapshot is kept this long past its
         # final heartbeat (the operator's post-mortem view), then
         # dropped — otherwise rolling restarts on dynamic ports would
-        # grow the node set and its gauge label space without bound
+        # grow the node set and its gauge label space without bound.
+        # Timeline samples share the SAME window (see RETENTION_SECONDS).
         self.retention_seconds = max(retention_seconds, self.stale_after)
         self._lock = threading.Lock()
         self._nodes: dict[str, NodeTelemetry] = {}
@@ -431,6 +444,21 @@ class ClusterTelemetry:
                 getattr(tel, "ingest_streamed_seals", 0)
             )
             nt.resident_by_volume = dict(tel.resident_shards_by_volume)
+            # getattr-guarded: pre-r21 servers ship no timeline; parsed
+            # leniently (the sample schema is JSON on purpose — see
+            # master.proto field 35) and deduped by `t`, which makes the
+            # volume server's ACK-protocol reships idempotent
+            for raw in getattr(tel, "timeline_samples_json", ()):
+                try:
+                    s = json.loads(raw)
+                    t_key = int(s["t"])
+                except (ValueError, KeyError, TypeError):
+                    continue
+                nt.timeline[t_key] = s
+            if nt.timeline:
+                cutoff = now - self.retention_seconds
+                for t_key in [t for t in nt.timeline if t < cutoff]:
+                    del nt.timeline[t_key]
             n_buckets = len(STAGE_SECONDS_BUCKETS) + 1
             for d in tel.stage_digests:
                 merged = self._stages.setdefault(
@@ -617,6 +645,48 @@ class ClusterTelemetry:
             rec = self._stages.get(stage)
             buckets = list(rec.buckets) if rec is not None else None
         return quantile_from_buckets(buckets, q) if buckets else None
+
+    def timeline(
+        self,
+        window_s: float | None = None,
+        now: float | None = None,
+    ) -> dict[str, Any]:
+        """The assembled cluster flight timeline: every node's shipped
+        samples joined CLOCK-ALIGNED on their whole-second `t`, so one
+        row answers "what was every node doing at t" (ledger busy
+        deltas, QoS pressure, ingest ramp, exemplar traces).  `window_s`
+        trims to the trailing window; the incident bundler embeds
+        exactly this with the burn window."""
+        now = time.time() if now is None else now
+        with self._lock:
+            per_node = {
+                url: dict(nt.timeline)
+                for url, nt in self._nodes.items()
+                if nt.timeline
+            }
+        ticks: set[int] = set()
+        for samples in per_node.values():
+            ticks.update(samples)
+        if window_s is not None and ticks:
+            cutoff = max(ticks) - window_s
+            ticks = {t_ for t_ in ticks if t_ >= cutoff}
+        rows = [
+            {
+                "t": t_,
+                "nodes": {
+                    url: samples[t_]
+                    for url, samples in sorted(per_node.items())
+                    if t_ in samples
+                },
+            }
+            for t_ in sorted(ticks)
+        ]
+        return {
+            "generated_unix_ms": int(now * 1e3),
+            "window_seconds": window_s,
+            "nodes": sorted(per_node),
+            "samples": rows,
+        }
 
     def health(self, now: float | None = None) -> dict[str, Any]:
         """The /cluster/health.json document."""

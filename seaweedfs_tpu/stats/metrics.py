@@ -633,6 +633,48 @@ for _path in ("streamed", "offline"):
 for _path in ("device", "host"):
     VOLUME_SERVER_INGEST_ROWS.labels(path=_path)
 
+# device-time attribution ledger (obs/devledger.py): every device
+# dispatch — serving reconstruct, ingest row encode, scrub megakernel,
+# repair re-encode, AOT pre-warm compiles, bulk executor legs — is
+# tagged with a workload class and lands here per class per device, so
+# "who is burning the accelerator" is a PromQL query instead of a
+# per-subsystem spelunk.  The class busy sums reconcile against the
+# DevicePipeline/bulk wall clocks (tests pin the conservation).
+DEVICE_WORKLOADS = (
+    "serving_interactive", "serving_bulk", "ingest", "scrub", "repair",
+    "warmup", "bulk", "untagged",
+)
+VOLUME_SERVER_DEVICE_BUSY_SECONDS = Counter(
+    "SeaweedFS_volumeServer_device_busy_seconds",
+    "Accelerator busy seconds attributed per workload class and device "
+    "(device = mesh for lane-sharded calls, a device index for pinned "
+    "calls, default/host for unplaced or CPU-kernel legs; untagged = a "
+    "dispatch that escaped the workload tagging — should stay ~0).",
+    ["workload", "device"],
+    registry=REGISTRY,
+)
+VOLUME_SERVER_DEVICE_DISPATCHES = Counter(
+    "SeaweedFS_volumeServer_device_dispatches",
+    "Device dispatches (kernel calls / codec legs / background "
+    "compiles) per workload class and device.",
+    ["workload", "device"],
+    registry=REGISTRY,
+)
+VOLUME_SERVER_DEVICE_DISPATCH_BYTES = Counter(
+    "SeaweedFS_volumeServer_device_dispatch_bytes",
+    "Bytes moved across the device boundary (H2D + D2H) per workload "
+    "class and device.",
+    ["workload", "device"],
+    registry=REGISTRY,
+)
+VOLUME_SERVER_DEVICE_QUEUE_WAIT_SECONDS = Counter(
+    "SeaweedFS_volumeServer_device_queue_wait_seconds",
+    "Seconds workloads spent queued for a device pipeline slot per "
+    "workload class and device — who is queued behind whom.",
+    ["workload", "device"],
+    registry=REGISTRY,
+)
+
 MQ_FENCE_CONFLICT = Counter(
     "SeaweedFS_mq_fence_conflict",
     "Partition activations that found the durable log tail moved after "

@@ -50,6 +50,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from ...obs import devledger
 from ...ops import rs
 from .layout import DATA_SHARDS, LARGE_BLOCK_SIZE
 
@@ -137,12 +138,23 @@ class Codec:
     mode): pread/pwrite and the native kernel all release the GIL, so the
     three legs genuinely overlap."""
 
-    def __init__(self, matrix: np.ndarray, backend: str, threaded: bool = False):
+    def __init__(
+        self,
+        matrix: np.ndarray,
+        backend: str,
+        threaded: bool = False,
+        workload: str = "bulk",
+    ):
         self.backend = rs.resolve_backend(backend)
         self.matrix = np.asarray(matrix, dtype=np.uint8)
         self.rows = self.matrix.shape[0]
         self.device = self.backend in ("xla", "pallas")
         self.busy_s = 0.0
+        # device-ledger class the legs record under: the dedicated leg
+        # thread never sees the submitting pipeline's context, so tenancy
+        # rides as an attribute (encode="bulk", rebuild="repair",
+        # verify="scrub" — encoder.py sets it per pipeline)
+        self.workload = workload
         self._pool = None
         if self.device:
             from ...ops import rs_tpu
@@ -170,52 +182,77 @@ class Codec:
 
     def _host_leg(self, shards: np.ndarray) -> np.ndarray:
         t0 = time.perf_counter()
-        out = self._codec.apply_matrix(self.matrix, shards)
-        self.busy_s += time.perf_counter() - t0
+        with devledger.workload(self.workload, device="host"):
+            out = self._codec.apply_matrix(self.matrix, shards)
+        dur = time.perf_counter() - t0
+        self.busy_s += dur
+        devledger.record(
+            workload=self.workload, device="host", busy_s=dur,
+            dispatches=1, nbytes=int(shards.nbytes) + int(out.nbytes),
+        )
         return out
 
     def _device_leg(self, shards: np.ndarray) -> np.ndarray:
         """Both transfers ship FLAT 1-D buffers (apply_matrix_device_flat):
         the tunnel pays ~80ms per row on 2-D arrays, which would dominate
         the whole pipeline."""
+        t0 = time.perf_counter()
+        parity = self._device_leg_tagged(shards)
+        dur = time.perf_counter() - t0
+        self.busy_s += dur
+        devledger.record(
+            workload=self.workload, busy_s=dur, dispatches=1,
+            nbytes=int(shards.nbytes) + int(parity.nbytes),
+        )
+        return parity
+
+    def _device_leg_tagged(self, shards: np.ndarray) -> np.ndarray:
         import jax
 
-        t0 = time.perf_counter()
         groups = self._tpu.BLOCKDIAG_GROUPS
         k, b = shards.shape
-        if self.backend == "pallas" and b % (groups * 128) == 0:
-            # block-diagonal fast path: host stages segment-stacked rows
-            # (free — same bytes) and the MXU runs with a full M dimension
-            # (~152 vs ~123 GB/s, see ops/rs_tpu.py header)
-            stacked = np.ascontiguousarray(self._tpu.stack_segments(shards))
-            x = jax.device_put(stacked.reshape(-1))
-            out = self._tpu.apply_matrix_device_flat(
-                self._a_blk,
-                x,
-                k=groups * k,
-                m=groups * self.rows,
-                tile=self._tpu.BLOCKDIAG_TILE,
-                interpret=self._interpret,
-            )
-            seg = b // groups
-            parity = self._tpu.unstack_segments(
-                # graftlint: allow(device-sync): the codec worker's own
-                # D2H — fetched on the dedicated device leg, timed busy_s
-                np.asarray(out).reshape(groups * self.rows, seg), self.rows
-            )
-        else:
-            x = jax.device_put(np.ascontiguousarray(shards).reshape(-1))
-            out = self._tpu.apply_matrix_device_flat(
-                self._a_bm,
-                x,
-                k=k,
-                m=self.rows,
-                kernel=self.backend,
-                interpret=self._interpret,
-            )
-            # graftlint: allow(device-sync): codec-leg D2H (see above)
-            parity = np.asarray(out).reshape(self.rows, b)
-        self.busy_s += time.perf_counter() - t0
+        # the with-block tags the dispatch IN the leg thread — the pool
+        # worker never inherits the submitter's ledger context (GL116's
+        # lexical-tagging contract anchors here, not in _device_leg)
+        with devledger.workload(self.workload):
+            if self.backend == "pallas" and b % (groups * 128) == 0:
+                # block-diagonal fast path: host stages segment-stacked
+                # rows (free — same bytes) and the MXU runs with a full M
+                # dimension (~152 vs ~123 GB/s, see ops/rs_tpu.py header)
+                stacked = np.ascontiguousarray(
+                    self._tpu.stack_segments(shards)
+                )
+                x = jax.device_put(stacked.reshape(-1))
+                out = self._tpu.apply_matrix_device_flat(
+                    self._a_blk,
+                    x,
+                    k=groups * k,
+                    m=groups * self.rows,
+                    tile=self._tpu.BLOCKDIAG_TILE,
+                    interpret=self._interpret,
+                )
+                seg = b // groups
+                parity = self._tpu.unstack_segments(
+                    # graftlint: allow(device-sync): the codec worker's
+                    # own D2H — fetched on the dedicated device leg,
+                    # timed busy_s
+                    np.asarray(out).reshape(groups * self.rows, seg),
+                    self.rows,
+                )
+            else:
+                x = jax.device_put(
+                    np.ascontiguousarray(shards).reshape(-1)
+                )
+                out = self._tpu.apply_matrix_device_flat(
+                    self._a_bm,
+                    x,
+                    k=k,
+                    m=self.rows,
+                    kernel=self.backend,
+                    interpret=self._interpret,
+                )
+                # graftlint: allow(device-sync): codec-leg D2H (see above)
+                parity = np.asarray(out).reshape(self.rows, b)
         return parity
 
     def resolve(self, handle) -> np.ndarray:

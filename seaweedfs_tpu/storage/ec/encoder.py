@@ -122,7 +122,8 @@ def write_ec_files(
     cfg = bulk.DEFAULT
     use_overlap = cfg.overlap if overlap is None else bool(overlap)
     codec = bulk.Codec(
-        rs.RSCodec().matrix[DATA_SHARDS:], backend, threaded=use_overlap
+        rs.RSCodec().matrix[DATA_SHARDS:], backend, threaded=use_overlap,
+        workload="bulk",
     )
     _save_vif_from_superblock(dat_path, base_name)
 
@@ -202,7 +203,7 @@ def rebuild_ec_files(
     stride = _resolve_stride(stride)
     cfg = bulk.DEFAULT
     use_overlap = cfg.overlap if overlap is None else bool(overlap)
-    codec = bulk.Codec(rmat, backend, threaded=use_overlap)
+    codec = bulk.Codec(rmat, backend, threaded=use_overlap, workload="repair")
 
     shard_size = os.path.getsize(base_name + to_ext(present[0]))
     inputs = {i: open(base_name + to_ext(i), "rb") for i in use}
@@ -266,7 +267,8 @@ def verify_ec_files(
     cfg = bulk.DEFAULT
     use_overlap = cfg.overlap if overlap is None else bool(overlap)
     codec = bulk.Codec(
-        rs.RSCodec().matrix[DATA_SHARDS:], backend, threaded=use_overlap
+        rs.RSCodec().matrix[DATA_SHARDS:], backend, threaded=use_overlap,
+        workload="scrub",
     )
     mism = np.zeros(TOTAL_SHARDS - DATA_SHARDS, dtype=np.int64)
     handles = [open(p, "rb") for p in paths]

@@ -12,7 +12,15 @@ reference security.toml scaffold), master.toml, filer.toml.
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:  # stdlib in py3.11+; the py3.10 image ships neither tomllib nor
+    # tomli, and a hard import here kills every `python -m seaweedfs_tpu`
+    # subprocess at startup (the multiprocess e2e's "spin-up timeout" was
+    # really this crash) — gate it and only fail when a .toml actually
+    # needs parsing
+    import tomllib
+except ModuleNotFoundError:  # pragma: no cover - py3.10 environments
+    tomllib = None
 
 SEARCH_DIRS = (".", os.path.expanduser("~/.seaweedfs"), "/etc/seaweedfs")
 
@@ -31,6 +39,11 @@ def load_config(name: str, dirs=SEARCH_DIRS) -> dict:
     path = find_config(name, dirs)
     if path is None:
         return {}
+    if tomllib is None:
+        raise RuntimeError(
+            f"cannot parse {path}: this Python has no TOML parser "
+            f"(tomllib needs py3.11+) — remove the file or upgrade"
+        )
     with open(path, "rb") as f:
         return tomllib.load(f)
 

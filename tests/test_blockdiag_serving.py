@@ -10,6 +10,7 @@ vs host reconstruct) through the real volume server.  The real-TPU
 numbers come from bench.py's serving sweep layout/overlap matrix.
 """
 import asyncio
+import os
 import random
 import threading
 import time
@@ -206,6 +207,11 @@ class TestDevicePipeline:
         t2.join()
         assert len(started) == 2
 
+    @pytest.mark.skipif(
+        (os.cpu_count() or 1) < 2,
+        reason="overlap gauge needs two sections genuinely concurrent — "
+        "a 1-core box timeslices them and busy/wall can round below 1",
+    )
     def test_two_slots_overlap_and_gauge(self):
         pipe = rs_resident.DevicePipeline(slots=2)
         started, release = [], threading.Event()

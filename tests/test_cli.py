@@ -37,6 +37,7 @@ def test_argparse_surfaces():
 
 
 def test_config_discovery(tmp_path):
+    pytest.importorskip("tomllib")  # py3.11+ stdlib; config gates without it
     sec = tmp_path / "security.toml"
     sec.write_text('[jwt.signing]\nkey = "abc123"\nexpires_after_seconds = 9\n')
     assert config_util.find_config("security", dirs=(str(tmp_path),)) == str(sec)
@@ -51,7 +52,7 @@ def test_config_discovery(tmp_path):
 
 
 def test_scaffold_templates_parse(capsys):
-    import tomllib
+    tomllib = pytest.importorskip("tomllib")
 
     from seaweedfs_tpu.command import scaffold
 

@@ -95,6 +95,7 @@ def test_every_rule_fires_on_its_corpus_fixture(corpus_findings):
         ("GL113", "case_unused_waiver"),
         ("GL114", "case_unbounded_rpc"),
         ("GL115", "case_unsharded_device_put"),
+        ("GL116", "case_untagged_dispatch"),
     ],
 )
 def test_rule_fires_in_the_named_case_file(
@@ -127,6 +128,7 @@ def test_seeded_counts_are_exact(corpus_findings):
         "GL113": 1,  # the stale waiver
         "GL114": 3,  # bare unary, unbounded stream, closure-built call
         "GL115": 3,  # bare put, imported-name put, loop-staged put
+        "GL116": 3,  # bare dispatch, bare bulk leg, untagged closure
     }, by_rule
 
 

@@ -40,7 +40,9 @@ async def spawn(*argv):
     )
 
 
-async def wait_http(url, timeout=30.0):
+async def wait_http(url, timeout=90.0):
+    # generous default: a 1-core CI box imports jax serially in each
+    # subprocess and can take >30s to bind the first port
     deadline = asyncio.get_event_loop().time() + timeout
     async with aiohttp.ClientSession() as s:
         while asyncio.get_event_loop().time() < deadline:

@@ -183,6 +183,16 @@ UNSHARDED_DEVICE_PUT = register(
     "sharded residency relies on",
     "arr = jax.device_put(padded)  # no sharding/device",
 )
+UNTAGGED_DEVICE_DISPATCH = register(
+    "GL116",
+    "untagged-device-dispatch",
+    "a device dispatch primitive (_dispatch_call, "
+    "apply_matrix_device_flat, _scrub_call*, _scrub_all_call) invoked "
+    "outside a devledger workload/device tagging context — its busy "
+    "time lands in the `untagged` ledger class and the per-workload "
+    "attribution the contention timeline depends on silently leaks",
+    "arr = _dispatch_call(...)  # no devledger.workload/device",
+)
 
 
 def rule_table_markdown() -> str:

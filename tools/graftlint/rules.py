@@ -20,6 +20,7 @@ from .model import (
     STAGE_REGISTRY,
     UNBOUNDED_RPC,
     UNSHARDED_DEVICE_PUT,
+    UNTAGGED_DEVICE_DISPATCH,
     Finding,
 )
 
@@ -590,6 +591,121 @@ def check_unsharded_device_put(
             "pass a NamedSharding (lane-sharded residency), the owning "
             "device, or waive a deliberate default-device staging with "
             "a reason",
+        )
+
+
+# ----------------------------------------- GL116 untagged-device-dispatch
+
+# modules where every accelerator dispatch must carry a devledger
+# workload class: the resident serving kernels (ops), the serving
+# plane, the ingest plane, the repair plane, and the bulk codec.  A
+# bare dispatch here bills its busy time to the `untagged` escape-hatch
+# class — the per-workload attribution invariant ("ledger sums
+# reconcile against the pipeline/codec wall clocks, per CLASS") holds
+# only when every primitive call is tagged at the call site.
+DISPATCH_SCOPE_PARTS = (
+    "seaweedfs_tpu/ops/",
+    "seaweedfs_tpu/serving/",
+    "seaweedfs_tpu/ingest/",
+    "seaweedfs_tpu/repair/",
+    "seaweedfs_tpu/storage/ec/",
+    "lint_corpus",
+)
+
+# the device dispatch primitives (by final dotted name): the jitted
+# entry points every accelerator call in the EC stack funnels through
+_DISPATCH_PRIMITIVES = {
+    "_dispatch_call",        # rs_resident serving reconstruct
+    "apply_matrix_device_flat",  # rs_tpu bulk matrix leg
+    "_scrub_call",           # per-volume parity scrub
+    "_scrub_call_blockdiag",
+    "_scrub_all_call",       # multi-volume scrub megakernel
+}
+
+# context-manager attrs that establish a ledger class lexically:
+# devledger.workload("scrub") / devledger.device(label)
+_TAGGING_CTX_ATTRS = {"workload", "device"}
+
+
+def in_dispatch_scope(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return any(part in p for part in DISPATCH_SCOPE_PARTS)
+
+
+def _with_items_tag(node: ast.With) -> bool:
+    for item in node.items:
+        ctx = item.context_expr
+        if isinstance(ctx, ast.Call):
+            name = dotted(ctx.func) or ""
+            if name.rsplit(".", 1)[-1] in _TAGGING_CTX_ATTRS:
+                return True
+    return False
+
+
+def _function_tags(fn: ast.AST) -> bool:
+    """A function that takes the class as a parameter or consults
+    devledger.current_workload() is attribution-aware by design."""
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    args = fn.args
+    for a in (
+        args.posonlyargs + args.args + args.kwonlyargs
+        + ([args.vararg] if args.vararg else [])
+        + ([args.kwarg] if args.kwarg else [])
+    ):
+        if a.arg == "workload":
+            return True
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and node.attr == "current_workload":
+            return True
+        if isinstance(node, ast.Name) and node.id == "current_workload":
+            return True
+    return False
+
+
+def check_untagged_device_dispatch(
+    tree: ast.Module, path: str
+) -> Iterator[Finding]:
+    """Every dispatch-primitive call must be tagged: lexically inside a
+    `with devledger.workload(...)/.device(...)` block (the walk stops at
+    the enclosing function — a closure dispatched later is not tagged by
+    where it was built), carry a `workload=` keyword itself, or sit in a
+    function that is attribution-aware (a `workload` parameter or a
+    `current_workload` consult)."""
+    if not in_dispatch_scope(path):
+        return
+    parents: dict[ast.AST, ast.AST] = {}
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            parents[child] = parent
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func) or ""
+        if name.rsplit(".", 1)[-1] not in _DISPATCH_PRIMITIVES:
+            continue
+        if any(kw.arg == "workload" for kw in node.keywords):
+            continue
+        cur = parents.get(node)
+        tagged = False
+        while cur is not None:
+            if isinstance(cur, ast.With) and _with_items_tag(cur):
+                tagged = True
+                break
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                tagged = _function_tags(cur)
+                break
+            cur = parents.get(cur)
+        if tagged:
+            continue
+        yield Finding(
+            UNTAGGED_DEVICE_DISPATCH.rule_id, path, node.lineno,
+            f"device dispatch {name.rsplit('.', 1)[-1]} carries no "
+            "workload class — its busy time lands in the `untagged` "
+            "ledger bucket and per-workload attribution leaks; wrap it "
+            "in devledger.workload(...)/device(...), pass workload=, "
+            "or waive a deliberately unattributed dispatch with a "
+            "reason",
         )
 
 
