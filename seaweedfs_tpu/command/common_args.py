@@ -85,6 +85,30 @@ def add_obs_args(p) -> None:
         help="flight-timeline samples kept per node (the ring bound; "
         "default 120 ≈ two minutes at the 1s interval)",
     )
+    p.add_argument(
+        "-obs.tail.disable", dest="obs_tail_disable", action="store_true",
+        help="disable tail-based trace retention (/debug/tail stays "
+        "empty; slow traces churn out of the ring like fast ones and "
+        "SeaweedFS_critpath_seconds stops accumulating)",
+    )
+    p.add_argument(
+        "-obs.tail.ring", dest="obs_tail_ring", type=int,
+        default=d.tail_ring,
+        help="pinned slow/incident span trees kept per process "
+        "(newest pins win; fast requests never evict a pin)",
+    )
+    p.add_argument(
+        "-obs.tail.alpha", dest="obs_tail_alpha", type=float,
+        default=d.tail_alpha,
+        help="EWMA smoothing factor for the per-route p99 estimate "
+        "that gates tail pinning (0 < alpha <= 1)",
+    )
+    p.add_argument(
+        "-obs.tail.floorMs", dest="obs_tail_floor_ms", type=float,
+        default=d.tail_floor_ms,
+        help="also pin any request at least this slow, regardless of "
+        "its route's p99 estimate (0 = off)",
+    )
 
 
 def apply_obs_args(args) -> None:
@@ -100,6 +124,10 @@ def apply_obs_args(args) -> None:
             timeline_enabled=not args.obs_timeline_disable,
             timeline_interval_seconds=args.obs_timeline_interval_seconds,
             timeline_window=args.obs_timeline_window,
+            tail_enabled=not args.obs_tail_disable,
+            tail_ring=args.obs_tail_ring,
+            tail_alpha=args.obs_tail_alpha,
+            tail_floor_ms=args.obs_tail_floor_ms,
         )
     )
     devledger.configure(enabled=not args.obs_ledger_disable)

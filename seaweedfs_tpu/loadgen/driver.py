@@ -16,6 +16,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..obs.trace import TRACE_HEADER
 from .workload import LoadScenario, ZipfPicker, percentile_ms, plan_keys
 
 
@@ -37,6 +38,28 @@ class LoadResult:
     write_errors: int = 0
     bytes_written: int = 0
     write_latencies_s: list = field(default_factory=list)
+    # forensics hooks: per-worker slowest op's server-assigned trace id
+    # (wid -> (latency_s, trace_id)) — each id resolves via
+    # /debug/critpath or `volume.trace.why -id` while the tail ring
+    # still pins it, so a bad level in a sweep names its own culprits
+    slow_read_trace: dict = field(default_factory=dict)
+    slow_write_trace: dict = field(default_factory=dict)
+
+    def note_trace(self, table: dict, wid: int, lat_s: float, header: str):
+        """Keep the slowest op's trace id per worker.  `header` is the
+        raw X-Seaweed-Trace-Id response value ('<trace_id>-<span_id>')."""
+        tid = header.partition("-")[0]
+        if tid and (wid not in table or lat_s > table[wid][0]):
+            table[wid] = (lat_s, tid)
+
+    @staticmethod
+    def _trace_exemplars(table: dict) -> list:
+        return [
+            {"worker": w, "ms": round(lat * 1e3, 3), "trace_id": tid}
+            for w, (lat, tid) in sorted(
+                table.items(), key=lambda kv: -kv[1][0]
+            )
+        ]
 
     @property
     def reads_per_s(self) -> float:
@@ -66,6 +89,10 @@ class LoadResult:
             "p50_ms": percentile_ms(self.latencies_s, 50),
             "p99_ms": percentile_ms(self.latencies_s, 99),
         }
+        if self.slow_read_trace:
+            d["slowest_read_traces"] = self._trace_exemplars(
+                self.slow_read_trace
+            )
         if self.writes_ok or self.write_errors:
             d.update({
                 "writes_ok": self.writes_ok,
@@ -76,6 +103,10 @@ class LoadResult:
                 "write_p50_ms": percentile_ms(self.write_latencies_s, 50),
                 "write_p99_ms": percentile_ms(self.write_latencies_s, 99),
             })
+            if self.slow_write_trace:
+                d["slowest_write_traces"] = self._trace_exemplars(
+                    self.slow_write_trace
+                )
         return d
 
 
@@ -120,6 +151,7 @@ async def _run_load(
                 t0 = time.perf_counter()
                 try:
                     async with session.get(url_of(key), headers=headers) as r:
+                        trace_hdr = r.headers.get(TRACE_HEADER, "")
                         if slow:
                             parts = []
                             while True:
@@ -147,7 +179,9 @@ async def _run_load(
                     # datum (sheds, stall disconnects, churn races)
                     result.errors += 1
                     continue
-                result.latencies_s.append(time.perf_counter() - t0)
+                lat = time.perf_counter() - t0
+                result.latencies_s.append(lat)
+                result.note_trace(result.slow_read_trace, wid, lat, trace_hdr)
                 result.bytes_read += len(body)
                 if scenario.verify:
                     want = expected(key)
@@ -253,7 +287,7 @@ async def run_mixed_http_load(
         t0 = time.perf_counter()
         try:
             a = await assign(master, collection=collection)
-            await upload_data(
+            up = await upload_data(
                 f"http://{a.url}/{a.fid}", data, f"mix{wid}_{seq}",
                 compress=False, jwt=a.auth, session=session,
                 headers=headers,
@@ -262,7 +296,11 @@ async def run_mixed_http_load(
             # ingest shed, dead server) is the datum
             result.write_errors += 1
             return
-        result.write_latencies_s.append(time.perf_counter() - t0)
+        lat = time.perf_counter() - t0
+        result.write_latencies_s.append(lat)
+        result.note_trace(
+            result.slow_write_trace, wid, lat, up.get("traceId", "")
+        )
         result.bytes_written += len(data)
         result.writes_ok += 1
         store[a.fid] = data
@@ -271,13 +309,14 @@ async def run_mixed_http_load(
         if written is not None:
             written[a.fid] = (a.url, data)
 
-    async def do_read(key: str, rng, session) -> None:
+    async def do_read(wid: int, key: str, rng, session) -> None:
         url = holder.get(key, volume_url)
         t0 = time.perf_counter()
         try:
             async with session.get(
                 f"http://{url}/{key}", headers=headers
             ) as r:
+                trace_hdr = r.headers.get(TRACE_HEADER, "")
                 body = await r.read()
                 if r.status != 200:
                     result.errors += 1
@@ -289,7 +328,9 @@ async def run_mixed_http_load(
         except Exception:  # noqa: BLE001
             result.errors += 1
             return
-        result.latencies_s.append(time.perf_counter() - t0)
+        lat = time.perf_counter() - t0
+        result.latencies_s.append(lat)
+        result.note_trace(result.slow_read_trace, wid, lat, trace_hdr)
         result.bytes_read += len(body)
         if scenario.verify and body != store[key]:
             result.verify_failures += 1
@@ -308,7 +349,7 @@ async def run_mixed_http_load(
                     result.churns += 1
                 if keys and rng.random() >= scenario.write_frac:
                     await do_read(
-                        keys[picker.pick(len(keys), rng)], rng, session
+                        wid, keys[picker.pick(len(keys), rng)], rng, session
                     )
                 else:
                     await do_write(wid, seq, rng, session)

@@ -2,11 +2,16 @@
 /debug/traces), the incident plane's flight recorder + bundler
 (incident.py), the master-side SLO burn-rate engine (slo.py),
 on-demand device profiling (profile.py), the per-workload device-time
-ledger (devledger.py), and the flight timeline (timeline.py)."""
-from . import devledger, incident, profile, slo, timeline
+ledger (devledger.py), the flight timeline (timeline.py), and the
+tail-latency forensics plane — cross-node trace assembly + critical-path
+attribution (critpath.py) over tail-pinned full span trees
+(tailstore.py)."""
+from . import critpath, devledger, incident, profile, slo, tailstore, timeline
 from .config import ObsConfig
+from .critpath import critpath_handler
 from .devledger import DeviceLedger, LEDGER
 from .incident import IncidentBundler, IncidentConfig
+from .tailstore import TailStore, tail_handler
 from .timeline import TimelineSampler
 from .profile import device_hot_handler, profile_handler
 from .slo import SloConfig, SloEngine
@@ -42,13 +47,18 @@ __all__ = [
     "RING",
     "SloConfig",
     "SloEngine",
+    "TailStore",
     "TimelineSampler",
+    "critpath",
+    "critpath_handler",
     "device_hot_handler",
     "devledger",
     "incident",
     "profile",
     "profile_handler",
     "slo",
+    "tail_handler",
+    "tailstore",
     "timeline",
     "TRACE_HEADER",
     "Trace",

@@ -38,6 +38,21 @@ class ObsConfig:
     # samples kept in the per-node ring — window = interval * this
     # (-obs.timeline.window)
     timeline_window: int = 120
+    # pin the FULL span tree of tail requests (slower than the live
+    # per-route p99 EWMA, or flagged by a QoS shed/breaker/stall
+    # incident) into a second retention ring the fast-path churn can
+    # never evict (-obs.tail.disable)
+    tail_enabled: bool = True
+    # pinned tail traces kept per process, newest win (-obs.tail.ring)
+    tail_ring: int = 64
+    # EWMA smoothing applied to the per-route windowed p99 estimate;
+    # higher chases spikes faster, lower rides through them
+    # (-obs.tail.alpha)
+    tail_alpha: float = 0.2
+    # absolute pin floor in milliseconds: any request at least this slow
+    # is pinned even while the route's p99 estimate is still warming up;
+    # 0 keeps the pin purely quantile-driven (-obs.tail.floorMs)
+    tail_floor_ms: float = 0.0
 
     def validated(self) -> "ObsConfig":
         if self.slow_ms < 0:
@@ -50,4 +65,10 @@ class ObsConfig:
             # a single-sample ring can never show a ramp — the
             # timeline's whole job — so reject it at flag-parse time
             raise ValueError("timeline_window must be >= 2")
+        if self.tail_ring < 1:
+            raise ValueError("tail_ring must be >= 1")
+        if not 0.0 < self.tail_alpha <= 1.0:
+            raise ValueError("tail_alpha must be in (0, 1]")
+        if self.tail_floor_ms < 0:
+            raise ValueError("tail_floor_ms must be >= 0")
         return self

@@ -154,14 +154,22 @@ def record(kind: str, **details: Any) -> None:
     if not CONFIG.enabled:
         return
     cur = obs_trace.current()
+    trace_id = cur[0].trace_id if cur is not None else ""
     EVENTS.add(
         {
             "unix_ms": int(time.time() * 1e3),
             "kind": kind,
-            "trace_id": cur[0].trace_id if cur is not None else "",
+            "trace_id": trace_id,
             "details": details,
         }
     )
+    if trace_id:
+        # a shed/breaker/hedge/stall decision marks the ambient trace
+        # for tail-ring pinning at finish (obs/tailstore.py filters to
+        # its trigger kinds; no installed store = no-op)
+        from . import tailstore
+
+        tailstore.flag_ambient(kind, trace_id)
 
 
 # ------------------------------------------------------------------ HTTP
@@ -199,22 +207,28 @@ class IncidentBundler:
     The bundle joins what every plane saw over the burn window: the SLO
     verdict that tripped, the full /cluster/health.json document (slo +
     repair blocks included), every fresh node's flight-recorder events
-    and trace-ring entries for the window, this process's own ring (the
-    master records repair/SLO events), the cross-node trace-id
-    correlation, and — for latency SLOs with profiling enabled — a
-    device-profile capture from the busiest node."""
+    for the window plus this process's own ring (the master records
+    repair/SLO events), the cross-node trace-id correlation, the
+    ASSEMBLED critical paths of the window's worst offenders (raw
+    per-node trace rings collapse to counts — obs/critpath.py turns
+    them into the structured "why" before the write), and — for latency
+    SLOs with profiling enabled — a device-profile capture from the
+    busiest node."""
 
     def __init__(
         self, node_urls_fn, health_fn, clock=time.monotonic,
-        timeline_fn=None,
+        timeline_fn=None, skew_ms_fn=None,
     ):
         # node_urls_fn() -> fresh volume-server HTTP urls;
         # health_fn() -> the /cluster/health.json dict (slo block incl.);
         # timeline_fn(window_s) -> the assembled cluster flight timeline
-        # (stats/cluster.py) — the "what happened BEFORE the burn" view
+        # (stats/cluster.py) — the "what happened BEFORE the burn" view;
+        # skew_ms_fn(server) -> heartbeat clock-skew estimate in ms, fed
+        # to the critical-path assembly of the worst offenders
         self._node_urls = node_urls_fn
         self._health = health_fn
         self._timeline = timeline_fn
+        self._skew_ms = skew_ms_fn
         self._clock = clock
         self._last_bundle_at: float | None = None
         self._lock = asyncio.Lock()  # one capture at a time
@@ -315,6 +329,17 @@ class IncidentBundler:
                 except Exception:  # noqa: BLE001 — a timeline failure
                     # must not lose the bundle
                     log.exception("incident timeline assembly failed")
+            # correlation reads the raw per-node trace payloads; the
+            # bundle itself then carries the ASSEMBLED critical paths of
+            # the worst offenders instead of every node's raw ring — the
+            # structured "why" an operator opens the bundle for, at a
+            # fraction of the bytes
+            correlation = self._correlate(nodes)
+            critpaths = self._worst_critpaths(nodes)
+            for doc in nodes.values():
+                traces = doc.pop("traces", None)
+                if traces is not None:
+                    doc["trace_count"] = len(traces)
             bundle = {
                 "written_unix_ms": now_ms,
                 "trigger": trigger,
@@ -323,7 +348,8 @@ class IncidentBundler:
                 "health": self._health(),
                 "timeline": timeline,
                 "nodes": nodes,
-                "correlation": self._correlate(nodes),
+                "correlation": correlation,
+                "critpaths": critpaths,
                 "profile": profile,
             }
             path = os.path.join(
@@ -391,6 +417,57 @@ class IncidentBundler:
                     "error": str(e) or type(e).__name__,
                 }
         return last
+
+    def _worst_critpaths(self, nodes: dict[str, dict], top: int = 5) -> list:
+        """Assembled critical paths of the window's worst offenders:
+        pool every node's fetched trace entries by id, rank the root
+        entries by client-visible duration, and assemble the top few
+        cross-node (obs/critpath.py, heartbeat skew applied).  Pinned
+        tail trees in this process's stores are pooled too — a straggler
+        that aged out of every live ring is exactly the one the bundle
+        is for.  Best-effort: an assembly failure drops that entry, not
+        the bundle."""
+        from . import critpath, tailstore
+
+        by_id: dict[str, list[dict]] = {}
+        for doc in nodes.values():
+            for t in doc.get("traces", ()):
+                tid = t.get("trace_id", "")
+                if tid:
+                    by_id.setdefault(tid, []).append(t)
+        with tailstore._INSTALLED_LOCK:
+            stores = list(tailstore.INSTALLED)
+        for s in stores:
+            for pin in s.snapshot():
+                for t in pin.get("entries", ()):
+                    tid = t.get("trace_id", "")
+                    if tid:
+                        by_id.setdefault(tid, []).append(t)
+
+        def root_duration(entries: list[dict]) -> float:
+            return max(
+                (
+                    float(t.get("duration_us", 0))
+                    for t in entries if not t.get("parent_span_id")
+                ),
+                default=0.0,
+            )
+
+        worst = sorted(
+            by_id.items(), key=lambda kv: root_duration(kv[1]), reverse=True
+        )[: max(0, top)]
+        out = []
+        for tid, entries in worst:
+            if root_duration(entries) <= 0:
+                continue
+            try:
+                doc = critpath.assemble(entries, self._skew_ms)
+            except Exception:  # noqa: BLE001 — best-effort embedding
+                log.exception("critpath assembly failed for %s", tid)
+                continue
+            if doc is not None:
+                out.append(doc)
+        return out
 
     @staticmethod
     def _correlate(nodes: dict[str, dict]) -> dict:

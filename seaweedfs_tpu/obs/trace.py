@@ -471,9 +471,29 @@ async def traces_handler(request):
 
     limit, since_unix = parse_limit_since(request)
     trace_id = request.query.get("id") or None
-    return web.json_response(
-        {"traces": RING.snapshot(limit, trace_id, since_unix)}
-    )
+    traces = RING.snapshot(limit, trace_id, since_unix)
+    if trace_id is not None and not traces:
+        # a pinned tail tree outlives the main ring's churn — serve it
+        # through the same lane so one fetch path covers both rings
+        from . import tailstore
+
+        for pin in tailstore.pinned(trace_id):
+            traces.extend(pin.get("entries", ()))
+        if limit is not None:
+            traces = traces[:limit]
+    if trace_id is not None and not traces:
+        # an id miss is a MISS, not an empty success: the cross-node
+        # assembler (obs/critpath.py) and `volume.trace -id` both key
+        # off the status instead of special-casing an empty 200
+        return web.json_response(
+            {
+                "error": f"trace {trace_id!r} not found (evicted or "
+                "never traced)",
+                "trace_id": trace_id,
+            },
+            status=404,
+        )
+    return web.json_response({"traces": traces})
 
 
 # paths whose traffic is telemetry, not service: tracing them would wash

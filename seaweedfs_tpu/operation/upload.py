@@ -64,7 +64,14 @@ async def upload_data(
                             raise RuntimeError(
                                 f"upload {url}: HTTP {r.status} {await r.text()}"
                             )
-                        return await r.json()
+                        doc = await r.json()
+                        # surface the server-assigned trace id so load
+                        # drivers can name their slowest write to the
+                        # forensics plane (volume.trace.why)
+                        tid = r.headers.get("X-Seaweed-Trace-Id", "")
+                        if tid and "traceId" not in doc:
+                            doc["traceId"] = tid
+                        return doc
                 finally:
                     if session is None:
                         await s.close()

@@ -140,7 +140,11 @@ class MasterServer:
         self.incident = obs.IncidentBundler(
             self.telemetry.fresh_node_urls, self._health_doc,
             timeline_fn=self.telemetry.timeline,
+            skew_ms_fn=self.telemetry.clock_skew_ms,
         )
+        # tail-forensics retention for the master's own requests
+        # (assign/lookup paths have tails too), built in start()
+        self.tailstore = None
         self.slo.on_violation.append(self._on_slo_violation)
         self._incident_captures: set = set()
         self._subscribers: dict[object, asyncio.Queue] = {}
@@ -215,6 +219,18 @@ class MasterServer:
         # through the same hook)
         app[stats.metrics.metrics_collect_key()] = self.telemetry.refresh_gauges
         app.router.add_get("/debug/traces", obs.traces_handler)
+        # tail-forensics plane: cross-node critical-path assembly (fans
+        # out to every fresh node's /debug/traces, reconciles clocks
+        # against the heartbeat skew estimates) + this master's own
+        # tail ring (volume.trace.why / cluster.tail read these)
+        app.router.add_get(
+            "/debug/critpath",
+            obs.critpath_handler(
+                node_urls_fn=self.telemetry.fresh_node_urls,
+                skew_ms_fn=self.telemetry.clock_skew_ms,
+            ),
+        )
+        app.router.add_get("/debug/tail", self.h_debug_tail)
         # the assembled cluster flight timeline (heartbeat-shipped node
         # samples, clock-aligned) — ?window=<seconds> trims the tail
         app.router.add_get("/debug/timeline", self.h_debug_timeline)
@@ -234,6 +250,12 @@ class MasterServer:
         await site.start()
         port = site._server.sockets[0].getsockname()[1]
         self.port = port
+
+        from ..obs import tailstore as tailstore_mod
+        from ..obs import trace as obs_trace_mod
+
+        if obs_trace_mod.CONFIG.tail_enabled:
+            self.tailstore = tailstore_mod.TailStore(node=self.url).install()
 
         from ..raft import RaftNode
 
@@ -291,6 +313,10 @@ class MasterServer:
             await self._grpc_server.stop(0.1)
         if self._http_runner:
             await self._http_runner.cleanup()
+        if self.tailstore is not None:
+            # unhook the finished-trace tap: the process-global observer
+            # list outlives this server (co-hosted roles, test restarts)
+            self.tailstore.uninstall()
 
     # ------------------------------------------------------------------ gRPC
 
@@ -1232,6 +1258,20 @@ class MasterServer:
                 {"error": f"bad window: {window!r}"}, status=400
             )
         return web.json_response(self.telemetry.timeline(window_s=window_s))
+
+    async def h_debug_tail(self, request: web.Request) -> web.Response:
+        """GET /debug/tail: the master's own tail ring (route stats +
+        pinned slow/incident span trees; ?id= resolves one full tree).
+        Per-node by design — cluster.tail fans out over every node's
+        endpoint, like the incident bundler does for /debug/traces."""
+        from .. import obs
+
+        if self.tailstore is None:
+            return web.json_response(
+                {"error": "tail retention disabled (-obs.tail.disable)"},
+                status=404,
+            )
+        return await obs.tail_handler(self.tailstore)(request)
 
     async def h_grow(self, request: web.Request) -> web.Response:
         self._redirect_if_follower(request)

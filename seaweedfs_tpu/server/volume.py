@@ -285,6 +285,10 @@ class VolumeServer:
         # after a stream break are safe because the master dedupes
         # samples by (node, t)
         self.timeline = None  # TimelineSampler, built in start()
+        # tail-forensics retention (obs/tailstore.py), built in start():
+        # pins the full span tree of p99-exceeding / incident-flagged
+        # requests and feeds SeaweedFS_critpath_seconds per route
+        self.tailstore = None
         self._timeline_backlog: list[dict] = []
         self._timeline_shipped = 0  # leading backlog entries in flight
         self._timeline_inflight_at: int | None = None
@@ -339,6 +343,11 @@ class VolumeServer:
         app.router.add_get("/status", self.h_status)
         app.router.add_get("/metrics", stats.metrics_handler)
         app.router.add_get("/debug/traces", obs.traces_handler)
+        # tail-forensics plane: this node's own critical-path view
+        # (local ring + tail pins only — cross-node assembly lives on
+        # the master) and the tail ring's route stats / pinned trees
+        app.router.add_get("/debug/critpath", self.h_debug_critpath)
+        app.router.add_get("/debug/tail", self.h_debug_tail)
         # incident plane: this node's flight-recorder ring + trace
         # window (the master's bundle fan-out target) and the live
         # per-shape device dispatch view (volume.device.status -hot)
@@ -407,6 +416,10 @@ class VolumeServer:
                     self._timeline_forever(), log, "timeline sampler loop"
                 )
             )
+        if obs_trace_mod.CONFIG.tail_enabled:
+            from ..obs import tailstore as tailstore_mod
+
+            self.tailstore = tailstore_mod.TailStore(node=self.url).install()
         push = stats.start_push_loop(
             "volumeServer", self.url, self.metrics_address,
             self.metrics_interval_seconds, collect=self._collect_metrics,
@@ -438,6 +451,23 @@ class VolumeServer:
             else []
         )
         return web.json_response({"node": self.url, "samples": samples})
+
+    async def h_debug_critpath(self, request: web.Request) -> web.Response:
+        """GET /debug/critpath?id=: critical-path attribution from THIS
+        node's local view (ring + tail pins).  No cluster fan-out here —
+        a volume server only ever holds its own hops; the master's
+        endpoint stitches the cross-node DAG."""
+        return await obs.critpath_handler()(request)
+
+    async def h_debug_tail(self, request: web.Request) -> web.Response:
+        """GET /debug/tail: the tail ring's per-route stats + pinned
+        slow/incident span trees (?id= resolves one full tree)."""
+        if self.tailstore is None:
+            return web.json_response(
+                {"error": "tail retention disabled (-obs.tail.disable)"},
+                status=404,
+            )
+        return await obs.tail_handler(self.tailstore)(request)
 
     async def h_debug_device_attribution(
         self, request: web.Request
@@ -642,6 +672,8 @@ class VolumeServer:
             # unhook the finished-trace tap: the process-global observer
             # list outlives this server (co-hosted roles, test restarts)
             self.timeline.uninstall()
+        if self.tailstore is not None:
+            self.tailstore.uninstall()
         if self.ingest is not None:
             # joins encode workers + the group-commit flusher
             await asyncio.to_thread(self.ingest.close)
@@ -676,6 +708,10 @@ class VolumeServer:
         follower's hint response during leader churn can false-ack one
         shipment) — a bounded skew, versus guaranteed loss."""
         tel = master_pb2.VolumeServerTelemetry()
+        # wall clock at build time: the master differences it against
+        # its own receive time for the per-node skew estimate the
+        # tail-forensics assembler reconciles span timestamps with
+        tel.wall_clock_unix_ms = int(time.time() * 1e3)
         cache = self.store.ec_device_cache
         if cache is not None:
             n_resident, n_bytes = cache.stats()

@@ -199,7 +199,23 @@ class EcReadDispatcher:
         self._route("batched", origin)
         stats.VOLUME_SERVER_EC_QUEUE_DEPTH.set(len(self.coalescer))
         self._maybe_spawn()
-        return await req.future
+        t_resident = time.perf_counter()
+        try:
+            return await req.future
+        finally:
+            # the request's WHOLE dispatcher residency, enqueue ->
+            # waiter resume, as a low-priority queue_wait span
+            # (observe=False: the admission-window histogram sample is
+            # the drain loop's).  The batch stage spans outrank it in
+            # critical-path attribution, so all it claims is the slice
+            # nothing else covers — chiefly the future-resume gap where
+            # the batch is done but the event loop hasn't scheduled
+            # this coroutine yet, which under load is milliseconds a
+            # tail forensics answer must not call untraced.
+            obs.record_span(
+                req.obs_ctx, "queue_wait", t_resident,
+                time.perf_counter() - t_resident, observe=False,
+            )
 
     async def _read_native(
         self, vid: int, nid: int, cookie: int | None, use_device: bool = False

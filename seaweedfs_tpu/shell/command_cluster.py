@@ -274,6 +274,94 @@ async def cmd_cluster_timeline(env, args):
             env.write(line)
 
 
+@command("cluster.tail")
+async def cmd_cluster_tail(env, args):
+    """[-limit N] [-json] : the cluster tail-forensics view — every
+    node's /debug/tail (per-route latency stats + critical-path
+    composition + pinned slow/incident traces) merged into one route
+    table and a worst-offenders list; feed a pin's trace id to
+    volume.trace.why for the assembled critical path"""
+    import aiohttp
+
+    flags = parse_flags(args)
+    limit = int(flags.get("limit", 10))
+    master = server_address.http_address(env.masters[0])
+    async with aiohttp.ClientSession() as sess:
+        async with sess.get(
+            f"http://{master}/cluster/health.json", allow_redirects=True
+        ) as r:
+            if r.status != 200:
+                raise ValueError(
+                    f"{master}/cluster/health.json returned HTTP {r.status}"
+                )
+            health = await r.json()
+        targets = [master] + sorted(health.get("nodes", {}))
+
+        async def one(u):
+            try:
+                async with sess.get(
+                    f"http://{u}/debug/tail",
+                    timeout=aiohttp.ClientTimeout(total=2.5),
+                ) as rr:
+                    if rr.status != 200:
+                        return u, None
+                    return u, await rr.json()
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                return u, None
+
+        docs = dict(await asyncio.gather(*(one(u) for u in targets)))
+    if "json" in flags:
+        env.write(json.dumps(docs, indent=2, sort_keys=True))
+        return
+    routes: dict = {}
+    pins = []
+    reached = 0
+    for u, doc in sorted(docs.items()):
+        if doc is None:
+            continue
+        reached += 1
+        for route, st in doc.get("routes", {}).items():
+            agg = routes.setdefault(
+                route,
+                {"count": 0, "total_s": 0.0, "pinned": 0, "seg_s": {}},
+            )
+            agg["count"] += st.get("count", 0)
+            agg["total_s"] += st.get("total_s", 0.0)
+            agg["pinned"] += st.get("pinned", 0)
+            for seg, s in st.get("segments_s", {}).items():
+                agg["seg_s"][seg] = agg["seg_s"].get(seg, 0.0) + s
+        for p in doc.get("pinned", []):
+            pins.append({**p, "node": u})
+    env.write(f"tail view from {reached}/{len(targets)} nodes")
+    for route, agg in sorted(
+        routes.items(), key=lambda kv: -kv[1]["total_s"]
+    ):
+        total = agg["total_s"]
+        comp = " ".join(
+            f"{seg}={s * 100.0 / total:.0f}%"
+            for seg, s in sorted(
+                agg["seg_s"].items(), key=lambda kv: -kv[1]
+            )
+            if total > 0 and s > 0
+        )
+        env.write(
+            f"  {route:<24} n={agg['count']:<6} {total:8.3f}s "
+            f"pinned={agg['pinned']:<4} {comp}"
+        )
+    pins.sort(key=lambda p: -p.get("total_ms", 0.0))
+    for p in pins[:limit]:
+        env.write(
+            f"  pin {p['trace_id']} {p.get('name', '?')} "
+            f"{p.get('total_ms', 0):.1f}ms [{p.get('reason', '?')}] "
+            f"@{p['node']}"
+        )
+    if not pins:
+        env.write(
+            "  no pinned traces yet (nothing beat its route's p99 "
+            "estimate; check -obs.tail.disable / -obs.tail.floorMs)"
+        )
+
+
 @command("cluster.incident.dump")
 async def cmd_cluster_incident_dump(env, args):
     """[-window <seconds>] [-json] : snapshot the cluster's flight

@@ -1118,6 +1118,14 @@ async def cmd_volume_trace(env, args):
         async with sess.get(
             f"http://{node}/debug/traces", params=params
         ) as r:
+            if r.status == 404 and flags.get("id"):
+                # the endpoint's JSON error body carries the contract
+                # wording; keep the shell line identical either way
+                env.write(
+                    f"{node}: trace {flags['id']!r} not found "
+                    "(evicted or never traced)"
+                )
+                return
             if r.status != 200:
                 raise ValueError(
                     f"{node}/debug/traces returned HTTP {r.status}"
@@ -1140,3 +1148,78 @@ async def cmd_volume_trace(env, args):
                 f"  +{sp['offset_us']:>8}us {sp['duration_us']:>8}us "
                 f"{sp['name']}{'  ' + ann if ann else ''}"
             )
+
+
+@command("volume.trace.why")
+async def cmd_volume_trace_why(env, args):
+    """-id <trace_id> [-node <host:port>] [-json] : critical-path
+    attribution for one request — fetch /debug/critpath?id= (from the
+    master by default, which stitches the cross-node DAG from every
+    node's ring + tail pins and reconciles clocks; -node asks one
+    server for its local view instead) and print where the
+    client-visible wall time went: queue_wait / device_execute /
+    host_reconstruct / disk / network_gap / untraced"""
+    import aiohttp
+
+    from ..pb import server_address
+
+    flags = parse_flags(args)
+    trace_id = flags.get("id") or flags.get("")
+    if not trace_id:
+        raise ValueError(
+            "volume.trace.why -id <trace_id> [-node <host:port(http)>] "
+            "[-json]"
+        )
+    node = flags.get("node") or server_address.http_address(env.masters[0])
+    url = f"http://{node}/debug/critpath"
+    async with aiohttp.ClientSession() as sess:
+        async with sess.get(
+            url, params={"id": trace_id}, allow_redirects=True
+        ) as r:
+            if r.status == 404:
+                env.write(
+                    f"{node}: trace {trace_id!r} not found "
+                    "(evicted or never traced)"
+                )
+                return
+            if r.status != 200:
+                raise ValueError(f"{url} returned HTTP {r.status}")
+            doc = await r.json()
+    if "json" in flags:
+        env.write(json.dumps(doc, indent=2, sort_keys=True))
+        return
+    total_us = doc.get("total_us", 0)
+    env.write(
+        f"trace {doc['trace_id']} {doc.get('name', '?')} "
+        f"(route {doc.get('route', '?')}) "
+        f"{total_us / 1000:.2f}ms status={doc.get('status', '')}"
+    )
+    parts = ", ".join(
+        f"{p['server']}[{p['role']}]" for p in doc.get("participants", [])
+    )
+    env.write(
+        f"participants: {parts or '-'}"
+        + (f"  coverage: {doc['coverage_pct']:.1f}%"
+           if doc.get("coverage_pct") is not None else "")
+    )
+    segs = doc.get("segments_us", {})
+    pcts = doc.get("segments_pct", {})
+    for seg, us in segs.items():
+        bar = "#" * int(round((pcts.get(seg, 0.0)) / 5))
+        env.write(
+            f"  {seg:<16} {us:>10}us {pcts.get(seg, 0.0):>6.2f}%  {bar}"
+        )
+    for u, err in sorted(doc.get("fetch_errors", {}).items()):
+        env.write(f"  (fan-out {u}: {err})")
+
+    def _walk(n, depth):
+        env.write(
+            f"  {'  ' * depth}[{n.get('server', '?')}] {n.get('name', '?')} "
+            f"+{n.get('offset_us', 0)}us {n.get('duration_us', 0)}us"
+        )
+        for c in n.get("children", []):
+            _walk(c, depth + 1)
+
+    tree = doc.get("tree")
+    if tree:
+        _walk(tree, 0)

@@ -253,6 +253,10 @@ class NodeTelemetry:
     # keyed by the sample's whole-second `t` — the key IS the dedupe for
     # ACK-protocol reships — trimmed to RETENTION_SECONDS
     timeline: dict[int, dict] = field(default_factory=dict)
+    # node wall clock minus master wall clock (ms) at the last pulse,
+    # from pb wall_clock_unix_ms — the tail-forensics assembler's span
+    # reconciliation input; None until a clock-stamped pulse arrives
+    clock_skew_ms: float | None = None
 
     def to_dict(self, now: float, stale_after: float) -> dict[str, Any]:
         age = now - self.last_seen
@@ -263,6 +267,8 @@ class NodeTelemetry:
             "telemetry": self.has_payload,
         }
         if self.has_payload:
+            if self.clock_skew_ms is not None:
+                d["clock_skew_ms"] = round(self.clock_skew_ms, 3)
             d["device"] = {
                 "budget_bytes": self.device_budget_bytes,
                 "used_bytes": self.device_used_bytes,
@@ -444,6 +450,14 @@ class ClusterTelemetry:
                 getattr(tel, "ingest_streamed_seals", 0)
             )
             nt.resident_by_volume = dict(tel.resident_shards_by_volume)
+            # getattr-guarded: pre-r22 servers ship no clock stamp.
+            # Stored raw (no EWMA): heartbeat transit inflates the
+            # estimate by at most one one-way delay, and the critpath
+            # assembler clamps child spans into the parent's call
+            # window anyway — determinism beats smoothing here
+            wall_ms = int(getattr(tel, "wall_clock_unix_ms", 0))
+            if wall_ms > 0:
+                nt.clock_skew_ms = wall_ms - now * 1e3
             # getattr-guarded: pre-r21 servers ship no timeline; parsed
             # leniently (the sample schema is JSON on purpose — see
             # master.proto field 35) and deduped by `t`, which makes the
@@ -610,6 +624,17 @@ class ClusterTelemetry:
                 url for url, nt in self._nodes.items()
                 if not self._stale(nt, now)
             )
+
+    def clock_skew_ms(self, node_url: str) -> float:
+        """Latest wall-clock skew estimate for one node (node clock
+        minus master clock, in ms; 0.0 when unknown) — passed into
+        obs/critpath.py's assembler to place a skewed node's span
+        timestamps on the master's clock line."""
+        with self._lock:
+            nt = self._nodes.get(node_url)
+            if nt is None or nt.clock_skew_ms is None:
+                return 0.0
+            return float(nt.clock_skew_ms)
 
     def read_shed_totals(self) -> tuple[int, int]:
         """(cumulative EC reads, cumulative sheds) summed over every

@@ -336,6 +336,33 @@ REQUEST_STAGE_SECONDS = Histogram(
 for _stage in TRACE_STAGES:
     REQUEST_STAGE_SECONDS.labels(stage=_stage)
 
+# critical-path attribution (obs/critpath.py): every finished ROOT trace
+# has its client-visible wall time bucketed into exactly these six
+# segments (trace stages map onto the first five; whatever no span
+# covers is `untraced`), so the per-route composition — "reads on this
+# route spend 60% in device_execute, 30% in disk" — is a counter ratio.
+# The segment label universe is fixed here; routes register lazily (the
+# route space is a runtime property, like the mesh width above).
+CRITPATH_SEGMENTS = ("queue_wait", "device_execute", "host_reconstruct",
+                     "disk", "network_gap", "untraced")
+CRITPATH_SECONDS = Counter(
+    "SeaweedFS_critpath_seconds",
+    "Client-visible request seconds attributed to each critical-path "
+    "segment per route (obs/tailstore.py feeds every finished root "
+    "trace through obs/critpath.py's bucketing); the six segments of "
+    "one route sum to that route's SeaweedFS_critpath_route_seconds.",
+    ["route", "segment"],
+    registry=REGISTRY,
+)
+CRITPATH_ROUTE_SECONDS = Counter(
+    "SeaweedFS_critpath_route_seconds",
+    "Total client-visible request seconds per route — the denominator "
+    "the per-segment SeaweedFS_critpath_seconds composition is read "
+    "against (segments sum to this by construction).",
+    ["route"],
+    registry=REGISTRY,
+)
+
 # device-call accounting for the resident EC reconstruct path
 # (ops/rs_resident.py): the tunnel bytes and the compile-cache behavior
 # per shape are what decide whether a batch was cheap or a 20-40s cliff

@@ -528,17 +528,25 @@ def publish(name: str, t: dict, input_bytes: int) -> None:
     wall = float(t.get("wall_s", 0.0))
     ctx = obs_trace.current()
     t0 = time.perf_counter() - wall
+    # stage names spelled out per leg (not f"bulk_{leg}") so lint can tie
+    # each TRACE_STAGES entry to a literal call site (GL117 stage-drift)
+    anns = {"pipeline": name, "batches": t.get("batches", 0)}
     for leg, key in (
         ("read", "read_s"), ("device", "device_busy_s"), ("write", "write_s")
     ):
-        secs = float(t.get(key, 0.0))
         _metrics.VOLUME_SERVER_EC_BULK_SECONDS.labels(
             pipeline=name, leg=leg
-        ).inc(secs)
-        obs_trace.record_span(
-            ctx, f"bulk_{leg}", t0, secs,
-            annotations={"pipeline": name, "batches": t.get("batches", 0)},
-        )
+        ).inc(float(t.get(key, 0.0)))
+    obs_trace.record_span(
+        ctx, "bulk_read", t0, float(t.get("read_s", 0.0)), annotations=anns
+    )
+    obs_trace.record_span(
+        ctx, "bulk_device", t0, float(t.get("device_busy_s", 0.0)),
+        annotations=anns,
+    )
+    obs_trace.record_span(
+        ctx, "bulk_write", t0, float(t.get("write_s", 0.0)), annotations=anns
+    )
     _metrics.VOLUME_SERVER_EC_BULK_BYTES.labels(pipeline=name).inc(
         max(0, int(input_bytes))
     )

@@ -1,0 +1,18 @@
+"""Seeded GL117 violation: a TRACE_STAGES entry nothing records.
+
+This module declares its OWN stage tuple (GL117 only judges files in
+the linted set that declare one — the corpus must never judge the repo
+registry it can't see): "queue_wait" is recorded right below, but
+"ghost_stage" has no span()/record_span() call site anywhere in the
+corpus, so the declaration line carries exactly one finding.
+"""
+
+TRACE_STAGES = (
+    "queue_wait",  # recorded below — no finding
+    "ghost_stage",  # GL117: declared but never recorded
+)
+
+
+def records_queue_wait(obs):
+    with obs.span("queue_wait"):
+        pass

@@ -291,15 +291,25 @@ async def _incident_e2e(tmp_path, monkeypatch):
             assert "shapes" in hot and "aot" in hot
 
             # /debug/profile (SWFS_DEBUG on): a short capture succeeds
-            # or reports profiler unavailability — never a 500
-            async with sess.get(
-                f"http://{front.url}/debug/profile",
-                params={"seconds": "0.2"},
-            ) as r:
-                assert r.status in (200, 503), await r.text()
-                if r.status == 200:
-                    prof = await r.json()
-                    assert prof["trace_dir"] and "hot_shapes" in prof
+            # or reports profiler unavailability — never a 500.  The
+            # bundler's OWN capture may still be draining on this node
+            # (it writes the bundle after a 30s timeout even if the
+            # node-side profiler is still initialising), so wait out
+            # the single-flight 409 before judging the manual capture
+            deadline = time.monotonic() + 45
+            while True:
+                async with sess.get(
+                    f"http://{front.url}/debug/profile",
+                    params={"seconds": "0.2"},
+                ) as r:
+                    if r.status == 409 and time.monotonic() < deadline:
+                        await asyncio.sleep(1.0)
+                        continue
+                    assert r.status in (200, 503), await r.text()
+                    if r.status == 200:
+                        prof = await r.json()
+                        assert prof["trace_dir"] and "hot_shapes" in prof
+                    break
 
             # operator dump: POST /cluster/incident/dump forces a
             # second bundle past the rate limit

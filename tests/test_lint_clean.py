@@ -96,6 +96,7 @@ def test_every_rule_fires_on_its_corpus_fixture(corpus_findings):
         ("GL114", "case_unbounded_rpc"),
         ("GL115", "case_unsharded_device_put"),
         ("GL116", "case_untagged_dispatch"),
+        ("GL117", "case_stage_drift"),
     ],
 )
 def test_rule_fires_in_the_named_case_file(
@@ -129,6 +130,7 @@ def test_seeded_counts_are_exact(corpus_findings):
         "GL114": 3,  # bare unary, unbounded stream, closure-built call
         "GL115": 3,  # bare put, imported-name put, loop-staged put
         "GL116": 3,  # bare dispatch, bare bulk leg, untagged closure
+        "GL117": 1,  # the declared-but-never-recorded ghost stage
     }, by_rule
 
 

@@ -258,8 +258,15 @@ def test_corrupt_shard_scrub_verdict_repair(tmp_path):
             # the repaired copy lives somewhere, and reads are byte-exact
             front._ec_locations.clear()
             await _verify_reads(front, blobs)
-            v = sched.status()["volumes"][str(vid)]
-            assert v["state"] in ("repaired", "healthy")
+            # the scrub sweep may transiently re-queue the volume while
+            # the post-repair census settles; wait for the steady state
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                v = sched.status()["volumes"][str(vid)]
+                if v["state"] in ("repaired", "healthy"):
+                    break
+                await asyncio.sleep(0.2)
+            assert v["state"] in ("repaired", "healthy"), v
         finally:
             await cluster.stop()
 

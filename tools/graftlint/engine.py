@@ -35,14 +35,14 @@ from dataclasses import dataclass, field
 
 from . import flags as flags_mod
 from . import flow, locks, proto, rules
-from .model import UNUSED_WAIVER, Finding, rule_by_id
+from .model import STAGE_DRIFT, UNUSED_WAIVER, Finding, rule_by_id
 
 # seeded-violation fixtures live here: the clean-tree run must skip them
 # (they exist to FAIL), but linting the corpus dir explicitly works
 _CORPUS_DIR = "lint_corpus"
 _WAIVER_RE = re.compile(r"graftlint:\s*allow\(([\w-]+)\)")
 _CACHE_NAME = ".graftlint_cache.json"
-_CACHE_VERSION = 3
+_CACHE_VERSION = 4
 
 
 @dataclass
@@ -64,6 +64,11 @@ class FileResult:
     waiver_lines: list[tuple[int, str]] = field(default_factory=list)
     used_waivers: list[int] = field(default_factory=list)
     flag_decls: list[tuple[str, int]] = field(default_factory=list)
+    # GL117 inputs: this file's OWN `TRACE_STAGES = (...)` declaration
+    # (line, names) if any, and the stage literals it records at
+    # span()/record_span() call sites
+    stage_decl: tuple[int, list[str]] | None = None
+    stage_uses: list[str] = field(default_factory=list)
 
     def to_json(self) -> dict:
         return {
@@ -73,16 +78,24 @@ class FileResult:
             "waivers": list(self.waiver_lines),
             "used": list(self.used_waivers),
             "flags": list(self.flag_decls),
+            "stage_decl": (
+                [self.stage_decl[0], list(self.stage_decl[1])]
+                if self.stage_decl is not None else None
+            ),
+            "stage_uses": list(self.stage_uses),
         }
 
     @classmethod
     def from_json(cls, path: str, d: dict) -> "FileResult":
+        sd = d.get("stage_decl")
         return cls(
             path=path,
             findings=[Finding(*row) for row in d.get("findings", ())],
             waiver_lines=[tuple(w) for w in d.get("waivers", ())],
             used_waivers=list(d.get("used", ())),
             flag_decls=[tuple(w) for w in d.get("flags", ())],
+            stage_decl=(int(sd[0]), list(sd[1])) if sd else None,
+            stage_uses=list(d.get("stage_uses", ())),
         )
 
 
@@ -247,6 +260,8 @@ def lint_one_file(
     assert unit is not None
     res.waiver_lines = sorted(unit.waivers.items())
     res.flag_decls = flags_mod.flag_decls(unit.tree, path)
+    res.stage_decl = rules.stage_decl_site(unit.tree)
+    res.stage_uses = sorted(rules.stage_use_literals(unit.tree))
 
     raw: list[Finding] = []
     raw += rules.check_async_blocking(unit.tree, path)
@@ -524,6 +539,37 @@ def run_paths(
                 if os.path.abspath(p) == ap:
                     used_by_path.setdefault(p, set()).add(w)
                     break
+            else:
+                used_by_path.setdefault(f.path, set()).add(w)
+
+    # GL117 stage drift: every stage a linted `TRACE_STAGES = (...)`
+    # tuple declares must be recorded — as a span()/record_span()
+    # literal — SOMEWHERE in the linted set.  Anchored on the declaring
+    # file/line (only modules that themselves declare the tuple judge:
+    # a loose file set without the registry judges nothing), so the
+    # normal waiver channel applies at the declaration.
+    all_stage_uses: set[str] = set()
+    for r in results.values():
+        all_stage_uses.update(r.stage_uses)
+    for path in sorted(results):
+        decl = results[path].stage_decl
+        if decl is None:
+            continue
+        decl_line, names = decl
+        for name in names:
+            if name in all_stage_uses:
+                continue
+            f = Finding(
+                STAGE_DRIFT.rule_id, path, decl_line,
+                f"trace stage {name!r} is declared in TRACE_STAGES but "
+                "no span()/record_span() call site in the linted tree "
+                "records it — delete the dead stage (and its README "
+                "row) or instrument the code path it was meant for",
+            )
+            u = _unit_for(f.path)
+            w = _waiver_line_for(u, f) if u is not None else None
+            if w is None:
+                findings.append(f)
             else:
                 used_by_path.setdefault(f.path, set()).add(w)
 

@@ -193,6 +193,16 @@ UNTAGGED_DEVICE_DISPATCH = register(
     "attribution the contention timeline depends on silently leaks",
     "arr = _dispatch_call(...)  # no devledger.workload/device",
 )
+STAGE_DRIFT = register(
+    "GL117",
+    "stage-drift",
+    "a TRACE_STAGES entry with no literal span()/record_span() call "
+    "site anywhere in the linted tree — the critical-path attribution "
+    "(obs/critpath.py) maps every stage to a latency segment, so a "
+    "declared-but-never-recorded stage is a dead row in the README "
+    "table and a segment that silently reads as zero",
+    'TRACE_STAGES = (..., "ghost_stage")  # nothing records it',
+)
 
 
 def rule_table_markdown() -> str:

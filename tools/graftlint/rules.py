@@ -415,6 +415,51 @@ def declared_stages(tree: ast.Module) -> set[str]:
     return set()
 
 
+def stage_decl_site(tree: ast.Module) -> tuple[int, list[str]] | None:
+    """(line, names) of a module's own `TRACE_STAGES = (...)` tuple
+    literal, or None.  GL117 anchors declared-but-never-recorded
+    findings on the declaring assignment, and only modules in the
+    linted set that themselves declare the tuple anchor findings — so
+    linting a loose file set (the corpus) never judges the repo
+    registry it can't see."""
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "TRACE_STAGES"
+                for t in node.targets
+            )
+            and isinstance(node.value, (ast.Tuple, ast.List))
+        ):
+            names = [
+                s for s in (_str_const(e) for e in node.value.elts)
+                if s is not None
+            ]
+            return node.lineno, names
+    return None
+
+
+def stage_use_literals(tree: ast.Module) -> set[str]:
+    """Stage literals recorded at span()/record_span() call sites —
+    the same extraction GL106 validates forward, collected per file so
+    GL117 can check the reverse direction (a declared stage nothing in
+    the tree ever records)."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func) or ""
+        stage = None
+        if name.endswith("span") and not name.endswith("record_span"):
+            if node.args:
+                stage = _str_const(node.args[0])
+        elif name.endswith("record_span") and len(node.args) >= 2:
+            stage = _str_const(node.args[1])
+        if stage is not None:
+            out.add(stage)
+    return out
+
+
 def check_metric_registry(
     tree: ast.Module, path: str, registry: set[str], is_registry_module: bool,
 ) -> Iterator[Finding]:
