@@ -154,6 +154,7 @@ HEADLINE_KEYS = (
     "write_headline",
     "contention_headline",
     "tailpath_headline",
+    "podscale_headline",
 )
 
 
@@ -4599,6 +4600,451 @@ def bench_shard_sweep(smoke=False):
     return asyncio.run(_shard_sweep_async(smoke=smoke))
 
 
+# ------------------------------------------------------------------ r23
+# true pod scale: multi-PROCESS resident serving over jax.distributed.
+# Three phases, each judged in the driver (bench_podscale_sweep):
+#   A. capacity — a REAL 2-process jax.distributed CPU mesh (subprocess
+#      workers, --xla_force_host_platform_device_count=4 each, so the
+#      pod spans 8 global lanes on 2 hosts): the 2-process pod holds a
+#      working set the 1-process mesh must shed, with zero evictions
+#      and each host's OWN lanes byte-verified against the owner-major
+#      stripe permutation (no survivor byte crossed a host to check
+#      them — addressable_shards only).  Rank 1 is then SIGKILLed.
+#   B. timed pod kernel — jax 0.4.37's CPU backend refuses
+#      cross-process COMPUTATIONS ("Multiprocess computations aren't
+#      implemented on the CPU backend"), so the timed reads run the
+#      IDENTICAL replicated pod program (multiprocess staging slices +
+#      all_gather + replicated out_specs, cache.multiprocess forced
+#      True) single-process over 8 forced devices: pod-program
+#      emulation, labeled as such.  Every timed read byte-verified,
+#      zero timed compile misses (r19 convention: untimed passes over
+#      the exact timed request lists first).
+#   C. repair handoff — the rank phase A actually SIGKILLed becomes a
+#      stale pod member in the repair planner's census: survivors
+#      collapsed into one pod escalate to critical (pod_exposed) even
+#      though the raw healthy count still shows slack; the same census
+#      without pod info must NOT escalate.
+
+_PODSCALE_DROP = 3  # the "lost" shard every degraded read rebuilds
+_PODSCALE_POD_LANES = 8  # full-pod lane count the per-chip budget assumes
+
+
+def _podscale_child_env(n_local_devices: int) -> dict:
+    """Env for one podscale subprocess: CPU backend with exactly
+    `n_local_devices` forced host-platform devices (any inherited
+    force-flag from an outer smoke rig is replaced, same rebuild the
+    dryrun's shard step uses)."""
+    env = dict(os.environ)
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count")
+    ]
+    flags.append(
+        f"--xla_force_host_platform_device_count={n_local_devices}"
+    )
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _podscale_volumes(n_volumes: int, shard_bytes: int, seed: int) -> dict:
+    """vid -> encoded shard list, a pure function of the seed: every pod
+    member stages identical bytes in identical order (SPMD lockstep),
+    and the driver's oracle is the same function."""
+    from seaweedfs_tpu.ops import rs
+
+    rng = np.random.default_rng(seed)
+    return {
+        vid: rs.RSCodec(backend="numpy").encode_all(
+            rng.integers(0, 256, size=(10, shard_bytes), dtype=np.uint8)
+        )
+        for vid in range(1, n_volumes + 1)
+    }
+
+
+def _podscale_stage(cache, volumes, n_staged: int):
+    """Stage every volume's survivor shards (all but _PODSCALE_DROP) in
+    deterministic lockstep order under a per-chip budget sized so the
+    FULL 8-lane pod holds EXACTLY the working set: per-chip capacity is
+    a constant of the deployment, so pod capacity = per_chip x lanes
+    scales with process count — the tentpole's capacity claim."""
+    from seaweedfs_tpu.ops import rs_resident
+
+    some_vid = next(iter(volumes))
+    pad = cache._padded_len(int(volumes[some_vid][0].size))
+    per_chip = -(-(len(volumes) * n_staged * pad) // _PODSCALE_POD_LANES)
+    cache.budget = per_chip * cache.n_devices
+    for vid in sorted(volumes):
+        for sid in range(rs_resident.TOTAL_SHARDS):
+            if sid != _PODSCALE_DROP:
+                cache.put(vid, sid, volumes[vid][sid].tobytes())
+    return pad
+
+
+def _podscale_worker(cfg: dict) -> None:
+    """Subprocess body of phase A: one pod member.  Joins the
+    jax.distributed mesh (process_count=1 skips the join and degrades
+    to the local mesh), stages the working set, byte-verifies its own
+    lanes, prints ONE JSON line, then (cfg["hold"]) parks until the
+    driver kills it — rank 1's SIGKILL is phase C's stale pod member."""
+    from seaweedfs_tpu.ops import rs_resident
+    from seaweedfs_tpu.parallel import mesh as mesh_mod
+
+    mesh_mod.initialize_distributed(
+        cfg["coordinator"], cfg["process_id"], cfg["process_count"]
+    )
+    shard_bytes = int(cfg["shard_kb"]) * 1024
+    volumes = _podscale_volumes(
+        int(cfg["n_volumes"]), shard_bytes, int(cfg["seed"])
+    )
+    cache = rs_resident.DeviceShardCache(
+        shard_quantum=1 << 18,
+        mesh_devices=0,
+        mesh_min_shard_bytes=0,
+        global_mesh=True,
+    )
+    cache.warm_sizes = ()  # the CI convention: no AOT warm plan
+    n_staged = rs_resident.TOTAL_SHARDS - 1
+    pad = _podscale_stage(cache, volumes, n_staged)
+    # lane byte-verify: rebuild the owner-major permuted buffer the put
+    # path shipped and compare every lane THIS process owns (its
+    # addressable shards) slice-for-slice.  sh.index[0] is the lane's
+    # slice of the GLOBAL buffer, so the check proves both bytes and
+    # placement (each host holding exactly its interleaved stripes).
+    lanes_checked = 0
+    lane_mismatches = 0
+    s_n = pad // cache.stripe
+    perm = (
+        np.arange(s_n)
+        .reshape(s_n // cache.n_devices, cache.n_devices)
+        .T.ravel()
+    )
+    for vid in sorted(volumes):
+        if cache.resident_count(vid) != n_staged:
+            continue  # W=1 sheds most volumes; verify what's resident
+        for sid in (0, rs_resident.TOTAL_SHARDS - 1):
+            arr = cache.get(vid, sid)
+            if arr is None:
+                continue
+            padded = np.zeros(pad, dtype=np.uint8)
+            padded[:shard_bytes] = volumes[vid][sid]
+            exp = padded.reshape(s_n, cache.stripe)[perm].reshape(-1)
+            for sh in arr.addressable_shards:
+                lo = sh.index[0].start or 0
+                piece = np.asarray(sh.data)
+                lanes_checked += 1
+                if not np.array_equal(piece, exp[lo : lo + piece.size]):
+                    lane_mismatches += 1
+    resident = sum(
+        1 for vid in volumes if cache.resident_count(vid) == n_staged
+    )
+    print(
+        json.dumps({
+            "rank": int(cfg["process_id"]),
+            "n_devices": int(cache.n_devices),
+            "n_hosts": int(cache.n_hosts),
+            "multiprocess": bool(cache.multiprocess),
+            "local_lanes": list(cache._local_dev_indices),
+            "resident_volumes": int(resident),
+            "evictions": int(cache.evictions),
+            "all_mesh_placed": all(
+                cache.placement(vid) == "mesh"
+                for vid in volumes
+                if cache.resident_count(vid)
+            ),
+            "lanes_checked": int(lanes_checked),
+            "lane_mismatches": int(lane_mismatches),
+        }),
+        flush=True,
+    )
+    if cfg.get("hold"):
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            time.sleep(0.2)
+
+
+def _podscale_timed(cfg: dict) -> None:
+    """Subprocess body of phase B: the timed pod kernel, single-process
+    over 8 forced devices with cache.multiprocess forced True —
+    pod-program EMULATION (the CPU backend refuses real cross-process
+    computations), so the timed trajectory runs the exact replicated
+    SPMD program a pod serves (local-slice staging, all_gather,
+    replicated out_specs) with every lane process-local."""
+    from seaweedfs_tpu import stats as swfs_stats
+    from seaweedfs_tpu.ops import rs_resident
+
+    shard_bytes = int(cfg["shard_kb"]) * 1024
+    volumes = _podscale_volumes(
+        int(cfg["n_volumes"]), shard_bytes, int(cfg["seed"])
+    )
+    cache = rs_resident.DeviceShardCache(
+        shard_quantum=1 << 18,
+        mesh_devices=0,
+        mesh_min_shard_bytes=0,
+        global_mesh=True,
+    )
+    cache.warm_sizes = ()
+    # the emulation switch: single-process degrade resolves to
+    # n_hosts=1 / multiprocess=False; forcing True reroutes every put
+    # through make_array_from_process_local_data (the local slice is
+    # the whole buffer here) and every reconstruct through the
+    # replicated gather kernel — the pod program, lanes process-local
+    cache.multiprocess = True
+    n_staged = rs_resident.TOTAL_SHARDS - 1
+    _podscale_stage(cache, volumes, n_staged)
+    size = 4096
+    rng = np.random.default_rng(int(cfg["seed"]) + 1)
+    request_lists = [
+        [
+            (_PODSCALE_DROP, int(off), size)
+            for off in rng.integers(
+                0, shard_bytes - size, size=int(cfg["batch"])
+            )
+        ]
+        for _ in range(int(cfg["rounds"]))
+    ]
+    vids = sorted(volumes)
+    # r19 convention: one untimed pass over the EXACT timed request
+    # lists pays every compile before the clock starts
+    for r, reqs in enumerate(request_lists):
+        rs_resident.reconstruct_intervals(cache, vids[r % len(vids)], reqs)
+
+    def _miss():
+        return swfs_stats.REGISTRY.get_sample_value(
+            "SeaweedFS_volumeServer_ec_device_compile_total",
+            {"result": "miss"},
+        ) or 0.0
+
+    miss0 = _miss()
+    verified = True
+    n_reads = 0
+    t0 = time.perf_counter()
+    for r, reqs in enumerate(request_lists):
+        vid = vids[r % len(vids)]
+        pieces = rs_resident.reconstruct_intervals(cache, vid, reqs)
+        for (sid, off, sz), piece in zip(reqs, pieces):
+            n_reads += 1
+            if piece != volumes[vid][sid][off : off + sz].tobytes():
+                verified = False
+    wall = time.perf_counter() - t0
+    print(
+        json.dumps({
+            "n_devices": int(cache.n_devices),
+            "pod_program": bool(cache.multiprocess),
+            "reads": int(n_reads),
+            "wall_s": round(wall, 4),
+            "reads_per_s": round(n_reads / max(wall, 1e-9), 1),
+            "timed_compile_misses": int(_miss() - miss0),
+            "verified": bool(verified),
+        }),
+        flush=True,
+    )
+
+
+def bench_podscale_sweep(smoke: bool = False) -> dict:
+    """Multi-process pod-scale serving: capacity scaling across real
+    jax.distributed processes (phase A), the timed replicated pod
+    kernel (phase B), and the SIGKILLed member degrading into the
+    repair plane as a stale pod member (phase C)."""
+    import socket
+    import subprocess
+
+    from seaweedfs_tpu.repair import planner
+
+    n_volumes = 6 if smoke else 8
+    shard_kb = 64 if smoke else 256
+    seed = 20260807
+    bench_path = os.path.abspath(__file__)
+    out: dict = {
+        "smoke": bool(smoke),
+        "n_volumes": n_volumes,
+        "shard_kb": shard_kb,
+    }
+
+    def spawn(rank, count, coordinator, hold):
+        cfg = {
+            "coordinator": coordinator,
+            "process_id": rank,
+            "process_count": count,
+            "n_volumes": n_volumes,
+            "shard_kb": shard_kb,
+            "seed": seed,
+            "hold": hold,
+        }
+        return subprocess.Popen(
+            [
+                sys.executable,
+                bench_path,
+                "_podscale_worker",
+                json.dumps(cfg),
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=_podscale_child_env(_PODSCALE_POD_LANES // 2),
+            cwd=os.path.dirname(bench_path),
+        )
+
+    def one_line(proc, who):
+        line = proc.stdout.readline()
+        if not line.strip():
+            proc.kill()
+            _, err = proc.communicate()
+            raise RuntimeError(
+                f"podscale worker {who} died before reporting: "
+                f"{(err or '').strip()[-800:]}"
+            )
+        return json.loads(line)
+
+    # ---- phase A: 1-process mesh, then the real 2-process pod
+    p = spawn(0, 1, "", hold=False)
+    stdout, stderr = p.communicate(timeout=600)
+    if p.returncode != 0 or not stdout.strip():
+        raise RuntimeError(
+            f"podscale 1-process worker failed rc={p.returncode}: "
+            f"{(stderr or '').strip()[-800:]}"
+        )
+    w1 = json.loads(stdout.strip().splitlines()[0])
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coordinator = f"127.0.0.1:{s.getsockname()[1]}"
+    procs = [spawn(r, 2, coordinator, hold=True) for r in (0, 1)]
+    try:
+        w2 = [one_line(procs[r], f"rank{r}") for r in (0, 1)]
+        # the chaos leg: SIGKILL rank 1 mid-hold — the dead pod member
+        # phase C feeds to the repair planner
+        procs[1].kill()
+        procs[1].wait(timeout=60)
+        killed_rc = procs[1].returncode
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+            try:
+                p.communicate(timeout=60)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.communicate()
+    out["one_process"] = w1
+    out["two_process"] = w2
+    out["killed_rank_rc"] = int(killed_rc)
+
+    lanes_ok = all(
+        w["lane_mismatches"] == 0 and w["lanes_checked"] > 0
+        for w in (w1, *w2)
+    )
+    # global lane ownership must partition: each host exactly its half
+    owned = sorted(w2[0]["local_lanes"] + w2[1]["local_lanes"])
+    pod_real = (
+        w2[0]["n_devices"] == _PODSCALE_POD_LANES
+        and w2[0]["n_hosts"] == 2
+        and all(w["multiprocess"] for w in w2)
+        and owned == list(range(_PODSCALE_POD_LANES))
+        and not w1["multiprocess"]
+        and w1["n_devices"] == _PODSCALE_POD_LANES // 2
+    )
+    capacity_scales = (
+        pod_real
+        and all(w["resident_volumes"] == n_volumes for w in w2)
+        and w1["resident_volumes"] < n_volumes
+    )
+    zero_shed = all(
+        w["evictions"] == 0 and w["all_mesh_placed"] for w in w2
+    )
+    one_sheds = w1["evictions"] > 0
+
+    # ---- phase B: the timed replicated pod kernel (emulated rig)
+    timed_cfg = {
+        "n_volumes": 2,
+        "shard_kb": shard_kb,
+        "seed": seed,
+        "batch": 16 if smoke else 64,
+        "rounds": 4 if smoke else 16,
+    }
+    p = subprocess.Popen(
+        [
+            sys.executable,
+            bench_path,
+            "_podscale_timed",
+            json.dumps(timed_cfg),
+        ],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        env=_podscale_child_env(_PODSCALE_POD_LANES),
+        cwd=os.path.dirname(bench_path),
+    )
+    stdout, stderr = p.communicate(timeout=600)
+    if p.returncode != 0 or not stdout.strip():
+        raise RuntimeError(
+            f"podscale timed worker failed rc={p.returncode}: "
+            f"{(stderr or '').strip()[-800:]}"
+        )
+    timed = json.loads(stdout.strip().splitlines()[0])
+    out["timed"] = timed
+
+    # ---- phase C: the SIGKILLed rank enters the repair census as a
+    # stale pod member — survivors collapsed into one pod escalate
+    host0, host1 = "pod-host0:8080", "pod-host1:8080"
+    shards = {sid: host0 for sid in range(11)}
+    shards.update({sid: host1 for sid in range(11, 14)})
+    stale = frozenset({host1}) if killed_rc == -9 else frozenset()
+    pods = {host0: coordinator, host1: coordinator}
+    planned = planner.plan(
+        {900: shards}, stale_nodes=stale, node_pods=pods
+    )
+    control = planner.plan({900: shards}, stale_nodes=stale)
+    job = planned.jobs[0] if planned.jobs else None
+    ctrl = control.jobs[0] if control.jobs else None
+    escalates = bool(
+        killed_rc == -9
+        and job is not None
+        and job.pod_exposed
+        and job.critical
+        and job.healthy > planner.DATA_SHARDS
+        and ctrl is not None
+        and not ctrl.critical  # same census, no pod info: no escalation
+    )
+    out["repair_plan"] = {
+        "killed_rank_rc": int(killed_rc),
+        "healthy": int(job.healthy) if job else -1,
+        "pod_exposed": bool(job.pod_exposed) if job else False,
+        "critical": bool(job.critical) if job else False,
+        "control_critical": bool(ctrl.critical) if ctrl else True,
+    }
+
+    misses = int(timed["timed_compile_misses"])
+    reads_verified = bool(timed["verified"]) and misses == 0
+    out["podscale_headline"] = {
+        "smoke": bool(smoke),
+        "pod_lanes_1p": int(w1["n_devices"]),
+        "pod_lanes_2p": int(w2[0]["n_devices"]),
+        "pod_hosts_2p": int(w2[0]["n_hosts"]),
+        "one_process_resident_volumes": int(w1["resident_volumes"]),
+        "one_process_sheds": bool(one_sheds),
+        "lane_bytes_verified": bool(lanes_ok),
+        "timed_compile_misses": misses,
+        "killed_rank_rc": int(killed_rc),
+        # the compact keys main() ships in the archived tail
+        "pod_capacity_scales": bool(capacity_scales and one_sheds),
+        "pod_zero_shed": bool(zero_shed),
+        "pod_reads_per_s": float(timed["reads_per_s"]),
+        "pod_reads_verified": reads_verified,
+        "kill_escalates_repair": escalates,
+        "podscale_wins": bool(
+            capacity_scales
+            and one_sheds
+            and zero_shed
+            and lanes_ok
+            and reads_verified
+            and escalates
+        ),
+    }
+    return out
+
+
 def probe_tpu(timeout_sec: int = 900) -> str | None:
     """Confirm the device backend can initialize before committing to it.
     A killed TPU process can leave the axon session grant held, making
@@ -4720,6 +5166,12 @@ def main():
     # churn, per-route segment counters summing to route totals
     # (tailpath_headline)
     tailpath_sweep = bench_tailpath_sweep()
+    # r23: true pod scale — real multi-process jax.distributed capacity
+    # scaling, the timed replicated pod kernel, and a SIGKILLed pod
+    # member degrading into the repair plane (podscale_headline).  The
+    # sweep is subprocess-rigged (CPU mesh), so it runs the same way on
+    # every rig
+    podscale_sweep = bench_podscale_sweep()
     scrub = bench_scrub()
     scrub_all = bench_scrub_all()
     disk_pre_mbps = bench_disk_ceiling()
@@ -4859,6 +5311,11 @@ def main():
                         k: v
                         for k, v in tailpath_sweep.items()
                         if k != "tailpath_headline"
+                    },
+                    "podscale_sweep": {
+                        k: v
+                        for k, v in podscale_sweep.items()
+                        if k != "podscale_headline"
                     },
                     "scrub": scrub,
                     "scrub_all_sweep": scrub_all,
@@ -5098,22 +5555,44 @@ def main():
                 # correlated across nodes, profile captured, recorder
                 # overhead bounded
                 "incident_headline": {
-                    k: v
-                    for k, v in incident_sweep["headline"].items()
-                    if k not in (
-                        "smoke",
-                        "calm_stage_p99_ms",
-                        "target_ms",
-                        "burn_evaluations",
-                        "recorder_noise_pct",
-                        "reads_verified",
-                        # r19 tail trim: recorder_overhead_ok carries
-                        # the bound (raw pct in extra.incident_sweep)
-                        "recorder_overhead_pct",
-                        # r22 tail trim: burn_within_pulses subsumes it
-                        # (a burn can't be within budget undetected)
-                        "burn_detected",
-                    )
+                    **{
+                        k: v
+                        for k, v in incident_sweep["headline"].items()
+                        if k not in (
+                            "smoke",
+                            "calm_stage_p99_ms",
+                            "target_ms",
+                            "burn_evaluations",
+                            "recorder_noise_pct",
+                            "reads_verified",
+                            # r19 tail trim: recorder_overhead_ok carries
+                            # the bound (raw pct in extra.incident_sweep)
+                            "recorder_overhead_pct",
+                            # r22 tail trim: burn_within_pulses subsumes
+                            # it (a burn can't be within budget
+                            # undetected)
+                            "burn_detected",
+                            # r23 tail trims: the three fold into
+                            # incident_verdict_ok below (full forms in
+                            # the standalone sweep output, which the
+                            # dryrun's step 10 asserts directly) — the
+                            # podscale headline needed their tail budget
+                            "bundle_written",
+                            "cross_node_trace_correlation",
+                            "profile_captured",
+                            "recorder_overhead_ok",
+                        )
+                    },
+                    "incident_verdict_ok": bool(
+                        incident_sweep["headline"]["bundle_written"]
+                        and incident_sweep["headline"][
+                            "cross_node_trace_correlation"
+                        ]
+                        and incident_sweep["headline"]["profile_captured"]
+                        and incident_sweep["headline"][
+                            "recorder_overhead_ok"
+                        ]
+                    ),
                 },
                 # r18 tail-tolerance verdict (bench_netchaos_sweep),
                 # COMPACT for the same 2000-char tail budget (full
@@ -5121,27 +5600,46 @@ def main():
                 # holder mid-window, hedged around; doomed work
                 # refused; retry storms budget-capped
                 "netchaos_headline": {
-                    k: v
-                    for k, v in netchaos_sweep["headline"].items()
-                    if k not in (
-                        "smoke",
-                        "calm_p99_ms",
-                        "netchaos_p99_ms",
-                        "detection_max_ms",  # detection_bounded stays
-                        "hedge_sent",
-                        "hedge_cancelled",
-                        "hedge_wins_positive",  # hedge_wins > 0 IS it
-                        "netchaos_errors",
-                        # reads_verified folds into
-                        # zero_unrecoverable_reads (verify failures
-                        # count as unrecoverable)
-                        "reads_verified",
-                        "retries_used",
-                        "retry_budget_exhausted",
-                        # r19 tail trim: p99_within_2x carries the
-                        # bound (raw ratio in extra.netchaos_sweep)
-                        "p99_ratio",
-                    )
+                    **{
+                        k: v
+                        for k, v in netchaos_sweep["headline"].items()
+                        if k not in (
+                            "smoke",
+                            "calm_p99_ms",
+                            "netchaos_p99_ms",
+                            "detection_max_ms",
+                            "hedge_sent",
+                            "hedge_cancelled",
+                            "hedge_wins_positive",  # hedge_wins > 0 IS it
+                            "netchaos_errors",
+                            # reads_verified folds into
+                            # zero_unrecoverable_reads (verify failures
+                            # count as unrecoverable)
+                            "reads_verified",
+                            "retries_used",
+                            "retry_budget_exhausted",
+                            # r19 tail trim: p99_within_2x carries the
+                            # bound (raw ratio in extra.netchaos_sweep)
+                            "p99_ratio",
+                            # r23 tail trims: the three fold into
+                            # netchaos_verdict_ok below (full forms in
+                            # the standalone sweep output, which the
+                            # dryrun's step 11 asserts directly) — the
+                            # podscale headline needed their tail budget
+                            "detection_bounded",
+                            "deadline_refuses_doomed",
+                            "retry_storm_bounded",
+                        )
+                    },
+                    "netchaos_verdict_ok": bool(
+                        netchaos_sweep["headline"]["detection_bounded"]
+                        and netchaos_sweep["headline"][
+                            "deadline_refuses_doomed"
+                        ]
+                        and netchaos_sweep["headline"][
+                            "retry_storm_bounded"
+                        ]
+                    ),
                 },
                 # r19 pod-scale-residency verdict (bench_shard_sweep),
                 # COMPACT for the same 2000-char tail budget (full
@@ -5278,6 +5776,34 @@ def main():
                         "all_slow_assembled",
                     )
                 },
+                # r23 pod-scale verdict (bench_podscale_sweep), COMPACT
+                # for the same 2000-char tail budget (worker reports,
+                # the timed rig, and the repair plan live in
+                # extra.podscale_sweep): a REAL 2-process
+                # jax.distributed pod holds a working set the 1-process
+                # mesh must shed with zero evictions (pod capacity
+                # scales with process count), the replicated pod kernel
+                # serves byte-verified reads, and the SIGKILLed pod
+                # member escalates the repair planner's pod-exposure
+                # path; lane byte-verification and the compile-miss
+                # guard fold into pod_reads_verified / podscale_wins
+                # here (full keys in the standalone sweep output, which
+                # the dryrun's step 16 asserts directly)
+                "podscale_headline": {
+                    k: v
+                    for k, v in podscale_sweep["podscale_headline"].items()
+                    if k not in (
+                        "smoke",
+                        "pod_lanes_1p",
+                        "pod_lanes_2p",
+                        "pod_hosts_2p",
+                        "one_process_resident_volumes",
+                        "one_process_sheds",
+                        "lane_bytes_verified",
+                        "timed_compile_misses",
+                        "killed_rank_rc",
+                    )
+                },
             })
         )
     )
@@ -5355,6 +5881,28 @@ if __name__ == "__main__":
         # compiles); --smoke is the CPU pass the dryrun's step 15 runs
         result = bench_tailpath_sweep(smoke="--smoke" in sys.argv[2:])
         print(json.dumps(order_result(result)))
+        sys.exit(0)
+    if len(sys.argv) >= 2 and sys.argv[1] == "bench_podscale_sweep":
+        # standalone multi-process pod-scale sweep: `python bench.py
+        # bench_podscale_sweep [--smoke]` — real 2-process
+        # jax.distributed capacity scaling (2 processes hold a working
+        # set 1 must shed, zero evictions, per-host lane bytes
+        # verified), the timed replicated pod kernel (byte-verified,
+        # zero timed compiles), and the SIGKILLed rank escalating the
+        # repair planner's pod-exposure path; --smoke is the CPU pass
+        # the dryrun's step 16 runs
+        result = bench_podscale_sweep(smoke="--smoke" in sys.argv[2:])
+        print(json.dumps(order_result(result)))
+        sys.exit(0)
+    if len(sys.argv) >= 3 and sys.argv[1] == "_podscale_worker":
+        # internal: one phase-A pod member (spawned by
+        # bench_podscale_sweep under its own jax.distributed env)
+        _podscale_worker(json.loads(sys.argv[2]))
+        sys.exit(0)
+    if len(sys.argv) >= 3 and sys.argv[1] == "_podscale_timed":
+        # internal: the phase-B timed pod-kernel rig (8 forced devices,
+        # replicated pod program with every lane process-local)
+        _podscale_timed(json.loads(sys.argv[2]))
         sys.exit(0)
     if len(sys.argv) >= 2 and sys.argv[1] == "bench_incident_smoke":
         # standalone incident-plane sweep: `python bench.py

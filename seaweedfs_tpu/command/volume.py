@@ -130,6 +130,26 @@ def add_args(p) -> None:
         "cross-device dispatch per batch)",
     )
     p.add_argument(
+        "-ec.mesh.coordinator", dest="ec_mesh_coordinator",
+        default=serving_defaults.mesh_coordinator,
+        help="host:port of the jax.distributed coordinator this volume "
+        "server rendezvouses at when joining a multi-controller pod "
+        "mesh (required when -ec.mesh.processCount > 1; ignored at 1)",
+    )
+    p.add_argument(
+        "-ec.mesh.processId", dest="ec_mesh_process_id",
+        type=int, default=serving_defaults.mesh_process_id,
+        help="this process's rank in the multi-controller pod mesh "
+        "(0 <= processId < processCount; one process per host)",
+    )
+    p.add_argument(
+        "-ec.mesh.processCount", dest="ec_mesh_process_count",
+        type=int, default=serving_defaults.mesh_process_count,
+        help="processes in the multi-controller pod mesh; 1 (default) "
+        "stays single-controller — resident volumes then shard over "
+        "this host's devices only and no coordinator is contacted",
+    )
+    p.add_argument(
         "-ec.serving.zerocopy.disable", dest="ec_serving_zerocopy_disable",
         action="store_true",
         help="materialize needle payloads as bytes on the HTTP read path "
@@ -439,6 +459,49 @@ async def run(args) -> None:
         storage_types.set_offset_size(args.offset_bytes)
     dirs = [d.strip() for d in args.dir.split(",") if d.strip()]
     counts = [int(c) for c in str(args.max_volume_counts).split(",")]
+    ec_serving = ServingConfig(
+        enabled=not args.ec_serving_disable,
+        max_batch=args.ec_serving_max_batch,
+        max_wait_us=args.ec_serving_max_wait_us,
+        max_inflight=args.ec_serving_max_inflight,
+        max_queue=args.ec_serving_max_queue,
+        layout=args.ec_serving_layout,
+        overlap=not args.ec_serving_overlap_disable,
+        aot=not args.ec_serving_aot_disable,
+        mesh=not args.ec_serving_mesh_disable,
+        mesh_devices=args.ec_serving_mesh_devices,
+        mesh_min_shard_mb=args.ec_serving_mesh_min_shard_mb,
+        mesh_coordinator=args.ec_mesh_coordinator,
+        mesh_process_id=args.ec_mesh_process_id,
+        mesh_process_count=args.ec_mesh_process_count,
+        zero_copy=not args.ec_serving_zerocopy_disable,
+        qos=not args.ec_qos_disable,
+        qos_interactive_queue=args.ec_qos_interactive_queue,
+        qos_bulk_queue=args.ec_qos_bulk_queue,
+        qos_interactive_deadline_ms=args.ec_qos_interactive_deadline_ms,
+        qos_bulk_deadline_ms=args.ec_qos_bulk_deadline_ms,
+        qos_trip_after=args.ec_qos_trip_after,
+        qos_recover_seconds=args.ec_qos_recover_seconds,
+        stall_budget_seconds=args.ec_qos_stall_budget_seconds,
+        stall_min_rate_kbps=args.ec_qos_stall_min_rate_kbps,
+        tier=not args.ec_tier_disable,
+        tier_interval_seconds=args.ec_tier_interval_seconds,
+        tier_host_cache_mb=args.ec_tier_host_cache_mb,
+        tier_half_life_seconds=args.ec_tier_half_life_seconds,
+        tier_promote_ratio=args.ec_tier_promote_ratio,
+        tier_min_residency_seconds=args.ec_tier_min_residency_seconds,
+        tier_bulk_weight=args.ec_tier_bulk_weight,
+    ).validated()  # startup fast-fail: a bad -ec.mesh.* config dies HERE
+    if ec_serving.multiprocess:
+        # multi-controller rendezvous must precede the first jax backend
+        # touch (the compile-cache warm below initializes the backend)
+        from ..parallel import mesh as mesh_mod
+
+        mesh_mod.initialize_distributed(
+            ec_serving.mesh_coordinator,
+            ec_serving.mesh_process_id,
+            ec_serving.mesh_process_count,
+        )
     if args.ec_device_cache_mb > 0:
         # process entry point: persist kernel compiles next to the data so
         # restarts don't re-pay tens of seconds per reconstruct shape
@@ -480,36 +543,7 @@ async def run(args) -> None:
         fix_jpg_orientation=args.fix_jpg_orientation,
         ec_scrub_interval_seconds=args.ec_scrub_interval_seconds,
         ec_scrub_megakernel=not args.ec_scrub_megakernel_disable,
-        ec_serving=ServingConfig(
-            enabled=not args.ec_serving_disable,
-            max_batch=args.ec_serving_max_batch,
-            max_wait_us=args.ec_serving_max_wait_us,
-            max_inflight=args.ec_serving_max_inflight,
-            max_queue=args.ec_serving_max_queue,
-            layout=args.ec_serving_layout,
-            overlap=not args.ec_serving_overlap_disable,
-            aot=not args.ec_serving_aot_disable,
-            mesh=not args.ec_serving_mesh_disable,
-            mesh_devices=args.ec_serving_mesh_devices,
-            mesh_min_shard_mb=args.ec_serving_mesh_min_shard_mb,
-            zero_copy=not args.ec_serving_zerocopy_disable,
-            qos=not args.ec_qos_disable,
-            qos_interactive_queue=args.ec_qos_interactive_queue,
-            qos_bulk_queue=args.ec_qos_bulk_queue,
-            qos_interactive_deadline_ms=args.ec_qos_interactive_deadline_ms,
-            qos_bulk_deadline_ms=args.ec_qos_bulk_deadline_ms,
-            qos_trip_after=args.ec_qos_trip_after,
-            qos_recover_seconds=args.ec_qos_recover_seconds,
-            stall_budget_seconds=args.ec_qos_stall_budget_seconds,
-            stall_min_rate_kbps=args.ec_qos_stall_min_rate_kbps,
-            tier=not args.ec_tier_disable,
-            tier_interval_seconds=args.ec_tier_interval_seconds,
-            tier_host_cache_mb=args.ec_tier_host_cache_mb,
-            tier_half_life_seconds=args.ec_tier_half_life_seconds,
-            tier_promote_ratio=args.ec_tier_promote_ratio,
-            tier_min_residency_seconds=args.ec_tier_min_residency_seconds,
-            tier_bulk_weight=args.ec_tier_bulk_weight,
-        ),
+        ec_serving=ec_serving,
         ec_ingest=IngestConfig(
             enabled=not args.ec_ingest_disable,
             backend=args.ec_ingest_backend,

@@ -471,6 +471,28 @@ class DeviceShardCache:
     each): eviction pressure targets the device that is actually full,
     and the tiering ladder's fit arithmetic follows the same per-device
     vectors (serving/tiering.py).
+
+    Pod scale (r20, -ec.mesh.*): with `global_mesh=True` the mesh spans
+    EVERY process of a multi-controller job (parallel.mesh.
+    global_serving_mesh) and the cache becomes one member of an SPMD
+    group.  Three rules keep the group consistent without any cache-to-
+    cache coordination channel:
+
+      * the mesh/whole placement decision is a pure function of
+        (shard_bytes, mesh_min_shard_bytes) — identical on every host —
+        so one volume can never straddle layouts across hosts; only the
+        least-loaded pick for a whole pin is host-local (a whole pin IS
+        host-local: it lands on one of THIS process's devices);
+      * mesh-placed arrays are staged with
+        `jax.make_array_from_process_local_data`, each host providing
+        exactly its devices' stripes (no survivor byte ever crosses the
+        host boundary at pin time either);
+      * eviction is PARTITIONED: mesh puts evict only mesh-placed
+        victims (pressure from mesh bytes alone) and host-local puts
+        never evict mesh-placed arrays — the mesh-array set stays a
+        pure function of the SPMD put sequence, so no host can evict a
+        lane of an array its peers still serve (a collective against a
+        half-evicted array deadlocks the pod).
     """
 
     def __init__(
@@ -481,6 +503,7 @@ class DeviceShardCache:
         groups: int = rs_tpu.BLOCKDIAG_GROUPS,
         mesh_devices: int | None = None,
         mesh_min_shard_bytes: int = 8 << 20,
+        global_mesh: bool = False,
     ):
         if layout not in LAYOUTS:
             raise ValueError(f"unknown resident layout {layout!r}")
@@ -501,12 +524,32 @@ class DeviceShardCache:
         # first n.  A resolved 1-wide mesh degrades to None (shard_map
         # overhead with no capacity win).
         self.mesh = (
-            mesh_mod.serving_mesh(mesh_devices)
+            (
+                mesh_mod.global_serving_mesh(mesh_devices)
+                if global_mesh
+                else mesh_mod.serving_mesh(mesh_devices)
+            )
             if mesh_devices is not None else None
         )
         self.n_devices = (
             int(self.mesh.devices.size) if self.mesh is not None else 1
         )
+        # pod-scale bookkeeping: which hosts (process indices) the mesh
+        # spans, and which global lane indices are THIS process's.  A
+        # single-process global mesh degrades to n_hosts == 1 and
+        # _local_dev_indices == range(n_devices) — every multiprocess
+        # branch below collapses to the r19 behavior.
+        self.n_hosts = max(1, len(mesh_mod.mesh_hosts(self.mesh)))
+        self.multiprocess = self.n_hosts > 1
+        if self.mesh is not None:
+            me = mesh_mod.process_index()
+            self._local_dev_indices = [
+                i
+                for i, d in enumerate(self.mesh.devices.reshape(-1))
+                if mesh_mod.device_host(d) == me
+            ]
+        else:
+            self._local_dev_indices = [0]
         self.mesh_min_shard_bytes = mesh_min_shard_bytes
         # interleaved stripe width of the lane-sharded layout: stripe c
         # of a padded buffer lives on device c % n (the host permutes
@@ -570,6 +613,11 @@ class DeviceShardCache:
         # accounting the per-device budget/eviction/tiering all share.
         # bytes_used (the pre-r19 scalar every caller reads) is the sum.
         self._dev_bytes: list[int] = [0] * self.n_devices
+        # per-device MESH-PLACED padded bytes only: the pressure signal
+        # of the multiprocess eviction partition (mesh puts may only
+        # evict mesh victims, so their budget check must not see
+        # host-local whole-pins another host knows nothing about)
+        self._mesh_dev_bytes: list[int] = [0] * self.n_devices
         # vid -> "mesh" | device index: where this volume's arrays
         # live, decided at first put (claimed like the pin source so a
         # partially pinned volume can never interleave placements)
@@ -628,10 +676,18 @@ class DeviceShardCache:
             if self.mesh is None:
                 place = 0
             elif shard_bytes >= self.mesh_min_shard_bytes:
+                # deterministic across processes: a pure function of the
+                # shard size, so every host of a pod mesh claims the
+                # same layout for the same volume (host-aware placement
+                # invariant — one volume never straddles layouts)
                 place = "mesh"
             else:
+                # a whole pin is HOST-LOCAL: only this process's lanes
+                # are addressable landing spots (== range(n) when
+                # single-process)
                 place = min(
-                    range(self.n_devices), key=lambda d: self._dev_bytes[d]
+                    self._local_dev_indices,
+                    key=lambda d: self._dev_bytes[d],
                 )
             self._vid_place[vid] = place
         return place
@@ -714,7 +770,7 @@ class DeviceShardCache:
                     place = "mesh"
                 else:
                     place = min(
-                        range(self.n_devices),
+                        self._local_dev_indices,
                         key=lambda i: self._dev_bytes[i],
                     )
         if place == "mesh":
@@ -728,7 +784,7 @@ class DeviceShardCache:
             return NamedSharding(self.mesh, P(mesh_mod.SHARD_AXIS))
         if self.mesh is not None:
             return self.mesh.devices.reshape(-1)[int(place)]
-        return jax.local_devices()[0]
+        return mesh_mod.default_device()
 
     def put(self, vid: int, shard_id: int, data) -> None:
         host = np.frombuffer(bytes(data), dtype=np.uint8) if isinstance(
@@ -766,8 +822,21 @@ class DeviceShardCache:
         # the H2D lands directly on the owning device(s): an explicit
         # sharding/device for every put (mesh puts split host-side and
         # ship each device its stripes; whole pins ship to the claimed
-        # device) — also what graftlint GL115 enforces in this scope
-        arr = jax.device_put(padded, self._device_of(place))
+        # device) — also what graftlint GL115 enforces in this scope.
+        # Multiprocess mesh puts can't device_put against a global
+        # sharding (most of its devices aren't addressable here):
+        # each process provides exactly ITS lanes' contiguous slice of
+        # the owner-major buffer via make_array_from_process_local_data
+        # — the pin path's no-survivor-byte-crosses-hosts rule.
+        if place == "mesh" and self.multiprocess:
+            chunk = padded.size // self.n_devices
+            lo = self._local_dev_indices[0] * chunk
+            hi = (self._local_dev_indices[-1] + 1) * chunk
+            arr = jax.make_array_from_process_local_data(
+                self._device_of(place), padded[lo:hi], (padded.size,)
+            )
+        else:
+            arr = jax.device_put(padded, self._device_of(place))
         key = (vid, shard_id)
         shares = self._shares(place, padded.size)
         budget = self.device_budget
@@ -793,13 +862,23 @@ class DeviceShardCache:
             # forward pass suffices: dropping victims only shrinks the
             # over set, so a key skipped as off-pressure can never
             # match later — rescanning from the LRU head per victim
-            # would cost O(victims x resident keys) under this lock
+            # would cost O(victims x resident keys) under this lock.
+            # Multiprocess eviction PARTITION: a pod cache's mesh-array
+            # set must stay a pure function of the SPMD put sequence
+            # (a lane evicted on one host deadlocks its peers' next
+            # collective), so mesh puts judge pressure by mesh bytes
+            # alone and evict only mesh victims, while host-local puts
+            # may never touch a mesh victim (they break over budget
+            # instead — tiering pressure demotion drains the rest).
+            mesh_only = self.multiprocess and place == "mesh"
+            skip_mesh = self.multiprocess and place != "mesh"
+            pressure = self._mesh_dev_bytes if mesh_only else self._dev_bytes
             lru = iter(list(self._arrays))
             while self._arrays:
                 over = {
                     d
                     for d, share in shares
-                    if self._dev_bytes[d] + share > budget
+                    if pressure[d] + share > budget
                 }
                 if not over:
                     break
@@ -807,9 +886,15 @@ class DeviceShardCache:
                     (
                         k
                         for k in lru
-                        if any(
-                            d in over
-                            for d, _ in self._shares(*self._foot[k])
+                        if (
+                            not (mesh_only and self._foot[k][0] != "mesh")
+                            and not (
+                                skip_mesh and self._foot[k][0] == "mesh"
+                            )
+                            and any(
+                                d in over
+                                for d, _ in self._shares(*self._foot[k])
+                            )
                         )
                     ),
                     None,
@@ -834,6 +919,8 @@ class DeviceShardCache:
             self._vid_counts[vid] = self._vid_counts.get(vid, 0) + 1
             for d, share in shares:
                 self._dev_bytes[d] += share
+                if place == "mesh":
+                    self._mesh_dev_bytes[d] += share
             self._publish_dev_gauges()
 
     def _drop_key_locked(self, key: tuple[int, int]) -> None:
@@ -844,6 +931,8 @@ class DeviceShardCache:
         place, size = self._foot.pop(key)
         for d, share in self._shares(place, size):
             self._dev_bytes[d] -= share
+            if place == "mesh":
+                self._mesh_dev_bytes[d] -= share
         self._vid_counts[key[0]] -= 1
         if not self._vid_counts[key[0]]:
             del self._vid_counts[key[0]]
@@ -1501,12 +1590,13 @@ def _gather_reconstruct_blockdiag(
     jax.jit,
     static_argnames=(
         "mesh", "tile", "groups", "w_true", "kernel", "interpret", "k_true",
+        "replicate_out",
     ),
     donate_argnums=(2,),
 )
 def _sharded_gather_reconstruct(
     a_prep, survivors, vecs, *, mesh, tile, groups, w_true, kernel,
-    interpret, k_true,
+    interpret, k_true, replicate_out=False,
 ):
     """survivors: tuple of [L_pad] u8 shards sharded P("shard") over
     `mesh`; vecs [n_dev, 2, N] int32 (donated), sharded P("shard") —
@@ -1516,7 +1606,14 @@ def _sharded_gather_reconstruct(
     host-side delta trim needs no wider window).  groups > 1 applies
     the block-diagonal system exactly like _gather_reconstruct_blockdiag
     (g contiguous segments per window, per-group row select at
-    jg*w_true + row).  -> [n_dev, N, tile] u8 sharded P("shard")."""
+    jg*w_true + row).  -> [n_dev, N, tile] u8 sharded P("shard").
+
+    `replicate_out` (the multi-controller mode): each lane all-gathers
+    the RESULT rows over the shard axis so the output is fully
+    replicated — same [n_dev, N, tile] global shape, but every process
+    can np.asarray it locally.  Only the small result vecs cross the
+    host boundary; survivor bytes never do (each lane still gathers
+    exclusively from its own resident chunk)."""
     k = len(survivors)
     if k_true is not None and k != k_true:
         raise ValueError(f"{k} survivors but matrix was built for {k_true}")
@@ -1554,6 +1651,11 @@ def _sharded_gather_reconstruct(
                 ]
             )
         sel = segs[0] if groups == 1 else jnp.concatenate(segs, axis=-1)
+        if replicate_out:
+            # [n_dev, N, tile] on EVERY lane: result rows (not survivor
+            # bytes) cross the ICI/DCN once, so each host can read the
+            # whole batch's answers without a second collective
+            return jax.lax.all_gather(sel, mesh_mod.SHARD_AXIS)
         return sel[None]  # [1, N, tile]: this device's chunk of the out
 
     return _shard_map(
@@ -1564,7 +1666,16 @@ def _sharded_gather_reconstruct(
             P(None, None),
             *([P(mesh_mod.SHARD_AXIS)] * k),
         ),
-        out_specs=P(mesh_mod.SHARD_AXIS, None, None),
+        out_specs=(
+            P(None, None, None)
+            if replicate_out
+            else P(mesh_mod.SHARD_AXIS, None, None)
+        ),
+        # the all_gather above really does replicate the output, but
+        # shard_map's static replication checker cannot infer that
+        # through the gather+select pipeline — disable the check only
+        # for the replicated (multi-controller) variant
+        **({"check_rep": False} if replicate_out else {}),
     )(vecs, a_prep, *survivors)
 
 
@@ -1618,18 +1729,31 @@ def _plan(requests: list[tuple[int, int, int]], l_loc: int = 0):
 
 
 @functools.lru_cache(maxsize=64)
-def _prepared_matrix_placed(matrix_bytes, m, k, groups, mesh, place):
+def _prepared_matrix_placed(
+    matrix_bytes, m, k, groups, mesh, place, multiprocess=False
+):
     """Prepared (flat or blockdiag) matrix staged where the placement's
     kernels need it: replicated over the mesh for lane-sharded volumes,
     committed to the owning device for whole-pins — jit refuses to mix
     committed inputs across device sets, so the matrix must follow the
-    survivors.  Cached per (system, placement) like _prepared_matrix."""
+    survivors.  Cached per (system, placement) like _prepared_matrix.
+    A multi-controller mesh can't device_put against the replicated
+    sharding (non-addressable devices): every process holds the same
+    matrix bytes, so each provides its full copy as the process-local
+    data of the replicated global array."""
     if groups > 1:
         base = _prepared_blockdiag_matrix(matrix_bytes, m, k, groups)
     else:
         base = _prepared_matrix(matrix_bytes, m, k)
     if place == "mesh":
-        return jax.device_put(base, NamedSharding(mesh, P(None, None)))
+        sharding = NamedSharding(mesh, P(None, None))
+        if multiprocess:
+            return jax.make_array_from_process_local_data(
+                # graftlint: allow(device-sync): `base` is host numpy —
+                # asarray is a no-copy view, not a device sync
+                sharding, np.asarray(base), base.shape
+            )
+        return jax.device_put(base, sharding)
     return jax.device_put(base, mesh.devices.reshape(-1)[int(place)])
 
 
@@ -1654,7 +1778,8 @@ def _resolve_codec(cache, vid, requests, data_shards, total_shards, layout):
     groups = cache.groups if layout == "blockdiag" else 1
     if cache.mesh is not None:
         a_prep = _prepared_matrix_placed(
-            rmat.tobytes(), *rmat.shape, groups, cache.mesh, place
+            rmat.tobytes(), *rmat.shape, groups, cache.mesh, place,
+            cache.multiprocess,
         )
     elif layout == "blockdiag":
         a_prep = _prepared_blockdiag_matrix(
@@ -1839,7 +1964,9 @@ def hot_shapes(limit: int = 10) -> list[dict]:
                 "survivor_len": surv_len,
                 "interpret": bool(interpret),
                 # 0 = default device; n = lane-sharded over n devices;
-                # ["dev", d] = whole-pin on mesh device d
+                # ["dev", d] = whole-pin on mesh device d; ["pod", n, h]
+                # = lane-sharded over an n-device h-host global mesh;
+                # ["podev", d] = whole-pin on global lane d of a pod
                 "placement": list(place) if isinstance(place, tuple)
                 else place,
                 "dispatches": count,
@@ -1918,7 +2045,11 @@ def _call_key(
     sharded twin, compiled against NamedSharding avals), ("dev", d) = a
     whole-pin on mesh device d (an executable compiled for device 0
     cannot serve arrays committed to device d, so each owning device is
-    its own compiled shape)."""
+    its own compiled shape).  r20 grows the PROCESS dimension:
+    ("pod", n_dev, n_hosts) = lane-sharded over a multi-controller
+    global mesh (compiled with replicated output — a different program
+    than the single-process n-wide twin), ("podev", d) = a whole-pin on
+    GLOBAL lane d of a pod cache."""
     return (
         "fused" if kind == "fused" else kernel,
         groups,
@@ -1937,10 +2068,18 @@ def _call_key(
 def _key_place(cache, place):
     """Map a cache placement to the _call_key placement element: the
     mesh width for lane-sharded vids, ("dev", d) for whole-pins on a
-    mesh cache, 0 for the legacy single-device cache."""
+    mesh cache, 0 for the legacy single-device cache.  A multiprocess
+    (pod) cache gets its own placement atoms — the SPMD executable with
+    replicated output is a different program than the single-process
+    sharded twin, and a pod whole-pin's owning device is a GLOBAL lane
+    index resolved through the global mesh."""
     if place == "mesh":
+        if cache.multiprocess:
+            return ("pod", cache.n_devices, cache.n_hosts)
         return cache.n_devices
     if cache.mesh is not None:
+        if cache.multiprocess:
+            return ("podev", int(place))
         return ("dev", int(place))
     return 0
 
@@ -1970,11 +2109,22 @@ def _compile_shape(key: tuple) -> None:
         family, groups, w_true, tile, fetch, n_bucket, k, a_shape,
         surv_len, interpret, place,
     ) = key
-    if isinstance(place, int) and place >= 2:
-        mesh = mesh_mod.serving_mesh(place)
-        if mesh is None or int(mesh.devices.size) != place:
+    pod = isinstance(place, tuple) and place[0] == "pod"
+    if (isinstance(place, int) and place >= 2) or pod:
+        n_dev = place[1] if pod else place
+        mesh = (
+            mesh_mod.global_serving_mesh(n_dev)
+            if pod
+            else mesh_mod.serving_mesh(n_dev)
+        )
+        if mesh is None or int(mesh.devices.size) != n_dev:
             raise RuntimeError(
-                f"serving mesh of {place} devices unavailable"
+                f"serving mesh of {n_dev} devices unavailable"
+            )
+        if pod and len(mesh_mod.mesh_hosts(mesh)) != place[2]:
+            raise RuntimeError(
+                f"pod mesh spans {len(mesh_mod.mesh_hosts(mesh))} hosts, "
+                f"key compiled for {place[2]}"
             )
         a_aval = jax.ShapeDtypeStruct(
             a_shape, jnp.int8, sharding=NamedSharding(mesh, P(None, None))
@@ -1985,7 +2135,7 @@ def _compile_shape(key: tuple) -> None:
             for _ in range(k)
         )
         vec = jax.ShapeDtypeStruct(
-            (place, 2, n_bucket), jnp.int32,
+            (n_dev, 2, n_bucket), jnp.int32,
             sharding=NamedSharding(mesh, P(mesh_mod.SHARD_AXIS, None, None)),
         )
         with _quiet_donation():
@@ -1993,12 +2143,19 @@ def _compile_shape(key: tuple) -> None:
                 a_aval, survivors, vec, mesh=mesh, tile=tile,
                 groups=groups, w_true=w_true if groups > 1 else 1,
                 kernel=family, interpret=interpret, k_true=k,
+                replicate_out=pod,
             ).compile()
         _register_compiled(key, exe)
         return
     if isinstance(place, tuple):
-        # whole-pin on mesh device place[1]: the avals commit there
-        mesh = mesh_mod.serving_mesh(0)
+        # whole-pin on mesh device place[1]: the avals commit there.
+        # ("podev", d) resolves d through the GLOBAL mesh — a pod
+        # cache's whole-pin lives on one global lane.
+        mesh = (
+            mesh_mod.global_serving_mesh(0)
+            if place[0] == "podev"
+            else mesh_mod.serving_mesh(0)
+        )
         dev = mesh.devices.reshape(-1)[place[1]]
         from jax.sharding import SingleDeviceSharding
 
@@ -2289,7 +2446,7 @@ def _stage_call_vec(kind, cols, pad, arena=None) -> np.ndarray:
 
 def _dispatch_call(
     kind, vec, a_prep, survivors, n_use, w_true, groups, tile,
-    fetch, kernel, interpret, key=None, mesh=None,
+    fetch, kernel, interpret, key=None, mesh=None, replicate_out=False,
 ):
     """Route one packed call's staged vector to its kernel — the single
     home of the fused/xla x flat/blockdiag dispatch, shared by
@@ -2317,6 +2474,7 @@ def _dispatch_call(
                 a_prep, survivors, vec, mesh=mesh, tile=tile,
                 groups=groups, w_true=w_true if groups > 1 else 1,
                 kernel=kernel, interpret=interpret, k_true=n_use,
+                replicate_out=replicate_out,
             )
         if kind == "fused":
             if groups > 1:
@@ -2507,12 +2665,23 @@ def reconstruct_intervals(
                 # requests' slots), committed to the claimed device for
                 # a whole-pin, default device otherwise
                 if kind == "sharded":
-                    dev_vec = jax.device_put(
-                        vec_np,
-                        NamedSharding(
-                            cache.mesh, P(mesh_mod.SHARD_AXIS, None, None)
-                        ),
+                    vec_sharding = NamedSharding(
+                        cache.mesh, P(mesh_mod.SHARD_AXIS, None, None)
                     )
+                    if cache.multiprocess:
+                        # pod mesh: only THIS process's request rows are
+                        # addressable here — ship exactly our lanes'
+                        # slice (the local rows are contiguous in the
+                        # canonical device order).  This is the only
+                        # payload that crosses toward remote lanes, and
+                        # it is request metadata, never survivor bytes.
+                        lo = cache._local_dev_indices[0]
+                        hi = cache._local_dev_indices[-1] + 1
+                        dev_vec = jax.make_array_from_process_local_data(
+                            vec_sharding, vec_np[lo:hi], vec_np.shape
+                        )
+                    else:
+                        dev_vec = jax.device_put(vec_np, vec_sharding)
                 elif cache.mesh is not None:
                     dev_vec = jax.device_put(
                         vec_np, cache.mesh.devices.reshape(-1)[int(place)]
@@ -2538,6 +2707,7 @@ def reconstruct_intervals(
                 kind, dev_vec, a_prep, survivors, len(use), w_true,
                 groups, tile, fetch, kernel, interpret, key=key,
                 mesh=cache.mesh if kind == "sharded" else None,
+                replicate_out=cache.multiprocess,
             )
             # the padded rows ride the wire too: count what the fetch
             # actually moves, not just the useful subset (a sharded
@@ -2617,18 +2787,25 @@ def make_batched_call(
         )
 
         def sharded_thunk():
-            vec = jax.device_put(
-                _stage_call_vec(kind, cols, pad),
-                NamedSharding(
-                    cache.mesh, P(mesh_mod.SHARD_AXIS, None, None)
-                ),
+            vec_np = _stage_call_vec(kind, cols, pad)
+            sharding = NamedSharding(
+                cache.mesh, P(mesh_mod.SHARD_AXIS, None, None)
             )
+            if cache.multiprocess:
+                lo = cache._local_dev_indices[0]
+                hi = cache._local_dev_indices[-1] + 1
+                vec = jax.make_array_from_process_local_data(
+                    sharding, vec_np[lo:hi], vec_np.shape
+                )
+            else:
+                vec = jax.device_put(vec_np, sharding)
             # graftlint: allow(untagged-device-dispatch): bench thunk —
             # the profiler times this measured region externally; ledger
             # tagging inside it would bill bench time to a serving class
             return _dispatch_call(
                 kind, vec, a_prep, survivors, len(use), w_true, groups,
                 tile, fetch, kernel, interpret, key=key, mesh=cache.mesh,
+                replicate_out=cache.multiprocess,
             )
 
         return sharded_thunk

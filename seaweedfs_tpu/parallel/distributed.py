@@ -274,13 +274,10 @@ def _staged_worker_main(argv) -> None:
         logging.getLogger("parallel").debug(
             "gloo CPU collectives unavailable (older jax?): %s", e
         )
-    jax.distributed.initialize(
-        coordinator_address=args.coordinator,
-        num_processes=args.nproc,
-        process_id=args.pid,
-    )
-    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
-    mesh = make_mesh(args.nproc, devices=devs)
+    from . import mesh as mesh_mod
+
+    mesh_mod.initialize_distributed(args.coordinator, args.pid, args.nproc)
+    mesh = make_mesh(args.nproc, devices=mesh_mod.global_devices())
 
     from ..ops import rs_cpu
     from ..ops.rs import RSCodec

@@ -15,6 +15,17 @@ whose arrays were placed with another only if the two resolve to the
 same devices in the same order).  This module is the single home:
 `make_mesh` is the 2-D bulk constructor, `serving_mesh` the cached 1-D
 serving constructor, and both use the same "shard" axis name.
+
+Pod scale (r20): `global_serving_mesh` is the multi-controller sibling
+of `serving_mesh` — same axis name, same width-1 degrade, but spanning
+every process's devices after `jax.distributed.initialize` (wrapped
+here as `initialize_distributed`, a no-op below 2 processes).  The two
+constructors share `_serving_mesh_or_none` so the degrade rule cannot
+drift between them, and every "how many devices / which host" question
+the serving stack asks goes through this module: in multi-controller
+mode `jax.devices()` spans the pod while `jax.local_device_count()` is
+one host's slice, and sizing a budget with the wrong one silently
+computes per-process capacity (graftlint GL118 pins that down).
 """
 from __future__ import annotations
 
@@ -30,10 +41,9 @@ def make_mesh(n_shard: int = 1, n_batch: int | None = None, devices=None):
     """(n_shard, n_batch) device mesh with axes ("shard", "batch") —
     the bulk-plane constructor (encode/rebuild psum over "shard",
     data-parallel over "batch")."""
-    import jax
     from jax.sharding import Mesh
 
-    devices = devices if devices is not None else jax.devices()
+    devices = devices if devices is not None else global_devices()
     if n_batch is None:
         n_batch = len(devices) // n_shard
     devs = np.asarray(devices[: n_shard * n_batch]).reshape(n_shard, n_batch)
@@ -41,10 +51,86 @@ def make_mesh(n_shard: int = 1, n_batch: int | None = None, devices=None):
 
 
 def local_device_count() -> int:
-    """Devices addressable by this process (the serving mesh's ceiling)."""
+    """Devices addressable by this process (the LOCAL serving mesh's
+    ceiling; one host's slice of a pod)."""
     import jax
 
-    return jax.local_device_count()
+    return jax.local_device_count()  # graftlint: allow(process-local-device-assumption): this module IS the helper home
+
+
+def global_device_count() -> int:
+    """Devices across every process of the global mesh (== local count
+    in single-controller mode)."""
+    import jax
+
+    return jax.device_count()  # graftlint: allow(process-local-device-assumption): this module IS the helper home
+
+
+def process_count() -> int:
+    """Processes in the multi-controller job (1 = single-controller)."""
+    import jax
+
+    return jax.process_count()
+
+
+def process_index() -> int:
+    """This process's rank in the multi-controller job (0 when single)."""
+    import jax
+
+    return jax.process_index()
+
+
+def local_devices():
+    """This process's addressable devices, in jax's local order."""
+    import jax
+
+    return list(jax.local_devices())  # graftlint: allow(process-local-device-assumption): this module IS the helper home
+
+
+def global_devices():
+    """Every process's devices in the CANONICAL pod order — sorted by
+    (process_index, id) so all processes of a multi-controller job
+    agree on lane numbering (jax.devices() order is backend-dependent
+    across processes; an owner-major residency layout computed against
+    different orders on different hosts would scatter a volume's
+    stripes inconsistently)."""
+    import jax
+
+    return sorted(jax.devices(), key=lambda d: (d.process_index, d.id))  # graftlint: allow(process-local-device-assumption): this module IS the helper home
+
+
+def default_device():
+    """The single-device landing spot (first local device) — the
+    whole-volume / non-mesh placement target."""
+    import jax
+
+    return jax.local_devices()[0]  # graftlint: allow(process-local-device-assumption): this module IS the helper home
+
+
+def device_host(dev) -> int:
+    """Failure-domain id of a mesh device: the owning process index.
+    One volume-server process per host in multi-controller mode, so
+    process == host == the unit that dies together."""
+    return int(getattr(dev, "process_index", 0))
+
+
+def mesh_hosts(mesh) -> tuple[int, ...]:
+    """Sorted distinct host (process) ids a serving mesh spans."""
+    if mesh is None:
+        return ()
+    return tuple(sorted({device_host(d) for d in mesh.devices.flat}))
+
+
+def _serving_mesh_or_none(devs):
+    """The ONE width-1 degrade rule both serving-mesh constructors (and
+    the bulk `make_mesh` wrapper below) share: a 1-wide mesh only adds
+    shard_map overhead over the plain single-device path, so anything
+    that resolves to fewer than 2 devices serves un-meshed (None)."""
+    from jax.sharding import Mesh
+
+    if len(devs) < 2:
+        return None
+    return Mesh(np.asarray(list(devs)), axis_names=(SHARD_AXIS,))
 
 
 @functools.lru_cache(maxsize=8)
@@ -56,14 +142,47 @@ def serving_mesh(n_devices: int = 0):
     jax hashes meshes by identity-equivalent content, and handing the
     compile path a different-but-equal mesh would still fracture the
     jit cache.  Returns None when the resolved mesh would be a single
-    device — a 1-wide mesh only adds shard_map overhead over the plain
-    single-device path."""
-    import jax
-    from jax.sharding import Mesh
-
-    devs = jax.local_devices()
+    device (`_serving_mesh_or_none`)."""
+    devs = local_devices()
     if n_devices > 0:
         devs = devs[:n_devices]
-    if len(devs) < 2:
-        return None
-    return Mesh(np.asarray(devs), axis_names=(SHARD_AXIS,))
+    return _serving_mesh_or_none(devs)
+
+
+@functools.lru_cache(maxsize=8)
+def global_serving_mesh(n_devices: int = 0):
+    """Cached 1-D serving mesh over EVERY process's devices in canonical
+    pod order (`global_devices`), same ("shard",) axis and same width-1
+    degrade as `serving_mesh`.  In a single-process job this resolves
+    to exactly the devices `serving_mesh` would pick (degrade
+    equality: nothing changes for existing deployments); in a
+    multi-controller job it is the pod-wide residency mesh every
+    process must construct IDENTICALLY for the SPMD reconstruct
+    programs to line up."""
+    devs = global_devices()
+    if n_devices > 0:
+        devs = devs[:n_devices]
+    return _serving_mesh_or_none(devs)
+
+
+def initialize_distributed(
+    coordinator: str, process_id: int, n_processes: int
+) -> bool:
+    """Join the multi-controller job: `jax.distributed.initialize`
+    against `coordinator` ("host:port") as process `process_id` of
+    `n_processes`.  No-op (returns False) when `n_processes` <= 1 —
+    single-process deployments never pay a coordinator handshake and
+    `global_serving_mesh` degrades to the local mesh.  Must run before
+    the first jax backend touch in the process; the caller validates
+    the config (ServingConfig.validated) so a bad coordinator string
+    fast-fails at startup, not here mid-handshake."""
+    if n_processes <= 1:
+        return False
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=n_processes,
+        process_id=process_id,
+    )
+    return True

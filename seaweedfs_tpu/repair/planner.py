@@ -21,7 +21,14 @@ rules unit-testable without a cluster:
     while it answers;
   * volumes where even fresh + stale copies can't reach DATA_SHARDS
     are flagged unrecoverable and NOT queued: burning repair attempts
-    on them would starve volumes that can still be saved.
+    on them would starve volumes that can still be saved;
+  * mesh pods are a failure domain (r20): members of one
+    multi-controller pod serve a single SPMD residency mesh and
+    degrade together, so a volume whose healthy survivors have
+    collapsed into ONE pod is one correlated host failure from loss —
+    it is escalated to critical even when the raw healthy count still
+    shows slack (`node_pods` maps holder url -> pod id; clusters
+    without pods pass nothing and plan exactly as before).
 """
 from __future__ import annotations
 
@@ -51,6 +58,10 @@ class RepairJob:
     healthy: int = 0
     critical: bool = False  # one more loss = data loss
     reason: str = "shard_loss"  # shard_loss | corrupt | stale_node
+    # every healthy survivor sits inside ONE mesh pod: a single
+    # correlated host failure (any pod member dying) is data loss, so
+    # the job escalates to critical regardless of raw healthy count
+    pod_exposed: bool = False
 
     def sort_key(self) -> tuple:
         # critical first; then most missing; vid tiebreak for determinism
@@ -69,12 +80,16 @@ def plan(
     collections: dict[int, str] | None = None,
     corrupt: dict[int, dict[int, str]] | None = None,
     stale_nodes: set[str] | frozenset[str] = frozenset(),
+    node_pods: dict[str, str] | None = None,
 ) -> PlanResult:
     """`shard_map`: vid -> {shard_id -> holder url} (the master's EC
     census); `corrupt`: vid -> {shard_id -> holder url} scrub verdicts;
-    `stale_nodes`: telemetry-stale holder urls."""
+    `stale_nodes`: telemetry-stale holder urls; `node_pods`: holder
+    url -> mesh-pod id ("", absent = not in a pod) — the r20 host
+    failure domain."""
     collections = collections or {}
     corrupt = corrupt or {}
+    node_pods = node_pods or {}
     jobs: list[RepairJob] = []
     dead: list[RepairJob] = []
     healthy_vids: list[int] = []
@@ -101,6 +116,13 @@ def plan(
             reason = "stale_node"
         else:
             reason = "shard_loss"
+        # pod-exposure check: the pods holding the healthy survivors.
+        # All of them inside one non-"" pod = one correlated host
+        # failure from loss (pod members degrade together)
+        healthy_pods = {node_pods.get(shards[sid], "") for sid in healthy}
+        pod_exposed = bool(
+            healthy and healthy_pods != {""} and len(healthy_pods) == 1
+        )
         job = RepairJob(
             vid=vid,
             collection=collections.get(vid, ""),
@@ -108,8 +130,9 @@ def plan(
             corrupt=bad,
             rescue=dict(sorted(stale_held.items())),
             healthy=len(healthy),
-            critical=len(healthy) <= DATA_SHARDS,
+            critical=len(healthy) <= DATA_SHARDS or pod_exposed,
             reason=reason,
+            pod_exposed=pod_exposed,
         )
         if len(healthy) + len(stale_held) < DATA_SHARDS:
             dead.append(job)

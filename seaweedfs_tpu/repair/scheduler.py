@@ -169,6 +169,13 @@ class RepairScheduler:
             collections=collections,
             corrupt={k: dict(v) for k, v in self._corrupt.items()},
             stale_nodes=stale,
+            # mesh pods as failure domains (r20): survivors collapsed
+            # into one pod escalate to critical in the planner
+            node_pods={
+                n.url: n.mesh_pod
+                for n in self.master.topo.data_nodes()
+                if n.mesh_pod
+            },
         )
         self._note_plan(result, now)
         if (

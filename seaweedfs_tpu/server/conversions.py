@@ -74,4 +74,7 @@ def node_to_location(n: DataNode) -> master_pb2.Location:
         public_url=n.public_url,
         grpc_port=n.grpc_port,
         data_center=n.rack.data_center.name if n.rack else "",
+        # r20: holder's multi-controller pod — degraded-read gathers
+        # hedge pod-anti-affine (pod members stall together)
+        mesh_pod=getattr(n, "mesh_pod", ""),
     )

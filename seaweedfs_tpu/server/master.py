@@ -448,12 +448,19 @@ class MasterServer:
                         hb.grpc_port,
                     )
                     log.info("volume server joined: %s", node.url)
+                # pod membership (r20, getattr-guarded for pre-r20
+                # servers): members of one jax.distributed pod serve a
+                # single SPMD residency mesh and degrade together, so
+                # the topology tree treats the pod id as a rack-like
+                # failure domain (placement + repair planning)
+                node.mesh_pod = str(getattr(hb, "mesh_pod", ""))
                 stats.MASTER_RECEIVED_HEARTBEATS.labels(type="total").inc()
                 # every pulse refreshes freshness; the payload (absent on
                 # pre-telemetry servers) feeds the cluster health plane
                 self.telemetry.observe(
                     node.url,
                     hb.telemetry if hb.HasField("telemetry") else None,
+                    mesh_pod=node.mesh_pod,
                 )
                 if hb.volumes or hb.has_no_volumes or hb.ec_shards or hb.has_no_ec_shards:
                     new_v, del_v, new_ec, del_ec = self.topo.sync_node(

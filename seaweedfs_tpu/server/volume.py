@@ -176,6 +176,7 @@ class VolumeServer:
         # immediately, and a warm racing a late layout assignment would
         # burn its 20-40s/shape budget compiling the wrong ladder
         ec_serving = (ec_serving or ServingConfig()).validated()
+        self.ec_serving = ec_serving
         device_cache = None
         if ec_device_cache_mb > 0:
             from ..ops.rs_resident import DeviceShardCache
@@ -190,6 +191,11 @@ class VolumeServer:
                     ec_serving.mesh_devices if ec_serving.mesh else None
                 ),
                 mesh_min_shard_bytes=ec_serving.mesh_min_shard_mb << 20,
+                # multi-controller pod mesh (-ec.mesh.*): residency
+                # spans every process's devices; the caller already ran
+                # parallel.mesh.initialize_distributed before the first
+                # jax touch (command/volume.py)
+                global_mesh=ec_serving.multiprocess,
             )
             device_cache.pipeline.set_slots(ec_serving.pipeline_slots)
             # -ec.serving.aot.disable: inline compiles instead of the
@@ -238,6 +244,9 @@ class VolumeServer:
         self.download_limiter = ByteLimiter(concurrent_download_limit_mb << 20)
         self._pending_compacts: dict[int, tuple[str, str, int, str | None]] = {}
         self._ec_locations: dict[int, tuple[float, dict[int, list[str]]]] = {}
+        # peer grpc addr -> mesh pod id, refreshed with _ec_locations:
+        # the hedged gather's pod anti-affinity signal (r20)
+        self._ec_location_pods: dict[int, dict[str, str]] = {}
         self.ec_dispatcher = EcReadDispatcher(
             self.store, self._remote_shard_reader, ec_serving
         )
@@ -712,6 +721,10 @@ class VolumeServer:
         # its own receive time for the per-node skew estimate the
         # tail-forensics assembler reconciles span timestamps with
         tel.wall_clock_unix_ms = int(time.time() * 1e3)
+        # pod rank (r20): which member of the multi-controller mesh this
+        # node is — cluster.health keys its per-host pod rows on it
+        tel.mesh_process_id = self.ec_serving.mesh_process_id
+        tel.mesh_process_count = self.ec_serving.mesh_process_count
         cache = self.store.ec_device_cache
         if cache is not None:
             n_resident, n_bytes = cache.stats()
@@ -903,6 +916,13 @@ class VolumeServer:
             ip=self.ip, port=self.port,
             public_url=self.store.public_url, grpc_port=self.grpc_port,
             data_center=self.data_center, rack=self.rack,
+            # pod membership: the coordinator address IS the pod id —
+            # every member of one jax.distributed job shares it, and the
+            # master treats it as a rack-like failure domain
+            mesh_pod=(
+                self.ec_serving.mesh_coordinator
+                if self.ec_serving.multiprocess else ""
+            ),
         )
         hb.telemetry.CopyFrom(self._build_telemetry())
         return hb
@@ -1753,7 +1773,18 @@ class VolumeServer:
                 iter(self._cached_ec_locations(vid).get(shard_id, ())), None
             )
 
+        def pod_of(shard_id: int):
+            # the primary holder's mesh pod ("" = not in a pod): the
+            # hedged gather prefers spares OUTSIDE a slow peer's pod —
+            # pod members serve one SPMD mesh and stall together, so a
+            # same-pod hedge buys nothing (r20)
+            peer = peer_of(shard_id)
+            if peer is None:
+                return ""
+            return self._ec_location_pods.get(vid, {}).get(peer, "")
+
         read.peer_of = peer_of
+        read.pod_of = pod_of
         return read
 
     def _cached_ec_locations(self, vid: int) -> dict[int, list[str]]:
@@ -1780,11 +1811,18 @@ class VolumeServer:
                     master_pb2.LookupEcVolumeRequest(volume_id=vid),
                     timeout=_EC_LOOKUP_TIMEOUT_S,
                 )
+                pods: dict[str, str] = {}
                 for e in resp.shard_id_locations:
-                    locs[e.shard_id] = [
-                        f"{l.url.rsplit(':', 1)[0]}:{l.grpc_port}" for l in e.locations
-                        if l.url != self.url
-                    ]
+                    addrs = []
+                    for l in e.locations:
+                        if l.url == self.url:
+                            continue
+                        addr = f"{l.url.rsplit(':', 1)[0]}:{l.grpc_port}"
+                        addrs.append(addr)
+                        if l.mesh_pod:
+                            pods[addr] = l.mesh_pod
+                    locs[e.shard_id] = addrs
+                self._ec_location_pods[vid] = pods
             except grpc.RpcError:
                 # unreachable master: keep serving the STALE snapshot
                 # rather than poisoning the cache with an empty map for

@@ -70,6 +70,21 @@ class ServingConfig:
     # (-ec.serving.mesh.minShardMB): spreading a tiny volume across the
     # mesh buys no capacity and pays cross-device dispatch per batch
     mesh_min_shard_mb: int = 8
+    # multi-controller pod mesh (-ec.mesh.coordinator /
+    # -ec.mesh.processId / -ec.mesh.processCount): when processCount > 1
+    # this volume server joins a single global mesh via
+    # jax.distributed.initialize(coordinator, ...) as process
+    # `processId`, and residency lane-shards across EVERY process's
+    # devices (parallel.mesh.global_serving_mesh) instead of this
+    # host's slice.  processCount == 1 (the default) never touches the
+    # coordinator and degrades to the local serving mesh — nothing
+    # changes for existing single-process deployments.  Validation is
+    # startup-time (validated() below): a bad coordinator string or an
+    # out-of-range processId must fail the process before it takes
+    # traffic, not the first dispatch.
+    mesh_coordinator: str = ""
+    mesh_process_id: int = 0
+    mesh_process_count: int = 1
     # zero-copy response writes (-ec.serving.zerocopy.disable): needle
     # payloads stay memoryviews over the reconstruct/pread buffers all
     # the way into the aiohttp body write; False restores the legacy
@@ -145,6 +160,12 @@ class ServingConfig:
     def max_wait_s(self) -> float:
         return self.max_wait_us / 1e6
 
+    @property
+    def multiprocess(self) -> bool:
+        """True when this server is one member of a multi-controller
+        pod mesh (residency spans hosts)."""
+        return self.mesh_process_count > 1
+
     def stall_budget_for(self, nbytes: int) -> float:
         """Total seconds a streamed response of `nbytes` may take before
         the dribbling client is disconnected (0 = unbounded)."""
@@ -173,6 +194,26 @@ class ServingConfig:
             raise ValueError("mesh_devices must be >= 0 (0 = all local)")
         if self.mesh_min_shard_mb < 0:
             raise ValueError("mesh_min_shard_mb must be >= 0")
+        if self.mesh_process_count < 1:
+            raise ValueError("mesh_process_count must be >= 1")
+        if self.mesh_process_count > 1:
+            # multi-controller: the coordinator handshake happens at
+            # startup, so a malformed rendezvous config must die HERE
+            host, sep, port = self.mesh_coordinator.rpartition(":")
+            if not (sep and host and port.isdigit() and 0 < int(port) < 65536):
+                raise ValueError(
+                    "mesh_coordinator must be host:port when "
+                    f"mesh_process_count > 1 (got {self.mesh_coordinator!r})"
+                )
+            if not 0 <= self.mesh_process_id < self.mesh_process_count:
+                raise ValueError(
+                    f"mesh_process_id {self.mesh_process_id} out of range "
+                    f"for mesh_process_count {self.mesh_process_count}"
+                )
+        elif self.mesh_process_id != 0:
+            raise ValueError(
+                "mesh_process_id must be 0 when mesh_process_count is 1"
+            )
         if self.qos_interactive_queue < 1 or self.qos_bulk_queue < 1:
             raise ValueError("qos tier queue budgets must be >= 1")
         if (

@@ -561,6 +561,20 @@ class TieringController:
                 >= cfg.tier_min_residency_seconds
             )
 
+        # r20 host-aware ladder: on a multi-process mesh, mesh-sharded
+        # volumes are SPMD-coupled — every pod member holds one lane of
+        # the same global array, so a heat-driven LOCAL demotion (heat
+        # is per-host read traffic, which differs across members) would
+        # strand the other hosts' lanes and deadlock the next
+        # collective.  Those vids demote only through the deterministic
+        # put-order eviction partition inside DeviceShardCache; the
+        # ladder keeps full authority over whole-device pins and every
+        # volume in single-process mode.
+        multiproc = bool(getattr(cache, "multiprocess", False))
+
+        def demotable(vid: int) -> bool:
+            return not (multiproc and cache.vid_sharded(vid))
+
         # 1. PRESSURE: any device over ITS budget -> demote coldest
         # residents actually HOLDING bytes on the fullest over-budget
         # device (r19 per-device accounting: demoting a volume parked
@@ -584,7 +598,9 @@ class TieringController:
             def on_dev(v: int) -> bool:
                 return bool(foot.get(v, {}).get(dev))
 
-            pool = [v for v in hbm_residents() if on_dev(v)]
+            pool = [
+                v for v in hbm_residents() if on_dev(v) and demotable(v)
+            ]
             if not pool:
                 # partial shard sets (mount pins racing the LRU, or a
                 # budget shrink mid-pin) hold device bytes without ever
@@ -594,7 +610,9 @@ class TieringController:
                 pool = [
                     v
                     for v in vols
-                    if cache.resident_count(v) > 0 and on_dev(v)
+                    if cache.resident_count(v) > 0
+                    and on_dev(v)
+                    and demotable(v)
                 ]
             if not pool:
                 break
@@ -664,7 +682,7 @@ class TieringController:
             # one locked footprint snapshot for the whole victim scan
             foot = cache.device_bytes_by_vid()
             for v in sorted(
-                (v for v in hbm_residents() if age_ok(v)),
+                (v for v in hbm_residents() if age_ok(v) and demotable(v)),
                 key=lambda v: (heat.get(v, 0.0), v),
             ):
                 if h < cfg.tier_promote_ratio * max(

@@ -26,6 +26,9 @@ class TopoNode:
     volumes: list[dict] = field(default_factory=list)
     ec_shards: list[dict] = field(default_factory=list)
     max_volume_counts: dict = field(default_factory=dict)
+    # r20 host failure domain: the node's multi-controller pod id
+    # ("" = not in a pod) — ec.balance/repair spread across pods
+    mesh_pod: str = ""
 
     @property
     def grpc_address(self) -> str:
@@ -71,6 +74,7 @@ def topo_nodes_from_info(info: dict) -> list[TopoNode]:
                         volumes=n.get("volumes", []),
                         ec_shards=n.get("ec_shards", []),
                         max_volume_counts=n.get("max_volume_counts", {}),
+                        mesh_pod=n.get("mesh_pod", ""),
                     )
                 )
     return nodes

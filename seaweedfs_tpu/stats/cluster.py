@@ -257,6 +257,12 @@ class NodeTelemetry:
     # from pb wall_clock_unix_ms — the tail-forensics assembler's span
     # reconciliation input; None until a clock-stamped pulse arrives
     clock_skew_ms: float | None = None
+    # multi-controller pod membership (r20): the pod id shared by every
+    # member of one jax.distributed job ("" = single-process server)
+    # and this member's rank/count — the per-host pod rows of health()
+    mesh_pod: str = ""
+    mesh_process_id: int = 0
+    mesh_process_count: int = 1
 
     def to_dict(self, now: float, stale_after: float) -> dict[str, Any]:
         age = now - self.last_seen
@@ -266,6 +272,14 @@ class NodeTelemetry:
             "connected": self.connected,
             "telemetry": self.has_payload,
         }
+        if self.mesh_pod:
+            # per-host pod row: which host (process) of which pod this
+            # node is — health()'s pods table aggregates across nodes
+            d["mesh"] = {
+                "pod": self.mesh_pod,
+                "process_id": self.mesh_process_id,
+                "process_count": self.mesh_process_count,
+            }
         if self.has_payload:
             if self.clock_skew_ms is not None:
                 d["clock_skew_ms"] = round(self.clock_skew_ms, 3)
@@ -375,18 +389,27 @@ class ClusterTelemetry:
         node_url: str,
         tel: Any | None = None,
         now: float | None = None,
+        mesh_pod: str = "",
     ) -> None:
         """Record one heartbeat from `node_url`; `tel` is the pb
         VolumeServerTelemetry (None for pre-telemetry servers — the
-        pulse still refreshes freshness)."""
+        pulse still refreshes freshness).  `mesh_pod` rides the
+        Heartbeat envelope, not the telemetry payload, so it updates
+        even on identity-only pulses."""
         now = time.time() if now is None else now
         with self._lock:
             nt = self._nodes.setdefault(node_url, NodeTelemetry())
             nt.last_seen = now
             nt.connected = True
+            nt.mesh_pod = mesh_pod
             if tel is None:
                 return
             nt.has_payload = True
+            # getattr-guarded: pre-r20 servers lack the pod-rank fields
+            nt.mesh_process_id = int(getattr(tel, "mesh_process_id", 0))
+            nt.mesh_process_count = max(
+                1, int(getattr(tel, "mesh_process_count", 1))
+            )
             nt.device_budget_bytes = tel.device_budget_bytes
             nt.device_used_bytes = tel.device_used_bytes
             nt.device_resident_shards = tel.device_resident_shards
@@ -735,6 +758,34 @@ class ClusterTelemetry:
         for url, nt in sorted(nodes.items()):
             for vid, n in nt.resident_by_volume.items():
                 residency.setdefault(str(vid), {})[url] = n
+        # r20 pod table: multi-controller pods as first-class rows.  A
+        # pod is "degraded" when fewer live members than its declared
+        # process_count — one member down stalls the whole SPMD mesh,
+        # so this is the signal the repair plane (and the kill bench
+        # phase) keys on.
+        pods: dict[str, dict[str, Any]] = {}
+        for url, nt in sorted(nodes.items()):
+            if not nt.mesh_pod:
+                continue
+            pod = pods.setdefault(
+                nt.mesh_pod,
+                {"members": [], "process_count": 0, "live_members": 0},
+            )
+            stale = self._stale(nt, now)
+            pod["members"].append(
+                {
+                    "url": url,
+                    "process_id": nt.mesh_process_id,
+                    "stale": stale,
+                }
+            )
+            pod["process_count"] = max(
+                pod["process_count"], nt.mesh_process_count
+            )
+            if not stale:
+                pod["live_members"] += 1
+        for pod in pods.values():
+            pod["degraded"] = pod["live_members"] < pod["process_count"]
         stage_docs: dict[str, dict[str, Any]] = {}
         for stage, (buckets, count, sum_s) in sorted(stages.items()):
             p50 = quantile_from_buckets(buckets, 0.50)
@@ -754,6 +805,10 @@ class ClusterTelemetry:
             "stale_after_seconds": self.stale_after,
             "bucket_edges_seconds": list(STAGE_SECONDS_BUCKETS),
             "nodes": node_docs,
+            # r20: pod id -> member rows; absent key meaning "no
+            # multi-controller pods in this cluster" keeps single
+            # process health docs byte-identical to r19
+            **({"pods": pods} if pods else {}),
             "cluster": {
                 "nodes_total": len(nodes),
                 "nodes_stale": sum(

@@ -518,6 +518,7 @@ class EcVolume:
                     pool=_gather_pool(),
                     validate=lambda b: b is not None and len(b) == size,
                     peer_of=getattr(remote_read, "peer_of", None),
+                    pod_of=getattr(remote_read, "pod_of", None),
                     what=f"ec {self.id} survivor gather",
                 )
                 n_remote = res.sent

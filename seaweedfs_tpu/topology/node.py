@@ -59,6 +59,13 @@ class DataNode:
         self.ec_shards: dict[int, EcShardInfo] = {}
         self.max_volume_counts: dict[str, int] = {}
         self.last_seen = time.time()
+        # multi-controller pod membership (r20): the coordinator address
+        # every member of one jax.distributed pod shares ("" = not in a
+        # pod).  A rack-like failure domain: pod members serve a single
+        # SPMD residency mesh and degrade together when one dies, so
+        # placement and repair must not treat two pod members as
+        # independent the way two arbitrary nodes are.
+        self.mesh_pod = ""
 
     @property
     def url(self) -> str:

@@ -333,6 +333,9 @@ class Topology:
                                         for s in n.ec_shards.values()
                                     ],
                                     "max_volume_counts": n.max_volume_counts,
+                                    # r20 host failure domain ("" = not
+                                    # in a multi-controller pod)
+                                    "mesh_pod": n.mesh_pod,
                                 }
                                 for n in r.data_nodes()
                             ],

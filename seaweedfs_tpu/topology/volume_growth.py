@@ -44,6 +44,21 @@ def target_count_per_request(rp: t.ReplicaPlacement) -> int:
     return 1
 
 
+def _avoid_pods(candidates: list[DataNode], chosen: list[DataNode]):
+    """Host-aware replica spreading (r20): drop candidates sharing a
+    mesh pod with an already-chosen replica — pod members serve one
+    SPMD residency mesh and degrade together, so two replicas inside
+    one pod are barely more durable than one.  Falls back to the full
+    candidate list when the filter would empty it (availability wins
+    over strict domain separation, and clusters without pods — every
+    mesh_pod "" — are untouched)."""
+    taken = {n.mesh_pod for n in chosen if n.mesh_pod}
+    if not taken:
+        return candidates
+    spread = [n for n in candidates if n.mesh_pod not in taken]
+    return spread or candidates
+
+
 class VolumeGrowth:
     def __init__(self, rng: random.Random | None = None):
         self.rng = rng or random.Random()
@@ -125,7 +140,8 @@ class VolumeGrowth:
             raise NoFreeSpace(f"no node in {main_dc.name}/{main_rack.name} has space")
 
         servers = [main_node]
-        # same-rack replicas: other nodes in the main rack
+        # same-rack replicas: other nodes in the main rack, spread
+        # across mesh pods where possible (pod members fail together)
         others = [
             n
             for n in main_rack.data_nodes()
@@ -133,7 +149,16 @@ class VolumeGrowth:
         ]
         if len(others) < rp.same_rack:
             raise NoFreeSpace(f"rack {main_rack.name}: need {rp.same_rack} more nodes")
-        servers += self._sample(others, rp.same_rack, lambda n: n.free_slots(dt))
+        for _ in range(rp.same_rack):
+            pick = self._pick(
+                _avoid_pods(others, servers), lambda n: n.free_slots(dt)
+            )
+            if pick is None:
+                raise NoFreeSpace(
+                    f"rack {main_rack.name}: need {rp.same_rack} more nodes"
+                )
+            servers.append(pick)
+            others.remove(pick)
 
         # different-rack replicas: one node from each other rack
         other_racks = [
@@ -145,7 +170,10 @@ class VolumeGrowth:
             raise NoFreeSpace(f"dc {main_dc.name}: need {rp.diff_rack} more racks")
         for r in self._sample(other_racks, rp.diff_rack, lambda r: r.free_slots(dt)):
             node = self._pick(
-                [n for n in r.data_nodes() if n.free_slots(dt) >= 1],
+                _avoid_pods(
+                    [n for n in r.data_nodes() if n.free_slots(dt) >= 1],
+                    servers,
+                ),
                 lambda n: n.free_slots(dt),
             )
             if node is None:
@@ -162,7 +190,10 @@ class VolumeGrowth:
             raise NoFreeSpace(f"need {rp.diff_dc} more data centers")
         for dc in self._sample(other_dcs, rp.diff_dc, lambda d: d.free_slots(dt)):
             node = self._pick(
-                [n for n in dc.data_nodes() if n.free_slots(dt) >= 1],
+                _avoid_pods(
+                    [n for n in dc.data_nodes() if n.free_slots(dt) >= 1],
+                    servers,
+                ),
                 lambda n: n.free_slots(dt),
             )
             if node is None:

@@ -569,6 +569,7 @@ def hedged_gather(
     pool,
     validate: Callable[[Optional[bytes]], bool] | None = None,
     peer_of: Callable[[int], Any] | None = None,
+    pod_of: Callable[[int], Any] | None = None,
     deadline_s: float | None = None,
     what: str = "",
 ) -> GatherResult:
@@ -610,6 +611,7 @@ def hedged_gather(
         validate = lambda b: b is not None  # noqa: E731
 
     key_of = peer_of if peer_of is not None else (lambda sid: None)
+    pod_key = pod_of if pod_of is not None else (lambda sid: "")
 
     def _mean(sid: int) -> float:
         m = PEER_LATENCY.mean_s(key_of(sid))
@@ -617,6 +619,23 @@ def hedged_gather(
 
     ranked = sorted(candidates, key=_mean)  # cheapest first, stable
     spares = ranked[need:]
+
+    def _pop_spare(avoid_sid: int | None = None) -> int:
+        """Next spare, preferring one whose holder sits OUTSIDE the
+        pod of `avoid_sid`'s holder (r20): mesh-pod members serve one
+        SPMD residency mesh in lockstep and stall together, so a hedge
+        or replacement routed back into the slow peer's own pod is
+        likely to hit the very stall it exists to route around.
+        Cheapest-first order is preserved within the preference, and
+        with no pod information (pod_of absent / "" pods) this is
+        exactly the pre-r20 spares.pop(0)."""
+        if avoid_sid is not None and len(spares) > 1:
+            avoid = pod_key(avoid_sid)
+            if avoid:
+                for i, sid in enumerate(spares):
+                    if pod_key(sid) != avoid:
+                        return spares.pop(i)
+        return spares.pop(0)
     ctx = contextvars.copy_context()
     # per-fetch budget: each submitted fetch runs under its own tight
     # deadline scope (never extending the ambient one), so a HUNG peer
@@ -755,7 +774,7 @@ def hedged_gather(
                 and CONFIG.hedge_budget_pct > 0
                 and HEDGE_BUDGET.take(1.0)
             ):
-                h = _Fetch(spares.pop(0), is_hedge=True, trigger=p)
+                h = _Fetch(_pop_spare(p.sid), is_hedge=True, trigger=p)
                 p.hedged = True
                 pending.append(h)
                 res.sent += 1
@@ -778,7 +797,7 @@ def hedged_gather(
                     # reorder the sick peer out of their primary sets
                     # within one patience cycle
                     PEER_LATENCY.observe(p.peer, age)
-                pending.append(_Fetch(spares.pop(0)))
+                pending.append(_Fetch(_pop_spare(p.sid)))
                 res.sent += 1
                 HEDGE_BUDGET.deposit(CONFIG.hedge_budget_pct / 100.0)
     # losers: cancel what never started; abandon what is running (its

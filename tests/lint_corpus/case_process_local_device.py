@@ -1,0 +1,36 @@
+"""GL118 seed: raw jax device enumeration sizing a mesh/budget.
+
+Three violations; the mesh-helper forms below them must stay clean."""
+import jax
+
+
+def mesh_width_from_raw_devices():
+    return len(jax.devices())  # GL118: pod-global on a multi-process mesh
+
+
+def budget_from_local_count(total_bytes):
+    return total_bytes // jax.local_device_count()  # GL118: one host only
+
+
+def lane_pick_from_local_devices(i):
+    return jax.local_devices()[i]  # GL118: raw enumeration, local order
+
+
+def mesh_width_via_helpers():
+    from seaweedfs_tpu.parallel import mesh
+
+    return mesh.global_device_count()  # clean: the sanctioned route
+
+
+def bare_imported_name_is_not_flagged():
+    # the parallel.mesh helpers SHARE these names — only the dotted
+    # jax. form is raw enumeration
+    from seaweedfs_tpu.parallel.mesh import local_devices
+
+    return local_devices()  # clean
+
+
+def waived_raw_enumeration():
+    # graftlint: allow(process-local-device-assumption): CI probe — a
+    # deliberate raw count for the single-process smoke banner
+    return jax.device_count()

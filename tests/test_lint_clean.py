@@ -97,6 +97,7 @@ def test_every_rule_fires_on_its_corpus_fixture(corpus_findings):
         ("GL115", "case_unsharded_device_put"),
         ("GL116", "case_untagged_dispatch"),
         ("GL117", "case_stage_drift"),
+        ("GL118", "case_process_local_device"),
     ],
 )
 def test_rule_fires_in_the_named_case_file(
@@ -131,6 +132,7 @@ def test_seeded_counts_are_exact(corpus_findings):
         "GL115": 3,  # bare put, imported-name put, loop-staged put
         "GL116": 3,  # bare dispatch, bare bulk leg, untagged closure
         "GL117": 1,  # the declared-but-never-recorded ghost stage
+        "GL118": 3,  # raw devices len, local_count budget, local pick
     }, by_rule
 
 

@@ -387,6 +387,11 @@ def test_breaker_open_defers_repair_cycle(tmp_path):
             br.cooldown_s = 4.0  # hold it open past a few pulses
             await asyncio.sleep(1.5)  # telemetry carries the state
             assert cluster.master.telemetry.breakers_open() >= 1
+            # baseline, not assumed 0: a loaded full-suite box can
+            # delay heartbeats past the staleness window during spin-up,
+            # and the resulting spurious stale-node repair may complete
+            # BEFORE the breaker trips — only post-trip launches matter
+            completed_before = cluster.master.repair.totals["completed"]
 
             chaos = ChaosInjector(cluster)
             victim_idx = next(
@@ -405,7 +410,7 @@ def test_breaker_open_defers_repair_cycle(tmp_path):
             # the shed is measurable: cycles deferred, nothing launched
             # while the breaker was open
             assert sched.totals["backoff_breaker"] >= 1
-            assert sched.totals["completed"] == 0
+            assert sched.totals["completed"] == completed_before
 
             # once the breaker closes, repair proceeds to convergence
             br.record_success()
@@ -414,7 +419,7 @@ def test_breaker_open_defers_repair_cycle(tmp_path):
                 cluster.master, vid, timeout=30,
                 exclude_urls=(victim_url,),
             )
-            assert sched.totals["completed"] >= 1
+            assert sched.totals["completed"] >= completed_before + 1
             front._ec_locations.clear()
             await _verify_reads(front, blobs)
         finally:

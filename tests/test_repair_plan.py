@@ -106,6 +106,10 @@ class _FakeTopo:
     def to_info(self):
         return self.info
 
+    def data_nodes(self):
+        # the r23 pod census: no nodes -> no pod failure domains
+        return []
+
 
 class _FakeMaster:
     def __init__(self):

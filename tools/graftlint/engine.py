@@ -274,6 +274,7 @@ def lint_one_file(
     raw += rules.check_silent_swallow(unit.tree, path)
     raw += rules.check_unbounded_rpc(unit.tree, path, set(rpcs))
     raw += rules.check_unsharded_device_put(unit.tree, path)
+    raw += rules.check_process_local_device(unit.tree, path)
     raw += rules.check_untagged_device_dispatch(unit.tree, path)
     raw += flow.check_view_escape(unit.tree, path)
     raw += flow.check_use_after_donate(unit.tree, path)

@@ -39,6 +39,7 @@ _README_ROW_RE = re.compile(r"^\|\s*`(-(?:ec|obs)\.[^`]+)`")
 # -obs.incident.*) must precede their parent's catch-all entry.
 CONFIG_OWNERS: tuple[tuple[str, str], ...] = (
     ("-ec.serving.", "seaweedfs_tpu/serving/config.py"),
+    ("-ec.mesh.", "seaweedfs_tpu/serving/config.py"),
     ("-ec.qos.", "seaweedfs_tpu/serving/config.py"),
     ("-ec.tier.", "seaweedfs_tpu/serving/config.py"),
     ("-ec.ingest.", "seaweedfs_tpu/ingest/config.py"),

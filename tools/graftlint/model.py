@@ -203,6 +203,17 @@ STAGE_DRIFT = register(
     "table and a segment that silently reads as zero",
     'TRACE_STAGES = (..., "ghost_stage")  # nothing records it',
 )
+PROCESS_LOCAL_DEVICE = register(
+    "GL118",
+    "process-local-device-assumption",
+    "a direct jax.devices()/jax.local_devices()/jax.device_count()/"
+    "jax.local_device_count() call in the placement-policy scope "
+    "(parallel/serving/ops) instead of the parallel.mesh helpers — on "
+    "a multi-process mesh the local and global device sets differ, so "
+    "a mesh or budget sized off the raw enumeration silently shrinks "
+    "to one host's chips (or double-counts the pod's)",
+    "n = len(jax.devices())  # process-local on a pod; use parallel.mesh",
+)
 
 
 def rule_table_markdown() -> str:

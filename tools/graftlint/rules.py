@@ -16,6 +16,7 @@ from .model import (
     DEVICE_SYNC,
     JIT_STATIC,
     METRIC_REGISTRY,
+    PROCESS_LOCAL_DEVICE,
     SILENT_SWALLOW,
     STAGE_REGISTRY,
     UNBOUNDED_RPC,
@@ -636,6 +637,53 @@ def check_unsharded_device_put(
             "pass a NamedSharding (lane-sharded residency), the owning "
             "device, or waive a deliberate default-device staging with "
             "a reason",
+        )
+
+
+# ------------------------------------ GL118 process-local-device-assumption
+
+# Direct jax device enumeration in the placement-policy scope.  On a
+# multi-process (pod-scale) mesh, jax.devices()/jax.device_count() span
+# the POD while jax.local_devices()/jax.local_device_count() cover one
+# host — code that sizes a mesh, a budget, or a placement decision off
+# whichever it happened to call breaks the moment -ec.mesh.processCount
+# goes above 1.  parallel.mesh owns the distinction (local_devices /
+# global_devices / serving_mesh / global_serving_mesh and the canonical
+# device order); everything in scope must route through it.  mesh.py
+# itself is IN scope — its raw calls carry reasoned waivers, which also
+# keeps the waiver channel (GL113) honest about them.
+_PROCESS_LOCAL_DEVICE_CALLS = frozenset({
+    "jax.devices",
+    "jax.local_devices",
+    "jax.device_count",
+    "jax.local_device_count",
+})
+
+
+def check_process_local_device(
+    tree: ast.Module, path: str
+) -> Iterator[Finding]:
+    """Any call of the four raw enumeration entry points (dotted
+    `jax.` form) inside the device-put scope is a finding — bare
+    imported names are not flagged, since the parallel.mesh helpers
+    themselves share those names (`local_devices()` there IS the
+    sanctioned call)."""
+    if not in_device_put_scope(path):
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func) or ""
+        if name not in _PROCESS_LOCAL_DEVICE_CALLS:
+            continue
+        yield Finding(
+            PROCESS_LOCAL_DEVICE.rule_id, path, node.lineno,
+            f"{name}() is process-local (or pod-global) raw device "
+            "enumeration — size meshes and budgets through the "
+            "parallel.mesh helpers (local_devices/global_devices/"
+            "serving_mesh/global_serving_mesh) so single-process and "
+            "pod-scale deployments agree, or waive the deliberate raw "
+            "call with a reason",
         )
 
 
